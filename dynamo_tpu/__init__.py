@@ -1,0 +1,9 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+A ground-up rebuild of the capability surface of NVIDIA Dynamo (see SURVEY.md)
+for TPU pods: first-party JAX/XLA/Pallas engine, self-contained control hub
+(discovery/leases/events/queues), KV-aware routing, multi-tier paged-KV block
+management, and disaggregated prefill/decode over ICI/DCN.
+"""
+
+__version__ = "0.1.0"

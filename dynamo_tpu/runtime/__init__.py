@@ -1,0 +1,41 @@
+"""Distributed runtime core (L2): engines, pipelines, components, transports."""
+
+from .engine import (
+    Annotated,
+    AsyncEngine,
+    AsyncEngineContext,
+    Context,
+    ResponseStream,
+    as_response_stream,
+)
+from .pipeline import MapOperator, Operator, link
+from .component import (
+    Client,
+    Component,
+    DistributedRuntime,
+    Endpoint,
+    Instance,
+    Namespace,
+    PushRouter,
+    RouterMode,
+)
+
+__all__ = [
+    "Annotated",
+    "AsyncEngine",
+    "AsyncEngineContext",
+    "Client",
+    "Component",
+    "Context",
+    "DistributedRuntime",
+    "Endpoint",
+    "Instance",
+    "MapOperator",
+    "Namespace",
+    "Operator",
+    "PushRouter",
+    "ResponseStream",
+    "RouterMode",
+    "as_response_stream",
+    "link",
+]

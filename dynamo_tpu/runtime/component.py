@@ -1,0 +1,444 @@
+"""Component model: DistributedRuntime -> Namespace -> Component -> Endpoint.
+
+Reference parity: lib/runtime/src/component.rs (naming hierarchy, instance
+registration under ``instances/{ns}/{comp}/{ep}:{lease_hex}``), endpoint.rs
+(serving = register subject handler + etcd instance key under the primary
+lease), client.rs (prefix watch -> live instance list).  The TPU build keeps
+the identical keyspace and subject naming so operational tooling translates
+1:1, but both planes ride the first-party hub / data plane instead of
+etcd + NATS.
+
+Serving an endpoint:
+
+    rt = await DistributedRuntime.detached(hub_addr)        # or .static()
+    ep = rt.namespace("dynamo").component("backend").endpoint("generate")
+    await ep.serve(my_engine)          # my_engine: AsyncEngine[dict, Annotated]
+
+Calling it:
+
+    client = await ep.client()
+    router = PushRouter(client, RouterMode.ROUND_ROBIN)
+    stream = await router.generate(Context.new({"prompt": ...}))
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import os
+import socket
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from .engine import (
+    Annotated,
+    AsyncEngine,
+    AsyncEngineContext,
+    Context,
+    ResponseStream,
+    ensure_response_stream,
+)
+from .transports.client import HubClient, StaticHub, WatchHandle
+from .transports.request_plane import DataPlaneClient, DataPlaneServer, RemoteError
+
+logger = logging.getLogger("dynamo.runtime")
+
+INSTANCE_ROOT_PATH = "instances"  # reference: component.rs:64
+
+
+@dataclass(frozen=True)
+class Instance:
+    """A live serving instance of an endpoint (reference component.rs:84-96)."""
+
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: int  # lease id; unique per process lifetime
+    host: str
+    port: int
+    subject: str
+
+    @property
+    def etcd_key(self) -> str:
+        return (
+            f"{INSTANCE_ROOT_PATH}/{self.namespace}/{self.component}/"
+            f"{self.endpoint}:{self.instance_id:x}"
+        )
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_json(cls, blob: bytes) -> "Instance":
+        return cls(**json.loads(blob))
+
+
+def _advertise_host() -> str:
+    host = os.environ.get("DYN_ADVERTISE_HOST")
+    if host:
+        return host
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+class DistributedRuntime:
+    """Cluster handle: hub client + shared data plane + primary lease.
+
+    Reference: lib/runtime/src/distributed.rs.  ``static_mode`` (no hub
+    server, in-process state) mirrors distributed.rs:85.
+    """
+
+    def __init__(self, hub, static_mode: bool) -> None:
+        self.hub = hub
+        self.static_mode = static_mode
+        self.primary_lease: int = 0
+        self.data_server = DataPlaneServer(host=os.environ.get("DYN_BIND_HOST", "0.0.0.0"))
+        self.data_client = DataPlaneClient()
+        self._data_server_started = False
+        # Local engine registry: subject -> engine, for zero-copy in-process
+        # dispatch when caller and worker share an event loop.
+        self.local_engines: Dict[str, AsyncEngine] = {}
+        self._shutdown = asyncio.Event()
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    async def detached(
+        cls, hub_addr: Optional[str] = None, lease_ttl: float = 5.0
+    ) -> "DistributedRuntime":
+        """Connect to a hub (``host:port``; env ``DYN_HUB_ADDRESS``)."""
+        addr = hub_addr or os.environ.get("DYN_HUB_ADDRESS", "127.0.0.1:6650")
+        host, _, port = addr.rpartition(":")
+        hub = await HubClient(host or "127.0.0.1", int(port)).connect()
+        rt = cls(hub, static_mode=False)
+        rt.primary_lease = await hub.lease_grant(ttl=lease_ttl)
+        return rt
+
+    @classmethod
+    async def static(cls, hub: Optional[StaticHub] = None) -> "DistributedRuntime":
+        rt = cls(hub or StaticHub(), static_mode=True)
+        rt.primary_lease = await rt.hub.lease_grant()
+        return rt
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def ensure_data_server(self) -> None:
+        if not self._data_server_started:
+            self.data_server.advertise_host = (
+                "127.0.0.1" if self.static_mode else _advertise_host()
+            )
+            await self.data_server.start()
+            self._data_server_started = True
+
+    async def shutdown(self) -> None:
+        self._shutdown.set()
+        with contextlib.suppress(Exception):
+            if self.primary_lease and not self.static_mode:
+                await self.hub.lease_revoke(self.primary_lease)
+        await self.data_client.close()
+        if self._data_server_started:
+            await self.data_server.stop()
+        await self.hub.close()
+
+    def namespace(self, name: str) -> "Namespace":
+        return Namespace(self, name)
+
+
+@dataclass
+class Namespace:
+    runtime: DistributedRuntime
+    name: str
+
+    def component(self, name: str) -> "Component":
+        return Component(self.runtime, self.name, name)
+
+    def event_subject(self, topic: str) -> str:
+        """Events ride ``{ns}.events.{topic}`` (reference traits/events.rs)."""
+        return f"{self.name}.events.{topic}"
+
+    async def publish(self, topic: str, payload: Dict[str, Any]) -> None:
+        await self.runtime.hub.publish(
+            self.event_subject(topic), json.dumps(payload).encode()
+        )
+
+    async def subscribe(self, topic: str):
+        return await self.runtime.hub.subscribe(self.event_subject(topic))
+
+
+@dataclass
+class Component:
+    runtime: DistributedRuntime
+    namespace: str
+    name: str
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self.runtime, self.namespace, self.name, name)
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Endpoint:
+    runtime: DistributedRuntime
+    namespace: str
+    component: str
+    name: str
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/{self.component}/{self.name}"
+
+    @property
+    def instance_prefix(self) -> str:
+        return (
+            f"{INSTANCE_ROOT_PATH}/{self.namespace}/{self.component}/{self.name}:"
+        )
+
+    def subject_for(self, instance_id: int) -> str:
+        # Reference subject shape: "{ns}_{comp}.{ep}-{lease_hex}"
+        return f"{self.namespace}_{self.component}.{self.name}-{instance_id:x}"
+
+    async def serve(
+        self,
+        engine: AsyncEngine,
+        *,
+        metrics_handler=None,
+    ) -> Instance:
+        """Serve ``engine`` on this endpoint.
+
+        Registers the subject on the process data-plane server and writes the
+        instance key under the runtime's primary lease: lease loss removes the
+        key, and every watching client drops the instance — identical
+        liveness semantics to reference endpoint.rs:115-134.
+        """
+        rt = self.runtime
+        await rt.ensure_data_server()
+        instance_id = rt.primary_lease
+        subject = self.subject_for(instance_id)
+        host, port = rt.data_server.address
+        instance = Instance(
+            namespace=self.namespace,
+            component=self.component,
+            endpoint=self.name,
+            instance_id=instance_id,
+            host=host,
+            port=port,
+            subject=subject,
+        )
+
+        handler = _IngressHandler(engine)
+        rt.data_server.register(subject, handler)
+        rt.local_engines[subject] = engine
+        created = await rt.hub.kv_create(
+            instance.etcd_key, instance.to_json(), lease=rt.primary_lease
+        )
+        if not created:
+            await rt.hub.kv_put(
+                instance.etcd_key, instance.to_json(), lease=rt.primary_lease
+            )
+        logger.info("serving %s as instance %x at %s:%d",
+                    self.path, instance_id, host, port)
+        return instance
+
+    async def client(self) -> "Client":
+        c = Client(self)
+        await c.start()
+        return c
+
+
+class _IngressHandler:
+    """Byte-level ingress: JSON payload -> Context -> engine -> JSON items.
+
+    Reference: Ingress::handle_payload (network/ingress/push_handler.rs:25) —
+    rebuild the Context with the caller's request id so cancellation and
+    tracing stay end-to-end.
+    """
+
+    def __init__(self, engine: AsyncEngine) -> None:
+        self.engine = engine
+
+    async def __call__(
+        self, hdr: Dict[str, Any], payload: bytes, ctx: AsyncEngineContext
+    ) -> AsyncIterator[bytes]:
+        data = json.loads(payload) if payload else None
+        request = Context(data=data, ctx=ctx, metadata=hdr.get("meta") or {})
+        stream = await self.engine.generate(request)
+
+        async def gen() -> AsyncIterator[bytes]:
+            # Wire contract: every item is an Annotated envelope.  Engines may
+            # yield Annotated (signals/errors) or raw payloads (wrapped here).
+            async for item in stream:
+                if not isinstance(item, Annotated):
+                    item = Annotated.from_data(item)
+                yield json.dumps(item.to_dict()).encode()
+
+        return gen()
+
+
+class Client:
+    """Endpoint client: live instance list via hub prefix watch.
+
+    Reference: component/client.rs (etcd prefix watcher -> watch channel of
+    ``Vec<Instance>``).
+    """
+
+    def __init__(self, endpoint: Endpoint) -> None:
+        self.endpoint = endpoint
+        self.instances: List[Instance] = []
+        self._by_key: Dict[str, Instance] = {}
+        self._watch: Optional[WatchHandle] = None
+        self._task: Optional[asyncio.Task] = None
+        self._changed = asyncio.Event()
+
+    async def start(self) -> None:
+        self._watch = await self.endpoint.runtime.hub.watch_prefix(
+            self.endpoint.instance_prefix
+        )
+        for key, value in self._watch.snapshot:
+            self._by_key[key] = Instance.from_json(value)
+        self._rebuild()
+        self._task = asyncio.create_task(self._pump())
+
+    def _rebuild(self) -> None:
+        self.instances = sorted(
+            self._by_key.values(), key=lambda i: i.instance_id
+        )
+        self._changed.set()
+
+    async def _pump(self) -> None:
+        assert self._watch is not None
+        with contextlib.suppress(asyncio.CancelledError):
+            while True:
+                ev = await self._watch.events.get()
+                if ev.type == "put":
+                    self._by_key[ev.key] = Instance.from_json(ev.value)
+                else:
+                    self._by_key.pop(ev.key, None)
+                self._rebuild()
+
+    async def wait_for_instances(self, timeout: float = 10.0) -> List[Instance]:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not self.instances:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"no instances for {self.endpoint.path} after {timeout}s"
+                )
+            self._changed.clear()
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._changed.wait(), remaining)
+        return self.instances
+
+    def instance_ids(self) -> List[int]:
+        return [i.instance_id for i in self.instances]
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+        if self._watch:
+            await self._watch.close()
+
+
+class RouterMode(str, Enum):
+    ROUND_ROBIN = "round_robin"
+    RANDOM = "random"
+    DIRECT = "direct"
+
+
+class PushRouter:
+    """Instance selection + remote dispatch (reference push_router.rs:35-203).
+
+    ``generate`` picks an instance (round-robin / random), ``direct`` targets
+    a specific instance id (the KV router uses this after best-match).
+    Yields :class:`Annotated` items.
+    """
+
+    def __init__(
+        self, client: Client, mode: RouterMode = RouterMode.ROUND_ROBIN
+    ) -> None:
+        self.client = client
+        self.mode = mode
+        self._rr = 0
+
+    def _pick(self) -> Instance:
+        instances = self.client.instances
+        if not instances:
+            raise RuntimeError(
+                f"no instances available for {self.client.endpoint.path}"
+            )
+        if self.mode == RouterMode.RANDOM:
+            import random
+
+            return random.choice(instances)
+        inst = instances[self._rr % len(instances)]
+        self._rr += 1
+        return inst
+
+    async def generate(
+        self, request: Context[Any]
+    ) -> ResponseStream[Annotated]:
+        return await self._dispatch(self._pick(), request)
+
+    async def direct(
+        self, request: Context[Any], instance_id: int
+    ) -> ResponseStream[Annotated]:
+        for inst in self.client.instances:
+            if inst.instance_id == instance_id:
+                return await self._dispatch(inst, request)
+        raise RuntimeError(f"instance {instance_id:x} not found")
+
+    async def random(self, request: Context[Any]) -> ResponseStream[Annotated]:
+        self.mode = RouterMode.RANDOM
+        return await self.generate(request)
+
+    async def round_robin(self, request: Context[Any]) -> ResponseStream[Annotated]:
+        self.mode = RouterMode.ROUND_ROBIN
+        return await self.generate(request)
+
+    async def _dispatch(
+        self, inst: Instance, request: Context[Any]
+    ) -> ResponseStream[Annotated]:
+        rt = self.client.endpoint.runtime
+        # In-process fast path: skip serialization when the instance lives in
+        # this very process (static mode pipelines).  Items are wrapped into
+        # the same Annotated envelope the remote path produces, so the stream
+        # type does not depend on deployment mode.
+        local = rt.local_engines.get(inst.subject)
+        if local is not None:
+            stream = ensure_response_stream(
+                request.ctx, await local.generate(request)
+            )
+
+            async def local_gen() -> AsyncIterator[Annotated]:
+                async for item in stream:
+                    if not isinstance(item, Annotated):
+                        item = Annotated.from_data(item)
+                    yield item
+
+            return ResponseStream(request.ctx, local_gen())
+
+        payload = json.dumps(request.data).encode()
+        byte_stream = await rt.data_client.request(
+            inst.host,
+            inst.port,
+            inst.subject,
+            request.id,
+            request.metadata,
+            payload,
+            request.ctx,
+        )
+
+        async def gen() -> AsyncIterator[Annotated]:
+            async for raw in byte_stream:
+                yield Annotated.from_dict(json.loads(raw))
+
+        return ResponseStream(request.ctx, gen())

@@ -1,0 +1,391 @@
+"""Hub client: the async API every runtime component uses for discovery,
+events, queues and small objects.
+
+Two interchangeable implementations:
+
+* :class:`HubClient` -- TCP connection to a :class:`~.hub.HubServer`
+  (distributed mode).
+* :class:`StaticHub` -- in-process :class:`~.hub.HubState` (static mode, no
+  sockets; reference distributed.rs:85 "static mode, no etcd").
+
+Both expose the same coroutine surface, so Namespace/Component/Endpoint and
+everything above them is transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+import logging
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+from .codec import read_frame, write_frame
+from .hub import HubState, WatchEvent
+
+logger = logging.getLogger("dynamo.hub.client")
+
+
+@dataclass
+class WatchHandle:
+    """A live prefix watch: initial snapshot + a stream of deltas."""
+
+    snapshot: List[Tuple[str, bytes]]
+    events: "asyncio.Queue[WatchEvent]"
+    watch_id: int
+    _close: Any = None
+
+    async def close(self) -> None:
+        if self._close is not None:
+            await self._close()
+
+    async def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        while True:
+            yield await self.events.get()
+
+
+@dataclass
+class Subscription:
+    queue: "asyncio.Queue[Tuple[str, bytes]]"
+    sub_id: int
+    _close: Any = None
+
+    async def next(self) -> Tuple[str, bytes]:
+        return await self.queue.get()
+
+    async def close(self) -> None:
+        if self._close is not None:
+            await self._close()
+
+    def __aiter__(self) -> "Subscription":
+        return self
+
+    async def __anext__(self) -> Tuple[str, bytes]:
+        return await self.queue.get()
+
+
+class HubClient:
+    """TCP client for HubServer with request/response correlation.
+
+    A single connection carries all ops; server-initiated frames (watch
+    events, subscription messages, blocking queue pops) are demuxed to their
+    owning handle's queue by id.
+    """
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host = host
+        self.port = port
+        self._seq = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watches: Dict[int, asyncio.Queue] = {}
+        self._subs: Dict[int, asyncio.Queue] = {}
+        # Events for ids whose local queue isn't registered yet: the pump can
+        # see a watch/sub frame before the registering coroutine resumes.
+        self._early: Dict[Tuple[str, int], list] = {}
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pump: Optional[asyncio.Task] = None
+        self._keepalives: Dict[int, asyncio.Task] = {}
+        self._send_lock = asyncio.Lock()
+
+    async def connect(self) -> "HubClient":
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        self._pump = asyncio.create_task(self._pump_loop())
+        return self
+
+    async def close(self) -> None:
+        for task in self._keepalives.values():
+            task.cancel()
+        if self._pump:
+            self._pump.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._pump
+        if self._writer:
+            self._writer.close()
+            with contextlib.suppress(Exception):
+                await self._writer.wait_closed()
+
+    async def _pump_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                if frame is None:
+                    break
+                hdr, payload = frame
+                if "watch" in hdr:
+                    ev = WatchEvent(hdr["type"], hdr["key"], payload)
+                    q = self._watches.get(hdr["watch"])
+                    if q is not None:
+                        q.put_nowait(ev)
+                    else:
+                        self._early.setdefault(("w", hdr["watch"]), []).append(ev)
+                elif "sub" in hdr:
+                    msg = (hdr["subject"], payload)
+                    q = self._subs.get(hdr["sub"])
+                    if q is not None:
+                        q.put_nowait(msg)
+                    else:
+                        self._early.setdefault(("s", hdr["sub"]), []).append(msg)
+                elif "seq" in hdr:
+                    fut = self._pending.pop(hdr["seq"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result((hdr, payload))
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("hub connection lost: %s", exc)
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("hub connection closed"))
+            self._pending.clear()
+
+    async def _call(
+        self, hdr: Dict[str, Any], payload: bytes = b""
+    ) -> Tuple[Dict[str, Any], bytes]:
+        assert self._writer is not None, "not connected"
+        seq = next(self._seq)
+        hdr["seq"] = seq
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[seq] = fut
+        async with self._send_lock:
+            write_frame(self._writer, hdr, payload)
+            await self._writer.drain()
+        return await fut
+
+    @staticmethod
+    def _check(hdr: Dict[str, Any]) -> Dict[str, Any]:
+        if not hdr.get("ok"):
+            raise RuntimeError(hdr.get("err", "hub op failed"))
+        return hdr
+
+    # -- kv ---------------------------------------------------------------
+
+    async def kv_put(self, key: str, value: bytes, lease: int = 0) -> None:
+        hdr, _ = await self._call({"op": "kv_put", "key": key, "lease": lease}, value)
+        self._check(hdr)
+
+    async def kv_create(self, key: str, value: bytes, lease: int = 0) -> bool:
+        hdr, _ = await self._call(
+            {"op": "kv_create", "key": key, "lease": lease}, value
+        )
+        return bool(hdr.get("ok"))
+
+    async def kv_get_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        hdr, blob = await self._call({"op": "kv_get", "prefix": prefix})
+        self._check(hdr)
+        return _split_entries(hdr["entries"], blob)
+
+    async def kv_delete(self, key: str) -> bool:
+        hdr, _ = await self._call({"op": "kv_delete", "key": key})
+        return bool(hdr.get("ok"))
+
+    async def kv_delete_prefix(self, prefix: str) -> int:
+        hdr, _ = await self._call({"op": "kv_delete_prefix", "prefix": prefix})
+        self._check(hdr)
+        return int(hdr.get("count", 0))
+
+    # -- leases -----------------------------------------------------------
+
+    async def lease_grant(self, ttl: float = 10.0, keepalive: bool = True) -> int:
+        hdr, _ = await self._call({"op": "lease_grant", "ttl": ttl})
+        self._check(hdr)
+        lease = int(hdr["lease"])
+        if keepalive:
+            self._keepalives[lease] = asyncio.create_task(
+                self._keepalive_loop(lease, ttl)
+            )
+        return lease
+
+    async def _keepalive_loop(self, lease: int, ttl: float) -> None:
+        interval = max(ttl / 3.0, 0.2)
+        with contextlib.suppress(asyncio.CancelledError, ConnectionError):
+            while True:
+                await asyncio.sleep(interval)
+                hdr, _ = await self._call({"op": "lease_keepalive", "lease": lease})
+                if not hdr.get("ok"):
+                    logger.error("lease %#x lost (keepalive rejected)", lease)
+                    return
+
+    async def lease_revoke(self, lease: int) -> None:
+        task = self._keepalives.pop(lease, None)
+        if task:
+            task.cancel()
+        hdr, _ = await self._call({"op": "lease_revoke", "lease": lease})
+        self._check(hdr)
+
+    # -- watch ------------------------------------------------------------
+
+    async def watch_prefix(self, prefix: str) -> WatchHandle:
+        q: asyncio.Queue = asyncio.Queue()
+        # Register the local queue under the id the server hands back; events
+        # can only start flowing after the response, so there is no race.
+        hdr, blob = await self._call({"op": "watch", "prefix": prefix})
+        self._check(hdr)
+        wid = int(hdr["watch_id"])
+        self._watches[wid] = q
+        for ev in self._early.pop(("w", wid), ()):
+            q.put_nowait(ev)
+        snapshot = _split_entries(hdr["entries"], blob)
+
+        async def close() -> None:
+            self._watches.pop(wid, None)
+            with contextlib.suppress(Exception):
+                await self._call({"op": "unwatch", "watch_id": wid})
+
+        return WatchHandle(snapshot=snapshot, events=q, watch_id=wid, _close=close)
+
+    # -- pub/sub ----------------------------------------------------------
+
+    async def publish(self, subject: str, payload: bytes) -> int:
+        hdr, _ = await self._call({"op": "publish", "subject": subject}, payload)
+        self._check(hdr)
+        return int(hdr.get("receivers", 0))
+
+    async def subscribe(self, pattern: str) -> Subscription:
+        hdr, _ = await self._call({"op": "subscribe", "pattern": pattern})
+        self._check(hdr)
+        sid = int(hdr["sub_id"])
+        q: asyncio.Queue = asyncio.Queue()
+        self._subs[sid] = q
+        for msg in self._early.pop(("s", sid), ()):
+            q.put_nowait(msg)
+
+        async def close() -> None:
+            self._subs.pop(sid, None)
+            with contextlib.suppress(Exception):
+                await self._call({"op": "unsubscribe", "sub_id": sid})
+
+        return Subscription(queue=q, sub_id=sid, _close=close)
+
+    # -- queues -----------------------------------------------------------
+
+    async def queue_push(self, queue: str, payload: bytes) -> None:
+        hdr, _ = await self._call({"op": "queue_push", "queue": queue}, payload)
+        self._check(hdr)
+
+    async def queue_pop(
+        self, queue: str, block: bool = True
+    ) -> Optional[bytes]:
+        hdr, payload = await self._call(
+            {"op": "queue_pop", "queue": queue, "block": block}
+        )
+        self._check(hdr)
+        return payload if hdr.get("found") else None
+
+    async def queue_depth(self, queue: str) -> int:
+        hdr, _ = await self._call({"op": "queue_depth", "queue": queue})
+        self._check(hdr)
+        return int(hdr["depth"])
+
+    # -- objects ----------------------------------------------------------
+
+    async def obj_put(self, name: str, blob: bytes) -> None:
+        hdr, _ = await self._call({"op": "obj_put", "name": name}, blob)
+        self._check(hdr)
+
+    async def obj_get(self, name: str) -> Optional[bytes]:
+        hdr, blob = await self._call({"op": "obj_get", "name": name})
+        if not hdr.get("ok"):
+            return None
+        return blob
+
+
+def _split_entries(
+    metas: List[Dict[str, Any]], blob: bytes
+) -> List[Tuple[str, bytes]]:
+    out = []
+    off = 0
+    for m in metas:
+        n = int(m["len"])
+        out.append((m["key"], blob[off : off + n]))
+        off += n
+    return out
+
+
+class StaticHub:
+    """In-process hub: same surface as HubClient, zero sockets.
+
+    Used for single-process serving ("static mode") and unit tests; also the
+    lease semantics degenerate to no-ops (nothing can crash independently).
+    """
+
+    def __init__(self, state: Optional[HubState] = None) -> None:
+        self.state = state or HubState()
+        self._lease_seq = itertools.count(0x9000)
+
+    async def connect(self) -> "StaticHub":
+        return self
+
+    async def close(self) -> None:
+        pass
+
+    async def kv_put(self, key: str, value: bytes, lease: int = 0) -> None:
+        self.state.kv_put(key, value, 0)
+
+    async def kv_create(self, key: str, value: bytes, lease: int = 0) -> bool:
+        try:
+            self.state.kv_create(key, value, 0)
+            return True
+        except FileExistsError:
+            return False
+
+    async def kv_get_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        return [(e.key, e.value) for e in self.state.kv_get_prefix(prefix)]
+
+    async def kv_delete(self, key: str) -> bool:
+        return self.state.kv_delete(key)
+
+    async def kv_delete_prefix(self, prefix: str) -> int:
+        return self.state.kv_delete_prefix(prefix)
+
+    async def lease_grant(self, ttl: float = 10.0, keepalive: bool = True) -> int:
+        return next(self._lease_seq)
+
+    async def lease_revoke(self, lease: int) -> None:
+        pass
+
+    async def watch_prefix(self, prefix: str) -> WatchHandle:
+        q: asyncio.Queue = asyncio.Queue()
+        wid = self.state.watch_add(prefix, q.put_nowait)
+        snapshot = [(e.key, e.value) for e in self.state.kv_get_prefix(prefix)]
+
+        async def close() -> None:
+            self.state.watch_remove(wid)
+
+        return WatchHandle(snapshot=snapshot, events=q, watch_id=wid, _close=close)
+
+    async def publish(self, subject: str, payload: bytes) -> int:
+        return self.state.publish(subject, payload)
+
+    async def subscribe(self, pattern: str) -> Subscription:
+        q: asyncio.Queue = asyncio.Queue()
+        sid = self.state.subscribe(pattern, lambda s, p: q.put_nowait((s, p)))
+
+        async def close() -> None:
+            self.state.unsubscribe(sid)
+
+        return Subscription(queue=q, sub_id=sid, _close=close)
+
+    async def queue_push(self, queue: str, payload: bytes) -> None:
+        self.state.queue_push(queue, payload)
+
+    async def queue_pop(self, queue: str, block: bool = True) -> Optional[bytes]:
+        item = self.state.queue_try_pop(queue)
+        if item is not None or not block:
+            return item
+        fut = self.state.queue_wait(queue)
+        return await fut
+
+    async def queue_depth(self, queue: str) -> int:
+        return self.state.queue_depth(queue)
+
+    async def obj_put(self, name: str, blob: bytes) -> None:
+        self.state.objects[name] = blob
+
+    async def obj_get(self, name: str) -> Optional[bytes]:
+        return self.state.objects.get(name)

@@ -1,0 +1,55 @@
+"""Wire framing for the control hub and the request/response plane.
+
+Two-part frames: a small JSON header and an opaque binary payload, each
+length-prefixed (u32 big-endian).  Reference parity: TwoPartCodec
+(lib/runtime/src/pipeline/network/codec/two_part.rs) which frames
+{RequestControlMessage, payload} the same way; re-used here for every plane
+(hub RPC, request plane, response stream) instead of mixing NATS messages and
+raw TCP.
+
+Frame layout:  [u32 header_len][u32 payload_len][header JSON][payload bytes]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+_LEN = struct.Struct(">II")
+
+# 64 MiB hard cap per frame: a corrupt length prefix should fail fast, not OOM.
+MAX_FRAME = 64 * 1024 * 1024
+
+
+def encode_frame(header: Dict[str, Any], payload: bytes = b"") -> bytes:
+    hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    if len(hdr) > MAX_FRAME or len(payload) > MAX_FRAME:
+        raise ValueError("frame exceeds MAX_FRAME")
+    return _LEN.pack(len(hdr), len(payload)) + hdr + payload
+
+
+async def read_frame(
+    reader: asyncio.StreamReader,
+) -> Optional[Tuple[Dict[str, Any], bytes]]:
+    """Read one frame; returns None on clean EOF at a frame boundary."""
+    try:
+        prefix = await reader.readexactly(_LEN.size)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    hdr_len, payload_len = _LEN.unpack(prefix)
+    if hdr_len > MAX_FRAME or payload_len > MAX_FRAME:
+        raise ValueError(f"oversized frame: hdr={hdr_len} payload={payload_len}")
+    try:
+        hdr_bytes = await reader.readexactly(hdr_len)
+        payload = await reader.readexactly(payload_len) if payload_len else b""
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return json.loads(hdr_bytes), payload
+
+
+def write_frame(
+    writer: asyncio.StreamWriter, header: Dict[str, Any], payload: bytes = b""
+) -> None:
+    writer.write(encode_frame(header, payload))

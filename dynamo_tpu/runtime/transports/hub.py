@@ -1,0 +1,460 @@
+"""The control hub: discovery KV + leases + prefix watches + pub/sub + queues
++ object store, as one embeddable asyncio service.
+
+The reference splits its L1 infra across *external* services: etcd (leases,
+prefix watches; lib/runtime/src/transports/etcd.rs), NATS core (request
+subjects, events), NATS JetStream (prefill queue), and the NATS object store
+(model cards) (lib/runtime/src/transports/nats.rs).  The TPU build ships its
+control plane first-party instead: a single hub process (or in-process task)
+speaking the two-part frame codec, providing the same primitives:
+
+  * ``kv_*``        -- key-value with atomic create, prefix get/delete
+  * ``lease_*``     -- TTL leases with keepalive; lease loss deletes its keys
+                       (liveness = leases, exactly as in the reference)
+  * ``watch``       -- prefix watch: initial dump + put/delete deltas
+  * ``publish/subscribe`` -- subject-based events ("ns.events.kv_events", ...)
+  * ``queue_*``     -- FIFO work queues with blocking pop (prefill queue)
+  * ``obj_put/obj_get``   -- small-object store (model cards, tokenizer blobs)
+
+Bulk data (response streams, KV pages) never transits the hub -- it flows
+peer-to-peer over the TCP data plane (``request_plane.py``) or over ICI/DCN
+(block manager transfer engine).
+
+``StaticHub`` implements the same client interface fully in-process for
+single-node / test use (reference "static mode": distributed.rs:85).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import contextlib
+import fnmatch
+import itertools
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+from .codec import read_frame, write_frame
+
+logger = logging.getLogger("dynamo.hub")
+
+# ---------------------------------------------------------------------------
+# Shared data model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KvEntry:
+    key: str
+    value: bytes
+    lease_id: int = 0
+    revision: int = 0
+
+
+@dataclass
+class WatchEvent:
+    """One delta on a watched prefix. type: 'put' | 'delete'."""
+
+    type: str
+    key: str
+    value: bytes = b""
+
+
+def _subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style matching: '.' separated tokens, '*' one token, '>' tail."""
+    if pattern == subject:
+        return True
+    p_toks = pattern.split(".")
+    s_toks = subject.split(".")
+    for i, pt in enumerate(p_toks):
+        if pt == ">":
+            return True
+        if i >= len(s_toks):
+            return False
+        if pt != "*" and pt != s_toks[i]:
+            return False
+    return len(p_toks) == len(s_toks)
+
+
+# ---------------------------------------------------------------------------
+# Core state machine (shared by the TCP server and StaticHub)
+# ---------------------------------------------------------------------------
+
+
+class HubState:
+    """The hub's data: pure in-memory state + waiter bookkeeping.
+
+    All mutation happens on one event loop, so no locks are needed
+    (the same single-writer discipline the reference applies to its radix
+    tree and etcd caches).
+    """
+
+    def __init__(self) -> None:
+        self.kv: Dict[str, KvEntry] = {}
+        self.revision = 0
+        self.leases: Dict[int, float] = {}  # lease_id -> expiry monotonic time
+        self.lease_ttl: Dict[int, float] = {}
+        self.lease_keys: Dict[int, set] = collections.defaultdict(set)
+        self._lease_seq = itertools.count(0x1000)
+        # prefix -> list of callbacks(WatchEvent)
+        self.watchers: Dict[int, Tuple[str, Callable[[WatchEvent], None]]] = {}
+        self._watch_seq = itertools.count(1)
+        # sub_id -> (pattern, callback(subject, payload))
+        self.subs: Dict[int, Tuple[str, Callable[[str, bytes], None]]] = {}
+        self._sub_seq = itertools.count(1)
+        self.queues: Dict[str, collections.deque] = collections.defaultdict(
+            collections.deque
+        )
+        self.queue_waiters: Dict[str, collections.deque] = collections.defaultdict(
+            collections.deque
+        )
+        self.objects: Dict[str, bytes] = {}
+
+    # -- kv ---------------------------------------------------------------
+
+    def _notify(self, ev: WatchEvent) -> None:
+        for prefix, cb in list(self.watchers.values()):
+            if ev.key.startswith(prefix):
+                cb(ev)
+
+    def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        if lease_id and lease_id not in self.leases:
+            raise KeyError(f"unknown lease {lease_id:#x}")
+        self.revision += 1
+        self.kv[key] = KvEntry(key, value, lease_id, self.revision)
+        if lease_id:
+            self.lease_keys[lease_id].add(key)
+        self._notify(WatchEvent("put", key, value))
+        return self.revision
+
+    def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        """Atomic create: fails if the key exists (etcd txn version==0)."""
+        if key in self.kv:
+            raise FileExistsError(key)
+        return self.kv_put(key, value, lease_id)
+
+    def kv_get_prefix(self, prefix: str) -> List[KvEntry]:
+        return [e for k, e in sorted(self.kv.items()) if k.startswith(prefix)]
+
+    def kv_delete(self, key: str) -> bool:
+        entry = self.kv.pop(key, None)
+        if entry is None:
+            return False
+        if entry.lease_id:
+            self.lease_keys[entry.lease_id].discard(key)
+        self.revision += 1
+        self._notify(WatchEvent("delete", key))
+        return True
+
+    def kv_delete_prefix(self, prefix: str) -> int:
+        keys = [k for k in self.kv if k.startswith(prefix)]
+        for k in keys:
+            self.kv_delete(k)
+        return len(keys)
+
+    # -- leases -----------------------------------------------------------
+
+    def lease_grant(self, ttl: float) -> int:
+        lease_id = next(self._lease_seq)
+        self.leases[lease_id] = time.monotonic() + ttl
+        self.lease_ttl[lease_id] = ttl
+        return lease_id
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        if lease_id not in self.leases:
+            return False
+        self.leases[lease_id] = time.monotonic() + self.lease_ttl[lease_id]
+        return True
+
+    def lease_revoke(self, lease_id: int) -> None:
+        self.leases.pop(lease_id, None)
+        self.lease_ttl.pop(lease_id, None)
+        for key in list(self.lease_keys.pop(lease_id, ())):
+            self.kv_delete(key)
+
+    def expire_leases(self) -> None:
+        now = time.monotonic()
+        for lease_id, expiry in list(self.leases.items()):
+            if expiry < now:
+                logger.warning("lease %#x expired; dropping its keys", lease_id)
+                self.lease_revoke(lease_id)
+
+    # -- watch ------------------------------------------------------------
+
+    def watch_add(self, prefix: str, cb: Callable[[WatchEvent], None]) -> int:
+        wid = next(self._watch_seq)
+        self.watchers[wid] = (prefix, cb)
+        return wid
+
+    def watch_remove(self, wid: int) -> None:
+        self.watchers.pop(wid, None)
+
+    # -- pub/sub ----------------------------------------------------------
+
+    def subscribe(self, pattern: str, cb: Callable[[str, bytes], None]) -> int:
+        sid = next(self._sub_seq)
+        self.subs[sid] = (pattern, cb)
+        return sid
+
+    def unsubscribe(self, sid: int) -> None:
+        self.subs.pop(sid, None)
+
+    def publish(self, subject: str, payload: bytes) -> int:
+        n = 0
+        for pattern, cb in list(self.subs.values()):
+            if _subject_matches(pattern, subject):
+                cb(subject, payload)
+                n += 1
+        return n
+
+    # -- queues -----------------------------------------------------------
+
+    def queue_push(self, queue: str, payload: bytes) -> None:
+        waiters = self.queue_waiters.get(queue)
+        while waiters:
+            fut = waiters.popleft()
+            if not fut.done():
+                fut.set_result(payload)
+                return
+        self.queues[queue].append(payload)
+
+    def queue_try_pop(self, queue: str) -> Optional[bytes]:
+        q = self.queues.get(queue)
+        if q:
+            return q.popleft()
+        return None
+
+    def queue_depth(self, queue: str) -> int:
+        return len(self.queues.get(queue, ()))
+
+    def queue_wait(self, queue: str) -> asyncio.Future:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.queue_waiters[queue].append(fut)
+        return fut
+
+
+# ---------------------------------------------------------------------------
+# TCP hub server
+# ---------------------------------------------------------------------------
+
+
+class HubServer:
+    """Serves HubState over TCP with the two-part frame codec.
+
+    Ops are request/response correlated by ``seq``; watches, subscriptions and
+    blocking queue pops push server-initiated frames tagged with their id.
+    Connection drop removes that connection's watches/subs and revokes leases
+    it created (so a crashed worker disappears exactly like an expired etcd
+    lease in the reference).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.host = host
+        self.port = port
+        self.state = HubState()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._expiry_task: Optional[asyncio.Task] = None
+        self._conn_writers: set = set()
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._expiry_task = asyncio.create_task(self._expiry_loop())
+        logger.info("hub listening on %s:%d", self.host, self.port)
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._expiry_task:
+            self._expiry_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._expiry_task
+        if self._server:
+            self._server.close()
+            # Force-close live connections: wait_closed() (3.12+) blocks until
+            # every connection handler returns, and handlers read until EOF.
+            for w in list(self._conn_writers):
+                with contextlib.suppress(Exception):
+                    w.close()
+            await self._server.wait_closed()
+
+    async def _expiry_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            self.state.expire_leases()
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        st = self.state
+        self._conn_writers.add(writer)
+        conn_watches: list = []
+        conn_subs: list = []
+        conn_leases: list = []
+        conn_qwaiters: list = []
+        send_tasks: set = set()  # strong refs: loop holds only weak task refs
+        send_lock = asyncio.Lock()
+
+        async def send(hdr: Dict[str, Any], payload: bytes = b"") -> None:
+            async with send_lock:
+                try:
+                    write_frame(writer, hdr, payload)
+                    await writer.drain()
+                except (ConnectionError, RuntimeError):
+                    pass
+
+        def send_soon(hdr: Dict[str, Any], payload: bytes = b"") -> None:
+            task = asyncio.ensure_future(send(hdr, payload))
+            send_tasks.add(task)
+            task.add_done_callback(send_tasks.discard)
+
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                hdr, payload = frame
+                op = hdr.get("op")
+                seq = hdr.get("seq")
+                try:
+                    if op == "kv_put":
+                        rev = st.kv_put(hdr["key"], payload, hdr.get("lease", 0))
+                        await send({"seq": seq, "ok": True, "rev": rev})
+                    elif op == "kv_create":
+                        try:
+                            rev = st.kv_create(hdr["key"], payload, hdr.get("lease", 0))
+                            await send({"seq": seq, "ok": True, "rev": rev})
+                        except FileExistsError:
+                            await send({"seq": seq, "ok": False, "err": "exists"})
+                    elif op == "kv_get":
+                        entries = st.kv_get_prefix(hdr["prefix"])
+                        # values are base64-free: ship as concatenated frames
+                        metas = [
+                            {"key": e.key, "lease": e.lease_id, "rev": e.revision,
+                             "len": len(e.value)}
+                            for e in entries
+                        ]
+                        blob = b"".join(e.value for e in entries)
+                        await send({"seq": seq, "ok": True, "entries": metas}, blob)
+                    elif op == "kv_delete":
+                        ok = st.kv_delete(hdr["key"])
+                        await send({"seq": seq, "ok": ok})
+                    elif op == "kv_delete_prefix":
+                        n = st.kv_delete_prefix(hdr["prefix"])
+                        await send({"seq": seq, "ok": True, "count": n})
+                    elif op == "lease_grant":
+                        lease = st.lease_grant(float(hdr["ttl"]))
+                        conn_leases.append(lease)
+                        await send({"seq": seq, "ok": True, "lease": lease})
+                    elif op == "lease_keepalive":
+                        ok = st.lease_keepalive(hdr["lease"])
+                        await send({"seq": seq, "ok": ok})
+                    elif op == "lease_revoke":
+                        st.lease_revoke(hdr["lease"])
+                        await send({"seq": seq, "ok": True})
+                    elif op == "watch":
+                        prefix = hdr["prefix"]
+
+                        def on_event(ev: WatchEvent, _wid_holder=[None]) -> None:
+                            send_soon(
+                                {"watch": _wid_holder[0], "type": ev.type,
+                                 "key": ev.key},
+                                ev.value,
+                            )
+
+                        holder = on_event.__defaults__[0]
+                        wid = st.watch_add(prefix, on_event)
+                        holder[0] = wid
+                        conn_watches.append(wid)
+                        entries = st.kv_get_prefix(prefix)
+                        metas = [
+                            {"key": e.key, "len": len(e.value)} for e in entries
+                        ]
+                        blob = b"".join(e.value for e in entries)
+                        await send(
+                            {"seq": seq, "ok": True, "watch_id": wid,
+                             "entries": metas},
+                            blob,
+                        )
+                    elif op == "unwatch":
+                        st.watch_remove(hdr["watch_id"])
+                        await send({"seq": seq, "ok": True})
+                    elif op == "subscribe":
+                        pattern = hdr["pattern"]
+
+                        def on_msg(subject: str, data: bytes, _sid_holder=[None]):
+                            send_soon(
+                                {"sub": _sid_holder[0], "subject": subject}, data
+                            )
+
+                        sholder = on_msg.__defaults__[0]
+                        sid = st.subscribe(pattern, on_msg)
+                        sholder[0] = sid
+                        conn_subs.append(sid)
+                        await send({"seq": seq, "ok": True, "sub_id": sid})
+                    elif op == "unsubscribe":
+                        st.unsubscribe(hdr["sub_id"])
+                        await send({"seq": seq, "ok": True})
+                    elif op == "publish":
+                        n = st.publish(hdr["subject"], payload)
+                        await send({"seq": seq, "ok": True, "receivers": n})
+                    elif op == "queue_push":
+                        st.queue_push(hdr["queue"], payload)
+                        await send({"seq": seq, "ok": True})
+                    elif op == "queue_pop":
+                        item = st.queue_try_pop(hdr["queue"])
+                        if item is not None:
+                            await send({"seq": seq, "ok": True, "found": True}, item)
+                        elif not hdr.get("block"):
+                            await send({"seq": seq, "ok": True, "found": False})
+                        else:
+                            fut = st.queue_wait(hdr["queue"])
+                            conn_qwaiters.append(fut)
+
+                            def deliver(f: asyncio.Future, _seq=seq) -> None:
+                                if not f.cancelled():
+                                    send_soon(
+                                        {"seq": _seq, "ok": True, "found": True},
+                                        f.result(),
+                                    )
+
+                            fut.add_done_callback(deliver)
+                    elif op == "queue_depth":
+                        await send(
+                            {"seq": seq, "ok": True,
+                             "depth": st.queue_depth(hdr["queue"])}
+                        )
+                    elif op == "obj_put":
+                        st.objects[hdr["name"]] = payload
+                        await send({"seq": seq, "ok": True})
+                    elif op == "obj_get":
+                        blob = st.objects.get(hdr["name"])
+                        if blob is None:
+                            await send({"seq": seq, "ok": False, "err": "not found"})
+                        else:
+                            await send({"seq": seq, "ok": True}, blob)
+                    elif op == "ping":
+                        await send({"seq": seq, "ok": True})
+                    else:
+                        await send({"seq": seq, "ok": False, "err": f"bad op {op}"})
+                except Exception as exc:  # noqa: BLE001 - report, keep serving
+                    logger.exception("hub op %s failed", op)
+                    await send({"seq": seq, "ok": False, "err": str(exc)})
+        finally:
+            for wid in conn_watches:
+                st.watch_remove(wid)
+            for sid in conn_subs:
+                st.unsubscribe(sid)
+            for lease in conn_leases:
+                st.lease_revoke(lease)
+            # Cancel parked blocking pops so a future queue_push doesn't hand
+            # a job to this dead connection (queue_push skips done futures).
+            for fut in conn_qwaiters:
+                if not fut.done():
+                    fut.cancel()
+            self._conn_writers.discard(writer)
+            with contextlib.suppress(Exception):
+                writer.close()

@@ -1,0 +1,328 @@
+"""Peer-to-peer streaming RPC: the request/response data plane.
+
+The reference splits one logical RPC across two transports: the request rides
+a NATS message to the worker's subject (addressed_router.rs:152) and the
+response stream comes back over a *reverse* raw-TCP connection that the worker
+dials into the caller (push_handler.rs:65, network/tcp/*).  That split exists
+because NATS cannot stream.  With a first-party transport we use a single
+duplex TCP connection per peer pair and multiplex many concurrent request
+streams over it -- which preserves every property the split design bought
+(streaming, per-request cancellation, backpressure, prologue errors) with one
+fewer connection handshake on the hot path.
+
+Frames (two-part codec, see codec.py):
+  client -> server:  {t:"req",  sid, subject, id, meta}  + request payload
+                     {t:"cancel", sid, kill}
+  server -> client:  {t:"ack",  sid}            -- prologue: handler accepted
+                     {t:"err",  sid, msg}       -- prologue or mid-stream error
+                     {t:"data", sid}            + response item payload
+                     {t:"end",  sid}            -- stream complete
+
+``sid`` is a client-chosen stream id unique per connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+import logging
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
+
+from ..engine import AsyncEngineContext
+from .codec import read_frame, write_frame
+
+logger = logging.getLogger("dynamo.dataplane")
+
+# A raw byte-level handler: receives (header, payload, ctx) and returns an
+# async iterator of payload byte strings.  Serde lives one layer up (ingress).
+ByteHandler = Callable[
+    [Dict[str, Any], bytes, AsyncEngineContext], Awaitable[AsyncIterator[bytes]]
+]
+
+
+class StreamEnd(Exception):
+    pass
+
+
+class RemoteError(Exception):
+    """Error raised by the remote handler, propagated through the stream."""
+
+
+class DataPlaneServer:
+    """Worker-side listener: dispatches request frames to subject handlers.
+
+    One server per process; endpoints register their subject here and their
+    address in the hub's ``instances/`` keyspace (component/endpoint.py).
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0) -> None:
+        self.host = host
+        self.port = port
+        self.advertise_host: Optional[str] = None
+        self._handlers: Dict[str, ByteHandler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_writers: set = set()
+
+    def register(self, subject: str, handler: ByteHandler) -> None:
+        self._handlers[subject] = handler
+
+    def unregister(self, subject: str) -> None:
+        self._handlers.pop(subject, None)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        host = self.advertise_host or (
+            "127.0.0.1" if self.host in ("0.0.0.0", "::") else self.host
+        )
+        return host, self.port
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.address
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            # 3.12+ wait_closed() blocks until handlers return; unblock them.
+            for w in list(self._conn_writers):
+                with contextlib.suppress(Exception):
+                    w.close()
+            await self._server.wait_closed()
+
+    async def _on_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._conn_writers.add(writer)
+        send_lock = asyncio.Lock()
+        live: Dict[int, AsyncEngineContext] = {}
+        tasks: set = set()  # strong refs: loop holds only weak task refs
+
+        async def send(hdr: Dict[str, Any], payload: bytes = b"") -> None:
+            async with send_lock:
+                try:
+                    write_frame(writer, hdr, payload)
+                    await writer.drain()
+                except (ConnectionError, RuntimeError):
+                    pass
+
+        async def run_stream(
+            sid: int, hdr: Dict[str, Any], payload: bytes, ctx: AsyncEngineContext
+        ) -> None:
+            handler = self._handlers.get(hdr.get("subject", ""))
+            if handler is None:
+                live.pop(sid, None)
+                await send(
+                    {"t": "err", "sid": sid,
+                     "msg": f"no handler for subject {hdr.get('subject')!r}"}
+                )
+                return
+            try:
+                stream = await handler(hdr, payload, ctx)
+            except Exception as exc:  # noqa: BLE001 - prologue error to caller
+                logger.exception("handler prologue failed for %s", hdr.get("subject"))
+                await send({"t": "err", "sid": sid, "msg": str(exc)})
+                live.pop(sid, None)
+                return
+            await send({"t": "ack", "sid": sid})
+            try:
+                async for item in stream:
+                    if ctx.is_killed():
+                        break
+                    await send({"t": "data", "sid": sid}, item)
+                await send({"t": "end", "sid": sid})
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - mid-stream error
+                logger.exception("handler stream failed for %s", hdr.get("subject"))
+                await send({"t": "err", "sid": sid, "msg": str(exc)})
+            finally:
+                ctx.set_complete()
+                live.pop(sid, None)
+
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                hdr, payload = frame
+                t = hdr.get("t")
+                if t == "req":
+                    sid = int(hdr["sid"])
+                    # Register the context *before* yielding to the loop so a
+                    # cancel frame already sitting in the TCP buffer can't
+                    # race past the stream it targets.
+                    ctx = AsyncEngineContext(hdr.get("id"))
+                    live[sid] = ctx
+                    task = asyncio.create_task(run_stream(sid, hdr, payload, ctx))
+                    tasks.add(task)
+                    task.add_done_callback(tasks.discard)
+                elif t == "cancel":
+                    ctx = live.get(int(hdr["sid"]))
+                    if ctx is not None:
+                        if hdr.get("kill"):
+                            ctx.kill()
+                        else:
+                            ctx.stop_generating()
+        finally:
+            # Peer went away: kill all of its in-flight streams.
+            for ctx in list(live.values()):
+                ctx.kill()
+            self._conn_writers.discard(writer)
+            with contextlib.suppress(Exception):
+                writer.close()
+
+
+class _Connection:
+    """One multiplexed client connection to a worker's data-plane server."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self._sid = itertools.count(1)
+        self._streams: Dict[int, asyncio.Queue] = {}
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pump: Optional[asyncio.Task] = None
+        self._send_lock = asyncio.Lock()
+        self.closed = False
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        self._pump = asyncio.create_task(self._pump_loop())
+
+    async def _pump_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                if frame is None:
+                    break
+                hdr, payload = frame
+                q = self._streams.get(hdr.get("sid"))
+                if q is not None:
+                    # Bounded queue: a stalled consumer stops the pump, TCP
+                    # flow control kicks in, and backpressure reaches the
+                    # producer (head-of-line blocking across the multiplexed
+                    # connection is the accepted cost, as in HTTP/2 w/o
+                    # per-stream flow control).
+                    await q.put((hdr, payload))
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("data-plane connection %s:%d lost: %s",
+                           self.host, self.port, exc)
+        finally:
+            self.closed = True
+            for q in self._streams.values():
+                # Make room if the bounded queue is full: the error must land.
+                if q.full():
+                    with contextlib.suppress(asyncio.QueueEmpty):
+                        q.get_nowait()
+                with contextlib.suppress(asyncio.QueueFull):
+                    q.put_nowait(({"t": "err", "msg": "connection lost"}, b""))
+
+    async def send(self, hdr: Dict[str, Any], payload: bytes = b"") -> None:
+        assert self._writer is not None
+        async with self._send_lock:
+            write_frame(self._writer, hdr, payload)
+            await self._writer.drain()
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._pump:
+            self._pump.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._pump
+        if self._writer:
+            self._writer.close()
+            with contextlib.suppress(Exception):
+                await self._writer.wait_closed()
+
+    async def request(
+        self,
+        subject: str,
+        request_id: str,
+        meta: Dict[str, Any],
+        payload: bytes,
+        ctx: AsyncEngineContext,
+    ) -> AsyncIterator[bytes]:
+        """Issue a request; await the prologue; yield response payloads."""
+        sid = next(self._sid)
+        q: asyncio.Queue = asyncio.Queue(maxsize=512)
+        self._streams[sid] = q
+        await self.send(
+            {"t": "req", "sid": sid, "subject": subject, "id": request_id,
+             "meta": meta},
+            payload,
+        )
+
+        # Prologue: ack or err (reference: TCP prologue, network.rs:64-73).
+        hdr, _ = await q.get()
+        if hdr.get("t") == "err":
+            self._streams.pop(sid, None)
+            raise RemoteError(hdr.get("msg", "remote error"))
+        assert hdr.get("t") == "ack", f"bad prologue {hdr}"
+
+        async def gen() -> AsyncIterator[bytes]:
+            watcher = asyncio.create_task(self._cancel_watch(sid, ctx))
+            try:
+                while True:
+                    hdr, payload = await q.get()
+                    t = hdr.get("t")
+                    if t == "data":
+                        yield payload
+                    elif t == "end":
+                        return
+                    elif t == "err":
+                        raise RemoteError(hdr.get("msg", "remote error"))
+            finally:
+                watcher.cancel()
+                self._streams.pop(sid, None)
+
+        return gen()
+
+    async def _cancel_watch(self, sid: int, ctx: AsyncEngineContext) -> None:
+        """Forward local stop/kill onto the wire as cancel frames."""
+        with contextlib.suppress(asyncio.CancelledError, ConnectionError):
+            await ctx.stopped()
+            await self.send(
+                {"t": "cancel", "sid": sid, "kill": ctx.is_killed()}
+            )
+
+
+class DataPlaneClient:
+    """Connection pool: one multiplexed connection per (host, port)."""
+
+    def __init__(self) -> None:
+        self._conns: Dict[Tuple[str, int], _Connection] = {}
+        self._locks: Dict[Tuple[str, int], asyncio.Lock] = {}
+
+    async def _get(self, host: str, port: int) -> _Connection:
+        key = (host, port)
+        lock = self._locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(key)
+            if conn is None or conn.closed:
+                conn = _Connection(host, port)
+                await conn.connect()
+                self._conns[key] = conn
+            return conn
+
+    async def request(
+        self,
+        host: str,
+        port: int,
+        subject: str,
+        request_id: str,
+        meta: Dict[str, Any],
+        payload: bytes,
+        ctx: AsyncEngineContext,
+    ) -> AsyncIterator[bytes]:
+        conn = await self._get(host, port)
+        return await conn.request(subject, request_id, meta, payload, ctx)
+
+    async def close(self) -> None:
+        for conn in self._conns.values():
+            await conn.close()
+        self._conns.clear()

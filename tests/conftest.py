@@ -1,0 +1,30 @@
+"""Test configuration: force a virtual 8-device CPU mesh before JAX loads.
+
+Multi-chip sharding (tp/dp/pp/sp) is validated on virtual CPU devices since
+only one physical TPU chip is available in CI; the driver separately
+dry-run-compiles the multichip path via __graft_entry__.dryrun_multichip.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DYN_LOG", "warning")
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def run():
+    """Run an async test body on a fresh event loop."""
+
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run

@@ -1,0 +1,174 @@
+"""Runtime core tests: context cancellation, pipeline links, annotations.
+
+Modeled on the reference's in-process runtime tests
+(lib/runtime/tests/pipeline.rs): everything here runs without sockets.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Annotated,
+    AsyncEngineContext,
+    Context,
+    MapOperator,
+    Operator,
+    ResponseStream,
+    as_response_stream,
+    link,
+)
+
+
+class EchoEngine:
+    """Yields each character of request.data['text'] as a token."""
+
+    async def generate(self, request):
+        async def gen():
+            for ch in request.data["text"]:
+                yield {"token": ch}
+
+        return gen()
+
+
+class SlowEngine:
+    """Yields integers forever until stopped; used for cancellation tests."""
+
+    async def generate(self, request):
+        ctx = request.ctx
+
+        async def gen():
+            i = 0
+            while not ctx.is_stopped():
+                yield i
+                i += 1
+                await asyncio.sleep(0.001)
+
+        return gen()
+
+
+def test_context_ids_and_map():
+    c = Context.new({"a": 1}, request_id="req-1")
+    assert c.id == "req-1"
+    c2 = c.map(lambda d: d["a"])
+    assert c2.data == 1
+    assert c2.id == "req-1"
+    assert c2.ctx is c.ctx
+
+
+def test_context_cancellation_linking():
+    parent = AsyncEngineContext()
+    child = AsyncEngineContext()
+    parent.link_child(child)
+    parent.stop_generating()
+    assert child.is_stopped() and not child.is_killed()
+    parent.kill()
+    assert child.is_killed()
+
+    # Linking to an already-killed parent propagates immediately.
+    late = AsyncEngineContext()
+    parent.link_child(late)
+    assert late.is_killed()
+
+
+def test_echo_engine(run):
+    async def body():
+        eng = EchoEngine()
+        stream = await as_response_stream(eng, Context.new({"text": "hi"}))
+        items = [x async for x in stream]
+        assert items == [{"token": "h"}, {"token": "i"}]
+        assert stream.ctx.is_complete()
+
+    run(body())
+
+
+def test_stop_generating_ends_stream(run):
+    async def body():
+        eng = SlowEngine()
+        req = Context.new(None)
+        stream = await as_response_stream(eng, req)
+        got = []
+        async for item in stream:
+            got.append(item)
+            if len(got) == 3:
+                req.ctx.stop_generating()
+        assert len(got) >= 3
+        assert got[:3] == [0, 1, 2]
+
+    run(body())
+
+
+def test_kill_truncates_stream(run):
+    async def body():
+        eng = SlowEngine()
+        req = Context.new(None)
+        stream = await as_response_stream(eng, req)
+        got = []
+        async for item in stream:
+            got.append(item)
+            if len(got) == 2:
+                req.ctx.kill()
+        # kill stops iteration before the producer can yield more
+        assert got == [0, 1]
+
+    run(body())
+
+
+class UpperOperator(Operator):
+    """Forward: uppercase the text. Backward: tag each item."""
+
+    async def generate(self, request, next):
+        mapped = request.map(lambda d: {"text": d["text"].upper()})
+        stream = await as_response_stream(next, mapped)
+
+        async def gen():
+            async for item in stream:
+                yield {"tagged": item["token"]}
+
+        return gen()
+
+
+def test_pipeline_link(run):
+    async def body():
+        pipe = link(UpperOperator(), EchoEngine())
+        stream = await pipe.generate(Context.new({"text": "ab"}))
+        items = [x async for x in stream]
+        assert items == [{"tagged": "A"}, {"tagged": "B"}]
+
+    run(body())
+
+
+def test_map_operator(run):
+    async def body():
+        pipe = link(
+            MapOperator(
+                lambda d: {"text": d["text"] * 2},
+                lambda item: item["token"],
+            ),
+            EchoEngine(),
+        )
+        stream = await pipe.generate(Context.new({"text": "x"}))
+        assert [x async for x in stream] == ["x", "x"]
+
+    run(body())
+
+
+def test_link_validation():
+    with pytest.raises(TypeError):
+        link(UpperOperator())  # operator cannot be terminal
+    with pytest.raises(ValueError):
+        link()
+
+
+def test_annotated_roundtrip():
+    a = Annotated.from_data({"x": 1})
+    assert not a.is_error()
+    d = a.to_dict()
+    assert Annotated.from_dict(d).data == {"x": 1}
+
+    e = Annotated.from_error("boom")
+    assert e.is_error()
+    assert e.error_message() == "boom"
+
+    ann = Annotated.from_annotation("token_ids", [1, 2, 3])
+    assert ann.event == "token_ids"

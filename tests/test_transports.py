@@ -1,0 +1,354 @@
+"""Transport tests: hub (kv/lease/watch/pubsub/queue), data plane RPC,
+component model end-to-end over real sockets on localhost."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    PushRouter,
+    RouterMode,
+)
+from dynamo_tpu.runtime.transports import (
+    HubClient,
+    HubServer,
+    RemoteError,
+    StaticHub,
+)
+
+
+async def _hub_pair():
+    server = HubServer()
+    host, port = await server.start()
+    client = await HubClient(host, port).connect()
+    return server, client
+
+
+def test_hub_kv_and_watch(run):
+    async def body():
+        server, client = await _hub_pair()
+        try:
+            await client.kv_put("models/a", b"va")
+            await client.kv_put("models/b", b"vb")
+            await client.kv_put("other/c", b"vc")
+            got = await client.kv_get_prefix("models/")
+            assert got == [("models/a", b"va"), ("models/b", b"vb")]
+
+            watch = await client.watch_prefix("models/")
+            assert sorted(k for k, _ in watch.snapshot) == ["models/a", "models/b"]
+
+            await client.kv_put("models/new", b"nv")
+            ev = await asyncio.wait_for(watch.events.get(), 2)
+            assert (ev.type, ev.key, ev.value) == ("put", "models/new", b"nv")
+
+            await client.kv_delete("models/a")
+            ev = await asyncio.wait_for(watch.events.get(), 2)
+            assert (ev.type, ev.key) == ("delete", "models/a")
+
+            # atomic create
+            assert await client.kv_create("models/b", b"x") is False
+            assert await client.kv_create("models/z", b"x") is True
+        finally:
+            await client.close()
+            await server.stop()
+
+    run(body())
+
+
+def test_hub_lease_expiry_removes_keys(run):
+    async def body():
+        server, client = await _hub_pair()
+        try:
+            lease = await client.lease_grant(ttl=0.6, keepalive=False)
+            await client.kv_put("instances/x", b"v", lease=lease)
+            watch = await client.watch_prefix("instances/")
+            assert len(watch.snapshot) == 1
+            # no keepalive -> expiry loop revokes and deletes the key
+            ev = await asyncio.wait_for(watch.events.get(), 5)
+            assert ev.type == "delete" and ev.key == "instances/x"
+        finally:
+            await client.close()
+            await server.stop()
+
+    run(body())
+
+
+def test_hub_lease_keepalive_holds_key(run):
+    async def body():
+        server, client = await _hub_pair()
+        try:
+            lease = await client.lease_grant(ttl=0.6, keepalive=True)
+            await client.kv_put("instances/y", b"v", lease=lease)
+            await asyncio.sleep(1.5)  # > 2 TTLs: keepalive must be working
+            assert await client.kv_get_prefix("instances/y") != []
+            await client.lease_revoke(lease)
+            assert await client.kv_get_prefix("instances/y") == []
+        finally:
+            await client.close()
+            await server.stop()
+
+    run(body())
+
+
+def test_hub_pubsub_wildcards(run):
+    async def body():
+        server, client = await _hub_pair()
+        try:
+            sub = await client.subscribe("ns.events.*")
+            subj_all = await client.subscribe("ns.>")
+            n = await client.publish("ns.events.kv_events", b"payload")
+            assert n == 2
+            s, p = await asyncio.wait_for(sub.next(), 2)
+            assert s == "ns.events.kv_events" and p == b"payload"
+            s2, _ = await asyncio.wait_for(subj_all.next(), 2)
+            assert s2 == "ns.events.kv_events"
+            # non-matching subject
+            await client.publish("other.events.x", b"no")
+            await asyncio.sleep(0.05)
+            assert sub.queue.empty()
+        finally:
+            await client.close()
+            await server.stop()
+
+    run(body())
+
+
+def test_hub_queue_blocking_pop(run):
+    async def body():
+        server, client = await _hub_pair()
+        client2 = await HubClient(server.host, server.port).connect()
+        try:
+            # blocking pop parked before push arrives
+            pop_task = asyncio.create_task(client2.queue_pop("prefill", block=True))
+            await asyncio.sleep(0.05)
+            await client.queue_push("prefill", b"job1")
+            assert await asyncio.wait_for(pop_task, 2) == b"job1"
+
+            await client.queue_push("prefill", b"job2")
+            assert await client.queue_depth("prefill") == 1
+            assert await client2.queue_pop("prefill", block=False) == b"job2"
+            assert await client2.queue_pop("prefill", block=False) is None
+        finally:
+            await client.close()
+            await client2.close()
+            await server.stop()
+
+    run(body())
+
+
+def test_hub_object_store(run):
+    async def body():
+        server, client = await _hub_pair()
+        try:
+            blob = b"\x00\x01" * 1000
+            await client.obj_put("mdc/llama", blob)
+            assert await client.obj_get("mdc/llama") == blob
+            assert await client.obj_get("missing") is None
+        finally:
+            await client.close()
+            await server.stop()
+
+    run(body())
+
+
+class TokenEngine:
+    """Streams request.data['n'] integers; honors stop."""
+
+    async def generate(self, request):
+        n = request.data["n"]
+        ctx = request.ctx
+
+        async def gen():
+            for i in range(n):
+                if ctx.is_stopped():
+                    return
+                yield {"i": i}
+                await asyncio.sleep(0)
+
+        return gen()
+
+
+def _make_distributed(n_workers=1):
+    """Start hub + n worker runtimes serving TokenEngine + 1 caller runtime."""
+
+    async def setup():
+        hub_server = HubServer()
+        host, port = await hub_server.start()
+        addr = f"{host}:{port}"
+        workers = []
+        for _ in range(n_workers):
+            w = await DistributedRuntime.detached(addr)
+            ep = w.namespace("test").component("backend").endpoint("generate")
+            await ep.serve(TokenEngine())
+            workers.append(w)
+        caller = await DistributedRuntime.detached(addr)
+        return hub_server, workers, caller
+
+    return setup()
+
+
+def test_endpoint_serve_and_call_over_tcp(run):
+    async def body():
+        hub_server, workers, caller = await _make_distributed(1)
+        try:
+            ep = caller.namespace("test").component("backend").endpoint("generate")
+            client = await ep.client()
+            await client.wait_for_instances(5)
+            router = PushRouter(client, RouterMode.ROUND_ROBIN)
+            stream = await router.generate(Context.new({"n": 4}))
+            items = [x async for x in stream]
+            assert [it.data["i"] for it in items] == [0, 1, 2, 3]
+        finally:
+            await caller.shutdown()
+            for w in workers:
+                await w.shutdown()
+            await hub_server.stop()
+
+    run(body())
+
+
+def test_round_robin_across_workers(run):
+    async def body():
+        hub_server, workers, caller = await _make_distributed(3)
+        try:
+            ep = caller.namespace("test").component("backend").endpoint("generate")
+            client = await ep.client()
+            deadline = asyncio.get_running_loop().time() + 5
+            while len(client.instances) < 3:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            router = PushRouter(client, RouterMode.ROUND_ROBIN)
+            for _ in range(6):
+                stream = await router.generate(Context.new({"n": 1}))
+                assert [x async for x in stream]
+            # direct dispatch to each instance works
+            for iid in client.instance_ids():
+                stream = await router.direct(Context.new({"n": 2}), iid)
+                assert len([x async for x in stream]) == 2
+        finally:
+            await caller.shutdown()
+            for w in workers:
+                await w.shutdown()
+            await hub_server.stop()
+
+    run(body())
+
+
+def test_worker_death_removes_instance(run):
+    async def body():
+        hub_server, workers, caller = await _make_distributed(2)
+        try:
+            ep = caller.namespace("test").component("backend").endpoint("generate")
+            client = await ep.client()
+            deadline = asyncio.get_running_loop().time() + 5
+            while len(client.instances) < 2:
+                await asyncio.sleep(0.02)
+                assert asyncio.get_running_loop().time() < deadline
+            # graceful shutdown revokes the lease -> instance key deleted
+            await workers[0].shutdown()
+            deadline = asyncio.get_running_loop().time() + 5
+            while len(client.instances) != 1:
+                await asyncio.sleep(0.02)
+                assert asyncio.get_running_loop().time() < deadline
+        finally:
+            await caller.shutdown()
+            await workers[1].shutdown()
+            await hub_server.stop()
+
+    run(body())
+
+
+def test_remote_error_prologue(run):
+    class BoomEngine:
+        async def generate(self, request):
+            raise ValueError("engine exploded")
+
+    async def body():
+        hub_server = HubServer()
+        host, port = await hub_server.start()
+        addr = f"{host}:{port}"
+        worker = await DistributedRuntime.detached(addr)
+        ep = worker.namespace("t").component("c").endpoint("e")
+        await ep.serve(BoomEngine())
+        caller = await DistributedRuntime.detached(addr)
+        try:
+            client = await (
+                caller.namespace("t").component("c").endpoint("e")
+            ).client()
+            await client.wait_for_instances(5)
+            router = PushRouter(client)
+            with pytest.raises(RemoteError, match="engine exploded"):
+                await router.generate(Context.new({}))
+        finally:
+            await caller.shutdown()
+            await worker.shutdown()
+            await hub_server.stop()
+
+    run(body())
+
+
+def test_static_mode_local_bypass(run):
+    async def body():
+        rt = await DistributedRuntime.static()
+        try:
+            ep = rt.namespace("t").component("c").endpoint("e")
+            await ep.serve(TokenEngine())
+            client = await ep.client()
+            await client.wait_for_instances(2)
+            router = PushRouter(client)
+            stream = await router.generate(Context.new({"n": 3}))
+            items = [x async for x in stream]
+            # local bypass must produce the same Annotated envelope as remote
+            assert [it.data["i"] for it in items] == [0, 1, 2]
+        finally:
+            await rt.shutdown()
+
+    run(body())
+
+
+def test_cross_process_cancellation(run):
+    class InfiniteEngine:
+        async def generate(self, request):
+            ctx = request.ctx
+
+            async def gen():
+                i = 0
+                while not ctx.is_stopped():
+                    yield i
+                    i += 1
+                    await asyncio.sleep(0.005)
+
+            return gen()
+
+    async def body():
+        hub_server = HubServer()
+        host, port = await hub_server.start()
+        addr = f"{host}:{port}"
+        worker = await DistributedRuntime.detached(addr)
+        ep = worker.namespace("t").component("c").endpoint("inf")
+        await ep.serve(InfiniteEngine())
+        caller = await DistributedRuntime.detached(addr)
+        try:
+            client = await (
+                caller.namespace("t").component("c").endpoint("inf")
+            ).client()
+            await client.wait_for_instances(5)
+            router = PushRouter(client)
+            req = Context.new({})
+            stream = await router.generate(req)
+            got = 0
+            async for _ in stream:
+                got += 1
+                if got == 3:
+                    req.ctx.stop_generating()
+            assert got >= 3
+            # remote generator must terminate (stream ended without kill)
+        finally:
+            await caller.shutdown()
+            await worker.shutdown()
+            await hub_server.stop()
+
+    run(body())
