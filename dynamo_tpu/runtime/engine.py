@@ -140,6 +140,7 @@ class ResponseStream(Generic[U]):
     def __init__(self, ctx: AsyncEngineContext, gen: AsyncIterator[U]) -> None:
         self._ctx = ctx
         self._gen = gen
+        self._kill_waiter: Optional[asyncio.Task] = None
 
     @property
     def ctx(self) -> AsyncEngineContext:
@@ -149,14 +150,44 @@ class ResponseStream(Generic[U]):
         return self
 
     async def __anext__(self) -> U:
-        if self._ctx.is_killed():
-            await self._dispose()
+        ctx = self._ctx
+        if ctx.is_killed():
+            await self._shutdown_killed()
             raise StopAsyncIteration
+        # Race the producer against kill: "immediate termination" must hold
+        # even when the producer is blocked awaiting a stalled backend.
+        if self._kill_waiter is None or self._kill_waiter.done():
+            self._kill_waiter = asyncio.ensure_future(ctx.killed())
+        nxt = asyncio.ensure_future(self._gen.__anext__())
         try:
-            return await self._gen.__anext__()
-        except StopAsyncIteration:
-            self._ctx.set_complete()
+            await asyncio.wait(
+                {nxt, self._kill_waiter}, return_when=asyncio.FIRST_COMPLETED
+            )
+        except asyncio.CancelledError:
+            nxt.cancel()
             raise
+        if nxt.done():
+            try:
+                return nxt.result()
+            except StopAsyncIteration:
+                ctx.set_complete()
+                self._cleanup_waiter()
+                raise
+        # kill fired while the producer was still pending
+        nxt.cancel()
+        with contextlib.suppress(asyncio.CancelledError, Exception):
+            await nxt
+        await self._shutdown_killed()
+        raise StopAsyncIteration
+
+    def _cleanup_waiter(self) -> None:
+        if self._kill_waiter is not None and not self._kill_waiter.done():
+            self._kill_waiter.cancel()
+        self._kill_waiter = None
+
+    async def _shutdown_killed(self) -> None:
+        self._cleanup_waiter()
+        await self._dispose()
 
     async def _dispose(self) -> None:
         aclose = getattr(self._gen, "aclose", None)
@@ -165,6 +196,7 @@ class ResponseStream(Generic[U]):
                 await aclose()
 
     async def aclose(self) -> None:
+        self._cleanup_waiter()
         await self._dispose()
 
 

@@ -30,13 +30,26 @@ def encode_frame(header: Dict[str, Any], payload: bytes = b"") -> bytes:
     return _LEN.pack(len(hdr), len(payload)) + hdr + payload
 
 
+class TruncatedFrame(ConnectionError):
+    """Connection died mid-frame: NOT a clean close."""
+
+
 async def read_frame(
     reader: asyncio.StreamReader,
 ) -> Optional[Tuple[Dict[str, Any], bytes]]:
-    """Read one frame; returns None on clean EOF at a frame boundary."""
+    """Read one frame.
+
+    Returns None only on clean EOF at a frame boundary; a connection torn
+    mid-frame raises :class:`TruncatedFrame` so callers can distinguish
+    graceful shutdown from transport failure.
+    """
     try:
         prefix = await reader.readexactly(_LEN.size)
-    except (asyncio.IncompleteReadError, ConnectionResetError):
+    except ConnectionResetError:
+        return None
+    except asyncio.IncompleteReadError as exc:
+        if exc.partial:
+            raise TruncatedFrame("EOF inside frame length prefix") from exc
         return None
     hdr_len, payload_len = _LEN.unpack(prefix)
     if hdr_len > MAX_FRAME or payload_len > MAX_FRAME:
@@ -44,8 +57,8 @@ async def read_frame(
     try:
         hdr_bytes = await reader.readexactly(hdr_len)
         payload = await reader.readexactly(payload_len) if payload_len else b""
-    except (asyncio.IncompleteReadError, ConnectionResetError):
-        return None
+    except (asyncio.IncompleteReadError, ConnectionResetError) as exc:
+        raise TruncatedFrame("EOF inside frame body") from exc
     return json.loads(hdr_bytes), payload
 
 

@@ -70,7 +70,8 @@ def _subject_matches(pattern: str, subject: str) -> bool:
     s_toks = subject.split(".")
     for i, pt in enumerate(p_toks):
         if pt == ">":
-            return True
+            # NATS semantics: '>' matches one or more remaining tokens.
+            return i < len(s_toks)
         if i >= len(s_toks):
             return False
         if pt != "*" and pt != s_toks[i]:
@@ -294,17 +295,18 @@ class HubServer:
         conn_watches: list = []
         conn_subs: list = []
         conn_leases: list = []
-        conn_qwaiters: list = []
+        conn_qwaiters: set = set()
         send_tasks: set = set()  # strong refs: loop holds only weak task refs
         send_lock = asyncio.Lock()
 
-        async def send(hdr: Dict[str, Any], payload: bytes = b"") -> None:
+        async def send(hdr: Dict[str, Any], payload: bytes = b"") -> bool:
             async with send_lock:
                 try:
                     write_frame(writer, hdr, payload)
                     await writer.drain()
+                    return True
                 except (ConnectionError, RuntimeError):
-                    pass
+                    return False
 
         def send_soon(hdr: Dict[str, Any], payload: bytes = b"") -> None:
             task = asyncio.ensure_future(send(hdr, payload))
@@ -412,14 +414,29 @@ class HubServer:
                             await send({"seq": seq, "ok": True, "found": False})
                         else:
                             fut = st.queue_wait(hdr["queue"])
-                            conn_qwaiters.append(fut)
+                            conn_qwaiters.add(fut)
+                            qname = hdr["queue"]
 
-                            def deliver(f: asyncio.Future, _seq=seq) -> None:
+                            async def deliver_job(
+                                payload: bytes, _seq=seq, _q=qname
+                            ) -> None:
+                                ok = await send(
+                                    {"seq": _seq, "ok": True, "found": True},
+                                    payload,
+                                )
+                                if not ok:
+                                    # Consumer died mid-delivery: requeue so
+                                    # the job is not lost (at-least-once).
+                                    st.queue_push(_q, payload)
+
+                            def deliver(f: asyncio.Future) -> None:
+                                conn_qwaiters.discard(f)
                                 if not f.cancelled():
-                                    send_soon(
-                                        {"seq": _seq, "ok": True, "found": True},
-                                        f.result(),
+                                    task = asyncio.ensure_future(
+                                        deliver_job(f.result())
                                     )
+                                    send_tasks.add(task)
+                                    task.add_done_callback(send_tasks.discard)
 
                             fut.add_done_callback(deliver)
                     elif op == "queue_depth":
@@ -443,6 +460,8 @@ class HubServer:
                 except Exception as exc:  # noqa: BLE001 - report, keep serving
                     logger.exception("hub op %s failed", op)
                     await send({"seq": seq, "ok": False, "err": str(exc)})
+        except ConnectionError as exc:
+            logger.warning("hub connection failed mid-frame: %s", exc)
         finally:
             for wid in conn_watches:
                 st.watch_remove(wid)
@@ -452,7 +471,7 @@ class HubServer:
                 st.lease_revoke(lease)
             # Cancel parked blocking pops so a future queue_push doesn't hand
             # a job to this dead connection (queue_push skips done futures).
-            for fut in conn_qwaiters:
+            for fut in list(conn_qwaiters):
                 if not fut.done():
                     fut.cancel()
             self._conn_writers.discard(writer)

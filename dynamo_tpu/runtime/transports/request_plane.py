@@ -29,10 +29,14 @@ import itertools
 import logging
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
-from ..engine import AsyncEngineContext
+from ..engine import AsyncEngineContext, ensure_response_stream
 from .codec import read_frame, write_frame
 
 logger = logging.getLogger("dynamo.dataplane")
+
+# How long a stalled consumer may block its (bounded) stream queue before the
+# stream is considered abandoned and dropped.
+ABANDONED_STREAM_TIMEOUT = 60.0
 
 # A raw byte-level handler: receives (header, payload, ctx) and returns an
 # async iterator of payload byte strings.  Serde lives one layer up (ingress).
@@ -127,7 +131,9 @@ class DataPlaneServer:
                 return
             await send({"t": "ack", "sid": sid})
             try:
-                async for item in stream:
+                # ResponseStream races the handler against kill, so a killed
+                # request terminates even when the engine is blocked mid-item.
+                async for item in ensure_response_stream(ctx, stream):
                     if ctx.is_killed():
                         break
                     await send({"t": "data", "sid": sid}, item)
@@ -165,6 +171,8 @@ class DataPlaneServer:
                             ctx.kill()
                         else:
                             ctx.stop_generating()
+        except ConnectionError as exc:
+            logger.warning("data-plane connection failed mid-frame: %s", exc)
         finally:
             # Peer went away: kill all of its in-flight streams.
             for ctx in list(live.values()):
@@ -201,14 +209,31 @@ class _Connection:
                 if frame is None:
                     break
                 hdr, payload = frame
-                q = self._streams.get(hdr.get("sid"))
+                sid = hdr.get("sid")
+                q = self._streams.get(sid)
                 if q is not None:
                     # Bounded queue: a stalled consumer stops the pump, TCP
                     # flow control kicks in, and backpressure reaches the
                     # producer (head-of-line blocking across the multiplexed
                     # connection is the accepted cost, as in HTTP/2 w/o
-                    # per-stream flow control).
-                    await q.put((hdr, payload))
+                    # per-stream flow control).  A consumer that stays stalled
+                    # past the deadline is treated as abandoned: its stream is
+                    # dropped and the server told to kill the request, so one
+                    # dead consumer can't wedge the shared connection forever.
+                    try:
+                        await asyncio.wait_for(
+                            q.put((hdr, payload)), ABANDONED_STREAM_TIMEOUT
+                        )
+                    except asyncio.TimeoutError:
+                        logger.warning(
+                            "stream %s abandoned (queue full %.0fs); dropping",
+                            sid, ABANDONED_STREAM_TIMEOUT,
+                        )
+                        self._streams.pop(sid, None)
+                        with contextlib.suppress(ConnectionError):
+                            await self.send(
+                                {"t": "cancel", "sid": sid, "kill": True}
+                            )
         except Exception as exc:  # noqa: BLE001
             logger.warning("data-plane connection %s:%d lost: %s",
                            self.host, self.port, exc)
@@ -265,7 +290,11 @@ class _Connection:
         assert hdr.get("t") == "ack", f"bad prologue {hdr}"
 
         async def gen() -> AsyncIterator[bytes]:
-            watcher = asyncio.create_task(self._cancel_watch(sid, ctx))
+            cancel_sent = [False]
+            watcher = asyncio.create_task(
+                self._cancel_watch(sid, ctx, cancel_sent)
+            )
+            ended = False
             try:
                 while True:
                     hdr, payload = await q.get()
@@ -273,19 +302,33 @@ class _Connection:
                     if t == "data":
                         yield payload
                     elif t == "end":
+                        ended = True
                         return
                     elif t == "err":
+                        ended = True
                         raise RemoteError(hdr.get("msg", "remote error"))
             finally:
                 watcher.cancel()
+                # The consumer may stop iterating (kill / early aclose) before
+                # the watcher got scheduled: make sure the worker hears about
+                # it, or it would keep generating into the void.
+                if not ended and ctx.is_stopped() and not cancel_sent[0]:
+                    cancel_sent[0] = True
+                    with contextlib.suppress(ConnectionError, RuntimeError):
+                        await self.send(
+                            {"t": "cancel", "sid": sid, "kill": ctx.is_killed()}
+                        )
                 self._streams.pop(sid, None)
 
         return gen()
 
-    async def _cancel_watch(self, sid: int, ctx: AsyncEngineContext) -> None:
+    async def _cancel_watch(
+        self, sid: int, ctx: AsyncEngineContext, cancel_sent: list
+    ) -> None:
         """Forward local stop/kill onto the wire as cancel frames."""
         with contextlib.suppress(asyncio.CancelledError, ConnectionError):
             await ctx.stopped()
+            cancel_sent[0] = True
             await self.send(
                 {"t": "cancel", "sid": sid, "kill": ctx.is_killed()}
             )

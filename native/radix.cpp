@@ -1,0 +1,124 @@
+// Native KV-block index: which workers hold which KV-cache blocks.
+//
+// The reference keeps a radix tree over token-block hashes inside a dedicated
+// single thread (lib/llm/src/kv_router/indexer.rs: RadixTree, find_matches
+// with early exit, apply_event).  Because sequence hashes already bind the
+// full prefix chain (parent-chained hashing, see tokenhash.cpp), the trie
+// collapses to a flat hash map keyed by sequence hash: looking up level i of
+// a query is one O(1) probe instead of a pointer walk, and the walk stops at
+// the first level no worker holds -- the same early-exit the reference's
+// radix descent performs, with better cache behavior on the hot path.
+//
+// Single-threaded by contract (the Python side owns it from one event loop),
+// mirroring the reference's single-threaded-actor design.
+//
+// C ABI (ctypes, with a pure-Python fallback in
+// dynamo_tpu/llm/kv_router/indexer.py):
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Index {
+  // seq_hash -> workers that hold this block (with its exact prefix chain)
+  std::unordered_map<uint64_t, std::unordered_set<uint64_t>> blocks;
+  // worker -> seq_hashes it holds (for removal / worker death)
+  std::unordered_map<uint64_t, std::unordered_set<uint64_t>> by_worker;
+
+  void store(uint64_t worker, const uint64_t* hashes, size_t n) {
+    auto& mine = by_worker[worker];
+    for (size_t i = 0; i < n; ++i) {
+      blocks[hashes[i]].insert(worker);
+      mine.insert(hashes[i]);
+    }
+  }
+
+  void remove(uint64_t worker, const uint64_t* hashes, size_t n) {
+    auto wit = by_worker.find(worker);
+    for (size_t i = 0; i < n; ++i) {
+      auto it = blocks.find(hashes[i]);
+      if (it != blocks.end()) {
+        it->second.erase(worker);
+        if (it->second.empty()) blocks.erase(it);
+      }
+      if (wit != by_worker.end()) wit->second.erase(hashes[i]);
+    }
+  }
+
+  void remove_worker(uint64_t worker) {
+    auto wit = by_worker.find(worker);
+    if (wit == by_worker.end()) return;
+    for (uint64_t h : wit->second) {
+      auto it = blocks.find(h);
+      if (it != blocks.end()) {
+        it->second.erase(worker);
+        if (it->second.empty()) blocks.erase(it);
+      }
+    }
+    by_worker.erase(wit);
+  }
+
+  // Accumulate per-worker match counts over the query's sequence-hash chain;
+  // stop at the first level held by nobody (early exit: deeper blocks cannot
+  // match because their sequence hashes chain through this one).
+  size_t find_matches(const uint64_t* hashes, size_t n, uint64_t* out_workers,
+                      uint32_t* out_scores, size_t max_out) const {
+    std::unordered_map<uint64_t, uint32_t> scores;
+    for (size_t i = 0; i < n; ++i) {
+      auto it = blocks.find(hashes[i]);
+      if (it == blocks.end()) break;
+      for (uint64_t w : it->second) scores[w] += 1;
+    }
+    size_t k = 0;
+    for (const auto& [w, s] : scores) {
+      if (k >= max_out) break;
+      out_workers[k] = w;
+      out_scores[k] = s;
+      ++k;
+    }
+    return k;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dyn_radix_new() { return new Index(); }
+
+void dyn_radix_free(void* p) { delete static_cast<Index*>(p); }
+
+void dyn_radix_store(void* p, uint64_t worker, const uint64_t* hashes,
+                     size_t n) {
+  static_cast<Index*>(p)->store(worker, hashes, n);
+}
+
+void dyn_radix_remove(void* p, uint64_t worker, const uint64_t* hashes,
+                      size_t n) {
+  static_cast<Index*>(p)->remove(worker, hashes, n);
+}
+
+void dyn_radix_remove_worker(void* p, uint64_t worker) {
+  static_cast<Index*>(p)->remove_worker(worker);
+}
+
+size_t dyn_radix_find_matches(void* p, const uint64_t* hashes, size_t n,
+                              uint64_t* out_workers, uint32_t* out_scores,
+                              size_t max_out) {
+  return static_cast<Index*>(p)->find_matches(hashes, n, out_workers,
+                                              out_scores, max_out);
+}
+
+size_t dyn_radix_num_blocks(void* p) {
+  return static_cast<Index*>(p)->blocks.size();
+}
+
+size_t dyn_radix_num_workers(void* p) {
+  return static_cast<Index*>(p)->by_worker.size();
+}
+
+}  // extern "C"

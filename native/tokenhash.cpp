@@ -1,0 +1,133 @@
+// Native token-block hashing: XXH64 plus batch chained sequence hashing.
+//
+// The reference computes block identity with xxHash over token blocks
+// (lib/tokens/src/lib.rs: salt/block/sequence chained hashing).  This is the
+// hot path of KV-aware routing (every request hashes its full prompt into
+// block hashes before the radix-tree lookup), so the TPU build keeps it
+// native: a from-spec XXH64 implementation (public domain algorithm,
+// https://github.com/Cyan4973/xxHash spec) with a batch entry point that
+// hashes a whole token sequence into chained block/sequence hashes in one
+// call across the FFI boundary.
+//
+// Exposed C ABI (consumed via ctypes from dynamo_tpu/tokens/hashing.py):
+//   uint64_t dyn_xxh64(const void* data, size_t len, uint64_t seed);
+//   void     dyn_hash_blocks(const int32_t* tokens, size_t n_tokens,
+//                            size_t block_size, uint64_t seed,
+//                            uint64_t* block_hashes, uint64_t* seq_hashes,
+//                            size_t n_blocks);
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t P1 = 11400714785074694791ULL;
+constexpr uint64_t P2 = 14029467366897019727ULL;
+constexpr uint64_t P3 = 1609587929392839161ULL;
+constexpr uint64_t P4 = 9650029242287828579ULL;
+constexpr uint64_t P5 = 2870177450012600261ULL;
+
+inline uint64_t rotl(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+
+inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86-64 / aarch64)
+}
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint64_t round_(uint64_t acc, uint64_t lane) {
+  return rotl(acc + lane * P2, 31) * P1;
+}
+
+inline uint64_t merge_round(uint64_t h, uint64_t acc) {
+  return (h ^ round_(0, acc)) * P1 + P4;
+}
+
+uint64_t xxh64(const void* data, size_t len, uint64_t seed) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  const uint8_t* end = p + len;
+  uint64_t h;
+
+  if (len >= 32) {
+    uint64_t a1 = seed + P1 + P2;
+    uint64_t a2 = seed + P2;
+    uint64_t a3 = seed;
+    uint64_t a4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      a1 = round_(a1, read64(p)); p += 8;
+      a2 = round_(a2, read64(p)); p += 8;
+      a3 = round_(a3, read64(p)); p += 8;
+      a4 = round_(a4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl(a1, 1) + rotl(a2, 7) + rotl(a3, 12) + rotl(a4, 18);
+    h = merge_round(h, a1);
+    h = merge_round(h, a2);
+    h = merge_round(h, a3);
+    h = merge_round(h, a4);
+  } else {
+    h = seed + P5;
+  }
+
+  h += static_cast<uint64_t>(len);
+
+  while (p + 8 <= end) {
+    h ^= round_(0, read64(p));
+    h = rotl(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= static_cast<uint64_t>(read32(p)) * P1;
+    h = rotl(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= static_cast<uint64_t>(*p) * P5;
+    h = rotl(h, 11) * P1;
+    ++p;
+  }
+
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t dyn_xxh64(const void* data, size_t len, uint64_t seed) {
+  return xxh64(data, len, seed);
+}
+
+// Hash `n_tokens` int32 tokens into `n_blocks` = n_tokens / block_size
+// complete blocks.  block_hashes[i] = xxh64(tokens of block i, seed);
+// seq_hashes[i] = xxh64(seq_hashes[i-1] || block_hashes[i], seed) — position
+// binding via the parent chain, mirroring the reference's SequenceHash.
+void dyn_hash_blocks(const int32_t* tokens, size_t n_tokens, size_t block_size,
+                     uint64_t seed, uint64_t* block_hashes, uint64_t* seq_hashes,
+                     size_t n_blocks) {
+  (void)n_tokens;
+  uint64_t parent = 0;
+  for (size_t i = 0; i < n_blocks; ++i) {
+    const int32_t* block = tokens + i * block_size;
+    uint64_t bh = xxh64(block, block_size * sizeof(int32_t), seed);
+    uint64_t chain[2] = {parent, bh};
+    uint64_t sh = (i == 0) ? bh : xxh64(chain, sizeof(chain), seed);
+    block_hashes[i] = bh;
+    seq_hashes[i] = sh;
+    parent = sh;
+  }
+}
+
+}  // extern "C"

@@ -172,3 +172,34 @@ def test_annotated_roundtrip():
 
     ann = Annotated.from_annotation("token_ids", [1, 2, 3])
     assert ann.event == "token_ids"
+
+
+def test_kill_interrupts_blocked_producer(run):
+    """kill() must terminate the stream even when the producer is stuck."""
+
+    class StuckEngine:
+        async def generate(self, request):
+            async def gen():
+                yield 1
+                await asyncio.sleep(3600)  # stalled backend
+                yield 2
+
+            return gen()
+
+    async def body():
+        req = Context.new(None)
+        stream = await as_response_stream(StuckEngine(), req)
+        assert await stream.__anext__() == 1
+
+        async def kill_soon():
+            await asyncio.sleep(0.05)
+            req.ctx.kill()
+
+        killer = asyncio.create_task(kill_soon())
+        t0 = asyncio.get_running_loop().time()
+        with pytest.raises(StopAsyncIteration):
+            await stream.__anext__()
+        assert asyncio.get_running_loop().time() - t0 < 5
+        await killer
+
+    run(body())

@@ -1,0 +1,102 @@
+"""Token hashing + block sequence tests.
+
+Cross-checks the native C++ XXH64 against the pure-Python implementation and
+against known public test vectors, then exercises TokenBlockSequence
+semantics (incremental completion, truncate/unwind, hash chaining).
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.tokens import (
+    NATIVE,
+    TokenBlockSequence,
+    block_hash,
+    hash_blocks,
+    split_tokens,
+    xxh64,
+    xxh64_py,
+)
+
+
+def test_xxh64_known_vectors():
+    # Public XXH64 test vectors.
+    assert xxh64_py(b"", 0) == 0xEF46DB3751D8E999
+    assert xxh64_py(b"", 1) == 0xD5AFBA1336A3BE4B
+    assert xxh64_py(b"a", 0) == 0xD24EC4F1A98C6E5B
+    assert xxh64_py(b"abc", 0) == 0x44BC2CF5AD770999
+
+
+def test_native_matches_python():
+    if NATIVE is None:
+        pytest.skip("native library not built")
+    rng = np.random.default_rng(0)
+    for n in [0, 1, 3, 4, 7, 8, 15, 31, 32, 33, 100, 1000]:
+        data = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        for seed in [0, 1337]:
+            assert xxh64(data, seed) == xxh64_py(data, seed), (n, seed)
+
+
+def test_hash_blocks_native_matches_fallback(monkeypatch):
+    tokens = list(range(100))
+    bh_n, sh_n = hash_blocks(tokens, 16)
+    # Force the pure-python path.
+    import dynamo_tpu.tokens.hashing as H
+
+    monkeypatch.setattr(H, "NATIVE", None)
+    bh_p, sh_p = H.hash_blocks(tokens, 16)
+    assert bh_n == bh_p
+    assert sh_n == sh_p
+    assert len(bh_n) == 6  # 100 // 16
+
+
+def test_sequence_hash_chains_position():
+    # Same block content at different positions -> different sequence hashes.
+    a = [1, 2, 3, 4, 1, 2, 3, 4]
+    bh, sh = hash_blocks(a, 4)
+    assert bh[0] == bh[1]  # same content
+    assert sh[0] != sh[1]  # different prefix
+
+    # Identical prefixes -> identical sequence hashes (cross-request).
+    bh2, sh2 = hash_blocks([1, 2, 3, 4, 9, 9, 9, 9], 4)
+    assert sh2[0] == sh[0]
+    assert sh2[1] != sh[1]
+
+
+def test_token_block_sequence_incremental_matches_batch():
+    tokens = list(np.random.default_rng(1).integers(0, 32000, size=75))
+    seq = TokenBlockSequence(block_size=16)
+    completed = []
+    for t in tokens:
+        blk = seq.append(t)
+        if blk is not None:
+            completed.append(blk)
+    assert seq.num_complete_blocks == 4
+    assert len(seq.tail_tokens) == 75 - 64
+    bh, sh = hash_blocks(tokens, 16)
+    assert seq.block_hashes() == bh
+    assert seq.sequence_hashes() == sh
+    assert [b.position for b in completed] == [0, 1, 2, 3]
+
+
+def test_truncate_and_unwind():
+    seq = TokenBlockSequence(list(range(40)), block_size=16)
+    assert seq.num_complete_blocks == 2
+    seq.unwind(10)  # 30 tokens left -> 1 complete block
+    assert len(seq) == 30
+    assert seq.num_complete_blocks == 1
+    assert seq.tail_tokens == list(range(16, 30))
+
+    # Re-extending reproduces identical hashes (determinism after rollback).
+    before = TokenBlockSequence(list(range(40)), block_size=16)
+    seq.extend(range(30, 40))
+    assert seq.sequence_hashes() == before.sequence_hashes()
+
+    with pytest.raises(ValueError):
+        seq.truncate(1000)
+
+
+def test_split_tokens():
+    bhs, shs, tail = split_tokens(list(range(20)), 8)
+    assert len(bhs) == 2 and len(shs) == 2
+    assert tail == [16, 17, 18, 19]

@@ -352,3 +352,16 @@ def test_cross_process_cancellation(run):
             await hub_server.stop()
 
     run(body())
+
+
+def test_subject_matching_semantics():
+    from dynamo_tpu.runtime.transports.hub import _subject_matches
+
+    assert _subject_matches("a.b.c", "a.b.c")
+    assert _subject_matches("a.*.c", "a.x.c")
+    assert not _subject_matches("a.*.c", "a.x.y")
+    assert _subject_matches("a.>", "a.b")
+    assert _subject_matches("a.>", "a.b.c.d")
+    assert not _subject_matches("a.>", "a")  # '>' needs >= 1 token
+    assert not _subject_matches("a.b", "a")
+    assert not _subject_matches("a", "a.b")
