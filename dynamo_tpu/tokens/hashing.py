@@ -99,6 +99,35 @@ _NATIVE_PATHS = [
 ]
 
 
+def ensure_native_built() -> bool:
+    """Build native/build/libdynnative.so if a toolchain is available.
+
+    Called explicitly by conftest/bench (never at import time).  Returns True
+    if the library exists afterwards.
+    """
+    lib_path = os.path.join(_REPO_ROOT, "native", "build", "libdynnative.so")
+    if os.path.exists(lib_path):
+        return True
+    import shutil
+    import subprocess
+
+    make = shutil.which("make")
+    if make is None or not os.path.exists(os.path.join(_REPO_ROOT, "native")):
+        return False
+    try:
+        subprocess.run(
+            [make, "-C", os.path.join(_REPO_ROOT, "native")],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (subprocess.SubprocessError, OSError):
+        return False
+    global NATIVE
+    NATIVE = _load_native()
+    return os.path.exists(lib_path)
+
+
 def _load_native() -> Optional[ctypes.CDLL]:
     for path in _NATIVE_PATHS:
         if path and os.path.exists(path):

@@ -19,6 +19,10 @@ import asyncio  # noqa: E402
 
 import pytest  # noqa: E402
 
+from dynamo_tpu.tokens.hashing import ensure_native_built  # noqa: E402
+
+ensure_native_built()
+
 
 @pytest.fixture
 def run():
