@@ -1,0 +1,18 @@
+"""First-party JAX/XLA engine: the TPU-native replacement for the
+reference's delegated GPU engines (vLLM/SGLang/TRT-LLM).
+
+The engine is structured TPU-first:
+
+- model forward passes are pure functions over a params pytree, jitted once
+  per (bucket, batch) shape with sharding annotations over a device mesh;
+- the KV cache is paged: one device array per model
+  ``[layers, 2, num_pages, page_size, kv_heads, head_dim]``, written with
+  scatters and read with gathers (Pallas kernel on the hot path);
+- continuous batching runs as a host-side scheduler feeding fixed-capacity
+  device loops -- no dynamic shapes under jit.
+"""
+
+from .config import ModelConfig
+from .engine import EngineConfig, JaxEngine
+
+__all__ = ["ModelConfig", "EngineConfig", "JaxEngine"]

@@ -1,0 +1,123 @@
+"""Attention ops over the paged KV cache (reference-free JAX implementations).
+
+Layout: the KV cache for one layer is ``[2, num_pages, page_size, kv_heads,
+head_dim]``; a request owns a list of pages recorded in its row of the page
+table ``[batch, pages_per_seq]``.  Page 0 is reserved as the trash page:
+inactive batch slots scatter their writes there, so dead lanes never corrupt
+live state and every step runs with fully static shapes (XLA requirement).
+
+These are the reference implementations; the Pallas kernel in
+``dynamo_tpu.ops.paged_attention`` replaces the decode gather path on the hot
+loop (same signature, validated against these in tests).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[.., kv_heads, d] -> [.., kv_heads * n_rep, d] (GQA expansion)."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def prefill_attention(
+    q: jax.Array,  # [B, T, Hq, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,  # [B, T, Hkv, D]
+    seq_lens: jax.Array,  # [B] valid prompt length per slot
+) -> jax.Array:
+    """Causal self-attention over the prompt being prefilled.
+
+    Assumes the prompt starts at position 0 (no prior cache); prefix-cache
+    restarts gather reused pages through the decode path instead.
+    """
+    B, T, Hq, D = q.shape
+    n_rep = Hq // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    # [B, H, T, T]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    pos = jnp.arange(T)
+    causal = pos[None, :] <= pos[:, None]  # [Tq, Tk] keys <= query
+    valid = pos[None, :] < seq_lens[:, None]  # [B, Tk]
+    mask = causal[None, None, :, :] & valid[:, None, None, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, Hq, D] one new query token per slot
+    kv_pages: jax.Array,  # [2, num_pages, page_size, Hkv, D]
+    page_table: jax.Array,  # [B, P] int32 page ids
+    kv_lens: jax.Array,  # [B] tokens in cache (incl. the one just written)
+) -> jax.Array:
+    """Decode-step attention: gather each slot's pages, mask, softmax.
+
+    The gather materializes ``[B, P*page_size, Hkv, D]`` -- the classic
+    paged-attention v1 shape.  P (pages per sequence) is static; kv_lens
+    masks the tail.
+    """
+    B, Hq, D = q.shape
+    _, _, page_size, Hkv, _ = kv_pages.shape
+    P = page_table.shape[1]
+    n_rep = Hq // Hkv
+
+    k = kv_pages[0][page_table]  # [B, P, page, Hkv, D]
+    v = kv_pages[1][page_table]
+    k = k.reshape(B, P * page_size, Hkv, D)
+    v = v.reshape(B, P * page_size, Hkv, D)
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    scores = jnp.einsum("bhd,bkhd->bhk", q, k) * scale  # [B, Hq, P*page]
+    idx = jnp.arange(P * page_size)
+    mask = idx[None, :] < kv_lens[:, None]  # [B, P*page]
+    scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhk,bkhd->bhd", probs, v)
+
+
+def write_prefill_kv(
+    kv_pages: jax.Array,  # [2, num_pages, page, Hkv, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,  # [B, T, Hkv, D]
+    page_table: jax.Array,  # [B, P]
+) -> jax.Array:
+    """Scatter a full prompt's K/V into its pages.  T must be a multiple of
+    page_size (prompts are bucket-padded); pad lanes land on trash page 0."""
+    B, T, Hkv, D = k.shape
+    page_size = kv_pages.shape[2]
+    n_pages = T // page_size
+    ids = page_table[:, :n_pages].reshape(-1)  # [B*n_pages]
+    kp = k.reshape(B * n_pages, page_size, Hkv, D)
+    vp = v.reshape(B * n_pages, page_size, Hkv, D)
+    kv_pages = kv_pages.at[0, ids].set(kp)
+    kv_pages = kv_pages.at[1, ids].set(vp)
+    return kv_pages
+
+
+def write_decode_kv(
+    kv_pages: jax.Array,  # [2, num_pages, page, Hkv, D]
+    k: jax.Array,  # [B, Hkv, D] one token
+    v: jax.Array,
+    page_table: jax.Array,  # [B, P]
+    positions: jax.Array,  # [B] position the token lands at
+) -> jax.Array:
+    page_size = kv_pages.shape[2]
+    B = k.shape[0]
+    page_idx = positions // page_size
+    slot = positions % page_size
+    ids = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+    del B
+    kv_pages = kv_pages.at[0, ids, slot].set(k)
+    kv_pages = kv_pages.at[1, ids, slot].set(v)
+    return kv_pages

@@ -1,0 +1,131 @@
+"""Model architecture config for the first-party JAX engine.
+
+Covers the Llama family surface (Llama 2/3, Mistral, Qwen2 via
+``attention_bias``, Mixtral/DeepSeek-style MoE via ``num_experts``) -- the
+model families the reference serves through vLLM/TRT-LLM configs
+(reference examples/llm/configs/*.yaml, examples/tensorrt_llm/configs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position: int = 4096
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False  # Qwen2-style qkv bias
+    # MoE (Mixtral-style); num_experts == 0 means dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # activation dtype for compute; params may be stored differently
+    dtype: str = "bfloat16"
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @classmethod
+    def tiny(cls, **overrides: Any) -> "ModelConfig":
+        """A CI-sized config: runs in milliseconds on CPU, same code paths."""
+        base = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            max_position=512,
+            dtype="float32",
+        )
+        base.update(overrides)
+        return cls(**base)
+
+    @classmethod
+    def llama3_8b(cls) -> "ModelConfig":
+        return cls(
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=500000.0,
+            max_position=8192,
+        )
+
+    @classmethod
+    def llama3_70b(cls) -> "ModelConfig":
+        return cls(
+            vocab_size=128256,
+            hidden_size=8192,
+            intermediate_size=28672,
+            num_layers=80,
+            num_heads=64,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=500000.0,
+            max_position=8192,
+        )
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "ModelConfig":
+        return cls(
+            vocab_size=32000,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=1000000.0,
+            max_position=32768,
+            num_experts=8,
+            num_experts_per_tok=2,
+        )
+
+    @classmethod
+    def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":
+        """Build from a HuggingFace ``config.json`` dict (llama/mistral/qwen2/
+        mixtral architectures)."""
+        hidden = cfg["hidden_size"]
+        heads = cfg["num_attention_heads"]
+        return cls(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=cfg.get("num_key_value_heads", heads),
+            head_dim=cfg.get("head_dim", hidden // heads),
+            rope_theta=float(cfg.get("rope_theta", 10000.0)),
+            rms_norm_eps=float(cfg.get("rms_norm_eps", 1e-5)),
+            max_position=cfg.get("max_position_embeddings", 4096),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            attention_bias=bool(
+                cfg.get("attention_bias", False)
+                or cfg.get("model_type") == "qwen2"
+            ),
+            num_experts=cfg.get("num_local_experts", 0),
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+        )
+
+    @classmethod
+    def from_pretrained(cls, model_path: str) -> "ModelConfig":
+        with open(os.path.join(model_path, "config.json")) as f:
+            return cls.from_hf_config(json.load(f))

@@ -1,0 +1,434 @@
+"""JaxEngine: the first-party TPU engine behind the AsyncEngine interface.
+
+This is the component the reference delegates to vLLM/SGLang/TRT-LLM
+subprocesses (launch/dynamo-run/src/subprocess/vllm_inc.py:53-120); here it
+is first-party: ``generate(Context[PreprocessedRequest]) ->
+AsyncIterator[Annotated[LLMEngineOutput-dict]]`` -- the token-level
+``ExecutionContext`` shape of the reference (lib/llm/src/backend.rs:60).
+
+Threading model: one asyncio task drives ticks; device dispatches run in a
+single-worker executor thread so the event loop keeps serving I/O while XLA
+executes.  All scheduler state is touched either inside an executor call or
+between them (the tick awaits each call), so no locks are needed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime.engine import Annotated, Context, ResponseStream
+from ..protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from ..tokens.sequence import TokenBlock
+from .config import ModelConfig
+from .kv_cache import PagedKVCache
+from .model import Params, init_params
+from .sampling import SamplingParams
+from .scheduler import Scheduler, SchedulerConfig, SeqState, StepEvent
+from .step import decode_step, pick_bucket, prefill_buckets, prefill_step, sample_step
+
+logger = logging.getLogger("dynamo.engine")
+
+
+@dataclass
+class EngineConfig:
+    max_batch_size: int = 8
+    max_seq_len: int = 2048
+    page_size: int = 16
+    num_pages: int = 512
+    block_size: Optional[int] = None  # router-visible KV block size
+    seed: int = 0
+    dtype: Optional[str] = None
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Worker load metrics published to the KV router
+    (reference kv_router/protocols.rs:43-62; 'gpu_*' names kept for parity)."""
+
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self.__dict__.copy()
+
+
+class JaxEngine:
+    """Continuous-batching JAX engine over a paged KV cache."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        params: Params,
+        cfg: Optional[EngineConfig] = None,
+        kv_sharding: Optional[jax.sharding.Sharding] = None,
+    ) -> None:
+        self.model_cfg = model_cfg
+        self.cfg = cfg or EngineConfig()
+        self.params = params
+        self.kv = PagedKVCache(
+            model_cfg,
+            num_pages=self.cfg.num_pages,
+            page_size=self.cfg.page_size,
+            dtype=self.cfg.dtype,
+            sharding=kv_sharding,
+        )
+        self.sched = Scheduler(
+            SchedulerConfig(
+                max_batch_size=self.cfg.max_batch_size,
+                max_seq_len=self.cfg.max_seq_len,
+                page_size=self.cfg.page_size,
+                block_size=self.cfg.block_size,
+            ),
+            self.kv.allocator,
+        )
+        self.buckets = prefill_buckets(self.cfg.page_size, self.cfg.max_seq_len)
+        self._rng = jax.random.PRNGKey(self.cfg.seed)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._cancelled: set = set()
+        self._wake: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+        self._ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="jax-engine"
+        )
+        self._running = False
+        # KV event sink: fn(event_dict) -- wired to the router event publisher
+        self.kv_event_sink: Optional[Callable[[Dict[str, Any]], None]] = None
+        self._prefix_hits = 0
+        self._prefix_lookups = 0
+        self._steps = 0
+        self._tokens_generated = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @classmethod
+    def random_init(
+        cls,
+        model_cfg: ModelConfig,
+        cfg: Optional[EngineConfig] = None,
+        seed: int = 0,
+    ) -> "JaxEngine":
+        params = init_params(model_cfg, jax.random.PRNGKey(seed))
+        return cls(model_cfg, params, cfg)
+
+    @classmethod
+    def from_pretrained(
+        cls, model_path: str, cfg: Optional[EngineConfig] = None
+    ) -> "JaxEngine":
+        from .weights import load_safetensors_params
+
+        model_cfg = ModelConfig.from_pretrained(model_path)
+        params = load_safetensors_params(model_path, model_cfg)
+        return cls(model_cfg, params, cfg)
+
+    async def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._wake = asyncio.Event()
+        self._task = asyncio.create_task(self._run(), name="jax-engine-loop")
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._wake is not None:
+            self._wake.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        self._ex.shutdown(wait=False)
+
+    # -- AsyncEngine --------------------------------------------------------
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
+        """Token-level generate; yields Annotated[LLMEngineOutput-dict]."""
+        if not self._running:
+            await self.start()
+        data = request.data
+        if isinstance(data, dict):
+            req = PreprocessedRequest.from_dict(data)
+        else:
+            req = data
+        seq = SeqState.from_request(request.id, req, self.sched.block_size)
+        ctx = request.ctx
+        try:
+            self.sched.enqueue(seq)
+        except ValueError as e:
+            # surface as an error item, matching the remote prologue-error path
+            message = str(e)
+
+            async def err_stream() -> AsyncIterator[Annotated]:
+                yield Annotated.from_error(message)
+
+            return ResponseStream(ctx, err_stream())
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[request.id] = queue
+        assert self._wake is not None
+        self._wake.set()
+
+        async def stream() -> AsyncIterator[Annotated]:
+            try:
+                while True:
+                    get = asyncio.ensure_future(queue.get())
+                    stop_waiter = asyncio.ensure_future(ctx.stopped())
+                    done, _ = await asyncio.wait(
+                        {get, stop_waiter}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    if get not in done:
+                        get.cancel()
+                        stop_waiter.cancel()
+                        self._cancelled.add(request.id)
+                        self._wake.set()
+                        yield Annotated.from_data(
+                            LLMEngineOutput.finished(FinishReason.CANCELLED).to_dict()
+                        )
+                        return
+                    stop_waiter.cancel()
+                    item = get.result()
+                    if item is None:
+                        return
+                    yield item
+            finally:
+                self._queues.pop(request.id, None)
+
+        return ResponseStream(ctx, stream())
+
+    # -- metrics ------------------------------------------------------------
+
+    def metrics(self) -> ForwardPassMetrics:
+        alloc = self.kv.allocator
+        hit_rate = (
+            self._prefix_hits / self._prefix_lookups if self._prefix_lookups else 0.0
+        )
+        return ForwardPassMetrics(
+            kv_active_blocks=alloc.used_pages,
+            kv_total_blocks=alloc.num_pages - 1,
+            num_requests_waiting=self.sched.num_waiting,
+            gpu_cache_usage_perc=self.kv.usage,
+            gpu_prefix_cache_hit_rate=hit_rate,
+            request_active_slots=self.sched.num_active,
+            request_total_slots=self.cfg.max_batch_size,
+        )
+
+    @property
+    def tokens_generated(self) -> int:
+        return self._tokens_generated
+
+    # -- the tick loop ------------------------------------------------------
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        assert self._wake is not None
+        while self._running:
+            try:
+                self._process_cancellations()
+                if not self.sched.has_work:
+                    self._wake.clear()
+                    await self._wake.wait()
+                    continue
+                plan = self.sched.plan()
+                for seq, prompt_len in plan.prefills:
+                    ev = await loop.run_in_executor(
+                        self._ex, self._do_prefill, seq, prompt_len
+                    )
+                    self._dispatch([ev])
+                if plan.run_decode and self.sched.num_active > 0:
+                    events = await loop.run_in_executor(self._ex, self._do_decode)
+                    self._dispatch(events)
+                if not plan.prefills and not plan.run_decode:
+                    self._handle_stalled_admission()
+                # yield so enqueue/cancel callbacks interleave
+                await asyncio.sleep(0)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # engine must never die silently
+                logger.exception("engine tick failed")
+                self._fail_all(f"engine error: {e}")
+                await asyncio.sleep(0.01)
+
+    def _handle_stalled_admission(self) -> None:
+        """Nothing running, nothing admitted: requests whose prompts can never
+        fit the page pool must fail instead of spinning the loop forever."""
+        sched = self.sched
+        if sched.num_active > 0 or not sched.waiting:
+            return
+        head = sched.waiting[0]
+        reason = (
+            f"request needs more KV pages than the pool holds "
+            f"({len(head.prompt)} prompt tokens, "
+            f"{sched.allocator.num_pages - 1} pages of {sched.cfg.page_size})"
+        )
+        # With no active sequences, no pages will ever free up -- anything
+        # unadmittable now is unadmittable forever.
+        sched.waiting.popleft()
+        self._fail_seq(head, reason)
+
+    def _fail_seq(self, seq: SeqState, message: str) -> None:
+        queue = self._queues.get(seq.request_id)
+        if queue is not None:
+            queue.put_nowait(Annotated.from_error(message))
+            queue.put_nowait(None)
+
+    def _fail_all(self, message: str) -> None:
+        for seq in list(self.sched.waiting) + [
+            s for s in self.sched.slots if s is not None
+        ]:
+            self._fail_seq(seq, message)
+            self.sched.cancel(seq)
+
+    def _process_cancellations(self) -> None:
+        if not self._cancelled:
+            return
+        by_id = {}
+        for s in self.sched.slots:
+            if s is not None:
+                by_id[s.request_id] = s
+        for s in self.sched.waiting:
+            by_id[s.request_id] = s
+        for rid in list(self._cancelled):
+            self._cancelled.discard(rid)
+            seq = by_id.get(rid)
+            if seq is not None:
+                self._publish_removed(seq)
+                self.sched.cancel(seq)
+
+    # -- device work (executor thread) --------------------------------------
+
+    def _sampling_arrays(self, seqs: List[Optional[SeqState]]) -> SamplingParams:
+        n = len(seqs)
+        temp = np.zeros((n,), np.float32)
+        top_p = np.ones((n,), np.float32)
+        top_k = np.zeros((n,), np.int32)
+        for i, s in enumerate(seqs):
+            if s is None:
+                continue
+            so = s.sampling
+            if so.temperature is not None:
+                temp[i] = so.temperature
+            elif so.top_p is not None or so.top_k is not None:
+                # unset temperature with explicit top_p/top_k means "sample":
+                # default temperature 1.0, not greedy
+                temp[i] = 1.0
+            top_p[i] = so.top_p if so.top_p is not None else 1.0
+            top_k[i] = so.top_k or 0
+        return SamplingParams(
+            temperature=jnp.asarray(temp),
+            top_p=jnp.asarray(top_p),
+            top_k=jnp.asarray(top_k),
+        )
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _do_prefill(self, seq: SeqState, prompt_len: int) -> StepEvent:
+        # Prefix-cache reuse lands with the block-manager integration; until
+        # then every lookup is an honest miss (hit counter stays 0).
+        self._prefix_lookups += 1
+        self._prefix_hits += 1 if seq.cached_prompt_tokens else 0
+        bucket = pick_bucket(self.buckets, prompt_len)
+        n_pages = bucket // self.cfg.page_size
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :prompt_len] = seq.prompt
+        page_table = np.zeros((1, n_pages), np.int32)
+        page_table[0, : len(seq.pages)] = seq.pages
+        seq_lens = np.asarray([prompt_len], np.int32)
+
+        t0 = time.monotonic()
+        logits, self.kv.pages = prefill_step(
+            self.params,
+            self.model_cfg,
+            self.kv.pages,
+            jnp.asarray(tokens),
+            jnp.asarray(seq_lens),
+            jnp.asarray(page_table),
+        )
+        sp = self._sampling_arrays([seq])
+        sampled = sample_step(logits, self._next_rng(), sp)
+        token = int(np.asarray(sampled)[0])
+        logger.debug(
+            "prefill id=%s len=%d bucket=%d %.1fms",
+            seq.request_id, prompt_len, bucket, (time.monotonic() - t0) * 1e3,
+        )
+        self._steps += 1
+        return self.sched.commit_prefill_token(seq, token)
+
+    def _do_decode(self) -> List[StepEvent]:
+        self.sched.ensure_decode_capacity()
+        logits, self.kv.pages = decode_step(
+            self.params,
+            self.model_cfg,
+            self.kv.pages,
+            jnp.asarray(self.sched.tokens),
+            jnp.asarray(self.sched.seq_lens),
+            jnp.asarray(self.sched.page_table),
+        )
+        sp = self._sampling_arrays(list(self.sched.slots))
+        sampled = sample_step(logits, self._next_rng(), sp)
+        self._steps += 1
+        return self.sched.commit_tokens(np.asarray(sampled))
+
+    # -- event/output dispatch (loop thread) --------------------------------
+
+    def _dispatch(self, events: List[StepEvent]) -> None:
+        for ev in events:
+            queue = self._queues.get(ev.seq.request_id)
+            if ev.token is not None:
+                self._tokens_generated += 1
+            if ev.completed_blocks:
+                self._publish_stored(ev.seq, ev.completed_blocks)
+            if queue is None:
+                continue
+            if ev.token is not None:
+                out = LLMEngineOutput(token_ids=[ev.token])
+                queue.put_nowait(Annotated.from_data(out.to_dict()))
+            if ev.finished is not None:
+                out = LLMEngineOutput.finished(ev.finished)
+                queue.put_nowait(Annotated.from_data(out.to_dict()))
+                queue.put_nowait(None)
+                self._publish_removed(ev.seq)
+
+    def _publish_stored(self, seq: SeqState, blocks: List[TokenBlock]) -> None:
+        if self.kv_event_sink is None:
+            return
+        self.kv_event_sink(
+            {
+                "type": "stored",
+                "blocks": [
+                    {
+                        "block_hash": b.block_hash,
+                        "sequence_hash": b.sequence_hash,
+                        "parent_sequence_hash": b.parent_sequence_hash,
+                        "position": b.position,
+                    }
+                    for b in blocks
+                ],
+            }
+        )
+
+    def _publish_removed(self, seq: SeqState) -> None:
+        if self.kv_event_sink is None or seq.blocks is None:
+            return
+        hashes = seq.blocks.sequence_hashes()
+        if hashes:
+            self.kv_event_sink({"type": "removed", "sequence_hashes": hashes})

@@ -1,0 +1,124 @@
+"""Paged KV cache: device pages + host-side page allocator.
+
+The G1 (HBM) tier of the multi-tier design (reference block_manager
+CacheLevel G1, lib/llm/src/block_manager.rs:66-80).  One device array per
+model:
+
+    kv_pages: [num_layers, 2, num_pages, page_size, num_kv_heads, head_dim]
+
+Page 0 is the reserved trash page (inactive batch lanes write there), so the
+usable pool is pages ``1..num_pages``.  Allocation is a host-side free list:
+page ids are just ints; the device array is only touched by the jitted step
+functions (functional update, buffer donated so XLA updates in place).
+
+G2 (host RAM) / G3 (disk) offload tiers and the sequence-hash reuse registry
+live in dynamo_tpu.block_manager; this module is the minimal engine-facing
+pool.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .config import ModelConfig
+
+
+class OutOfPages(RuntimeError):
+    pass
+
+
+class PageAllocator:
+    """LIFO free-list over page ids 1..num_pages-1 (0 is the trash page)."""
+
+    def __init__(self, num_pages: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n <= 0:
+            return []
+        if n > len(self._free):
+            raise OutOfPages(f"requested {n} pages, {len(self._free)} free")
+        out = self._free[-n:][::-1]
+        del self._free[len(self._free) - n :]
+        return out
+
+    def free(self, pages: List[int]) -> None:
+        self._free.extend(pages)
+
+
+class PagedKVCache:
+    """Owns the device KV array and its allocator."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        num_pages: int,
+        page_size: int = 16,
+        dtype: Any = None,
+        sharding: Optional[jax.sharding.Sharding] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.dtype = jnp.dtype(dtype or cfg.dtype)
+        self.allocator = PageAllocator(num_pages)
+        shape = (
+            cfg.num_layers,
+            2,
+            num_pages,
+            page_size,
+            cfg.num_kv_heads,
+            cfg.head_dim,
+        )
+        arr = jnp.zeros(shape, self.dtype)
+        if sharding is not None:
+            arr = jax.device_put(arr, sharding)
+        self.pages = arr
+
+    @property
+    def bytes_per_page(self) -> int:
+        c = self.cfg
+        return (
+            c.num_layers * 2 * self.page_size * c.num_kv_heads * c.head_dim
+            * self.dtype.itemsize
+        )
+
+    def pages_for_tokens(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    @property
+    def usage(self) -> float:
+        total = self.num_pages - 1
+        return self.allocator.used_pages / total if total else 0.0
+
+
+def choose_num_pages(
+    cfg: ModelConfig,
+    page_size: int,
+    hbm_bytes: int,
+    param_bytes: int,
+    mem_fraction: float = 0.9,
+    kv_dtype_size: int = 2,
+) -> int:
+    """Size the G1 pool from available HBM after weights (reference vLLM-style
+    gpu_memory_utilization accounting)."""
+    per_page = (
+        cfg.num_layers * 2 * page_size * cfg.num_kv_heads * cfg.head_dim
+        * kv_dtype_size
+    )
+    budget = int(hbm_bytes * mem_fraction) - param_bytes
+    return max(2, budget // per_page)

@@ -1,0 +1,224 @@
+"""Llama-family transformer as pure JAX functions over a stacked-params pytree.
+
+Design (TPU-first, not a torch translation):
+
+- **Stacked layers + ``lax.scan``**: every per-layer weight is stored with a
+  leading ``[num_layers, ...]`` axis and the layer loop is a ``lax.scan``.
+  One layer gets traced/compiled once regardless of depth -- an 80-layer
+  70B compiles in the same time as a 2-layer test model.
+- **Params are a flat dict pytree** (no framework Module state); sharding is
+  applied by annotating the pytree leaves with ``NamedSharding`` at load
+  time (see dynamo_tpu.parallel.sharding) and letting GSPMD propagate.
+- **Weights are stored ``[in, out]``** so the forward is ``x @ W`` (row-major
+  matmuls map directly onto the MXU); the safetensors loader transposes from
+  torch's ``[out, in]``.
+
+RoPE matches the HF ``rotate_half`` convention so HF checkpoints reproduce
+logits bit-for-band (validated against transformers' torch CPU reference in
+tests/test_engine_model.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype: Any = None) -> Params:
+    """Random-init a full parameter pytree (tests/benchmarks; real serving
+    loads safetensors via dynamo_tpu.engine.weights)."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    L = cfg.num_layers
+    H = cfg.hidden_size
+    D = cfg.head_dim
+    Hq, Hkv = cfg.num_heads, cfg.num_kv_heads
+    I = cfg.intermediate_size
+
+    keys = iter(jax.random.split(key, 16))
+
+    def w(k, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / jnp.sqrt(shape[-2] if len(shape) > 1 else shape[-1]))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers: Dict[str, Any] = {
+        "wq": w(next(keys), (L, H, Hq * D)),
+        "wk": w(next(keys), (L, H, Hkv * D)),
+        "wv": w(next(keys), (L, H, Hkv * D)),
+        "wo": w(next(keys), (L, Hq * D, H)),
+        "input_norm": jnp.ones((L, H), dtype),
+        "post_norm": jnp.ones((L, H), dtype),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((L, Hq * D), dtype)
+        layers["bk"] = jnp.zeros((L, Hkv * D), dtype)
+        layers["bv"] = jnp.zeros((L, Hkv * D), dtype)
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["router"] = w(next(keys), (L, H, E))
+        layers["w_gate"] = w(next(keys), (L, E, H, I))
+        layers["w_up"] = w(next(keys), (L, E, H, I))
+        layers["w_down"] = w(next(keys), (L, E, I, H))
+    else:
+        layers["w_gate"] = w(next(keys), (L, H, I))
+        layers["w_up"] = w(next(keys), (L, H, I))
+        layers["w_down"] = w(next(keys), (L, I, H))
+
+    params: Params = {
+        "embed": w(next(keys), (cfg.vocab_size, H), scale=0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (H, cfg.vocab_size))
+    return params
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * weight.astype(jnp.float32)).astype(dt)
+
+
+def rope_cos_sin(
+    positions: jax.Array, head_dim: int, theta: float
+) -> Tuple[jax.Array, jax.Array]:
+    """HF convention: inv_freq over even dims, angles ``pos * inv_freq``,
+    cos/sin tiled as [freqs, freqs]."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., D/2]
+    emb = jnp.concatenate([angles, angles], axis=-1)  # [..., D]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [..., heads, D]; cos/sin: [..., D] (broadcast over heads)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    return (x.astype(jnp.float32) * cos + rotated.astype(jnp.float32) * sin).astype(
+        x.dtype
+    )
+
+
+def _dense_mlp(lp: Params, x: jax.Array) -> jax.Array:
+    gate = jax.nn.silu(x @ lp["w_gate"])
+    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Mixtral-style sparse MoE via one-hot dispatch einsums.
+
+    Correct and jit-friendly at any scale; the EP-sharded all_to_all path
+    (dynamo_tpu.parallel) replaces the dispatch when an ``expert`` mesh axis
+    is present.
+    """
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    xf = x.reshape(-1, H)  # [N, H]
+    router_logits = (xf @ lp["router"]).astype(jnp.float32)  # [N, E]
+    topw, topi = jax.lax.top_k(router_logits, cfg.num_experts_per_tok)
+    topw = jax.nn.softmax(topw, axis=-1).astype(x.dtype)  # [N, K]
+    one_hot = jax.nn.one_hot(topi, cfg.num_experts, dtype=x.dtype)  # [N, K, E]
+    combine = jnp.einsum("nk,nke->ne", topw, one_hot)  # [N, E]
+    # dense dispatch: every expert sees every token, weighted combine.
+    gate = jax.nn.silu(jnp.einsum("nh,ehi->eni", xf, lp["w_gate"]))
+    up = jnp.einsum("nh,ehi->eni", xf, lp["w_up"])
+    down = jnp.einsum("eni,eih->enh", gate * up, lp["w_down"])  # [E, N, H]
+    out = jnp.einsum("enh,ne->nh", down, combine)
+    return out.reshape(orig_shape)
+
+
+# ---------------------------------------------------------------------------
+# transformer trunk
+# ---------------------------------------------------------------------------
+
+# An attention callback receives (q, k, v, layer_kv) and returns
+# (attn_out, new_layer_kv); prefill and decode provide different callbacks
+# (see step.py). q/k/v carry head dims: q [.., Hq, D], k/v [.., Hkv, D].
+AttnFn = Callable[[jax.Array, jax.Array, jax.Array, jax.Array], Tuple[jax.Array, jax.Array]]
+
+
+def transformer(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [B, T] or [B] int32
+    positions: jax.Array,  # same leading shape as tokens
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    attn_fn: AttnFn,
+) -> Tuple[jax.Array, jax.Array]:
+    """Run the trunk; returns (hidden [.., H], updated kv_pages)."""
+    squeeze = tokens.ndim == 1
+    if squeeze:
+        tokens = tokens[:, None]
+        positions = positions[:, None]
+
+    B, T = tokens.shape
+    D = cfg.head_dim
+    x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    cos, sin = rope_cos_sin(positions, D, cfg.rope_theta)  # [B, T, D]
+
+    lp_stack = params["layers"]
+    has_bias = "bq" in lp_stack
+
+    def layer(x: jax.Array, scanned) -> Tuple[jax.Array, jax.Array]:
+        lp, layer_kv = scanned
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if has_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = q.reshape(B, T, cfg.num_heads, D)
+        k = k.reshape(B, T, cfg.num_kv_heads, D)
+        v = v.reshape(B, T, cfg.num_kv_heads, D)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn, new_kv = attn_fn(q, k, v, layer_kv)
+        x = x + attn.reshape(B, T, cfg.num_heads * D) @ lp["wo"]
+        h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            x = x + _moe_mlp(lp, h2, cfg)
+        else:
+            x = x + _dense_mlp(lp, h2)
+        return x, new_kv
+
+    x, new_kv_pages = jax.lax.scan(
+        lambda carry, scanned: layer(carry, scanned), x, (lp_stack, kv_pages)
+    )
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if squeeze:
+        x = x[:, 0]
+    return x, new_kv_pages
+
+
+def lm_logits(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    if cfg.tie_word_embeddings:
+        w = params["embed"].T
+    else:
+        w = params["lm_head"]
+    return (hidden @ w).astype(jnp.float32)

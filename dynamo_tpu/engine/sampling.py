@@ -1,0 +1,69 @@
+"""Token sampling: batched, jittable, per-request parameters.
+
+Greedy (temperature == 0), temperature, top-k and top-p all execute as one
+vectorized program over the batch -- per-request settings are arrays, not
+Python branches, so one compiled sampler serves every request mix
+(XLA requirement: no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+class SamplingParams(NamedTuple):
+    """Per-slot sampling settings as device arrays (batch-shaped)."""
+
+    temperature: jax.Array  # [B] f32; <= 0 means greedy
+    top_p: jax.Array  # [B] f32 in (0, 1]; 1 disables
+    top_k: jax.Array  # [B] i32; 0 disables
+
+    @classmethod
+    def fill(cls, batch: int, temperature=0.0, top_p=1.0, top_k=0):
+        return cls(
+            temperature=jnp.full((batch,), temperature, jnp.float32),
+            top_p=jnp.full((batch,), top_p, jnp.float32),
+            top_k=jnp.full((batch,), top_k, jnp.int32),
+        )
+
+
+def sample_tokens(
+    logits: jax.Array,  # [B, V] float32
+    rng: jax.Array,
+    params: SamplingParams,
+) -> jax.Array:
+    """Returns sampled token ids [B] int32."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # One descending sort serves both top-k and top-p filtering.
+    sorted_logits = -jnp.sort(-scaled, axis=-1)  # [B, V] descending
+    # top-k: threshold at the k-th largest value (k==0 -> keep all)
+    k = jnp.where(params.top_k > 0, params.top_k, V)
+    kth = jnp.take_along_axis(
+        sorted_logits, jnp.minimum(k - 1, V - 1)[:, None], axis=-1
+    )  # [B, 1]
+    masked = jnp.where(scaled >= kth, scaled, _NEG_INF)
+
+    # top-p: smallest prefix of the sorted distribution with mass >= p
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # keep entries whose *preceding* cumulative mass is < p
+    keep_sorted = (cum - probs_sorted) < params.top_p[:, None]
+    # threshold = smallest kept logit value per row
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    masked = jnp.where(scaled >= thresh, masked, _NEG_INF)
+
+    sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(params.temperature <= 0.0, greedy, sampled)

@@ -1,0 +1,316 @@
+"""Continuous-batching scheduler: host-side state feeding fixed-shape device
+steps.
+
+Behavioral spec comes from the reference mocker scheduler / KV manager split
+(lib/llm/src/mocker/scheduler.rs:185, kv_manager.rs:55) and vLLM-style
+continuous batching, re-shaped for XLA: the device sees a fixed-capacity
+decode batch (``max_batch_size`` lanes) and bucket-padded prefill shapes;
+all variability -- admission, slot assignment, page growth, stop conditions,
+preemption -- lives here on the host.
+
+The scheduler is sans-IO: it owns numpy mirrors of the device-side batch
+arrays (tokens / seq_lens / page_table) and pure-Python bookkeeping; the
+engine drives it and runs the actual jitted steps.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from ..tokens.sequence import TokenBlock, TokenBlockSequence
+from .kv_cache import OutOfPages, PageAllocator
+
+
+@dataclass
+class SchedulerConfig:
+    max_batch_size: int = 8
+    max_seq_len: int = 2048
+    page_size: int = 16
+    # max prompts prefilled per tick (each prefill is one device dispatch)
+    max_prefill_per_tick: int = 1
+    # KV block size for router-visible block identity (token hashing); usually
+    # equals page_size but decoupled (reference recommends 128 for routing).
+    block_size: Optional[int] = None
+
+
+@dataclass
+class SeqState:
+    """One in-flight request."""
+
+    request_id: str
+    prompt: List[int]
+    stop: StopConditions
+    sampling: SamplingOptions
+    eos_ids: List[int]
+    arrival_s: float = field(default_factory=time.monotonic)
+    slot: int = -1
+    pages: List[int] = field(default_factory=list)
+    blocks: Optional[TokenBlockSequence] = None  # router-visible block identity
+    num_generated: int = 0
+    # tokens generated before the last preemption (already streamed to the
+    # client); stop-condition accounting uses prior_generated + num_generated
+    prior_generated: int = 0
+    finish: Optional[FinishReason] = None
+    # number of prompt tokens whose KV was reused from a prefix-cache match
+    cached_prompt_tokens: int = 0
+
+    @property
+    def seq_len(self) -> int:
+        return len(self.prompt) + self.num_generated
+
+    @classmethod
+    def from_request(cls, request_id: str, req: PreprocessedRequest, block_size: int) -> "SeqState":
+        return cls(
+            request_id=request_id,
+            prompt=list(req.token_ids),
+            stop=req.stop_conditions,
+            sampling=req.sampling_options,
+            eos_ids=list(req.eos_token_ids),
+            blocks=TokenBlockSequence(req.token_ids, block_size=block_size),
+        )
+
+
+@dataclass
+class TickPlan:
+    """What the engine must execute this tick."""
+
+    # prompts to prefill: (seq, bucket_len) -- each is one prefill dispatch
+    prefills: List[Tuple[SeqState, int]] = field(default_factory=list)
+    # whether a decode step over the active batch should run
+    run_decode: bool = False
+
+
+@dataclass
+class StepEvent:
+    """Per-request outcome of a tick (token emitted and/or finished)."""
+
+    seq: SeqState
+    token: Optional[int] = None
+    finished: Optional[FinishReason] = None
+    completed_blocks: List[TokenBlock] = field(default_factory=list)
+
+
+class Scheduler:
+    def __init__(self, cfg: SchedulerConfig, allocator: PageAllocator) -> None:
+        self.cfg = cfg
+        self.allocator = allocator
+        self.block_size = cfg.block_size or cfg.page_size
+        B = cfg.max_batch_size
+        self.max_pages = cfg.max_seq_len // cfg.page_size
+        self.waiting: Deque[SeqState] = collections.deque()
+        self.slots: List[Optional[SeqState]] = [None] * B
+        # numpy mirrors of the device batch arrays
+        self.tokens = np.zeros((B,), np.int32)
+        self.seq_lens = np.zeros((B,), np.int32)
+        self.page_table = np.zeros((B, self.max_pages), np.int32)
+
+    # -- queue/observability -------------------------------------------------
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def has_work(self) -> bool:
+        return self.num_active > 0 or len(self.waiting) > 0
+
+    def enqueue(self, seq: SeqState) -> None:
+        if len(seq.prompt) > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(seq.prompt)} tokens exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}"
+            )
+        self.waiting.append(seq)
+
+    # -- admission -----------------------------------------------------------
+
+    def plan(self) -> TickPlan:
+        """Admit waiting requests into free slots (page permitting), then
+        decide whether a decode step runs."""
+        plan = TickPlan()
+        while (
+            self.waiting
+            and len(plan.prefills) < self.cfg.max_prefill_per_tick
+        ):
+            slot = self._free_slot()
+            if slot is None:
+                break
+            seq = self.waiting[0]
+            n_pages = -(-len(seq.prompt) // self.cfg.page_size)
+            # keep one page of headroom per active seq for decode growth
+            if self.allocator.free_pages < n_pages + self.num_active:
+                break
+            self.waiting.popleft()
+            seq.pages = self.allocator.alloc(n_pages)
+            seq.slot = slot
+            self.slots[slot] = seq
+            self._write_slot_arrays(seq)
+            plan.prefills.append((seq, len(seq.prompt)))
+        plan.run_decode = self.num_active > 0
+        return plan
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def _write_slot_arrays(self, seq: SeqState) -> None:
+        b = seq.slot
+        self.page_table[b, :] = 0
+        self.page_table[b, : len(seq.pages)] = seq.pages
+        self.seq_lens[b] = len(seq.prompt)
+        self.tokens[b] = seq.prompt[-1] if seq.prompt else 0
+
+    # -- decode bookkeeping --------------------------------------------------
+
+    def ensure_decode_capacity(self) -> List[SeqState]:
+        """Grow page tables for sequences whose next token starts a new page.
+        Returns sequences preempted because the pool is exhausted (moved back
+        to the head of the waiting queue, pages freed)."""
+        preempted: List[SeqState] = []
+        for seq in [s for s in self.slots if s is not None]:
+            if seq.slot < 0:
+                continue  # became a preemption victim earlier this pass
+            # next decode writes at index seq_len - 1 (the newest token's KV)
+            needed = (seq.seq_len - 1) // self.cfg.page_size + 1
+            if needed > self.max_pages:
+                continue  # will hit max_seq_len stop below
+            while len(seq.pages) < needed:
+                try:
+                    page = self.allocator.alloc(1)[0]
+                except OutOfPages:
+                    victim = self._pick_preemption_victim()
+                    if victim is None or victim is seq:
+                        # cannot make room; preempt this one
+                        self._preempt(seq)
+                        preempted.append(seq)
+                        break
+                    self._preempt(victim)
+                    preempted.append(victim)
+                    continue
+                seq.pages.append(page)
+                self.page_table[seq.slot, len(seq.pages) - 1] = page
+        return preempted
+
+    def _pick_preemption_victim(self) -> Optional[SeqState]:
+        """Preempt the most recently arrived active sequence (reference
+        vLLM-style recompute preemption favors older requests)."""
+        active = [s for s in self.slots if s is not None]
+        if not active:
+            return None
+        return max(active, key=lambda s: s.arrival_s)
+
+    def _preempt(self, seq: SeqState) -> None:
+        self._release_slot(seq)
+        # restart from scratch: fold generated tokens into the prompt so the
+        # re-prefill reproduces the full sequence deterministically
+        seq.prompt = seq.prompt + self._generated_tokens(seq)
+        seq.prior_generated += seq.num_generated
+        seq.num_generated = 0
+        seq.slot = -1
+        self.waiting.appendleft(seq)
+
+    def _generated_tokens(self, seq: SeqState) -> List[int]:
+        if seq.blocks is None:
+            return []
+        all_tokens = seq.blocks.tokens
+        return list(all_tokens[len(seq.prompt) :])
+
+    def _release_slot(self, seq: SeqState) -> None:
+        if seq.slot >= 0:
+            b = seq.slot
+            self.slots[b] = None
+            self.page_table[b, :] = 0
+            self.seq_lens[b] = 0
+            self.tokens[b] = 0
+        if seq.pages:
+            self.allocator.free(seq.pages)
+            seq.pages = []
+
+    # -- per-token postprocessing -------------------------------------------
+
+    def commit_tokens(self, sampled: np.ndarray) -> List[StepEvent]:
+        """Apply one decode step's sampled tokens [B]; returns per-seq events.
+
+        Stop-condition semantics follow the reference backend jail
+        (lib/llm/src/backend.rs): eos finishes unless ignore_eos; hidden stop
+        token ids finish without emitting the token.
+        """
+        events: List[StepEvent] = []
+        for b, seq in enumerate(self.slots):
+            if seq is None:
+                continue
+            token = int(sampled[b])
+            ev = self._commit_token(seq, token)
+            events.append(ev)
+            if ev.finished is not None:
+                seq.finish = ev.finished
+                self._release_slot(seq)
+        return events
+
+    def commit_prefill_token(self, seq: SeqState, token: int) -> StepEvent:
+        """Apply the first token sampled from prefill logits."""
+        ev = self._commit_token(seq, token)
+        if ev.finished is not None:
+            seq.finish = ev.finished
+            self._release_slot(seq)
+        return ev
+
+    def _commit_token(self, seq: SeqState, token: int) -> StepEvent:
+        stop = seq.stop
+        # total tokens streamed to the client, across preemptions
+        n_gen = seq.prior_generated + seq.num_generated + 1
+
+        hidden_stop = stop.stop_token_ids_hidden or []
+        is_eos = token in seq.eos_ids
+        min_ok = stop.min_tokens is None or n_gen >= stop.min_tokens
+
+        if token in hidden_stop and min_ok:
+            return StepEvent(seq=seq, token=None, finished=FinishReason.STOP)
+        if is_eos and not stop.ignore_eos and min_ok:
+            return StepEvent(seq=seq, token=None, finished=FinishReason.EOS)
+
+        seq.num_generated += 1
+        completed: List[TokenBlock] = []
+        if seq.blocks is not None:
+            blk = seq.blocks.append(token)
+            if blk is not None:
+                completed.append(blk)
+        b = seq.slot
+        self.tokens[b] = token
+        # seq_lens mirrors the *cache* length: the KV of the newest token is
+        # written by the upcoming decode step at exactly this position
+        # (decode_step positions = seq_lens).
+        self.seq_lens[b] = seq.seq_len - 1
+
+        finished: Optional[FinishReason] = None
+        if stop.max_tokens is not None and n_gen >= stop.max_tokens:
+            finished = FinishReason.LENGTH
+        elif seq.seq_len >= self.cfg.max_seq_len:
+            finished = FinishReason.LENGTH
+        return StepEvent(
+            seq=seq, token=token, finished=finished, completed_blocks=completed
+        )
+
+    def cancel(self, seq: SeqState) -> None:
+        if seq.slot >= 0:
+            self._release_slot(seq)
+        elif seq in self.waiting:
+            self.waiting.remove(seq)
+        seq.finish = FinishReason.CANCELLED

@@ -1,0 +1,103 @@
+"""Jitted engine steps: prefill, decode, sample.
+
+Everything under jit runs with static shapes; variability is absorbed by
+
+- **prefill length buckets** (powers of two, multiples of page_size),
+- a **fixed-capacity decode batch** (inactive lanes attend to nothing and
+  scatter to the trash page),
+- per-request sampling settings as arrays.
+
+The KV buffer is donated on every step so XLA aliases it in place -- the
+cache never copies.  Compiled executables are cached per entry shape, so the
+first request in a bucket pays compile cost once (persistent compilation
+cache applies across processes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import attention as att
+from .config import ModelConfig
+from .model import Params, lm_logits, transformer
+from .sampling import SamplingParams, sample_tokens
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
+def prefill_step(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    tokens: jax.Array,  # [B, T] bucket-padded prompts
+    seq_lens: jax.Array,  # [B] true prompt lengths (0 = inactive lane)
+    page_table: jax.Array,  # [B, P]
+) -> Tuple[jax.Array, jax.Array]:
+    """Run full prompts, write their KV pages, return last-token logits.
+
+    Returns (logits [B, V] f32, updated kv_pages).
+    """
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def attn_fn(q, k, v, layer_kv):
+        out = att.prefill_attention(q, k, v, seq_lens)
+        new_kv = att.write_prefill_kv(layer_kv, k, v, page_table)
+        return out, new_kv
+
+    hidden, kv_pages = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)
+    last = jnp.clip(seq_lens - 1, 0, T - 1)
+    hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
+    return lm_logits(params, cfg, hidden_last), kv_pages
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,
+    tokens: jax.Array,  # [B] last sampled token per slot
+    seq_lens: jax.Array,  # [B] tokens already in cache (new token's position)
+    page_table: jax.Array,  # [B, P]
+) -> Tuple[jax.Array, jax.Array]:
+    """One decode step for the whole batch.  Returns (logits [B,V], kv)."""
+    positions = seq_lens.astype(jnp.int32)  # new token position (0-indexed)
+
+    def attn_fn(q, k, v, layer_kv):
+        # q/k/v arrive [B, 1, H, D]; squeeze the singleton time axis.
+        q1, k1, v1 = q[:, 0], k[:, 0], v[:, 0]
+        new_kv = att.write_decode_kv(layer_kv, k1, v1, page_table, positions)
+        out = att.paged_decode_attention(q1, new_kv, page_table, positions + 1)
+        return out[:, None], new_kv
+
+    hidden, kv_pages = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)
+    return lm_logits(params, cfg, hidden), kv_pages
+
+
+@jax.jit
+def sample_step(
+    logits: jax.Array, rng: jax.Array, params: SamplingParams
+) -> jax.Array:
+    return sample_tokens(logits, rng, params)
+
+
+def prefill_buckets(page_size: int, max_len: int) -> list:
+    """Power-of-two length buckets, all multiples of page_size."""
+    max_len = -(-max_len // page_size) * page_size  # round up to a page multiple
+    buckets = []
+    b = page_size
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+def pick_bucket(buckets: list, n: int) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds max bucket {buckets[-1]}")

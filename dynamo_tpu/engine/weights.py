@@ -1,0 +1,155 @@
+"""Checkpoint loading: HF safetensors -> stacked-layer JAX pytree.
+
+Maps HuggingFace llama/mistral/qwen2/mixtral parameter names onto the
+stacked ``[num_layers, ...]`` layout of dynamo_tpu.engine.model, transposing
+torch ``[out, in]`` linears to ``[in, out]``.  Loads shard-by-shard to bound
+host memory; each leaf is placed onto its target sharding as it is built
+(weights stream straight to device, never materializing twice on host).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+from .model import Params
+
+
+def load_safetensors_params(
+    model_path: str,
+    cfg: ModelConfig,
+    dtype: Any = None,
+    shardings: Optional[Dict[str, Any]] = None,
+) -> Params:
+    """Load all ``*.safetensors`` files under ``model_path``.
+
+    ``shardings`` optionally maps pytree paths (e.g. ``layers/wq``) to
+    ``NamedSharding``; leaves are device_put as they are assembled.
+    """
+    from safetensors import safe_open
+
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    files = sorted(
+        os.path.join(model_path, f)
+        for f in os.listdir(model_path)
+        if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {model_path}")
+
+    raw: Dict[str, np.ndarray] = {}
+    for path in files:
+        with safe_open(path, framework="np") as f:
+            for name in f.keys():
+                raw[name] = f.get_tensor(name)
+
+    return assemble_params(raw, cfg, dtype, shardings)
+
+
+def assemble_params(
+    raw: Dict[str, np.ndarray],
+    cfg: ModelConfig,
+    dtype: Any,
+    shardings: Optional[Dict[str, Any]] = None,
+) -> Params:
+    """Assemble the stacked pytree from a flat HF name->array dict."""
+    L = cfg.num_layers
+
+    def get(name: str) -> np.ndarray:
+        if name not in raw:
+            raise KeyError(f"missing weight {name}")
+        return raw[name]
+
+    def linear(name: str) -> np.ndarray:
+        return np.ascontiguousarray(get(name).T)  # [out,in] -> [in,out]
+
+    def put(path: str, arr: np.ndarray) -> jax.Array:
+        x = jnp.asarray(arr, dtype=dtype)
+        if shardings and path in shardings:
+            x = jax.device_put(x, shardings[path])
+        return x
+
+    def stack(path: str, per_layer: List[np.ndarray]) -> jax.Array:
+        return put(path, np.stack(per_layer, axis=0))
+
+    pre = "model."
+    layers: Dict[str, Any] = {}
+    attn = {
+        "wq": "self_attn.q_proj.weight",
+        "wk": "self_attn.k_proj.weight",
+        "wv": "self_attn.v_proj.weight",
+        "wo": "self_attn.o_proj.weight",
+    }
+    for key, suffix in attn.items():
+        layers[key] = stack(
+            f"layers/{key}",
+            [linear(f"{pre}layers.{i}.{suffix}") for i in range(L)],
+        )
+    if cfg.attention_bias:
+        for key, suffix in (
+            ("bq", "self_attn.q_proj.bias"),
+            ("bk", "self_attn.k_proj.bias"),
+            ("bv", "self_attn.v_proj.bias"),
+        ):
+            layers[key] = stack(
+                f"layers/{key}", [get(f"{pre}layers.{i}.{suffix}") for i in range(L)]
+            )
+    layers["input_norm"] = stack(
+        "layers/input_norm",
+        [get(f"{pre}layers.{i}.input_layernorm.weight") for i in range(L)],
+    )
+    layers["post_norm"] = stack(
+        "layers/post_norm",
+        [get(f"{pre}layers.{i}.post_attention_layernorm.weight") for i in range(L)],
+    )
+
+    if cfg.is_moe:
+        E = cfg.num_experts
+        moe = "block_sparse_moe"
+        layers["router"] = stack(
+            "layers/router",
+            [linear(f"{pre}layers.{i}.{moe}.gate.weight") for i in range(L)],
+        )
+        # Mixtral: w1 = gate, w3 = up, w2 = down
+        for key, w in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
+            layers[key] = stack(
+                f"layers/{key}",
+                [
+                    np.stack(
+                        [
+                            linear(f"{pre}layers.{i}.{moe}.experts.{e}.{w}.weight")
+                            for e in range(E)
+                        ]
+                    )
+                    for i in range(L)
+                ],
+            )
+    else:
+        for key, name in (
+            ("w_gate", "gate_proj"),
+            ("w_up", "up_proj"),
+            ("w_down", "down_proj"),
+        ):
+            layers[key] = stack(
+                f"layers/{key}",
+                [linear(f"{pre}layers.{i}.mlp.{name}.weight") for i in range(L)],
+            )
+
+    params: Params = {
+        "embed": put("embed", get(f"{pre}embed_tokens.weight")),
+        "layers": layers,
+        "final_norm": put("final_norm", get(f"{pre}norm.weight")),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = put("lm_head", linear("lm_head.weight"))
+    return params
+
+
+def param_bytes(params: Params) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))

@@ -1,0 +1,17 @@
+"""Wire/pipeline protocol types shared across the framework."""
+
+from .common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+__all__ = [
+    "FinishReason",
+    "LLMEngineOutput",
+    "PreprocessedRequest",
+    "SamplingOptions",
+    "StopConditions",
+]

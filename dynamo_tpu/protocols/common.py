@@ -1,0 +1,157 @@
+"""Common token-level protocols between preprocessor, router, and engine.
+
+Reference parity: ``PreprocessedRequest`` / ``LLMEngineOutput`` /
+``StopConditions`` / ``SamplingOptions`` in the reference LLM crate
+(lib/llm/src/protocols/common/llm_backend.rs:27-90, protocols/common.rs).
+These are plain dataclasses with dict (de)serialization so they travel over
+the request plane as msgpack/JSON without a schema compiler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+
+class FinishReason(str, Enum):
+    EOS = "eos"
+    STOP = "stop"
+    LENGTH = "length"
+    CANCELLED = "cancelled"
+    ERROR = "error"
+
+    def to_openai(self) -> str:
+        # OpenAI surface only knows stop/length/content_filter.
+        if self in (FinishReason.EOS, FinishReason.STOP, FinishReason.CANCELLED):
+            return "stop"
+        if self is FinishReason.LENGTH:
+            return "length"
+        return "stop"
+
+
+@dataclass
+class StopConditions:
+    """Reference: common.rs StopConditions."""
+
+    max_tokens: Optional[int] = None
+    stop: Optional[List[str]] = None
+    stop_token_ids_hidden: Optional[List[int]] = None
+    min_tokens: Optional[int] = None
+    ignore_eos: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "StopConditions":
+        return cls(**(d or {}))
+
+
+@dataclass
+class SamplingOptions:
+    """Reference: common.rs SamplingOptions (subset that maps onto the engine)."""
+
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None
+    seed: Optional[int] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "SamplingOptions":
+        return cls(**(d or {}))
+
+
+@dataclass
+class PreprocessedRequest:
+    """Token-level request handed to the engine.
+
+    Reference: llm_backend.rs:27-56 (token_ids, stop/sampling conditions,
+    annotations, ``estimated_prefix_hit_num_blocks`` injected by the KV
+    router).
+    """
+
+    token_ids: List[int]
+    stop_conditions: StopConditions = field(default_factory=StopConditions)
+    sampling_options: SamplingOptions = field(default_factory=SamplingOptions)
+    eos_token_ids: List[int] = field(default_factory=list)
+    annotations: List[str] = field(default_factory=list)
+    mdc_sum: Optional[str] = None
+    estimated_prefix_hit_num_blocks: Optional[int] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "token_ids": list(self.token_ids),
+            "stop_conditions": self.stop_conditions.to_dict(),
+            "sampling_options": self.sampling_options.to_dict(),
+            "eos_token_ids": list(self.eos_token_ids),
+            "annotations": list(self.annotations),
+            "mdc_sum": self.mdc_sum,
+            "estimated_prefix_hit_num_blocks": self.estimated_prefix_hit_num_blocks,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PreprocessedRequest":
+        return cls(
+            token_ids=list(d.get("token_ids") or []),
+            stop_conditions=StopConditions.from_dict(d.get("stop_conditions")),
+            sampling_options=SamplingOptions.from_dict(d.get("sampling_options")),
+            eos_token_ids=list(d.get("eos_token_ids") or []),
+            annotations=list(d.get("annotations") or []),
+            mdc_sum=d.get("mdc_sum"),
+            estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
+        )
+
+
+@dataclass
+class LLMEngineOutput:
+    """Per-step engine output (reference llm_backend.rs:58-90).
+
+    ``token_ids`` usually holds one decoded token; the final chunk carries a
+    ``finish_reason`` and empty tokens.  ``text`` stays None at the engine
+    level -- detokenization happens in the Backend stage.
+    """
+
+    token_ids: List[int] = field(default_factory=list)
+    tokens: Optional[List[str]] = None
+    text: Optional[str] = None
+    cum_log_probs: Optional[float] = None
+    finish_reason: Optional[FinishReason] = None
+    # completed KV blocks for this step (router/event feedback)
+    completed_blocks: Optional[List[Dict[str, int]]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"token_ids": list(self.token_ids)}
+        if self.tokens is not None:
+            out["tokens"] = self.tokens
+        if self.text is not None:
+            out["text"] = self.text
+        if self.cum_log_probs is not None:
+            out["cum_log_probs"] = self.cum_log_probs
+        if self.finish_reason is not None:
+            out["finish_reason"] = self.finish_reason.value
+        if self.completed_blocks is not None:
+            out["completed_blocks"] = self.completed_blocks
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "LLMEngineOutput":
+        fr = d.get("finish_reason")
+        return cls(
+            token_ids=list(d.get("token_ids") or []),
+            tokens=d.get("tokens"),
+            text=d.get("text"),
+            cum_log_probs=d.get("cum_log_probs"),
+            finish_reason=FinishReason(fr) if fr else None,
+            completed_blocks=d.get("completed_blocks"),
+        )
+
+    @classmethod
+    def finished(cls, reason: FinishReason) -> "LLMEngineOutput":
+        return cls(token_ids=[], finish_reason=reason)

@@ -17,7 +17,14 @@ os.environ.setdefault("DYN_LOG", "warning")
 
 import asyncio  # noqa: E402
 
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# XLA-CPU's oneDNN path does reduced-precision matmuls by default; parity
+# tests against fp64/torch references need full fp32 accumulation.  (On TPU
+# the production default -- bf16 on the MXU -- is what we want, so this is
+# test-only.)
+jax.config.update("jax_default_matmul_precision", "highest")
 
 from dynamo_tpu.tokens.hashing import ensure_native_built  # noqa: E402
 

@@ -1,0 +1,218 @@
+"""Correctness of the JAX model against the HuggingFace torch reference.
+
+Builds a tiny llama with transformers (torch CPU), exports its state dict
+into the stacked-params layout, and checks logits parity for (a) a full
+prefill and (b) step-by-step paged decode -- proving the paged KV read/write
+path is equivalent to full attention.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.kv_cache import PagedKVCache
+from dynamo_tpu.engine.step import decode_step, prefill_step
+from dynamo_tpu.engine.weights import assemble_params
+
+PAGE = 4
+
+
+def tiny_cfg(**kw) -> ModelConfig:
+    return ModelConfig.tiny(**kw)
+
+
+@pytest.fixture(scope="module")
+def hf_pair():
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = tiny_cfg()
+    hf_cfg = LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        max_position_embeddings=cfg.max_position,
+        rms_norm_eps=cfg.rms_norm_eps,
+        rope_theta=cfg.rope_theta,
+        tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    raw = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    params = assemble_params(raw, cfg, jnp.float32)
+    return cfg, model, params
+
+
+def hf_logits(model, token_ids):
+    import torch
+
+    with torch.no_grad():
+        out = model(torch.tensor([token_ids], dtype=torch.long))
+    return out.logits[0].numpy()  # [T, V]
+
+
+def test_prefill_matches_hf(hf_pair):
+    cfg, model, params = hf_pair
+    prompt = [3, 17, 91, 204, 5, 42, 7]
+    T = len(prompt)
+    ref = hf_logits(model, prompt)  # [T, V]
+
+    kv = PagedKVCache(cfg, num_pages=16, page_size=PAGE, dtype=jnp.float32)
+    n_pages = -(-T // PAGE)
+    pages = kv.allocator.alloc(n_pages)
+    bucket = n_pages * PAGE
+    tokens = np.zeros((1, bucket), np.int32)
+    tokens[0, :T] = prompt
+    pt = np.zeros((1, n_pages), np.int32)
+    pt[0, :] = pages
+
+    logits, kv_pages = prefill_step(
+        params,
+        cfg,
+        kv.pages,
+        jnp.asarray(tokens),
+        jnp.asarray([T], jnp.int32),
+        jnp.asarray(pt),
+    )
+    got = np.asarray(logits)[0]
+    np.testing.assert_allclose(got, ref[-1], rtol=2e-4, atol=2e-4)
+
+
+def test_paged_decode_matches_hf(hf_pair):
+    """Prefill a prompt, then decode token-by-token (teacher-forced with the
+    HF argmax continuation); every step's logits must match the HF forward
+    over the growing full sequence."""
+    cfg, model, params = hf_pair
+    prompt = [3, 17, 91, 204, 5]
+    T = len(prompt)
+    max_pages = 4
+
+    kv = PagedKVCache(cfg, num_pages=32, page_size=PAGE, dtype=jnp.float32)
+    pages = kv.allocator.alloc(-(-T // PAGE))
+    bucket = -(-T // PAGE) * PAGE
+    tokens = np.zeros((1, bucket), np.int32)
+    tokens[0, :T] = prompt
+    pt_prefill = np.zeros((1, bucket // PAGE), np.int32)
+    pt_prefill[0, : len(pages)] = pages
+
+    logits, kv_pages = prefill_step(
+        params, cfg, kv.pages,
+        jnp.asarray(tokens), jnp.asarray([T], jnp.int32), jnp.asarray(pt_prefill),
+    )
+    seq = list(prompt)
+    ref = hf_logits(model, seq)
+    np.testing.assert_allclose(np.asarray(logits)[0], ref[-1], rtol=2e-4, atol=2e-4)
+
+    # decode 6 tokens (crosses a page boundary at 8)
+    for step in range(6):
+        next_tok = int(np.argmax(ref[-1]))
+        pos = len(seq)  # position of next_tok
+        if pos // PAGE >= len(pages):
+            pages.extend(kv.allocator.alloc(1))
+        pt = np.zeros((1, max_pages), np.int32)
+        pt[0, : len(pages)] = pages
+        logits, kv_pages = decode_step(
+            params, cfg, kv_pages,
+            jnp.asarray([next_tok], jnp.int32),
+            jnp.asarray([pos], jnp.int32),
+            jnp.asarray(pt),
+        )
+        seq.append(next_tok)
+        ref = hf_logits(model, seq)
+        np.testing.assert_allclose(
+            np.asarray(logits)[0], ref[-1], rtol=5e-4, atol=5e-4,
+            err_msg=f"decode step {step}",
+        )
+
+
+def test_batched_decode_isolation(hf_pair):
+    """Two slots decoding concurrently must not interfere; a dead lane
+    (seq_len 0, trash pages) must not corrupt live lanes."""
+    cfg, model, params = hf_pair
+    p1 = [3, 17, 91, 204, 5]
+    p2 = [9, 8, 7]
+
+    kv = PagedKVCache(cfg, num_pages=32, page_size=PAGE, dtype=jnp.float32)
+
+    def prefill_one(prompt, kv_pages):
+        T = len(prompt)
+        n = -(-T // PAGE)
+        pages = kv.allocator.alloc(n)
+        tokens = np.zeros((1, n * PAGE), np.int32)
+        tokens[0, :T] = prompt
+        pt = np.zeros((1, n), np.int32)
+        pt[0, :] = pages
+        logits, kv_pages = prefill_step(
+            params, cfg, kv_pages,
+            jnp.asarray(tokens), jnp.asarray([T], jnp.int32), jnp.asarray(pt),
+        )
+        return pages, kv_pages
+
+    pages1, kvp = prefill_one(p1, kv.pages)
+    pages2, kvp = prefill_one(p2, kvp)
+
+    B, P = 3, 4  # 3 lanes, one dead
+    tok = np.zeros((B,), np.int32)
+    lens = np.zeros((B,), np.int32)
+    pt = np.zeros((B, P), np.int32)
+    n1 = int(np.argmax(hf_logits(model, p1)[-1]))
+    n2 = int(np.argmax(hf_logits(model, p2)[-1]))
+    tok[0], tok[1] = n1, n2
+    lens[0], lens[1] = len(p1), len(p2)
+    pages1.extend(kv.allocator.alloc(1))  # room for pos 5..7 already; page for growth
+    pt[0, : len(pages1)] = pages1
+    pt[1, : len(pages2)] = pages2
+
+    logits, kvp = decode_step(
+        params, cfg, kvp,
+        jnp.asarray(tok), jnp.asarray(lens), jnp.asarray(pt),
+    )
+    ref1 = hf_logits(model, p1 + [n1])[-1]
+    ref2 = hf_logits(model, p2 + [n2])[-1]
+    np.testing.assert_allclose(np.asarray(logits)[0], ref1, rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(logits)[1], ref2, rtol=5e-4, atol=5e-4)
+
+
+def test_qwen2_bias_and_tied_embeddings():
+    """attention_bias + tie_word_embeddings variants run and produce finite
+    logits (architecture coverage; HF parity is exercised by the llama path)."""
+    from dynamo_tpu.engine.model import init_params
+
+    cfg = ModelConfig.tiny(attention_bias=True, tie_word_embeddings=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    kv = PagedKVCache(cfg, num_pages=8, page_size=PAGE, dtype=jnp.float32)
+    pages = kv.allocator.alloc(2)
+    pt = np.zeros((1, 2), np.int32)
+    pt[0, :] = pages
+    tokens = np.zeros((1, 8), np.int32)
+    tokens[0, :5] = [1, 2, 3, 4, 5]
+    logits, _ = prefill_step(
+        params, cfg, kv.pages,
+        jnp.asarray(tokens), jnp.asarray([5], jnp.int32), jnp.asarray(pt),
+    )
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_moe_forward_runs():
+    from dynamo_tpu.engine.model import init_params
+
+    cfg = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    kv = PagedKVCache(cfg, num_pages=8, page_size=PAGE, dtype=jnp.float32)
+    pages = kv.allocator.alloc(1)
+    pt = np.asarray([pages], np.int32)
+    tokens = np.zeros((1, PAGE), np.int32)
+    tokens[0, :3] = [1, 2, 3]
+    logits, _ = prefill_step(
+        params, cfg, kv.pages,
+        jnp.asarray(tokens), jnp.asarray([3], jnp.int32), jnp.asarray(pt),
+    )
+    assert np.isfinite(np.asarray(logits)).all()
