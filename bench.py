@@ -1,0 +1,102 @@
+"""Benchmark: serving throughput of the first-party JAX engine on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures end-to-end engine decode throughput (continuous batching, paged KV,
+sampling, async streaming -- the serving hot path) on a TinyLlama-1.1B-shaped
+model in bfloat16, batch 8.  ``vs_baseline`` is the ratio against the
+reference's published per-device decode number (51.22 tok/s/GPU, H100 TP4,
+Llama-70B -- docs/architecture/planner.md:86, see BASELINE.md); the models
+differ in size, so the ratio is a tracking index, not a same-model claim.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+
+def build_engine():
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+
+    model_cfg = ModelConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        intermediate_size=5632,
+        num_layers=22,
+        num_heads=32,
+        num_kv_heads=4,
+        head_dim=64,
+        rope_theta=10000.0,
+        max_position=2048,
+        dtype="bfloat16",
+    )
+    cfg = EngineConfig(
+        max_batch_size=8,
+        max_seq_len=1024,
+        page_size=16,
+        num_pages=768,
+        seed=0,
+    )
+    return JaxEngine.random_init(model_cfg, cfg)
+
+
+async def run_batch(engine, prompts, max_tokens):
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    async def one(prompt):
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            stop_conditions=StopConditions(max_tokens=max_tokens),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        stream = await engine.generate(Context.new(req))
+        n = 0
+        async for item in stream:
+            data = item.data or {}
+            n += len(data.get("token_ids") or [])
+        return n
+
+    results = await asyncio.gather(*[one(p) for p in prompts])
+    return sum(results)
+
+
+async def main():
+    import numpy as np
+
+    engine = build_engine()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(8)]
+
+    # warmup: compiles prefill bucket + decode + sampler
+    await run_batch(engine, prompts, max_tokens=8)
+
+    t0 = time.monotonic()
+    total = await run_batch(engine, prompts, max_tokens=128)
+    elapsed = time.monotonic() - t0
+    await engine.stop()
+
+    tok_s = total / elapsed
+    baseline = 51.22  # H100 TP4 per-GPU decode tok/s (reference planner.md:86)
+    print(
+        json.dumps(
+            {
+                "metric": "engine_decode_tok_s_per_chip_tinyllama1b_bs8",
+                "value": round(tok_s, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_s / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

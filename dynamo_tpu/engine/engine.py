@@ -37,7 +37,13 @@ from .kv_cache import PagedKVCache
 from .model import Params, init_params
 from .sampling import SamplingParams
 from .scheduler import Scheduler, SchedulerConfig, SeqState, StepEvent
-from .step import decode_step, pick_bucket, prefill_buckets, prefill_step, sample_step
+from .step import (
+    decode_block,
+    inject_token,
+    pick_bucket,
+    prefill_and_sample,
+    prefill_buckets,
+)
 
 logger = logging.getLogger("dynamo.engine")
 
@@ -49,6 +55,14 @@ class EngineConfig:
     page_size: int = 16
     num_pages: int = 512
     block_size: Optional[int] = None  # router-visible KV block size
+    # decode steps per device dispatch: decode state stays on device for this
+    # many tokens, so host round trips amortize K-fold (ITL burstiness trade)
+    decode_block_size: int = 16
+    # extra pages allocated per growth event so the page table (and its
+    # device copy) changes every few blocks instead of every block
+    grow_chunk_pages: int = 4
+    # width of the device-checked stop-token set per lane
+    device_stop_width: int = 8
     seed: int = 0
     dtype: Optional[str] = None
 
@@ -68,6 +82,26 @@ class ForwardPassMetrics:
 
     def to_dict(self) -> Dict[str, Any]:
         return self.__dict__.copy()
+
+
+@dataclass
+class InflightBlock:
+    """A dispatched-but-uncommitted decode block (device handle + the slot
+    mapping captured at dispatch time)."""
+
+    sampled: Any  # jax.Array [B, K], still computing on device
+    slots: List[Optional[SeqState]]
+
+
+@dataclass
+class InflightPrefill:
+    """A dispatched-but-uncommitted prefill: the sampled first token lives on
+    device (already injected into the decode state); the host commits it when
+    the handle is materialized alongside the next block."""
+
+    sampled: Any  # jax.Array [1]
+    seq: SeqState
+    slot: int
 
 
 class JaxEngine:
@@ -109,6 +143,13 @@ class JaxEngine:
             max_workers=1, thread_name_prefix="jax-engine"
         )
         self._running = False
+        # device-resident decode state (tokens/seq_lens/active/...); rebuilt
+        # from the scheduler mirrors whenever the slot layout changes
+        self._dev: Optional[Dict[str, Any]] = None
+        self._dev_version = -1
+        # first tokens injected on device but not yet host-committed; a state
+        # re-push must re-apply them (mirrors still hold the placeholder)
+        self._pending_injects: Dict[int, InflightPrefill] = {}
         # KV event sink: fn(event_dict) -- wired to the router event publisher
         self.kv_event_sink: Optional[Callable[[Dict[str, Any]], None]] = None
         self._prefix_hits = 0
@@ -237,25 +278,44 @@ class JaxEngine:
     # -- the tick loop ------------------------------------------------------
 
     async def _run(self) -> None:
+        """The tick loop, software-pipelined over the device queue.
+
+        Each iteration dispatches decode block i+1 *before* materializing
+        block i's sampled tokens, so the ~RTT device->host transfer overlaps
+        the next block's compute.  Safety of the one-block lag rests on the
+        device executing launches in order: writes from a lane whose request
+        finished at commit time land before any later-dispatched prefill
+        reuses its freed pages, and the post-release state push deactivates
+        the lane for subsequent blocks.
+        """
         loop = asyncio.get_running_loop()
         assert self._wake is not None
+        pending: List[Any] = []  # InflightPrefill | InflightBlock, FIFO
         while self._running:
             try:
                 self._process_cancellations()
-                if not self.sched.has_work:
+                if not self.sched.has_work and not pending:
                     self._wake.clear()
                     await self._wake.wait()
                     continue
                 plan = self.sched.plan()
+                fresh: List[Any] = []
                 for seq, prompt_len in plan.prefills:
-                    ev = await loop.run_in_executor(
+                    pf = await loop.run_in_executor(
                         self._ex, self._do_prefill, seq, prompt_len
                     )
-                    self._dispatch([ev])
-                if plan.run_decode and self.sched.num_active > 0:
-                    events = await loop.run_in_executor(self._ex, self._do_decode)
+                    fresh.append(pf)
+                if self.sched.num_active > 0:
+                    blk = await loop.run_in_executor(self._ex, self._dispatch_block)
+                    if blk is not None:
+                        fresh.append(blk)
+                if pending:
+                    events = await loop.run_in_executor(
+                        self._ex, self._commit_all, pending
+                    )
                     self._dispatch(events)
-                if not plan.prefills and not plan.run_decode:
+                pending = fresh
+                if not fresh and not pending:
                     self._handle_stalled_admission()
                 # yield so enqueue/cancel callbacks interleave
                 await asyncio.sleep(0)
@@ -263,25 +323,34 @@ class JaxEngine:
                 raise
             except Exception as e:  # engine must never die silently
                 logger.exception("engine tick failed")
+                pending = []
+                self._pending_injects.clear()
                 self._fail_all(f"engine error: {e}")
                 await asyncio.sleep(0.01)
 
     def _handle_stalled_admission(self) -> None:
         """Nothing running, nothing admitted: requests whose prompts can never
-        fit the page pool must fail instead of spinning the loop forever."""
+        fit the page pool must fail instead of spinning the loop forever.
+
+        Only fundamental capacity (prompt pages + one growth page exceed the
+        whole pool) fails a request -- a request that merely raced past this
+        iteration's plan() gets admitted on the next tick.
+        """
         sched = self.sched
         if sched.num_active > 0 or not sched.waiting:
             return
         head = sched.waiting[0]
-        reason = (
-            f"request needs more KV pages than the pool holds "
-            f"({len(head.prompt)} prompt tokens, "
-            f"{sched.allocator.num_pages - 1} pages of {sched.cfg.page_size})"
-        )
-        # With no active sequences, no pages will ever free up -- anything
-        # unadmittable now is unadmittable forever.
+        n_pages = -(-len(head.prompt) // sched.cfg.page_size)
+        usable = sched.allocator.num_pages - 1
+        if n_pages + 1 <= usable:
+            return  # admittable; plan() will take it next tick
         sched.waiting.popleft()
-        self._fail_seq(head, reason)
+        self._fail_seq(
+            head,
+            f"request needs more KV pages than the pool holds "
+            f"({len(head.prompt)} prompt tokens -> {n_pages + 1} pages, "
+            f"pool has {usable} pages of {sched.cfg.page_size})",
+        )
 
     def _fail_seq(self, seq: SeqState, message: str) -> None:
         queue = self._queues.get(seq.request_id)
@@ -341,7 +410,10 @@ class JaxEngine:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def _do_prefill(self, seq: SeqState, prompt_len: int) -> StepEvent:
+    def _do_prefill(self, seq: SeqState, prompt_len: int) -> InflightPrefill:
+        """Dispatch prefill + first-token sampling; inject the token into the
+        device decode state.  No host round trip -- the token is committed
+        later, materialized together with the next decode block."""
         # Prefix-cache reuse lands with the block-manager integration; until
         # then every lookup is an honest miss (hit counter stays 0).
         self._prefix_lookups += 1
@@ -354,39 +426,146 @@ class JaxEngine:
         page_table[0, : len(seq.pages)] = seq.pages
         seq_lens = np.asarray([prompt_len], np.int32)
 
-        t0 = time.monotonic()
-        logits, self.kv.pages = prefill_step(
+        sampled, self.kv.pages = prefill_and_sample(
             self.params,
             self.model_cfg,
             self.kv.pages,
             jnp.asarray(tokens),
             jnp.asarray(seq_lens),
             jnp.asarray(page_table),
+            self._next_rng(),
+            self._sampling_arrays([seq]),
         )
-        sp = self._sampling_arrays([seq])
-        sampled = sample_step(logits, self._next_rng(), sp)
-        token = int(np.asarray(sampled)[0])
-        logger.debug(
-            "prefill id=%s len=%d bucket=%d %.1fms",
-            seq.request_id, prompt_len, bucket, (time.monotonic() - t0) * 1e3,
-        )
+        # bring decode state current (admission bumped the layout version),
+        # then inject the device-resident first token into its lane
+        if self._dev is None or self._dev_version != self.sched.layout_version:
+            self._push_device_state()
+        pf = InflightPrefill(sampled=sampled, seq=seq, slot=seq.slot)
+        self._pending_injects[seq.slot] = pf
+        self._dev["tokens"] = inject_token(self._dev["tokens"], seq.slot, sampled)
         self._steps += 1
-        return self.sched.commit_prefill_token(seq, token)
+        logger.debug("prefill dispatched id=%s len=%d bucket=%d",
+                     seq.request_id, prompt_len, bucket)
+        return pf
 
-    def _do_decode(self) -> List[StepEvent]:
-        self.sched.ensure_decode_capacity()
-        logits, self.kv.pages = decode_step(
+    def _push_device_state(self) -> None:
+        """Rebuild device-resident decode state from the scheduler mirrors."""
+        sched = self.sched
+        B = self.cfg.max_batch_size
+        E = self.cfg.device_stop_width
+        limit = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        stop_ids = np.full((B, E), -1, np.int32)
+        for b, seq in enumerate(sched.slots):
+            if seq is None:
+                continue
+            active[b] = True
+            remaining = (
+                seq.stop.max_tokens
+                - (seq.prior_generated + seq.num_generated)
+                if seq.stop.max_tokens is not None
+                else self.cfg.max_seq_len
+            )
+            limit[b] = min(
+                int(sched.seq_lens[b]) + max(remaining, 0),
+                self.cfg.max_seq_len - 1,
+                # capacity cap: never write past the lane's allocated pages
+                # (positions < len(pages)*page_size); the lane pauses there
+                # until ensure_decode_capacity frees/grows pages
+                len(seq.pages) * self.cfg.page_size,
+            )
+            # stop tokens the device may swallow itself: only when the host
+            # rules coincide exactly (no min_tokens gating)
+            if seq.stop.min_tokens is None:
+                ids = list(seq.stop.stop_token_ids_hidden or [])
+                if not seq.stop.ignore_eos:
+                    ids += list(seq.eos_ids)
+                for j, t in enumerate(ids[:E]):
+                    stop_ids[b, j] = t
+        self._dev = {
+            "tokens": jnp.asarray(sched.tokens),
+            "seq_lens": jnp.asarray(sched.seq_lens),
+            "limit_lens": jnp.asarray(limit),
+            "active": jnp.asarray(active),
+            "stop_ids": jnp.asarray(stop_ids),
+            "page_table": jnp.asarray(sched.page_table),
+            "sampling": self._sampling_arrays(list(sched.slots)),
+        }
+        # mirrors hold a placeholder for lanes whose prefilled first token is
+        # still device-only; re-apply those injections
+        for slot, pf in list(self._pending_injects.items()):
+            if sched.slots[slot] is pf.seq and pf.seq.finish is None:
+                self._dev["tokens"] = inject_token(
+                    self._dev["tokens"], slot, pf.sampled
+                )
+            else:
+                del self._pending_injects[slot]
+        self._dev_version = sched.layout_version
+
+    def _dispatch_block(self) -> Optional["InflightBlock"]:
+        """Enqueue one decode block; does not wait for results."""
+        K = self.cfg.decode_block_size
+        # cover the in-flight block plus this one (the host mirror lags the
+        # device by up to one uncommitted block)
+        self.sched.ensure_decode_capacity(
+            lookahead=2 * K, chunk_pages=self.cfg.grow_chunk_pages
+        )
+        if self.sched.num_active == 0:
+            return None  # everything was preempted
+        if self._dev is None or self._dev_version != self.sched.layout_version:
+            self._push_device_state()
+        d = self._dev
+        (
+            sampled,
+            d["tokens"],
+            d["seq_lens"],
+            d["active"],
+            self.kv.pages,
+            self._rng,
+        ) = decode_block(
             self.params,
             self.model_cfg,
             self.kv.pages,
-            jnp.asarray(self.sched.tokens),
-            jnp.asarray(self.sched.seq_lens),
-            jnp.asarray(self.sched.page_table),
+            d["tokens"],
+            d["seq_lens"],
+            d["limit_lens"],
+            d["active"],
+            d["stop_ids"],
+            d["page_table"],
+            self._rng,
+            d["sampling"],
+            K,
         )
-        sp = self._sampling_arrays(list(self.sched.slots))
-        sampled = sample_step(logits, self._next_rng(), sp)
         self._steps += 1
-        return self.sched.commit_tokens(np.asarray(sampled))
+        try:
+            sampled.copy_to_host_async()
+        except Exception:
+            pass  # optional fast path; device_get below still works
+        return InflightBlock(sampled=sampled, slots=list(self.sched.slots))
+
+    def _commit_all(self, entries: List[Any]) -> List[StepEvent]:
+        """Materialize and commit pending prefills/blocks in dispatch order
+        (one bundled device_get instead of one round trip per handle)."""
+        mats = jax.device_get([e.sampled for e in entries])
+        events: List[StepEvent] = []
+        for e, mat in zip(entries, mats):
+            if isinstance(e, InflightPrefill):
+                seq = e.seq
+                if self._pending_injects.get(e.slot) is e:
+                    del self._pending_injects[e.slot]
+                if (
+                    seq.finish is not None
+                    or seq.slot != e.slot
+                    or self.sched.slots[e.slot] is not seq
+                    or seq.num_generated > 0
+                ):
+                    continue  # preempted/cancelled before the commit landed
+                events.append(
+                    self.sched.commit_prefill_token(seq, int(np.asarray(mat)[0]))
+                )
+            else:
+                events.extend(self.sched.commit_block(np.asarray(mat), e.slots))
+        return events
 
     # -- event/output dispatch (loop thread) --------------------------------
 

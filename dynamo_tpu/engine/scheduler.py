@@ -114,6 +114,9 @@ class Scheduler:
         self.tokens = np.zeros((B,), np.int32)
         self.seq_lens = np.zeros((B,), np.int32)
         self.page_table = np.zeros((B, self.max_pages), np.int32)
+        # bumped whenever slot membership or the page table changes; the
+        # engine re-pushes device-resident decode state when it moves
+        self.layout_version = 0
 
     # -- queue/observability -------------------------------------------------
 
@@ -176,21 +179,29 @@ class Scheduler:
         self.page_table[b, : len(seq.pages)] = seq.pages
         self.seq_lens[b] = len(seq.prompt)
         self.tokens[b] = seq.prompt[-1] if seq.prompt else 0
+        self.layout_version += 1
 
     # -- decode bookkeeping --------------------------------------------------
 
-    def ensure_decode_capacity(self) -> List[SeqState]:
-        """Grow page tables for sequences whose next token starts a new page.
-        Returns sequences preempted because the pool is exhausted (moved back
-        to the head of the waiting queue, pages freed)."""
+    def ensure_decode_capacity(
+        self, lookahead: int = 1, chunk_pages: int = 0
+    ) -> List[SeqState]:
+        """Grow page tables so each active sequence can absorb ``lookahead``
+        more tokens (device-resident decode blocks write that far ahead
+        between host syncs).  When growth is needed, over-allocate by
+        ``chunk_pages`` so the page table (and the device copy of it) changes
+        every few blocks instead of every block.  Returns sequences preempted
+        because the pool is exhausted (moved back to the head of the waiting
+        queue, pages freed)."""
         preempted: List[SeqState] = []
         for seq in [s for s in self.slots if s is not None]:
             if seq.slot < 0:
                 continue  # became a preemption victim earlier this pass
-            # next decode writes at index seq_len - 1 (the newest token's KV)
-            needed = (seq.seq_len - 1) // self.cfg.page_size + 1
-            if needed > self.max_pages:
-                continue  # will hit max_seq_len stop below
+            # next decode writes at index seq_len - 1; pre-grow for lookahead
+            last_pos = seq.seq_len - 2 + lookahead
+            needed = min(last_pos // self.cfg.page_size + 1, self.max_pages)
+            if len(seq.pages) < needed:
+                needed = min(needed + chunk_pages, self.max_pages)
             while len(seq.pages) < needed:
                 try:
                     page = self.allocator.alloc(1)[0]
@@ -206,6 +217,7 @@ class Scheduler:
                     continue
                 seq.pages.append(page)
                 self.page_table[seq.slot, len(seq.pages) - 1] = page
+                self.layout_version += 1
         return preempted
 
     def _pick_preemption_victim(self) -> Optional[SeqState]:
@@ -239,6 +251,7 @@ class Scheduler:
             self.page_table[b, :] = 0
             self.seq_lens[b] = 0
             self.tokens[b] = 0
+            self.layout_version += 1
         if seq.pages:
             self.allocator.free(seq.pages)
             seq.pages = []
@@ -262,6 +275,43 @@ class Scheduler:
             if ev.finished is not None:
                 seq.finish = ev.finished
                 self._release_slot(seq)
+        return events
+
+    def commit_block(
+        self,
+        sampled: np.ndarray,
+        slot_snapshot: Optional[List[Optional[SeqState]]] = None,
+    ) -> List[StepEvent]:
+        """Apply a device-decoded block of raw sampled tokens [B, K].
+
+        Host-side replay of the device loop: per step, per lane, the exact
+        stop-condition rules run here (``_commit_token``); ``-1`` marks a
+        lane the device already knew was dead.  Once a lane finishes, the
+        rest of its column was speculative decode and is discarded.
+
+        ``slot_snapshot`` is the slot list captured when the block was
+        dispatched -- with pipelined blocks a slot may have been released (or
+        even re-assigned) since, and those lanes' tokens must not be
+        attributed to the new occupant.
+        """
+        events: List[StepEvent] = []
+        B, K = sampled.shape
+        slots_at_entry = (
+            list(slot_snapshot) if slot_snapshot is not None else list(self.slots)
+        )
+        for k in range(K):
+            for b in range(B):
+                seq = slots_at_entry[b]
+                if seq is None or seq.finish is not None or seq.slot != b:
+                    continue
+                token = int(sampled[b, k])
+                if token < 0:
+                    continue
+                ev = self._commit_token(seq, token)
+                events.append(ev)
+                if ev.finished is not None:
+                    seq.finish = ev.finished
+                    self._release_slot(seq)
         return events
 
     def commit_prefill_token(self, seq: SeqState, token: int) -> StepEvent:

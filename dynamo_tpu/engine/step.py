@@ -54,8 +54,7 @@ def prefill_step(
     return lm_logits(params, cfg, hidden_last), kv_pages
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
-def decode_step(
+def _decode_once(
     params: Params,
     cfg: ModelConfig,
     kv_pages: jax.Array,
@@ -63,7 +62,7 @@ def decode_step(
     seq_lens: jax.Array,  # [B] tokens already in cache (new token's position)
     page_table: jax.Array,  # [B, P]
 ) -> Tuple[jax.Array, jax.Array]:
-    """One decode step for the whole batch.  Returns (logits [B,V], kv)."""
+    """One unjitted decode step.  Returns (logits [B,V], kv)."""
     positions = seq_lens.astype(jnp.int32)  # new token position (0-indexed)
 
     def attn_fn(q, k, v, layer_kv):
@@ -77,11 +76,100 @@ def decode_step(
     return lm_logits(params, cfg, hidden), kv_pages
 
 
+decode_step = partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))(
+    _decode_once
+)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "num_steps"),
+    donate_argnames=("kv_pages",),
+)
+def decode_block(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,
+    tokens: jax.Array,  # [B] last committed token per lane
+    seq_lens: jax.Array,  # [B] cache length (position of the incoming token)
+    limit_lens: jax.Array,  # [B] cache length at which a lane must stop
+    active: jax.Array,  # [B] bool
+    stop_ids: jax.Array,  # [B, E] device-checked stop tokens (-1 = pad)
+    page_table: jax.Array,  # [B, P] (pre-grown for num_steps of growth)
+    rng: jax.Array,
+    sampling: SamplingParams,
+    num_steps: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Run ``num_steps`` decode+sample iterations entirely on device.
+
+    The TPU-native decode loop: ONE host dispatch and ONE device->host
+    transfer per K tokens instead of per token -- decode state (last token,
+    cache lengths, active mask) lives on device between blocks; the host
+    only intervenes when batch membership changes (admission / completion /
+    page growth).
+
+    Lanes self-deactivate on device when they sample a ``stop_ids`` token or
+    reach ``limit_lens``; the host re-derives the authoritative stop reason
+    from the raw sampled matrix with the exact same rules (scheduler
+    ``_commit_token``), so device masking is purely an optimization that
+    stops dead lanes from burning HBM bandwidth.
+
+    Returns ``(sampled [B, num_steps] raw tokens, tokens, seq_lens, active,
+    kv_pages, rng)`` -- everything except ``sampled`` stays device-resident
+    for the next block.
+    """
+
+    def body(carry, _):
+        tokens, seq_lens, active, rng, kv = carry
+        logits, kv = _decode_once(params, cfg, kv, tokens, seq_lens, page_table)
+        rng, sub = jax.random.split(rng)
+        sampled = sample_tokens(logits, sub, sampling)
+        hit_stop = jnp.any(sampled[:, None] == stop_ids, axis=1)
+        emit = active & ~hit_stop  # stop tokens are swallowed, not emitted
+        new_seq = seq_lens + emit.astype(jnp.int32)
+        new_active = emit & (new_seq < limit_lens)
+        new_tokens = jnp.where(emit, sampled, tokens)
+        out = jnp.where(active, sampled, -1)  # -1 = lane was already dead
+        return (new_tokens, new_seq, new_active, rng, kv), out
+
+    (tokens, seq_lens, active, rng, kv_pages), sampled = jax.lax.scan(
+        body, (tokens, seq_lens, active, rng, kv_pages), None, length=num_steps
+    )
+    return sampled.T, tokens, seq_lens, active, kv_pages, rng
+
+
 @jax.jit
 def sample_step(
     logits: jax.Array, rng: jax.Array, params: SamplingParams
 ) -> jax.Array:
     return sample_tokens(logits, rng, params)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
+def prefill_and_sample(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,
+    tokens: jax.Array,
+    seq_lens: jax.Array,
+    page_table: jax.Array,
+    rng: jax.Array,
+    sampling: SamplingParams,
+) -> Tuple[jax.Array, jax.Array]:
+    """Prefill + first-token sampling fused into one dispatch.
+
+    Returns (sampled [B], kv) -- the sampled handle stays on device so the
+    first token can be injected into the decode state without a host round
+    trip (engine._do_prefill)."""
+    logits, kv_pages = prefill_step(params, cfg, kv_pages, tokens, seq_lens, page_table)
+    return sample_tokens(logits, rng, sampling), kv_pages
+
+
+@partial(jax.jit, donate_argnames=("tokens",))
+def inject_token(tokens: jax.Array, slot: jax.Array, token: jax.Array) -> jax.Array:
+    """Scatter a freshly-prefilled lane's first token into the device-resident
+    decode token vector (dynamic slot index -> one cached executable)."""
+    return tokens.at[slot].set(token[0])
 
 
 def prefill_buckets(page_size: int, max_len: int) -> list:

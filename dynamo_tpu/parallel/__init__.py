@@ -1,0 +1,19 @@
+"""Device-mesh parallelism: mesh construction and sharding rules.
+
+The reference delegates intra-model parallelism to its GPU engines (NCCL
+inside vLLM/TRT-LLM -- SURVEY.md 2.8); here it is first-party: a
+``jax.sharding.Mesh`` over ICI with named axes and ``NamedSharding``
+annotations on the params/KV pytrees; XLA inserts the collectives.
+"""
+
+from .mesh import MeshConfig, build_mesh
+from .sharding import kv_pspec, batch_pspecs, param_pspecs, shard_params
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "param_pspecs",
+    "kv_pspec",
+    "batch_pspecs",
+    "shard_params",
+]

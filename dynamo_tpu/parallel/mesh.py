@@ -1,0 +1,56 @@
+"""Mesh construction over TPU slices.
+
+Axes (scaling-book conventions):
+
+- ``dp``   -- data parallel: independent batch lanes (serving-layer worker
+  replication maps here when one engine spans multiple hosts).
+- ``tp``   -- tensor parallel: attention heads / MLP hidden sharded; the
+  all-reduce rides ICI.
+- ``pp``   -- pipeline parallel over layer groups (cross-host).
+- ``sp``   -- sequence/context parallel (ring attention) for long context.
+
+``build_mesh`` lays axes out so that tp is innermost (fastest-varying
+device order = closest ICI neighbors), matching how XLA enumerates cores in
+a slice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp * self.pp * self.sp
+
+    def axis_names(self) -> List[str]:
+        return ["dp", "pp", "sp", "tp"]
+
+
+def build_mesh(
+    cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < cfg.num_devices:
+        raise ValueError(
+            f"mesh needs {cfg.num_devices} devices, have {len(devices)}"
+        )
+    devices = devices[: cfg.num_devices]
+    arr = np.asarray(devices).reshape(cfg.dp, cfg.pp, cfg.sp, cfg.tp)
+    return Mesh(arr, axis_names=tuple(cfg.axis_names()))
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(MeshConfig())

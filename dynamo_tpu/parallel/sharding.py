@@ -1,0 +1,138 @@
+"""Sharding rules: PartitionSpecs for the model params, KV pages, and batch.
+
+Megatron-style TP layout expressed as GSPMD annotations (XLA inserts the
+collectives -- SURVEY.md 5.8 "engine-internal collectives -> XLA over ICI"):
+
+- attention qkv projections column-parallel (heads sharded), output
+  projection row-parallel -> one all-reduce per attention block;
+- MLP gate/up column-parallel, down row-parallel -> one all-reduce per MLP;
+- KV pages sharded over kv_heads so each tp shard attends its own heads
+  with zero cross-chip traffic on the decode hot path;
+- MoE expert weights sharded over the experts axis (``tp`` doubles as the
+  expert axis until a dedicated ``ep`` axis is configured).
+
+All specs carry the leading ``num_layers`` axis unsharded (layers are
+scanned, not distributed; pipeline parallel splits the scan instead).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.config import ModelConfig
+from ..engine.model import Params
+
+
+def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
+    """Pytree-path (``a/b``) -> PartitionSpec for every parameter."""
+    specs: Dict[str, P] = {
+        "embed": P(None, "tp"),
+        "final_norm": P(None),
+        "layers/wq": P(None, None, "tp"),
+        "layers/wk": P(None, None, "tp"),
+        "layers/wv": P(None, None, "tp"),
+        "layers/wo": P(None, "tp", None),
+        "layers/input_norm": P(None, None),
+        "layers/post_norm": P(None, None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    if cfg.attention_bias:
+        specs["layers/bq"] = P(None, "tp")
+        specs["layers/bk"] = P(None, "tp")
+        specs["layers/bv"] = P(None, "tp")
+    if cfg.is_moe:
+        specs["layers/router"] = P(None, None, None)
+        specs["layers/w_gate"] = P(None, "tp", None, None)
+        specs["layers/w_up"] = P(None, "tp", None, None)
+        specs["layers/w_down"] = P(None, "tp", None, None)
+    else:
+        specs["layers/w_gate"] = P(None, None, "tp")
+        specs["layers/w_up"] = P(None, None, "tp")
+        specs["layers/w_down"] = P(None, "tp", None)
+    return specs
+
+
+def kv_pspec(cfg: ModelConfig) -> P:
+    """KV pages [L, 2, pages, page, Hkv, D]: shard kv heads over tp when
+    divisible (GQA models with few kv heads and large tp replicate)."""
+    return P(None, None, None, None, "tp", None)
+
+
+def batch_pspecs() -> Dict[str, P]:
+    """Decode batch arrays sharded over dp."""
+    return {
+        "tokens": P("dp"),
+        "seq_lens": P("dp"),
+        "page_table": P("dp", None),
+        "prompt_tokens": P("dp", None),
+    }
+
+
+def _flatten_with_paths(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_with_paths(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def param_shardings(
+    cfg: ModelConfig, mesh: Mesh
+) -> Dict[str, NamedSharding]:
+    """Path -> NamedSharding map (feeds the streaming safetensors loader)."""
+    return {
+        path: NamedSharding(mesh, spec) for path, spec in param_pspecs(cfg).items()
+    }
+
+
+def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
+    """Device_put an assembled params pytree onto its TP layout.
+
+    Axes that do not divide evenly (e.g. kv heads < tp) fall back to
+    replication for that tensor.
+    """
+    flat = _flatten_with_paths(params)
+    specs = param_pspecs(cfg)
+    out_flat: Dict[str, jax.Array] = {}
+    for path, leaf in flat.items():
+        spec = specs.get(path, P())
+        spec = _compatible_spec(spec, leaf.shape, mesh)
+        out_flat[path] = jax.device_put(leaf, NamedSharding(mesh, spec))
+    return _unflatten(out_flat)
+
+
+def shard_kv(kv: jax.Array, cfg: ModelConfig, mesh: Mesh) -> jax.Array:
+    spec = _compatible_spec(kv_pspec(cfg), kv.shape, mesh)
+    return jax.device_put(kv, NamedSharding(mesh, spec))
+
+
+def _compatible_spec(spec: P, shape, mesh: Mesh) -> P:
+    """Drop mesh axes that do not divide the corresponding dim."""
+    fixed = []
+    for i, axis in enumerate(spec):
+        if axis is None:
+            fixed.append(None)
+            continue
+        size = mesh.shape.get(axis, 1)
+        if i < len(shape) and shape[i] % size == 0:
+            fixed.append(axis)
+        else:
+            fixed.append(None)
+    return P(*fixed)
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, leaf in flat.items():
+        parts = path.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return out

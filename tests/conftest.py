@@ -13,12 +13,20 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the axon sitecustomize force-registers the TPU PJRT plugin (and pins
+# JAX_PLATFORMS=axon) whenever PALLAS_AXON_POOL_IPS is set; clear it so the
+# CPU platform + virtual device count above actually take effect
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ.setdefault("DYN_LOG", "warning")
 
 import asyncio  # noqa: E402
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# The axon sitecustomize may have pinned the platform before this file ran;
+# the config update (unlike the env var) reliably forces CPU.
+jax.config.update("jax_platforms", "cpu")
 
 # XLA-CPU's oneDNN path does reduced-precision matmuls by default; parity
 # tests against fp64/torch references need full fp32 accumulation.  (On TPU

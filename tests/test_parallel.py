@@ -1,0 +1,96 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh.
+
+Validates that (a) the TP/DP sharding rules produce genuinely distributed
+params/KV, (b) the sharded decode step computes the same logits as the
+single-device run, and (c) the driver-facing __graft_entry__ hooks work.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.model import init_params
+from dynamo_tpu.engine.step import decode_step, prefill_step
+from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+from dynamo_tpu.parallel.sharding import (
+    _compatible_spec,
+    batch_pspecs,
+    shard_kv,
+    shard_params,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def cfg8():
+    return ModelConfig.tiny(num_heads=8, num_kv_heads=4, hidden_size=64, head_dim=8)
+
+
+def test_params_actually_sharded():
+    cfg = cfg8()
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    params = shard_params(init_params(cfg, jax.random.PRNGKey(0)), cfg, mesh)
+    wq = params["layers"]["wq"]
+    # column-parallel: each tp shard holds 1/4 of the output features
+    shard_shapes = {s.data.shape for s in wq.addressable_shards}
+    L, H, O = wq.shape
+    assert shard_shapes == {(L, H, O // 4)}
+
+
+def test_sharded_decode_matches_single_device():
+    cfg = cfg8()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    PAGES, PAGE, B, Pmax = 16, 4, 4, 4
+    kv = jnp.zeros(
+        (cfg.num_layers, 2, PAGES, PAGE, cfg.num_kv_heads, cfg.head_dim),
+        jnp.float32,
+    )
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(1, 200, (B,)), jnp.int32)
+    seq_lens = jnp.asarray([3, 1, 2, 0], jnp.int32)
+    pt = np.zeros((B, Pmax), np.int32)
+    pt[0, :2] = [1, 2]
+    pt[1, :1] = [3]
+    pt[2, :1] = [4]
+    page_table = jnp.asarray(pt)
+
+    kv_shape = kv.shape
+    ref_logits, _ = decode_step(params, cfg, kv, tokens, seq_lens, page_table)
+    ref = np.asarray(ref_logits)
+
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    sp = shard_params(params, cfg, mesh)
+    # decode_step donates kv_pages; rebuild rather than reuse the deleted buffer
+    skv = shard_kv(jnp.zeros(kv_shape, jnp.float32), cfg, mesh)
+    bp = batch_pspecs()
+
+    def put(name, arr):
+        spec = _compatible_spec(bp[name], arr.shape, mesh)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    got_logits, _ = decode_step(
+        sp, cfg, skv, put("tokens", tokens), put("seq_lens", seq_lens),
+        put("page_table", page_table),
+    )
+    np.testing.assert_allclose(np.asarray(got_logits), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    logits, kv = jax.jit(fn)(*args)
+    jax.block_until_ready((logits, kv))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_graft_entry_multichip():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
