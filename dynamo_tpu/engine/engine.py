@@ -299,8 +299,29 @@ class JaxEngine:
                     await self._wake.wait()
                     continue
                 plan = self.sched.plan()
+                if self.sched.num_active > 0:
+                    # pre-grow pages to cover the in-flight block plus this
+                    # tick's block (the host mirror lags the device by up to
+                    # one uncommitted block)
+                    self.sched.ensure_decode_capacity(
+                        lookahead=2 * self.cfg.decode_block_size + 1,
+                        chunk_pages=self.cfg.grow_chunk_pages,
+                    )
+                if pending and self._dev_version != self.sched.layout_version:
+                    # A layout change forces a device-state rebuild from the
+                    # host mirrors, which exclude the still-uncommitted
+                    # in-flight work -- rebuilding now would re-decode and
+                    # double-commit the in-flight block.  Drain the pipeline
+                    # first (forfeits the one-block overlap for this tick).
+                    events = await loop.run_in_executor(
+                        self._ex, self._commit_all, pending
+                    )
+                    pending = []
+                    self._dispatch(events)
                 fresh: List[Any] = []
                 for seq, prompt_len in plan.prefills:
+                    if seq.slot < 0 or self.sched.slots[seq.slot] is not seq:
+                        continue  # preempted by this tick's capacity pass
                     pf = await loop.run_in_executor(
                         self._ex, self._do_prefill, seq, prompt_len
                     )
@@ -332,23 +353,23 @@ class JaxEngine:
         """Nothing running, nothing admitted: requests whose prompts can never
         fit the page pool must fail instead of spinning the loop forever.
 
-        Only fundamental capacity (prompt pages + one growth page exceed the
-        whole pool) fails a request -- a request that merely raced past this
-        iteration's plan() gets admitted on the next tick.
+        Only fundamental capacity (the prompt plus the first decode-write
+        page exceed the whole pool) fails a request -- a request that merely
+        raced past this iteration's plan() gets admitted on the next tick.
         """
         sched = self.sched
         if sched.num_active > 0 or not sched.waiting:
             return
         head = sched.waiting[0]
-        n_pages = -(-len(head.prompt) // sched.cfg.page_size)
+        need = sched.min_total_pages(head)
         usable = sched.allocator.num_pages - 1
-        if n_pages + 1 <= usable:
+        if need <= usable:
             return  # admittable; plan() will take it next tick
         sched.waiting.popleft()
         self._fail_seq(
             head,
             f"request needs more KV pages than the pool holds "
-            f"({len(head.prompt)} prompt tokens -> {n_pages + 1} pages, "
+            f"({len(head.prompt)} prompt tokens -> {need} pages, "
             f"pool has {usable} pages of {sched.cfg.page_size})",
         )
 
@@ -423,7 +444,11 @@ class JaxEngine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :prompt_len] = seq.prompt
         page_table = np.zeros((1, n_pages), np.int32)
-        page_table[0, : len(seq.pages)] = seq.pages
+        # the lane may hold growth pages beyond the prompt already
+        # (loop-side ensure_decode_capacity runs before prefill dispatch);
+        # prefill writes only within the prompt's pages
+        k = min(len(seq.pages), n_pages)
+        page_table[0, :k] = seq.pages[:k]
         seq_lens = np.asarray([prompt_len], np.int32)
 
         sampled, self.kv.pages = prefill_and_sample(
@@ -459,21 +484,17 @@ class JaxEngine:
         for b, seq in enumerate(sched.slots):
             if seq is None:
                 continue
-            active[b] = True
-            remaining = (
-                seq.stop.max_tokens
-                - (seq.prior_generated + seq.num_generated)
-                if seq.stop.max_tokens is not None
-                else self.cfg.max_seq_len
-            )
             limit[b] = min(
-                int(sched.seq_lens[b]) + max(remaining, 0),
+                int(sched.seq_lens[b]) + sched.remaining_budget(seq),
                 self.cfg.max_seq_len - 1,
                 # capacity cap: never write past the lane's allocated pages
                 # (positions < len(pages)*page_size); the lane pauses there
                 # until ensure_decode_capacity frees/grows pages
                 len(seq.pages) * self.cfg.page_size,
             )
+            # a lane with no write headroom must not run: it would scatter
+            # its next KV write to the trash page and emit a garbage token
+            active[b] = limit[b] > int(sched.seq_lens[b])
             # stop tokens the device may swallow itself: only when the host
             # rules coincide exactly (no min_tokens gating)
             if seq.stop.min_tokens is None:
@@ -503,13 +524,12 @@ class JaxEngine:
         self._dev_version = sched.layout_version
 
     def _dispatch_block(self) -> Optional["InflightBlock"]:
-        """Enqueue one decode block; does not wait for results."""
+        """Enqueue one decode block; does not wait for results.
+
+        Page growth happened loop-side (ensure_decode_capacity in _run)
+        *before* the pipeline-drain decision, so a rebuilt device state here
+        never overwrites uncommitted in-flight work."""
         K = self.cfg.decode_block_size
-        # cover the in-flight block plus this one (the host mirror lags the
-        # device by up to one uncommitted block)
-        self.sched.ensure_decode_capacity(
-            lookahead=2 * K, chunk_pages=self.cfg.grow_chunk_pages
-        )
         if self.sched.num_active == 0:
             return None  # everything was preempted
         if self._dev is None or self._dev_version != self.sched.layout_version:

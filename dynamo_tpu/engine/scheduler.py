@@ -142,6 +142,27 @@ class Scheduler:
 
     # -- admission -----------------------------------------------------------
 
+    def remaining_budget(self, seq: SeqState) -> int:
+        """Tokens the sequence may still emit (max_tokens / max_seq_len caps)."""
+        produced = seq.prior_generated + seq.num_generated
+        by_max = (
+            seq.stop.max_tokens - produced
+            if seq.stop.max_tokens is not None
+            else self.cfg.max_seq_len
+        )
+        by_len = self.cfg.max_seq_len - seq.seq_len
+        return max(0, min(by_max, by_len))
+
+    def min_total_pages(self, seq: SeqState) -> int:
+        """Smallest page count that lets the sequence make forward progress:
+        the prompt KV plus, when at least one decode step must run, the write
+        slot for the next token.  (A single-token request samples its only
+        token from the prefill logits and never decodes.)"""
+        n = len(seq.prompt)
+        if self.remaining_budget(seq) >= 2:
+            n += 1
+        return -(-n // self.cfg.page_size)
+
     def plan(self) -> TickPlan:
         """Admit waiting requests into free slots (page permitting), then
         decide whether a decode step runs."""
@@ -155,8 +176,10 @@ class Scheduler:
                 break
             seq = self.waiting[0]
             n_pages = -(-len(seq.prompt) // self.cfg.page_size)
-            # keep one page of headroom per active seq for decode growth
-            if self.allocator.free_pages < n_pages + self.num_active:
+            # admission needs room for the prompt *and* the first decode
+            # write, with one page of headroom per active seq for growth
+            need = self.min_total_pages(seq)
+            if self.allocator.free_pages < need + self.num_active:
                 break
             self.waiting.popleft()
             seq.pages = self.allocator.alloc(n_pages)
@@ -186,26 +209,42 @@ class Scheduler:
     def ensure_decode_capacity(
         self, lookahead: int = 1, chunk_pages: int = 0
     ) -> List[SeqState]:
-        """Grow page tables so each active sequence can absorb ``lookahead``
-        more tokens (device-resident decode blocks write that far ahead
-        between host syncs).  When growth is needed, over-allocate by
-        ``chunk_pages`` so the page table (and the device copy of it) changes
-        every few blocks instead of every block.  Returns sequences preempted
-        because the pool is exhausted (moved back to the head of the waiting
-        queue, pages freed)."""
+        """Grow page tables so each active sequence can absorb up to
+        ``lookahead`` more tokens, never growing past the lane's remaining
+        token budget (max_tokens / max_seq_len).  When growth is needed,
+        over-allocate by ``chunk_pages`` so the page table (and the device
+        copy of it) changes every few blocks instead of every block.
+
+        Growth is best-effort: a lane that cannot reach the full lookahead
+        pauses at its allocated capacity (the device-side ``limit_lens`` cap
+        keeps it from writing past its pages) and retries next tick.
+        Preemption only triggers when a lane lacks room for even one more
+        token -- then the youngest lane is evicted (possibly the lane
+        itself).  Returns the preempted sequences (moved back to the head of
+        the waiting queue, pages freed)."""
+        ps = self.cfg.page_size
         preempted: List[SeqState] = []
         for seq in [s for s in self.slots if s is not None]:
             if seq.slot < 0:
                 continue  # became a preemption victim earlier this pass
-            # next decode writes at index seq_len - 1; pre-grow for lookahead
-            last_pos = seq.seq_len - 2 + lookahead
-            needed = min(last_pos // self.cfg.page_size + 1, self.max_pages)
-            if len(seq.pages) < needed:
-                needed = min(needed + chunk_pages, self.max_pages)
-            while len(seq.pages) < needed:
+            cache_len = int(self.seq_lens[seq.slot])
+            budget = max(self.remaining_budget(seq), 1)
+            # max cache length the lane can ever use (limit_lens semantics:
+            # the final token's KV is never read, and position max_seq_len-1
+            # is the last writable slot)
+            useful = min(cache_len + budget, self.cfg.max_seq_len - 1)
+            want_tokens = min(cache_len + lookahead, useful)
+            need_tokens = min(cache_len + 1, useful)
+            want = min(-(-want_tokens // ps), self.max_pages)
+            need = min(-(-need_tokens // ps), self.max_pages)
+            if len(seq.pages) < want:
+                want = min(want + chunk_pages, -(-useful // ps), self.max_pages)
+            while len(seq.pages) < want:
                 try:
                     page = self.allocator.alloc(1)[0]
                 except OutOfPages:
+                    if len(seq.pages) >= need:
+                        break  # best effort met; lane pauses at capacity
                     victim = self._pick_preemption_victim()
                     if victim is None or victim is seq:
                         # cannot make room; preempt this one

@@ -300,6 +300,53 @@ def test_unadmittable_prompt_fails_not_spins(run):
     run(body())
 
 
+def test_greedy_invariant_to_decode_block_size(run):
+    """Pipelined decode must not corrupt output when the layout changes
+    mid-stream (page growth, admission, slot release): the same greedy
+    request must yield identical tokens for any decode_block_size, with
+    max_tokens spanning many blocks and page-growth events."""
+
+    async def body():
+        results = {}
+        for K in (4, 64):
+            engine = make_engine(decode_block_size=K, grow_chunk_pages=1)
+            try:
+                results[K] = await collect(engine, req([1, 2, 3], max_tokens=40))
+            finally:
+                await engine.stop()
+        assert results[4][0] == results[64][0]
+        assert len(results[4][0]) == 40
+
+    run(body())
+
+
+def test_greedy_invariant_under_concurrent_admission(run):
+    """Admission mid-decode forces device-state rebuilds; earlier requests'
+    outputs must be unaffected by later arrivals."""
+
+    async def body():
+        engine = make_engine(decode_block_size=4)
+        try:
+            solo, _ = await collect(engine, req([5, 6, 7], max_tokens=24))
+
+            async def staggered():
+                first = asyncio.create_task(
+                    collect(engine, req([5, 6, 7], max_tokens=24))
+                )
+                await asyncio.sleep(0.05)  # let the first enter decode
+                second = asyncio.create_task(
+                    collect(engine, req([9, 9], max_tokens=24))
+                )
+                return await first, await second
+
+            (t1, _), _ = await staggered()
+            assert t1 == solo
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
 def test_top_p_only_is_not_greedy(run):
     """temperature unset + top_p set must sample (temp 1.0), not argmax."""
 
