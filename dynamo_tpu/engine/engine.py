@@ -28,6 +28,7 @@ import numpy as np
 from ..runtime.engine import Annotated, Context, ResponseStream
 from ..protocols.common import (
     FinishReason,
+    ForwardPassMetrics,
     LLMEngineOutput,
     PreprocessedRequest,
 )
@@ -65,23 +66,6 @@ class EngineConfig:
     device_stop_width: int = 8
     seed: int = 0
     dtype: Optional[str] = None
-
-
-@dataclass
-class ForwardPassMetrics:
-    """Worker load metrics published to the KV router
-    (reference kv_router/protocols.rs:43-62; 'gpu_*' names kept for parity)."""
-
-    kv_active_blocks: int = 0
-    kv_total_blocks: int = 0
-    num_requests_waiting: int = 0
-    gpu_cache_usage_perc: float = 0.0
-    gpu_prefix_cache_hit_rate: float = 0.0
-    request_active_slots: int = 0
-    request_total_slots: int = 0
-
-    def to_dict(self) -> Dict[str, Any]:
-        return self.__dict__.copy()
 
 
 @dataclass

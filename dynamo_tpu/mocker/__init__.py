@@ -1,0 +1,20 @@
+"""Mocker: a deterministic fake engine for chip-free CI.
+
+Rebuild of the reference mocker (lib/llm/src/mocker/{scheduler,kv_manager,
+sequence,evictor}.rs): simulates continuous batching, paged-KV block
+movement (active/inactive pools, LRU eviction, preemption), prefix-cache
+reuse, and KV event publication -- behind the exact AsyncEngine surface of
+the real JaxEngine, with zero JAX imports.  Router / disaggregation /
+planner logic tests run against it in milliseconds.
+"""
+
+from .kv_manager import LRUEvictor, MockKvManager, PrefillCost
+from .engine import MockerConfig, MockerEngine
+
+__all__ = [
+    "LRUEvictor",
+    "MockKvManager",
+    "MockerConfig",
+    "MockerEngine",
+    "PrefillCost",
+]

@@ -1,0 +1,381 @@
+"""MockerEngine: deterministic fake engine behind the AsyncEngine surface.
+
+Behavioral rebuild of the reference mocker scheduler
+(lib/llm/src/mocker/scheduler.rs:185-400, sequence.rs): waiting queue ->
+watermark-gated admission with a prefill cost model -> per-tick decode over
+all running sequences -> LRU preemption when blocks run out -> completion
+derefs blocks into the reusable pool.  Token generation is a deterministic
+function of (prompt, index), so tests get reproducible streams; simulated
+prefill/decode latency is configurable (0 = as fast as the event loop).
+
+Publishes the same KV events (stored / removed) and ``ForwardPassMetrics``
+the real JaxEngine does, so router / disagg / planner stacks are exercised
+unmodified -- just pointed at a mock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+
+from ..protocols.common import (
+    FinishReason,
+    ForwardPassMetrics,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from ..runtime.engine import Annotated, Context, ResponseStream
+from ..tokens.sequence import TokenBlockSequence
+from .kv_manager import MockKvManager, PrefillCost
+
+logger = logging.getLogger("dynamo.mocker")
+
+_partial_ids = itertools.count(1)
+
+
+def _new_partial_id() -> int:
+    """Unique negative key for a still-filling block (never a valid hash)."""
+    return -next(_partial_ids)
+
+
+@dataclass
+class MockerConfig:
+    block_size: int = 16
+    kv_capacity_blocks: int = 256
+    max_batch_size: int = 64
+    watermark: float = 0.01
+    # simulated time: seconds per prefill-compute unit and per decode step;
+    # 0.0 = run at event-loop speed (unit-test mode)
+    prefill_s_per_compute: float = 0.0
+    decode_s_per_step: float = 0.0
+    # token budget per admission round (reference token_capacity)
+    token_capacity: int = 8192
+    vocab_size: int = 32000
+    speedup_ratio: float = 1.0
+
+
+@dataclass
+class _MockSeq:
+    request_id: str
+    req: PreprocessedRequest
+    blocks: TokenBlockSequence  # prompt + generated, canonical identity
+    partial_id: int
+    held: List[int] = field(default_factory=list)  # keys currently use()'d
+    num_generated: int = 0
+    cost: Optional[PrefillCost] = None
+    prefilled: bool = False
+    finish: Optional[FinishReason] = None
+    # prefix-cache stats are counted once per request (first admission);
+    # re-admissions after preemption trivially re-hit their own blocks
+    stats_counted: bool = False
+
+    @property
+    def max_tokens(self) -> int:
+        mt = self.req.stop_conditions.max_tokens
+        return mt if mt is not None else 1 << 30
+
+
+class MockerEngine:
+    """AsyncEngine-compatible deterministic engine (no device, no JAX)."""
+
+    def __init__(self, cfg: Optional[MockerConfig] = None) -> None:
+        self.cfg = cfg or MockerConfig()
+        self.kv_event_sink: Optional[Callable[[Dict[str, Any]], None]] = None
+        self.kv = MockKvManager(
+            self.cfg.kv_capacity_blocks,
+            self.cfg.block_size,
+            event_sink=lambda ev: self._sink(ev),
+        )
+        self._waiting_list: List[_MockSeq] = []
+        self.running: Dict[str, _MockSeq] = {}
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._cancelled: set = set()
+        self._task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._running = False
+        self._prefix_hits = 0
+        self._prefix_lookups = 0
+        self._tokens_generated = 0
+
+    def _sink(self, ev: Dict[str, Any]) -> None:
+        if self.kv_event_sink is not None:
+            self.kv_event_sink(ev)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._wake = asyncio.Event()
+        self._task = asyncio.create_task(self._run(), name="mocker-loop")
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._wake is not None:
+            self._wake.set()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    # -- AsyncEngine --------------------------------------------------------
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
+        if not self._running:
+            await self.start()
+        data = request.data
+        req = (
+            PreprocessedRequest.from_dict(data) if isinstance(data, dict) else data
+        )
+        seq = _MockSeq(
+            request_id=request.id,
+            req=req,
+            blocks=TokenBlockSequence(req.token_ids, block_size=self.cfg.block_size),
+            partial_id=_new_partial_id(),
+        )
+        ctx = request.ctx
+        queue: asyncio.Queue = asyncio.Queue()
+        self._queues[request.id] = queue
+        self._waiting_list.append(seq)
+        assert self._wake is not None
+        self._wake.set()
+
+        async def stream() -> AsyncIterator[Annotated]:
+            try:
+                while True:
+                    get = asyncio.ensure_future(queue.get())
+                    stop_waiter = asyncio.ensure_future(ctx.stopped())
+                    done, _ = await asyncio.wait(
+                        {get, stop_waiter}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    if get not in done:
+                        get.cancel()
+                        stop_waiter.cancel()
+                        self._cancelled.add(request.id)
+                        self._wake.set()
+                        yield Annotated.from_data(
+                            LLMEngineOutput.finished(FinishReason.CANCELLED).to_dict()
+                        )
+                        return
+                    stop_waiter.cancel()
+                    item = get.result()
+                    if item is None:
+                        return
+                    yield item
+            finally:
+                self._queues.pop(request.id, None)
+
+        return ResponseStream(ctx, stream())
+
+    # -- metrics ------------------------------------------------------------
+
+    def metrics(self) -> ForwardPassMetrics:
+        hit_rate = (
+            self._prefix_hits / self._prefix_lookups if self._prefix_lookups else 0.0
+        )
+        return ForwardPassMetrics(
+            kv_active_blocks=self.kv.num_active_blocks,
+            kv_total_blocks=self.kv.max_capacity,
+            num_requests_waiting=len(self._waiting_list),
+            gpu_cache_usage_perc=self.kv.usage_perc,
+            gpu_prefix_cache_hit_rate=hit_rate,
+            request_active_slots=len(self.running),
+            request_total_slots=self.cfg.max_batch_size,
+        )
+
+    @property
+    def tokens_generated(self) -> int:
+        return self._tokens_generated
+
+    # -- deterministic token function ---------------------------------------
+
+    def _next_token(self, seq: _MockSeq) -> int:
+        base = sum(seq.req.token_ids) * 1000003 + len(seq.req.token_ids) * 8191
+        return (base + seq.num_generated * 7919) % self.cfg.vocab_size
+
+    # -- the tick loop ------------------------------------------------------
+
+    async def _run(self) -> None:
+        assert self._wake is not None
+        while self._running:
+            try:
+                self._process_cancellations()
+                if not self._waiting_list and not self.running:
+                    self._wake.clear()
+                    await self._wake.wait()
+                    continue
+                self._admit()
+                await self._simulate_tick()
+                await asyncio.sleep(0)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.exception("mocker tick failed")
+                for seq in list(self.running.values()) + self._waiting_list:
+                    self._emit_error(seq, f"mocker error: {e}")
+                    self.kv.deref(seq.held)
+                    seq.held = []
+                self.running.clear()
+                self._waiting_list.clear()
+                await asyncio.sleep(0.01)
+
+    def _process_cancellations(self) -> None:
+        for rid in list(self._cancelled):
+            self._cancelled.discard(rid)
+            seq = self.running.pop(rid, None)
+            if seq is not None:
+                self.kv.deref(seq.held)
+                seq.held = []
+            else:
+                self._waiting_list = [
+                    s for s in self._waiting_list if s.request_id != rid
+                ]
+
+    def _admit(self) -> None:
+        budget = self.cfg.token_capacity
+        while self._waiting_list and len(self.running) < self.cfg.max_batch_size:
+            seq = self._waiting_list[0]
+            hashes = seq.blocks.sequence_hashes()
+            # after a preemption the re-prefill covers generated tokens too
+            cost = self.kv.try_schedule(
+                hashes,
+                len(seq.blocks),
+                watermark=self.cfg.watermark,
+                tokens_budget=budget,
+            )
+            if cost is None:
+                if not self.running and budget == self.cfg.token_capacity:
+                    # nothing running, full budget, and still unschedulable:
+                    # the cache state is static, so this head can *never* be
+                    # admitted -- fail it instead of spinning forever
+                    self._waiting_list.pop(0)
+                    self._emit_error(
+                        seq,
+                        f"request of {len(seq.blocks)} tokens "
+                        f"({len(hashes) + 1} blocks) cannot be scheduled: "
+                        f"capacity {self.kv.max_capacity} blocks, "
+                        f"token budget {self.cfg.token_capacity}",
+                    )
+                    continue
+                break
+            self._waiting_list.pop(0)
+            if not seq.stats_counted:
+                seq.stats_counted = True
+                self._prefix_lookups += 1
+                if cost.cached_tokens > 0:
+                    self._prefix_hits += 1
+            ok = self.kv.use(hashes + [seq.partial_id])
+            if not ok:
+                # should not happen (watermark guards admission)
+                self._waiting_list.insert(0, seq)
+                break
+            seq.held = hashes + [seq.partial_id]
+            seq.cost = cost
+            self.running[seq.request_id] = seq
+            budget -= cost.new_tokens
+
+    async def _simulate_tick(self) -> None:
+        cfg = self.cfg
+        # decode time models HBM-bound KV reads over all active tokens
+        tick_s = cfg.decode_s_per_step * self.kv.num_active_blocks
+        for rid in list(self.running.keys()):
+            seq = self.running.get(rid)
+            if seq is None:
+                continue
+            if not seq.prefilled:
+                assert seq.cost is not None
+                if cfg.prefill_s_per_compute:
+                    await asyncio.sleep(
+                        cfg.prefill_s_per_compute
+                        * seq.cost.prefill_compute
+                        / cfg.speedup_ratio
+                    )
+                seq.prefilled = True
+            self._generate_one(seq)
+        if tick_s:
+            await asyncio.sleep(tick_s / cfg.speedup_ratio)
+
+    def _generate_one(self, seq: _MockSeq) -> None:
+        token = self._next_token(seq)
+        stop = seq.req.stop_conditions
+        n_gen = seq.num_generated + 1
+        min_ok = stop.min_tokens is None or n_gen >= stop.min_tokens
+        hidden = stop.stop_token_ids_hidden or []
+        if token in hidden and min_ok:
+            return self._finish(seq, FinishReason.STOP)
+        if token in seq.req.eos_token_ids and not stop.ignore_eos and min_ok:
+            return self._finish(seq, FinishReason.EOS)
+
+        completed = seq.blocks.append(token)
+        seq.num_generated += 1
+        self._tokens_generated += 1
+        out_of_room = False
+        if completed is not None:
+            # secure the next partial first; only then promote the completed
+            # one (an unwound failure must leave partial bookkeeping intact)
+            new_partial = _new_partial_id()
+            if not self.kv.use([new_partial]):
+                # out of blocks: preempt the oldest *other* running request;
+                # if this sequence is the only one left, its own growth
+                # exceeds the pool -- truncate gracefully rather than thrash
+                victim = next(
+                    (s for s in self.running.values() if s is not seq), seq
+                )
+                if victim is not seq:
+                    seq.blocks.unwind(1)
+                    seq.num_generated -= 1
+                    self._tokens_generated -= 1
+                    self._preempt(victim)
+                    return
+                out_of_room = True
+                self.kv.promote(seq.partial_id, completed.sequence_hash)
+                seq.held[-1] = completed.sequence_hash
+            else:
+                self.kv.promote(seq.partial_id, completed.sequence_hash)
+                seq.held[-1] = completed.sequence_hash
+                seq.partial_id = new_partial
+                seq.held.append(new_partial)
+
+        queue = self._queues.get(seq.request_id)
+        if queue is not None:
+            queue.put_nowait(
+                Annotated.from_data(LLMEngineOutput(token_ids=[token]).to_dict())
+            )
+        if out_of_room or seq.num_generated >= seq.max_tokens:
+            self._finish(seq, FinishReason.LENGTH)
+
+    def _preempt(self, seq: _MockSeq) -> None:
+        logger.debug("mocker preempting %s", seq.request_id)
+        self.running.pop(seq.request_id, None)
+        self.kv.deref(seq.held)
+        seq.held = []
+        # restart from scratch with generated tokens folded into the blocks
+        seq.partial_id = _new_partial_id()
+        seq.prefilled = False
+        seq.cost = None
+        self._waiting_list.insert(0, seq)
+
+    def _finish(self, seq: _MockSeq, reason: FinishReason) -> None:
+        seq.finish = reason
+        self.running.pop(seq.request_id, None)
+        self.kv.deref(seq.held)
+        seq.held = []
+        queue = self._queues.get(seq.request_id)
+        if queue is not None:
+            queue.put_nowait(
+                Annotated.from_data(LLMEngineOutput.finished(reason).to_dict())
+            )
+            queue.put_nowait(None)
+
+    def _emit_error(self, seq: _MockSeq, message: str) -> None:
+        queue = self._queues.get(seq.request_id)
+        if queue is not None:
+            queue.put_nowait(Annotated.from_error(message))
+            queue.put_nowait(None)

@@ -110,6 +110,28 @@ class PreprocessedRequest:
 
 
 @dataclass
+class ForwardPassMetrics:
+    """Worker load metrics published to the KV router
+    (reference kv_router/protocols.rs:43-62; 'gpu_*' names kept for parity)."""
+
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ForwardPassMetrics":
+        d = d or {}
+        return cls(**{k: d[k] for k in cls().__dict__ if k in d})
+
+
+@dataclass
 class LLMEngineOutput:
     """Per-step engine output (reference llm_backend.rs:58-90).
 

@@ -1,0 +1,271 @@
+"""Mocker engine tests: deterministic streams, block movement, prefix reuse,
+preemption, KV events -- all pure-Python, no device.
+
+Reference behavior spec: lib/llm/src/mocker/{scheduler,kv_manager}.rs.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.mocker import MockerConfig, MockerEngine, MockKvManager
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Annotated, Context
+
+
+def req(tokens, max_tokens=8, **kw) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, **kw),
+        sampling_options=SamplingOptions(temperature=0.0),
+    )
+
+
+async def collect(engine, request):
+    stream = await engine.generate(Context.new(request))
+    tokens, finish = [], None
+    async for item in stream:
+        assert not item.is_error(), item.error_message()
+        data = item.data or {}
+        tokens.extend(data.get("token_ids") or [])
+        if data.get("finish_reason"):
+            finish = data["finish_reason"]
+    return tokens, finish
+
+
+# -- KV manager unit tests ---------------------------------------------------
+
+
+def test_kv_manager_use_deref_reuse():
+    kv = MockKvManager(max_capacity=4, block_size=4)
+    assert kv.use([101, 102])
+    assert kv.num_active_blocks == 2
+    kv.deref([101, 102])
+    assert kv.num_active_blocks == 0
+    assert kv.current_capacity == 2  # inactive, still resident
+    # reuse revives from inactive, no new allocation
+    assert kv.probe_cached_blocks([101, 102]) == 2
+    assert kv.use([101, 102])
+    assert kv.num_active_blocks == 2
+
+
+def test_kv_manager_lru_eviction_and_events():
+    events = []
+    kv = MockKvManager(max_capacity=2, block_size=4, event_sink=events.append)
+    kv.use([1])
+    kv.deref([1])
+    kv.use([2])
+    kv.deref([2])
+    # capacity full (both inactive); using a new block evicts LRU (=1)
+    assert kv.use([3])
+    assert kv.probe_cached_blocks([1]) == 0
+    assert kv.probe_cached_blocks([2]) == 1
+    stored = [e for e in events if e["type"] == "stored"]
+    removed = [e for e in events if e["type"] == "removed"]
+    assert [e["blocks"][0]["sequence_hash"] for e in stored] == [1, 2, 3]
+    assert [e["sequence_hashes"] for e in removed] == [[1]]
+
+
+def test_kv_manager_use_fails_when_all_active():
+    kv = MockKvManager(max_capacity=2, block_size=4)
+    assert kv.use([1, 2])
+    assert not kv.use([3])  # nothing evictable -> preemption signal
+
+
+def test_kv_manager_try_schedule_watermark():
+    kv = MockKvManager(max_capacity=10, block_size=4)
+    cost = kv.try_schedule([11, 12], prompt_len=8, watermark=0.01)
+    assert cost is not None
+    assert cost.new_blocks == 3  # 2 full + 1 partial
+    assert cost.new_tokens == 8 and cost.cached_tokens == 0
+    kv.use([11, 12])
+    kv.deref([11, 12])
+    cost2 = kv.try_schedule([11, 12, 13], prompt_len=12, watermark=0.01)
+    assert cost2 is not None
+    assert cost2.cached_tokens == 8 and cost2.new_tokens == 4
+    # watermark blocks admission when nearly full
+    kv2 = MockKvManager(max_capacity=3, block_size=4)
+    kv2.use([1, 2, 3])
+    assert kv2.try_schedule([4], prompt_len=4, watermark=0.01) is None
+
+
+# -- engine tests ------------------------------------------------------------
+
+
+def test_deterministic_stream(run):
+    async def body():
+        engine = MockerEngine(MockerConfig(block_size=4))
+        try:
+            t1, f1 = await collect(engine, req([1, 2, 3], max_tokens=10))
+            t2, f2 = await collect(engine, req([1, 2, 3], max_tokens=10))
+            t3, _ = await collect(engine, req([9, 9, 9], max_tokens=10))
+            assert t1 == t2 and len(t1) == 10 and f1 == "length"
+            assert t3 != t1  # prompt-dependent
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_concurrent_requests(run):
+    async def body():
+        engine = MockerEngine(MockerConfig(block_size=4))
+        try:
+            prompts = [[i + 1] * 5 for i in range(8)]
+            solo = [await collect(engine, req(p, max_tokens=6)) for p in prompts]
+            together = await asyncio.gather(
+                *[collect(engine, req(p, max_tokens=6)) for p in prompts]
+            )
+            assert [t for t, _ in together] == [t for t, _ in solo]
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_prefix_reuse_is_honest(run):
+    """A second request sharing the prompt prefix must register as a prefix
+    hit (blocks revived from the inactive pool) -- and the metric reflects
+    exactly that."""
+
+    async def body():
+        engine = MockerEngine(MockerConfig(block_size=4))
+        try:
+            await collect(engine, req([7] * 12, max_tokens=4))
+            m1 = engine.metrics()
+            assert m1.gpu_prefix_cache_hit_rate == 0.0
+            await collect(engine, req([7] * 12 + [1, 2], max_tokens=4))
+            m2 = engine.metrics()
+            assert m2.gpu_prefix_cache_hit_rate == pytest.approx(0.5)  # 1 of 2
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_kv_events_published(run):
+    async def body():
+        events = []
+        engine = MockerEngine(MockerConfig(block_size=4))
+        engine.kv_event_sink = events.append
+        try:
+            await collect(engine, req([5] * 8, max_tokens=6))
+            stored = [e for e in events if e["type"] == "stored"]
+            # 2 prompt blocks stored at admission + blocks completed by
+            # generation (8 prompt + 6 generated = 14 tokens -> 3 full blocks)
+            hashes = [b["sequence_hash"] for e in stored for b in e["blocks"]]
+            assert len(hashes) == 3
+            assert len(set(hashes)) == 3
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_preemption_under_pressure(run):
+    """More concurrent generation than the pool holds: requests must still
+    all complete (preemption + retry), and the pool must end empty-active."""
+
+    async def body():
+        engine = MockerEngine(
+            MockerConfig(block_size=4, kv_capacity_blocks=12, watermark=0.0)
+        )
+        try:
+            prompts = [[i + 1] * 8 for i in range(6)]
+            results = await asyncio.gather(
+                *[collect(engine, req(p, max_tokens=12)) for p in prompts]
+            )
+            for tokens, finish in results:
+                assert finish == "length"
+                assert len(tokens) == 12
+            assert engine.kv.num_active_blocks == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_cancellation(run):
+    async def body():
+        engine = MockerEngine(
+            MockerConfig(block_size=4, decode_s_per_step=0.001)
+        )
+        try:
+            ctx = Context.new(req([1, 2, 3], max_tokens=100000))
+            stream = await engine.generate(ctx)
+            got = 0
+            async for item in stream:
+                got += 1
+                if got == 3:
+                    ctx.ctx.stop_generating()
+            for _ in range(50):
+                await asyncio.sleep(0.01)
+                if not engine.running:
+                    break
+            assert not engine.running
+            assert engine.kv.num_active_blocks == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_oversized_prompt_fails_cleanly(run):
+    async def body():
+        engine = MockerEngine(MockerConfig(block_size=4, kv_capacity_blocks=4))
+        try:
+            stream = await engine.generate(Context.new(req([1] * 64, max_tokens=4)))
+            items = [item async for item in stream]
+            assert any(i.is_error() for i in items)
+            # engine still works afterwards
+            tokens, _ = await collect(engine, req([1, 2], max_tokens=3))
+            assert len(tokens) == 3
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_prompt_over_token_budget_fails_not_spins(run):
+    """A prompt whose uncached tokens exceed token_capacity can never be
+    scheduled; it must error out instead of head-of-line-blocking forever."""
+
+    async def body():
+        engine = MockerEngine(
+            MockerConfig(block_size=4, kv_capacity_blocks=64, token_capacity=16)
+        )
+        try:
+            stream = await engine.generate(Context.new(req([1] * 32, max_tokens=4)))
+            items = [item async for item in stream]
+            assert any(i.is_error() for i in items)
+            tokens, _ = await collect(engine, req([1] * 8, max_tokens=3))
+            assert len(tokens) == 3
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_simulated_latency_scales(run):
+    """With a nonzero decode time model, wall time grows with active load --
+    the hook the planner tests rely on."""
+
+    async def body():
+        import time
+
+        engine = MockerEngine(
+            MockerConfig(block_size=4, decode_s_per_step=0.0005)
+        )
+        try:
+            t0 = time.monotonic()
+            await collect(engine, req([1] * 4, max_tokens=20))
+            dt = time.monotonic() - t0
+            assert dt > 0.005  # 20 steps x >=1 active block x 0.5ms
+        finally:
+            await engine.stop()
+
+    run(body())
