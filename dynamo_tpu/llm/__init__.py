@@ -1,0 +1,6 @@
+"""LLM service layer: KV-aware routing, preprocessing, HTTP frontend.
+
+TPU-native rebuild of the reference lib/llm crate's service surface
+(lib/llm/src: kv_router, preprocessor, backend, http, block_manager) on top
+of the dynamo_tpu runtime.
+"""

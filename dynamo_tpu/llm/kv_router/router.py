@@ -1,0 +1,137 @@
+"""KvRouter + KvPushRouter: KV-overlap-aware request dispatch.
+
+Rebuild of the reference (lib/llm/src/kv_router.rs:104-255): the KvRouter
+owns the indexer (fed by ``{ns}.events.kv_events`` subscriptions), the
+metrics aggregator, and the scheduler; ``find_best_match(tokens)`` returns
+the worker with the best cost.  KvPushRouter wraps a PushRouter: pick the
+best worker, stamp ``estimated_prefix_hit_num_blocks`` into the request,
+and dispatch with ``direct()``.
+
+Worker death is handled on both feeds: the aggregator drops workers whose
+``load_metrics`` instance disappeared (lease loss), and the indexer drops
+their whole subtree (reference indexer.rs:382 semantics).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from ...protocols.common import PreprocessedRequest
+from ...runtime.component import Component, Namespace, PushRouter
+from ...runtime.engine import Annotated, Context, ResponseStream
+from ...tokens.hashing import hash_blocks
+from .indexer import KvIndexer, OverlapScores
+from .metrics_aggregator import KvMetricsAggregator
+from .scheduler import DefaultWorkerSelector, KvRouterConfig, KvScheduler
+
+logger = logging.getLogger("dynamo.kv_router")
+
+KV_EVENT_SUBJECT = "kv_events"  # rides {ns}.events.kv_events
+
+
+class KvRouter:
+    """Chooses a worker; does not dispatch (reference kv_router.rs:104)."""
+
+    def __init__(
+        self,
+        namespace: Namespace,
+        component: Component,
+        block_size: int = 16,
+        config: Optional[KvRouterConfig] = None,
+        scrape_interval_s: float = 0.2,
+    ) -> None:
+        self.namespace = namespace
+        self.component = component
+        self.block_size = block_size
+        self.indexer = KvIndexer(block_size=block_size)
+        self.scheduler = KvScheduler(
+            block_size, DefaultWorkerSelector(config)
+        )
+        # one shared ProcessedEndpoints: the aggregator writes scrapes into
+        # the same snapshot the scheduler reads/predictively bumps
+        self.aggregator = KvMetricsAggregator(
+            component,
+            interval_s=scrape_interval_s,
+            endpoints=self.scheduler.workers,
+            on_remove=self._on_worker_removed,
+        )
+        self._sub = None
+        self._sub_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._sub = await self.namespace.subscribe(KV_EVENT_SUBJECT)
+        self._sub_task = asyncio.create_task(
+            self._consume_events(), name="kv-router-events"
+        )
+        await self.aggregator.start()
+
+    async def stop(self) -> None:
+        if self._sub_task is not None:
+            self._sub_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await self._sub_task
+            self._sub_task = None
+        if self._sub is not None:
+            await self._sub.close()
+        await self.aggregator.stop()
+
+    def _on_worker_removed(self, worker_id: int) -> None:
+        # the aggregator already dropped it from the shared endpoint
+        # snapshot; the index subtree is ours to clean up
+        logger.info("worker %x removed; dropping its KV index entries", worker_id)
+        self.indexer.remove_worker(worker_id)
+
+    async def _consume_events(self) -> None:
+        assert self._sub is not None
+        async for _subject, payload in self._sub:
+            try:
+                msg = json.loads(payload)
+                self.indexer.apply_event(int(msg["worker_id"]), msg["event"])
+            except Exception:
+                logger.exception("bad kv event payload")
+
+    # -- selection -----------------------------------------------------------
+
+    async def find_best_match(self, tokens: Sequence[int]) -> Tuple[int, int]:
+        """Returns (worker_id, overlap_blocks) (reference kv_router.rs:
+        176-196)."""
+        _, seq_hashes = hash_blocks(tokens, self.block_size)
+        overlap = self.indexer.find_matches(seq_hashes)
+        worker_id = self.scheduler.schedule(overlap, len(tokens))
+        return worker_id, overlap.scores.get(worker_id, 0)
+
+
+class KvPushRouter:
+    """PushRouter wrapper: best-match then ``direct()`` (reference
+    kv_router.rs:220-255)."""
+
+    def __init__(self, inner: PushRouter, chooser: KvRouter) -> None:
+        self.inner = inner
+        self.chooser = chooser
+
+    async def generate(self, request: Context[Any]) -> ResponseStream[Annotated]:
+        data = request.data
+        if isinstance(data, PreprocessedRequest):
+            token_ids = data.token_ids
+        else:
+            token_ids = list((data or {}).get("token_ids") or [])
+        try:
+            instance_id, overlap = await self.chooser.find_best_match(token_ids)
+            if isinstance(data, PreprocessedRequest):
+                data.estimated_prefix_hit_num_blocks = overlap
+                stamped = request
+            else:
+                stamped = request.replace(
+                    dict(data or {}, estimated_prefix_hit_num_blocks=overlap)
+                )
+            return await self.inner.direct(stamped, instance_id)
+        except Exception:
+            # no metrics yet, no workers known to the scheduler, or a stale
+            # selection (worker died between scrapes): degrade to plain load
+            # balancing over the live instances rather than failing
+            logger.debug("kv selection failed; falling back", exc_info=True)
+            return await self.inner.generate(request)

@@ -1,0 +1,160 @@
+"""KvScheduler: pick the best worker from overlap scores + load metrics.
+
+Rebuild of the reference scheduler (lib/llm/src/kv_router/scheduler.rs:
+88-227 select loop + predictive load update, :248-330 DefaultWorkerSelector)
+with the identical cost function:
+
+    score  = overlap_blocks * block_size / isl_tokens
+    logit  = w_overlap * score
+           - w_usage   * gpu_cache_usage_perc
+           - w_wait    * num_requests_waiting / max_waiting
+
+argmax wins; ties break randomly.  After a selection the chosen worker's
+load is updated predictively (waiting += 1, kv_active_blocks += uncached
+blocks) so back-to-back requests spread out before the next metrics scrape
+overwrites the estimates.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ...protocols.common import ForwardPassMetrics
+from .indexer import OverlapScores
+
+
+@dataclass
+class KvRouterConfig:
+    """Cost-function weights (reference kv_router.rs:59-100)."""
+
+    overlap_score_weight: float = 2.0
+    gpu_cache_usage_weight: float = 1.0
+    waiting_requests_weight: float = 1.0
+
+
+@dataclass
+class KVHitRateEvent:
+    """Emitted per selection (reference scheduler.rs:31-36)."""
+
+    worker_id: int
+    isl_blocks: int
+    overlap_blocks: int
+
+
+class NoEndpointsError(RuntimeError):
+    pass
+
+
+@dataclass
+class ProcessedEndpoints:
+    """Live per-worker load snapshot (reference scoring.rs:24)."""
+
+    endpoints: Dict[int, ForwardPassMetrics] = field(default_factory=dict)
+
+    def update(self, worker_id: int, metrics: ForwardPassMetrics) -> None:
+        self.endpoints[worker_id] = metrics
+
+    def remove(self, worker_id: int) -> None:
+        self.endpoints.pop(worker_id, None)
+
+
+class DefaultWorkerSelector:
+    """The reference cost function (scheduler.rs:248-330)."""
+
+    def __init__(self, config: Optional[KvRouterConfig] = None) -> None:
+        self.config = config or KvRouterConfig()
+
+    def select_worker(
+        self,
+        workers: ProcessedEndpoints,
+        overlap: OverlapScores,
+        isl_tokens: int,
+        block_size: int,
+    ) -> Tuple[int, float]:
+        """Returns (worker_id, best_logit).  Raises NoEndpointsError when no
+        workers are known."""
+        if not workers.endpoints:
+            raise NoEndpointsError("no endpoints")
+        isl_tokens = max(isl_tokens, 1)
+        cfg = self.config
+
+        max_waiting = max(
+            (m.num_requests_waiting for m in workers.endpoints.values()),
+            default=0.0,
+        )
+        best_logit = float("-inf")
+        best: List[int] = []
+        for worker_id, m in workers.endpoints.items():
+            score = (
+                overlap.scores.get(worker_id, 0) * block_size / isl_tokens
+            )
+            normalized_waiting = (
+                m.num_requests_waiting / max_waiting if max_waiting > 0 else 0.0
+            )
+            logit = (
+                cfg.overlap_score_weight * score
+                - cfg.gpu_cache_usage_weight * m.gpu_cache_usage_perc
+                - cfg.waiting_requests_weight * normalized_waiting
+            )
+            if logit > best_logit:
+                best_logit = logit
+                best = [worker_id]
+            elif logit == best_logit:
+                best.append(worker_id)
+        if not best:
+            raise NoEndpointsError("no valid workers")
+        return (best[0] if len(best) == 1 else random.choice(best)), best_logit
+
+
+class KvScheduler:
+    """Selection + predictive load update (reference scheduler.rs:88-232)."""
+
+    def __init__(
+        self,
+        block_size: int,
+        selector: Optional[DefaultWorkerSelector] = None,
+    ) -> None:
+        self.block_size = block_size
+        self.selector = selector or DefaultWorkerSelector()
+        self.workers = ProcessedEndpoints()
+        self.hit_rate_events: List[KVHitRateEvent] = []
+
+    def update_metrics(self, worker_id: int, metrics: ForwardPassMetrics) -> None:
+        self.workers.update(worker_id, metrics)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.workers.remove(worker_id)
+
+    def schedule(self, overlap: OverlapScores, isl_tokens: int) -> int:
+        worker_id, _ = self.selector.select_worker(
+            self.workers, overlap, isl_tokens, self.block_size
+        )
+        self._process_selection(worker_id, overlap, isl_tokens)
+        return worker_id
+
+    def _process_selection(
+        self, worker_id: int, overlap: OverlapScores, isl_tokens: int
+    ) -> None:
+        """Predictive update, overwritten by the next metrics scrape
+        (reference scheduler.rs:201-232)."""
+        m = self.workers.endpoints.get(worker_id)
+        required_blocks = -(-isl_tokens // self.block_size)
+        overlap_blocks = overlap.scores.get(worker_id, 0)
+        if m is not None:
+            m.num_requests_waiting += 1
+            m.kv_active_blocks += max(required_blocks - overlap_blocks, 0)
+            if m.kv_total_blocks:
+                m.gpu_cache_usage_perc = min(
+                    m.kv_active_blocks / m.kv_total_blocks, 1.0
+                )
+        self.hit_rate_events.append(
+            KVHitRateEvent(
+                worker_id=worker_id,
+                isl_blocks=required_blocks,
+                overlap_blocks=overlap_blocks,
+            )
+        )
+        if len(self.hit_rate_events) > 1024:
+            del self.hit_rate_events[:512]

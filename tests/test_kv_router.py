@@ -1,0 +1,290 @@
+"""KV-aware router tests: indexer (native vs python parity), cost function,
+and the full loop -- mocker workers publishing KV events + load metrics over
+a live hub, KvPushRouter provably routing repeated prefixes to the holder,
+and worker death dropping its index entries.
+
+Reference spec: lib/llm/src/kv_router/{indexer,scheduler}.rs, kv_router.rs.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.kv_router import (
+    DefaultWorkerSelector,
+    KvIndexer,
+    KvPushRouter,
+    KvRouter,
+    KvRouterConfig,
+)
+from dynamo_tpu.llm.kv_router.indexer import _PyIndex
+from dynamo_tpu.llm.kv_router.publisher import (
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+)
+from dynamo_tpu.llm.kv_router.scheduler import (
+    NoEndpointsError,
+    OverlapScores,
+    ProcessedEndpoints,
+)
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import (
+    ForwardPassMetrics,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.runtime.component import DistributedRuntime, PushRouter
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.hub import HubServer
+from dynamo_tpu.tokens.hashing import hash_blocks
+
+
+# -- indexer -----------------------------------------------------------------
+
+
+def _events_for(tokens, block_size=4):
+    _, shs = hash_blocks(tokens, block_size)
+    return {"type": "stored", "blocks": [{"sequence_hash": h} for h in shs]}
+
+
+def test_indexer_native_python_parity():
+    native = KvIndexer(block_size=4, use_native=True)
+    py = KvIndexer(block_size=4, use_native=False)
+    assert native.native and not py.native
+    ops = [
+        (1, _events_for([1, 2, 3, 4, 5, 6, 7, 8])),
+        (2, _events_for([1, 2, 3, 4, 9, 9, 9, 9])),
+        (3, _events_for([5] * 12)),
+        (1, {"type": "removed",
+             "sequence_hashes": [hash_blocks([1, 2, 3, 4, 5, 6, 7, 8], 4)[1][1]]}),
+    ]
+    for ix in (native, py):
+        for worker, ev in ops:
+            ix.apply_event(worker, ev)
+    for query in ([1, 2, 3, 4, 5, 6, 7, 8], [1, 2, 3, 4, 9, 9, 9, 9], [5] * 8,
+                  [7] * 8):
+        a = native.find_matches_for_tokens(query).scores
+        b = py.find_matches_for_tokens(query).scores
+        assert a == b, (query, a, b)
+    assert native.num_blocks == py.num_blocks
+    native.remove_worker(2)
+    py.remove_worker(2)
+    assert (native.find_matches_for_tokens([1, 2, 3, 4, 9, 9, 9, 9]).scores
+            == py.find_matches_for_tokens([1, 2, 3, 4, 9, 9, 9, 9]).scores)
+
+
+def test_indexer_early_exit():
+    """A gap in the chain stops the walk: deeper blocks can't match."""
+    ix = _PyIndex()
+    ix.store(1, [10, 30])  # holds level 0 and level 2, NOT level 1
+    assert ix.find_matches([10, 20, 30]) == {1: 1}  # stops at missing 20
+
+
+# -- cost function -----------------------------------------------------------
+
+
+def _metrics(**kw):
+    return ForwardPassMetrics(**kw)
+
+
+def test_selector_prefers_overlap():
+    sel = DefaultWorkerSelector(KvRouterConfig())
+    workers = ProcessedEndpoints(
+        endpoints={
+            1: _metrics(gpu_cache_usage_perc=0.2),
+            2: _metrics(gpu_cache_usage_perc=0.2),
+        }
+    )
+    wid, _ = sel.select_worker(
+        workers, OverlapScores(scores={2: 3}), isl_tokens=64, block_size=16
+    )
+    assert wid == 2
+
+
+def test_selector_penalizes_usage_and_waiting():
+    sel = DefaultWorkerSelector(KvRouterConfig())
+    workers = ProcessedEndpoints(
+        endpoints={
+            1: _metrics(gpu_cache_usage_perc=0.95, num_requests_waiting=10),
+            2: _metrics(gpu_cache_usage_perc=0.1, num_requests_waiting=0),
+        }
+    )
+    # no overlap anywhere: pick the unloaded worker
+    wid, _ = sel.select_worker(
+        workers, OverlapScores(), isl_tokens=64, block_size=16
+    )
+    assert wid == 2
+    # enough overlap outweighs the load penalty (w_overlap=2.0)
+    wid2, _ = sel.select_worker(
+        workers, OverlapScores(scores={1: 4}), isl_tokens=64, block_size=16
+    )
+    assert wid2 == 1
+
+
+def test_selector_no_endpoints():
+    sel = DefaultWorkerSelector()
+    with pytest.raises(NoEndpointsError):
+        sel.select_worker(ProcessedEndpoints(), OverlapScores(), 8, 16)
+
+
+def test_scheduler_predictive_update():
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+
+    sched = KvScheduler(block_size=16)
+    sched.update_metrics(1, _metrics(kv_total_blocks=100))
+    sched.update_metrics(2, _metrics(kv_total_blocks=100))
+    first = sched.schedule(OverlapScores(), isl_tokens=160)
+    # the chosen worker's predicted load must rise so an immediate identical
+    # request (still no overlap) goes to the other worker
+    second = sched.schedule(OverlapScores(), isl_tokens=160)
+    assert {first, second} == {1, 2}
+
+
+# -- end-to-end over the hub -------------------------------------------------
+
+
+BLOCK = 4
+
+
+async def _spawn_worker(addr, ns_name="kvr"):
+    """A mocker worker serving generate + load_metrics, publishing KV events."""
+    rt = await DistributedRuntime.detached(addr)
+    ns = rt.namespace(ns_name)
+    comp = ns.component("backend")
+    engine = MockerEngine(MockerConfig(block_size=BLOCK))
+    pub = KvEventPublisher(ns, worker_id=rt.primary_lease)
+    pub.hook(engine)
+    metrics_pub = WorkerMetricsPublisher(engine.metrics)
+    inst = await comp.endpoint("generate").serve(engine)
+    await metrics_pub.attach(comp)
+    return rt, engine, inst, pub
+
+
+def req(tokens, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+    ).to_dict()
+
+
+async def _drain(stream):
+    toks = []
+    async for item in stream:
+        d = item.data or {}
+        toks.extend(d.get("token_ids") or [])
+    return toks
+
+
+def test_kv_router_end_to_end(run):
+    """Repeated-prefix requests must route to the worker holding the prefix,
+    and a dead worker's index entries must vanish."""
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        workers = [await _spawn_worker(addr) for _ in range(3)]
+        router_rt = await DistributedRuntime.detached(addr)
+        ns = router_rt.namespace("kvr")
+        comp = ns.component("backend")
+        chooser = KvRouter(ns, comp, block_size=BLOCK)
+        await chooser.start()
+        try:
+            gen_client = await comp.endpoint("generate").client()
+            await gen_client.wait_for_instances()
+            assert len(gen_client.instances) == 3
+            await chooser.aggregator.scrape_once()
+            kv_router = KvPushRouter(PushRouter(gen_client), chooser)
+
+            # --- request with a distinctive prefix lands somewhere ---------
+            prefix = [11, 22, 33, 44, 55, 66, 77, 88]  # 2 full blocks
+            stream = await kv_router.generate(Context.new(req(prefix)))
+            toks = await _drain(stream)
+            assert len(toks) == 6
+
+            # wait for that worker's stored events to reach the indexer
+            # (both prompt blocks, published as separate events)
+            for _ in range(100):
+                if chooser.indexer.num_blocks >= 2:
+                    break
+                await asyncio.sleep(0.02)
+            assert chooser.indexer.num_blocks >= 2
+            holder, overlap = await chooser.find_best_match(prefix)
+            assert overlap >= 2  # both prompt blocks resident
+
+            # --- same prefix again: must go to the holder ------------------
+            await chooser.aggregator.scrape_once()
+            captured = {}
+            orig_direct = kv_router.inner.direct
+
+            async def spy_direct(request, instance_id):
+                captured["instance"] = instance_id
+                captured["overlap"] = (request.data or {}).get(
+                    "estimated_prefix_hit_num_blocks"
+                )
+                return await orig_direct(request, instance_id)
+
+            kv_router.inner.direct = spy_direct
+            stream = await kv_router.generate(
+                Context.new(req(prefix + [1, 2]))
+            )
+            await _drain(stream)
+            assert captured["instance"] == holder
+            assert captured["overlap"] >= 2
+
+            # --- worker death drops its index entries ----------------------
+            dead = next(w for w in workers if w[0].primary_lease == holder)
+            await dead[0].shutdown()
+            for _ in range(100):
+                if holder not in {i.instance_id for i in gen_client.instances}:
+                    break
+                await asyncio.sleep(0.02)
+            await chooser.aggregator.scrape_once()
+            scores = chooser.indexer.find_matches_for_tokens(prefix).scores
+            assert holder not in scores
+            assert holder not in chooser.scheduler.workers.endpoints
+        finally:
+            await chooser.stop()
+            for rt, engine, _, pub in workers:
+                await engine.stop()
+                await pub.close()
+                try:
+                    await rt.shutdown()
+                except Exception:
+                    pass
+            await router_rt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_kv_push_router_falls_back_without_metrics(run):
+    """No scrape yet (scheduler knows nobody): requests still flow via plain
+    round-robin instead of erroring."""
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        rt, engine, inst, pub = await _spawn_worker(addr)
+        router_rt = await DistributedRuntime.detached(addr)
+        ns = router_rt.namespace("kvr")
+        comp = ns.component("backend")
+        chooser = KvRouter(ns, comp, block_size=BLOCK)
+        await chooser.start()
+        try:
+            client = await comp.endpoint("generate").client()
+            await client.wait_for_instances()
+            kv_router = KvPushRouter(PushRouter(client), chooser)
+            stream = await kv_router.generate(Context.new(req([1, 2, 3])))
+            toks = await _drain(stream)
+            assert len(toks) == 6
+        finally:
+            await chooser.stop()
+            await engine.stop()
+            await pub.close()
+            await rt.shutdown()
+            await router_rt.shutdown()
+            await hub.stop()
+
+    run(body())
