@@ -22,6 +22,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..block_manager import PagePool
 from ..protocols.common import (
     FinishReason,
     PreprocessedRequest,
@@ -55,6 +56,7 @@ class SeqState:
     eos_ids: List[int]
     arrival_s: float = field(default_factory=time.monotonic)
     slot: int = -1
+    # page_table view: shared (reused) pages first, then owned pages
     pages: List[int] = field(default_factory=list)
     blocks: Optional[TokenBlockSequence] = None  # router-visible block identity
     num_generated: int = 0
@@ -64,6 +66,15 @@ class SeqState:
     finish: Optional[FinishReason] = None
     # number of prompt tokens whose KV was reused from a prefix-cache match
     cached_prompt_tokens: int = 0
+    # registry refs this sequence holds (reused prefix + own registered blocks)
+    held_blocks: List[int] = field(default_factory=list)
+    # pages allocated to (and freed by) this sequence alone
+    owned_pages: List[int] = field(default_factory=list)
+    # completed blocks whose final token's KV is not yet written (it lands
+    # with the next decode step); registered once the cache catches up
+    pending_register: List[TokenBlock] = field(default_factory=list)
+    # prefix-cache stats are counted once per request (first admission)
+    stats_counted: bool = False
 
     @property
     def seq_len(self) -> int:
