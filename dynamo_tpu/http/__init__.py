@@ -1,0 +1,4 @@
+from .server import HttpServer, Request, Response
+from .service import HttpService, ModelManager
+
+__all__ = ["HttpServer", "HttpService", "ModelManager", "Request", "Response"]

@@ -1,0 +1,117 @@
+"""HTTP service Prometheus metrics.
+
+Reference parity: lib/llm/src/http/service/metrics.rs:27-188,402-460 -- same
+metric family names (``{prefix}_http_service_requests_total``,
+``_inflight_requests``, ``_request_duration_seconds``,
+``_time_to_first_token_seconds``, ``_inter_token_latency_seconds``) so
+existing dashboards translate directly.  Each service owns a private
+registry (tests run many services per process).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+from prometheus_client.exposition import CONTENT_TYPE_LATEST
+
+_DURATION_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+_TTFT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+_ITL_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+
+class ServiceMetrics:
+    def __init__(self, prefix: str = "dynamo") -> None:
+        self.registry = CollectorRegistry()
+        self.requests_total = Counter(
+            f"{prefix}_http_service_requests_total",
+            "Total HTTP service requests",
+            ["model", "endpoint", "status"],
+            registry=self.registry,
+        )
+        self.inflight = Gauge(
+            f"{prefix}_http_service_inflight_requests",
+            "Requests currently being processed",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.duration = Histogram(
+            f"{prefix}_http_service_request_duration_seconds",
+            "End-to-end request duration",
+            ["model", "endpoint"],
+            buckets=_DURATION_BUCKETS,
+            registry=self.registry,
+        )
+        self.ttft = Histogram(
+            f"{prefix}_http_service_time_to_first_token_seconds",
+            "Time to first generated token",
+            ["model"],
+            buckets=_TTFT_BUCKETS,
+            registry=self.registry,
+        )
+        self.itl = Histogram(
+            f"{prefix}_http_service_inter_token_latency_seconds",
+            "Latency between consecutive tokens",
+            ["model"],
+            buckets=_ITL_BUCKETS,
+            registry=self.registry,
+        )
+
+    def guard(self, model: str, endpoint: str) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint)
+
+    def render(self) -> tuple[bytes, str]:
+        return generate_latest(self.registry), CONTENT_TYPE_LATEST
+
+
+class InflightGuard:
+    """Tracks one request: inflight gauge, duration, TTFT, ITL, final status.
+
+    Reference: metrics.rs InflightGuard -- created at admission, marked
+    ok/error at completion; dropping without mark counts as error.
+    """
+
+    def __init__(self, metrics: ServiceMetrics, model: str, endpoint: str) -> None:
+        self.m = metrics
+        self.model = model
+        self.endpoint = endpoint
+        self.start = time.monotonic()
+        self._last_token: Optional[float] = None
+        self._status: Optional[str] = None
+        metrics.inflight.labels(model, endpoint).inc()
+
+    def token(self) -> None:
+        now = time.monotonic()
+        if self._last_token is None:
+            self.m.ttft.labels(self.model).observe(now - self.start)
+        else:
+            self.m.itl.labels(self.model).observe(now - self._last_token)
+        self._last_token = now
+
+    def mark_ok(self) -> None:
+        self._status = "success"
+
+    def mark_error(self) -> None:
+        self._status = "error"
+
+    def finish(self) -> None:
+        self.m.inflight.labels(self.model, self.endpoint).dec()
+        self.m.duration.labels(self.model, self.endpoint).observe(
+            time.monotonic() - self.start
+        )
+        self.m.requests_total.labels(
+            self.model, self.endpoint, self._status or "error"
+        ).inc()

@@ -1,0 +1,237 @@
+"""OpenAI-compatible HTTP service: routes + per-model engine registry.
+
+Reference parity: lib/llm/src/http/service/service_v2.rs:51-133 (HttpService
++ state), openai.rs:123,277 (completions / chat completions handlers with
+SSE streaming), discovery/model_manager.rs (ModelManager: engines keyed by
+model name, added/removed dynamically by the discovery watcher).
+
+An entry's engine is an AsyncEngine taking Context[ChatCompletionRequest]
+(or CompletionRequest) and yielding Annotated[openai-chunk-dict] -- usually
+``link(OpenAIPreprocessor, Backend, push_router_or_engine)``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Dict, Optional
+
+from ..protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    OpenAIError,
+    SSE_DONE,
+    aggregate_chat,
+    aggregate_completion,
+    sse_encode,
+    sse_error,
+)
+from ..runtime.engine import Annotated, AsyncEngine, Context, as_response_stream
+from .metrics import ServiceMetrics
+from .server import HttpServer, Request, Response
+
+logger = logging.getLogger("dynamo.http.service")
+
+
+class ModelNotFound(OpenAIError):
+    def __init__(self, model: str) -> None:
+        super().__init__(f"model '{model}' not found", code=404)
+
+
+class ModelManager:
+    """Engines per model name, per endpoint type (chat / completion)."""
+
+    def __init__(self) -> None:
+        self._chat: Dict[str, AsyncEngine] = {}
+        self._completion: Dict[str, AsyncEngine] = {}
+
+    def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
+        self._chat[name] = engine
+
+    def add_completion_model(self, name: str, engine: AsyncEngine) -> None:
+        self._completion[name] = engine
+
+    def remove_model(self, name: str) -> None:
+        self._chat.pop(name, None)
+        self._completion.pop(name, None)
+
+    def chat_engine(self, name: str) -> AsyncEngine:
+        try:
+            return self._chat[name]
+        except KeyError:
+            raise ModelNotFound(name) from None
+
+    def completion_engine(self, name: str) -> AsyncEngine:
+        try:
+            return self._completion[name]
+        except KeyError:
+            raise ModelNotFound(name) from None
+
+    def list_models(self) -> list:
+        names = sorted(set(self._chat) | set(self._completion))
+        return [
+            {"id": n, "object": "model", "owned_by": "dynamo-tpu"} for n in names
+        ]
+
+    @property
+    def is_empty(self) -> bool:
+        return not self._chat and not self._completion
+
+
+class HttpService:
+    """The OpenAI frontend: /v1/chat/completions, /v1/completions,
+    /v1/models, /health, /live, /metrics."""
+
+    def __init__(
+        self,
+        manager: Optional[ModelManager] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics_prefix: str = "dynamo",
+    ) -> None:
+        self.manager = manager or ModelManager()
+        self.metrics = ServiceMetrics(prefix=metrics_prefix)
+        self.server = HttpServer(host, port)
+        self.server.route("POST", "/v1/chat/completions", self._chat)
+        self.server.route("POST", "/v1/completions", self._completions)
+        self.server.route("GET", "/v1/models", self._models)
+        self.server.route("GET", "/health", self._health)
+        self.server.route("GET", "/live", self._health)
+        self.server.route("GET", "/metrics", self._metrics)
+
+    @property
+    def address(self) -> tuple:
+        return self.server.address
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.address
+        return f"http://{host}:{port}"
+
+    async def start(self) -> None:
+        await self.server.start()
+        logger.info("http service listening on %s", self.url)
+
+    async def stop(self) -> None:
+        await self.server.stop()
+
+    # -- handlers ------------------------------------------------------------
+
+    async def _health(self, req: Request) -> Response:
+        return Response.json(
+            {"status": "healthy", "models": [m["id"] for m in self.manager.list_models()]}
+        )
+
+    async def _models(self, req: Request) -> Response:
+        return Response.json({"object": "list", "data": self.manager.list_models()})
+
+    async def _metrics(self, req: Request) -> Response:
+        body, content_type = self.metrics.render()
+        return Response(200, {"Content-Type": content_type}, body)
+
+    async def _chat(self, req: Request) -> Response:
+        return await self._serve(req, chat=True)
+
+    async def _completions(self, req: Request) -> Response:
+        return await self._serve(req, chat=False)
+
+    async def _serve(self, req: Request, chat: bool) -> Response:
+        endpoint = "chat_completions" if chat else "completions"
+        try:
+            body = req.json()
+            if not isinstance(body, dict):
+                raise OpenAIError("request body must be a JSON object")
+            parsed = (
+                ChatCompletionRequest.from_dict(body)
+                if chat
+                else CompletionRequest.from_dict(body)
+            )
+            engine = (
+                self.manager.chat_engine(parsed.model)
+                if chat
+                else self.manager.completion_engine(parsed.model)
+            )
+        except OpenAIError as e:
+            self.metrics.requests_total.labels(
+                body.get("model", "unknown") if isinstance(body, dict) else "unknown",
+                endpoint,
+                "rejected",
+            ).inc()
+            return Response.json(e.to_body(), e.code)
+
+        guard = self.metrics.guard(parsed.model, endpoint)
+        request = Context.new(parsed)
+        try:
+            stream = await as_response_stream(engine, request)
+        except Exception as e:
+            logger.exception("engine dispatch failed")
+            guard.mark_error()
+            guard.finish()
+            return Response.json(
+                {"error": {"message": f"engine error: {e}", "type": "server_error"}},
+                503,
+            )
+
+        if parsed.stream:
+            return Response.sse(self._sse_body(stream, request, guard))
+        return await self._aggregate_body(stream, guard, chat)
+
+    async def _sse_body(
+        self, stream, request: Context, guard
+    ) -> AsyncIterator[bytes]:
+        try:
+            async for item in stream:
+                if not isinstance(item, Annotated):
+                    item = Annotated.from_data(item)
+                if item.is_error():
+                    guard.mark_error()
+                    yield sse_error(item.error_message() or "engine error")
+                    return
+                if item.data is not None:
+                    guard.token()
+                    yield sse_encode(item.data)
+            guard.mark_ok()
+            yield SSE_DONE
+        except asyncio.CancelledError:
+            # client went away mid-stream: propagate kill to the engine
+            request.ctx.kill()
+            raise
+        except Exception as e:
+            logger.exception("stream failed")
+            guard.mark_error()
+            yield sse_error(str(e))
+        finally:
+            guard.finish()
+
+    async def _aggregate_body(self, stream, guard, chat: bool) -> Response:
+        chunks = []
+        try:
+            async for item in stream:
+                if not isinstance(item, Annotated):
+                    item = Annotated.from_data(item)
+                if item.is_error():
+                    guard.mark_error()
+                    guard.finish()
+                    return Response.json(
+                        {
+                            "error": {
+                                "message": item.error_message(),
+                                "type": "server_error",
+                            }
+                        },
+                        500,
+                    )
+                if item.data is not None:
+                    guard.token()
+                    chunks.append(item.data)
+            guard.mark_ok()
+            agg = aggregate_chat(chunks) if chat else aggregate_completion(chunks)
+            return Response.json(agg)
+        except Exception as e:
+            logger.exception("aggregation failed")
+            guard.mark_error()
+            return Response.json(
+                {"error": {"message": str(e), "type": "server_error"}}, 500
+            )
+        finally:
+            guard.finish()

@@ -4,3 +4,16 @@ TPU-native rebuild of the reference lib/llm crate's service surface
 (lib/llm/src: kv_router, preprocessor, backend, http, block_manager) on top
 of the dynamo_tpu runtime.
 """
+
+from .backend import Backend, StopJail
+from .preprocessor import OpenAIPreprocessor, PromptFormatter
+from .tokenizer import DecodeStream, Tokenizer
+
+__all__ = [
+    "Backend",
+    "DecodeStream",
+    "OpenAIPreprocessor",
+    "PromptFormatter",
+    "StopJail",
+    "Tokenizer",
+]

@@ -1,0 +1,192 @@
+"""OpenAIPreprocessor: OpenAI requests -> token-level requests, and engine
+deltas -> OpenAI chunks on the way back.
+
+Reference parity: lib/llm/src/preprocessor.rs:64-110 (template render +
+tokenize + sampling-defaults application, ``formatted_prompt`` / ``token_ids``
+annotations) and the chat-template engine under preprocessor/prompt/
+(minijinja there, jinja2 here -- both execute the HF ``chat_template``
+dialect: ``raise_exception``, ``tojson``, sandboxed).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, AsyncIterator, Dict, List, Optional, Union
+
+import jinja2
+import jinja2.sandbox
+
+from ..protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from ..protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    OpenAIError,
+    chat_chunk,
+    completion_chunk,
+    new_response_id,
+    usage_block,
+)
+from ..runtime.engine import Annotated, AsyncEngine, Context, as_response_stream
+from ..runtime.pipeline import Operator
+from .tokenizer import Tokenizer
+
+# Fallback template when the tokenizer artifact carries none: the simple
+# role-tagged layout (matches the reference's default for template-less
+# models rather than failing the request).
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message['role'] }}|>\n{{ message['content'] }}\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+
+def _raise_exception(message: str) -> None:
+    raise jinja2.exceptions.TemplateError(message)
+
+
+class PromptFormatter:
+    """Renders the HF ``chat_template`` for a message list."""
+
+    def __init__(self, tokenizer: Tokenizer) -> None:
+        self._env = jinja2.sandbox.ImmutableSandboxedEnvironment(
+            trim_blocks=True, lstrip_blocks=True
+        )
+        self._env.globals["raise_exception"] = _raise_exception
+        self._env.globals["strftime_now"] = lambda fmt: time.strftime(fmt)
+        template = tokenizer.chat_template or DEFAULT_CHAT_TEMPLATE
+        self._template = self._env.from_string(template)
+        self._bos = tokenizer.bos_token or ""
+        self._eos = tokenizer.eos_token or ""
+
+    def render(self, messages: List[Dict[str, Any]]) -> str:
+        try:
+            return self._template.render(
+                messages=messages,
+                add_generation_prompt=True,
+                bos_token=self._bos,
+                eos_token=self._eos,
+            )
+        except jinja2.exceptions.TemplateError as e:
+            raise OpenAIError(f"chat template failed: {e}") from e
+
+
+class OpenAIPreprocessor(Operator):
+    """Forward: OpenAI request -> PreprocessedRequest.  Backward: backend
+    deltas -> OpenAI chunk dicts (still wrapped in Annotated envelopes).
+
+    The downstream engine yields dicts shaped like BackendOutput: ``text``
+    (delta), ``token_ids``, ``finish_reason``.
+    """
+
+    def __init__(self, model_name: str, tokenizer: Tokenizer) -> None:
+        self.model_name = model_name
+        self.tokenizer = tokenizer
+        self.formatter = PromptFormatter(tokenizer)
+
+    # -- forward translation -------------------------------------------------
+
+    def preprocess(
+        self, req: Union[ChatCompletionRequest, CompletionRequest]
+    ) -> PreprocessedRequest:
+        if isinstance(req, ChatCompletionRequest):
+            prompt = self.formatter.render(req.messages)
+            token_ids = self.tokenizer.encode(prompt)
+        elif isinstance(req.prompt, list):
+            prompt = None
+            token_ids = list(req.prompt)
+        else:
+            prompt = req.prompt
+            token_ids = self.tokenizer.encode(prompt)
+        s = req.sampling
+        out = PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=StopConditions(
+                max_tokens=s.max_tokens,
+                stop=s.stop,
+                min_tokens=s.min_tokens,
+                ignore_eos=s.ignore_eos,
+            ),
+            sampling_options=SamplingOptions(
+                temperature=s.temperature,
+                top_p=s.top_p,
+                top_k=s.top_k,
+                frequency_penalty=s.frequency_penalty,
+                presence_penalty=s.presence_penalty,
+                seed=s.seed,
+            ),
+            eos_token_ids=self.tokenizer.eos_token_ids,
+        )
+        out.annotations = list(getattr(req, "annotations", []) or [])
+        out._formatted_prompt = prompt  # for the formatted_prompt annotation
+        return out
+
+    # -- Operator ------------------------------------------------------------
+
+    async def generate(
+        self, request: Context, next: AsyncEngine
+    ) -> AsyncIterator[Annotated]:
+        req = request.data
+        is_chat = isinstance(req, ChatCompletionRequest)
+        pre = self.preprocess(req)
+        stream = await as_response_stream(next, request.replace(pre.to_dict()))
+
+        rid = new_response_id("chatcmpl" if is_chat else "cmpl")
+        created = int(time.time())
+        model = self.model_name
+
+        async def gen() -> AsyncIterator[Annotated]:
+            # request-level annotations ride the stream ahead of data
+            # (reference preprocessor.rs:61-62)
+            if "formatted_prompt" in pre.annotations and pre._formatted_prompt:
+                yield Annotated.from_annotation(
+                    "formatted_prompt", pre._formatted_prompt
+                )
+            if "token_ids" in pre.annotations:
+                yield Annotated.from_annotation("token_ids", pre.token_ids)
+            if is_chat:
+                yield Annotated.from_data(
+                    chat_chunk(rid, model, created, role="assistant", content="")
+                )
+            completion_tokens = 0
+            finish: Optional[str] = None
+            async for item in stream:
+                if not isinstance(item, Annotated):
+                    item = Annotated.from_data(item)
+                if item.is_error():
+                    yield item
+                    return
+                data = item.data
+                if data is None:
+                    continue
+                completion_tokens += len(data.get("token_ids") or [])
+                text = data.get("text")
+                fr = data.get("finish_reason")
+                if fr:
+                    from ..protocols.common import FinishReason
+
+                    finish = FinishReason(fr).to_openai()
+                if text:
+                    if is_chat:
+                        yield Annotated.from_data(
+                            chat_chunk(rid, model, created, content=text)
+                        )
+                    else:
+                        yield Annotated.from_data(
+                            completion_chunk(rid, model, created, text=text)
+                        )
+            final = (
+                chat_chunk(rid, model, created, finish_reason=finish or "stop")
+                if is_chat
+                else completion_chunk(
+                    rid, model, created, finish_reason=finish or "stop"
+                )
+            )
+            final["usage"] = usage_block(len(pre.token_ids), completion_tokens)
+            yield Annotated.from_data(final)
+
+        return gen()

@@ -1,0 +1,120 @@
+"""Tokenizer facade: one interface over HF ``tokenizers`` artifacts.
+
+Reference parity: lib/llm/src/tokenizers.rs:83-92 (``Tokenizer`` facade over
+HF tokenizers), :158-191 (``DecodeStream`` incremental decoding).  The TPU
+build drops the GGUF leg (gguf/gguf_tokenizer.rs) -- checkpoints arrive as HF
+model directories (tokenizer.json) -- and rides the same Rust ``tokenizers``
+core through its Python binding, so token ids are bit-identical with the
+reference for the same artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+from tokenizers import Tokenizer as _HFTokenizer
+from tokenizers.decoders import DecodeStream as _HFDecodeStream
+
+
+class TokenizerError(RuntimeError):
+    pass
+
+
+class Tokenizer:
+    """Encode/decode facade bound to one model's tokenizer artifact.
+
+    Loads ``tokenizer.json`` (plus ``tokenizer_config.json`` for the chat
+    template and special tokens) from a model directory or explicit file.
+    """
+
+    def __init__(
+        self,
+        hf: _HFTokenizer,
+        *,
+        chat_template: Optional[str] = None,
+        eos_token: Optional[str] = None,
+        bos_token: Optional[str] = None,
+    ) -> None:
+        self._hf = hf
+        self.chat_template = chat_template
+        self.eos_token = eos_token
+        self.bos_token = bos_token
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def from_model_dir(cls, path: str) -> "Tokenizer":
+        tok_file = os.path.join(path, "tokenizer.json")
+        if not os.path.exists(tok_file):
+            raise TokenizerError(f"no tokenizer.json under {path}")
+        hf = _HFTokenizer.from_file(tok_file)
+        chat_template = eos = bos = None
+        cfg_file = os.path.join(path, "tokenizer_config.json")
+        if os.path.exists(cfg_file):
+            with open(cfg_file) as f:
+                cfg = json.load(f)
+            chat_template = cfg.get("chat_template")
+            eos = _token_str(cfg.get("eos_token"))
+            bos = _token_str(cfg.get("bos_token"))
+        return cls(hf, chat_template=chat_template, eos_token=eos, bos_token=bos)
+
+    @classmethod
+    def from_file(cls, tokenizer_json: str) -> "Tokenizer":
+        return cls(_HFTokenizer.from_file(tokenizer_json))
+
+    # -- special tokens ------------------------------------------------------
+
+    @property
+    def eos_token_ids(self) -> List[int]:
+        if self.eos_token is None:
+            return []
+        tid = self._hf.token_to_id(self.eos_token)
+        return [tid] if tid is not None else []
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._hf.token_to_id(token)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._hf.get_vocab_size()
+
+    # -- encode/decode -------------------------------------------------------
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> List[int]:
+        return self._hf.encode(text, add_special_tokens=add_special_tokens).ids
+
+    def decode(self, ids: List[int], skip_special_tokens: bool = True) -> str:
+        return self._hf.decode(ids, skip_special_tokens=skip_special_tokens)
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
+        return DecodeStream(self._hf, skip_special_tokens=skip_special_tokens)
+
+
+class DecodeStream:
+    """Incremental detokenizer: feed token ids one at a time, get back the
+    text delta each id completes (None while a multi-id glyph is pending).
+
+    Reference: tokenizers.rs:158-191 -- same Rust DecodeStream underneath, so
+    byte-fallback and multi-token unicode sequences flush identically.
+    """
+
+    def __init__(self, hf: _HFTokenizer, skip_special_tokens: bool = True) -> None:
+        self._hf = hf
+        self._stream = _HFDecodeStream(skip_special_tokens=skip_special_tokens)
+
+    def step(self, token_id: int) -> Optional[str]:
+        return self._stream.step(self._hf, token_id)
+
+
+def _token_str(t) -> Optional[str]:
+    """tokenizer_config.json encodes special tokens either as strings or as
+    AddedToken dicts ({"content": ...})."""
+    if t is None:
+        return None
+    if isinstance(t, str):
+        return t
+    if isinstance(t, dict):
+        return t.get("content")
+    return None

@@ -1,0 +1,287 @@
+"""OpenAI-compatible protocol types, SSE codec, and stream aggregators.
+
+Reference parity: lib/llm/src/protocols/openai/* (request/response types,
+SSE codec codec.rs, delta generators, stream->full aggregators) reduced to
+the fields the serving path consumes.  Requests arrive as JSON dicts; these
+dataclasses validate and normalize them, and the builders produce
+wire-shaped dicts for both the streaming (chunk) and aggregated (full)
+responses.
+
+``nvext``-style extension fields are kept under the same names the reference
+uses (ignore_eos, min_tokens, annotations) but accepted at the top level
+too, matching common client behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+
+class OpenAIError(ValueError):
+    """Invalid request -> HTTP 400 with an OpenAI-shaped error body."""
+
+    def __init__(self, message: str, code: int = 400) -> None:
+        super().__init__(message)
+        self.code = code
+
+    def to_body(self) -> Dict[str, Any]:
+        return {
+            "error": {
+                "message": str(self),
+                "type": "invalid_request_error",
+                "code": self.code,
+            }
+        }
+
+
+def _as_stop_list(stop: Union[None, str, List[str]]) -> Optional[List[str]]:
+    if stop is None:
+        return None
+    if isinstance(stop, str):
+        return [stop]
+    if isinstance(stop, list) and all(isinstance(s, str) for s in stop):
+        return list(stop) or None
+    raise OpenAIError("'stop' must be a string or a list of strings")
+
+
+@dataclass
+class SamplingFields:
+    """Sampling/stop fields shared by chat and completion requests."""
+
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    max_tokens: Optional[int] = None
+    min_tokens: Optional[int] = None
+    stop: Optional[List[str]] = None
+    seed: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    ignore_eos: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SamplingFields":
+        nvext = d.get("nvext") or {}
+        max_tokens = d.get("max_completion_tokens", d.get("max_tokens"))
+        out = cls(
+            temperature=d.get("temperature"),
+            top_p=d.get("top_p"),
+            top_k=d.get("top_k", nvext.get("top_k")),
+            max_tokens=max_tokens,
+            min_tokens=d.get("min_tokens", nvext.get("min_tokens")),
+            stop=_as_stop_list(d.get("stop")),
+            seed=d.get("seed"),
+            frequency_penalty=d.get("frequency_penalty"),
+            presence_penalty=d.get("presence_penalty"),
+            ignore_eos=bool(d.get("ignore_eos", nvext.get("ignore_eos", False))),
+        )
+        if out.temperature is not None and not 0.0 <= out.temperature <= 2.0:
+            raise OpenAIError("'temperature' must be in [0, 2]")
+        if out.top_p is not None and not 0.0 < out.top_p <= 1.0:
+            raise OpenAIError("'top_p' must be in (0, 1]")
+        if out.max_tokens is not None and out.max_tokens < 1:
+            raise OpenAIError("'max_tokens' must be >= 1")
+        return out
+
+
+@dataclass
+class ChatCompletionRequest:
+    """POST /v1/chat/completions body (subset the engine consumes)."""
+
+    model: str
+    messages: List[Dict[str, Any]]
+    sampling: SamplingFields
+    stream: bool = False
+    annotations: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ChatCompletionRequest":
+        model = d.get("model")
+        if not model or not isinstance(model, str):
+            raise OpenAIError("'model' is required")
+        messages = d.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise OpenAIError("'messages' must be a non-empty list")
+        for m in messages:
+            if not isinstance(m, dict) or "role" not in m:
+                raise OpenAIError("each message needs a 'role'")
+        if d.get("n") not in (None, 1):
+            raise OpenAIError("only n=1 is supported")
+        nvext = d.get("nvext") or {}
+        return cls(
+            model=model,
+            messages=messages,
+            sampling=SamplingFields.from_dict(d),
+            stream=bool(d.get("stream", False)),
+            annotations=list(nvext.get("annotations") or []),
+        )
+
+
+@dataclass
+class CompletionRequest:
+    """POST /v1/completions body."""
+
+    model: str
+    prompt: Union[str, List[int]]
+    sampling: SamplingFields
+    stream: bool = False
+    echo: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CompletionRequest":
+        model = d.get("model")
+        if not model or not isinstance(model, str):
+            raise OpenAIError("'model' is required")
+        prompt = d.get("prompt")
+        if isinstance(prompt, list) and all(isinstance(t, int) for t in prompt):
+            pass  # pre-tokenized prompt
+        elif not isinstance(prompt, str):
+            raise OpenAIError("'prompt' must be a string or a list of token ids")
+        if d.get("n") not in (None, 1):
+            raise OpenAIError("only n=1 is supported")
+        return cls(
+            model=model,
+            prompt=prompt,
+            sampling=SamplingFields.from_dict(d),
+            stream=bool(d.get("stream", False)),
+            echo=bool(d.get("echo", False)),
+        )
+
+
+# -- response builders -------------------------------------------------------
+
+
+def new_response_id(kind: str = "chatcmpl") -> str:
+    return f"{kind}-{uuid.uuid4().hex}"
+
+
+def chat_chunk(
+    response_id: str,
+    model: str,
+    created: int,
+    *,
+    content: Optional[str] = None,
+    role: Optional[str] = None,
+    finish_reason: Optional[str] = None,
+) -> Dict[str, Any]:
+    delta: Dict[str, Any] = {}
+    if role is not None:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    return {
+        "id": response_id,
+        "object": "chat.completion.chunk",
+        "created": created,
+        "model": model,
+        "choices": [
+            {"index": 0, "delta": delta, "finish_reason": finish_reason}
+        ],
+    }
+
+
+def completion_chunk(
+    response_id: str,
+    model: str,
+    created: int,
+    *,
+    text: str = "",
+    finish_reason: Optional[str] = None,
+) -> Dict[str, Any]:
+    return {
+        "id": response_id,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": [
+            {"index": 0, "text": text, "finish_reason": finish_reason}
+        ],
+    }
+
+
+def usage_block(prompt_tokens: int, completion_tokens: int) -> Dict[str, Any]:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def aggregate_chat(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a chunk stream into one chat.completion response (reference
+    aggregator, protocols/openai/chat_completions/aggregator.rs)."""
+    content: List[str] = []
+    finish = None
+    rid, model, created, usage = "", "", int(time.time()), None
+    for ch in chunks:
+        rid = ch.get("id") or rid
+        model = ch.get("model") or model
+        created = ch.get("created") or created
+        usage = ch.get("usage") or usage
+        for choice in ch.get("choices") or []:
+            delta = choice.get("delta") or {}
+            if delta.get("content"):
+                content.append(delta["content"])
+            if choice.get("finish_reason"):
+                finish = choice["finish_reason"]
+    out = {
+        "id": rid,
+        "object": "chat.completion",
+        "created": created,
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": "".join(content)},
+                "finish_reason": finish or "stop",
+            }
+        ],
+    }
+    if usage:
+        out["usage"] = usage
+    return out
+
+
+def aggregate_completion(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
+    text: List[str] = []
+    finish = None
+    rid, model, created, usage = "", "", int(time.time()), None
+    for ch in chunks:
+        rid = ch.get("id") or rid
+        model = ch.get("model") or model
+        created = ch.get("created") or created
+        usage = ch.get("usage") or usage
+        for choice in ch.get("choices") or []:
+            if choice.get("text"):
+                text.append(choice["text"])
+            if choice.get("finish_reason"):
+                finish = choice["finish_reason"]
+    out = {
+        "id": rid,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": [
+            {"index": 0, "text": "".join(text), "finish_reason": finish or "stop"}
+        ],
+    }
+    if usage:
+        out["usage"] = usage
+    return out
+
+
+# -- SSE codec ---------------------------------------------------------------
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def sse_encode(obj: Dict[str, Any]) -> bytes:
+    return b"data: " + json.dumps(obj, separators=(",", ":")).encode() + b"\n\n"
+
+
+def sse_error(message: str) -> bytes:
+    return sse_encode({"error": {"message": message, "type": "server_error"}})

@@ -47,3 +47,60 @@ def run():
         return asyncio.run(coro)
 
     return _run
+
+
+@pytest.fixture(scope="session")
+def model_dir(tmp_path_factory):
+    """A mock model directory: real (tiny) tokenizer artifact + config, no
+    weights -- the reference's sample-model fixture pattern
+    (lib/llm/tests/data/sample-models/mock-llama-3.1-8b-instruct)."""
+    import json
+
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    d = tmp_path_factory.mktemp("mock-model")
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=512, special_tokens=["<unk>", "<s>", "</s>"]
+    )
+    corpus = [
+        "hello world this is a test of the tokenizer facade",
+        "the quick brown fox jumps over the lazy dog",
+        "paged attention over a device mesh with sharded kv heads",
+        "user assistant system STOP DONE stop done tell me a story",
+        "0123456789 abcdefghijklmnopqrstuvwxyz ABCDEFGHIJKLMNOPQRSTUVWXYZ",
+        "<|user|> <|assistant|> <|system|> \n !?.,:;'\"()[]{}",
+    ]
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(
+        json.dumps(
+            {
+                "eos_token": "</s>",
+                "bos_token": "<s>",
+                "chat_template": (
+                    "{% for message in messages %}"
+                    "<|{{ message['role'] }}|>\n{{ message['content'] }}\n"
+                    "{% endfor %}"
+                    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+                ),
+            }
+        )
+    )
+    (d / "config.json").write_text(
+        json.dumps(
+            {
+                "model_type": "llama",
+                "vocab_size": tok.get_vocab_size(),
+                "hidden_size": 64,
+                "intermediate_size": 128,
+                "num_hidden_layers": 2,
+                "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+                "max_position_embeddings": 2048,
+            }
+        )
+    )
+    return str(d)

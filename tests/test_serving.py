@@ -1,0 +1,499 @@
+"""Serving front half: tokenizer facade, preprocessor, backend stop jail,
+OpenAI HTTP service end-to-end against the mocker engine.
+
+Mirrors the reference test strategy (SURVEY.md §4): http-service.rs spins a
+real server on a port with fake engines and asserts both payloads and
+Prometheus metrics; preprocessor.rs exercises template+tokenize against a
+sample-model dir fixture.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm import Backend, OpenAIPreprocessor, StopJail, Tokenizer
+from dynamo_tpu.llm.preprocessor import DEFAULT_CHAT_TEMPLATE
+from dynamo_tpu.http import HttpService, ModelManager
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    OpenAIError,
+    aggregate_chat,
+)
+from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.runtime.pipeline import link
+
+
+# -- HTTP test client --------------------------------------------------------
+
+
+async def http_request(host, port, method, path, body=None, stream=False):
+    """Minimal HTTP/1.1 client: returns (status, headers, payload).
+
+    payload is parsed JSON for full responses, or the list of SSE data
+    payloads (parsed JSON, '[DONE]' literal last) for event streams.
+    """
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        data = json.dumps(body).encode() if body is not None else b""
+        req = (
+            f"{method} {path} HTTP/1.1\r\nHost: {host}\r\n"
+            f"Content-Length: {len(data)}\r\nConnection: close\r\n"
+            "Content-Type: application/json\r\n\r\n"
+        ).encode() + data
+        writer.write(req)
+        await writer.drain()
+        head = await reader.readuntil(b"\r\n\r\n")
+        lines = head.decode().split("\r\n")
+        status = int(lines[0].split(" ")[1])
+        headers = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, _, v = line.partition(":")
+                headers[k.strip().lower()] = v.strip()
+        raw = await reader.read()
+        if headers.get("transfer-encoding") == "chunked":
+            payload = b""
+            rest = raw
+            while rest:
+                size_line, _, rest = rest.partition(b"\r\n")
+                size = int(size_line, 16)
+                if size == 0:
+                    break
+                payload += rest[:size]
+                rest = rest[size + 2 :]
+        else:
+            payload = raw
+        if headers.get("content-type", "").startswith("text/event-stream"):
+            events = []
+            for block in payload.decode().split("\n\n"):
+                for line in block.split("\n"):
+                    if line.startswith("data: "):
+                        chunk = line[len("data: ") :]
+                        events.append(
+                            "[DONE]" if chunk == "[DONE]" else json.loads(chunk)
+                        )
+            return status, headers, events
+        return status, headers, json.loads(payload) if payload else None
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+# -- tokenizer facade --------------------------------------------------------
+
+
+def test_tokenizer_roundtrip(model_dir):
+    tok = Tokenizer.from_model_dir(model_dir)
+    ids = tok.encode("hello world")
+    assert ids and tok.decode(ids) == "hello world"
+    assert tok.eos_token == "</s>"
+    assert tok.eos_token_ids == [tok.token_to_id("</s>")]
+
+
+def test_decode_stream_matches_full_decode(model_dir):
+    tok = Tokenizer.from_model_dir(model_dir)
+    text = "the quick brown fox jumps over the lazy dog"
+    ids = tok.encode(text)
+    ds = tok.decode_stream()
+    out = "".join(p for p in (ds.step(t) for t in ids) if p)
+    assert out == tok.decode(ids)
+
+
+# -- stop jail ---------------------------------------------------------------
+
+
+def test_stop_jail_holds_partial_and_releases_on_divergence():
+    j = StopJail(["STOP"])
+    text, hit = j.push("hello ST")
+    assert (text, hit) == ("hello ", False)
+    assert j.held == "ST"
+    text, hit = j.push("ory time")  # "STory time" diverges from "STOP"
+    assert (text, hit) == ("STory time", False)
+    assert j.flush() == ""
+
+
+def test_stop_jail_cuts_at_stop_string():
+    j = StopJail(["STOP"])
+    text, hit = j.push("abc STOP def")
+    assert (text, hit) == ("abc ", True)
+
+
+def test_stop_jail_across_deltas():
+    j = StopJail(["<end>"])
+    out = []
+    for d in ["hello <e", "nd> tail"]:
+        text, hit = j.push(d)
+        out.append(text)
+        if hit:
+            break
+    assert "".join(out) == "hello "
+    assert hit
+
+
+def test_stop_jail_multiple_stops_earliest_wins():
+    j = StopJail(["xx", "yy"])
+    text, hit = j.push("a yy b xx")
+    assert (text, hit) == ("a ", True)
+
+
+# -- openai protocol validation ---------------------------------------------
+
+
+def test_chat_request_validation():
+    ok = ChatCompletionRequest.from_dict(
+        {"model": "m", "messages": [{"role": "user", "content": "hi"}],
+         "stop": "x", "max_tokens": 4}
+    )
+    assert ok.sampling.stop == ["x"] and ok.sampling.max_tokens == 4
+    with pytest.raises(OpenAIError):
+        ChatCompletionRequest.from_dict({"messages": [{"role": "u"}]})
+    with pytest.raises(OpenAIError):
+        ChatCompletionRequest.from_dict({"model": "m", "messages": []})
+    with pytest.raises(OpenAIError):
+        ChatCompletionRequest.from_dict(
+            {"model": "m", "messages": [{"role": "u"}], "temperature": 9.0}
+        )
+
+
+def test_completion_request_token_prompt():
+    r = CompletionRequest.from_dict({"model": "m", "prompt": [1, 2, 3]})
+    assert r.prompt == [1, 2, 3]
+
+
+# -- preprocessor ------------------------------------------------------------
+
+
+def test_preprocessor_renders_template_and_tokenizes(model_dir):
+    tok = Tokenizer.from_model_dir(model_dir)
+    pre = OpenAIPreprocessor("m", tok)
+    req = ChatCompletionRequest.from_dict(
+        {
+            "model": "m",
+            "messages": [
+                {"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hello world"},
+            ],
+            "max_tokens": 5,
+            "temperature": 0.5,
+        }
+    )
+    out = pre.preprocess(req)
+    rendered = pre.formatter.render(req.messages)
+    assert "<|user|>" in rendered and rendered.endswith("<|assistant|>\n")
+    assert out.token_ids == tok.encode(rendered)
+    assert out.stop_conditions.max_tokens == 5
+    assert out.sampling_options.temperature == 0.5
+    assert out.eos_token_ids == tok.eos_token_ids
+
+
+def test_preprocessor_default_template_used_when_missing(model_dir):
+    tok = Tokenizer.from_model_dir(model_dir)
+    tok.chat_template = None
+    pre = OpenAIPreprocessor("m", tok)
+    rendered = pre.formatter.render([{"role": "user", "content": "x"}])
+    assert "<|user|>" in rendered  # DEFAULT_CHAT_TEMPLATE kicked in
+    assert DEFAULT_CHAT_TEMPLATE  # template constant exists and is non-empty
+
+
+# -- backend detokenizer -----------------------------------------------------
+
+
+class _ScriptEngine:
+    """Engine yielding a scripted list of token ids, one per step."""
+
+    def __init__(self, token_ids, finish=FinishReason.EOS):
+        self.token_ids = token_ids
+        self.finish = finish
+        self.stop_seen = False
+
+    async def generate(self, request):
+        ctx = request.ctx
+
+        async def gen():
+            for t in self.token_ids:
+                if ctx.is_stopped():
+                    self.stop_seen = True
+                    return
+                yield Annotated.from_data(
+                    LLMEngineOutput(token_ids=[t]).to_dict()
+                )
+                await asyncio.sleep(0)
+            yield Annotated.from_data(
+                LLMEngineOutput.finished(self.finish).to_dict()
+            )
+
+        return gen()
+
+
+def test_backend_detokenizes_stream(model_dir, run):
+    tok = Tokenizer.from_model_dir(model_dir)
+    text = "hello world this is a test"
+    ids = tok.encode(text)
+
+    async def main():
+        eng = link(Backend(tok), _ScriptEngine(ids))
+        from dynamo_tpu.protocols.common import PreprocessedRequest
+
+        stream = await eng.generate(Context.new(PreprocessedRequest(token_ids=[1])))
+        parts, finish = [], None
+        async for item in stream:
+            d = item.data or {}
+            if d.get("text"):
+                parts.append(d["text"])
+            if d.get("finish_reason"):
+                finish = d["finish_reason"]
+        return "".join(parts), finish
+
+    out, finish = run(main())
+    assert out == text
+    assert finish == "eos"
+
+
+def test_backend_stop_string_cuts_and_stops_engine(model_dir, run):
+    tok = Tokenizer.from_model_dir(model_dir)
+    ids = tok.encode("tell me a story STOP hidden tail")
+
+    async def main():
+        script = _ScriptEngine(ids)
+        eng = link(Backend(tok), script)
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest,
+            StopConditions,
+        )
+
+        req = PreprocessedRequest(
+            token_ids=[1], stop_conditions=StopConditions(stop=["STOP"])
+        )
+        stream = await eng.generate(Context.new(req))
+        parts, finish = [], None
+        async for item in stream:
+            d = item.data or {}
+            if d.get("text"):
+                parts.append(d["text"])
+            if d.get("finish_reason"):
+                finish = d["finish_reason"]
+        return "".join(parts), finish, script
+
+    out, finish, script = run(main())
+    assert "STOP" not in out and "hidden" not in out
+    assert out.startswith("tell me a story")
+    assert finish == "stop"
+
+
+# -- HTTP service e2e against the mocker ------------------------------------
+
+
+def _build_service(model_dir, model_name="mock-model"):
+    tok = Tokenizer.from_model_dir(model_dir)
+    engine = MockerEngine(
+        MockerConfig(vocab_size=max(2, tok.vocab_size - 1))
+    )
+    pipeline = link(OpenAIPreprocessor(model_name, tok), Backend(tok), engine)
+    svc = HttpService()
+    svc.manager.add_chat_model(model_name, pipeline)
+    svc.manager.add_completion_model(model_name, pipeline)
+    return svc, engine
+
+
+def test_http_chat_completion_aggregated(model_dir, run):
+    async def main():
+        svc, engine = _build_service(model_dir)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, body = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 8,
+                },
+            )
+            return status, body
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    status, body = run(main())
+    assert status == 200
+    assert body["object"] == "chat.completion"
+    choice = body["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert body["usage"]["completion_tokens"] == 8
+    assert choice["finish_reason"] == "length"
+
+
+def test_http_chat_completion_streaming_sse(model_dir, run):
+    async def main():
+        svc, engine = _build_service(model_dir)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, headers, events = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 4,
+                    "stream": True,
+                },
+            )
+            return status, headers, events
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    status, headers, events = run(main())
+    assert status == 200
+    assert headers["content-type"].startswith("text/event-stream")
+    assert events[-1] == "[DONE]"
+    chunks = [e for e in events if isinstance(e, dict)]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    assert chunks[-1]["usage"]["completion_tokens"] == 4
+    # aggregating the SSE chunks reproduces a full response
+    agg = aggregate_chat(chunks)
+    assert agg["choices"][0]["finish_reason"] == "length"
+
+
+def test_http_completions_endpoint(model_dir, run):
+    async def main():
+        svc, engine = _build_service(model_dir)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, body = await http_request(
+                host, port, "POST", "/v1/completions",
+                {"model": "mock-model", "prompt": "hello world", "max_tokens": 3},
+            )
+            return status, body
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    status, body = run(main())
+    assert status == 200
+    assert body["object"] == "text_completion"
+    assert isinstance(body["choices"][0]["text"], str)
+
+
+def test_http_unknown_model_404(model_dir, run):
+    async def main():
+        svc, engine = _build_service(model_dir)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, body = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+            )
+            return status, body
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    status, body = run(main())
+    assert status == 404
+    assert "not found" in body["error"]["message"]
+
+
+def test_http_bad_request_400(model_dir, run):
+    async def main():
+        svc, engine = _build_service(model_dir)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, body = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {"model": "mock-model", "messages": []},
+            )
+            return status, body
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    status, body = run(main())
+    assert status == 400
+
+
+def test_http_models_health_metrics(model_dir, run):
+    async def main():
+        svc, engine = _build_service(model_dir)
+        await svc.start()
+        try:
+            host, port = svc.address
+            _, _, models = await http_request(host, port, "GET", "/v1/models")
+            _, _, health = await http_request(host, port, "GET", "/health")
+            # generate one request so counters move
+            await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 2,
+                },
+            )
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(
+                f"GET /metrics HTTP/1.1\r\nHost: {host}\r\nConnection: close\r\n\r\n".encode()
+            )
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            return models, health, raw.decode()
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    models, health, metrics_text = run(main())
+    assert models["data"][0]["id"] == "mock-model"
+    assert health["status"] == "healthy"
+    assert "dynamo_http_service_requests_total" in metrics_text
+    assert 'status="success"' in metrics_text
+    assert "dynamo_http_service_time_to_first_token_seconds" in metrics_text
+
+
+def test_http_stop_string_via_full_stack(model_dir, run):
+    """Stop strings flow HTTP -> preprocessor -> backend jail."""
+
+    async def main():
+        tok = Tokenizer.from_model_dir(model_dir)
+        text = "hello world DONE tail"
+        ids = tok.encode(text)
+        pipeline = link(
+            OpenAIPreprocessor("m", tok), Backend(tok), _ScriptEngine(ids)
+        )
+        svc = HttpService()
+        svc.manager.add_chat_model("m", pipeline)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, body = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "stop": ["DONE"],
+                },
+            )
+            return status, body
+        finally:
+            await svc.stop()
+
+    status, body = run(main())
+    assert status == 200
+    content = body["choices"][0]["message"]["content"]
+    assert "DONE" not in content and "tail" not in content
+    assert content.startswith("hello world")
+    assert body["choices"][0]["finish_reason"] == "stop"
