@@ -72,6 +72,8 @@ async def run_batch(engine, prompts, max_tokens):
 async def main():
     import numpy as np
 
+    from dynamo_tpu.engine.weights import param_bytes
+
     engine = build_engine()
     rs = np.random.RandomState(0)
     prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(8)]
@@ -79,12 +81,23 @@ async def main():
     # warmup: compiles prefill bucket + decode + sampler
     await run_batch(engine, prompts, max_tokens=8)
 
+    steps0 = engine._steps
     t0 = time.monotonic()
     total = await run_batch(engine, prompts, max_tokens=128)
     elapsed = time.monotonic() - t0
-    await engine.stop()
+    steps = engine._steps - steps0
 
     tok_s = total / elapsed
+    steps_s = steps / elapsed
+    # each decode step streams ~all weights once (batch small) plus the
+    # batch's KV reads; utilization vs a v5e's ~819 GB/s HBM
+    pbytes = param_bytes(engine.params)
+    kv_bytes_per_step = 8 * 320 * engine.kv.bytes_per_page // engine.kv.page_size
+    decode_steps_s = (total / 8) / elapsed  # token rows per lane per second
+    hbm_bw = (pbytes + kv_bytes_per_step) * decode_steps_s
+    util = hbm_bw / 819e9
+    await engine.stop()
+
     baseline = 51.22  # H100 TP4 per-GPU decode tok/s (reference planner.md:86)
     print(
         json.dumps(
@@ -93,6 +106,10 @@ async def main():
                 "value": round(tok_s, 2),
                 "unit": "tok/s",
                 "vs_baseline": round(tok_s / baseline, 3),
+                "decode_steps_s": round(decode_steps_s, 2),
+                "dispatches_s": round(steps_s, 2),
+                "est_hbm_util_v5e": round(util, 4),
+                "param_bytes": pbytes,
             }
         )
     )

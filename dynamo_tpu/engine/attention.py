@@ -6,9 +6,10 @@ table ``[batch, pages_per_seq]``.  Page 0 is reserved as the trash page:
 inactive batch slots scatter their writes there, so dead lanes never corrupt
 live state and every step runs with fully static shapes (XLA requirement).
 
-These are the reference implementations; the Pallas kernel in
-``dynamo_tpu.ops.paged_attention`` replaces the decode gather path on the hot
-loop (same signature, validated against these in tests).
+These are the XLA-composed implementations (gather + einsum; XLA fuses the
+mask/softmax chain).  The decode gather materializes [B, P*page, Hkv, D]
+per step -- a Pallas kernel that streams pages through VMEM is the planned
+replacement on the hot loop once validated against these functions.
 """
 
 from __future__ import annotations
@@ -84,6 +85,52 @@ def paged_decode_attention(
     scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhk,bkhd->bhd", probs, v)
+
+
+def prefill_prefix_attention(
+    q: jax.Array,  # [B, T, Hq, D] suffix queries
+    k: jax.Array,  # [B, T, Hkv, D] suffix keys (being prefilled)
+    v: jax.Array,  # [B, T, Hkv, D]
+    layer_kv: jax.Array,  # [2, num_pages, page, Hkv, D]
+    prefix_table: jax.Array,  # [B, Pp] reused-prefix page ids (0-padded)
+    offset: jax.Array,  # [B] cached prefix length in tokens
+    suffix_lens: jax.Array,  # [B] valid suffix length
+) -> jax.Array:
+    """Suffix prefill attention with a resident prefix (prefix-cache restart).
+
+    Queries live at absolute positions ``offset + local``; keys are the
+    gathered prefix pages (positions ``0..offset``) concatenated with the
+    suffix K/V computed this dispatch.  ``Pp`` is a static page-count bucket;
+    pad slots point at trash page 0 and are masked by ``kpos < offset``.
+    """
+    B, T, Hq, D = q.shape
+    page_size = layer_kv.shape[2]
+    Pp = prefix_table.shape[1]
+    Hkv = k.shape[2]
+    n_rep = Hq // Hkv
+
+    kp = layer_kv[0][prefix_table].reshape(B, Pp * page_size, Hkv, D)
+    vp = layer_kv[1][prefix_table].reshape(B, Pp * page_size, Hkv, D)
+    keys = repeat_kv(jnp.concatenate([kp, k], axis=1), n_rep)
+    vals = repeat_kv(jnp.concatenate([vp, v], axis=1), n_rep)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
+
+    local = jnp.arange(T)
+    prefix_valid = jnp.arange(Pp * page_size)[None, :] < offset[:, None]  # [B, Kp]
+    suffix_valid = local[None, :] < suffix_lens[:, None]  # [B, T]
+    causal = local[None, :] <= local[:, None]  # [Tq, Tk]
+    mask_prefix = jnp.broadcast_to(
+        prefix_valid[:, None, None, :], (B, 1, T, Pp * page_size)
+    )
+    mask_suffix = jnp.broadcast_to(
+        causal[None, None, :, :] & suffix_valid[:, None, None, :], (B, 1, T, T)
+    )
+    mask = jnp.concatenate([mask_prefix, mask_suffix], axis=-1)
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
 
 
 def write_prefill_kv(

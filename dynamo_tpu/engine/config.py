@@ -31,6 +31,9 @@ class ModelConfig:
     # MoE (Mixtral-style); num_experts == 0 means dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # per-expert buffer headroom over perfect balance (GShard capacity
+    # factor); assignments past capacity are dropped
+    moe_capacity_factor: float = 2.0
     # activation dtype for compute; params may be stored differently
     dtype: str = "bfloat16"
 

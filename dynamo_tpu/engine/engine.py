@@ -32,6 +32,7 @@ from ..protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
+from ..block_manager import PagePool
 from ..tokens.sequence import TokenBlock
 from .config import ModelConfig
 from .kv_cache import PagedKVCache
@@ -42,8 +43,10 @@ from .step import (
     decode_block,
     inject_token,
     pick_bucket,
+    pick_page_bucket,
     prefill_and_sample,
     prefill_buckets,
+    prefill_suffix_and_sample,
 )
 
 logger = logging.getLogger("dynamo.engine")
@@ -59,6 +62,9 @@ class EngineConfig:
     # decode steps per device dispatch: decode state stays on device for this
     # many tokens, so host round trips amortize K-fold (ITL burstiness trade)
     decode_block_size: int = 16
+    # sequence-hash prefix-cache reuse (block_manager.PagePool); requires
+    # block_size to divide evenly into pages
+    enable_prefix_caching: bool = True
     # extra pages allocated per growth event so the page table (and its
     # device copy) changes every few blocks instead of every block
     grow_chunk_pages: int = 4
@@ -101,12 +107,30 @@ class JaxEngine:
         self.model_cfg = model_cfg
         self.cfg = cfg or EngineConfig()
         self.params = params
+        # KV event sink: fn(event_dict) -- wired to the router event publisher
+        self.kv_event_sink: Optional[Callable[[Dict[str, Any]], None]] = None
+        block_size = self.cfg.block_size or self.cfg.page_size
+        pool: Optional[PagePool] = None
+        if self.cfg.enable_prefix_caching:
+            if block_size % self.cfg.page_size == 0:
+                pool = PagePool(
+                    self.cfg.num_pages,
+                    pages_per_block=block_size // self.cfg.page_size,
+                    event_sink=self._emit_kv_event,
+                )
+            else:
+                logger.warning(
+                    "prefix caching disabled: block_size %d is not a "
+                    "multiple of page_size %d",
+                    block_size, self.cfg.page_size,
+                )
         self.kv = PagedKVCache(
             model_cfg,
             num_pages=self.cfg.num_pages,
             page_size=self.cfg.page_size,
             dtype=self.cfg.dtype,
             sharding=kv_sharding,
+            allocator=pool,
         )
         self.sched = Scheduler(
             SchedulerConfig(
@@ -121,6 +145,7 @@ class JaxEngine:
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._queues: Dict[str, asyncio.Queue] = {}
         self._cancelled: set = set()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._ex = concurrent.futures.ThreadPoolExecutor(
@@ -128,14 +153,16 @@ class JaxEngine:
         )
         self._running = False
         # device-resident decode state (tokens/seq_lens/active/...); rebuilt
-        # from the scheduler mirrors whenever the slot layout changes
+        # from the scheduler mirrors whenever the slot layout changes; page
+        # growth only swaps the device page table + limits (no drain)
         self._dev: Optional[Dict[str, Any]] = None
         self._dev_version = -1
+        self._dev_growth = -1
+        # host copy of the pushed limit_lens: detects capacity-paused lanes
+        self._limit_host = np.zeros((self.cfg.max_batch_size,), np.int32)
         # first tokens injected on device but not yet host-committed; a state
         # re-push must re-apply them (mirrors still hold the placeholder)
         self._pending_injects: Dict[int, InflightPrefill] = {}
-        # KV event sink: fn(event_dict) -- wired to the router event publisher
-        self.kv_event_sink: Optional[Callable[[Dict[str, Any]], None]] = None
         self._prefix_hits = 0
         self._prefix_lookups = 0
         self._steps = 0
@@ -167,6 +194,7 @@ class JaxEngine:
         if self._running:
             return
         self._running = True
+        self._loop = asyncio.get_running_loop()
         self._wake = asyncio.Event()
         self._task = asyncio.create_task(self._run(), name="jax-engine-loop")
 
@@ -291,6 +319,7 @@ class JaxEngine:
                         lookahead=2 * self.cfg.decode_block_size + 1,
                         chunk_pages=self.cfg.grow_chunk_pages,
                     )
+                self._revive_paused_lanes()
                 if pending and self._dev_version != self.sched.layout_version:
                     # A layout change forces a device-state rebuild from the
                     # host mirrors, which exclude the still-uncommitted
@@ -332,6 +361,22 @@ class JaxEngine:
                 self._pending_injects.clear()
                 self._fail_all(f"engine error: {e}")
                 await asyncio.sleep(0.01)
+
+    def _revive_paused_lanes(self) -> None:
+        """A lane that hit its device-side limit self-deactivated; if growth
+        since raised what its limit would be, force a full state rebuild so
+        the lane resumes (growth-only refreshes never touch ``active``)."""
+        sched = self.sched
+        limits = self._compute_limits()
+        for b, seq in enumerate(sched.slots):
+            if seq is None or seq.finish is not None:
+                continue
+            if (
+                int(sched.seq_lens[b]) >= int(self._limit_host[b])
+                and limits[b] > self._limit_host[b]
+            ):
+                sched.layout_version += 1
+                return
 
     def _handle_stalled_admission(self) -> None:
         """Nothing running, nothing admitted: requests whose prompts can never
@@ -383,7 +428,10 @@ class JaxEngine:
             self._cancelled.discard(rid)
             seq = by_id.get(rid)
             if seq is not None:
-                self._publish_removed(seq)
+                # with the PagePool, cancel releases refs -- registered blocks
+                # stay resident (no removed event until real eviction)
+                if self.sched.pool is None:
+                    self._publish_removed(seq)
                 self.sched.cancel(seq)
 
     # -- device work (executor thread) --------------------------------------
@@ -418,33 +466,67 @@ class JaxEngine:
     def _do_prefill(self, seq: SeqState, prompt_len: int) -> InflightPrefill:
         """Dispatch prefill + first-token sampling; inject the token into the
         device decode state.  No host round trip -- the token is committed
-        later, materialized together with the next decode block."""
-        # Prefix-cache reuse lands with the block-manager integration; until
-        # then every lookup is an honest miss (hit counter stays 0).
-        self._prefix_lookups += 1
-        self._prefix_hits += 1 if seq.cached_prompt_tokens else 0
-        bucket = pick_bucket(self.buckets, prompt_len)
-        n_pages = bucket // self.cfg.page_size
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :prompt_len] = seq.prompt
-        page_table = np.zeros((1, n_pages), np.int32)
-        # the lane may hold growth pages beyond the prompt already
-        # (loop-side ensure_decode_capacity runs before prefill dispatch);
-        # prefill writes only within the prompt's pages
-        k = min(len(seq.pages), n_pages)
-        page_table[0, :k] = seq.pages[:k]
-        seq_lens = np.asarray([prompt_len], np.int32)
+        later, materialized together with the next decode block.
 
-        sampled, self.kv.pages = prefill_and_sample(
-            self.params,
-            self.model_cfg,
-            self.kv.pages,
-            jnp.asarray(tokens),
-            jnp.asarray(seq_lens),
-            jnp.asarray(page_table),
-            self._next_rng(),
-            self._sampling_arrays([seq]),
-        )
+        With a prefix-cache hit (scheduler matched resident blocks), only the
+        prompt suffix is prefilled: queries start at position
+        ``cached_prompt_tokens`` and attend to the reused pages."""
+        # prefix-cache stats are token-weighted and counted once per request
+        # (not per re-prefill after preemption)
+        if not seq.stats_counted:
+            seq.stats_counted = True
+            self._prefix_lookups += prompt_len
+            self._prefix_hits += seq.cached_prompt_tokens
+        cached = seq.cached_prompt_tokens
+        ps = self.cfg.page_size
+        if cached > 0:
+            suffix_len = prompt_len - cached
+            bucket = pick_bucket(self.buckets, suffix_len)
+            n_suffix_pages = bucket // ps
+            n_prefix_pages = cached // ps
+            prefix_P = pick_page_bucket(n_prefix_pages, self.sched.max_pages)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :suffix_len] = seq.prompt[cached:]
+            prefix_table = np.zeros((1, prefix_P), np.int32)
+            prefix_table[0, :n_prefix_pages] = seq.pages[:n_prefix_pages]
+            suffix_table = np.zeros((1, n_suffix_pages), np.int32)
+            k = min(len(seq.pages) - n_prefix_pages, n_suffix_pages)
+            suffix_table[0, :k] = seq.pages[n_prefix_pages : n_prefix_pages + k]
+            sampled, self.kv.pages = prefill_suffix_and_sample(
+                self.params,
+                self.model_cfg,
+                self.kv.pages,
+                jnp.asarray(tokens),
+                jnp.asarray([cached], np.int32),
+                jnp.asarray([suffix_len], np.int32),
+                jnp.asarray(prefix_table),
+                jnp.asarray(suffix_table),
+                self._next_rng(),
+                self._sampling_arrays([seq]),
+            )
+        else:
+            bucket = pick_bucket(self.buckets, prompt_len)
+            n_pages = bucket // ps
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :prompt_len] = seq.prompt
+            page_table = np.zeros((1, n_pages), np.int32)
+            # the lane may hold growth pages beyond the prompt already
+            # (loop-side ensure_decode_capacity runs before prefill dispatch);
+            # prefill writes only within the prompt's pages
+            k = min(len(seq.pages), n_pages)
+            page_table[0, :k] = seq.pages[:k]
+            seq_lens = np.asarray([prompt_len], np.int32)
+
+            sampled, self.kv.pages = prefill_and_sample(
+                self.params,
+                self.model_cfg,
+                self.kv.pages,
+                jnp.asarray(tokens),
+                jnp.asarray(seq_lens),
+                jnp.asarray(page_table),
+                self._next_rng(),
+                self._sampling_arrays([seq]),
+            )
         # bring decode state current (admission bumped the layout version),
         # then inject the device-resident first token into its lane
         if self._dev is None or self._dev_version != self.sched.layout_version:
@@ -457,14 +539,14 @@ class JaxEngine:
                      seq.request_id, prompt_len, bucket)
         return pf
 
-    def _push_device_state(self) -> None:
-        """Rebuild device-resident decode state from the scheduler mirrors."""
+    def _compute_limits(self) -> np.ndarray:
+        """Absolute per-lane cache-length caps from the host mirrors.
+
+        ``seq_lens + remaining_budget`` is invariant under commits (each
+        commit raises one and lowers the other equally), so this is correct
+        even while a decode block is in flight."""
         sched = self.sched
-        B = self.cfg.max_batch_size
-        E = self.cfg.device_stop_width
-        limit = np.zeros((B,), np.int32)
-        active = np.zeros((B,), bool)
-        stop_ids = np.full((B, E), -1, np.int32)
+        limit = np.zeros((self.cfg.max_batch_size,), np.int32)
         for b, seq in enumerate(sched.slots):
             if seq is None:
                 continue
@@ -476,6 +558,19 @@ class JaxEngine:
                 # until ensure_decode_capacity frees/grows pages
                 len(seq.pages) * self.cfg.page_size,
             )
+        return limit
+
+    def _push_device_state(self) -> None:
+        """Rebuild device-resident decode state from the scheduler mirrors."""
+        sched = self.sched
+        B = self.cfg.max_batch_size
+        E = self.cfg.device_stop_width
+        limit = self._compute_limits()
+        active = np.zeros((B,), bool)
+        stop_ids = np.full((B, E), -1, np.int32)
+        for b, seq in enumerate(sched.slots):
+            if seq is None:
+                continue
             # a lane with no write headroom must not run: it would scatter
             # its next KV write to the trash page and emit a garbage token
             active[b] = limit[b] > int(sched.seq_lens[b])
@@ -487,13 +582,23 @@ class JaxEngine:
                     ids += list(seq.eos_ids)
                 for j, t in enumerate(ids[:E]):
                     stop_ids[b, j] = t
+        # COPY the scheduler mirrors with numpy (synchronous) before handing
+        # them to JAX: on CPU, jnp.asarray aliases the numpy buffer zero-copy
+        # and even jnp.array's copy can be performed asynchronously -- while
+        # the scheduler mutates these arrays in place on later ticks.  An
+        # async-dispatched decode block still queued on device would read the
+        # *future* page table and scatter a dead lane's frozen write into a
+        # page that now belongs to another sequence.  Harmless when every
+        # reallocated page is re-prefilled; fatal once prefix reuse keeps
+        # pages alive.  The .copy() is owned by JAX alone, so aliasing it is
+        # safe.
         self._dev = {
-            "tokens": jnp.asarray(sched.tokens),
-            "seq_lens": jnp.asarray(sched.seq_lens),
+            "tokens": jnp.asarray(sched.tokens.copy()),
+            "seq_lens": jnp.asarray(sched.seq_lens.copy()),
             "limit_lens": jnp.asarray(limit),
             "active": jnp.asarray(active),
             "stop_ids": jnp.asarray(stop_ids),
-            "page_table": jnp.asarray(sched.page_table),
+            "page_table": jnp.asarray(sched.page_table.copy()),
             "sampling": self._sampling_arrays(list(sched.slots)),
         }
         # mirrors hold a placeholder for lanes whose prefilled first token is
@@ -506,6 +611,8 @@ class JaxEngine:
             else:
                 del self._pending_injects[slot]
         self._dev_version = sched.layout_version
+        self._dev_growth = sched.growth_version
+        self._limit_host = limit
 
     def _dispatch_block(self) -> Optional["InflightBlock"]:
         """Enqueue one decode block; does not wait for results.
@@ -518,6 +625,19 @@ class JaxEngine:
             return None  # everything was preempted
         if self._dev is None or self._dev_version != self.sched.layout_version:
             self._push_device_state()
+        elif self._dev_growth != self.sched.growth_version:
+            # growth-only refresh: swap the page table and raise the limits,
+            # keeping tokens/seq_lens/active device-resident -- the pipeline
+            # never drains for page growth.  ``active`` is left as the device
+            # carry: raising a paused lane's limit without knowing its device
+            # seq could make it write one position past its pages; paused
+            # lanes instead revive via the full push forced below.
+            limit = self._compute_limits()
+            # numpy copy for the same aliasing reason as _push_device_state
+            self._dev["page_table"] = jnp.asarray(self.sched.page_table.copy())
+            self._dev["limit_lens"] = jnp.asarray(limit)
+            self._dev_growth = self.sched.growth_version
+            self._limit_host = limit
         d = self._dev
         (
             sampled,
@@ -574,11 +694,16 @@ class JaxEngine:
     # -- event/output dispatch (loop thread) --------------------------------
 
     def _dispatch(self, events: List[StepEvent]) -> None:
+        # with the PagePool active, stored/removed events flow from the
+        # registry itself (register/evict via _emit_kv_event), so the router
+        # index mirrors actual cache residency; the direct per-completion /
+        # per-finish publishes below are the no-pool fallback
+        pool = self.sched.pool
         for ev in events:
             queue = self._queues.get(ev.seq.request_id)
             if ev.token is not None:
                 self._tokens_generated += 1
-            if ev.completed_blocks:
+            if ev.completed_blocks and pool is None:
                 self._publish_stored(ev.seq, ev.completed_blocks)
             if queue is None:
                 continue
@@ -589,7 +714,34 @@ class JaxEngine:
                 out = LLMEngineOutput.finished(ev.finished)
                 queue.put_nowait(Annotated.from_data(out.to_dict()))
                 queue.put_nowait(None)
-                self._publish_removed(ev.seq)
+                if pool is None:
+                    self._publish_removed(ev.seq)
+
+    def _emit_kv_event(self, event: Dict[str, Any]) -> None:
+        """PagePool event_sink -> the externally-wired kv_event_sink.
+
+        Registration fires inside commit calls on the executor thread while
+        eviction fires on the loop thread; sinks (KvEventPublisher.emit uses
+        an asyncio.Queue) are not thread-safe, so off-loop emissions hop to
+        the engine's event loop."""
+        sink = self.kv_event_sink
+        if sink is None:
+            return
+        loop = self._loop
+        if loop is None:
+            sink(event)
+            return
+        try:
+            on_loop = asyncio.get_running_loop() is loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            sink(event)
+        else:
+            try:
+                loop.call_soon_threadsafe(sink, event)
+            except RuntimeError:
+                pass  # loop already closed during shutdown
 
     def _publish_stored(self, seq: SeqState, blocks: List[TokenBlock]) -> None:
         if self.kv_event_sink is None:

@@ -23,11 +23,8 @@ from typing import Any, List, Optional
 import jax
 import jax.numpy as jnp
 
+from ..block_manager import OutOfPages
 from .config import ModelConfig
-
-
-class OutOfPages(RuntimeError):
-    pass
 
 
 class PageAllocator:
@@ -70,12 +67,15 @@ class PagedKVCache:
         page_size: int = 16,
         dtype: Any = None,
         sharding: Optional[jax.sharding.Sharding] = None,
+        allocator: Optional[Any] = None,
     ) -> None:
         self.cfg = cfg
         self.num_pages = num_pages
         self.page_size = page_size
         self.dtype = jnp.dtype(dtype or cfg.dtype)
-        self.allocator = PageAllocator(num_pages)
+        # default is the plain free list; the engine passes a PagePool
+        # (block_manager) to get the sequence-hash reuse registry
+        self.allocator = allocator if allocator is not None else PageAllocator(num_pages)
         shape = (
             cfg.num_layers,
             2,

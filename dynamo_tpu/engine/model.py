@@ -127,13 +127,10 @@ def _dense_mlp(lp: Params, x: jax.Array) -> jax.Array:
     return (gate * (x @ lp["w_up"])) @ lp["w_down"]
 
 
-def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """Mixtral-style sparse MoE via one-hot dispatch einsums.
-
-    Correct and jit-friendly at any scale; the EP-sharded all_to_all path
-    (dynamo_tpu.parallel) replaces the dispatch when an ``expert`` mesh axis
-    is present.
-    """
+def _moe_mlp_dense(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Reference dense-dispatch MoE: every expert computes every token,
+    weighted combine.  O(E*N) compute -- kept only as the ground truth the
+    sparse dispatch is validated against in tests."""
     orig_shape = x.shape
     H = orig_shape[-1]
     xf = x.reshape(-1, H)  # [N, H]
@@ -142,11 +139,69 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     topw = jax.nn.softmax(topw, axis=-1).astype(x.dtype)  # [N, K]
     one_hot = jax.nn.one_hot(topi, cfg.num_experts, dtype=x.dtype)  # [N, K, E]
     combine = jnp.einsum("nk,nke->ne", topw, one_hot)  # [N, E]
-    # dense dispatch: every expert sees every token, weighted combine.
     gate = jax.nn.silu(jnp.einsum("nh,ehi->eni", xf, lp["w_gate"]))
     up = jnp.einsum("nh,ehi->eni", xf, lp["w_up"])
     down = jnp.einsum("eni,eih->enh", gate * up, lp["w_down"])  # [E, N, H]
     out = jnp.einsum("enh,ne->nh", down, combine)
+    return out.reshape(orig_shape)
+
+
+def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Capacity-based sparse MoE dispatch (GShard/Switch pattern).
+
+    Tokens are routed top-k, packed into fixed [E, C, H] per-expert buffers
+    (C = capacity), each expert runs a batched matmul over its buffer, and
+    the combine scatters results back weighted by the router.  Compute is
+    O(N*K*capacity_factor) instead of dense-dispatch O(N*E), shapes are
+    static (jit), and the leading E axis of the buffers/weights shards over
+    the ``ep`` mesh axis -- GSPMD turns the pack/unpack into an all_to_all
+    over ICI (SURVEY.md 2.8: EP is first-party here, engine-internal in the
+    reference).
+
+    Assignments that overflow an expert's capacity are dropped (their
+    combine weight contributes nothing), the standard GShard behavior; the
+    default capacity factor leaves headroom so drops need an adversarially
+    skewed batch.
+    """
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    E = cfg.num_experts
+    K = cfg.num_experts_per_tok
+    xf = x.reshape(-1, H)  # [N, H]
+    N = xf.shape[0]
+
+    router_logits = (xf @ lp["router"]).astype(jnp.float32)  # [N, E]
+    topw, topi = jax.lax.top_k(router_logits, K)
+    topw = jax.nn.softmax(topw, axis=-1).astype(x.dtype)  # [N, K]
+
+    # capacity per expert: perfect balance is N*K/E; leave headroom
+    C = int(max(1, -(-N * K * cfg.moe_capacity_factor // E)))
+    C = min(C, N * K)
+
+    flat_expert = topi.reshape(-1)  # [N*K] expert id per assignment
+    flat_w = topw.reshape(-1)  # [N*K]
+    token_of = jnp.arange(N * K, dtype=jnp.int32) // K  # [N*K]
+
+    # slot of each assignment within its expert's buffer (stable order)
+    onehot = jax.nn.one_hot(flat_expert, E, dtype=jnp.int32)  # [NK, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # running count where routed
+    slot = jnp.sum(pos, axis=1) - 1  # [N*K]
+    keep = slot < C
+    dispatch = jnp.where(keep, flat_expert * C + slot, E * C)  # OOB = drop
+
+    buf = jnp.zeros((E * C, H), xf.dtype)
+    buf = buf.at[dispatch].set(xf[token_of], mode="drop")
+    buf = buf.reshape(E, C, H)
+
+    gate = jax.nn.silu(jnp.einsum("ech,ehi->eci", buf, lp["w_gate"]))
+    up = jnp.einsum("ech,ehi->eci", buf, lp["w_up"])
+    down = jnp.einsum("eci,eih->ech", gate * up, lp["w_down"])  # [E, C, H]
+
+    per_assign = down.reshape(E * C, H).at[jnp.minimum(dispatch, E * C - 1)].get(
+        mode="fill", fill_value=0
+    )  # [N*K, H]
+    per_assign = per_assign * (flat_w * keep.astype(flat_w.dtype))[:, None]
+    out = jax.ops.segment_sum(per_assign, token_of, num_segments=N)
     return out.reshape(orig_shape)
 
 

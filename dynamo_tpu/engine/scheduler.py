@@ -117,6 +117,15 @@ class Scheduler:
         self.cfg = cfg
         self.allocator = allocator
         self.block_size = cfg.block_size or cfg.page_size
+        # prefix-cache reuse runs when the allocator is a PagePool (has a
+        # sequence-hash registry) and router blocks align to whole pages
+        self.pool: Optional[PagePool] = (
+            allocator
+            if isinstance(allocator, PagePool)
+            and self.block_size % cfg.page_size == 0
+            else None
+        )
+        self.pages_per_block = self.block_size // cfg.page_size
         B = cfg.max_batch_size
         self.max_pages = cfg.max_seq_len // cfg.page_size
         self.waiting: Deque[SeqState] = collections.deque()
@@ -125,9 +134,13 @@ class Scheduler:
         self.tokens = np.zeros((B,), np.int32)
         self.seq_lens = np.zeros((B,), np.int32)
         self.page_table = np.zeros((B, self.max_pages), np.int32)
-        # bumped whenever slot membership or the page table changes; the
-        # engine re-pushes device-resident decode state when it moves
+        # layout_version: slot membership changed (admission / release /
+        # preemption) -- the engine must drain its pipeline and rebuild the
+        # full device state.  growth_version: pages were appended to live
+        # lanes -- the engine only refreshes the device page table and
+        # limits, keeping the decode pipeline running.
         self.layout_version = 0
+        self.growth_version = 0
 
     # -- queue/observability -------------------------------------------------
 
@@ -144,6 +157,8 @@ class Scheduler:
         return self.num_active > 0 or len(self.waiting) > 0
 
     def enqueue(self, seq: SeqState) -> None:
+        if not seq.prompt:
+            raise ValueError("empty prompt (zero tokens after preprocessing)")
         if len(seq.prompt) > self.cfg.max_seq_len:
             raise ValueError(
                 f"prompt of {len(seq.prompt)} tokens exceeds max_seq_len "
@@ -186,20 +201,59 @@ class Scheduler:
             if slot is None:
                 break
             seq = self.waiting[0]
+            cached_pages = self._match_prefix(seq)
             n_pages = -(-len(seq.prompt) // self.cfg.page_size)
             # admission needs room for the prompt *and* the first decode
-            # write, with one page of headroom per active seq for growth
-            need = self.min_total_pages(seq)
+            # write, with one page of headroom per active seq for growth;
+            # reused prefix pages are already resident and cost nothing
+            need = self.min_total_pages(seq) - len(cached_pages)
             if self.allocator.free_pages < need + self.num_active:
+                self._unmatch_prefix(seq)
                 break
             self.waiting.popleft()
-            seq.pages = self.allocator.alloc(n_pages)
+            seq.owned_pages = self.allocator.alloc(n_pages - len(cached_pages))
+            seq.pages = cached_pages + list(seq.owned_pages)
             seq.slot = slot
             self.slots[slot] = seq
             self._write_slot_arrays(seq)
+            self._queue_prompt_registrations(seq)
             plan.prefills.append((seq, len(seq.prompt)))
         plan.run_decode = self.num_active > 0
         return plan
+
+    def _match_prefix(self, seq: SeqState) -> List[int]:
+        """Acquire the longest resident prefix of the prompt's blocks; returns
+        the reused pages (front of the page table).  Reuse is capped below the
+        full prompt so prefill always has at least one token to process."""
+        seq.cached_prompt_tokens = 0
+        if self.pool is None or seq.blocks is None:
+            return []
+        max_blocks = max(0, (len(seq.prompt) - 1) // self.block_size)
+        matched = self.pool.match(seq.blocks.sequence_hashes()[:max_blocks])
+        pages: List[int] = []
+        for blk in matched:
+            got = self.pool.acquire(blk.sequence_hash)
+            if got is None:  # raced away (defensive; single-threaded today)
+                break
+            seq.held_blocks.append(blk.sequence_hash)
+            pages.extend(blk.pages)
+        seq.cached_prompt_tokens = len(seq.held_blocks) * self.block_size
+        return pages
+
+    def _unmatch_prefix(self, seq: SeqState) -> None:
+        for h in seq.held_blocks:
+            self.pool.release(h)
+        seq.held_blocks = []
+        seq.cached_prompt_tokens = 0
+
+    def _queue_prompt_registrations(self, seq: SeqState) -> None:
+        """Prompt blocks beyond the reused prefix register once prefill's KV
+        writes are committed (the catch-up in ``_register_ready``)."""
+        if self.pool is None or seq.blocks is None:
+            return
+        n_reused = seq.cached_prompt_tokens // self.block_size
+        n_prompt_blocks = len(seq.prompt) // self.block_size
+        seq.pending_register = list(seq.blocks.blocks[n_reused:n_prompt_blocks])
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self.slots):
@@ -266,8 +320,9 @@ class Scheduler:
                     preempted.append(victim)
                     continue
                 seq.pages.append(page)
+                seq.owned_pages.append(page)
                 self.page_table[seq.slot, len(seq.pages) - 1] = page
-                self.layout_version += 1
+                self.growth_version += 1
         return preempted
 
     def _pick_preemption_victim(self) -> Optional[SeqState]:
@@ -302,9 +357,21 @@ class Scheduler:
             self.seq_lens[b] = 0
             self.tokens[b] = 0
             self.layout_version += 1
-        if seq.pages:
+        # registered blocks outlive the sequence (refcount drops; the block
+        # turns inactive-reusable at zero); only exclusively-owned pages and
+        # never-registered completions return to the free list
+        if self.pool is not None:
+            self.allocator.free(seq.owned_pages)
+            for h in seq.held_blocks:
+                self.pool.release(h)
+            seq.held_blocks = []
+            seq.pending_register = []
+            seq.pages = []
+            seq.owned_pages = []
+        elif seq.pages:
             self.allocator.free(seq.pages)
             seq.pages = []
+            seq.owned_pages = []
 
     # -- per-token postprocessing -------------------------------------------
 
@@ -398,6 +465,9 @@ class Scheduler:
         # written by the upcoming decode step at exactly this position
         # (decode_step positions = seq_lens).
         self.seq_lens[b] = seq.seq_len - 1
+        if self.pool is not None:
+            seq.pending_register.extend(completed)
+            self._register_ready(seq)
 
         finished: Optional[FinishReason] = None
         if stop.max_tokens is not None and n_gen >= stop.max_tokens:
@@ -407,6 +477,41 @@ class Scheduler:
         return StepEvent(
             seq=seq, token=token, finished=finished, completed_blocks=completed
         )
+
+    def _register_ready(self, seq: SeqState) -> None:
+        """Register completed blocks whose KV is fully written.
+
+        A block ending at token position ``end`` is committable once the
+        cache length reaches ``end``: the decode step that consumed the
+        block's final token wrote its KV (commit implies the write was
+        dispatched, and the device executes dispatches in order, so any
+        later prefill that reuses the block reads it complete).
+        """
+        cache_len = int(self.seq_lens[seq.slot])
+        ppb = self.pages_per_block
+        while seq.pending_register:
+            blk = seq.pending_register[0]
+            end = (blk.position + 1) * self.block_size
+            if end > cache_len:
+                break
+            seq.pending_register.pop(0)
+            start = blk.position * ppb
+            pages = seq.pages[start : start + ppb]
+            if len(pages) < ppb:
+                break  # table shorter than the block span (defensive)
+            if self.pool.register(
+                blk.sequence_hash,
+                pages,
+                block_hash=blk.block_hash,
+                parent_sequence_hash=blk.parent_sequence_hash,
+                position=blk.position,
+            ):
+                # ownership moves to the registry; this seq keeps a ref
+                seq.held_blocks.append(blk.sequence_hash)
+                for p in pages:
+                    seq.owned_pages.remove(p)
+            # register() == False: identical block already registered by a
+            # concurrent twin; keep plain ownership of our duplicate pages
 
     def cancel(self, seq: SeqState) -> None:
         if seq.slot >= 0:

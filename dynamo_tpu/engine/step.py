@@ -165,6 +165,41 @@ def prefill_and_sample(
     return sample_tokens(logits, rng, sampling), kv_pages
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
+def prefill_suffix_and_sample(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,
+    tokens: jax.Array,  # [B, T] bucket-padded suffix tokens
+    offset: jax.Array,  # [B] cached prefix length (page-aligned)
+    suffix_lens: jax.Array,  # [B] true suffix length
+    prefix_table: jax.Array,  # [B, Pp] reused-prefix pages (bucketed, 0-padded)
+    suffix_table: jax.Array,  # [B, T//page_size] pages the suffix writes into
+    rng: jax.Array,
+    sampling: SamplingParams,
+) -> Tuple[jax.Array, jax.Array]:
+    """Prefix-cache restart: prefill only the suffix, attending to the
+    resident prefix pages; sample the first token (engine-side prefix reuse,
+    reference block_manager/pool.rs match + vLLM prefix caching semantics).
+
+    Returns (sampled [B], kv)."""
+    B, T = tokens.shape
+    positions = offset[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    def attn_fn(q, k, v, layer_kv):
+        out = att.prefill_prefix_attention(
+            q, k, v, layer_kv, prefix_table, offset, suffix_lens
+        )
+        new_kv = att.write_prefill_kv(layer_kv, k, v, suffix_table)
+        return out, new_kv
+
+    hidden, kv_pages = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)
+    last = jnp.clip(suffix_lens - 1, 0, T - 1)
+    hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
+    logits = lm_logits(params, cfg, hidden_last)
+    return sample_tokens(logits, rng, sampling), kv_pages
+
+
 @partial(jax.jit, donate_argnames=("tokens",))
 def inject_token(tokens: jax.Array, slot: jax.Array, token: jax.Array) -> jax.Array:
     """Scatter a freshly-prefilled lane's first token into the device-resident
@@ -189,3 +224,14 @@ def pick_bucket(buckets: list, n: int) -> int:
         if n <= b:
             return b
     raise ValueError(f"prompt length {n} exceeds max bucket {buckets[-1]}")
+
+
+def pick_page_bucket(n_pages: int, max_pages: int) -> int:
+    """Static width for the prefix page gather: smallest power of two
+    >= n_pages (capped at max_pages), so compile-cache entries stay few."""
+    if n_pages > max_pages:
+        raise ValueError(f"{n_pages} prefix pages exceed max {max_pages}")
+    b = 1
+    while b < n_pages:
+        b *= 2
+    return min(b, max_pages)

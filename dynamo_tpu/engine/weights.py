@@ -2,16 +2,20 @@
 
 Maps HuggingFace llama/mistral/qwen2/mixtral parameter names onto the
 stacked ``[num_layers, ...]`` layout of dynamo_tpu.engine.model, transposing
-torch ``[out, in]`` linears to ``[in, out]``.  Loads shard-by-shard to bound
-host memory; each leaf is placed onto its target sharding as it is built
-(weights stream straight to device, never materializing twice on host).
+torch ``[out, in]`` linears to ``[in, out]``.
+
+Memory discipline: tensors are read lazily (mmap, on demand) from the open
+safetensors shards and each stacked leaf is filled into one preallocated
+host buffer, then placed onto its target sharding.  Peak host residency is
+bounded by the largest single leaf (one stacked parameter across layers),
+not the checkpoint -- a 70B load never materializes all weights on host.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +23,29 @@ import numpy as np
 
 from .config import ModelConfig
 from .model import Params
+
+
+class _ShardIndex:
+    """Lazy name->tensor view over a set of safetensors files.
+
+    Tensors are read on demand and never cached here, so the host only ever
+    holds what the caller is currently assembling.
+    """
+
+    def __init__(self, files: List[str]) -> None:
+        from safetensors import safe_open
+
+        self._handles = [safe_open(p, framework="np") for p in files]
+        self._where: Dict[str, Any] = {}
+        for h in self._handles:
+            for name in h.keys():
+                self._where[name] = h
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._where
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._where[name].get_tensor(name)
 
 
 def load_safetensors_params(
@@ -32,8 +59,6 @@ def load_safetensors_params(
     ``shardings`` optionally maps pytree paths (e.g. ``layers/wq``) to
     ``NamedSharding``; leaves are device_put as they are assembled.
     """
-    from safetensors import safe_open
-
     dtype = jnp.dtype(dtype or cfg.dtype)
     files = sorted(
         os.path.join(model_path, f)
@@ -42,23 +67,17 @@ def load_safetensors_params(
     )
     if not files:
         raise FileNotFoundError(f"no .safetensors files under {model_path}")
-
-    raw: Dict[str, np.ndarray] = {}
-    for path in files:
-        with safe_open(path, framework="np") as f:
-            for name in f.keys():
-                raw[name] = f.get_tensor(name)
-
-    return assemble_params(raw, cfg, dtype, shardings)
+    return assemble_params(_ShardIndex(files), cfg, dtype, shardings)
 
 
 def assemble_params(
-    raw: Dict[str, np.ndarray],
+    raw: Any,
     cfg: ModelConfig,
     dtype: Any,
     shardings: Optional[Dict[str, Any]] = None,
 ) -> Params:
-    """Assemble the stacked pytree from a flat HF name->array dict."""
+    """Assemble the stacked pytree from a flat HF name->array mapping
+    (a dict, or the lazy ``_ShardIndex``)."""
     L = cfg.num_layers
 
     def get(name: str) -> np.ndarray:
@@ -75,8 +94,16 @@ def assemble_params(
             x = jax.device_put(x, shardings[path])
         return x
 
-    def stack(path: str, per_layer: List[np.ndarray]) -> jax.Array:
-        return put(path, np.stack(per_layer, axis=0))
+    def stack(path: str, layer_fn: Callable[[int], np.ndarray]) -> jax.Array:
+        """Fill one preallocated [L, ...] buffer layer by layer (streaming:
+        at most one layer's tensor plus the leaf buffer live on host)."""
+        first = layer_fn(0)
+        out = np.empty((L,) + first.shape, first.dtype)
+        out[0] = first
+        del first
+        for i in range(1, L):
+            out[i] = layer_fn(i)
+        return put(path, out)
 
     pre = "model."
     layers: Dict[str, Any] = {}
@@ -89,7 +116,7 @@ def assemble_params(
     for key, suffix in attn.items():
         layers[key] = stack(
             f"layers/{key}",
-            [linear(f"{pre}layers.{i}.{suffix}") for i in range(L)],
+            lambda i, s=suffix: linear(f"{pre}layers.{i}.{s}"),
         )
     if cfg.attention_bias:
         for key, suffix in (
@@ -98,15 +125,16 @@ def assemble_params(
             ("bv", "self_attn.v_proj.bias"),
         ):
             layers[key] = stack(
-                f"layers/{key}", [get(f"{pre}layers.{i}.{suffix}") for i in range(L)]
+                f"layers/{key}",
+                lambda i, s=suffix: get(f"{pre}layers.{i}.{s}"),
             )
     layers["input_norm"] = stack(
         "layers/input_norm",
-        [get(f"{pre}layers.{i}.input_layernorm.weight") for i in range(L)],
+        lambda i: get(f"{pre}layers.{i}.input_layernorm.weight"),
     )
     layers["post_norm"] = stack(
         "layers/post_norm",
-        [get(f"{pre}layers.{i}.post_attention_layernorm.weight") for i in range(L)],
+        lambda i: get(f"{pre}layers.{i}.post_attention_layernorm.weight"),
     )
 
     if cfg.is_moe:
@@ -114,21 +142,18 @@ def assemble_params(
         moe = "block_sparse_moe"
         layers["router"] = stack(
             "layers/router",
-            [linear(f"{pre}layers.{i}.{moe}.gate.weight") for i in range(L)],
+            lambda i: linear(f"{pre}layers.{i}.{moe}.gate.weight"),
         )
         # Mixtral: w1 = gate, w3 = up, w2 = down
         for key, w in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
             layers[key] = stack(
                 f"layers/{key}",
-                [
-                    np.stack(
-                        [
-                            linear(f"{pre}layers.{i}.{moe}.experts.{e}.{w}.weight")
-                            for e in range(E)
-                        ]
-                    )
-                    for i in range(L)
-                ],
+                lambda i, w=w: np.stack(
+                    [
+                        linear(f"{pre}layers.{i}.{moe}.experts.{e}.{w}.weight")
+                        for e in range(E)
+                    ]
+                ),
             )
     else:
         for key, name in (
@@ -138,7 +163,7 @@ def assemble_params(
         ):
             layers[key] = stack(
                 f"layers/{key}",
-                [linear(f"{pre}layers.{i}.mlp.{name}.weight") for i in range(L)],
+                lambda i, n=name: linear(f"{pre}layers.{i}.mlp.{n}.weight"),
             )
 
     params: Params = {

@@ -8,6 +8,9 @@ Axes (scaling-book conventions):
   all-reduce rides ICI.
 - ``pp``   -- pipeline parallel over layer groups (cross-host).
 - ``sp``   -- sequence/context parallel (ring attention) for long context.
+- ``ep``   -- expert parallel: MoE expert weights and dispatch buffers
+  sharded over experts; the token shuffle rides ICI (GSPMD inserts the
+  all_to_all from the sharding annotations).
 
 ``build_mesh`` lays axes out so that tp is innermost (fastest-varying
 device order = closest ICI neighbors), matching how XLA enumerates cores in
@@ -30,13 +33,14 @@ class MeshConfig:
     tp: int = 1
     pp: int = 1
     sp: int = 1
+    ep: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp * self.pp * self.sp
+        return self.dp * self.tp * self.pp * self.sp * self.ep
 
     def axis_names(self) -> List[str]:
-        return ["dp", "pp", "sp", "tp"]
+        return ["dp", "pp", "sp", "ep", "tp"]
 
 
 def build_mesh(
@@ -48,7 +52,7 @@ def build_mesh(
             f"mesh needs {cfg.num_devices} devices, have {len(devices)}"
         )
     devices = devices[: cfg.num_devices]
-    arr = np.asarray(devices).reshape(cfg.dp, cfg.pp, cfg.sp, cfg.tp)
+    arr = np.asarray(devices).reshape(cfg.dp, cfg.pp, cfg.sp, cfg.ep, cfg.tp)
     return Mesh(arr, axis_names=tuple(cfg.axis_names()))
 
 

@@ -8,8 +8,8 @@ collectives -- SURVEY.md 5.8 "engine-internal collectives -> XLA over ICI"):
 - MLP gate/up column-parallel, down row-parallel -> one all-reduce per MLP;
 - KV pages sharded over kv_heads so each tp shard attends its own heads
   with zero cross-chip traffic on the decode hot path;
-- MoE expert weights sharded over the experts axis (``tp`` doubles as the
-  expert axis until a dedicated ``ep`` axis is configured).
+- MoE expert weights sharded over the ``ep`` axis (experts per device
+  group), with column/row TP inside each expert.
 
 All specs carry the leading ``num_layers`` axis unsharded (layers are
 scanned, not distributed; pipeline parallel splits the scan instead).
@@ -45,10 +45,11 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
         specs["layers/bk"] = P(None, "tp")
         specs["layers/bv"] = P(None, "tp")
     if cfg.is_moe:
+        # experts over ep; within an expert, classic column/row TP
         specs["layers/router"] = P(None, None, None)
-        specs["layers/w_gate"] = P(None, "tp", None, None)
-        specs["layers/w_up"] = P(None, "tp", None, None)
-        specs["layers/w_down"] = P(None, "tp", None, None)
+        specs["layers/w_gate"] = P(None, "ep", None, "tp")
+        specs["layers/w_up"] = P(None, "ep", None, "tp")
+        specs["layers/w_down"] = P(None, "ep", "tp", None)
     else:
         specs["layers/w_gate"] = P(None, None, "tp")
         specs["layers/w_up"] = P(None, None, "tp")

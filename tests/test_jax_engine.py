@@ -389,3 +389,32 @@ def test_preemption_respects_max_tokens_total():
     assert ev.finished is None
     ev = sched._commit_token(seq, 7)
     assert ev.finished == FinishReason.LENGTH  # 3 + 3 == max_tokens
+
+
+def test_device_state_never_aliases_scheduler_mirrors(run):
+    """The device-side decode state must be a COPY of the host mirrors: on
+    CPU, jnp.asarray aliases numpy buffers zero-copy, and the scheduler
+    mutates its mirrors in place -- an async in-flight decode block reading
+    a mutated page table scatters stale writes into pages that now belong
+    to another sequence (corrupting reused prefix pages).  Regression test
+    for that aliasing."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            sched = engine.sched
+            sched.tokens[0] = 11
+            sched.seq_lens[0] = 3
+            sched.page_table[0, 0] = 7
+            engine._push_device_state()
+            # in-place mirror mutation (what plan()/commit do on later ticks)
+            sched.tokens[0] = 99
+            sched.seq_lens[0] = 9
+            sched.page_table[0, 0] = 42
+            assert int(engine._dev["tokens"][0]) == 11
+            assert int(engine._dev["seq_lens"][0]) == 3
+            assert int(engine._dev["page_table"][0, 0]) == 7
+        finally:
+            await engine.stop()
+
+    run(body())
