@@ -21,7 +21,12 @@ import logging
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 from ...protocols.common import PreprocessedRequest
-from ...runtime.component import Component, Namespace, PushRouter
+from ...runtime.component import (
+    Component,
+    InstanceNotFoundError,
+    Namespace,
+    PushRouter,
+)
 from ...runtime.engine import Annotated, Context, ResponseStream
 from ...tokens.hashing import hash_blocks
 from .indexer import KvIndexer, OverlapScores
@@ -31,6 +36,7 @@ from .scheduler import DefaultWorkerSelector, KvRouterConfig, KvScheduler
 logger = logging.getLogger("dynamo.kv_router")
 
 KV_EVENT_SUBJECT = "kv_events"  # rides {ns}.events.kv_events
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"  # reference kv_router.rs:44
 
 
 class KvRouter:
@@ -61,12 +67,16 @@ class KvRouter:
         )
         self._sub = None
         self._sub_task: Optional[asyncio.Task] = None
+        self._publish_tasks: set = set()
 
     async def start(self) -> None:
         self._sub = await self.namespace.subscribe(KV_EVENT_SUBJECT)
         self._sub_task = asyncio.create_task(
             self._consume_events(), name="kv-router-events"
         )
+        # per-selection hit-rate telemetry -> {ns}.events.kv-hit-rate
+        # (reference scheduler.rs:104); consumed by the metrics component
+        self.scheduler.on_hit_rate = self._publish_hit_rate
         await self.aggregator.start()
 
     async def stop(self) -> None:
@@ -78,6 +88,25 @@ class KvRouter:
         if self._sub is not None:
             await self._sub.close()
         await self.aggregator.stop()
+
+    def _publish_hit_rate(self, ev) -> None:
+        payload = {
+            "worker_id": ev.worker_id,
+            "isl_blocks": ev.isl_blocks,
+            "overlap_blocks": ev.overlap_blocks,
+        }
+
+        async def _send() -> None:
+            try:
+                await self.namespace.publish(KV_HIT_RATE_SUBJECT, payload)
+            except Exception:
+                logger.debug("kv-hit-rate publish failed", exc_info=True)
+
+        # hold a strong reference until done: a bare ensure_future() task
+        # can be garbage-collected mid-await, silently dropping the event
+        task = asyncio.ensure_future(_send())
+        self._publish_tasks.add(task)
+        task.add_done_callback(self._publish_tasks.discard)
 
     def _on_worker_removed(self, worker_id: int) -> None:
         # the aggregator already dropped it from the shared endpoint
@@ -119,19 +148,33 @@ class KvPushRouter:
             token_ids = data.token_ids
         else:
             token_ids = list((data or {}).get("token_ids") or [])
+        def stamp(overlap_blocks: int) -> Context[Any]:
+            if isinstance(data, PreprocessedRequest):
+                data.estimated_prefix_hit_num_blocks = overlap_blocks
+                return request
+            return request.replace(
+                dict(data or {}, estimated_prefix_hit_num_blocks=overlap_blocks)
+            )
+
         try:
             instance_id, overlap = await self.chooser.find_best_match(token_ids)
-            if isinstance(data, PreprocessedRequest):
-                data.estimated_prefix_hit_num_blocks = overlap
-                stamped = request
-            else:
-                stamped = request.replace(
-                    dict(data or {}, estimated_prefix_hit_num_blocks=overlap)
-                )
-            return await self.inner.direct(stamped, instance_id)
         except Exception:
-            # no metrics yet, no workers known to the scheduler, or a stale
-            # selection (worker died between scrapes): degrade to plain load
-            # balancing over the live instances rather than failing
+            # no metrics yet / no workers known to the scheduler: degrade to
+            # plain load balancing over the live instances rather than failing
             logger.debug("kv selection failed; falling back", exc_info=True)
             return await self.inner.generate(request)
+        try:
+            return await self.inner.direct(stamp(overlap), instance_id)
+        except (InstanceNotFoundError, ConnectionRefusedError):
+            # retryable dispatch failures are exactly those where the
+            # request provably never left this process: a stale selection
+            # (instance gone from the live set) or a refused connect (the
+            # worker died before the lease expired).  Anything later must
+            # propagate -- re-dispatching after the worker may have started
+            # executing would run the request twice.  Clear the overlap
+            # estimate: it described the dead worker's cache, not whoever
+            # the fallback picks.
+            logger.debug(
+                "selected instance %x vanished; falling back", instance_id
+            )
+            return await self.inner.generate(stamp(0))

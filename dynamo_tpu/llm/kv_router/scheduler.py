@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ...protocols.common import ForwardPassMetrics
 from .indexer import OverlapScores
@@ -120,6 +120,9 @@ class KvScheduler:
         self.selector = selector or DefaultWorkerSelector()
         self.workers = ProcessedEndpoints()
         self.hit_rate_events: List[KVHitRateEvent] = []
+        # per-selection sink; the KvRouter wires this to publish on the
+        # {ns}.events.kv-hit-rate subject (reference scheduler.rs:31-36,104)
+        self.on_hit_rate: Optional[Callable[[KVHitRateEvent], None]] = None
 
     def update_metrics(self, worker_id: int, metrics: ForwardPassMetrics) -> None:
         self.workers.update(worker_id, metrics)
@@ -149,12 +152,16 @@ class KvScheduler:
                 m.gpu_cache_usage_perc = min(
                     m.kv_active_blocks / m.kv_total_blocks, 1.0
                 )
-        self.hit_rate_events.append(
-            KVHitRateEvent(
-                worker_id=worker_id,
-                isl_blocks=required_blocks,
-                overlap_blocks=overlap_blocks,
-            )
+        ev = KVHitRateEvent(
+            worker_id=worker_id,
+            isl_blocks=required_blocks,
+            overlap_blocks=overlap_blocks,
         )
-        if len(self.hit_rate_events) > 1024:
-            del self.hit_rate_events[:512]
+        if self.on_hit_rate is not None:
+            self.on_hit_rate(ev)
+        else:
+            # no publisher wired (standalone scheduler): keep a bounded
+            # in-memory tail for introspection/tests
+            self.hit_rate_events.append(ev)
+            if len(self.hit_rate_events) > 1024:
+                del self.hit_rate_events[:512]

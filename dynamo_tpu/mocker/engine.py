@@ -184,7 +184,13 @@ class MockerEngine:
             kv_active_blocks=self.kv.num_active_blocks,
             kv_total_blocks=self.kv.max_capacity,
             num_requests_waiting=len(self._waiting_list),
-            gpu_cache_usage_perc=self.kv.usage_perc,
+            # active (pinned) blocks only: inactive-reusable blocks are
+            # reclaimable capacity, matching PagePool.used_pages semantics
+            gpu_cache_usage_perc=(
+                self.kv.num_active_blocks / self.kv.max_capacity
+                if self.kv.max_capacity
+                else 0.0
+            ),
             gpu_prefix_cache_hit_rate=hit_rate,
             request_active_slots=len(self.running),
             request_total_slots=self.cfg.max_batch_size,

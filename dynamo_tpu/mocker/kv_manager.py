@@ -112,15 +112,27 @@ class MockKvManager:
     def use(self, keys: Sequence[int]) -> bool:
         """Acquire blocks (prefix reuse when resident).  False = out of
         space and nothing evictable: the caller must preempt.  Atomic: on
-        failure no refcounts are left behind."""
+        failure no refcounts are left behind.
+
+        Two passes: resident keys (active or inactive) are acquired first so
+        at-capacity eviction can never claim a block that appears later in
+        the same batch -- evicting a request's own cached prefix would emit
+        a spurious removed+stored pair and invalidate the cached_tokens
+        estimate try_schedule just computed."""
         applied: List[int] = []
+        fresh: List[int] = []
         for key in keys:
             if key in self.active:
                 self.active[key] += 1
                 applied.append(key)
-                continue
-            if self.inactive.remove(key):
+            elif self.inactive.remove(key):
                 self.active[key] = 1
+                applied.append(key)
+            else:
+                fresh.append(key)
+        for key in fresh:
+            if key in self.active:  # duplicate new key within this batch
+                self.active[key] += 1
                 applied.append(key)
                 continue
             if self.current_capacity >= self.max_capacity:

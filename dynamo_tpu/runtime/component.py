@@ -348,6 +348,11 @@ class Client:
             await self._watch.close()
 
 
+class InstanceNotFoundError(RuntimeError):
+    """direct() addressed an instance no longer in the live set (stale
+    selection -- the worker died between the choice and the dispatch)."""
+
+
 class RouterMode(str, Enum):
     ROUND_ROBIN = "round_robin"
     RANDOM = "random"
@@ -394,7 +399,7 @@ class PushRouter:
         for inst in self.client.instances:
             if inst.instance_id == instance_id:
                 return await self._dispatch(inst, request)
-        raise RuntimeError(f"instance {instance_id:x} not found")
+        raise InstanceNotFoundError(f"instance {instance_id:x} not found")
 
     async def random(self, request: Context[Any]) -> ResponseStream[Annotated]:
         self.mode = RouterMode.RANDOM

@@ -26,6 +26,10 @@ from .hub import HubState, WatchEvent
 
 logger = logging.getLogger("dynamo.hub.client")
 
+# poison pill pushed into every watch/subscription queue when the hub
+# connection drops: consumers must fail loudly, never hang on a dead stream
+_CONN_LOST = object()
+
 
 @dataclass
 class WatchHandle:
@@ -42,7 +46,12 @@ class WatchHandle:
 
     async def __aiter__(self) -> AsyncIterator[WatchEvent]:
         while True:
-            yield await self.events.get()
+            ev = await self.events.get()
+            if ev is _CONN_LOST:
+                # re-enqueue so every current and future consumer fails too
+                self.events.put_nowait(_CONN_LOST)
+                raise ConnectionError("hub connection lost (watch orphaned)")
+            yield ev
 
 
 @dataclass
@@ -52,7 +61,7 @@ class Subscription:
     _close: Any = None
 
     async def next(self) -> Tuple[str, bytes]:
-        return await self.queue.get()
+        return await self.__anext__()
 
     async def close(self) -> None:
         if self._close is not None:
@@ -62,7 +71,12 @@ class Subscription:
         return self
 
     async def __anext__(self) -> Tuple[str, bytes]:
-        return await self.queue.get()
+        msg = await self.queue.get()
+        if msg is _CONN_LOST:
+            # re-enqueue so every current and future consumer fails too
+            self.queue.put_nowait(_CONN_LOST)
+            raise ConnectionError("hub connection lost (subscription orphaned)")
+        return msg
 
 
 class HubClient:
@@ -76,6 +90,12 @@ class HubClient:
     def __init__(self, host: str, port: int) -> None:
         self.host = host
         self.port = port
+        # fires once when the connection drops un-asked (not on close());
+        # components register shutdown here -- the reference gets the same
+        # property from etcd lease loss + CriticalTaskExecutionHandle
+        self.on_connection_lost: Optional[Any] = None
+        self._closing = False
+        self._conn_lost = False
         self._seq = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._watches: Dict[int, asyncio.Queue] = {}
@@ -97,6 +117,7 @@ class HubClient:
         return self
 
     async def close(self) -> None:
+        self._closing = True
         for task in self._keepalives.values():
             task.cancel()
         if self._pump:
@@ -143,10 +164,35 @@ class HubClient:
                 if not fut.done():
                     fut.set_exception(ConnectionError("hub connection closed"))
             self._pending.clear()
+            if not self._closing:
+                # unexpected loss: every watch, subscription and lease this
+                # client held is orphaned server-side.  Poison the local
+                # streams and notify, so the process fails loudly instead of
+                # serving from a silently frozen view of the cluster.
+                self._conn_lost = True
+                for task in self._keepalives.values():
+                    task.cancel()
+                for q in self._watches.values():
+                    q.put_nowait(_CONN_LOST)
+                for q in self._subs.values():
+                    q.put_nowait(_CONN_LOST)
+                logger.error(
+                    "hub connection lost: %d watches, %d subscriptions and "
+                    "%d leases orphaned",
+                    len(self._watches), len(self._subs), len(self._keepalives),
+                )
+                cb = self.on_connection_lost
+                if cb is not None:
+                    with contextlib.suppress(Exception):
+                        res = cb()
+                        if asyncio.iscoroutine(res):
+                            asyncio.ensure_future(res)
 
     async def _call(
         self, hdr: Dict[str, Any], payload: bytes = b""
     ) -> Tuple[Dict[str, Any], bytes]:
+        if self._conn_lost:
+            raise ConnectionError("hub connection lost")
         assert self._writer is not None, "not connected"
         seq = next(self._seq)
         hdr["seq"] = seq

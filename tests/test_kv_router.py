@@ -7,6 +7,7 @@ Reference spec: lib/llm/src/kv_router/{indexer,scheduler}.rs, kv_router.rs.
 """
 
 import asyncio
+import json
 
 import pytest
 
@@ -252,6 +253,47 @@ def test_kv_router_end_to_end(run):
                     await rt.shutdown()
                 except Exception:
                     pass
+            await router_rt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_hit_rate_events_published(run):
+    """Every KV-aware selection publishes a KVHitRateEvent on
+    {ns}.events.kv-hit-rate (reference kv_router/scheduler.rs:31-36,104)."""
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        rt, engine, _inst, pub = await _spawn_worker(addr)
+        router_rt = await DistributedRuntime.detached(addr)
+        ns = router_rt.namespace("kvr")
+        comp = ns.component("backend")
+        chooser = KvRouter(ns, comp, block_size=BLOCK)
+        await chooser.start()
+        try:
+            sub = await ns.subscribe("kv-hit-rate")
+            gen_client = await comp.endpoint("generate").client()
+            await gen_client.wait_for_instances()
+            await chooser.aggregator.scrape_once()
+            kv_router = KvPushRouter(PushRouter(gen_client), chooser)
+            stream = await kv_router.generate(
+                Context.new(req([1, 2, 3, 4, 5, 6, 7, 8]))
+            )
+            await _drain(stream)
+            _subject, payload = await asyncio.wait_for(sub.next(), 2)
+            ev = json.loads(payload)
+            assert ev["worker_id"] == rt.primary_lease
+            assert ev["isl_blocks"] == 2
+            assert ev["overlap_blocks"] == 0
+            await sub.close()
+        finally:
+            await chooser.stop()
+            await engine.stop()
+            await pub.close()
+            await rt.shutdown()
             await router_rt.shutdown()
             await hub.stop()
 

@@ -354,6 +354,32 @@ def test_cross_process_cancellation(run):
     run(body())
 
 
+def test_hub_connection_loss_is_loud(run):
+    """A hub crash must not silently orphan watches/subscriptions: pending
+    streams raise, new calls raise, and the loss callback fires."""
+
+    async def body():
+        server, client = await _hub_pair()
+        lost = asyncio.Event()
+        client.on_connection_lost = lost.set
+        sub = await client.subscribe("events.>")
+        watch = await client.watch_prefix("models/")
+        sub_iter = sub.__anext__()
+        # kill the hub out from under the client
+        await server.stop()
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(sub_iter, 2)
+        await asyncio.wait_for(lost.wait(), 2)
+        with pytest.raises(ConnectionError):
+            async for _ in watch:
+                break
+        with pytest.raises(ConnectionError):
+            await client.kv_put("k", b"v")
+        await client.close()
+
+    run(body())
+
+
 def test_subject_matching_semantics():
     from dynamo_tpu.runtime.transports.hub import _subject_matches
 
