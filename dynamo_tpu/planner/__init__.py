@@ -1,0 +1,12 @@
+from .connector import Connector, LocalConnector
+from .planner import DECODE, PREFILL, Adjustment, Planner, PlannerConfig
+
+__all__ = [
+    "Adjustment",
+    "Connector",
+    "DECODE",
+    "LocalConnector",
+    "PREFILL",
+    "Planner",
+    "PlannerConfig",
+]

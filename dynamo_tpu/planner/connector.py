@@ -1,0 +1,68 @@
+"""Scaling connectors: how the planner actually adds/removes workers.
+
+Reference parity: ``dynamo.planner`` connectors -- LocalConnector drives
+circus watchers (components/planner/src/dynamo/planner/local_connector.py),
+KubernetesConnector patches DynamoGraphDeployment replicas.  Here the local
+connector drives in-process worker handles through user-supplied factories:
+production wires factories that spawn real engine processes; tests wire
+mocker engines.  The k8s leg is out of scope until the operator exists.
+"""
+
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+from typing import Any, Awaitable, Callable, Dict, List, Optional
+
+logger = logging.getLogger("dynamo.planner")
+
+
+class Connector(ABC):
+    """The planner's actuation surface."""
+
+    @abstractmethod
+    async def add_worker(self, kind: str) -> None: ...
+
+    @abstractmethod
+    async def remove_worker(self, kind: str) -> None: ...
+
+    @abstractmethod
+    def worker_count(self, kind: str) -> int: ...
+
+
+class LocalConnector(Connector):
+    """Spawn/retire worker handles via per-kind async factories.
+
+    ``factories[kind]()`` returns a live handle; ``stopper(handle)`` (or the
+    handle's own ``stop()``) retires it.  Removal is LIFO: the youngest
+    worker drains first (its cache is coldest).
+    """
+
+    def __init__(
+        self,
+        factories: Dict[str, Callable[[], Awaitable[Any]]],
+        stopper: Optional[Callable[[Any], Awaitable[None]]] = None,
+    ) -> None:
+        self.factories = factories
+        self.stopper = stopper
+        self.workers: Dict[str, List[Any]] = {k: [] for k in factories}
+
+    async def add_worker(self, kind: str) -> None:
+        handle = await self.factories[kind]()
+        self.workers.setdefault(kind, []).append(handle)
+        logger.info("local connector: added %s worker (now %d)",
+                    kind, len(self.workers[kind]))
+
+    async def remove_worker(self, kind: str) -> None:
+        pool = self.workers.get(kind) or []
+        if not pool:
+            return
+        handle = pool.pop()
+        if self.stopper is not None:
+            await self.stopper(handle)
+        elif hasattr(handle, "stop"):
+            await handle.stop()
+        logger.info("local connector: removed %s worker (now %d)", kind, len(pool))
+
+    def worker_count(self, kind: str) -> int:
+        return len(self.workers.get(kind) or [])

@@ -1,0 +1,180 @@
+"""Planner: reactive autoscaling of decode / prefill workers.
+
+Rebuild of the reference planner (examples/llm/components/planner.py:40-49
+thresholds+grace constants, :142 collect_metrics, :214-340 make_adjustments):
+every adjustment interval, average the fleet's KV-cache load and the prefill
+queue depth, then scale
+
+  * **decode workers** on KV load: above ``kv_load_scale_up`` add one, below
+    ``kv_load_scale_down`` (and nobody waiting) remove one;
+  * **prefill workers** on queue depth per worker: above
+    ``queue_scale_up_per_worker`` add one, below ``queue_scale_down`` remove.
+
+A freshly added worker warms up (engine start, weight load, cache fill), so
+each scale-up opens a grace period during which further changes of that kind
+are suppressed (reference NEW_DECODE_WORKER_GRACE_PERIOD /
+NEW_PREFILL_WORKER_QUEUE_BUFFER_PERIOD = 3 intervals).
+
+The planner is deliberately sans-IO: ``metrics_source`` yields the current
+per-worker ``ForwardPassMetrics`` (wire it to a KvMetricsAggregator's shared
+``ProcessedEndpoints`` in production, or to in-process engines in tests) and
+``queue_depth_source`` yields the prefill queue depth (hub ``queue_depth``).
+Scaling goes through a :class:`~.connector.Connector`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from ..protocols.common import ForwardPassMetrics
+from .connector import Connector
+
+logger = logging.getLogger("dynamo.planner")
+
+DECODE = "decode"
+PREFILL = "prefill"
+
+
+@dataclass
+class PlannerConfig:
+    adjustment_interval_s: float = 10.0
+    # decode scaling on average KV-cache usage (reference planner.py:220-260)
+    kv_load_scale_up: float = 0.8
+    kv_load_scale_down: float = 0.3
+    min_decode_workers: int = 1
+    max_decode_workers: int = 8
+    # prefill scaling on queue depth per prefill worker (planner.py:262-320)
+    queue_scale_up_per_worker: float = 2.0
+    queue_scale_down: float = 0.2
+    min_prefill_workers: int = 0
+    max_prefill_workers: int = 4
+    # intervals to wait after a scale-up before acting again on that kind
+    decode_grace_periods: int = 3
+    prefill_grace_periods: int = 3
+    # observe and log decisions without acting (reference no-operation mode)
+    no_op: bool = False
+
+
+@dataclass
+class Adjustment:
+    """One decision, kept for observability/tests."""
+
+    t: float
+    kind: str
+    action: str  # "up" | "down" | "hold"
+    reason: str
+    count_before: int
+
+
+class Planner:
+    def __init__(
+        self,
+        connector: Connector,
+        metrics_source: Callable[[], Dict[int, ForwardPassMetrics]],
+        queue_depth_source: Optional[Callable[[], Awaitable[int]]] = None,
+        cfg: Optional[PlannerConfig] = None,
+    ) -> None:
+        self.connector = connector
+        self.metrics_source = metrics_source
+        self.queue_depth_source = queue_depth_source
+        self.cfg = cfg or PlannerConfig()
+        self.adjustments: List[Adjustment] = []
+        self._decode_grace = 0
+        self._prefill_grace = 0
+        self._task: Optional[asyncio.Task] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop(), name="planner-loop")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await self._task
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except Exception:
+                logger.exception("planner step failed")
+            await asyncio.sleep(self.cfg.adjustment_interval_s)
+
+    # -- one adjustment round (reference make_adjustments) --------------------
+
+    async def step(self) -> None:
+        metrics = self.metrics_source()
+        queue_depth = 0
+        if self.queue_depth_source is not None:
+            queue_depth = await self.queue_depth_source()
+        await self._adjust_decode(metrics)
+        await self._adjust_prefill(queue_depth)
+
+    async def _adjust_decode(self, metrics: Dict[int, ForwardPassMetrics]) -> None:
+        cfg = self.cfg
+        n = self.connector.worker_count(DECODE)
+        if self._decode_grace > 0:
+            self._decode_grace -= 1
+            self._record(DECODE, "hold", f"grace ({self._decode_grace} left)", n)
+            return
+        if not metrics:
+            return
+        loads = [m.gpu_cache_usage_perc for m in metrics.values()]
+        waiting = sum(m.num_requests_waiting for m in metrics.values())
+        avg_load = sum(loads) / len(loads)
+        if avg_load > cfg.kv_load_scale_up and n < cfg.max_decode_workers:
+            self._record(DECODE, "up", f"avg kv load {avg_load:.2f}", n)
+            if not cfg.no_op:
+                await self.connector.add_worker(DECODE)
+                self._decode_grace = cfg.decode_grace_periods
+        elif (
+            avg_load < cfg.kv_load_scale_down
+            and waiting == 0
+            and n > cfg.min_decode_workers
+        ):
+            self._record(DECODE, "down", f"avg kv load {avg_load:.2f}", n)
+            if not cfg.no_op:
+                await self.connector.remove_worker(DECODE)
+
+    async def _adjust_prefill(self, queue_depth: int) -> None:
+        cfg = self.cfg
+        if self.queue_depth_source is None:
+            return
+        n = self.connector.worker_count(PREFILL)
+        if self._prefill_grace > 0:
+            self._prefill_grace -= 1
+            self._record(PREFILL, "hold", f"grace ({self._prefill_grace} left)", n)
+            return
+        per_worker = queue_depth / max(n, 1)
+        if per_worker > cfg.queue_scale_up_per_worker and n < cfg.max_prefill_workers:
+            self._record(PREFILL, "up", f"queue/worker {per_worker:.1f}", n)
+            if not cfg.no_op:
+                await self.connector.add_worker(PREFILL)
+                self._prefill_grace = cfg.prefill_grace_periods
+        elif per_worker < cfg.queue_scale_down and n > cfg.min_prefill_workers:
+            self._record(PREFILL, "down", f"queue/worker {per_worker:.1f}", n)
+            if not cfg.no_op:
+                await self.connector.remove_worker(PREFILL)
+
+    def _record(self, kind: str, action: str, reason: str, count: int) -> None:
+        self.adjustments.append(
+            Adjustment(
+                t=time.monotonic(),
+                kind=kind,
+                action=action,
+                reason=reason,
+                count_before=count,
+            )
+        )
+        if action != "hold":
+            logger.info("planner: %s %s (%s), count was %d", kind, action, reason, count)
+        if len(self.adjustments) > 4096:
+            del self.adjustments[:2048]

@@ -1,0 +1,212 @@
+"""Planner tests: threshold decisions + grace periods (unit) and a chip-free
+load-ramp against live mocker engines (integration).
+
+Reference behavior: examples/llm/components/planner.py:214-340
+(make_adjustments with grace periods)."""
+
+import asyncio
+
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.planner import (
+    DECODE,
+    PREFILL,
+    LocalConnector,
+    Planner,
+    PlannerConfig,
+)
+from dynamo_tpu.protocols.common import (
+    ForwardPassMetrics,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+
+
+def fpm(load, waiting=0):
+    return ForwardPassMetrics(
+        kv_active_blocks=0,
+        kv_total_blocks=100,
+        num_requests_waiting=waiting,
+        gpu_cache_usage_perc=load,
+        gpu_prefix_cache_hit_rate=0.0,
+        request_active_slots=0,
+        request_total_slots=8,
+    )
+
+
+class FakeConnector:
+    def __init__(self, decode=1, prefill=1):
+        self.counts = {DECODE: decode, PREFILL: prefill}
+        self.log = []
+
+    async def add_worker(self, kind):
+        self.counts[kind] += 1
+        self.log.append(("add", kind))
+
+    async def remove_worker(self, kind):
+        self.counts[kind] -= 1
+        self.log.append(("remove", kind))
+
+    def worker_count(self, kind):
+        return self.counts[kind]
+
+
+def test_decode_scale_up_with_grace(run):
+    async def body():
+        conn = FakeConnector()
+        metrics = {1: fpm(0.95), 2: fpm(0.9)}
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(decode_grace_periods=2, max_decode_workers=4),
+        )
+        await planner.step()
+        assert conn.counts[DECODE] == 2  # scaled up
+        # grace: two intervals of high load change nothing
+        await planner.step()
+        await planner.step()
+        assert conn.counts[DECODE] == 2
+        # grace over: scales again
+        await planner.step()
+        assert conn.counts[DECODE] == 3
+
+    run(body())
+
+
+def test_decode_scale_down_requires_idle(run):
+    async def body():
+        conn = FakeConnector(decode=3)
+        metrics = {1: fpm(0.1, waiting=2)}
+        planner = Planner(conn, metrics_source=lambda: metrics)
+        await planner.step()
+        assert conn.counts[DECODE] == 3  # waiting requests block scale-down
+        metrics[1] = fpm(0.1, waiting=0)
+        await planner.step()
+        assert conn.counts[DECODE] == 2
+        # never below the floor
+        metrics[1] = fpm(0.0)
+        await planner.step()
+        assert conn.counts[DECODE] == 1
+        await planner.step()
+        assert conn.counts[DECODE] == 1
+
+    run(body())
+
+
+def test_prefill_scales_on_queue_depth(run):
+    async def body():
+        conn = FakeConnector(prefill=1)
+        depth = {"v": 8}
+
+        async def qdepth():
+            return depth["v"]
+
+        planner = Planner(
+            conn,
+            metrics_source=lambda: {},
+            queue_depth_source=qdepth,
+            cfg=PlannerConfig(prefill_grace_periods=0, max_prefill_workers=3),
+        )
+        await planner.step()
+        assert conn.counts[PREFILL] == 2  # 8 deep / 1 worker > 2.0
+        depth["v"] = 0
+        await planner.step()
+        assert conn.counts[PREFILL] == 1  # drains back down
+        await planner.step()
+        assert conn.counts[PREFILL] == 0  # min_prefill_workers=0
+
+    run(body())
+
+
+def test_no_op_mode_records_without_acting(run):
+    async def body():
+        conn = FakeConnector()
+        planner = Planner(
+            conn,
+            metrics_source=lambda: {1: fpm(0.95)},
+            cfg=PlannerConfig(no_op=True),
+        )
+        await planner.step()
+        assert conn.counts[DECODE] == 1
+        assert [a.action for a in planner.adjustments] == ["up"]
+
+    run(body())
+
+
+def test_load_ramp_scales_mocker_fleet(run):
+    """End-to-end chip-free ramp: flood live mocker engines until KV load
+    crosses the threshold, watch the planner add a worker, drain, watch it
+    scale back down.  Must finish well under 5s."""
+
+    async def body():
+        engines = []
+
+        async def make_decoder():
+            eng = MockerEngine(
+                MockerConfig(
+                    block_size=4,
+                    kv_capacity_blocks=96,
+                    decode_s_per_step=0.004,
+                )
+            )
+            await eng.start()
+            engines.append(eng)
+            return eng
+
+        conn = LocalConnector({DECODE: make_decoder})
+        await conn.add_worker(DECODE)  # initial fleet of 1
+
+        def metrics():
+            return {
+                i: e.metrics() for i, e in enumerate(conn.workers[DECODE])
+            }
+
+        planner = Planner(
+            conn,
+            metrics_source=metrics,
+            cfg=PlannerConfig(
+                adjustment_interval_s=0.05,
+                kv_load_scale_up=0.5,
+                kv_load_scale_down=0.1,
+                decode_grace_periods=2,
+                max_decode_workers=3,
+            ),
+        )
+        await planner.start()
+        try:
+            # flood the single worker: long prompts, long generations
+            streams = []
+            for i in range(6):
+                req = PreprocessedRequest(
+                    token_ids=[i + 1] * 32,
+                    stop_conditions=StopConditions(max_tokens=64),
+                )
+                worker = conn.workers[DECODE][0]
+                streams.append(await worker.generate(Context.new(req.to_dict())))
+
+            async def drain(s):
+                async for _ in s:
+                    pass
+
+            drains = [asyncio.create_task(drain(s)) for s in streams]
+            # scale-up must happen while the flood is in flight
+            for _ in range(60):
+                if conn.worker_count(DECODE) >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert conn.worker_count(DECODE) >= 2, (
+                f"no scale-up; adjustments={planner.adjustments}"
+            )
+            await asyncio.gather(*drains)
+            # idle fleet drains back to the floor
+            for _ in range(100):
+                if conn.worker_count(DECODE) == 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert conn.worker_count(DECODE) == 1
+        finally:
+            await planner.stop()
+            for e in engines:
+                await e.stop()
+
+    run(body())
