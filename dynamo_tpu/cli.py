@@ -1,0 +1,375 @@
+"""dynamo-tpu run: the single launch entrypoint.
+
+Reference parity: launch/dynamo-run (opt.rs:23,83 ``in=http|text|dyn://…``
+x ``out=echo|mocker|vllm|dyn``; flags.rs:26-137).  Usage::
+
+    python -m dynamo_tpu run in=http out=jax --model-path /m/tinyllama
+    python -m dynamo_tpu run in=http out=mocker --model-path /m/tok-only
+    python -m dynamo_tpu run in=dyn  out=jax --model-path … --hub H:P
+    python -m dynamo_tpu run in=http out=dyn --hub H:P          # frontend
+    python -m dynamo_tpu run in=text out=jax --model-path …     # local REPL
+
+``in=http out=<engine>`` is single-process aggregated serving (static mode,
+no hub).  ``in=dyn`` serves the engine as a worker on the hub (registering
+the model + KV/metrics publishers); ``in=http out=dyn`` runs the
+discovery-driven frontend.  ``--hub auto`` spawns an in-process HubServer
+(dev convenience).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import logging
+import re
+import signal
+import sys
+from typing import Optional, Tuple
+
+logger = logging.getLogger("dynamo.run")
+
+ENDPOINT_RE = re.compile(r"^dyn://([^.]+)\.([^.]+)\.([^.]+)$")
+
+
+def parse_endpoint_id(s: str) -> Tuple[str, str, str]:
+    """Parse ``dyn://namespace.component.endpoint`` (reference
+    protocols.rs:35)."""
+    m = ENDPOINT_RE.match(s)
+    if not m:
+        raise ValueError(
+            f"invalid endpoint id {s!r}: expected dyn://ns.component.endpoint"
+        )
+    return m.group(1), m.group(2), m.group(3)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dynamo-tpu",
+        description="TPU-native distributed LLM serving (dynamo rebuild)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    run = sub.add_parser("run", help="launch an engine/frontend/worker")
+    run.add_argument("io", nargs=2, metavar=("in=...", "out=..."),
+                     help="in=http|text|dyn out=jax|mocker|dyn")
+    run.add_argument("--model-path", help="HF model dir (weights + tokenizer)")
+    run.add_argument("--model-name", help="served model name (default: dir name)")
+    run.add_argument("--hub", help="hub address host:port, or 'auto'")
+    run.add_argument("--endpoint", default="dyn://dynamo.backend.generate",
+                     help="worker endpoint id (dyn://ns.comp.ep)")
+    run.add_argument("--host", default="127.0.0.1")
+    run.add_argument("--port", type=int, default=8080)
+    run.add_argument("--router-mode", default="round_robin",
+                     choices=["round_robin", "random", "kv"])
+    # engine shape
+    run.add_argument("--max-batch-size", type=int, default=8)
+    run.add_argument("--max-seq-len", type=int, default=2048)
+    run.add_argument("--page-size", type=int, default=16)
+    run.add_argument("--num-pages", type=int, default=512)
+    run.add_argument("--block-size", type=int, default=None,
+                     help="router-visible KV block size (default: page size)")
+    run.add_argument("--decode-block-size", type=int, default=16)
+    run.add_argument("--tp", type=int, default=1,
+                     help="tensor-parallel degree (shards over local devices)")
+    run.add_argument("--prompt", help="in=text: run one prompt and exit")
+    run.add_argument("--max-tokens", type=int, default=128)
+    return p
+
+
+def _parse_io(io) -> Tuple[str, str]:
+    try:
+        kv = dict(part.split("=", 1) for part in io)
+    except ValueError:
+        kv = {}
+    if "in" not in kv or "out" not in kv:
+        raise SystemExit("usage: run in=<http|text|dyn> out=<jax|mocker|dyn>")
+    return kv["in"], kv["out"]
+
+
+async def _make_engine(args):
+    """Build the local engine for out=jax|mocker."""
+    if args.out == "mocker":
+        from .mocker import MockerConfig, MockerEngine
+
+        block = args.block_size or args.page_size
+        vocab = 32000
+        if args.model_path:
+            # emit ids the model's tokenizer can actually detokenize
+            vocab = _tokenizer_for(args).vocab_size
+        return MockerEngine(MockerConfig(block_size=block, vocab_size=vocab))
+    from .engine import EngineConfig, JaxEngine
+
+    if not args.model_path:
+        raise SystemExit("out=jax requires --model-path")
+    cfg = EngineConfig(
+        max_batch_size=args.max_batch_size,
+        max_seq_len=args.max_seq_len,
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        block_size=args.block_size,
+        decode_block_size=args.decode_block_size,
+    )
+    logger.info("loading %s ...", args.model_path)
+    if args.tp > 1:
+        import jax
+        from jax.sharding import NamedSharding
+
+        from .engine.config import ModelConfig
+        from .engine.weights import load_safetensors_params
+        from .parallel.mesh import MeshConfig, build_mesh
+        from .parallel.sharding import kv_pspec, param_shardings
+
+        devices = jax.devices()
+        if len(devices) < args.tp:
+            raise SystemExit(f"--tp {args.tp} but only {len(devices)} devices")
+        mesh = build_mesh(MeshConfig(tp=args.tp), devices[: args.tp])
+        model_cfg = ModelConfig.from_pretrained(args.model_path)
+        params = load_safetensors_params(
+            args.model_path, model_cfg,
+            shardings=param_shardings(model_cfg, mesh),
+        )
+        kv_sharding = NamedSharding(mesh, kv_pspec(model_cfg))
+        return JaxEngine(model_cfg, params, cfg, kv_sharding=kv_sharding)
+    return JaxEngine.from_pretrained(args.model_path, cfg)
+
+
+def _tokenizer_for(args):
+    from .llm.tokenizer import Tokenizer
+
+    if not args.model_path:
+        raise SystemExit("this mode needs --model-path for the tokenizer")
+    return Tokenizer.from_model_dir(args.model_path)
+
+
+def _model_name(args) -> str:
+    import os
+
+    if args.model_name:
+        return args.model_name
+    if args.model_path:
+        return os.path.basename(os.path.normpath(args.model_path))
+    return "mocker"
+
+
+async def _resolve_hub(args):
+    """Returns (hub_address, owned_hub_server|None); spawns one for 'auto'."""
+    if args.hub == "auto":
+        from .runtime.transports.hub import HubServer
+
+        server = HubServer()
+        host, port = await server.start()
+        logger.info("spawned in-process hub at %s:%d", host, port)
+        return f"{host}:{port}", server
+    return args.hub, None
+
+
+async def run_http_local(args) -> None:
+    """in=http out=jax|mocker: single-process aggregated serving."""
+    from .http.service import HttpService, ModelManager
+    from .llm.backend import Backend
+    from .llm.preprocessor import OpenAIPreprocessor
+    from .runtime.pipeline import link
+
+    engine = await _make_engine(args)
+    tokenizer = _tokenizer_for(args)
+    name = _model_name(args)
+    pipeline = link(OpenAIPreprocessor(name, tokenizer), Backend(tokenizer), engine)
+    manager = ModelManager()
+    manager.add_chat_model(name, pipeline)
+    manager.add_completion_model(name, pipeline)
+    service = HttpService(manager, host=args.host, port=args.port)
+    await service.start()
+    print(f"serving {name} at {service.url}  (POST /v1/chat/completions)")
+    try:
+        await _wait_forever()
+    finally:
+        await service.stop()
+        await engine.stop()
+
+
+async def run_http_frontend(args) -> None:
+    """in=http out=dyn: discovery-driven frontend over the hub."""
+    if not args.hub:
+        raise SystemExit("in=http out=dyn requires --hub")
+    from .http.service import HttpService, ModelManager
+    from .llm.discovery import ModelWatcher
+    from .runtime.component import DistributedRuntime, RouterMode
+
+    addr, owned_hub = await _resolve_hub(args)
+    runtime = await DistributedRuntime.detached(addr)
+    manager = ModelManager()
+    if args.router_mode == "kv":
+        from .llm.backend import Backend
+        from .llm.kv_router.router import KvPushRouter, KvRouter
+        from .llm.preprocessor import OpenAIPreprocessor
+        from .runtime.pipeline import link
+
+        async def kv_factory(entry, card, client, router):
+            ns = runtime.namespace(entry.namespace)
+            comp = ns.component(entry.component)
+            chooser = KvRouter(ns, comp, block_size=card.kv_block_size)
+            await chooser.start()
+            tokenizer = card.tokenizer()
+            engine = link(
+                OpenAIPreprocessor(entry.name, tokenizer),
+                Backend(tokenizer),
+                KvPushRouter(router, chooser),
+            )
+            return engine, chooser.stop  # watcher stops the chooser w/ model
+
+        watcher = ModelWatcher(runtime, manager, engine_factory=kv_factory)
+    else:
+        watcher = ModelWatcher(
+            runtime, manager, router_mode=RouterMode(args.router_mode)
+        )
+    await watcher.start()
+    service = HttpService(manager, host=args.host, port=args.port)
+    await service.start()
+    print(f"frontend at {service.url} (hub {addr}); models appear on discovery")
+    stop = asyncio.Event()
+    # hub loss must terminate the frontend (fail loud), not freeze its view
+    if hasattr(runtime.hub, "on_connection_lost"):
+        runtime.hub.on_connection_lost = stop.set
+    try:
+        await _wait_forever(stop)
+    finally:
+        await service.stop()
+        await watcher.stop()
+        await runtime.shutdown()
+        if owned_hub:
+            await owned_hub.stop()
+
+
+async def run_worker(args) -> None:
+    """in=dyn out=jax|mocker: engine worker on the hub."""
+    if not args.hub:
+        raise SystemExit("in=dyn requires --hub")
+    from .llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+    from .llm.model_card import register_llm
+    from .runtime.component import DistributedRuntime
+
+    ns_name, comp_name, ep_name = parse_endpoint_id(args.endpoint)
+    addr, owned_hub = await _resolve_hub(args)
+    runtime = await DistributedRuntime.detached(addr)
+    engine = await _make_engine(args)
+    ns = runtime.namespace(ns_name)
+    comp = ns.component(comp_name)
+    ep = comp.endpoint(ep_name)
+    await ep.serve(engine)
+    pub = KvEventPublisher(ns, worker_id=runtime.primary_lease)
+    pub.hook(engine)
+    metrics_pub = WorkerMetricsPublisher(engine.metrics)
+    await metrics_pub.attach(comp)
+    stop = asyncio.Event()
+    # hub loss orphans this worker's registrations: exit so a supervisor
+    # restarts it into a live cluster (fail loud)
+    if hasattr(runtime.hub, "on_connection_lost"):
+        runtime.hub.on_connection_lost = stop.set
+    if args.model_path:
+        card = await register_llm(
+            runtime, ep, args.model_path,
+            model_name=args.model_name,
+            kv_block_size=args.block_size or args.page_size,
+        )
+        print(f"worker serving model {card.name} on {args.endpoint} (hub {addr})")
+    else:
+        print(f"worker serving on {args.endpoint} (hub {addr}; no model card)")
+    try:
+        await _wait_forever(stop)
+    finally:
+        await pub.close()
+        await engine.stop()
+        await runtime.shutdown()
+        if owned_hub:
+            await owned_hub.stop()
+
+
+async def run_text(args) -> None:
+    """in=text out=jax|mocker: REPL / one-shot prompt through the full
+    preprocessor->engine->detokenizer pipeline."""
+    from .llm.backend import Backend
+    from .llm.preprocessor import OpenAIPreprocessor
+    from .protocols.openai import ChatCompletionRequest
+    from .runtime.engine import Annotated, Context, as_response_stream
+    from .runtime.pipeline import link
+
+    engine = await _make_engine(args)
+    tokenizer = _tokenizer_for(args)
+    name = _model_name(args)
+    pipeline = link(OpenAIPreprocessor(name, tokenizer), Backend(tokenizer), engine)
+
+    async def ask(text: str) -> None:
+        req = ChatCompletionRequest.from_dict(
+            {
+                "model": name,
+                "messages": [{"role": "user", "content": text}],
+                "stream": True,
+                "max_tokens": args.max_tokens,
+            }
+        )
+        stream = await as_response_stream(pipeline, Context.new(req))
+        async for item in stream:
+            if not isinstance(item, Annotated):
+                item = Annotated.from_data(item)
+            if item.is_error():
+                print(f"\n[error] {item.error_message()}", flush=True)
+                return
+            data = item.data or {}
+            for choice in data.get("choices", []):
+                delta = (choice.get("delta") or {}).get("content")
+                if delta:
+                    print(delta, end="", flush=True)
+        print()
+
+    try:
+        if args.prompt is not None:
+            await ask(args.prompt)
+            return
+        loop = asyncio.get_running_loop()
+        while True:
+            line = await loop.run_in_executor(None, sys.stdin.readline)
+            if not line:
+                break
+            line = line.strip()
+            if line in ("exit", "quit", ""):
+                if line:
+                    break
+                continue
+            await ask(line)
+    finally:
+        await engine.stop()
+
+
+async def _wait_forever(stop: Optional[asyncio.Event] = None) -> None:
+    stop = stop or asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    args = build_parser().parse_args(argv)
+    args.inp, args.out = _parse_io(args.io)
+    try:
+        if args.inp == "http" and args.out in ("jax", "mocker"):
+            asyncio.run(run_http_local(args))
+        elif args.inp == "http" and args.out == "dyn":
+            asyncio.run(run_http_frontend(args))
+        elif args.inp == "dyn":
+            asyncio.run(run_worker(args))
+        elif args.inp == "text":
+            asyncio.run(run_text(args))
+        else:
+            raise SystemExit(f"unsupported combination in={args.inp} out={args.out}")
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

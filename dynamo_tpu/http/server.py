@@ -108,7 +108,7 @@ class HttpServer:
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port
+            self._handle_conn, self.host, self.port, limit=MAX_HEADER_BYTES
         )
         self.port = self.address[1]
 
@@ -131,7 +131,17 @@ class HttpServer:
         self._writers.add(writer)
         try:
             while True:
-                req = await self._read_request(reader)
+                try:
+                    req = await self._read_request(reader)
+                except BadRequest as e:
+                    # malformed framing: answer 400 and drop the connection
+                    # (the stream position is no longer trustworthy)
+                    await self._write_response(
+                        writer,
+                        Response.json({"error": {"message": str(e)}}, 400),
+                        keep_alive=False,
+                    )
+                    break
                 if req is None:
                     break
                 keep_alive = (
@@ -174,8 +184,9 @@ class HttpServer:
             head = await reader.readuntil(b"\r\n\r\n")
         except asyncio.IncompleteReadError:
             return None
-        if len(head) > MAX_HEADER_BYTES:
-            raise BadRequest("headers too large")
+        except asyncio.LimitOverrunError:
+            # the StreamReader limit (== MAX_HEADER_BYTES) tripped first
+            raise BadRequest("headers too large") from None
         lines = head.decode("latin-1").split("\r\n")
         parts = lines[0].split(" ")
         if len(parts) != 3:
@@ -188,7 +199,10 @@ class HttpServer:
                 continue
             k, _, v = line.partition(":")
             headers[k.strip().lower()] = v.strip()
-        length = int(headers.get("content-length", "0") or 0)
+        try:
+            length = int(headers.get("content-length", "0") or 0)
+        except ValueError:
+            raise BadRequest("invalid Content-Length") from None
         if length > MAX_BODY_BYTES:
             raise BadRequest("body too large")
         body = await reader.readexactly(length) if length else b""

@@ -33,6 +33,25 @@ from .server import HttpServer, Request, Response
 logger = logging.getLogger("dynamo.http.service")
 
 
+def _bears_token(data: dict) -> bool:
+    """True when an OpenAI chunk carries generated text (TTFT/ITL must not
+    count the synthetic role-priming chat chunk)."""
+    for c in data.get("choices") or []:
+        if (c.get("delta") or {}).get("content"):
+            return True
+        if c.get("text"):
+            return True
+    return False
+
+
+def sse_annotation(name: str, comment) -> bytes:
+    """Named SSE event for Annotated annotation envelopes."""
+    import json as _json
+
+    payload = _json.dumps({"comment": comment or []}, separators=(",", ":"))
+    return f"event: {name}\ndata: {payload}\n\n".encode()
+
+
 class ModelNotFound(OpenAIError):
     def __init__(self, model: str) -> None:
         super().__init__(f"model '{model}' not found", code=404)
@@ -152,10 +171,12 @@ class HttpService:
                 else self.manager.completion_engine(parsed.model)
             )
         except OpenAIError as e:
+            # label with the model name only when it is actually served:
+            # client-supplied junk names must not mint unbounded label series
+            raw = body.get("model") if isinstance(body, dict) else None
+            known = {m["id"] for m in self.manager.list_models()}
             self.metrics.requests_total.labels(
-                body.get("model", "unknown") if isinstance(body, dict) else "unknown",
-                endpoint,
-                "rejected",
+                raw if raw in known else "unknown", endpoint, "rejected"
             ).inc()
             return Response.json(e.to_body(), e.code)
 
@@ -188,12 +209,19 @@ class HttpService:
                     yield sse_error(item.error_message() or "engine error")
                     return
                 if item.data is not None:
-                    guard.token()
+                    if _bears_token(item.data):
+                        guard.token()
                     yield sse_encode(item.data)
+                elif item.event is not None:
+                    # annotation envelope (formatted_prompt / token_ids ...):
+                    # surface as a named SSE event, reference openai.rs shape
+                    yield sse_annotation(item.event, item.comment)
             guard.mark_ok()
             yield SSE_DONE
-        except asyncio.CancelledError:
-            # client went away mid-stream: propagate kill to the engine
+        except (asyncio.CancelledError, GeneratorExit):
+            # client went away mid-stream (handler cancelled, or the writer
+            # failed and the generator was aclosed): kill the engine-side
+            # request instead of decoding for a dead connection
             request.ctx.kill()
             raise
         except Exception as e:
@@ -222,7 +250,8 @@ class HttpService:
                         500,
                     )
                 if item.data is not None:
-                    guard.token()
+                    if _bears_token(item.data):
+                        guard.token()
                     chunks.append(item.data)
             guard.mark_ok()
             agg = aggregate_chat(chunks) if chat else aggregate_completion(chunks)

@@ -64,6 +64,24 @@ class Tokenizer:
     def from_file(cls, tokenizer_json: str) -> "Tokenizer":
         return cls(_HFTokenizer.from_file(tokenizer_json))
 
+    @classmethod
+    def from_blobs(cls, tokenizer_json: bytes, config: Optional[dict] = None) -> "Tokenizer":
+        """Build from in-memory artifacts (model-card transport: the MDC
+        carries tokenizer.json + tokenizer_config.json through the hub
+        object store, no filesystem involved)."""
+        hf = _HFTokenizer.from_str(
+            tokenizer_json.decode()
+            if isinstance(tokenizer_json, bytes)
+            else tokenizer_json
+        )
+        cfg = config or {}
+        return cls(
+            hf,
+            chat_template=cfg.get("chat_template"),
+            eos_token=_token_str(cfg.get("eos_token")),
+            bos_token=_token_str(cfg.get("bos_token")),
+        )
+
     # -- special tokens ------------------------------------------------------
 
     @property

@@ -141,6 +141,8 @@ class CompletionRequest:
             pass  # pre-tokenized prompt
         elif not isinstance(prompt, str):
             raise OpenAIError("'prompt' must be a string or a list of token ids")
+        if not prompt:
+            raise OpenAIError("'prompt' must not be empty")
         if d.get("n") not in (None, 1):
             raise OpenAIError("only n=1 is supported")
         return cls(

@@ -1,0 +1,114 @@
+"""CLI tests: endpoint-id parsing, one-shot text mode through the full
+pipeline, and the http frontend+worker combo launched via cli entrypoints
+(reference launch/dynamo-run/src/opt.rs:23,83)."""
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.cli import build_parser, main, parse_endpoint_id
+
+
+def test_parse_endpoint_id():
+    assert parse_endpoint_id("dyn://ns.comp.ep") == ("ns", "comp", "ep")
+    with pytest.raises(ValueError):
+        parse_endpoint_id("ns.comp.ep")
+    with pytest.raises(ValueError):
+        parse_endpoint_id("dyn://ns.comp")
+    with pytest.raises(ValueError):
+        parse_endpoint_id("dyn://a.b.c.d")
+
+
+def test_text_one_shot_mocker(model_dir, capsys):
+    rc = main(
+        [
+            "run", "in=text", "out=mocker",
+            "--model-path", model_dir,
+            "--prompt", "hello",
+            "--max-tokens", "4",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.strip()  # generated some text
+
+
+def test_http_frontend_plus_worker(model_dir, run):
+    """Worker (in=dyn out=mocker) + frontend (in=http out=dyn) over a hub:
+    a chat request flows through discovery-built pipeline to the worker."""
+
+    async def body():
+        from dynamo_tpu.cli import build_parser as bp
+
+        from dynamo_tpu.http.service import HttpService, ModelManager
+        from dynamo_tpu.llm.discovery import ModelWatcher
+        from dynamo_tpu.llm.kv_router.publisher import (
+            KvEventPublisher,
+            WorkerMetricsPublisher,
+        )
+        from dynamo_tpu.llm.model_card import register_llm
+        from dynamo_tpu.mocker import MockerConfig, MockerEngine
+        from dynamo_tpu.runtime.component import DistributedRuntime
+        from dynamo_tpu.runtime.transports.hub import HubServer
+
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        # worker leg (what run_worker does)
+        wrt = await DistributedRuntime.detached(addr)
+        engine = MockerEngine(MockerConfig(block_size=4, vocab_size=300))
+        ep = wrt.namespace("dynamo").component("backend").endpoint("generate")
+        await ep.serve(engine)
+        pub = KvEventPublisher(wrt.namespace("dynamo"), worker_id=wrt.primary_lease)
+        pub.hook(engine)
+        mp = WorkerMetricsPublisher(engine.metrics)
+        await mp.attach(wrt.namespace("dynamo").component("backend"))
+        await register_llm(wrt, ep, model_dir, model_name="cli-model")
+        # frontend leg (what run_http_frontend does)
+        frt = await DistributedRuntime.detached(addr)
+        manager = ModelManager()
+        watcher = ModelWatcher(frt, manager)
+        await watcher.start()
+        service = HttpService(manager)
+        await service.start()
+        try:
+            def chat():
+                req = urllib.request.Request(
+                    service.url + "/v1/chat/completions",
+                    data=json.dumps(
+                        {
+                            "model": "cli-model",
+                            "messages": [{"role": "user", "content": "ping"}],
+                            "max_tokens": 4,
+                        }
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status, json.loads(r.read())
+
+            loop = asyncio.get_running_loop()
+            status, body = await loop.run_in_executor(None, chat)
+            assert status == 200
+            assert body["choices"][0]["message"]["content"]
+        finally:
+            await service.stop()
+            await watcher.stop()
+            await pub.close()
+            await engine.stop()
+            await wrt.shutdown()
+            await frt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_parser_flags():
+    p = build_parser()
+    a = p.parse_args(
+        ["run", "in=http", "out=jax", "--model-path", "/m", "--tp", "4",
+         "--page-size", "32", "--num-pages", "1024"]
+    )
+    assert a.tp == 4 and a.page_size == 32 and a.num_pages == 1024

@@ -1,0 +1,147 @@
+"""Model card + discovery watcher tests: MDC transport through the hub
+object store, lease-scoped model registration, watcher-driven pipeline
+assembly and removal (reference discovery/watcher.rs:34-250,
+model_card/model.rs:88)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.llm.discovery import ModelWatcher
+from dynamo_tpu.llm.model_card import (
+    ModelDeploymentCard,
+    ModelEntry,
+    register_llm,
+    slugify,
+)
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+
+def test_mdc_roundtrip_and_tokenizer(model_dir):
+    card = ModelDeploymentCard.from_model_dir(model_dir, name="org/test-model")
+    assert card.slug == "org--test-model"
+    assert card.context_length == 2048  # from config.json
+    blob = card.to_blob()
+    back = ModelDeploymentCard.from_blob(blob)
+    assert back.name == card.name
+    assert back.mdcsum == card.mdcsum
+    tok = back.tokenizer()
+    ids = tok.encode("hello world")
+    assert ids and tok.decode(ids).strip() == "hello world"
+    assert tok.chat_template  # carried through the card
+
+
+async def _spawn_model_worker(addr, model_dir, name, ns="disc"):
+    rt = await DistributedRuntime.detached(addr)
+    # vocab capped below the test tokenizer's 512 so generated ids detokenize
+    engine = MockerEngine(MockerConfig(block_size=4, vocab_size=300))
+    ep = rt.namespace(ns).component("backend-" + slugify(name)).endpoint("generate")
+    await ep.serve(engine)
+    card = await register_llm(rt, ep, model_dir, model_name=name)
+    return rt, engine, card
+
+
+def test_two_models_discovery_and_death(run, model_dir):
+    """Two models register; the frontend serves both; killing one worker
+    makes its model 404 while the other keeps serving."""
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+
+        rt_a, eng_a, _ = await _spawn_model_worker(addr, model_dir, "model-a")
+        rt_b, eng_b, _ = await _spawn_model_worker(addr, model_dir, "model-b")
+
+        front_rt = await DistributedRuntime.detached(addr)
+        manager = ModelManager()
+        watcher = ModelWatcher(front_rt, manager)
+        await watcher.start()
+        service = HttpService(manager)
+        await service.start()
+        try:
+            import json
+            import urllib.request
+
+            names = sorted(m["id"] for m in manager.list_models())
+            assert names == ["model-a", "model-b"]
+
+            def chat(model):
+                req = urllib.request.Request(
+                    service.url + "/v1/chat/completions",
+                    data=json.dumps(
+                        {
+                            "model": model,
+                            "messages": [{"role": "user", "content": "hi there"}],
+                            "max_tokens": 4,
+                        }
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            loop = asyncio.get_running_loop()
+            status, body_a = await loop.run_in_executor(None, chat, "model-a")
+            assert status == 200
+            assert body_a["choices"][0]["message"]["content"]
+            status, _ = await loop.run_in_executor(None, chat, "model-b")
+            assert status == 200
+
+            # kill worker B; its lease-scoped registration disappears and the
+            # watcher drops the model from the frontend
+            await eng_b.stop()
+            await rt_b.shutdown()
+            for _ in range(100):
+                if len(manager.list_models()) == 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert [m["id"] for m in manager.list_models()] == ["model-a"]
+            status, err = await loop.run_in_executor(None, chat, "model-b")
+            assert status == 404
+            status, _ = await loop.run_in_executor(None, chat, "model-a")
+            assert status == 200
+        finally:
+            await service.stop()
+            await watcher.stop()
+            await eng_a.stop()
+            await rt_a.shutdown()
+            await front_rt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_watcher_sees_models_registered_after_start(run, model_dir):
+    """Late registration: the watcher picks up models added after start."""
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        front_rt = await DistributedRuntime.detached(addr)
+        manager = ModelManager()
+        watcher = ModelWatcher(front_rt, manager)
+        await watcher.start()
+        assert manager.is_empty
+        rt, eng, card = await _spawn_model_worker(addr, model_dir, "late-model")
+        try:
+            for _ in range(100):
+                if not manager.is_empty:
+                    break
+                await asyncio.sleep(0.02)
+            assert [m["id"] for m in manager.list_models()] == ["late-model"]
+        finally:
+            await watcher.stop()
+            await eng.stop()
+            await rt.shutdown()
+            await front_rt.shutdown()
+            await hub.stop()
+
+    run(body())
