@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="tensor-parallel degree (shards over local devices)")
     run.add_argument("--prompt", help="in=text: run one prompt and exit")
     run.add_argument("--max-tokens", type=int, default=128)
+    # disaggregated prefill/decode (in=dyn workers only)
+    run.add_argument("--disagg", choices=["decode", "prefill"],
+                     help="serve as a disaggregated decode or prefill worker")
+    run.add_argument("--max-local-prefill-length", type=int, default=512)
+    run.add_argument("--max-prefill-queue-depth", type=int, default=16)
     return p
 
 
@@ -249,13 +254,48 @@ async def run_worker(args) -> None:
     from .runtime.component import DistributedRuntime
 
     ns_name, comp_name, ep_name = parse_endpoint_id(args.endpoint)
+    # build the engine BEFORE connecting: weight loading blocks the event
+    # loop long enough to starve lease keepalives and get this worker evicted
+    engine = await _make_engine(args)
     addr, owned_hub = await _resolve_hub(args)
     runtime = await DistributedRuntime.detached(addr)
-    engine = await _make_engine(args)
     ns = runtime.namespace(ns_name)
     comp = ns.component(comp_name)
     ep = comp.endpoint(ep_name)
-    await ep.serve(engine)
+    prefill_worker = None
+    if args.disagg == "prefill":
+        # queue consumer only: no generate endpoint, no model registration
+        from .llm.disagg import PrefillWorker
+
+        prefill_worker = PrefillWorker(engine, ns)
+        await prefill_worker.start()
+        print(f"prefill worker consuming {ns_name}_prefill_queue (hub {addr})")
+    elif args.disagg == "decode":
+        from .llm.disagg import (
+            KV_DELIVER_ENDPOINT,
+            DisaggConfig,
+            DisaggDecodeEngine,
+        )
+
+        disagg = DisaggDecodeEngine(
+            engine,
+            ns,
+            comp_name,
+            # serve() registers under the primary lease; fixing the id now
+            # avoids a window where a shipped job carries a placeholder
+            instance_id=runtime.primary_lease,
+            cfg=DisaggConfig(
+                max_local_prefill_length=args.max_local_prefill_length,
+                max_prefill_queue_depth=args.max_prefill_queue_depth,
+            ),
+            block_size=args.block_size or args.page_size,
+        )
+        # kv_deliver must exist before any request can be shipped remote, or
+        # the prefill worker's write-back races a missing endpoint
+        await comp.endpoint(KV_DELIVER_ENDPOINT).serve(disagg.deliver_handler())
+        await ep.serve(disagg)
+    else:
+        await ep.serve(engine)
     pub = KvEventPublisher(ns, worker_id=runtime.primary_lease)
     pub.hook(engine)
     metrics_pub = WorkerMetricsPublisher(engine.metrics)
@@ -265,18 +305,20 @@ async def run_worker(args) -> None:
     # restarts it into a live cluster (fail loud)
     if hasattr(runtime.hub, "on_connection_lost"):
         runtime.hub.on_connection_lost = stop.set
-    if args.model_path:
+    if args.model_path and args.disagg != "prefill":
         card = await register_llm(
             runtime, ep, args.model_path,
             model_name=args.model_name,
             kv_block_size=args.block_size or args.page_size,
         )
         print(f"worker serving model {card.name} on {args.endpoint} (hub {addr})")
-    else:
+    elif args.disagg != "prefill":
         print(f"worker serving on {args.endpoint} (hub {addr}; no model card)")
     try:
         await _wait_forever(stop)
     finally:
+        if prefill_worker is not None:
+            await prefill_worker.stop()
         await pub.close()
         await engine.stop()
         await runtime.shutdown()

@@ -19,7 +19,7 @@ import concurrent.futures
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -70,6 +70,9 @@ class EngineConfig:
     grow_chunk_pages: int = 4
     # width of the device-checked stop-token set per lane
     device_stop_width: int = 8
+    # disaggregation: a lane parked for a remote prefill's KV fails after
+    # this long (lost queue item / crashed prefill worker backstop)
+    external_kv_timeout_s: float = 60.0
     seed: int = 0
     dtype: Optional[str] = None
 
@@ -145,6 +148,12 @@ class JaxEngine:
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._queues: Dict[str, asyncio.Queue] = {}
         self._cancelled: set = set()
+        # disaggregation: request_id -> seq awaiting remote KV; deliveries
+        # are applied by the tick loop at a controlled point
+        self._external: Dict[str, SeqState] = {}
+        self._deliveries: Dict[str, Tuple[np.ndarray, int]] = {}
+        self._external_deadline: Dict[str, float] = {}
+        self._external_errors: Dict[str, str] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
@@ -213,7 +222,9 @@ class JaxEngine:
 
     # -- AsyncEngine --------------------------------------------------------
 
-    async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
+    async def generate(
+        self, request: Context[Any], _external: bool = False
+    ) -> AsyncIterator[Annotated]:
         """Token-level generate; yields Annotated[LLMEngineOutput-dict]."""
         if not self._running:
             await self.start()
@@ -223,11 +234,20 @@ class JaxEngine:
         else:
             req = data
         seq = SeqState.from_request(request.id, req, self.sched.block_size)
+        if _external:
+            # disaggregated: the prompt KV arrives via deliver_external
+            seq.awaiting_kv = True
+            self._external[request.id] = seq
+            self._external_deadline[request.id] = (
+                time.monotonic() + self.cfg.external_kv_timeout_s
+            )
         ctx = request.ctx
         try:
             self.sched.enqueue(seq)
         except ValueError as e:
             # surface as an error item, matching the remote prologue-error path
+            self._external.pop(request.id, None)
+            self._external_deadline.pop(request.id, None)
             message = str(e)
 
             async def err_stream() -> AsyncIterator[Annotated]:
@@ -265,6 +285,149 @@ class JaxEngine:
                 self._queues.pop(request.id, None)
 
         return ResponseStream(ctx, stream())
+
+    # -- disaggregation (SURVEY.md 5.8: blockset export/import over the data
+    # plane replaces NIXL one-sided writes) --------------------------------
+
+    async def generate_external(
+        self, request: Context[Any]
+    ) -> AsyncIterator[Annotated]:
+        """Admit a request whose prompt KV a remote prefill worker delivers;
+        the lane holds pages but decodes only after deliver_external."""
+        return await self.generate(request, _external=True)
+
+    def awaiting_external(self, request_id: str) -> bool:
+        """True while the request is admitted (or queued) and still expects a
+        remote prefill delivery."""
+        return request_id in self._external
+
+    def deliver_external(
+        self, request_id: str, kv_blob: np.ndarray, first_token: int
+    ) -> bool:
+        """Hand over a remote prefill's KV (``[L, 2, n_pages, page, Hkv, D]``)
+        plus its sampled first token.  Returns False when the request is no
+        longer waiting (cancelled/failed).  Applied by the tick loop at its
+        next iteration -- scheduler state is never touched from here."""
+        if request_id not in self._external:
+            return False
+        self._deliveries[request_id] = (kv_blob, int(first_token))
+        if self._wake is not None:
+            self._wake.set()
+        return True
+
+    def fail_external(self, request_id: str, message: str) -> bool:
+        """Remote prefill reported failure: fail the parked request instead of
+        letting it ride out the delivery timeout."""
+        if request_id not in self._external:
+            return False
+        self._external_errors[request_id] = message
+        if self._wake is not None:
+            self._wake.set()
+        return True
+
+    def _expected_blob_shape(self, seq: SeqState) -> Tuple[int, ...]:
+        kp = self.kv.pages.shape  # [L, 2, num_pages, page, Hkv, D]
+        n_pages = -(-len(seq.prompt) // self.cfg.page_size)
+        return (kp[0], kp[1], n_pages) + tuple(kp[3:])
+
+    def _drop_external(self, rid: str, message: str) -> None:
+        """Fail one parked external request without touching the rest of the
+        batch (the _fail_all hammer is for engine-wide faults only)."""
+        seq = self._external.pop(rid, None)
+        self._deliveries.pop(rid, None)
+        self._external_deadline.pop(rid, None)
+        if seq is None or seq.finish is not None:
+            return
+        self._fail_seq(seq, message)
+        self.sched.cancel(seq)
+
+    def _process_deliveries(self) -> List[Tuple[SeqState, int]]:
+        """Tick-loop side: returns (seq, first_token) pairs whose KV scatter
+        must be dispatched; drops deliveries for dead requests, fails parked
+        lanes whose prefill errored, mis-shaped, or timed out."""
+        for rid, msg in list(self._external_errors.items()):
+            self._external_errors.pop(rid)
+            self._drop_external(rid, f"remote prefill failed: {msg}")
+        out: List[Tuple[SeqState, int]] = []
+        for rid in list(self._deliveries):
+            blob, first = self._deliveries.pop(rid)
+            seq = self._external.pop(rid, None)
+            if seq is None or seq.finish is not None:
+                continue
+            if seq.slot < 0:
+                # not yet admitted: re-queue the delivery until plan() gives
+                # the seq a slot and pages (or it dies)
+                self._external[rid] = seq
+                self._deliveries[rid] = (blob, first)
+                continue
+            expect = self._expected_blob_shape(seq)
+            if tuple(blob.shape) != expect or expect[2] > len(seq.pages):
+                # a mis-configured prefill worker (page_size/model mismatch)
+                # must not take down the whole decode batch
+                self._external_deadline.pop(rid, None)
+                self._fail_seq(
+                    seq,
+                    f"remote prefill KV shape {tuple(blob.shape)} does not "
+                    f"match decode geometry {expect}",
+                )
+                self.sched.cancel(seq)
+                continue
+            self._external_deadline.pop(rid, None)
+            seq._kv_blob = blob  # type: ignore[attr-defined]
+            out.append((seq, first))
+        if self._external_deadline:
+            now = time.monotonic()
+            for rid, deadline in list(self._external_deadline.items()):
+                if now >= deadline:
+                    self._drop_external(
+                        rid,
+                        "timed out waiting for remote prefill KV "
+                        f"({self.cfg.external_kv_timeout_s:.0f}s)",
+                    )
+        return out
+
+    def _apply_external_kv(self, seq: SeqState, first_token: int) -> StepEvent:
+        """Executor thread: scatter the delivered KV into the lane's pages,
+        then commit the remotely-sampled first token."""
+        blob = seq._kv_blob  # type: ignore[attr-defined]
+        del seq._kv_blob  # type: ignore[attr-defined]
+        n_pages = blob.shape[2]
+        ids = np.asarray(seq.pages[:n_pages], np.int32)
+        self.kv.pages = self.kv.pages.at[:, :, ids].set(
+            jnp.asarray(blob, self.kv.pages.dtype)
+        )
+        seq.awaiting_kv = False
+        ev = self.sched.commit_prefill_token(seq, first_token)
+        # membership semantics changed (parked -> live): full state rebuild
+        self.sched.layout_version += 1
+        return ev
+
+    async def prefill_export(
+        self, req: PreprocessedRequest
+    ) -> Tuple[np.ndarray, int]:
+        """Prefill-worker side: run a standalone prefill into scratch pages,
+        return (kv_blob [L, 2, n_pages, page, Hkv, D], first_token) and free
+        the scratch.  Serialized with the tick loop via the engine executor."""
+        if not self._running:
+            await self.start()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._ex, self._prefill_export, req)
+
+    def _prefill_export(self, req: PreprocessedRequest) -> Tuple[np.ndarray, int]:
+        prompt = list(req.token_ids)
+        if not prompt:
+            raise ValueError("empty prompt")
+        n_pages = -(-len(prompt) // self.cfg.page_size)
+        pages = self.kv.allocator.alloc(n_pages)
+        try:
+            seq = SeqState.from_request("export", req, self.sched.block_size)
+            sampled = self._dispatch_full_prefill(seq, prompt, pages)
+            ids = np.asarray(pages, np.int32)
+            blob = np.asarray(jax.device_get(self.kv.pages[:, :, ids]))
+            first = int(np.asarray(jax.device_get(sampled))[0])
+            return blob, first
+        finally:
+            self.kv.allocator.free(pages)
 
     # -- metrics ------------------------------------------------------------
 
@@ -306,9 +469,21 @@ class JaxEngine:
         while self._running:
             try:
                 self._process_cancellations()
-                if not self.sched.has_work and not pending:
+                for seq, first in self._process_deliveries():
+                    ev = await loop.run_in_executor(
+                        self._ex, self._apply_external_kv, seq, first
+                    )
+                    self._dispatch([ev])
+                if not self.sched.has_runnable_work and not pending:
                     self._wake.clear()
-                    await self._wake.wait()
+                    if self._external:
+                        # bounded wait so parked-lane timeouts still fire
+                        try:
+                            await asyncio.wait_for(self._wake.wait(), 1.0)
+                        except asyncio.TimeoutError:
+                            pass
+                    else:
+                        await self._wake.wait()
                     continue
                 plan = self.sched.plan()
                 if self.sched.num_active > 0:
@@ -339,7 +514,7 @@ class JaxEngine:
                         self._ex, self._do_prefill, seq, prompt_len
                     )
                     fresh.append(pf)
-                if self.sched.num_active > 0:
+                if self.sched.num_runnable > 0:
                     blk = await loop.run_in_executor(self._ex, self._dispatch_block)
                     if blk is not None:
                         fresh.append(blk)
@@ -351,6 +526,9 @@ class JaxEngine:
                 pending = fresh
                 if not fresh and not pending:
                     self._handle_stalled_admission()
+                    # nothing dispatched and nothing in flight (e.g. waiting
+                    # on slots held by parked lanes): don't spin the loop hot
+                    await asyncio.sleep(0.001)
                 # yield so enqueue/cancel callbacks interleave
                 await asyncio.sleep(0)
             except asyncio.CancelledError:
@@ -403,6 +581,12 @@ class JaxEngine:
         )
 
     def _fail_seq(self, seq: SeqState, message: str) -> None:
+        if seq.finish is None:
+            seq.finish = FinishReason.ERROR
+        # a failed external request must not resurrect via a late delivery
+        self._external.pop(seq.request_id, None)
+        self._deliveries.pop(seq.request_id, None)
+        self._external_deadline.pop(seq.request_id, None)
         queue = self._queues.get(seq.request_id)
         if queue is not None:
             queue.put_nowait(Annotated.from_error(message))
@@ -426,6 +610,9 @@ class JaxEngine:
             by_id[s.request_id] = s
         for rid in list(self._cancelled):
             self._cancelled.discard(rid)
+            self._external.pop(rid, None)
+            self._deliveries.pop(rid, None)
+            self._external_deadline.pop(rid, None)
             seq = by_id.get(rid)
             if seq is not None:
                 # with the PagePool, cancel releases refs -- registered blocks
@@ -462,6 +649,36 @@ class JaxEngine:
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    def _dispatch_full_prefill(
+        self, seq: SeqState, prompt: List[int], pages: List[int]
+    ) -> jax.Array:
+        """Dispatch a full-prompt (no prefix reuse) prefill + first-token
+        sample writing into ``pages``.  Shared by the local prefill path and
+        the disagg export path so they cannot diverge (the disagg-equals-
+        aggregated invariant rests on identical dispatch here)."""
+        ps = self.cfg.page_size
+        bucket = pick_bucket(self.buckets, len(prompt))
+        n_pages = bucket // ps
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, : len(prompt)] = prompt
+        page_table = np.zeros((1, n_pages), np.int32)
+        # the lane may hold growth pages beyond the prompt already
+        # (loop-side ensure_decode_capacity runs before prefill dispatch);
+        # prefill writes only within the prompt's pages
+        k = min(len(pages), n_pages)
+        page_table[0, :k] = pages[:k]
+        sampled, self.kv.pages = prefill_and_sample(
+            self.params,
+            self.model_cfg,
+            self.kv.pages,
+            jnp.asarray(tokens),
+            jnp.asarray([len(prompt)], np.int32),
+            jnp.asarray(page_table),
+            self._next_rng(),
+            self._sampling_arrays([seq]),
+        )
+        return sampled
 
     def _do_prefill(self, seq: SeqState, prompt_len: int) -> InflightPrefill:
         """Dispatch prefill + first-token sampling; inject the token into the
@@ -505,28 +722,8 @@ class JaxEngine:
                 self._sampling_arrays([seq]),
             )
         else:
+            sampled = self._dispatch_full_prefill(seq, seq.prompt, seq.pages)
             bucket = pick_bucket(self.buckets, prompt_len)
-            n_pages = bucket // ps
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :prompt_len] = seq.prompt
-            page_table = np.zeros((1, n_pages), np.int32)
-            # the lane may hold growth pages beyond the prompt already
-            # (loop-side ensure_decode_capacity runs before prefill dispatch);
-            # prefill writes only within the prompt's pages
-            k = min(len(seq.pages), n_pages)
-            page_table[0, :k] = seq.pages[:k]
-            seq_lens = np.asarray([prompt_len], np.int32)
-
-            sampled, self.kv.pages = prefill_and_sample(
-                self.params,
-                self.model_cfg,
-                self.kv.pages,
-                jnp.asarray(tokens),
-                jnp.asarray(seq_lens),
-                jnp.asarray(page_table),
-                self._next_rng(),
-                self._sampling_arrays([seq]),
-            )
         # bring decode state current (admission bumped the layout version),
         # then inject the device-resident first token into its lane
         if self._dev is None or self._dev_version != self.sched.layout_version:
@@ -572,8 +769,11 @@ class JaxEngine:
             if seq is None:
                 continue
             # a lane with no write headroom must not run: it would scatter
-            # its next KV write to the trash page and emit a garbage token
-            active[b] = limit[b] > int(sched.seq_lens[b])
+            # its next KV write to the trash page and emit a garbage token.
+            # Lanes awaiting a remote prefill's KV stay parked until delivery.
+            active[b] = (
+                limit[b] > int(sched.seq_lens[b]) and not seq.awaiting_kv
+            )
             # stop tokens the device may swallow itself: only when the host
             # rules coincide exactly (no min_tokens gating)
             if seq.stop.min_tokens is None:

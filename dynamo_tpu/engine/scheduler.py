@@ -75,6 +75,9 @@ class SeqState:
     pending_register: List[TokenBlock] = field(default_factory=list)
     # prefix-cache stats are counted once per request (first admission)
     stats_counted: bool = False
+    # disaggregation: prompt KV arrives from a remote prefill worker; the
+    # lane holds pages but stays inactive until delivery
+    awaiting_kv: bool = False
 
     @property
     def seq_len(self) -> int:
@@ -153,8 +156,22 @@ class Scheduler:
         return sum(1 for s in self.slots if s is not None)
 
     @property
+    def num_runnable(self) -> int:
+        """Slotted lanes the device can actually step (parked awaiting_kv
+        lanes hold a slot + pages but must not spin decode blocks)."""
+        return sum(
+            1 for s in self.slots if s is not None and not s.awaiting_kv
+        )
+
+    @property
     def has_work(self) -> bool:
         return self.num_active > 0 or len(self.waiting) > 0
+
+    @property
+    def has_runnable_work(self) -> bool:
+        """Work the tick loop can make progress on *right now*; a batch of
+        only parked lanes sleeps until a delivery (or timeout) wakes it."""
+        return self.num_runnable > 0 or len(self.waiting) > 0
 
     def enqueue(self, seq: SeqState) -> None:
         if not seq.prompt:
@@ -201,7 +218,13 @@ class Scheduler:
             if slot is None:
                 break
             seq = self.waiting[0]
-            cached_pages = self._match_prefix(seq)
+            # remote-prefilled prompts arrive as one full-prompt KV blob; a
+            # shared reused prefix would be overwritten by the scatter, so
+            # external admissions take fresh pages only (reuse is the local
+            # prefill path's optimization)
+            cached_pages = [] if seq.awaiting_kv else self._match_prefix(seq)
+            if seq.awaiting_kv:
+                seq.cached_prompt_tokens = 0
             n_pages = -(-len(seq.prompt) // self.cfg.page_size)
             # admission needs room for the prompt *and* the first decode
             # write, with one page of headroom per active seq for growth;
@@ -217,8 +240,11 @@ class Scheduler:
             self.slots[slot] = seq
             self._write_slot_arrays(seq)
             self._queue_prompt_registrations(seq)
-            plan.prefills.append((seq, len(seq.prompt)))
-        plan.run_decode = self.num_active > 0
+            if not seq.awaiting_kv:
+                plan.prefills.append((seq, len(seq.prompt)))
+            # awaiting_kv lanes hold their pages and stay device-inactive
+            # until the remote prefill delivers (engine.deliver_external)
+        plan.run_decode = self.num_runnable > 0
         return plan
 
     def _match_prefix(self, seq: SeqState) -> List[int]:

@@ -1,0 +1,302 @@
+"""Disaggregated prefill/decode serving v1.
+
+Reference architecture (examples/llm/components/worker.py:186-235 conditional
+disagg decision, prefill_worker.py:139-207 queue consumer + KV write-back,
+lib/llm/src/disagg_router.rs:25-90 policy): the decode worker owns the
+request and its KV pages; long prefills are shipped to a pool of prefill
+workers through a shared hub queue; the prefill worker computes the prompt
+KV and writes it back into the decode worker's reserved pages, and decode
+resumes.
+
+TPU-native transfer plane (SURVEY.md 5.8): the reference's NIXL one-sided
+RDMA write becomes an explicit blockset export/import -- the prefill worker
+device_gets its scratch pages, stages the blob in the hub object store, and
+notifies the decode worker over the data plane (``kv_deliver`` endpoint);
+the decode worker scatters the pages into HBM and unparks the lane.  Same
+handshake shape as block_manager.rs:119-146, host-staged.
+
+Wire pieces:
+
+  * queue ``{ns}_prefill_queue``  -- serialized PreprocessedRequest + return
+    address (decode component/instance)
+  * object  ``kvx/{request_id}``  -- the raw KV blob (deleted after import)
+  * endpoint ``kv_deliver``       -- completion notification into the
+    decode worker's engine
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Dict, Optional
+
+import numpy as np
+
+from ..protocols.common import PreprocessedRequest
+from ..runtime.component import Namespace, PushRouter
+from ..runtime.engine import Annotated, Context, EngineFn, ResponseStream
+
+logger = logging.getLogger("dynamo.disagg")
+
+PREFILL_QUEUE_SUFFIX = "_prefill_queue"  # reference {ns}_prefill_queue
+KV_DELIVER_ENDPOINT = "kv_deliver"
+KV_OBJ_PREFIX = "kvx"
+
+
+@dataclass
+class DisaggConfig:
+    """Reference DisaggRouterConf + queue cap (disagg_router.rs:25-90,
+    disagg_router.py)."""
+
+    # prefills at most this long (after prefix-cache credit) run locally
+    max_local_prefill_length: int = 512
+    # stop shipping prefills when the queue is this deep (prefill pool is
+    # saturated; local prefill beats queueing)
+    max_prefill_queue_depth: int = 16
+
+
+class DisaggRouter:
+    """Local-vs-remote prefill policy (reference disagg_router.py:66)."""
+
+    def __init__(self, cfg: Optional[DisaggConfig] = None) -> None:
+        self.cfg = cfg or DisaggConfig()
+
+    def prefill_remote(
+        self, prefill_length: int, prefix_hit_length: int, queue_depth: int
+    ) -> bool:
+        effective = prefill_length - prefix_hit_length
+        return (
+            effective > self.cfg.max_local_prefill_length
+            and queue_depth < self.cfg.max_prefill_queue_depth
+        )
+
+
+class PrefillQueue:
+    """Hub work queue facade (reference utils/nats_queue.py:24-56)."""
+
+    def __init__(self, namespace: Namespace) -> None:
+        self.hub = namespace.runtime.hub
+        self.name = f"{namespace.name}{PREFILL_QUEUE_SUFFIX}"
+
+    async def enqueue(self, msg: Dict[str, Any]) -> None:
+        await self.hub.queue_push(self.name, json.dumps(msg).encode())
+
+    async def dequeue(self, block: bool = True) -> Optional[Dict[str, Any]]:
+        payload = await self.hub.queue_pop(self.name, block=block)
+        return json.loads(payload) if payload is not None else None
+
+    async def depth(self) -> int:
+        return await self.hub.queue_depth(self.name)
+
+
+def _encode_blob(blob: np.ndarray) -> Dict[str, Any]:
+    return {"dtype": str(blob.dtype), "shape": list(blob.shape)}
+
+
+def _decode_blob(raw: bytes, meta: Dict[str, Any]) -> np.ndarray:
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(meta["dtype"])  # resolves bfloat16 via ml_dtypes
+    return np.frombuffer(raw, dtype=dtype).reshape(meta["shape"])
+
+
+class DisaggDecodeEngine:
+    """Decode-worker serving engine: conditionally ships prefills.
+
+    Serve this (instead of the engine) on the worker's ``generate`` endpoint
+    and attach :meth:`deliver_handler` on the ``kv_deliver`` endpoint.
+    """
+
+    def __init__(
+        self,
+        engine,  # JaxEngine (generate / generate_external / deliver_external)
+        namespace: Namespace,
+        component_name: str,
+        instance_id: int,
+        cfg: Optional[DisaggConfig] = None,
+        block_size: int = 16,
+    ) -> None:
+        self.engine = engine
+        self.namespace = namespace
+        self.component_name = component_name
+        self.instance_id = instance_id
+        self.router = DisaggRouter(cfg)
+        self.queue = PrefillQueue(namespace)
+        self.block_size = block_size
+        # observability: how many prefills went remote vs local
+        self.remote_prefills = 0
+        self.local_prefills = 0
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
+        data = request.data
+        req = (
+            PreprocessedRequest.from_dict(data) if isinstance(data, dict) else data
+        )
+        prefix_hit_tokens = (
+            (req.estimated_prefix_hit_num_blocks or 0) * self.block_size
+        )
+        try:
+            depth = await self.queue.depth()
+        except Exception:
+            depth = self.router.cfg.max_prefill_queue_depth  # force local
+        if not self.router.prefill_remote(
+            len(req.token_ids), prefix_hit_tokens, depth
+        ):
+            self.local_prefills += 1
+            return await self.engine.generate(request)
+
+        stream = await self.engine.generate_external(request)
+        if not self.engine.awaiting_external(request.id):
+            # admission failed (e.g. prompt > max_seq_len): the stream already
+            # carries the error; don't waste a prefill worker on it
+            self.local_prefills += 1
+            return stream
+        self.remote_prefills += 1
+        try:
+            await self.queue.enqueue(
+                {
+                    "request_id": request.id,
+                    "request": req.to_dict(),
+                    "decode_component": self.component_name,
+                    "decode_instance": self.instance_id,
+                }
+            )
+        except Exception as e:
+            # unpark the admitted lane now -- don't hold its slot + pages
+            # hostage to the delivery timeout for a job that never shipped
+            self.engine.fail_external(
+                request.id, f"failed to enqueue remote prefill: {e}"
+            )
+            raise
+        return stream
+
+    async def _deliver(self, request: Context[Any]) -> AsyncIterator[Annotated]:
+        d = request.data or {}
+        rid = d["request_id"]
+        ok = False
+        if d.get("error"):
+            # prefill worker reporting failure: fail the parked lane now
+            # instead of riding out the delivery timeout
+            ok = self.engine.fail_external(rid, str(d["error"]))
+        else:
+            obj = d["obj"]
+            raw = await self.namespace.runtime.hub.obj_get(obj)
+            if raw is not None:
+                blob = _decode_blob(raw, d["meta"])
+                ok = self.engine.deliver_external(
+                    rid, blob, int(d["first_token"])
+                )
+                await self.namespace.runtime.hub.obj_del(obj)
+            else:
+                logger.error("kv blob %s missing for request %s", obj, rid)
+                self.engine.fail_external(
+                    rid, f"prefilled KV blob {obj} missing from object store"
+                )
+
+        async def one() -> AsyncIterator[Annotated]:
+            yield Annotated.from_data({"ok": ok})
+
+        return ResponseStream(request.ctx, one())
+
+    def deliver_handler(self):
+        """AsyncEngine for the ``kv_deliver`` endpoint."""
+        return EngineFn(self._deliver)
+
+
+class PrefillWorker:
+    """Queue consumer: prefill remotely-shipped prompts and deliver their KV
+    (reference prefill_worker.py:139-207)."""
+
+    def __init__(self, engine, namespace: Namespace) -> None:
+        self.engine = engine
+        self.namespace = namespace
+        self.queue = PrefillQueue(namespace)
+        self.prefills_done = 0
+        self._task: Optional[asyncio.Task] = None
+        self._clients: Dict[str, PushRouter] = {}
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop(), name="prefill-worker")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await self._task
+            self._task = None
+        for router in self._clients.values():
+            with contextlib.suppress(Exception):
+                await router.client.close()
+        self._clients.clear()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                msg = await self.queue.dequeue(block=True)
+                if msg is None:
+                    continue
+                await self._process(msg)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("prefill worker failed on a queue item")
+
+    async def _process(self, msg: Dict[str, Any]) -> None:
+        rid = msg["request_id"]
+        req = PreprocessedRequest.from_dict(msg["request"])
+        try:
+            blob, first = await self.engine.prefill_export(req)
+        except Exception as e:
+            # tell the decode worker so its parked lane fails immediately
+            # (the decode-side timeout is only the backstop for lost items)
+            logger.exception("prefill_export failed for request %s", rid)
+            await self._notify(msg, {"request_id": rid, "error": str(e)})
+            return
+        obj = f"{KV_OBJ_PREFIX}/{rid}"
+        hub = self.namespace.runtime.hub
+        await hub.obj_put(obj, np.ascontiguousarray(blob).tobytes())
+        try:
+            await self._notify(
+                msg,
+                {
+                    "request_id": rid,
+                    "obj": obj,
+                    "meta": _encode_blob(blob),
+                    "first_token": first,
+                },
+            )
+        except Exception:
+            # undelivered blob must not sit in the hub forever (the decode
+            # side only deletes what it imports)
+            with contextlib.suppress(Exception):
+                await hub.obj_del(obj)
+            raise
+        self.prefills_done += 1
+        logger.info(
+            "prefilled %d tokens for %s -> %s/%d",
+            len(req.token_ids), rid,
+            msg["decode_component"], int(msg["decode_instance"]),
+        )
+
+    async def _notify(self, msg: Dict[str, Any], payload: Dict[str, Any]) -> None:
+        router = await self._router_for(msg["decode_component"])
+        stream = await router.direct(
+            Context.new(payload), int(msg["decode_instance"])
+        )
+        async for _item in stream:
+            pass  # single-ack stream
+
+    async def _router_for(self, component: str) -> PushRouter:
+        router = self._clients.get(component)
+        if router is None:
+            client = await (
+                self.namespace.component(component)
+                .endpoint(KV_DELIVER_ENDPOINT)
+                .client()
+            )
+            router = PushRouter(client)
+            self._clients[component] = router
+        return router

@@ -340,6 +340,10 @@ class HubClient:
             return None
         return blob
 
+    async def obj_del(self, name: str) -> bool:
+        hdr, _ = await self._call({"op": "obj_del", "name": name})
+        return bool(hdr.get("found"))
+
 
 def _split_entries(
     metas: List[Dict[str, Any]], blob: bytes
@@ -435,3 +439,6 @@ class StaticHub:
 
     async def obj_get(self, name: str) -> Optional[bytes]:
         return self.state.objects.get(name)
+
+    async def obj_del(self, name: str) -> bool:
+        return self.state.objects.pop(name, None) is not None

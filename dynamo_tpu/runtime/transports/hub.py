@@ -453,6 +453,9 @@ class HubServer:
                             await send({"seq": seq, "ok": False, "err": "not found"})
                         else:
                             await send({"seq": seq, "ok": True}, blob)
+                    elif op == "obj_del":
+                        existed = st.objects.pop(hdr["name"], None) is not None
+                        await send({"seq": seq, "ok": True, "found": existed})
                     elif op == "ping":
                         await send({"seq": seq, "ok": True})
                     else:

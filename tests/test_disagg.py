@@ -1,0 +1,277 @@
+"""Disaggregated prefill/decode tests: policy, the export/import KV
+handshake, and end-to-end equivalence -- a remotely-prefilled request must
+produce exactly the greedy tokens an aggregated engine produces.
+
+Reference parity: disagg_router.rs:25-90 (policy),
+examples/llm/components/prefill_worker.py:139-207 (queue consumer +
+write-back), block_manager.rs:119-146 (blockset export/import)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.llm.disagg import (
+    KV_DELIVER_ENDPOINT,
+    DisaggConfig,
+    DisaggDecodeEngine,
+    DisaggRouter,
+    PrefillWorker,
+)
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.component import DistributedRuntime, PushRouter
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+from tests.test_jax_engine import collect, make_engine, req
+
+
+def test_disagg_router_policy():
+    r = DisaggRouter(DisaggConfig(max_local_prefill_length=100,
+                                  max_prefill_queue_depth=4))
+    assert not r.prefill_remote(80, 0, 0)  # short: local
+    assert r.prefill_remote(200, 0, 0)  # long: remote
+    assert not r.prefill_remote(200, 150, 0)  # prefix credit makes it short
+    assert not r.prefill_remote(200, 0, 4)  # queue saturated: local
+
+
+def test_prefill_export_import_roundtrip(run):
+    """A prompt prefilled remotely (export on engine B, import on engine A)
+    must continue decoding exactly like a local prefill on engine A."""
+
+    async def body():
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        # identical weights on both sides (same seed)
+        agg = make_engine()
+        try:
+            expect, _ = await collect(agg, req(prompt, max_tokens=6))
+        finally:
+            await agg.stop()
+
+        decode = make_engine()
+        prefiller = make_engine()
+        try:
+            r = req(prompt, max_tokens=6)
+            blob, first = await prefiller.prefill_export(
+                PreprocessedRequest.from_dict(r.to_dict())
+            )
+            assert blob.shape[0] == decode.model_cfg.num_layers
+            ctx = Context.new(r)
+            stream = await decode.generate_external(ctx)
+            assert decode.deliver_external(ctx.id, blob, first)
+            tokens = []
+            async for item in stream:
+                d = item.data or {}
+                assert not item.is_error(), item.error_message()
+                tokens.extend(d.get("token_ids") or [])
+            assert tokens == expect
+            # all pages released afterwards
+            assert decode.kv.allocator.used_pages == 0
+        finally:
+            await decode.stop()
+            await prefiller.stop()
+
+    run(body())
+
+
+def test_deliver_for_dead_request_is_refused(run):
+    async def body():
+        engine = make_engine()
+        try:
+            assert not engine.deliver_external("nope", np.zeros(1), 5)
+            assert not engine.fail_external("nope", "boom")
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+async def _collect_error(stream):
+    async for item in stream:
+        if item.is_error():
+            return item.error_message()
+    return None
+
+
+def test_fail_external_errors_parked_request_and_frees_pages(run):
+    """A prefill worker's failure notification must fail the parked lane
+    immediately and return its slot + pages to the pool."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            ctx = Context.new(req([1, 2, 3, 4, 5, 6], max_tokens=4))
+            stream = await engine.generate_external(ctx)
+            await asyncio.sleep(0.1)  # let plan() admit + park the lane
+            assert engine.fail_external(ctx.id, "prefill OOM")
+            msg = await asyncio.wait_for(_collect_error(stream), 5)
+            assert msg is not None and "prefill OOM" in msg
+            assert not engine.awaiting_external(ctx.id)
+            assert engine.kv.allocator.used_pages == 0
+            assert engine.sched.num_active == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_external_kv_timeout_fails_parked_request(run):
+    """A lost delivery (crashed prefill worker, dropped queue item) must not
+    park the lane forever: the engine-side deadline fails it."""
+
+    async def body():
+        engine = make_engine(external_kv_timeout_s=0.3)
+        try:
+            ctx = Context.new(req([9, 8, 7, 6, 5], max_tokens=4))
+            stream = await engine.generate_external(ctx)
+            msg = await asyncio.wait_for(_collect_error(stream), 10)
+            assert msg is not None and "timed out" in msg
+            assert engine.kv.allocator.used_pages == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_misshaped_delivery_fails_only_that_request(run):
+    """A mis-configured prefill worker (wrong page size / model geometry)
+    must fail its own request, not nuke the whole decode batch."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            # a healthy local request sharing the batch
+            ok_task = asyncio.ensure_future(
+                collect(engine, req([1, 2, 3], max_tokens=6))
+            )
+            await asyncio.sleep(0.05)
+            ctx = Context.new(req([4, 5, 6, 7], max_tokens=4))
+            stream = await engine.generate_external(ctx)
+            await asyncio.sleep(0.1)
+            bad = np.zeros((1, 2, 3, 4, 5, 6), np.float32)  # wrong everything
+            assert engine.deliver_external(ctx.id, bad, 1)
+            msg = await asyncio.wait_for(_collect_error(stream), 5)
+            assert msg is not None and "does not match decode geometry" in msg
+            tokens, finish = await asyncio.wait_for(ok_task, 10)
+            assert len(tokens) == 6  # the healthy request was untouched
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_oversized_remote_prompt_is_not_enqueued(run):
+    """Admission failure (prompt > max_seq_len) must surface the error and
+    skip the prefill queue entirely."""
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        rt = await DistributedRuntime.detached(f"{host}:{port}")
+        ns = rt.namespace("disagg")
+        engine = make_engine(max_seq_len=32)
+        disagg = DisaggDecodeEngine(
+            engine, ns, "decode", instance_id=0,
+            cfg=DisaggConfig(max_local_prefill_length=8),
+        )
+        try:
+            ctx = Context.new(req(list(range(40)), max_tokens=4).to_dict())
+            stream = await disagg.generate(ctx)
+            msg = await asyncio.wait_for(_collect_error(stream), 5)
+            assert msg is not None and "max_seq_len" in msg
+            assert await disagg.queue.depth() == 0
+            assert disagg.remote_prefills == 0
+        finally:
+            await engine.stop()
+            await rt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_disagg_end_to_end_matches_aggregated(run):
+    """Full stack: decode worker + prefill worker over a hub.  Long prompts
+    ship to the prefill pool; output must equal aggregated serving."""
+
+    async def body():
+        long_prompt = [7, 3, 7, 3, 5, 5, 9, 1, 2, 8, 4, 6]
+        short_prompt = [1, 2, 3]
+
+        agg = make_engine()
+        try:
+            expect_long, _ = await collect(agg, req(long_prompt, max_tokens=6))
+            expect_short, _ = await collect(agg, req(short_prompt, max_tokens=6))
+        finally:
+            await agg.stop()
+
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+
+        # decode worker
+        drt = await DistributedRuntime.detached(addr)
+        dns = drt.namespace("disagg")
+        dcomp = dns.component("decode")
+        decode_engine = make_engine()
+        disagg = DisaggDecodeEngine(
+            decode_engine,
+            dns,
+            "decode",
+            instance_id=drt.primary_lease,  # serve() registers under this lease
+            cfg=DisaggConfig(max_local_prefill_length=8,
+                             max_prefill_queue_depth=4),
+            block_size=4,
+        )
+        await dcomp.endpoint(KV_DELIVER_ENDPOINT).serve(disagg.deliver_handler())
+        await dcomp.endpoint("generate").serve(disagg)
+
+        # prefill worker (own runtime + engine, same weights)
+        prt = await DistributedRuntime.detached(addr)
+        pns = prt.namespace("disagg")
+        prefill_engine = make_engine()
+        pw = PrefillWorker(prefill_engine, pns)
+        await pw.start()
+
+        # caller
+        crt = await DistributedRuntime.detached(addr)
+        gen_client = await (
+            crt.namespace("disagg").component("decode").endpoint("generate").client()
+        )
+        await gen_client.wait_for_instances()
+        router = PushRouter(gen_client)
+
+        async def ask(prompt):
+            ctx = Context.new(req(prompt, max_tokens=6).to_dict())
+            stream = await router.generate(ctx)
+            toks = []
+            async for item in stream:
+                assert not item.is_error(), item.error_message()
+                d = item.data or {}
+                toks.extend(d.get("token_ids") or [])
+            return toks, ctx.id
+
+        try:
+            got_long, long_rid = await ask(long_prompt)
+            assert got_long == expect_long
+            assert disagg.remote_prefills == 1  # 12 tokens > 8 -> remote
+            assert pw.prefills_done == 1
+            got_short, _ = await ask(short_prompt)
+            assert got_short == expect_short
+            assert disagg.local_prefills == 1  # 3 tokens stayed local
+            # the staged KV blob was cleaned out of the object store
+            assert await crt.hub.obj_get(f"kvx/{long_rid}") is None
+        finally:
+            await pw.stop()
+            await prefill_engine.stop()
+            await decode_engine.stop()
+            await gen_client.close()
+            for rt in (drt, prt, crt):
+                await rt.shutdown()
+            await hub.stop()
+
+    run(body())
