@@ -47,6 +47,7 @@ from .step import (
     prefill_and_sample,
     prefill_buckets,
     prefill_suffix_and_sample,
+    update_lane,
 )
 
 logger = logging.getLogger("dynamo.engine")
@@ -398,8 +399,10 @@ class JaxEngine:
         )
         seq.awaiting_kv = False
         ev = self.sched.commit_prefill_token(seq, first_token)
-        # membership semantics changed (parked -> live): full state rebuild
-        self.sched.layout_version += 1
+        # membership semantics changed (parked -> live): fold the lane into
+        # the device state at the next dispatch
+        if seq.slot >= 0:
+            self.sched.dirty_slots.add(seq.slot)
         return ev
 
     async def prefill_export(
@@ -457,11 +460,16 @@ class JaxEngine:
 
         Each iteration dispatches decode block i+1 *before* materializing
         block i's sampled tokens, so the ~RTT device->host transfer overlaps
-        the next block's compute.  Safety of the one-block lag rests on the
-        device executing launches in order: writes from a lane whose request
-        finished at commit time land before any later-dispatched prefill
-        reuses its freed pages, and the post-release state push deactivates
-        the lane for subsequent blocks.
+        the next block's compute.  Batch-membership changes (admission,
+        completion, revival) reach the device as per-lane row scatters
+        (``_apply_dirty_rows``), never draining the pipeline: on a tunneled
+        TPU the device->host round trip is ~100ms, so a drain per admission
+        would serialize every block behind a full RTT.  Safety of the
+        one-block lag rests on the device executing launches in order:
+        writes from a lane whose request finished at commit time land before
+        any later-dispatched prefill reuses its freed pages, and the
+        later-dispatched row scatter deactivates the lane for subsequent
+        blocks.
         """
         loop = asyncio.get_running_loop()
         assert self._wake is not None
@@ -495,17 +503,6 @@ class JaxEngine:
                         chunk_pages=self.cfg.grow_chunk_pages,
                     )
                 self._revive_paused_lanes()
-                if pending and self._dev_version != self.sched.layout_version:
-                    # A layout change forces a device-state rebuild from the
-                    # host mirrors, which exclude the still-uncommitted
-                    # in-flight work -- rebuilding now would re-decode and
-                    # double-commit the in-flight block.  Drain the pipeline
-                    # first (forfeits the one-block overlap for this tick).
-                    events = await loop.run_in_executor(
-                        self._ex, self._commit_all, pending
-                    )
-                    pending = []
-                    self._dispatch(events)
                 fresh: List[Any] = []
                 for seq, prompt_len in plan.prefills:
                     if seq.slot < 0 or self.sched.slots[seq.slot] is not seq:
@@ -538,12 +535,16 @@ class JaxEngine:
                 pending = []
                 self._pending_injects.clear()
                 self._fail_all(f"engine error: {e}")
+                self._dev = None  # full rebuild once work resumes
+                self.sched.dirty_slots.clear()
                 await asyncio.sleep(0.01)
 
     def _revive_paused_lanes(self) -> None:
         """A lane that hit its device-side limit self-deactivated; if growth
-        since raised what its limit would be, force a full state rebuild so
-        the lane resumes (growth-only refreshes never touch ``active``)."""
+        since raised what its limit would be, mark the lane dirty so the next
+        dispatch folds the raised limit (and ``active``) back in with a row
+        scatter -- no pipeline drain (growth-only refreshes never touch
+        ``active``)."""
         sched = self.sched
         limits = self._compute_limits()
         for b, seq in enumerate(sched.slots):
@@ -553,8 +554,7 @@ class JaxEngine:
                 int(sched.seq_lens[b]) >= int(self._limit_host[b])
                 and limits[b] > self._limit_host[b]
             ):
-                sched.layout_version += 1
-                return
+                sched.dirty_slots.add(b)
 
     def _handle_stalled_admission(self) -> None:
         """Nothing running, nothing admitted: requests whose prompts can never
@@ -724,10 +724,9 @@ class JaxEngine:
         else:
             sampled = self._dispatch_full_prefill(seq, seq.prompt, seq.pages)
             bucket = pick_bucket(self.buckets, prompt_len)
-        # bring decode state current (admission bumped the layout version),
+        # bring decode state current (admission marked the lane dirty),
         # then inject the device-resident first token into its lane
-        if self._dev is None or self._dev_version != self.sched.layout_version:
-            self._push_device_state()
+        self._sync_device_state()
         pf = InflightPrefill(sampled=sampled, seq=seq, slot=seq.slot)
         self._pending_injects[seq.slot] = pf
         self._dev["tokens"] = inject_token(self._dev["tokens"], seq.slot, sampled)
@@ -756,6 +755,123 @@ class JaxEngine:
                 len(seq.pages) * self.cfg.page_size,
             )
         return limit
+
+    def _lane_stop_row(self, seq: Optional[SeqState]) -> np.ndarray:
+        """Device-swallowable stop tokens for one lane (see
+        ``_push_device_state``): only when the host rules coincide exactly."""
+        E = self.cfg.device_stop_width
+        row = np.full((E,), -1, np.int32)
+        if seq is not None and seq.stop.min_tokens is None:
+            ids = list(seq.stop.stop_token_ids_hidden or [])
+            if not seq.stop.ignore_eos:
+                ids += list(seq.eos_ids)
+            for j, t in enumerate(ids[:E]):
+                row[j] = t
+        return row
+
+    def _apply_dirty_rows(self) -> None:
+        """Fold mirror changes for dirty lanes into the device-resident state
+        with per-row scatters (executor thread).
+
+        This replaces the pipeline drain the engine used to pay on every
+        batch-membership change: the scatters are dispatched after any
+        in-flight decode blocks, which therefore run against the old rows --
+        their stale lanes' output is discarded at commit (slot snapshots +
+        ``seq.finish`` guards in ``Scheduler.commit_block``), and any pages
+        a stale lane's tail writes touch are either still owned by it or are
+        re-prefilled by a later-dispatched admission before reuse (device
+        executes dispatches in order).  Correct only because dirty lanes
+        never carry uncommitted in-flight decode progress: admission,
+        release, revival and external-KV arrival all act on lanes that are
+        parked, fresh, or committed-through."""
+        sched = self.sched
+        d = self._dev
+        assert d is not None
+        limits = self._compute_limits()
+        for b in sorted(sched.dirty_slots):
+            seq = sched.slots[b]
+            row = {
+                "token": np.int32(sched.tokens[b]),
+                "seq_len": np.int32(sched.seq_lens[b]),
+                "limit": np.int32(limits[b]),
+                "active": np.bool_(
+                    seq is not None
+                    and limits[b] > int(sched.seq_lens[b])
+                    and not seq.awaiting_kv
+                ),
+                "stop": self._lane_stop_row(seq),
+                "pages": sched.page_table[b].copy(),
+                "temp": np.float32(0.0),
+                "top_p": np.float32(1.0),
+                "top_k": np.int32(0),
+            }
+            if seq is not None:
+                so = seq.sampling
+                if so.temperature is not None:
+                    row["temp"] = np.float32(so.temperature)
+                elif so.top_p is not None or so.top_k is not None:
+                    row["temp"] = np.float32(1.0)
+                row["top_p"] = np.float32(so.top_p if so.top_p is not None else 1.0)
+                row["top_k"] = np.int32(so.top_k or 0)
+            samp = d["sampling"]
+            (
+                d["tokens"],
+                d["seq_lens"],
+                d["limit_lens"],
+                d["active"],
+                d["stop_ids"],
+                d["page_table"],
+                temp,
+                top_p,
+                top_k,
+            ) = update_lane(
+                d["tokens"],
+                d["seq_lens"],
+                d["limit_lens"],
+                d["active"],
+                d["stop_ids"],
+                d["page_table"],
+                samp.temperature,
+                samp.top_p,
+                samp.top_k,
+                jnp.int32(b),
+                row,
+            )
+            d["sampling"] = SamplingParams(temperature=temp, top_p=top_p, top_k=top_k)
+            self._limit_host[b] = limits[b]
+            # a pending inject for this slot holds the real first token (the
+            # mirror still has the placeholder); re-apply it on top
+            pf = self._pending_injects.get(b)
+            if pf is not None:
+                if sched.slots[b] is pf.seq and pf.seq.finish is None:
+                    d["tokens"] = inject_token(d["tokens"], jnp.int32(b), pf.sampled)
+                else:
+                    del self._pending_injects[b]
+        sched.dirty_slots.clear()
+        self._dev_version = sched.layout_version
+
+    def _sync_device_state(self) -> None:
+        """Bring the device-resident decode state current (executor thread):
+        full rebuild only when none exists; otherwise per-lane row scatters
+        for membership changes and a table/limit swap for page growth --
+        neither drains the decode pipeline."""
+        sched = self.sched
+        if self._dev is None:
+            self._push_device_state()
+            return
+        if sched.dirty_slots:
+            self._apply_dirty_rows()
+        if self._dev_growth != sched.growth_version:
+            # growth-only refresh: swap the page table and raise the limits,
+            # keeping tokens/seq_lens/active device-resident.  ``active`` is
+            # left as the device carry: paused lanes revive through
+            # _revive_paused_lanes marking them dirty.
+            limit = self._compute_limits()
+            # numpy copy for the same aliasing reason as _push_device_state
+            self._dev["page_table"] = jnp.asarray(sched.page_table.copy())
+            self._dev["limit_lens"] = jnp.asarray(limit)
+            self._dev_growth = sched.growth_version
+            self._limit_host = limit
 
     def _push_device_state(self) -> None:
         """Rebuild device-resident decode state from the scheduler mirrors."""
@@ -813,31 +929,14 @@ class JaxEngine:
         self._dev_version = sched.layout_version
         self._dev_growth = sched.growth_version
         self._limit_host = limit
+        sched.dirty_slots.clear()
 
     def _dispatch_block(self) -> Optional["InflightBlock"]:
-        """Enqueue one decode block; does not wait for results.
-
-        Page growth happened loop-side (ensure_decode_capacity in _run)
-        *before* the pipeline-drain decision, so a rebuilt device state here
-        never overwrites uncommitted in-flight work."""
+        """Enqueue one decode block; does not wait for results."""
         K = self.cfg.decode_block_size
         if self.sched.num_active == 0:
             return None  # everything was preempted
-        if self._dev is None or self._dev_version != self.sched.layout_version:
-            self._push_device_state()
-        elif self._dev_growth != self.sched.growth_version:
-            # growth-only refresh: swap the page table and raise the limits,
-            # keeping tokens/seq_lens/active device-resident -- the pipeline
-            # never drains for page growth.  ``active`` is left as the device
-            # carry: raising a paused lane's limit without knowing its device
-            # seq could make it write one position past its pages; paused
-            # lanes instead revive via the full push forced below.
-            limit = self._compute_limits()
-            # numpy copy for the same aliasing reason as _push_device_state
-            self._dev["page_table"] = jnp.asarray(self.sched.page_table.copy())
-            self._dev["limit_lens"] = jnp.asarray(limit)
-            self._dev_growth = self.sched.growth_version
-            self._limit_host = limit
+        self._sync_device_state()
         d = self._dev
         (
             sampled,

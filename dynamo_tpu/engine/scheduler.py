@@ -38,8 +38,11 @@ class SchedulerConfig:
     max_batch_size: int = 8
     max_seq_len: int = 2048
     page_size: int = 16
-    # max prompts prefilled per tick (each prefill is one device dispatch)
-    max_prefill_per_tick: int = 1
+    # max prompts prefilled per tick (each prefill is one async device
+    # dispatch); None = as many as there are free slots.  Uncapped admission
+    # fills the decode batch in one tick, so a burst of N prompts costs one
+    # partially-occupied decode block instead of N
+    max_prefill_per_tick: Optional[int] = None
     # KV block size for router-visible block identity (token hashing); usually
     # equals page_size but decoupled (reference recommends 128 for routing).
     block_size: Optional[int] = None
@@ -138,12 +141,15 @@ class Scheduler:
         self.seq_lens = np.zeros((B,), np.int32)
         self.page_table = np.zeros((B, self.max_pages), np.int32)
         # layout_version: slot membership changed (admission / release /
-        # preemption) -- the engine must drain its pipeline and rebuild the
-        # full device state.  growth_version: pages were appended to live
-        # lanes -- the engine only refreshes the device page table and
-        # limits, keeping the decode pipeline running.
+        # preemption).  growth_version: pages were appended to live lanes --
+        # the engine refreshes the device page table and limits, keeping the
+        # decode pipeline running.  dirty_slots: lanes whose mirrors changed
+        # (admission/release); the engine folds them into the device-resident
+        # decode state with per-row scatters instead of a full rebuild, so
+        # the decode pipeline never drains for batch-membership changes.
         self.layout_version = 0
         self.growth_version = 0
+        self.dirty_slots: set = set()
 
     # -- queue/observability -------------------------------------------------
 
@@ -210,10 +216,8 @@ class Scheduler:
         """Admit waiting requests into free slots (page permitting), then
         decide whether a decode step runs."""
         plan = TickPlan()
-        while (
-            self.waiting
-            and len(plan.prefills) < self.cfg.max_prefill_per_tick
-        ):
+        cap = self.cfg.max_prefill_per_tick
+        while self.waiting and (cap is None or len(plan.prefills) < cap):
             slot = self._free_slot()
             if slot is None:
                 break
@@ -294,6 +298,7 @@ class Scheduler:
         self.seq_lens[b] = len(seq.prompt)
         self.tokens[b] = seq.prompt[-1] if seq.prompt else 0
         self.layout_version += 1
+        self.dirty_slots.add(b)
 
     # -- decode bookkeeping --------------------------------------------------
 
@@ -383,6 +388,7 @@ class Scheduler:
             self.seq_lens[b] = 0
             self.tokens[b] = 0
             self.layout_version += 1
+            self.dirty_slots.add(b)
         # registered blocks outlive the sequence (refcount drops; the block
         # turns inactive-reusable at zero); only exclusively-owned pages and
         # never-registered completions return to the free list

@@ -207,6 +207,47 @@ def inject_token(tokens: jax.Array, slot: jax.Array, token: jax.Array) -> jax.Ar
     return tokens.at[slot].set(token[0])
 
 
+@partial(
+    jax.jit,
+    donate_argnames=(
+        "tokens", "seq_lens", "limit_lens", "active", "stop_ids",
+        "page_table", "temp", "top_p", "top_k",
+    ),
+)
+def update_lane(
+    tokens: jax.Array,  # [B]
+    seq_lens: jax.Array,  # [B]
+    limit_lens: jax.Array,  # [B]
+    active: jax.Array,  # [B] bool
+    stop_ids: jax.Array,  # [B, E]
+    page_table: jax.Array,  # [B, P]
+    temp: jax.Array,  # [B]
+    top_p: jax.Array,  # [B]
+    top_k: jax.Array,  # [B]
+    slot: jax.Array,  # scalar i32 (dynamic -> one cached executable)
+    row: dict,  # per-lane values: token/seq_len/limit/active/stop/pages/...
+) -> Tuple[jax.Array, ...]:
+    """Fold one lane's host-side state into the device-resident decode state.
+
+    This is how batch membership changes (admission, completion, revival,
+    external-KV arrival) reach the device WITHOUT draining the decode
+    pipeline: the scatter is dispatched after any in-flight decode blocks,
+    so those blocks run against the old state (their stale lanes' output is
+    discarded at commit via slot snapshots) and every later block sees the
+    new lane.  One dispatch, no host round trip."""
+    return (
+        tokens.at[slot].set(row["token"]),
+        seq_lens.at[slot].set(row["seq_len"]),
+        limit_lens.at[slot].set(row["limit"]),
+        active.at[slot].set(row["active"]),
+        stop_ids.at[slot].set(row["stop"]),
+        page_table.at[slot].set(row["pages"]),
+        temp.at[slot].set(row["temp"]),
+        top_p.at[slot].set(row["top_p"]),
+        top_k.at[slot].set(row["top_k"]),
+    )
+
+
 def prefill_buckets(page_size: int, max_len: int) -> list:
     """Power-of-two length buckets, all multiples of page_size."""
     max_len = -(-max_len // page_size) * page_size  # round up to a page multiple
