@@ -29,6 +29,7 @@ evicted block's pages can be copied out before the free-list reclaim.
 from __future__ import annotations
 
 import collections
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -73,6 +74,11 @@ class PagePool:
         )
         self.prefix_hits = 0
         self.prefix_lookups = 0
+        # alloc/free/registry mutations are locked: the scheduler runs on
+        # the tick-loop thread while JaxEngine._prefill_export (disagg
+        # prefill-worker path) allocates scratch pages on the engine
+        # executor thread
+        self._lock = threading.RLock()
 
     # -- capacity ------------------------------------------------------------
 
@@ -97,17 +103,21 @@ class PagePool:
         registered blocks (reuse-priority: most recently released last)."""
         if n <= 0:
             return []
-        while len(self._free) < n and self._inactive:
-            self._evict_one()
-        if len(self._free) < n:
-            raise OutOfPages(f"requested {n} pages, {len(self._free)} free")
-        out = self._free[-n:][::-1]
-        del self._free[len(self._free) - n:]
-        return out
+        with self._lock:
+            while len(self._free) < n and self._inactive:
+                self._evict_one()
+            if len(self._free) < n:
+                raise OutOfPages(
+                    f"requested {n} pages, {len(self._free)} free"
+                )
+            out = self._free[-n:][::-1]
+            del self._free[len(self._free) - n:]
+            return out
 
     def free(self, pages: Sequence[int]) -> None:
         """Return *owned* (unregistered) pages to the free list."""
-        self._free.extend(pages)
+        with self._lock:
+            self._free.extend(pages)
 
     def _evict_one(self) -> None:
         seq_hash, _ = self._inactive.popitem(last=False)
@@ -123,25 +133,27 @@ class PagePool:
     def match(self, sequence_hashes: Sequence[int]) -> List[RegisteredBlock]:
         """Longest resident prefix of ``sequence_hashes`` (reference
         pool.rs match_sequence_hashes).  Does not take references."""
-        out: List[RegisteredBlock] = []
-        for h in sequence_hashes:
-            blk = self._registered.get(h)
-            if blk is None:
-                break
-            out.append(blk)
-        self.prefix_lookups += len(sequence_hashes)
-        self.prefix_hits += len(out)
-        return out
+        with self._lock:
+            out: List[RegisteredBlock] = []
+            for h in sequence_hashes:
+                blk = self._registered.get(h)
+                if blk is None:
+                    break
+                out.append(blk)
+            self.prefix_lookups += len(sequence_hashes)
+            self.prefix_hits += len(out)
+            return out
 
     def acquire(self, sequence_hash: int) -> Optional[RegisteredBlock]:
         """Take a reference on a resident block (revives inactive)."""
-        blk = self._registered.get(sequence_hash)
-        if blk is None:
-            return None
-        if blk.refs == 0:
-            self._inactive.pop(sequence_hash, None)
-        blk.refs += 1
-        return blk
+        with self._lock:
+            blk = self._registered.get(sequence_hash)
+            if blk is None:
+                return None
+            if blk.refs == 0:
+                self._inactive.pop(sequence_hash, None)
+            blk.refs += 1
+            return blk
 
     def register(
         self,
@@ -160,16 +172,17 @@ class PagePool:
             raise ValueError(
                 f"block needs {self.pages_per_block} pages, got {len(pages)}"
             )
-        if sequence_hash in self._registered:
-            return False
-        self._registered[sequence_hash] = RegisteredBlock(
-            sequence_hash=sequence_hash,
-            pages=tuple(pages),
-            refs=1,
-            block_hash=block_hash,
-            parent_sequence_hash=parent_sequence_hash,
-            position=position,
-        )
+        with self._lock:
+            if sequence_hash in self._registered:
+                return False
+            self._registered[sequence_hash] = RegisteredBlock(
+                sequence_hash=sequence_hash,
+                pages=tuple(pages),
+                refs=1,
+                block_hash=block_hash,
+                parent_sequence_hash=parent_sequence_hash,
+                position=position,
+            )
         if self.event_sink is not None:
             self.event_sink(
                 {
@@ -189,15 +202,18 @@ class PagePool:
     def release(self, sequence_hash: int) -> None:
         """Drop one reference; at zero the block turns inactive (reusable,
         evictable LRU)."""
-        blk = self._registered.get(sequence_hash)
-        if blk is None:
-            return
-        if blk.refs <= 0:
-            raise RuntimeError(f"negative refs for block {sequence_hash:x}")
-        blk.refs -= 1
-        if blk.refs == 0:
-            self._inactive[sequence_hash] = None
-            self._inactive.move_to_end(sequence_hash)
+        with self._lock:
+            blk = self._registered.get(sequence_hash)
+            if blk is None:
+                return
+            if blk.refs <= 0:
+                raise RuntimeError(
+                    f"negative refs for block {sequence_hash:x}"
+                )
+            blk.refs -= 1
+            if blk.refs == 0:
+                self._inactive[sequence_hash] = None
+                self._inactive.move_to_end(sequence_hash)
 
     def is_registered(self, sequence_hash: int) -> bool:
         return sequence_hash in self._registered

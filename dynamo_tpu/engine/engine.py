@@ -312,6 +312,11 @@ class JaxEngine:
         if request_id not in self._external:
             return False
         self._deliveries[request_id] = (kv_blob, int(first_token))
+        # the KV is in hand: the remote-prefill deadline's job is done.  A
+        # delivery that arrives while the request still waits for a slot
+        # must not be discarded by the timeout scan (the remaining wait is
+        # for decode capacity, not for the prefill worker).
+        self._external_deadline.pop(request_id, None)
         if self._wake is not None:
             self._wake.set()
         return True

@@ -18,6 +18,7 @@ pool.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, List, Optional
 
 import jax
@@ -28,13 +29,18 @@ from .config import ModelConfig
 
 
 class PageAllocator:
-    """LIFO free-list over page ids 1..num_pages-1 (0 is the trash page)."""
+    """LIFO free-list over page ids 1..num_pages-1 (0 is the trash page).
+
+    alloc/free are locked: the scheduler allocates on the tick-loop thread
+    while ``JaxEngine._prefill_export`` (the disagg prefill-worker path)
+    allocates scratch pages on the engine executor thread."""
 
     def __init__(self, num_pages: int) -> None:
         if num_pages < 2:
             raise ValueError("need at least 2 pages (page 0 is reserved)")
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._lock = threading.Lock()
 
     @property
     def free_pages(self) -> int:
@@ -47,14 +53,16 @@ class PageAllocator:
     def alloc(self, n: int) -> List[int]:
         if n <= 0:
             return []
-        if n > len(self._free):
-            raise OutOfPages(f"requested {n} pages, {len(self._free)} free")
-        out = self._free[-n:][::-1]
-        del self._free[len(self._free) - n :]
-        return out
+        with self._lock:
+            if n > len(self._free):
+                raise OutOfPages(f"requested {n} pages, {len(self._free)} free")
+            out = self._free[-n:][::-1]
+            del self._free[len(self._free) - n :]
+            return out
 
     def free(self, pages: List[int]) -> None:
-        self._free.extend(pages)
+        with self._lock:
+            self._free.extend(pages)
 
 
 class PagedKVCache:

@@ -138,6 +138,12 @@ class DisaggDecodeEngine:
         prefix_hit_tokens = (
             (req.estimated_prefix_hit_num_blocks or 0) * self.block_size
         )
+        effective = len(req.token_ids) - prefix_hit_tokens
+        if effective <= self.router.cfg.max_local_prefill_length:
+            # short prefill can only run locally: skip the hub round trip
+            # for the queue depth on the request hot path
+            self.local_prefills += 1
+            return await self.engine.generate(request)
         try:
             depth = await self.queue.depth()
         except Exception:
@@ -243,6 +249,9 @@ class PrefillWorker:
                 raise
             except Exception:
                 logger.exception("prefill worker failed on a queue item")
+                # a persistent fault (hub down, conn refused) must not spin
+                # the loop hot re-raising the same error
+                await asyncio.sleep(0.5)
 
     async def _process(self, msg: Dict[str, Any]) -> None:
         rid = msg["request_id"]

@@ -275,3 +275,47 @@ def test_disagg_end_to_end_matches_aggregated(run):
             await hub.stop()
 
     run(body())
+
+
+def test_delivery_while_waiting_for_slot_outlives_timeout(run):
+    """A KV delivery that arrives while the request is still queued (decode
+    batch full, no slot yet) must clear the remote-prefill deadline: the
+    remaining wait is for decode capacity, not the prefill worker, so the
+    request must decode once a slot frees -- not die with a spurious
+    'timed out waiting for remote prefill KV'."""
+
+    async def body():
+        prompt = [3, 1, 4, 1, 5]
+        prefiller = make_engine()
+        decode = make_engine(max_batch_size=1, external_kv_timeout_s=0.5)
+        try:
+            r = req(prompt, max_tokens=4)
+            blob, first = await prefiller.prefill_export(
+                PreprocessedRequest.from_dict(r.to_dict())
+            )
+            # request A holds the only slot, parked without a delivery; it
+            # dies at the 0.5s deadline, freeing the slot
+            ctx_a = Context.new(req([9, 8, 7], max_tokens=4))
+            stream_a = await decode.generate_external(ctx_a)
+            # request B queues behind it; its KV arrives immediately
+            ctx_b = Context.new(r)
+            stream_b = await decode.generate_external(ctx_b)
+            assert decode.deliver_external(ctx_b.id, blob, first)
+
+            msg_a = await asyncio.wait_for(_collect_error(stream_a), 10)
+            assert msg_a is not None and "timed out" in msg_a
+
+            async def drain_b():
+                tokens = []
+                async for item in stream_b:
+                    assert not item.is_error(), item.error_message()
+                    tokens.extend((item.data or {}).get("token_ids") or [])
+                return tokens
+
+            tokens = await asyncio.wait_for(drain_b(), 10)
+            assert len(tokens) == 4
+        finally:
+            await decode.stop()
+            await prefiller.stop()
+
+    run(body())
