@@ -7,17 +7,54 @@ inactive batch slots scatter their writes there, so dead lanes never corrupt
 live state and every step runs with fully static shapes (XLA requirement).
 
 These are the XLA-composed implementations (gather + einsum; XLA fuses the
-mask/softmax chain).  The decode gather materializes [B, P*page, Hkv, D]
-per step -- a Pallas kernel that streams pages through VMEM is the planned
-replacement on the hot loop once validated against these functions.
+mask/softmax chain).  On TPU the decode hot loop routes through the Pallas
+kernel in dynamo_tpu.ops.paged_attention instead (see
+``decode_attention_dispatch``): the XLA gather materializes
+[B, P*page, Hkv, D] per step, the kernel streams pages HBM->VMEM once.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
 
 _NEG_INF = -1e30
+
+
+def _pallas_decode_enabled(page_size: int) -> bool:
+    """Trace-time choice of the decode-attention backend.
+
+    ``DYN_PALLAS_DECODE=1/0`` forces it; default is auto -- on when the
+    backend is a TPU and the page size meets the kernel's sublane tiling
+    (>= 8).  The XLA path stays as the universal fallback (CPU tests, tiny
+    page sizes)."""
+    env = os.environ.get("DYN_PALLAS_DECODE")
+    if env is not None:
+        return env not in ("0", "false", "")
+    if page_size < 8:
+        return False
+    try:
+        return any("TPU" in d.device_kind for d in jax.devices())
+    except Exception:
+        return False
+
+
+def decode_attention_dispatch(
+    q: jax.Array,  # [B, Hq, D]
+    kv_pages: jax.Array,  # [2, num_pages, page_size, Hkv, D]
+    page_table: jax.Array,  # [B, P]
+    kv_lens: jax.Array,  # [B]
+) -> jax.Array:
+    """Decode attention: Pallas page-streaming kernel on TPU, XLA gather
+    elsewhere.  Resolved at trace time (static), so each compiled executable
+    embeds exactly one backend."""
+    if _pallas_decode_enabled(kv_pages.shape[2]):
+        from ..ops.paged_attention import paged_decode_attention as pallas_decode
+
+        return pallas_decode(q, kv_pages, page_table, kv_lens)
+    return paged_decode_attention(q, kv_pages, page_table, kv_lens)
 
 
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:

@@ -69,7 +69,7 @@ def _decode_once(
         # q/k/v arrive [B, 1, H, D]; squeeze the singleton time axis.
         q1, k1, v1 = q[:, 0], k[:, 0], v[:, 0]
         new_kv = att.write_decode_kv(layer_kv, k1, v1, page_table, positions)
-        out = att.paged_decode_attention(q1, new_kv, page_table, positions + 1)
+        out = att.decode_attention_dispatch(q1, new_kv, page_table, positions + 1)
         return out[:, None], new_kv
 
     hidden, kv_pages = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)

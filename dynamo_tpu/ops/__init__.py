@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the serving hot path.
+
+XLA-composed fallbacks for every op live in dynamo_tpu.engine.attention;
+these kernels are drop-in replacements validated against them in
+tests/test_ops.py.
+"""
+
+from .paged_attention import paged_decode_attention  # noqa: F401

@@ -1,0 +1,155 @@
+"""Pallas paged-attention decode kernel (TPU).
+
+Replaces the XLA gather path (engine/attention.py paged_decode_attention,
+the classic paged-attention "v1" shape) on the decode hot loop.  The XLA
+path materializes ``[B, P*page, Hkv, D]`` in HBM every step -- gather write
++ attention read, twice the KV traffic.  This kernel instead streams each
+lane's pages HBM->VMEM directly, guided by the page table, and keeps the
+softmax accumulation (flash-style online max/sum) in f32 VMEM scratch; KV
+is read from HBM exactly once and nothing is written back but the [B, Hq,
+D] output.
+
+Mechanics: the grid is ``(B, P)`` and the page table + kv lengths ride as
+scalar-prefetch operands, so the BlockSpec index maps can dereference
+``page_table[b, p]`` -- Pallas' pipeline machinery then double-buffers the
+page fetches automatically (the fetch of page p+1 overlaps the attention
+math on page p).  The same KV pool array is passed twice (K half / V half
+via the leading axis index map); no copy is made -- both operands alias the
+one HBM buffer.
+
+Numerics match the XLA path: f32 scores/softmax, bf16 (input dtype)
+probs @ V accumulation per page chunk, f32 running rescale.  Inactive
+lanes (kv_len == 0) produce zeros.  Capability parity: vLLM's CUDA
+paged_attention v1 (the engine the reference shells out to --
+lib/llm/src/engines.rs MultiNodeConfig vllm path); built TPU-native here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    pt_ref,  # [B, P] page table (SMEM)
+    len_ref,  # [B] kv lengths (SMEM)
+    # blocked operands
+    k_ref,  # [1, 1, page, Hkv, D] current page's keys (VMEM)
+    v_ref,  # [1, 1, page, Hkv, D] current page's values (VMEM)
+    q_ref,  # [1, Hq, D] this lane's query (VMEM)
+    o_ref,  # [1, Hq, D] output (VMEM)
+    # scratch
+    m_scr,  # [Hq, 1] f32 running max
+    l_scr,  # [Hq, 1] f32 running sum
+    acc_scr,  # [Hq, D] f32 running numerator
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    page = k_ref.shape[2]
+    Hkv = k_ref.shape[3]
+    D = k_ref.shape[4]
+    Hq = q_ref.shape[1]
+    n_rep = Hq // Hkv
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kv_len = len_ref[b]
+
+    # only pages holding live positions contribute; the index map clamps
+    # dead table slots to page 0, whose contents this mask ignores
+    @pl.when(p * page < kv_len)
+    def _attend():
+        # [Hkv, n_rep, D] query grouped by kv head
+        q = q_ref[0].reshape(Hkv, n_rep, D)
+        k = k_ref[0, 0].transpose(1, 0, 2)  # [Hkv, page, D]
+        v = v_ref[0, 0].transpose(1, 0, 2)  # [Hkv, page, D]
+        scale = 1.0 / (D ** 0.5)
+        # batched over kv heads: [Hkv, n_rep, page] f32
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        pos = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, (Hkv, n_rep, page), dimension=2
+        )
+        s = jnp.where(pos < kv_len, s, _NEG_INF)
+
+        s2 = s.reshape(Hq, page)
+        m_prev = m_scr[:]  # [Hq, 1]
+        m_cur = jnp.max(s2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)  # [Hq, 1]
+        probs = jnp.exp(s2 - m_new)  # [Hq, page] f32
+        # [Hkv, n_rep, D] partial numerator for this page
+        pv = jax.lax.dot_general(
+            probs.reshape(Hkv, n_rep, page).astype(v.dtype), v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + pv.reshape(Hq, D)
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _finish():
+        l = l_scr[:]
+        safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention(
+    q: jax.Array,  # [B, Hq, D] one new query token per lane
+    kv_pages: jax.Array,  # [2, num_pages, page, Hkv, D]
+    page_table: jax.Array,  # [B, P] int32 page ids
+    kv_lens: jax.Array,  # [B] tokens in cache (incl. the one just written)
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in replacement for engine.attention.paged_decode_attention."""
+    B, Hq, D = q.shape
+    _, _, page, Hkv, _ = kv_pages.shape
+    P = page_table.shape[1]
+    num_pages = kv_pages.shape[1]
+
+    pt = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
+    lens = kv_lens.astype(jnp.int32)
+
+    def k_map(b, p, pt_ref, len_ref):
+        return (0, pt_ref[b, p], 0, 0, 0)
+
+    def v_map(b, p, pt_ref, len_ref):
+        return (1, pt_ref[b, p], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, 1, page, Hkv, D), k_map),
+            pl.BlockSpec((1, 1, page, Hkv, D), v_map),
+            pl.BlockSpec((1, Hq, D), lambda b, p, *_: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, p, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hq, 1), jnp.float32),
+            pltpu.VMEM((Hq, 1), jnp.float32),
+            pltpu.VMEM((Hq, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        _decode_kernel,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(pt, lens, kv_pages, kv_pages, q)
