@@ -215,6 +215,43 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
 AttnFn = Callable[[jax.Array, jax.Array, jax.Array, jax.Array], Tuple[jax.Array, jax.Array]]
 
 
+def transformer_layer(
+    lp: Params,
+    x: jax.Array,  # [B, T, H]
+    cos: jax.Array,  # [B, T, D]
+    sin: jax.Array,
+    cfg: ModelConfig,
+    attn_fn: AttnFn,
+    layer_kv: jax.Array,  # [2, num_pages, page, Hkv, D]
+) -> Tuple[jax.Array, jax.Array]:
+    """One decoder layer (norm -> attention -> norm -> MLP, residuals).
+    Shared by the single-device layer scan and the pipeline-parallel stage
+    loop so the math cannot diverge."""
+    B, T, _ = x.shape
+    D = cfg.head_dim
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if "bq" in lp:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, T, cfg.num_heads, D)
+    k = k.reshape(B, T, cfg.num_kv_heads, D)
+    v = v.reshape(B, T, cfg.num_kv_heads, D)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn, new_kv = attn_fn(q, k, v, layer_kv)
+    x = x + attn.reshape(B, T, cfg.num_heads * D) @ lp["wo"]
+    h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+    if cfg.is_moe:
+        x = x + _moe_mlp(lp, h2, cfg)
+    else:
+        x = x + _dense_mlp(lp, h2)
+    return x, new_kv
+
+
 def transformer(
     params: Params,
     cfg: ModelConfig,
@@ -229,37 +266,15 @@ def transformer(
         tokens = tokens[:, None]
         positions = positions[:, None]
 
-    B, T = tokens.shape
     D = cfg.head_dim
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
     cos, sin = rope_cos_sin(positions, D, cfg.rope_theta)  # [B, T, D]
 
     lp_stack = params["layers"]
-    has_bias = "bq" in lp_stack
 
     def layer(x: jax.Array, scanned) -> Tuple[jax.Array, jax.Array]:
         lp, layer_kv = scanned
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = h @ lp["wq"]
-        k = h @ lp["wk"]
-        v = h @ lp["wv"]
-        if has_bias:
-            q = q + lp["bq"]
-            k = k + lp["bk"]
-            v = v + lp["bv"]
-        q = q.reshape(B, T, cfg.num_heads, D)
-        k = k.reshape(B, T, cfg.num_kv_heads, D)
-        v = v.reshape(B, T, cfg.num_kv_heads, D)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        attn, new_kv = attn_fn(q, k, v, layer_kv)
-        x = x + attn.reshape(B, T, cfg.num_heads * D) @ lp["wo"]
-        h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
-        if cfg.is_moe:
-            x = x + _moe_mlp(lp, h2, cfg)
-        else:
-            x = x + _dense_mlp(lp, h2)
-        return x, new_kv
+        return transformer_layer(lp, x, cos, sin, cfg, attn_fn, layer_kv)
 
     x, new_kv_pages = jax.lax.scan(
         lambda carry, scanned: layer(carry, scanned), x, (lp_stack, kv_pages)

@@ -167,3 +167,60 @@ def test_ring_prefill_rejects_unaligned_bucket():
             params, cfg, kv, tokens,
             jnp.asarray([10], jnp.int32), jnp.zeros((1, 2), jnp.int32), mesh,
         )
+
+
+# -- pipeline parallelism over the pp axis ---------------------------------
+
+
+@pytest.mark.parametrize("pp,M", [(2, 2), (4, 2), (2, 4)])
+def test_pp_prefill_matches_reference(pp, M):
+    """GPipe-style microbatched prefill over pp stages must reproduce the
+    single-device prefill: logits and every live KV page (bubble ticks
+    write only to the trash page)."""
+    from dynamo_tpu.parallel.pipeline_parallel import pp_prefill_step
+
+    cfg = ModelConfig.tiny(
+        num_heads=4, num_kv_heads=2, hidden_size=32, head_dim=8, num_layers=4
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    PAGES, PAGE = 64, 8
+    kv0 = jnp.zeros(
+        (cfg.num_layers, 2, PAGES, PAGE, cfg.num_kv_heads, cfg.head_dim),
+        jnp.float32,
+    )
+    B, T = 4, 16
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(1, cfg.vocab_size - 1, (B, T)), jnp.int32)
+    lens = jnp.asarray([16, 9, 12, 16], jnp.int32)
+    pt = jnp.asarray(
+        1 + np.arange(B * (T // PAGE)).reshape(B, T // PAGE), jnp.int32
+    )
+    ref_logits, ref_kv = prefill_step(params, cfg, kv0, tokens, lens, pt)
+
+    mesh = build_mesh(MeshConfig(pp=pp), jax.devices()[:pp])
+    got_logits, got_kv = pp_prefill_step(
+        params, cfg, jnp.zeros_like(kv0), tokens, lens, pt, mesh,
+        num_microbatches=M,
+    )
+    assert float(jnp.max(jnp.abs(ref_logits - got_logits))) < 1e-4
+    pages = np.unique(np.asarray(pt))
+    err = np.abs(
+        np.asarray(ref_kv)[:, :, pages] - np.asarray(got_kv)[:, :, pages]
+    ).max()
+    assert err < 1e-4
+
+
+def test_pp_prefill_rejects_bad_divisibility():
+    from dynamo_tpu.parallel.pipeline_parallel import pp_prefill_step
+
+    cfg = ModelConfig.tiny(
+        num_heads=4, num_kv_heads=2, hidden_size=32, head_dim=8, num_layers=3
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    kv = jnp.zeros((3, 2, 8, 8, 2, 8), jnp.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        pp_prefill_step(
+            params, cfg, kv, jnp.zeros((2, 8), jnp.int32),
+            jnp.asarray([8, 8], jnp.int32), jnp.zeros((2, 1), jnp.int32), mesh,
+        )
