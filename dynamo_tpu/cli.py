@@ -22,25 +22,13 @@ import argparse
 import asyncio
 import contextlib
 import logging
-import re
 import signal
 import sys
 from typing import Optional, Tuple
 
 logger = logging.getLogger("dynamo.run")
 
-ENDPOINT_RE = re.compile(r"^dyn://([^.]+)\.([^.]+)\.([^.]+)$")
-
-
-def parse_endpoint_id(s: str) -> Tuple[str, str, str]:
-    """Parse ``dyn://namespace.component.endpoint`` (reference
-    protocols.rs:35)."""
-    m = ENDPOINT_RE.match(s)
-    if not m:
-        raise ValueError(
-            f"invalid endpoint id {s!r}: expected dyn://ns.component.endpoint"
-        )
-    return m.group(1), m.group(2), m.group(3)
+from .protocols.endpoint import parse_endpoint_id  # noqa: E402 (re-export)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -392,9 +380,9 @@ async def _wait_forever(stop: Optional[asyncio.Event] = None) -> None:
 
 
 def main(argv=None) -> int:
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
-    )
+    from .runtime.utils import configure_logging
+
+    configure_logging()  # DYN_LOG filter spec + DYN_LOG_JSONL mode
     args = build_parser().parse_args(argv)
     args.inp, args.out = _parse_io(args.io)
     try:

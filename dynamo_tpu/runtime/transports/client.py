@@ -242,10 +242,31 @@ class HubClient:
         self._check(hdr)
         lease = int(hdr["lease"])
         if keepalive:
-            self._keepalives[lease] = asyncio.create_task(
-                self._keepalive_loop(lease, ttl)
+            # a silently-dead keepalive means the hub evicts this client's
+            # instances while the process believes it is healthy -- exactly
+            # the failure CriticalTaskExecutionHandle exists for (reference
+            # runtime/src/utils/task.rs:42): promote it to connection loss
+            from ..utils import CriticalTaskExecutionHandle
+
+            self._keepalives[lease] = CriticalTaskExecutionHandle(
+                self._keepalive_loop(lease, ttl),
+                on_failure=lambda e: self._signal_connection_lost(
+                    f"lease {lease:#x} keepalive died: {e}"
+                ),
+                name=f"lease-keepalive-{lease:#x}",
             )
         return lease
+
+    def _signal_connection_lost(self, reason: str) -> None:
+        logger.error("%s", reason)
+        cb = self.on_connection_lost
+        if cb is not None:
+            try:
+                res = cb()
+                if asyncio.iscoroutine(res):
+                    asyncio.ensure_future(res)
+            except Exception:
+                logger.exception("on_connection_lost callback failed")
 
     async def _keepalive_loop(self, lease: int, ttl: float) -> None:
         interval = max(ttl / 3.0, 0.2)

@@ -1,0 +1,139 @@
+"""Runtime utils: critical tasks, object pool, DYN_LOG config."""
+
+import asyncio
+import io
+import json
+import logging
+
+import pytest
+
+from dynamo_tpu.runtime.utils import (
+    CriticalTaskExecutionHandle,
+    Pool,
+    configure_logging,
+)
+
+
+def test_critical_task_failure_fires_handler(run):
+    async def body():
+        fired = []
+
+        async def boom():
+            await asyncio.sleep(0.01)
+            raise RuntimeError("keepalive died")
+
+        h = CriticalTaskExecutionHandle(boom(), fired.append, name="t")
+        with pytest.raises(RuntimeError):
+            await h
+        assert len(fired) == 1 and "keepalive died" in str(fired[0])
+
+    run(body())
+
+
+def test_critical_task_cancel_is_clean(run):
+    async def body():
+        fired = []
+
+        async def forever():
+            await asyncio.Event().wait()
+
+        h = CriticalTaskExecutionHandle(forever(), fired.append)
+        await asyncio.sleep(0.01)
+        h.cancel()
+        await h.wait_stopped()
+        assert fired == [] and h.done()
+
+    run(body())
+
+
+def test_critical_task_async_failure_handler(run):
+    async def body():
+        fired = asyncio.Event()
+
+        async def on_fail(_exc):
+            fired.set()
+
+        async def boom():
+            raise ValueError("x")
+
+        h = CriticalTaskExecutionHandle(boom(), on_fail)
+        await h.wait_stopped()
+        await asyncio.wait_for(fired.wait(), 1)
+
+    run(body())
+
+
+def test_pool_reuses_and_bounds(run):
+    async def body():
+        built = []
+
+        def factory():
+            built.append(object())
+            return built[-1]
+
+        pool = Pool(factory, max_size=2)
+        a = await pool.acquire()
+        b = await pool.acquire()
+        assert pool.size == 2
+        # third acquire must wait until a release
+        third = asyncio.ensure_future(pool.acquire())
+        await asyncio.sleep(0.01)
+        assert not third.done()
+        pool.release(a)
+        got = await asyncio.wait_for(third, 1)
+        assert got is a  # reused, not rebuilt
+        assert len(built) == 2
+        pool.release(b)
+        pool.release(got)
+        async with pool.handle() as obj:
+            assert obj in built
+
+    run(body())
+
+
+def test_dyn_log_spec_and_jsonl(monkeypatch):
+    monkeypatch.setenv("DYN_LOG", "warn,dynamo.engine=debug")
+    monkeypatch.setenv("DYN_LOG_JSONL", "1")
+    buf = io.StringIO()
+    configure_logging(stream=buf)
+    try:
+        assert logging.getLogger().level == logging.WARNING
+        assert logging.getLogger("dynamo.engine").level == logging.DEBUG
+        logging.getLogger("dynamo.engine").debug("hello %s", "world")
+        line = buf.getvalue().strip().splitlines()[-1]
+        entry = json.loads(line)
+        assert entry["msg"] == "hello world"
+        assert entry["level"] == "DEBUG"
+    finally:
+        logging.getLogger().handlers[:] = []
+        logging.getLogger("dynamo.engine").setLevel(logging.NOTSET)
+        logging.basicConfig(level=logging.INFO)
+
+
+# -- dyn:// endpoint ids ----------------------------------------------------
+
+
+def test_endpoint_id_roundtrip():
+    from dynamo_tpu.protocols.endpoint import EndpointId
+
+    e = EndpointId.parse("dyn://dynamo.backend.generate")
+    assert (e.namespace, e.component, e.endpoint) == (
+        "dynamo", "backend", "generate"
+    )
+    assert e.instance is None
+    assert str(e) == "dyn://dynamo.backend.generate"
+    assert e.subject == "dynamo.backend.generate"
+
+    e2 = EndpointId.parse("dyn://ns.comp.ep:1a2b")
+    assert e2.instance == 0x1A2B
+    assert str(e2) == "dyn://ns.comp.ep:1a2b"
+    assert e2.instance_key() == "instances/ns/comp/ep:1a2b"
+
+
+def test_endpoint_id_rejects_malformed():
+    from dynamo_tpu.protocols.endpoint import EndpointId
+
+    for bad in ("dynamo.backend.generate", "dyn://a.b", "dyn://a.b.c.d",
+                "dyn://a.b.c:zz"):
+        with pytest.raises(ValueError):
+            EndpointId.parse(bad)
