@@ -29,8 +29,10 @@ import json
 import logging
 import os
 import socket
+import time
 from dataclasses import dataclass, field
 from enum import Enum
+from functools import partial
 from typing import Any, AsyncIterator, Dict, List, Optional
 
 from .engine import (
@@ -38,6 +40,7 @@ from .engine import (
     AsyncEngine,
     AsyncEngineContext,
     Context,
+    EngineFn,
     ResponseStream,
     ensure_response_stream,
 )
@@ -103,6 +106,11 @@ class DistributedRuntime:
         # Local engine registry: subject -> engine, for zero-copy in-process
         # dispatch when caller and worker share an event loop.
         self.local_engines: Dict[str, AsyncEngine] = {}
+        # Per-endpoint service stats ("{ns}/{comp}/{ep}" -> EndpointStats);
+        # served by the auto-registered per-component ``_stats`` endpoint
+        # (the NATS $SRV.STATS equivalent, SURVEY.md 2.1 row 15)
+        self.endpoint_stats: Dict[str, "EndpointStats"] = {}
+        self._stats_served: set = set()
         self._shutdown = asyncio.Event()
 
     # -- constructors ------------------------------------------------------
@@ -183,6 +191,40 @@ class Component:
     def path(self) -> str:
         return f"{self.namespace}/{self.name}"
 
+    async def scrape_stats(self, timeout_s: float = 2.0) -> List[Dict[str, Any]]:
+        """Request service stats from every live instance of this component
+        (the ``$SRV.STATS`` scatter-gather, reference component.rs:284).
+
+        Returns one dict per responding instance:
+        ``{"instance": id, "endpoints": {path: {requests, errors, ...}}}``;
+        wedged instances are skipped after ``timeout_s``."""
+        ep = self.endpoint(STATS_ENDPOINT)
+        client = await ep.client()
+        try:
+            out: List[Dict[str, Any]] = []
+
+            async def one(instance_id: int):
+                router = PushRouter(client)
+                stream = await router.direct(
+                    Context.new(None), instance_id
+                )
+                async for item in stream:
+                    if isinstance(item, Annotated) and item.data is not None:
+                        return {"instance": instance_id, **item.data}
+                return None
+
+            ids = [i.instance_id for i in client.instances]
+            results = await asyncio.gather(
+                *(asyncio.wait_for(one(i), timeout_s) for i in ids),
+                return_exceptions=True,
+            )
+            for r in results:
+                if isinstance(r, dict):
+                    out.append(r)
+            return out
+        finally:
+            await client.close()
+
 
 @dataclass
 class Endpoint:
@@ -233,7 +275,8 @@ class Endpoint:
             subject=subject,
         )
 
-        handler = _IngressHandler(engine)
+        stats = rt.endpoint_stats.setdefault(self.path, EndpointStats())
+        handler = _IngressHandler(engine, stats)
         rt.data_server.register(subject, handler)
         rt.local_engines[subject] = engine
         created = await rt.hub.kv_create(
@@ -245,12 +288,59 @@ class Endpoint:
             )
         logger.info("serving %s as instance %x at %s:%d",
                     self.path, instance_id, host, port)
+        # auto-serve the component's $SRV.STATS equivalent once
+        comp_path = f"{self.namespace}/{self.component}"
+        if self.name != STATS_ENDPOINT and comp_path not in rt._stats_served:
+            rt._stats_served.add(comp_path)
+            await Endpoint(
+                rt, self.namespace, self.component, STATS_ENDPOINT
+            ).serve(EngineFn(partial(_stats_handler, rt, self.namespace)))
         return instance
 
     async def client(self) -> "Client":
         c = Client(self)
         await c.start()
         return c
+
+
+STATS_ENDPOINT = "_stats"  # reserved; the $SRV.STATS-equivalent endpoint
+
+
+@dataclass
+class EndpointStats:
+    """Per-endpoint service counters (reference: NATS micro endpoint stats
+    surfaced via $SRV.STATS; service.rs stats handler)."""
+
+    requests: int = 0
+    errors: int = 0
+    in_flight: int = 0
+    processing_ms_total: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        avg = self.processing_ms_total / self.requests if self.requests else 0.0
+        return {
+            "num_requests": self.requests,
+            "num_errors": self.errors,
+            "in_flight": self.in_flight,
+            "processing_ms_total": round(self.processing_ms_total, 3),
+            "average_processing_ms": round(avg, 3),
+        }
+
+
+async def _stats_handler(rt, namespace, request):
+    """One-item stream with every endpoint's counters in this process."""
+    del namespace, request
+
+    async def gen():
+        yield Annotated.from_data(
+            {
+                "endpoints": {
+                    path: s.to_dict() for path, s in rt.endpoint_stats.items()
+                }
+            }
+        )
+
+    return gen()
 
 
 class _IngressHandler:
@@ -261,23 +351,48 @@ class _IngressHandler:
     tracing stay end-to-end.
     """
 
-    def __init__(self, engine: AsyncEngine) -> None:
+    def __init__(self, engine: AsyncEngine, stats: Optional[EndpointStats] = None) -> None:
         self.engine = engine
+        self.stats = stats
 
     async def __call__(
         self, hdr: Dict[str, Any], payload: bytes, ctx: AsyncEngineContext
     ) -> AsyncIterator[bytes]:
         data = json.loads(payload) if payload else None
         request = Context(data=data, ctx=ctx, metadata=hdr.get("meta") or {})
-        stream = await self.engine.generate(request)
+        stats = self.stats
+        t0 = time.monotonic()
+        if stats is not None:
+            stats.requests += 1
+            stats.in_flight += 1
+        try:
+            stream = await self.engine.generate(request)
+        except BaseException:
+            if stats is not None:
+                stats.errors += 1
+                stats.in_flight -= 1
+                stats.processing_ms_total += (time.monotonic() - t0) * 1e3
+            raise
 
         async def gen() -> AsyncIterator[bytes]:
             # Wire contract: every item is an Annotated envelope.  Engines may
             # yield Annotated (signals/errors) or raw payloads (wrapped here).
-            async for item in stream:
-                if not isinstance(item, Annotated):
-                    item = Annotated.from_data(item)
-                yield json.dumps(item.to_dict()).encode()
+            failed = False
+            try:
+                async for item in stream:
+                    if not isinstance(item, Annotated):
+                        item = Annotated.from_data(item)
+                    if item.is_error():
+                        failed = True
+                    yield json.dumps(item.to_dict()).encode()
+            except BaseException:
+                failed = True
+                raise
+            finally:
+                if stats is not None:
+                    stats.in_flight -= 1
+                    stats.errors += 1 if failed else 0
+                    stats.processing_ms_total += (time.monotonic() - t0) * 1e3
 
         return gen()
 

@@ -391,3 +391,39 @@ def test_subject_matching_semantics():
     assert not _subject_matches("a.>", "a")  # '>' needs >= 1 token
     assert not _subject_matches("a.b", "a")
     assert not _subject_matches("a", "a.b")
+
+
+def test_component_stats_scrape(run):
+    """Every served component auto-registers a ``_stats`` endpoint (the
+    $SRV.STATS equivalent); scrape_stats gathers per-endpoint counters
+    from every live instance."""
+
+    async def body():
+        hub_server, workers, caller = await _make_distributed(2)
+        try:
+            ep = caller.namespace("test").component("backend").endpoint("generate")
+            client = await ep.client()
+            await client.wait_for_instances(5)
+            router = PushRouter(client, RouterMode.ROUND_ROBIN)
+            for _ in range(4):
+                stream = await router.generate(Context.new({"n": 2}))
+                assert [x async for x in stream]
+            comp = caller.namespace("test").component("backend")
+            stats = await comp.scrape_stats()
+            assert len(stats) == 2  # one report per worker instance
+            totals = 0
+            for s in stats:
+                entry = s["endpoints"]["test/backend/generate"]
+                totals += entry["num_requests"]
+                assert entry["num_errors"] == 0
+                assert entry["in_flight"] == 0
+                assert entry["average_processing_ms"] >= 0.0
+            assert totals == 4  # round robin spread the 4 requests
+            await client.close()
+        finally:
+            await caller.shutdown()
+            for w in workers:
+                await w.shutdown()
+            await hub_server.stop()
+
+    run(body())
