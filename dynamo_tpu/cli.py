@@ -39,7 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="cmd", required=True)
     run = sub.add_parser("run", help="launch an engine/frontend/worker")
     run.add_argument("io", nargs=2, metavar=("in=...", "out=..."),
-                     help="in=http|text|dyn out=jax|mocker|dyn")
+                     help="in=http|text|dyn out=jax|mocker|echo|dyn")
+    run.add_argument("--echo-delay-ms", type=float, default=0.0,
+                     help="out=echo: per-token delay")
     run.add_argument("--model-path", help="HF model dir (weights + tokenizer)")
     run.add_argument("--model-name", help="served model name (default: dir name)")
     run.add_argument("--hub", help="hub address host:port, or 'auto'")
@@ -80,7 +82,11 @@ def _parse_io(io) -> Tuple[str, str]:
 
 
 async def _make_engine(args):
-    """Build the local engine for out=jax|mocker."""
+    """Build the local engine for out=jax|mocker|echo."""
+    if args.out == "echo":
+        from .llm.echo import EchoEngineCore
+
+        return EchoEngineCore(delay_ms=args.echo_delay_ms)
     if args.out == "mocker":
         from .mocker import MockerConfig, MockerEngine
 
@@ -386,7 +392,7 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     args.inp, args.out = _parse_io(args.io)
     try:
-        if args.inp == "http" and args.out in ("jax", "mocker"):
+        if args.inp == "http" and args.out in ("jax", "mocker", "echo"):
             asyncio.run(run_http_local(args))
         elif args.inp == "http" and args.out == "dyn":
             asyncio.run(run_http_frontend(args))

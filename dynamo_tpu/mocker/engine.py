@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import random
 import logging
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
@@ -55,6 +56,11 @@ class MockerConfig:
     token_capacity: int = 8192
     vocab_size: int = 32000
     speedup_ratio: float = 1.0
+    # fault injection: simulated network latency on the response path --
+    # a fixed floor plus uniform jitter per item (SURVEY.md 5.3: latency-
+    # model mock network for chip-free failure/SLO testing)
+    network_latency_ms: float = 0.0
+    network_jitter_ms: float = 0.0
 
 
 @dataclass
@@ -168,6 +174,15 @@ class MockerEngine:
                     item = get.result()
                     if item is None:
                         return
+                    if self.cfg.network_latency_ms or self.cfg.network_jitter_ms:
+                        jitter = (
+                            random.random() * self.cfg.network_jitter_ms
+                            if self.cfg.network_jitter_ms
+                            else 0.0
+                        )
+                        await asyncio.sleep(
+                            (self.cfg.network_latency_ms + jitter) / 1e3
+                        )
                     yield item
             finally:
                 self._queues.pop(request.id, None)

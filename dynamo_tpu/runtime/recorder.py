@@ -1,0 +1,137 @@
+"""Recorder / replay: capture engine streams as JSONL fixtures.
+
+Reference parity: lib/llm/src/recorder.rs:35 (stream recorder feeding
+tests/data/replays) -- the cheapest route to engine-stream regression
+tests: record a live engine once, replay the exact stream (optionally with
+its original timing) without the engine.
+
+Line format (one JSON object per line, append-only)::
+
+    {"type": "request", "request_id": ..., "ts": ..., "data": ...}
+    {"type": "item",    "request_id": ..., "dt": ...,  "data": <Annotated>}
+    {"type": "end",     "request_id": ..., "dt": ...}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+from .engine import Annotated, AsyncEngine, Context, ResponseStream
+
+
+class RecordingEngine:
+    """AsyncEngine wrapper: pass items through, append them to a JSONL file."""
+
+    def __init__(self, inner: AsyncEngine, path: str) -> None:
+        self.inner = inner
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def _write(self, entry: Dict[str, Any]) -> None:
+        self._fh.write(json.dumps(entry) + "\n")
+        self._fh.flush()
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
+        t0 = time.monotonic()
+        self._write(
+            {
+                "type": "request",
+                "request_id": request.id,
+                "ts": round(time.time(), 6),
+                "data": request.data,
+            }
+        )
+        stream = await self.inner.generate(request)
+
+        async def gen() -> AsyncIterator[Annotated]:
+            try:
+                async for item in stream:
+                    if not isinstance(item, Annotated):
+                        item = Annotated.from_data(item)
+                    self._write(
+                        {
+                            "type": "item",
+                            "request_id": request.id,
+                            "dt": round(time.monotonic() - t0, 6),
+                            "data": item.to_dict(),
+                        }
+                    )
+                    yield item
+            finally:
+                self._write(
+                    {
+                        "type": "end",
+                        "request_id": request.id,
+                        "dt": round(time.monotonic() - t0, 6),
+                    }
+                )
+
+        return ResponseStream(request.ctx, gen())
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def load_recording(path: str) -> List[Dict[str, Any]]:
+    """All entries, in file order."""
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+class ReplayEngine:
+    """Replay a recording as an AsyncEngine: the i-th generate() call
+    receives the i-th recorded stream (requests replay in recording order,
+    matching the reference's replay fixtures).  ``timed=True`` reproduces
+    the recorded inter-item gaps (scaled by ``speedup``)."""
+
+    def __init__(
+        self, path: str, timed: bool = False, speedup: float = 1.0
+    ) -> None:
+        self.timed = timed
+        self.speedup = max(speedup, 1e-9)
+        self._streams: List[List[Dict[str, Any]]] = []
+        self._requests: List[Dict[str, Any]] = []
+        by_id: Dict[str, List[Dict[str, Any]]] = {}
+        for entry in load_recording(path):
+            if entry["type"] == "request":
+                by_id[entry["request_id"]] = []
+                self._requests.append(entry)
+                self._streams.append(by_id[entry["request_id"]])
+            elif entry["type"] == "item":
+                by_id[entry["request_id"]].append(entry)
+        self._next = 0
+
+    @property
+    def num_recorded(self) -> int:
+        return len(self._streams)
+
+    def recorded_request(self, i: int) -> Any:
+        return self._requests[i]["data"]
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
+        if self._next >= len(self._streams):
+            raise RuntimeError(
+                f"replay exhausted after {len(self._streams)} recorded streams"
+            )
+        items = self._streams[self._next]
+        self._next += 1
+
+        async def gen() -> AsyncIterator[Annotated]:
+            prev = 0.0
+            for entry in items:
+                if self.timed:
+                    gap = max(0.0, entry["dt"] - prev) / self.speedup
+                    prev = entry["dt"]
+                    if gap:
+                        await asyncio.sleep(gap)
+                yield Annotated.from_dict(entry["data"])
+
+        return ResponseStream(request.ctx, gen())

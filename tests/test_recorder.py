@@ -1,0 +1,120 @@
+"""Recorder/replay fixtures, echo engine, mocker latency injection."""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.llm.echo import EchoEngineCore
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.runtime.recorder import (
+    RecordingEngine,
+    ReplayEngine,
+    load_recording,
+)
+
+
+def req(tokens, max_tokens=8):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0),
+    ).to_dict()
+
+
+async def drain(stream):
+    items = []
+    async for item in stream:
+        items.append(item if isinstance(item, Annotated) else Annotated.from_dict(item))
+    return items
+
+
+def test_echo_engine_streams_prompt_back(run):
+    async def body():
+        engine = EchoEngineCore()
+        stream = await engine.generate(Context.new(req([5, 6, 7], max_tokens=2)))
+        items = await drain(stream)
+        tokens = [t for it in items for t in (it.data or {}).get("token_ids") or []]
+        assert tokens == [5, 6]  # capped by max_tokens
+        assert items[-1].data.get("finish_reason") == "stop"
+
+    run(body())
+
+
+def test_record_then_replay_identical_stream(run, tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+
+    async def body():
+        inner = MockerEngine(MockerConfig(block_size=4))
+        rec = RecordingEngine(inner, path)
+        try:
+            live1 = await drain(await rec.generate(Context.new(req([1, 2, 3], 5))))
+            live2 = await drain(await rec.generate(Context.new(req([9, 8], 3))))
+        finally:
+            await inner.stop()
+            rec.close()
+
+        entries = load_recording(path)
+        kinds = [e["type"] for e in entries]
+        assert kinds.count("request") == 2 and kinds.count("end") == 2
+
+        replay = ReplayEngine(path)
+        assert replay.num_recorded == 2
+        got1 = await drain(await replay.generate(Context.new(req([1, 2, 3], 5))))
+        got2 = await drain(await replay.generate(Context.new(req([9, 8], 3))))
+        assert [i.to_dict() for i in got1] == [i.to_dict() for i in live1]
+        assert [i.to_dict() for i in got2] == [i.to_dict() for i in live2]
+        with pytest.raises(RuntimeError, match="exhausted"):
+            await replay.generate(Context.new(req([1], 1)))
+
+    run(body())
+
+
+def test_replay_timed_mode_preserves_gaps(run, tmp_path):
+    path = str(tmp_path / "rec.jsonl")
+
+    async def body():
+        inner = EchoEngineCore(delay_ms=20.0)
+        rec = RecordingEngine(inner, path)
+        await drain(await rec.generate(Context.new(req([1, 2, 3], 3))))
+        rec.close()
+
+        fast = ReplayEngine(path)  # untimed: immediate
+        t0 = time.monotonic()
+        await drain(await fast.generate(Context.new(req([1, 2, 3], 3))))
+        assert time.monotonic() - t0 < 0.05
+
+        timed = ReplayEngine(path, timed=True)
+        t0 = time.monotonic()
+        await drain(await timed.generate(Context.new(req([1, 2, 3], 3))))
+        assert time.monotonic() - t0 >= 0.05  # ~3 x 20ms recorded gaps
+
+    run(body())
+
+
+def test_mocker_network_latency_injection(run):
+    async def body():
+        fast = MockerEngine(MockerConfig(block_size=4))
+        slow = MockerEngine(
+            MockerConfig(block_size=4, network_latency_ms=15.0)
+        )
+        try:
+            t0 = time.monotonic()
+            await drain(await fast.generate(Context.new(req([1, 2], 4))))
+            fast_s = time.monotonic() - t0
+            t0 = time.monotonic()
+            await drain(await slow.generate(Context.new(req([1, 2], 4))))
+            slow_s = time.monotonic() - t0
+            # 5 items (4 tokens + finish) x 15ms floor
+            assert slow_s >= fast_s + 0.05
+        finally:
+            await fast.stop()
+            await slow.stop()
+
+    run(body())
