@@ -66,6 +66,11 @@ class PagePool:
         self.num_pages = num_pages
         self.pages_per_block = pages_per_block
         self.event_sink = event_sink
+        # offload hook: called with the RegisteredBlock *before* its pages
+        # return to the free list, so the owner can snapshot the contents
+        # (G1 -> G2 demotion; engine wires this to a device-slice dispatch
+        # whose device ordering precedes any page reuse)
+        self.on_evict: Optional[Callable[[RegisteredBlock], None]] = None
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._registered: Dict[int, RegisteredBlock] = {}
         # LRU over refs==0 registered blocks (insertion-ordered)
@@ -122,6 +127,15 @@ class PagePool:
     def _evict_one(self) -> None:
         seq_hash, _ = self._inactive.popitem(last=False)
         blk = self._registered.pop(seq_hash)
+        if self.on_evict is not None:
+            try:
+                self.on_evict(blk)
+            except Exception:  # offload is best-effort; eviction is not
+                import logging
+
+                logging.getLogger("dynamo.offload").exception(
+                    "on_evict hook failed for block %x", seq_hash
+                )
         self._free.extend(blk.pages)
         if self.event_sink is not None:
             self.event_sink(

@@ -59,6 +59,12 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--block-size", type=int, default=None,
                      help="router-visible KV block size (default: page size)")
     run.add_argument("--decode-block-size", type=int, default=16)
+    run.add_argument("--host-offload-blocks", type=int, default=0,
+                     help="G2 host-RAM KV offload capacity (blocks); 0 = off")
+    run.add_argument("--disk-offload-blocks", type=int, default=0,
+                     help="G3 disk KV offload capacity (blocks); 0 = off")
+    run.add_argument("--disk-offload-dir",
+                     help="directory for G3 disk offload files")
     run.add_argument("--tp", type=int, default=1,
                      help="tensor-parallel degree (shards over local devices)")
     run.add_argument("--prompt", help="in=text: run one prompt and exit")
@@ -107,6 +113,9 @@ async def _make_engine(args):
         num_pages=args.num_pages,
         block_size=args.block_size,
         decode_block_size=args.decode_block_size,
+        host_offload_blocks=args.host_offload_blocks,
+        disk_offload_blocks=args.disk_offload_blocks,
+        disk_offload_dir=args.disk_offload_dir,
     )
     logger.info("loading %s ...", args.model_path)
     if args.tp > 1:

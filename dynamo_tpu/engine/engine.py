@@ -66,6 +66,13 @@ class EngineConfig:
     # sequence-hash prefix-cache reuse (block_manager.PagePool); requires
     # block_size to divide evenly into pages
     enable_prefix_caching: bool = True
+    # KV offload tiers (SURVEY.md 5.4 / reference offload.rs): evicted G1
+    # blocks demote to host RAM (G2, this many blocks) and overflow to disk
+    # (G3); admission onboards offloaded prefixes back into fresh pages.
+    # 0 disables.
+    host_offload_blocks: int = 0
+    disk_offload_blocks: int = 0
+    disk_offload_dir: Optional[str] = None
     # extra pages allocated per growth event so the page table (and its
     # device copy) changes every few blocks instead of every block
     grow_chunk_pages: int = 4
@@ -145,6 +152,27 @@ class JaxEngine:
             ),
             self.kv.allocator,
         )
+        # G2/G3 offload tiers: evictions snapshot (async) to host RAM with
+        # disk overflow; admission onboards offloaded prefixes
+        self.offload: Optional[Any] = None
+        self._offload_pending: List[Tuple[int, Any, Any]] = []
+        if pool is not None and (
+            self.cfg.host_offload_blocks > 0 or self.cfg.disk_offload_blocks > 0
+        ):
+            from ..offload import DiskTier, HostTier
+
+            disk = None
+            if self.cfg.disk_offload_blocks > 0:
+                if not self.cfg.disk_offload_dir:
+                    raise ValueError(
+                        "disk_offload_blocks > 0 requires disk_offload_dir"
+                    )
+                disk = DiskTier(
+                    self.cfg.disk_offload_dir, self.cfg.disk_offload_blocks
+                )
+            self.offload = HostTier(self.cfg.host_offload_blocks, parent=disk)
+            pool.on_evict = self._on_pool_evict
+            self.sched.offload_lookup = self.offload.get
         self.buckets = prefill_buckets(self.cfg.page_size, self.cfg.max_seq_len)
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._queues: Dict[str, asyncio.Queue] = {}
@@ -488,6 +516,8 @@ class JaxEngine:
                     )
                     self._dispatch([ev])
                 if not self.sched.has_runnable_work and not pending:
+                    if self._offload_pending:
+                        await loop.run_in_executor(self._ex, self._drain_offload)
                     self._wake.clear()
                     if self._external:
                         # bounded wait so parked-lane timeouts still fire
@@ -693,6 +723,8 @@ class JaxEngine:
         With a prefix-cache hit (scheduler matched resident blocks), only the
         prompt suffix is prefilled: queries start at position
         ``cached_prompt_tokens`` and attend to the reused pages."""
+        if seq.pending_onboard:
+            self._apply_onboards(seq)
         # prefix-cache stats are token-weighted and counted once per request
         # (not per re-prefill after preemption)
         if not seq.stats_counted:
@@ -971,10 +1003,79 @@ class JaxEngine:
             pass  # optional fast path; device_get below still works
         return InflightBlock(sampled=sampled, slots=list(self.sched.slots))
 
+    # -- KV offload (G1 -> G2 -> G3; SURVEY.md 5.4) ------------------------
+
+    def _on_pool_evict(self, blk) -> None:
+        """PagePool eviction hook: dispatch an async device slice of the
+        block's pages before the free list reclaims them.  Device program
+        order places the read before any reuse; the host copy materializes
+        with the next commit sync (``_drain_offload``) -- no extra round
+        trip on the hot loop."""
+        if self.offload is None:
+            return
+        from ..offload import BlockMeta
+        from .step import slice_block_pages
+
+        try:
+            snap = slice_block_pages(
+                self.kv.pages, jnp.asarray(blk.pages, jnp.int32)
+            )
+            try:
+                snap.copy_to_host_async()
+            except Exception:
+                pass
+            meta = BlockMeta(
+                block_hash=blk.block_hash,
+                parent_sequence_hash=blk.parent_sequence_hash,
+                position=blk.position,
+            )
+            self._offload_pending.append((blk.sequence_hash, snap, meta))
+        except Exception:
+            # best-effort: a lost offload is a cache miss later, not an error
+            logger.debug("offload snapshot failed", exc_info=True)
+
+    def _drain_offload(self) -> None:
+        """Materialize pending eviction snapshots into the host tier
+        (executor thread; runs alongside the commit device_get)."""
+        if not self._offload_pending:
+            return
+        pending, self._offload_pending = self._offload_pending, []
+        for seq_hash, snap, meta in pending:
+            try:
+                self.offload.put(seq_hash, np.asarray(snap), meta)
+            except Exception:
+                logger.debug("offload store failed", exc_info=True)
+
+    def _apply_onboards(self, seq: SeqState) -> None:
+        """Scatter offload-tier hits into their pages and register them
+        (executor thread, before the prefill dispatch that reads them)."""
+        from .step import scatter_block_pages
+
+        sched = self.sched
+        for seq_hash, pages, blob, meta in seq.pending_onboard:
+            self.kv.pages = scatter_block_pages(
+                self.kv.pages,
+                jnp.asarray(pages, jnp.int32),
+                jnp.asarray(blob),
+            )
+            if sched.pool.register(
+                seq_hash,
+                pages,
+                block_hash=meta.block_hash,
+                parent_sequence_hash=meta.parent_sequence_hash,
+                position=meta.position,
+            ):
+                seq.held_blocks.append(seq_hash)
+                for p in pages:
+                    seq.owned_pages.remove(p)
+            # register False: twin onboarded it concurrently; keep ownership
+        seq.pending_onboard = []
+
     def _commit_all(self, entries: List[Any]) -> List[StepEvent]:
         """Materialize and commit pending prefills/blocks in dispatch order
         (one bundled device_get instead of one round trip per handle)."""
         mats = jax.device_get([e.sampled for e in entries])
+        self._drain_offload()
         events: List[StepEvent] = []
         for e, mat in zip(entries, mats):
             if isinstance(e, InflightPrefill):

@@ -76,6 +76,9 @@ class SeqState:
     # completed blocks whose final token's KV is not yet written (it lands
     # with the next decode step); registered once the cache catches up
     pending_register: List[TokenBlock] = field(default_factory=list)
+    # offload-tier hits awaiting their device scatter: (seq_hash, pages,
+    # blob, meta) -- the engine scatters + registers them at prefill time
+    pending_onboard: List[Any] = field(default_factory=list)
     # prefix-cache stats are counted once per request (first admission)
     stats_counted: bool = False
     # disaggregation: prompt KV arrives from a remote prefill worker; the
@@ -132,6 +135,9 @@ class Scheduler:
             else None
         )
         self.pages_per_block = self.block_size // cfg.page_size
+        # G2/G3 offload lookup: fn(seq_hash) -> (blob, meta) | None, wired
+        # by the engine when offload tiers are configured
+        self.offload_lookup: Optional[Any] = None
         B = cfg.max_batch_size
         self.max_pages = cfg.max_seq_len // cfg.page_size
         self.waiting: Deque[SeqState] = collections.deque()
@@ -238,8 +244,14 @@ class Scheduler:
                 self._unmatch_prefix(seq)
                 break
             self.waiting.popleft()
-            seq.owned_pages = self.allocator.alloc(n_pages - len(cached_pages))
-            seq.pages = cached_pages + list(seq.owned_pages)
+            fresh = self.allocator.alloc(n_pages - len(cached_pages))
+            # onboard pages were allocated inside _match_prefix and stay
+            # plain-owned until the engine registers them post-scatter
+            onboard = [
+                p for _h, pgs, _b, _m in seq.pending_onboard for p in pgs
+            ]
+            seq.owned_pages = onboard + fresh
+            seq.pages = cached_pages + fresh
             seq.slot = slot
             self.slots[slot] = seq
             self._write_slot_arrays(seq)
@@ -254,12 +266,19 @@ class Scheduler:
     def _match_prefix(self, seq: SeqState) -> List[int]:
         """Acquire the longest resident prefix of the prompt's blocks; returns
         the reused pages (front of the page table).  Reuse is capped below the
-        full prompt so prefill always has at least one token to process."""
+        full prompt so prefill always has at least one token to process.
+
+        After the G1 (HBM) match ends, the chain continues into the offload
+        tiers: a G2/G3 hit allocates fresh pages now and defers the device
+        scatter + registration to the engine (``seq.pending_onboard``) --
+        those pages stay plain-owned until the scatter is dispatched, so no
+        other request can match a block whose contents haven't landed."""
         seq.cached_prompt_tokens = 0
         if self.pool is None or seq.blocks is None:
             return []
         max_blocks = max(0, (len(seq.prompt) - 1) // self.block_size)
-        matched = self.pool.match(seq.blocks.sequence_hashes()[:max_blocks])
+        hashes = seq.blocks.sequence_hashes()[:max_blocks]
+        matched = self.pool.match(hashes)
         pages: List[int] = []
         for blk in matched:
             got = self.pool.acquire(blk.sequence_hash)
@@ -267,13 +286,33 @@ class Scheduler:
                 break
             seq.held_blocks.append(blk.sequence_hash)
             pages.extend(blk.pages)
-        seq.cached_prompt_tokens = len(seq.held_blocks) * self.block_size
+        n_matched = len(seq.held_blocks)
+        if self.offload_lookup is not None:
+            for h in hashes[n_matched:]:
+                if self.pool.is_registered(h):
+                    break  # re-resident meanwhile; stop the offload chain
+                hit = self.offload_lookup(h)
+                if hit is None:
+                    break
+                blob, meta = hit
+                try:
+                    got_pages = self.allocator.alloc(self.pages_per_block)
+                except OutOfPages:
+                    break
+                seq.pending_onboard.append((h, got_pages, blob, meta))
+                pages.extend(got_pages)
+        seq.cached_prompt_tokens = (
+            n_matched + len(seq.pending_onboard)
+        ) * self.block_size
         return pages
 
     def _unmatch_prefix(self, seq: SeqState) -> None:
         for h in seq.held_blocks:
             self.pool.release(h)
         seq.held_blocks = []
+        for _h, pages, _blob, _meta in seq.pending_onboard:
+            self.allocator.free(pages)
+        seq.pending_onboard = []
         seq.cached_prompt_tokens = 0
 
     def _queue_prompt_registrations(self, seq: SeqState) -> None:
@@ -398,6 +437,7 @@ class Scheduler:
                 self.pool.release(h)
             seq.held_blocks = []
             seq.pending_register = []
+            seq.pending_onboard = []  # pages were owned; freed above
             seq.pages = []
             seq.owned_pages = []
         elif seq.pages:

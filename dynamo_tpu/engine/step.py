@@ -248,6 +248,25 @@ def update_lane(
     )
 
 
+@partial(jax.jit, donate_argnames=("kv_pages",))
+def scatter_block_pages(
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    ids: jax.Array,  # [pages_per_block] page ids
+    blob: jax.Array,  # [L, 2, pages_per_block, page, Hkv, D]
+) -> jax.Array:
+    """Write an offloaded block's contents back into fresh pages (G2/G3 ->
+    G1 onboarding).  Donated so the cache updates in place."""
+    return kv_pages.at[:, :, ids].set(blob.astype(kv_pages.dtype))
+
+
+@jax.jit
+def slice_block_pages(kv_pages: jax.Array, ids: jax.Array) -> jax.Array:
+    """Read a block's pages (pre-eviction snapshot for G1 -> G2 demotion).
+    Dispatched before the free-list reuses the pages, so device program
+    order guarantees it reads the pre-reuse contents."""
+    return kv_pages[:, :, ids]
+
+
 def prefill_buckets(page_size: int, max_len: int) -> list:
     """Power-of-two length buckets, all multiples of page_size."""
     max_len = -(-max_len // page_size) * page_size  # round up to a page multiple

@@ -1,0 +1,185 @@
+"""KV offload tiers: G2 (host RAM) and G3 (disk) behind the G1 page pool.
+
+Reference parity: lib/llm/src/block_manager offload (offload.rs:76-80 --
+eviction cascades G1 -> G2 -> G3, lookups promote back up).  The TPU build
+keeps the same cascade but moves data on XLA's terms (see
+engine/engine.py): an evicted block's pages are *sliced on device* before
+the free-list reclaims them (device program order guarantees the slice
+reads pre-reuse contents), the transfer rides ``copy_to_host_async``, and
+the host copy lands in the ``HostTier`` when the engine next synchronizes
+for a commit -- zero added round trips on the hot loop.
+
+A block is stored as ``(blob, meta)``: blob is the raw page content
+``[L, 2, pages_per_block, page, Hkv, D]``, meta carries the router-facing
+identity (block_hash, parent_sequence_hash, position) so an onboarded
+block re-registers and re-publishes exactly as it first did.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("dynamo.offload")
+
+
+@dataclass
+class BlockMeta:
+    block_hash: int = 0
+    parent_sequence_hash: int = 0
+    position: int = 0
+
+    def to_dict(self) -> Dict[str, int]:
+        return {
+            "block_hash": self.block_hash,
+            "parent_sequence_hash": self.parent_sequence_hash,
+            "position": self.position,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "BlockMeta":
+        return cls(
+            int(d.get("block_hash", 0)),
+            int(d.get("parent_sequence_hash", 0)),
+            int(d.get("position", 0)),
+        )
+
+
+class DiskTier:
+    """G3: one ``.npz`` file per block under ``root``, LRU-capped."""
+
+    def __init__(self, root: str, capacity_blocks: int) -> None:
+        self.root = root
+        self.capacity = capacity_blocks
+        os.makedirs(root, exist_ok=True)
+        self._lru: "collections.OrderedDict[int, None]" = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def _path(self, seq_hash: int) -> str:
+        return os.path.join(self.root, f"{seq_hash & (2**64 - 1):016x}.npz")
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def put(self, seq_hash: int, blob: np.ndarray, meta: BlockMeta) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            try:
+                np.savez(
+                    self._path(seq_hash), blob=blob, **meta.to_dict()
+                )
+            except OSError:
+                logger.exception("disk tier write failed for %x", seq_hash)
+                return
+            self._lru[seq_hash] = None
+            self._lru.move_to_end(seq_hash)
+            while len(self._lru) > self.capacity:
+                victim, _ = self._lru.popitem(last=False)
+                with_suppress_remove(self._path(victim))
+
+    def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta]]:
+        with self._lock:
+            if seq_hash not in self._lru:
+                self.misses += 1
+                return None
+            try:
+                with np.load(self._path(seq_hash)) as z:
+                    blob = z["blob"]
+                    meta = BlockMeta(
+                        int(z["block_hash"]),
+                        int(z["parent_sequence_hash"]),
+                        int(z["position"]),
+                    )
+            except OSError:
+                self._lru.pop(seq_hash, None)
+                self.misses += 1
+                return None
+            self._lru.move_to_end(seq_hash)
+            self.hits += 1
+            return blob, meta
+
+
+def with_suppress_remove(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+class HostTier:
+    """G2: in-RAM LRU of block blobs; overflow demotes to the G3 parent."""
+
+    def __init__(
+        self, capacity_blocks: int, parent: Optional[DiskTier] = None
+    ) -> None:
+        self.capacity = capacity_blocks
+        self.parent = parent
+        self._store: "collections.OrderedDict[int, Tuple[np.ndarray, BlockMeta]]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def put(self, seq_hash: int, blob: np.ndarray, meta: BlockMeta) -> None:
+        if self.capacity <= 0:
+            if self.parent is not None:
+                self.parent.put(seq_hash, blob, meta)
+            return
+        with self._lock:
+            self._store[seq_hash] = (blob, meta)
+            self._store.move_to_end(seq_hash)
+            demote = []
+            while len(self._store) > self.capacity:
+                demote.append(self._store.popitem(last=False))
+        for victim, (vb, vm) in demote:
+            if self.parent is not None:
+                self.parent.put(victim, vb, vm)
+
+    def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta]]:
+        with self._lock:
+            hit = self._store.get(seq_hash)
+            if hit is not None:
+                self._store.move_to_end(seq_hash)
+                self.hits += 1
+                return hit
+        if self.parent is not None:
+            promoted = self.parent.get(seq_hash)
+            if promoted is not None:
+                # promote back into G2 (and let LRU demote something else)
+                self.put(seq_hash, *promoted)
+                return promoted
+        self.misses += 1
+        return None
+
+    def contains(self, seq_hash: int) -> bool:
+        with self._lock:
+            if seq_hash in self._store:
+                return True
+        return self.parent is not None and seq_hash in self.parent._lru
+
+    def stats(self) -> Dict[str, Any]:
+        out = {
+            "g2_blocks": len(self),
+            "g2_hits": self.hits,
+            "g2_misses": self.misses,
+        }
+        if self.parent is not None:
+            out.update(
+                g3_blocks=len(self.parent),
+                g3_hits=self.parent.hits,
+                g3_misses=self.parent.misses,
+            )
+        return out

@@ -1,0 +1,154 @@
+"""KV offload tiers (G2 host / G3 disk) and their engine integration.
+
+Reference capability: block_manager offload.rs:76-80 -- eviction cascades
+G1 -> G2 -> G3; admission lookups promote blocks back up.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.offload import BlockMeta, DiskTier, HostTier
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from tests.test_jax_engine import collect, req
+
+
+def _blob(seed, shape=(2, 2, 1, 4, 2, 8)):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def test_host_tier_lru_and_capacity():
+    t = HostTier(2)
+    t.put(1, _blob(1), BlockMeta(position=0))
+    t.put(2, _blob(2), BlockMeta(position=1))
+    t.put(3, _blob(3), BlockMeta(position=2))  # evicts 1 (LRU, no parent)
+    assert t.get(1) is None
+    blob, meta = t.get(2)
+    assert meta.position == 1 and np.array_equal(blob, _blob(2))
+    assert len(t) == 2
+
+
+def test_host_tier_demotes_to_disk_and_promotes_back(tmp_path):
+    disk = DiskTier(str(tmp_path), capacity_blocks=4)
+    t = HostTier(1, parent=disk)
+    t.put(1, _blob(1), BlockMeta(block_hash=11))
+    t.put(2, _blob(2), BlockMeta(block_hash=22))  # demotes 1 to disk
+    assert len(t) == 1 and len(disk) == 1
+    blob, meta = t.get(1)  # disk hit, promoted back to G2
+    assert meta.block_hash == 11 and np.array_equal(blob, _blob(1))
+    assert disk.hits == 1
+
+
+def test_disk_tier_capacity_deletes_files(tmp_path):
+    disk = DiskTier(str(tmp_path), capacity_blocks=2)
+    for i in range(4):
+        disk.put(i, _blob(i), BlockMeta())
+    assert len(disk) == 2
+    assert disk.get(0) is None and disk.get(1) is None
+    blob, _ = disk.get(3)
+    assert np.array_equal(blob, _blob(3))
+    files = list(tmp_path.iterdir())
+    assert len(files) == 2
+
+
+def _offload_engine(**kw):
+    defaults = dict(
+        max_batch_size=2,
+        max_seq_len=64,
+        page_size=4,
+        num_pages=17,  # 16 usable = 4 blocks of 4 pages... (block=page here)
+        host_offload_blocks=32,
+    )
+    defaults.update(kw)
+    return JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**defaults))
+
+
+def test_engine_offload_roundtrip(run):
+    """Fill the pool with A, force eviction with B, re-run A: the blocks
+    come back from G2 (onboarding), the output is identical, and the
+    prefix-cache hit counter moves."""
+
+    async def body():
+        prompt_a = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]  # 3 blocks of 4
+        prompt_b = [7, 7, 7, 7, 8, 8, 8, 8, 6, 6, 6, 6]
+
+        from dynamo_tpu.tokens.sequence import TokenBlockSequence
+
+        engine = _offload_engine()
+        try:
+            first_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            a_hashes = TokenBlockSequence(
+                prompt_a, block_size=engine.sched.block_size
+            ).sequence_hashes()
+            pool = engine.sched.pool
+
+            def a_resident():
+                return sum(1 for h in a_hashes if pool.is_registered(h))
+
+            # B churns the pool until A's registered blocks are all evicted
+            for i in range(12):
+                if a_resident() == 0:
+                    break
+                await collect(
+                    engine, req([(p + i) % 30 for p in prompt_b], max_tokens=4)
+                )
+            assert a_resident() == 0, "A's blocks must have been evicted"
+            assert len(engine.offload) > 0, "evictions must have offloaded"
+
+            hits_before = engine._prefix_hits
+            second_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            assert second_a == first_a  # onboarded KV reproduces the stream
+            assert engine._prefix_hits > hits_before
+            assert engine.offload.hits > 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_engine_offload_disk_spill_roundtrip(run, tmp_path):
+    """G2 capacity 1 forces spills to G3; a re-run still reconstructs its
+    prefix from disk."""
+
+    async def body():
+        prompt_a = [3, 1, 4, 1, 5, 9, 2, 6]
+        engine = _offload_engine(
+            host_offload_blocks=1,
+            disk_offload_blocks=16,
+            disk_offload_dir=str(tmp_path / "g3"),
+        )
+        try:
+            first_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            assert engine.offload.parent is not None
+            for i in range(16):
+                if len(engine.offload.parent) > 0:
+                    break
+                await collect(
+                    engine,
+                    req([(9 + i + j) % 30 for j in range(12)], max_tokens=4),
+                )
+            assert len(engine.offload.parent) > 0, "G3 must hold spills"
+            second_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            assert second_a == first_a
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_offload_disabled_by_default(run):
+    async def body():
+        engine = JaxEngine.random_init(
+            ModelConfig.tiny(),
+            EngineConfig(max_batch_size=2, max_seq_len=32, page_size=4,
+                         num_pages=16),
+        )
+        try:
+            assert engine.offload is None
+            await collect(engine, req([1, 2, 3], max_tokens=2))
+        finally:
+            await engine.stop()
+
+    run(body())
