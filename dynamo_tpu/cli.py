@@ -74,6 +74,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="serve as a disaggregated decode or prefill worker")
     run.add_argument("--max-local-prefill-length", type=int, default=512)
     run.add_argument("--max-prefill-queue-depth", type=int, default=16)
+
+    # llmctl: cluster model administration (reference llmctl/src/main.rs)
+    ctl = sub.add_parser("llmctl", help="list/remove models on a hub")
+    ctl.add_argument("--hub", required=True, help="hub address host:port")
+    ctlsub = ctl.add_subparsers(dest="llmcmd", required=True)
+    ctlsub.add_parser("list", help="list registered models + instances")
+    rm = ctlsub.add_parser("remove", help="deregister a model by name")
+    rm.add_argument("name")
     return p
 
 
@@ -394,11 +402,52 @@ async def _wait_forever(stop: Optional[asyncio.Event] = None) -> None:
     await stop.wait()
 
 
+async def run_llmctl(args) -> int:
+    """Model administration against a live hub (reference llmctl: list /
+    remove chat-models)."""
+    from .llm.model_card import MDC_OBJ_PREFIX, MODEL_ROOT, ModelEntry, slugify
+    from .runtime.transports.client import HubClient
+
+    host, _, port = args.hub.rpartition(":")
+    hub = await HubClient(host or "127.0.0.1", int(port)).connect()
+    try:
+        entries = await hub.kv_get_prefix(f"{MODEL_ROOT}/")
+        if args.llmcmd == "list":
+            by_slug = {}
+            for key, blob in entries:
+                slug = key.split("/")[1]
+                by_slug.setdefault(slug, []).append(ModelEntry.from_json(blob))
+            if not by_slug:
+                print("no models registered")
+                return 0
+            for slug, insts in sorted(by_slug.items()):
+                e = insts[0]
+                print(
+                    f"{e.name}  instances={len(insts)}  "
+                    f"endpoint=dyn://{e.namespace}.{e.component}.{e.endpoint}  "
+                    f"type={e.model_type}"
+                )
+            return 0
+        # remove
+        slug = slugify(args.name)
+        n = await hub.kv_delete_prefix(f"{MODEL_ROOT}/{slug}/")
+        # the MDC object is keyed by slug as well; best-effort cleanup
+        with contextlib.suppress(Exception):
+            await hub.obj_del(f"{MDC_OBJ_PREFIX}/{slug}")
+        print(f"removed {n} instance entr{'y' if n == 1 else 'ies'} for "
+              f"{args.name!r}")
+        return 0 if n else 1
+    finally:
+        await hub.close()
+
+
 def main(argv=None) -> int:
     from .runtime.utils import configure_logging
 
     configure_logging()  # DYN_LOG filter spec + DYN_LOG_JSONL mode
     args = build_parser().parse_args(argv)
+    if args.cmd == "llmctl":
+        return asyncio.run(run_llmctl(args))
     args.inp, args.out = _parse_io(args.io)
     try:
         if args.inp == "http" and args.out in ("jax", "mocker", "echo"):

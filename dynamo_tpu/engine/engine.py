@@ -723,6 +723,8 @@ class JaxEngine:
         With a prefix-cache hit (scheduler matched resident blocks), only the
         prompt suffix is prefilled: queries start at position
         ``cached_prompt_tokens`` and attend to the reused pages."""
+        from ..runtime import tracing
+
         if seq.pending_onboard:
             self._apply_onboards(seq)
         # prefix-cache stats are token-weighted and counted once per request
@@ -768,6 +770,11 @@ class JaxEngine:
         self._pending_injects[seq.slot] = pf
         self._dev["tokens"] = inject_token(self._dev["tokens"], seq.slot, sampled)
         self._steps += 1
+        if tracing.collector.enabled:
+            with tracing.span(
+                "engine.prefill_dispatch", seq.request_id
+            ) as sp:
+                sp.set(prompt_len=prompt_len, bucket=bucket, cached=cached)
         logger.debug("prefill dispatched id=%s len=%d bucket=%d",
                      seq.request_id, prompt_len, bucket)
         return pf

@@ -44,6 +44,7 @@ from .engine import (
     ResponseStream,
     ensure_response_stream,
 )
+from . import tracing
 from .transports.client import HubClient, StaticHub, WatchHandle
 from .transports.request_plane import DataPlaneClient, DataPlaneServer, RemoteError
 
@@ -378,21 +379,27 @@ class _IngressHandler:
             # Wire contract: every item is an Annotated envelope.  Engines may
             # yield Annotated (signals/errors) or raw payloads (wrapped here).
             failed = False
-            try:
-                async for item in stream:
-                    if not isinstance(item, Annotated):
-                        item = Annotated.from_data(item)
-                    if item.is_error():
-                        failed = True
-                    yield json.dumps(item.to_dict()).encode()
-            except BaseException:
-                failed = True
-                raise
-            finally:
-                if stats is not None:
-                    stats.in_flight -= 1
-                    stats.errors += 1 if failed else 0
-                    stats.processing_ms_total += (time.monotonic() - t0) * 1e3
+            n_items = 0
+            with tracing.span("ingress", request.id) as sp:
+                try:
+                    async for item in stream:
+                        if not isinstance(item, Annotated):
+                            item = Annotated.from_data(item)
+                        if item.is_error():
+                            failed = True
+                        n_items += 1
+                        yield json.dumps(item.to_dict()).encode()
+                except BaseException:
+                    failed = True
+                    raise
+                finally:
+                    sp.set(items=n_items, error=failed)
+                    if stats is not None:
+                        stats.in_flight -= 1
+                        stats.errors += 1 if failed else 0
+                        stats.processing_ms_total += (
+                            time.monotonic() - t0
+                        ) * 1e3
 
         return gen()
 

@@ -1,0 +1,119 @@
+"""Request tracing: lightweight spans keyed by request id.
+
+Parity target (SURVEY.md 5.1): the reference threads request ids through
+every hop and hangs tracing/profiling off them (distributed_runtime
+tracing features).  Here the request id already crosses the request plane
+in every frame; this module adds the span layer: timed, named sections
+attached to a request id, collected in a process-local ring buffer.
+
+Enable with ``DYN_TRACE=1`` (or ``enable()``); disabled spans cost one
+attribute check.  Spans log at DEBUG as they close, and the collector's
+``get(request_id)`` / ``dump()`` feed tests and debug endpoints.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("dynamo.trace")
+
+
+@dataclass
+class Span:
+    name: str
+    request_id: str
+    start_s: float
+    end_s: float = 0.0
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_s - self.start_s) * 1e3
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "request_id": self.request_id,
+            "start_s": round(self.start_s, 6),
+            "duration_ms": round(self.duration_ms, 3),
+            **({"attrs": self.attrs} if self.attrs else {}),
+        }
+
+
+class TraceCollector:
+    """Ring buffer of completed spans (thread-safe)."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self._spans: "collections.deque[Span]" = collections.deque(
+            maxlen=capacity
+        )
+        self._lock = threading.Lock()
+        self.enabled = os.environ.get("DYN_TRACE", "") not in ("", "0", "false")
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+        logger.debug(
+            "span %s [%s] %.2fms", span.name, span.request_id, span.duration_ms
+        )
+
+    def get(self, request_id: str) -> List[Span]:
+        with self._lock:
+            return [s for s in self._spans if s.request_id == request_id]
+
+    def dump(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [s.to_dict() for s in self._spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+collector = TraceCollector()
+
+
+class span:
+    """``with span("prefill", request_id, tokens=128): ...`` -- no-op when
+    tracing is disabled.  Also usable around ``async`` sections (the timing
+    covers wall time, which is what serving spans want)."""
+
+    def __init__(self, name: str, request_id: str = "", **attrs: Any) -> None:
+        self.name = name
+        self.request_id = request_id
+        self.attrs = attrs
+        self._span: Optional[Span] = None
+
+    def __enter__(self) -> "span":
+        if collector.enabled:
+            self._span = Span(
+                name=self.name,
+                request_id=self.request_id,
+                start_s=time.monotonic(),
+                attrs=self.attrs,
+            )
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._span is not None:
+            self._span.end_s = time.monotonic()
+            if exc is not None:
+                self._span.attrs["error"] = repr(exc)
+            collector.record(self._span)
+        return False
+
+    def set(self, **attrs: Any) -> None:
+        if self._span is not None:
+            self._span.attrs.update(attrs)

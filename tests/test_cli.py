@@ -112,3 +112,75 @@ def test_parser_flags():
          "--page-size", "32", "--num-pages", "1024"]
     )
     assert a.tp == 4 and a.page_size == 32 and a.num_pages == 1024
+
+
+def test_llmctl_list_and_remove(run, capsys, model_dir):
+    """llmctl lists registered models with instance counts and removes a
+    model's entries + card from the hub."""
+    import argparse
+
+    from dynamo_tpu.cli import run_llmctl
+    from dynamo_tpu.llm.model_card import register_llm
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.transports.hub import HubServer
+
+    def ctl(addr, *argv):
+        ns = argparse.Namespace(hub=addr, llmcmd=argv[0])
+        if argv[0] == "remove":
+            ns.name = argv[1]
+        return run_llmctl(ns)
+
+    async def body():
+        hub_server = HubServer()
+        host, port = await hub_server.start()
+        addr = f"{host}:{port}"
+        rt = await DistributedRuntime.detached(addr)
+        try:
+            ep = rt.namespace("ns").component("backend").endpoint("generate")
+            await register_llm(rt, ep, model_dir, model_name="tiny-model")
+
+            assert await ctl(addr, "list") == 0
+            out = capsys.readouterr().out
+            assert "tiny-model" in out and "instances=1" in out
+            assert "dyn://ns.backend.generate" in out
+
+            assert await ctl(addr, "remove", "tiny-model") == 0
+            assert "removed 1" in capsys.readouterr().out
+
+            assert await ctl(addr, "list") == 0
+            assert "no models registered" in capsys.readouterr().out
+            assert await ctl(addr, "remove", "tiny-model") == 1
+        finally:
+            await rt.shutdown()
+            await hub_server.stop()
+
+    run(body())
+
+
+def test_tracing_spans_collected():
+    from dynamo_tpu.runtime import tracing
+
+    tracing.collector.clear()
+    tracing.collector.enable()
+    try:
+        with tracing.span("unit.op", "req-1", size=3) as sp:
+            sp.set(extra=True)
+        spans = tracing.collector.get("req-1")
+        assert len(spans) == 1
+        s = spans[0].to_dict()
+        assert s["name"] == "unit.op"
+        assert s["attrs"]["size"] == 3 and s["attrs"]["extra"] is True
+        assert s["duration_ms"] >= 0.0
+    finally:
+        tracing.collector.disable()
+        tracing.collector.clear()
+
+
+def test_tracing_disabled_is_noop():
+    from dynamo_tpu.runtime import tracing
+
+    tracing.collector.clear()
+    assert not tracing.collector.enabled
+    with tracing.span("x", "req-2"):
+        pass
+    assert tracing.collector.get("req-2") == []
