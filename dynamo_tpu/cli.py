@@ -67,6 +67,15 @@ def build_parser() -> argparse.ArgumentParser:
                      help="directory for G3 disk offload files")
     run.add_argument("--tp", type=int, default=1,
                      help="tensor-parallel degree (shards over local devices)")
+    # multi-host engine bootstrap (jax.distributed; env DYN_NUM_NODES /
+    # DYN_NODE_RANK / DYN_LEADER_ADDR also work)
+    run.add_argument("--num-nodes", type=int, default=None,
+                     help="hosts in the engine's multi-host world")
+    run.add_argument("--node-rank", type=int, default=None,
+                     help="this host's rank (0 = leader)")
+    run.add_argument("--leader-addr", default=None,
+                     help="leader host:port for the jax.distributed "
+                          "coordinator")
     run.add_argument("--prompt", help="in=text: run one prompt and exit")
     run.add_argument("--max-tokens", type=int, default=128)
     # disaggregated prefill/decode (in=dyn workers only)
@@ -126,6 +135,16 @@ async def _make_engine(args):
         disk_offload_dir=args.disk_offload_dir,
     )
     logger.info("loading %s ...", args.model_path)
+    from .parallel.multihost import MultiNodeConfig, initialize_multihost
+
+    mn = MultiNodeConfig.from_env()
+    if args.num_nodes is not None:
+        mn.num_nodes = args.num_nodes
+    if args.node_rank is not None:
+        mn.node_rank = args.node_rank
+    if args.leader_addr is not None:
+        mn.leader_addr = args.leader_addr
+    initialize_multihost(mn)  # must precede the first jax backend touch
     if args.tp > 1:
         import jax
         from jax.sharding import NamedSharding

@@ -1,0 +1,92 @@
+"""Multi-host bootstrap: one engine spanning several TPU hosts.
+
+Reference parity: the engines' ``MultiNodeConfig`` (lib/llm engines glue:
+node_rank / num_nodes / leader address handed to vLLM's distributed
+runtime).  The TPU-native equivalent is ``jax.distributed``: every host
+runs the same program, the leader coordinates, and ``jax.devices()``
+becomes the *global* device list -- after which the existing mesh/GSPMD
+machinery (parallel.mesh, parallel.sharding) works unchanged across hosts
+with XLA collectives riding ICI/DCN.
+
+Usage (every host, same binary)::
+
+    cfg = MultiNodeConfig.from_env()        # DYN_NUM_NODES / DYN_NODE_RANK /
+    initialize_multihost(cfg)               # DYN_LEADER_ADDR
+    mesh = build_mesh(MeshConfig(dp=..., tp=...))   # global devices
+
+Single-node configs make ``initialize_multihost`` a no-op, so the same
+launch path serves laptops and pods.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger("dynamo.multihost")
+
+
+@dataclass
+class MultiNodeConfig:
+    """Reference MultiNodeConfig shape: ranks + a leader address."""
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    # leader host:port for the jax.distributed coordinator
+    leader_addr: str = ""
+
+    @classmethod
+    def from_env(cls) -> "MultiNodeConfig":
+        return cls(
+            num_nodes=int(os.environ.get("DYN_NUM_NODES", "1")),
+            node_rank=int(os.environ.get("DYN_NODE_RANK", "0")),
+            leader_addr=os.environ.get("DYN_LEADER_ADDR", ""),
+        )
+
+    def validate(self) -> None:
+        if self.num_nodes < 1:
+            raise ValueError(f"num_nodes must be >= 1, got {self.num_nodes}")
+        if not (0 <= self.node_rank < self.num_nodes):
+            raise ValueError(
+                f"node_rank {self.node_rank} out of range for "
+                f"{self.num_nodes} nodes"
+            )
+        if self.num_nodes > 1 and not self.leader_addr:
+            raise ValueError("multi-node requires leader_addr (host:port)")
+
+    @property
+    def is_multi_node(self) -> bool:
+        return self.num_nodes > 1
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node_rank == 0
+
+
+def initialize_multihost(
+    cfg: Optional[MultiNodeConfig] = None,
+    local_device_ids: Optional[list] = None,
+) -> MultiNodeConfig:
+    """Join the multi-host world (must run before first backend touch).
+
+    No-op for single-node configs.  After this returns, ``jax.devices()``
+    lists every host's chips and sharded computations span them."""
+    cfg = cfg or MultiNodeConfig.from_env()
+    cfg.validate()
+    if not cfg.is_multi_node:
+        return cfg
+    import jax
+
+    logger.info(
+        "joining multihost world: rank %d/%d, leader %s",
+        cfg.node_rank, cfg.num_nodes, cfg.leader_addr,
+    )
+    jax.distributed.initialize(
+        coordinator_address=cfg.leader_addr,
+        num_processes=cfg.num_nodes,
+        process_id=cfg.node_rank,
+        local_device_ids=local_device_ids,
+    )
+    return cfg

@@ -1,0 +1,227 @@
+"""SDK: declarative service graphs (`@service` / `depends` / `serve`).
+
+Reference parity: deploy/sdk (``@service`` decorator, ``depends()``
+edges, ``dynamo serve`` launching the graph under circus).  The TPU build
+keeps the authoring surface -- a class per component, declared
+dependencies, one launcher -- but runs services as asyncio tasks on one
+DistributedRuntime per service (same process), which is the shape the
+rest of this framework already scales by (workers are processes; the SDK
+graph is the in-process development/composition layer, exactly how the
+reference uses it with ``dynamo serve`` locally).
+
+Authoring::
+
+    @service(namespace="demo")
+    class Worker:
+        async def create_engine(self):      # -> AsyncEngine
+            return MockerEngine()
+
+    @service(namespace="demo")
+    class Frontend:
+        worker = depends(Worker)            # -> PushRouter at runtime
+
+        async def started(self):            # optional hook
+            ...
+
+Launching::
+
+    graph = await serve(Frontend, hub="auto")   # starts Worker first
+    ...
+    await graph.shutdown()
+
+A service class provides either ``create_engine()`` (served on its
+endpoint) or just hooks; ``depends`` attributes resolve to PushRouters
+for the dependency's endpoint before ``started`` runs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Type
+
+from .runtime.component import (
+    DistributedRuntime,
+    PushRouter,
+    RouterMode,
+)
+
+logger = logging.getLogger("dynamo.sdk")
+
+_SERVICE_META = "__dynamo_service__"
+_DEPENDS = "__dynamo_depends__"
+
+
+@dataclass
+class ServiceMeta:
+    namespace: str
+    component: str
+    endpoint: str
+
+
+class depends:  # noqa: N801 -- decorator-style lowercase, like the reference
+    """Declares an edge to another ``@service`` class; replaced with a
+    ``PushRouter`` bound to that service's endpoint before hooks run."""
+
+    def __init__(self, target: Type, router_mode: RouterMode = RouterMode.ROUND_ROBIN):
+        self.target = target
+        self.router_mode = router_mode
+
+    def __set_name__(self, owner: Type, name: str) -> None:
+        edges = getattr(owner, _DEPENDS, None)
+        if edges is None:
+            edges = {}
+            setattr(owner, _DEPENDS, edges)
+        edges[name] = self
+
+
+def service(
+    namespace: str = "dynamo",
+    component: Optional[str] = None,
+    endpoint: str = "generate",
+):
+    """Class decorator registering a component in the graph."""
+
+    def wrap(cls: Type) -> Type:
+        setattr(
+            cls,
+            _SERVICE_META,
+            ServiceMeta(
+                namespace=namespace,
+                component=component or cls.__name__.lower(),
+                endpoint=endpoint,
+            ),
+        )
+        return cls
+
+    return wrap
+
+
+def service_meta(cls: Type) -> ServiceMeta:
+    meta = getattr(cls, _SERVICE_META, None)
+    if meta is None:
+        raise TypeError(f"{cls.__name__} is not a @service class")
+    return meta
+
+
+def _dependency_order(root: Type) -> List[Type]:
+    """Dependencies-first topological order; cycles rejected."""
+    order: List[Type] = []
+    state: Dict[Type, int] = {}  # 1 = visiting, 2 = done
+
+    def visit(cls: Type) -> None:
+        if state.get(cls) == 2:
+            return
+        if state.get(cls) == 1:
+            raise ValueError(f"dependency cycle through {cls.__name__}")
+        state[cls] = 1
+        for dep in getattr(cls, _DEPENDS, {}).values():
+            visit(dep.target)
+        state[cls] = 2
+        order.append(cls)
+
+    visit(root)
+    return order
+
+
+@dataclass
+class RunningService:
+    cls: Type
+    meta: ServiceMeta
+    instance: Any
+    runtime: DistributedRuntime
+    engine: Optional[Any] = None
+    clients: List[Any] = field(default_factory=list)
+
+
+class ServiceGraph:
+    """A launched graph: per-service instances, runtimes, and engines."""
+
+    def __init__(self, hub_addr: str, owned_hub: Optional[Any]) -> None:
+        self.hub_addr = hub_addr
+        self._owned_hub = owned_hub
+        self.services: Dict[Type, RunningService] = {}
+
+    def get(self, cls: Type) -> Any:
+        """The live instance of a service class."""
+        return self.services[cls].instance
+
+    async def shutdown(self) -> None:
+        # reverse start order: dependents first
+        for rs in reversed(list(self.services.values())):
+            for client in rs.clients:
+                try:
+                    await client.close()
+                except Exception:
+                    pass
+            stop = getattr(rs.instance, "stopped", None)
+            if stop is not None:
+                try:
+                    await stop()
+                except Exception:
+                    logger.exception("%s.stopped failed", rs.cls.__name__)
+            if rs.engine is not None and hasattr(rs.engine, "stop"):
+                try:
+                    await rs.engine.stop()
+                except Exception:
+                    pass
+            await rs.runtime.shutdown()
+        self.services.clear()
+        if self._owned_hub is not None:
+            await self._owned_hub.stop()
+
+
+async def serve(root: Type, hub: str = "auto") -> ServiceGraph:
+    """Launch ``root`` and every service it depends on (dependencies
+    first).  ``hub="auto"`` spawns an in-process HubServer."""
+    owned_hub = None
+    if hub == "auto":
+        from .runtime.transports.hub import HubServer
+
+        owned_hub = HubServer()
+        host, port = await owned_hub.start()
+        hub = f"{host}:{port}"
+
+    graph = ServiceGraph(hub, owned_hub)
+    try:
+        for cls in _dependency_order(root):
+            meta = service_meta(cls)
+            rt = await DistributedRuntime.detached(hub)
+            instance = cls()
+            rs = RunningService(cls=cls, meta=meta, instance=instance, runtime=rt)
+            graph.services[cls] = rs
+
+            # resolve depends() -> PushRouter over the dependency's endpoint
+            for name, edge in getattr(cls, _DEPENDS, {}).items():
+                dep_meta = service_meta(edge.target)
+                ep = (
+                    rt.namespace(dep_meta.namespace)
+                    .component(dep_meta.component)
+                    .endpoint(dep_meta.endpoint)
+                )
+                client = await ep.client()
+                await client.wait_for_instances(10)
+                rs.clients.append(client)
+                setattr(instance, name, PushRouter(client, edge.router_mode))
+
+            factory = getattr(instance, "create_engine", None)
+            if factory is not None:
+                engine = await factory()
+                rs.engine = engine
+                ep = (
+                    rt.namespace(meta.namespace)
+                    .component(meta.component)
+                    .endpoint(meta.endpoint)
+                )
+                await ep.serve(engine)
+
+            hook = getattr(instance, "started", None)
+            if hook is not None:
+                await hook()
+            logger.info("service %s up (%s/%s/%s)", cls.__name__,
+                        meta.namespace, meta.component, meta.endpoint)
+        return graph
+    except BaseException:
+        await graph.shutdown()
+        raise

@@ -1,0 +1,85 @@
+"""SDK graph tests: @service / depends / serve."""
+
+import pytest
+
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.sdk import ServiceGraph, depends, serve, service, service_meta
+
+
+def preq(tokens, max_tokens=4):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0),
+    ).to_dict()
+
+
+@service(namespace="sdktest")
+class Worker:
+    async def create_engine(self):
+        self.engine = MockerEngine(MockerConfig(block_size=4))
+        return self.engine
+
+
+@service(namespace="sdktest")
+class Frontend:
+    worker = depends(Worker)
+
+    async def started(self):
+        self.ready = True
+
+    async def ask(self, tokens):
+        stream = await self.worker.generate(Context.new(preq(tokens)))
+        out = []
+        async for item in stream:
+            out.extend((item.data or {}).get("token_ids") or [])
+        return out
+
+
+def test_meta_and_dependency_order():
+    meta = service_meta(Frontend)
+    assert meta.component == "frontend" and meta.namespace == "sdktest"
+    with pytest.raises(TypeError):
+        service_meta(dict)
+
+
+def test_serve_graph_end_to_end(run):
+    async def body():
+        graph = await serve(Frontend, hub="auto")
+        try:
+            assert isinstance(graph, ServiceGraph)
+            fe = graph.get(Frontend)
+            assert fe.ready  # started() hook ran after deps resolved
+            tokens = await fe.ask([1, 2, 3])
+            assert len(tokens) == 4  # mocker honored max_tokens
+            # dependency instance is reachable too
+            assert graph.get(Worker).engine is not None
+        finally:
+            await graph.shutdown()
+
+    run(body())
+
+
+def test_cycle_detection(run):
+    @service(namespace="sdktest")
+    class A:
+        pass
+
+    @service(namespace="sdktest")
+    class B:
+        a = depends(A)
+
+    A.b = depends(B)
+    A.b.__set_name__(A, "b")
+
+    async def body():
+        with pytest.raises(ValueError, match="cycle"):
+            await serve(A, hub="auto")
+
+    run(body())
