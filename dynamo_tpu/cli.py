@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--max-local-prefill-length", type=int, default=512)
     run.add_argument("--max-prefill-queue-depth", type=int, default=16)
 
+    # standalone hub (the control plane process; k8s hub Deployment)
+    hub = sub.add_parser("hub", help="run a standalone hub server")
+    hub.add_argument("--host", default="0.0.0.0")
+    hub.add_argument("--port", type=int, default=6650)
+
     # llmctl: cluster model administration (reference llmctl/src/main.rs)
     ctl = sub.add_parser("llmctl", help="list/remove models on a hub")
     ctl.add_argument("--hub", required=True, help="hub address host:port")
@@ -465,6 +470,16 @@ def main(argv=None) -> int:
 
     configure_logging()  # DYN_LOG filter spec + DYN_LOG_JSONL mode
     args = build_parser().parse_args(argv)
+    if args.cmd == "hub":
+        from .runtime.transports.hub import HubServer
+
+        try:
+            asyncio.run(
+                HubServer(host=args.host, port=args.port).serve_forever()
+            )
+        except KeyboardInterrupt:
+            pass
+        return 0
     if args.cmd == "llmctl":
         return asyncio.run(run_llmctl(args))
     args.inp, args.out = _parse_io(args.io)

@@ -1,0 +1,173 @@
+"""Kubernetes deployment rendering: the graph-deployment spec -> manifests.
+
+Reference parity: deploy/cloud (the DynamoGraphDeployment CRD + Go
+operator reconciling hub/frontend/worker Deployments).  The TPU build
+renders the same topology as plain Kubernetes manifests from a Python
+spec -- no in-cluster controller to operate; `kubectl apply` (or any
+GitOps pipe) is the reconciler.  Every component is a Deployment +
+Service wired together through env vars this framework already reads
+(DYN_HUB_ADDRESS etc.), so the manifests and the local CLI launch the
+exact same processes.
+
+    spec = DeploymentSpec(name="tinyllama", model_path="/models/tiny",
+                          decode_workers=4, prefill_workers=2, tp=4)
+    for fname, text in render_manifests(spec).items():
+        (outdir / fname).write_text(text)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import yaml
+
+
+@dataclass
+class DeploymentSpec:
+    """One serving graph: hub + frontend + decode (+ prefill) workers."""
+
+    name: str
+    model_path: str
+    image: str = "dynamo-tpu:latest"
+    namespace: str = "default"
+    hub_port: int = 6650
+    http_port: int = 8080
+    frontend_replicas: int = 1
+    decode_workers: int = 1
+    prefill_workers: int = 0  # > 0 enables disaggregated serving
+    tp: int = 1
+    router_mode: str = "kv"
+    max_local_prefill_length: int = 512
+    tpu_resource: str = "google.com/tpu"
+    tpu_chips_per_worker: int = 0  # 0 = no TPU resource request (CPU/mock)
+    extra_env: Dict[str, str] = field(default_factory=dict)
+    extra_worker_args: List[str] = field(default_factory=list)
+
+
+def _meta(spec: DeploymentSpec, comp: str) -> Dict:
+    return {
+        "name": f"{spec.name}-{comp}",
+        "namespace": spec.namespace,
+        "labels": {"app": spec.name, "component": comp},
+    }
+
+
+def _env(spec: DeploymentSpec, extra: Optional[Dict[str, str]] = None) -> List[Dict]:
+    env = {"DYN_HUB_ADDRESS": f"{spec.name}-hub:{spec.hub_port}",
+           "DYN_LOG_JSONL": "1"}
+    env.update(spec.extra_env)
+    env.update(extra or {})
+    return [{"name": k, "value": str(v)} for k, v in sorted(env.items())]
+
+
+def _deployment(
+    spec: DeploymentSpec,
+    comp: str,
+    replicas: int,
+    args: List[str],
+    port: Optional[int] = None,
+    tpu: bool = False,
+    env: Optional[Dict[str, str]] = None,
+) -> Dict:
+    container: Dict = {
+        "name": comp,
+        "image": spec.image,
+        "args": args,
+        "env": _env(spec, env),
+    }
+    if port is not None:
+        container["ports"] = [{"containerPort": port}]
+    if tpu and spec.tpu_chips_per_worker > 0:
+        container["resources"] = {
+            "limits": {spec.tpu_resource: spec.tpu_chips_per_worker}
+        }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta(spec, comp),
+        "spec": {
+            "replicas": replicas,
+            "selector": {
+                "matchLabels": {"app": spec.name, "component": comp}
+            },
+            "template": {
+                "metadata": {
+                    "labels": {"app": spec.name, "component": comp}
+                },
+                "spec": {"containers": [container]},
+            },
+        },
+    }
+
+
+def _service(spec: DeploymentSpec, comp: str, port: int) -> Dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(spec, comp),
+        "spec": {
+            "selector": {"app": spec.name, "component": comp},
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+
+
+def render_manifests(spec: DeploymentSpec) -> Dict[str, str]:
+    """Render the full graph; returns {filename: yaml}."""
+    py = ["python", "-m", "dynamo_tpu"]
+    out: Dict[str, str] = {}
+
+    def emit(fname: str, *docs: Dict) -> None:
+        out[fname] = yaml.safe_dump_all(list(docs), sort_keys=False)
+
+    emit(
+        "hub.yaml",
+        _deployment(
+            spec, "hub", 1,
+            py + ["hub", "--host", "0.0.0.0", "--port", str(spec.hub_port)],
+            port=spec.hub_port,
+        ),
+        _service(spec, "hub", spec.hub_port),
+    )
+    emit(
+        "frontend.yaml",
+        _deployment(
+            spec, "frontend", spec.frontend_replicas,
+            py + ["run", "in=http", "out=dyn",
+                  "--router-mode", spec.router_mode,
+                  "--host", "0.0.0.0", "--port", str(spec.http_port),
+                  "--hub", f"{spec.name}-hub:{spec.hub_port}"],
+            port=spec.http_port,
+        ),
+        _service(spec, "frontend", spec.http_port),
+    )
+    decode_args = py + [
+        "run", "in=dyn", "out=jax",
+        "--model-path", spec.model_path,
+        "--tp", str(spec.tp),
+        "--hub", f"{spec.name}-hub:{spec.hub_port}",
+    ] + spec.extra_worker_args
+    if spec.prefill_workers > 0:
+        decode_args += [
+            "--disagg", "decode",
+            "--max-local-prefill-length", str(spec.max_local_prefill_length),
+        ]
+    emit(
+        "decode-worker.yaml",
+        _deployment(spec, "decode", spec.decode_workers, decode_args, tpu=True),
+    )
+    if spec.prefill_workers > 0:
+        emit(
+            "prefill-worker.yaml",
+            _deployment(
+                spec, "prefill", spec.prefill_workers,
+                py + ["run", "in=dyn", "out=jax",
+                      "--model-path", spec.model_path,
+                      "--tp", str(spec.tp),
+                      "--hub", f"{spec.name}-hub:{spec.hub_port}",
+                      "--disagg", "prefill"] + spec.extra_worker_args,
+                tpu=True,
+            ),
+        )
+    return out

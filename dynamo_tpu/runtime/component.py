@@ -154,6 +154,14 @@ class DistributedRuntime:
             await self.data_server.stop()
         await self.hub.close()
 
+    async def wait_for_shutdown(self) -> None:
+        """Block until shutdown is requested (signal handler, hub loss, or
+        an explicit ``shutdown()``) -- the app-harness idle state."""
+        await self._shutdown.wait()
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+
     def namespace(self, name: str) -> "Namespace":
         return Namespace(self, name)
 

@@ -268,6 +268,16 @@ class HubServer:
         logger.info("hub listening on %s:%d", self.host, self.port)
         return self.host, self.port
 
+    async def serve_forever(self) -> None:
+        """Start (if needed) and run until cancelled -- the standalone-hub
+        entrypoint (``dynamo-tpu hub``, k8s hub Deployment)."""
+        if self._server is None:
+            await self.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await self.stop()
+
     async def stop(self) -> None:
         if self._expiry_task:
             self._expiry_task.cancel()

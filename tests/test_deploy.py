@@ -1,0 +1,59 @@
+"""K8s manifest rendering (deploy/cloud parity, operator-free)."""
+
+import yaml
+
+from dynamo_tpu.deploy import DeploymentSpec, render_manifests
+
+
+def _load_all(text):
+    return list(yaml.safe_load_all(text))
+
+
+def test_aggregated_graph_manifests():
+    spec = DeploymentSpec(
+        name="tiny", model_path="/models/tiny", decode_workers=3, tp=4,
+        tpu_chips_per_worker=4,
+    )
+    m = render_manifests(spec)
+    assert set(m) == {"hub.yaml", "frontend.yaml", "decode-worker.yaml"}
+
+    hub_dep, hub_svc = _load_all(m["hub.yaml"])
+    assert hub_dep["kind"] == "Deployment" and hub_svc["kind"] == "Service"
+    assert hub_dep["metadata"]["name"] == "tiny-hub"
+    assert "hub" in hub_dep["spec"]["template"]["spec"]["containers"][0]["args"]
+
+    fe_dep, fe_svc = _load_all(m["frontend.yaml"])
+    c = fe_dep["spec"]["template"]["spec"]["containers"][0]
+    assert "in=http" in c["args"] and "out=dyn" in c["args"]
+    assert fe_svc["spec"]["ports"][0]["port"] == 8080
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["DYN_HUB_ADDRESS"] == "tiny-hub:6650"
+
+    (dec,) = _load_all(m["decode-worker.yaml"])
+    assert dec["spec"]["replicas"] == 3
+    c = dec["spec"]["template"]["spec"]["containers"][0]
+    assert "--tp" in c["args"] and "4" in c["args"]
+    assert c["resources"]["limits"]["google.com/tpu"] == 4
+    assert "--disagg" not in c["args"]  # aggregated mode
+
+
+def test_disaggregated_graph_adds_prefill_workers():
+    spec = DeploymentSpec(
+        name="big", model_path="/m", decode_workers=2, prefill_workers=2,
+    )
+    m = render_manifests(spec)
+    assert "prefill-worker.yaml" in m
+    (dec,) = _load_all(m["decode-worker.yaml"])
+    dargs = dec["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--disagg" in dargs and "decode" in dargs
+    (pre,) = _load_all(m["prefill-worker.yaml"])
+    pargs = pre["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--disagg" in pargs and "prefill" in pargs
+    assert pre["spec"]["replicas"] == 2
+
+
+def test_hub_cli_subcommand_parses():
+    from dynamo_tpu.cli import build_parser
+
+    args = build_parser().parse_args(["hub", "--port", "7000"])
+    assert args.cmd == "hub" and args.port == 7000

@@ -224,3 +224,37 @@ def test_pp_prefill_rejects_bad_divisibility():
             params, cfg, kv, jnp.zeros((2, 8), jnp.int32),
             jnp.asarray([8, 8], jnp.int32), jnp.zeros((2, 1), jnp.int32), mesh,
         )
+
+
+def test_moe_ep_sharded_matches_single_device():
+    """Capacity-based MoE dispatch with experts sharded over ep=4 must
+    match the unsharded computation (GSPMD turns the [E, C, H] pack/
+    combine into the expert all_to_all)."""
+    from jax.sharding import NamedSharding
+
+    from dynamo_tpu.engine.model import _moe_mlp, init_params
+
+    cfg = ModelConfig.tiny(
+        num_heads=4, num_kv_heads=2, hidden_size=32, head_dim=8,
+        num_experts=4, num_experts_per_tok=2, moe_capacity_factor=4.0,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    lp = jax.tree.map(lambda a: a[0], params["layers"])
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 32), jnp.float32)
+    ref = _moe_mlp(lp, x, cfg)
+
+    mesh = build_mesh(MeshConfig(ep=4), jax.devices()[:4])
+    ep_spec = {
+        "router": P(),
+        "w_gate": P("ep", None, None),
+        "w_up": P("ep", None, None),
+        "w_down": P("ep", None, None),
+    }
+    lp_sharded = {
+        k: jax.device_put(
+            v, NamedSharding(mesh, ep_spec.get(k, P()))
+        )
+        for k, v in lp.items()
+    }
+    got = jax.jit(lambda l, xx: _moe_mlp(l, xx, cfg))(lp_sharded, x)
+    assert float(jnp.max(jnp.abs(ref - got))) < 1e-5

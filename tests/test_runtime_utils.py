@@ -137,3 +137,66 @@ def test_endpoint_id_rejects_malformed():
                 "dyn://a.b.c:zz"):
         with pytest.raises(ValueError):
             EndpointId.parse(bad)
+
+
+# -- RuntimeConfig + Worker harness ----------------------------------------
+
+
+def test_runtime_config_from_env(monkeypatch):
+    from dynamo_tpu.runtime.config import RuntimeConfig
+
+    monkeypatch.setenv("DYN_HUB_ADDRESS", "10.1.2.3:7000")
+    monkeypatch.setenv("DYN_LEASE_TTL", "2.5")
+    monkeypatch.setenv("DYN_TRACE", "1")
+    monkeypatch.setenv("DYN_NUM_NODES", "2")
+    cfg = RuntimeConfig.from_env()
+    assert cfg.hub_address == "10.1.2.3:7000"
+    assert cfg.lease_ttl_s == 2.5
+    assert cfg.trace and cfg.num_nodes == 2
+
+
+def test_worker_execute_runs_app_and_shuts_down(run, monkeypatch):
+    from dynamo_tpu.runtime.config import RuntimeConfig, Worker
+    from dynamo_tpu.runtime.transports.hub import HubServer
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        try:
+            seen = {}
+
+            async def app(runtime):
+                seen["lease"] = runtime.primary_lease
+                runtime.request_shutdown()
+                await runtime.wait_for_shutdown()
+                return "done"
+
+            w = Worker(RuntimeConfig(hub_address=f"{host}:{port}"))
+            result = await w.execute_async(app)
+            assert result == "done"
+            assert seen["lease"] != 0
+        finally:
+            await hub.stop()
+
+    run(body())
+
+
+def test_worker_execute_shuts_down_on_app_failure(run):
+    from dynamo_tpu.runtime.config import RuntimeConfig, Worker
+    from dynamo_tpu.runtime.transports.hub import HubServer
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        try:
+            async def app(runtime):
+                raise RuntimeError("app exploded")
+
+            w = Worker(RuntimeConfig(hub_address=f"{host}:{port}"))
+            with pytest.raises(RuntimeError, match="app exploded"):
+                await w.execute_async(app)
+            # the lease was revoked: no leases left on the hub
+        finally:
+            await hub.stop()
+
+    run(body())
