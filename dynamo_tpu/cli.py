@@ -77,6 +77,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="leader host:port for the jax.distributed "
                           "coordinator")
     run.add_argument("--prompt", help="in=text: run one prompt and exit")
+    run.add_argument("--input-file", help="in=batch: JSONL prompts file")
+    run.add_argument("--output-file", help="in=batch: JSONL results path "
+                                           "(default stdout)")
     run.add_argument("--max-tokens", type=int, default=128)
     # disaggregated prefill/decode (in=dyn workers only)
     run.add_argument("--disagg", choices=["decode", "prefill"],
@@ -417,6 +420,79 @@ async def run_text(args) -> None:
         await engine.stop()
 
 
+async def run_batch(args) -> None:
+    """in=batch out=jax|mocker|echo: run a JSONL file of prompts through the
+    full pipeline concurrently; one JSON result line per prompt, in input
+    order (reference dynamo-run ``in=batch:file``).
+
+    Input lines: ``{"text": "..."}`` (or ``{"prompt": ...}``), optional
+    ``max_tokens``.  Output lines: ``{"index", "text", "response"}``.
+    """
+    import json as _json
+
+    from .llm.backend import Backend
+    from .llm.preprocessor import OpenAIPreprocessor
+    from .protocols.openai import ChatCompletionRequest
+    from .runtime.engine import Annotated, Context, as_response_stream
+    from .runtime.pipeline import link
+
+    if not args.input_file:
+        raise SystemExit("in=batch requires --input-file prompts.jsonl")
+    engine = await _make_engine(args)
+    tokenizer = _tokenizer_for(args)
+    name = _model_name(args)
+    pipeline = link(OpenAIPreprocessor(name, tokenizer), Backend(tokenizer), engine)
+
+    prompts = []
+    with open(args.input_file, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                prompts.append(_json.loads(line))
+
+    async def one(i, entry):
+        text = entry.get("text") or entry.get("prompt") or ""
+        req = ChatCompletionRequest.from_dict(
+            {
+                "model": name,
+                "messages": [{"role": "user", "content": text}],
+                "stream": True,
+                "max_tokens": int(entry.get("max_tokens", args.max_tokens)),
+            }
+        )
+        parts: list = []
+        error = None
+        stream = await as_response_stream(pipeline, Context.new(req))
+        async for item in stream:
+            if not isinstance(item, Annotated):
+                item = Annotated.from_data(item)
+            if item.is_error():
+                error = item.error_message()
+                break
+            for choice in (item.data or {}).get("choices", []):
+                delta = (choice.get("delta") or {}).get("content")
+                if delta:
+                    parts.append(delta)
+        out = {"index": i, "text": text, "response": "".join(parts)}
+        if error:
+            out["error"] = error
+        return out
+
+    try:
+        results = await asyncio.gather(
+            *(one(i, e) for i, e in enumerate(prompts))
+        )
+        sink = open(args.output_file, "w", encoding="utf-8") if args.output_file else sys.stdout
+        try:
+            for r in results:
+                sink.write(_json.dumps(r) + "\n")
+        finally:
+            if args.output_file:
+                sink.close()
+    finally:
+        await engine.stop()
+
+
 async def _wait_forever(stop: Optional[asyncio.Event] = None) -> None:
     stop = stop or asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -492,6 +568,8 @@ def main(argv=None) -> int:
             asyncio.run(run_worker(args))
         elif args.inp == "text":
             asyncio.run(run_text(args))
+        elif args.inp == "batch":
+            asyncio.run(run_batch(args))
         else:
             raise SystemExit(f"unsupported combination in={args.inp} out={args.out}")
     except KeyboardInterrupt:

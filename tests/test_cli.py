@@ -184,3 +184,30 @@ def test_tracing_disabled_is_noop():
     with tracing.span("x", "req-2"):
         pass
     assert tracing.collector.get("req-2") == []
+
+
+def test_batch_mode_runs_prompt_file(run, tmp_path, model_dir, capsys):
+    """in=batch: a JSONL prompt file runs through the full pipeline and
+    produces one in-order JSON result per line."""
+    import json
+
+    from dynamo_tpu.cli import build_parser, run_batch
+
+    inp = tmp_path / "prompts.jsonl"
+    inp.write_text(
+        json.dumps({"text": "hello world", "max_tokens": 3}) + "\n"
+        + json.dumps({"prompt": "the quick brown fox"}) + "\n"
+    )
+    out = tmp_path / "results.jsonl"
+    args = build_parser().parse_args(
+        ["run", "in=batch", "out=mocker", "--model-path", model_dir,
+         "--input-file", str(inp), "--output-file", str(out),
+         "--max-tokens", "4"]
+    )
+    args.inp, args.out = "batch", "mocker"
+    run(run_batch(args))
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [l["index"] for l in lines] == [0, 1]
+    assert lines[0]["text"] == "hello world"
+    assert all(l["response"] for l in lines)
+    assert all("error" not in l for l in lines)
