@@ -509,7 +509,10 @@ async def run_llmctl(args) -> int:
     from .runtime.transports.client import HubClient
 
     host, _, port = args.hub.rpartition(":")
-    hub = await HubClient(host or "127.0.0.1", int(port)).connect()
+    try:
+        hub = await HubClient(host or "127.0.0.1", int(port)).connect()
+    except OSError as e:
+        raise SystemExit(f"cannot reach hub at {args.hub}: {e}")
     try:
         entries = await hub.kv_get_prefix(f"{MODEL_ROOT}/")
         if args.llmcmd == "list":
