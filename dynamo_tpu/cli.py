@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--block-size", type=int, default=None,
                      help="router-visible KV block size (default: page size)")
     run.add_argument("--decode-block-size", type=int, default=16)
+    run.add_argument("--prefill-chunk-tokens", type=int, default=None,
+                     help="chunked prefill: split long prompts into chunks "
+                          "of this many tokens, interleaved with decode")
     run.add_argument("--host-offload-blocks", type=int, default=0,
                      help="G2 host-RAM KV offload capacity (blocks); 0 = off")
     run.add_argument("--disk-offload-blocks", type=int, default=0,
@@ -138,6 +141,7 @@ async def _make_engine(args):
         num_pages=args.num_pages,
         block_size=args.block_size,
         decode_block_size=args.decode_block_size,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
         host_offload_blocks=args.host_offload_blocks,
         disk_offload_blocks=args.disk_offload_blocks,
         disk_offload_dir=args.disk_offload_dir,

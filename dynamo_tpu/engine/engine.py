@@ -63,6 +63,13 @@ class EngineConfig:
     # decode steps per device dispatch: decode state stays on device for this
     # many tokens, so host round trips amortize K-fold (ITL burstiness trade)
     decode_block_size: int = 16
+    # chunked prefill: prompts longer than this prefill in page-aligned
+    # chunks of this many tokens, one chunk per tick, so decode blocks for
+    # running requests interleave instead of stalling behind one long
+    # prompt (the reference gets this from vLLM's chunked prefill; here
+    # the suffix-prefill machinery restarts at any page-aligned offset).
+    # None = whole prompt in one dispatch.
+    prefill_chunk_tokens: Optional[int] = None
     # sequence-hash prefix-cache reuse (block_manager.PagePool); requires
     # block_size to divide evenly into pages
     enable_prefix_caching: bool = True
@@ -182,6 +189,9 @@ class JaxEngine:
         self._external: Dict[str, SeqState] = {}
         self._deliveries: Dict[str, Tuple[np.ndarray, int]] = {}
         self._external_deadline: Dict[str, float] = {}
+        # chunked prefill: slotted seqs with prompt KV still being written,
+        # one chunk dispatched per tick (interleaves with decode blocks)
+        self._chunking: List[SeqState] = []
         self._external_errors: Dict[str, str] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._wake: Optional[asyncio.Event] = None
@@ -515,7 +525,11 @@ class JaxEngine:
                         self._ex, self._apply_external_kv, seq, first
                     )
                     self._dispatch([ev])
-                if not self.sched.has_runnable_work and not pending:
+                if (
+                    not self.sched.has_runnable_work
+                    and not pending
+                    and not self._chunking
+                ):
                     if self._offload_pending:
                         await loop.run_in_executor(self._ex, self._drain_offload)
                     self._wake.clear()
@@ -539,13 +553,36 @@ class JaxEngine:
                     )
                 self._revive_paused_lanes()
                 fresh: List[Any] = []
+                # advance chunked prefills: one chunk per seq per tick, so
+                # decode blocks interleave below instead of stalling behind
+                # one long prompt
+                still_chunking: List[SeqState] = []
+                for seq in self._chunking:
+                    if (
+                        seq.finish is not None
+                        or seq.slot < 0
+                        or self.sched.slots[seq.slot] is not seq
+                        or not seq.prefilling
+                    ):
+                        continue  # cancelled / preempted mid-prefill
+                    pf = await loop.run_in_executor(
+                        self._ex, self._dispatch_chunk, seq
+                    )
+                    if pf is not None:
+                        fresh.append(pf)  # final chunk sampled
+                    else:
+                        still_chunking.append(seq)
+                self._chunking = still_chunking
                 for seq, prompt_len in plan.prefills:
                     if seq.slot < 0 or self.sched.slots[seq.slot] is not seq:
                         continue  # preempted by this tick's capacity pass
                     pf = await loop.run_in_executor(
                         self._ex, self._do_prefill, seq, prompt_len
                     )
-                    fresh.append(pf)
+                    if pf is not None:
+                        fresh.append(pf)
+                    elif seq.prefilling:
+                        self._chunking.append(seq)
                 if self.sched.num_runnable > 0:
                     blk = await loop.run_in_executor(self._ex, self._dispatch_block)
                     if blk is not None:
@@ -569,6 +606,7 @@ class JaxEngine:
                 logger.exception("engine tick failed")
                 pending = []
                 self._pending_injects.clear()
+                self._chunking = []
                 self._fail_all(f"engine error: {e}")
                 self._dev = None  # full rebuild once work resumes
                 self.sched.dirty_slots.clear()
@@ -715,16 +753,20 @@ class JaxEngine:
         )
         return sampled
 
-    def _do_prefill(self, seq: SeqState, prompt_len: int) -> InflightPrefill:
+    def _do_prefill(
+        self, seq: SeqState, prompt_len: int
+    ) -> Optional[InflightPrefill]:
         """Dispatch prefill + first-token sampling; inject the token into the
         device decode state.  No host round trip -- the token is committed
         later, materialized together with the next decode block.
 
         With a prefix-cache hit (scheduler matched resident blocks), only the
         prompt suffix is prefilled: queries start at position
-        ``cached_prompt_tokens`` and attend to the reused pages."""
-        from ..runtime import tracing
+        ``cached_prompt_tokens`` and attend to the reused pages.
 
+        With chunked prefill configured and a long-enough remainder, only
+        the first chunk dispatches here (no sample); the tick loop advances
+        the rest via ``_dispatch_chunk`` (returns None in that case)."""
         if seq.pending_onboard:
             self._apply_onboards(seq)
         # prefix-cache stats are token-weighted and counted once per request
@@ -733,7 +775,70 @@ class JaxEngine:
             seq.stats_counted = True
             self._prefix_lookups += prompt_len
             self._prefix_hits += seq.cached_prompt_tokens
-        cached = seq.cached_prompt_tokens
+        chunk = self.cfg.prefill_chunk_tokens
+        start = seq.cached_prompt_tokens
+        if chunk is not None and prompt_len - start > chunk:
+            seq.prefilling = True
+            seq.prefilled_tokens = start
+            # the admission row must land (lane inactive while chunking)
+            self._sync_device_state()
+            return self._dispatch_chunk(seq)
+        return self._finish_prefill(seq, prompt_len, start)
+
+    def _dispatch_chunk(self, seq: SeqState) -> Optional[InflightPrefill]:
+        """Advance one page-aligned chunk of a chunked prefill (executor
+        thread).  Intermediate chunks write KV and sample nothing; the final
+        chunk runs the normal sample-and-inject path and re-activates the
+        lane (dirty row ordered after the dispatch)."""
+        prompt_len = len(seq.prompt)
+        start = seq.prefilled_tokens
+        chunk = self.cfg.prefill_chunk_tokens
+        assert chunk is not None
+        if prompt_len - start <= chunk:
+            seq.prefilling = False
+            pf = self._finish_prefill(seq, prompt_len, start)
+            self.sched.dirty_slots.add(seq.slot)
+            return pf
+        ps = self.cfg.page_size
+        suffix_len = chunk - (chunk % ps) or ps  # page-aligned chunk
+        bucket = pick_bucket(self.buckets, suffix_len)
+        n_suffix_pages = bucket // ps
+        n_prefix_pages = start // ps
+        prefix_P = pick_page_bucket(
+            max(n_prefix_pages, 1), self.sched.max_pages
+        )
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :suffix_len] = seq.prompt[start : start + suffix_len]
+        prefix_table = np.zeros((1, prefix_P), np.int32)
+        prefix_table[0, :n_prefix_pages] = seq.pages[:n_prefix_pages]
+        suffix_table = np.zeros((1, n_suffix_pages), np.int32)
+        k = min(len(seq.pages) - n_prefix_pages, n_suffix_pages)
+        suffix_table[0, :k] = seq.pages[n_prefix_pages : n_prefix_pages + k]
+        _, self.kv.pages = prefill_suffix_and_sample(
+            self.params,
+            self.model_cfg,
+            self.kv.pages,
+            jnp.asarray(tokens),
+            jnp.asarray([start], np.int32),
+            jnp.asarray([suffix_len], np.int32),
+            jnp.asarray(prefix_table),
+            jnp.asarray(suffix_table),
+            self._next_rng(),
+            self._sampling_arrays([seq]),
+        )
+        seq.prefilled_tokens = start + suffix_len
+        self._steps += 1
+        logger.debug(
+            "prefill chunk id=%s %d..%d/%d", seq.request_id, start,
+            seq.prefilled_tokens, prompt_len,
+        )
+        return None
+
+    def _finish_prefill(
+        self, seq: SeqState, prompt_len: int, cached: int
+    ) -> InflightPrefill:
+        from ..runtime import tracing
+
         ps = self.cfg.page_size
         if cached > 0:
             suffix_len = prompt_len - cached
@@ -842,6 +947,7 @@ class JaxEngine:
                     seq is not None
                     and limits[b] > int(sched.seq_lens[b])
                     and not seq.awaiting_kv
+                    and not seq.prefilling
                 ),
                 "stop": self._lane_stop_row(seq),
                 "pages": sched.page_table[b].copy(),
@@ -932,7 +1038,9 @@ class JaxEngine:
             # its next KV write to the trash page and emit a garbage token.
             # Lanes awaiting a remote prefill's KV stay parked until delivery.
             active[b] = (
-                limit[b] > int(sched.seq_lens[b]) and not seq.awaiting_kv
+                limit[b] > int(sched.seq_lens[b])
+                and not seq.awaiting_kv
+                and not seq.prefilling
             )
             # stop tokens the device may swallow itself: only when the host
             # rules coincide exactly (no min_tokens gating)

@@ -84,6 +84,10 @@ class SeqState:
     # disaggregation: prompt KV arrives from a remote prefill worker; the
     # lane holds pages but stays inactive until delivery
     awaiting_kv: bool = False
+    # chunked prefill: prompt tokens whose KV has been dispatched so far;
+    # the lane stays decode-inactive while prefilling is True
+    prefilled_tokens: int = 0
+    prefilling: bool = False
 
     @property
     def seq_len(self) -> int:
@@ -169,10 +173,13 @@ class Scheduler:
 
     @property
     def num_runnable(self) -> int:
-        """Slotted lanes the device can actually step (parked awaiting_kv
-        lanes hold a slot + pages but must not spin decode blocks)."""
+        """Slotted lanes the device can actually step (parked awaiting_kv /
+        mid-chunked-prefill lanes hold a slot + pages but must not spin
+        decode blocks)."""
         return sum(
-            1 for s in self.slots if s is not None and not s.awaiting_kv
+            1
+            for s in self.slots
+            if s is not None and not s.awaiting_kv and not s.prefilling
         )
 
     @property
@@ -420,6 +427,8 @@ class Scheduler:
         return list(all_tokens[len(seq.prompt) :])
 
     def _release_slot(self, seq: SeqState) -> None:
+        seq.prefilling = False
+        seq.prefilled_tokens = 0
         if seq.slot >= 0:
             b = seq.slot
             self.slots[b] = None

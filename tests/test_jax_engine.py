@@ -488,3 +488,93 @@ def test_churn_determinism_no_drain_pipeline(run):
             await engine.stop()
 
     run(body())
+
+
+def test_chunked_prefill_matches_unchunked(run):
+    """Chunked prefill (any chunk size) must reproduce the single-dispatch
+    greedy output exactly -- the chunks restart the suffix machinery at
+    page-aligned offsets over the same pages."""
+
+    async def body():
+        prompt = [((i * 7) % 200) + 1 for i in range(30)]
+        ref_engine = make_engine(num_pages=64, max_seq_len=64)
+        try:
+            expect, fin = await collect(ref_engine, req(prompt, max_tokens=6))
+        finally:
+            await ref_engine.stop()
+
+        for chunk in (4, 8, 12, 13):  # incl. a non-page-aligned size
+            engine = make_engine(
+                num_pages=64, max_seq_len=64, prefill_chunk_tokens=chunk
+            )
+            try:
+                toks, f = await collect(engine, req(prompt, max_tokens=6))
+                assert toks == expect, f"chunk={chunk}: {toks} != {expect}"
+                assert f == fin
+            finally:
+                await engine.stop()
+
+    run(body())
+
+
+def test_chunked_prefill_interleaves_with_decode(run):
+    """While a long prompt chunk-prefills, an already-running request keeps
+    decoding: the short request must finish before the chunked one emits
+    its first token."""
+
+    async def body():
+        engine = make_engine(
+            num_pages=64, max_seq_len=64, prefill_chunk_tokens=4,
+            decode_block_size=2,
+        )
+        try:
+            order = []
+
+            async def short():
+                toks, _ = await collect(engine, req([5, 6, 7], max_tokens=8))
+                order.append("short-done")
+                return toks
+
+            async def long_prompt():
+                ctx = Context.new(req(list(range(1, 29)), max_tokens=2))
+                stream = await engine.generate(ctx)
+                first = True
+                toks = []
+                async for item in stream:
+                    got = (item.data or {}).get("token_ids") or []
+                    if got and first:
+                        order.append("long-first-token")
+                        first = False
+                    toks.extend(got)
+                return toks
+
+            t_short = asyncio.ensure_future(short())
+            await asyncio.sleep(0.05)  # short admitted and decoding
+            t_long = asyncio.ensure_future(long_prompt())
+            await asyncio.gather(t_short, t_long)
+            assert order.index("short-done") < order.index("long-first-token")
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_chunked_prefill_cancel_mid_chunking_frees_pages(run):
+    async def body():
+        engine = make_engine(
+            num_pages=64, max_seq_len=64, prefill_chunk_tokens=4
+        )
+        try:
+            ctx = Context.new(req(list(range(1, 25)), max_tokens=4))
+            stream = await engine.generate(ctx)
+            await asyncio.sleep(0.02)  # a chunk or two dispatched
+            ctx.ctx.stop_generating()
+            async for _ in stream:
+                pass
+            # give the loop a tick to release
+            await asyncio.sleep(0.05)
+            assert engine.sched.num_active == 0
+        finally:
+            await engine.stop()
+
+    run(body())
