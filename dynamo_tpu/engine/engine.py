@@ -47,6 +47,7 @@ from .step import (
     prefill_and_sample,
     prefill_buckets,
     prefill_suffix_and_sample,
+    scatter_block_pages,
     update_lane,
 )
 
@@ -180,6 +181,16 @@ class JaxEngine:
             self.offload = HostTier(self.cfg.host_offload_blocks, parent=disk)
             pool.on_evict = self._on_pool_evict
             self.sched.offload_lookup = self.offload.get
+        # chunked prefill restarts at page-aligned offsets: normalize the
+        # configured chunk up to a whole page so an intermediate chunk can
+        # never overrun the remaining prompt (trigger and dispatch both use
+        # the normalized value)
+        self._chunk_tokens: Optional[int] = None
+        if self.cfg.prefill_chunk_tokens is not None:
+            ps_ = self.cfg.page_size
+            self._chunk_tokens = max(
+                ps_, -(-self.cfg.prefill_chunk_tokens // ps_) * ps_
+            )
         self.buckets = prefill_buckets(self.cfg.page_size, self.cfg.max_seq_len)
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._queues: Dict[str, asyncio.Queue] = {}
@@ -436,9 +447,21 @@ class JaxEngine:
         blob = seq._kv_blob  # type: ignore[attr-defined]
         del seq._kv_blob  # type: ignore[attr-defined]
         n_pages = blob.shape[2]
-        ids = np.asarray(seq.pages[:n_pages], np.int32)
-        self.kv.pages = self.kv.pages.at[:, :, ids].set(
-            jnp.asarray(blob, self.kv.pages.dtype)
+        # donated, jitted scatter (scatter_block_pages): an out-of-jit
+        # .at[].set would materialize a full copy of the KV pool per
+        # delivery.  Pad the page list to a power-of-two bucket (extra
+        # slots target trash page 0 with zero content) so compile-cache
+        # entries stay few across prompt sizes.
+        bucket = pick_page_bucket(n_pages, self.sched.max_pages)
+        ids = np.zeros((bucket,), np.int32)
+        ids[:n_pages] = seq.pages[:n_pages]
+        padded = blob
+        if bucket > n_pages:
+            pad = [(0, 0)] * blob.ndim
+            pad[2] = (0, bucket - n_pages)
+            padded = np.pad(blob, pad)
+        self.kv.pages = scatter_block_pages(
+            self.kv.pages, jnp.asarray(ids), jnp.asarray(padded)
         )
         seq.awaiting_kv = False
         ev = self.sched.commit_prefill_token(seq, first_token)
@@ -775,7 +798,7 @@ class JaxEngine:
             seq.stats_counted = True
             self._prefix_lookups += prompt_len
             self._prefix_hits += seq.cached_prompt_tokens
-        chunk = self.cfg.prefill_chunk_tokens
+        chunk = self._chunk_tokens
         start = seq.cached_prompt_tokens
         if chunk is not None and prompt_len - start > chunk:
             seq.prefilling = True
@@ -792,7 +815,7 @@ class JaxEngine:
         lane (dirty row ordered after the dispatch)."""
         prompt_len = len(seq.prompt)
         start = seq.prefilled_tokens
-        chunk = self.cfg.prefill_chunk_tokens
+        chunk = self._chunk_tokens
         assert chunk is not None
         if prompt_len - start <= chunk:
             seq.prefilling = False
@@ -800,7 +823,7 @@ class JaxEngine:
             self.sched.dirty_slots.add(seq.slot)
             return pf
         ps = self.cfg.page_size
-        suffix_len = chunk - (chunk % ps) or ps  # page-aligned chunk
+        suffix_len = chunk  # page-aligned by construction (__init__)
         bucket = pick_bucket(self.buckets, suffix_len)
         n_suffix_pages = bucket // ps
         n_prefix_pages = start // ps
@@ -1042,14 +1065,9 @@ class JaxEngine:
                 and not seq.awaiting_kv
                 and not seq.prefilling
             )
-            # stop tokens the device may swallow itself: only when the host
-            # rules coincide exactly (no min_tokens gating)
-            if seq.stop.min_tokens is None:
-                ids = list(seq.stop.stop_token_ids_hidden or [])
-                if not seq.stop.ignore_eos:
-                    ids += list(seq.eos_ids)
-                for j, t in enumerate(ids[:E]):
-                    stop_ids[b, j] = t
+            # stop tokens the device may swallow itself (shared helper so
+            # the full-rebuild and dirty-row paths cannot diverge)
+            stop_ids[b] = self._lane_stop_row(seq)
         # COPY the scheduler mirrors with numpy (synchronous) before handing
         # them to JAX: on CPU, jnp.asarray aliases the numpy buffer zero-copy
         # and even jnp.array's copy can be performed asynchronously -- while

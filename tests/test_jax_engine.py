@@ -578,3 +578,26 @@ def test_chunked_prefill_cancel_mid_chunking_frees_pages(run):
             await engine.stop()
 
     run(body())
+
+
+def test_chunked_prefill_chunk_smaller_than_page(run):
+    """A prefill_chunk_tokens below page_size must normalize up to a page,
+    not crash the tick loop on an overrunning intermediate chunk."""
+
+    async def body():
+        prompt = list(range(1, 23))
+        ref = make_engine(num_pages=64, max_seq_len=64)
+        try:
+            expect, _ = await collect(ref, req(prompt, max_tokens=4))
+        finally:
+            await ref.stop()
+        engine = make_engine(
+            num_pages=64, max_seq_len=64, prefill_chunk_tokens=3  # < page 4
+        )
+        try:
+            toks, _ = await collect(engine, req(prompt, max_tokens=4))
+            assert toks == expect
+        finally:
+            await engine.stop()
+
+    run(body())
