@@ -99,6 +99,16 @@ async def main():
             best = (total, elapsed, steps)
     total, elapsed, steps = best
 
+    # prefill throughput: 8 cold 512-token prompts (prefix caching off via
+    # fresh token ids), one token each -- measures prompt ingestion
+    pf_prompts = [rs.randint(1, 30000, (512,)).tolist() for _ in range(8)]
+    await run_batch(engine, pf_prompts, max_tokens=1)  # compile the bucket
+    pf_prompts = [rs.randint(1, 30000, (512,)).tolist() for _ in range(8)]
+    t0 = time.monotonic()
+    await run_batch(engine, pf_prompts, max_tokens=1)
+    pf_elapsed = time.monotonic() - t0
+    prefill_tok_s = 8 * 512 / pf_elapsed
+
     tok_s = total / elapsed
     steps_s = steps / elapsed
     # each decode step streams ~all weights once (batch small) plus the
@@ -120,6 +130,7 @@ async def main():
                 "vs_baseline": round(tok_s / baseline, 3),
                 "decode_steps_s": round(decode_steps_s, 2),
                 "dispatches_s": round(steps_s, 2),
+                "prefill_tok_s": round(prefill_tok_s, 1),
                 "est_hbm_util_v5e": round(util, 4),
                 "param_bytes": pbytes,
             }
