@@ -596,16 +596,40 @@ class JaxEngine:
                     else:
                         still_chunking.append(seq)
                 self._chunking = still_chunking
+                # batch plain prefills by compiled shape: a burst of N
+                # admissions costs one weight-streaming pass per shape
+                # group instead of N (chunked-prefill candidates go one at
+                # a time through _do_prefill)
+                groups: Dict[Tuple[int, int], List[Tuple[SeqState, int]]] = {}
                 for seq, prompt_len in plan.prefills:
                     if seq.slot < 0 or self.sched.slots[seq.slot] is not seq:
                         continue  # preempted by this tick's capacity pass
-                    pf = await loop.run_in_executor(
-                        self._ex, self._do_prefill, seq, prompt_len
+                    cached = seq.cached_prompt_tokens
+                    if (
+                        self._chunk_tokens is not None
+                        and prompt_len - cached > self._chunk_tokens
+                    ):
+                        pf = await loop.run_in_executor(
+                            self._ex, self._do_prefill, seq, prompt_len
+                        )
+                        if pf is not None:
+                            fresh.append(pf)
+                        elif seq.prefilling:
+                            self._chunking.append(seq)
+                        continue
+                    key = (
+                        pick_bucket(self.buckets, prompt_len - cached),
+                        pick_page_bucket(
+                            max(cached // self.cfg.page_size, 1),
+                            self.sched.max_pages,
+                        ) if cached else 0,
                     )
-                    if pf is not None:
-                        fresh.append(pf)
-                    elif seq.prefilling:
-                        self._chunking.append(seq)
+                    groups.setdefault(key, []).append((seq, prompt_len))
+                for items in groups.values():
+                    pfs = await loop.run_in_executor(
+                        self._ex, self._do_prefill_group, items
+                    )
+                    fresh.extend(pfs)
                 if self.sched.num_runnable > 0:
                     blk = await loop.run_in_executor(self._ex, self._dispatch_block)
                     if blk is not None:
@@ -906,6 +930,101 @@ class JaxEngine:
         logger.debug("prefill dispatched id=%s len=%d bucket=%d",
                      seq.request_id, prompt_len, bucket)
         return pf
+
+    def _do_prefill_group(
+        self, items: List[Tuple[SeqState, int]]
+    ) -> List[InflightPrefill]:
+        """One batched prefill dispatch for same-shape admissions (executor
+        thread): the whole group pays a single weight-streaming pass.
+
+        All lanes share a suffix-length bucket and (when any lane has a
+        cached prefix) a prefix-page bucket -- the tick loop groups by
+        exactly those keys, so each group maps to one compiled executable.
+        Ragged true lengths ride the per-lane length/offset arrays."""
+        for seq, _pl in items:
+            if seq.pending_onboard:
+                self._apply_onboards(seq)
+            if not seq.stats_counted:
+                seq.stats_counted = True
+                self._prefix_lookups += len(seq.prompt)
+                self._prefix_hits += seq.cached_prompt_tokens
+        B = len(items)
+        ps = self.cfg.page_size
+        seqs = [seq for seq, _ in items]
+        caches = [seq.cached_prompt_tokens for seq in seqs]
+        if not any(caches):
+            # cache-cold group: plain full prefill (same dispatch family as
+            # the disagg export path)
+            bucket = pick_bucket(
+                self.buckets, max(pl for _, pl in items)
+            )
+            n_pages = bucket // ps
+            tokens = np.zeros((B, bucket), np.int32)
+            lens = np.zeros((B,), np.int32)
+            table = np.zeros((B, n_pages), np.int32)
+            for i, (seq, pl) in enumerate(items):
+                tokens[i, :pl] = seq.prompt
+                lens[i] = pl
+                k = min(len(seq.pages), n_pages)
+                table[i, :k] = seq.pages[:k]
+            sampled, self.kv.pages = prefill_and_sample(
+                self.params,
+                self.model_cfg,
+                self.kv.pages,
+                jnp.asarray(tokens),
+                jnp.asarray(lens),
+                jnp.asarray(table),
+                self._next_rng(),
+                self._sampling_arrays(seqs),
+            )
+        else:
+            bucket = pick_bucket(
+                self.buckets, max(pl - c for (_, pl), c in zip(items, caches))
+            )
+            n_suffix_pages = bucket // ps
+            prefix_P = pick_page_bucket(
+                max(max(caches) // ps, 1), self.sched.max_pages
+            )
+            tokens = np.zeros((B, bucket), np.int32)
+            offsets = np.zeros((B,), np.int32)
+            suffix_lens = np.zeros((B,), np.int32)
+            prefix_table = np.zeros((B, prefix_P), np.int32)
+            suffix_table = np.zeros((B, n_suffix_pages), np.int32)
+            for i, (seq, pl) in enumerate(items):
+                cached = caches[i]
+                sl = pl - cached
+                tokens[i, :sl] = seq.prompt[cached:]
+                offsets[i] = cached
+                suffix_lens[i] = sl
+                npp = cached // ps
+                prefix_table[i, :npp] = seq.pages[:npp]
+                k = min(len(seq.pages) - npp, n_suffix_pages)
+                suffix_table[i, :k] = seq.pages[npp : npp + k]
+            sampled, self.kv.pages = prefill_suffix_and_sample(
+                self.params,
+                self.model_cfg,
+                self.kv.pages,
+                jnp.asarray(tokens),
+                jnp.asarray(offsets),
+                jnp.asarray(suffix_lens),
+                jnp.asarray(prefix_table),
+                jnp.asarray(suffix_table),
+                self._next_rng(),
+                self._sampling_arrays(seqs),
+            )
+        self._sync_device_state()
+        out: List[InflightPrefill] = []
+        for i, (seq, pl) in enumerate(items):
+            tok = sampled[i : i + 1]
+            pf = InflightPrefill(sampled=tok, seq=seq, slot=seq.slot)
+            self._pending_injects[seq.slot] = pf
+            self._dev["tokens"] = inject_token(
+                self._dev["tokens"], seq.slot, tok
+            )
+            out.append(pf)
+        self._steps += 1
+        logger.debug("batched prefill dispatched: %d lanes", B)
+        return out
 
     def _compute_limits(self) -> np.ndarray:
         """Absolute per-lane cache-length caps from the host mirrors.
