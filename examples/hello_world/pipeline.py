@@ -1,0 +1,87 @@
+"""Raw runtime hello world: a three-stage pipeline over the hub.
+
+Reference: lib/bindings/python/examples (hello_world, pipeline) -- a
+frontend operator calls a middle operator which calls the backend engine,
+each stage a separately-served endpoint discovered through the hub.
+
+Run:  python examples/hello_world/pipeline.py
+"""
+
+import asyncio
+
+from dynamo_tpu.runtime.component import (
+    Context,
+    DistributedRuntime,
+    PushRouter,
+)
+from dynamo_tpu.runtime.engine import Annotated, EngineFn, ResponseStream
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+
+def backend():
+    async def handle(request):
+        async def gen():
+            for word in (request.data or {}).get("words", []):
+                yield Annotated.from_data({"word": word.upper()})
+
+        return ResponseStream(request.ctx, gen())
+
+    return EngineFn(handle)
+
+
+def middle(downstream: PushRouter):
+    async def handle(request):
+        async def gen():
+            stream = await downstream.generate(
+                Context.new(request.data, request.id)
+            )
+            async for item in stream:
+                data = dict(item.data or {})
+                data["word"] = f"<{data['word']}>"
+                yield Annotated.from_data(data)
+
+        return ResponseStream(request.ctx, gen())
+
+    return EngineFn(handle)
+
+
+async def main():
+    hub = HubServer()
+    host, port = await hub.start()
+    addr = f"{host}:{port}"
+
+    be_rt = await DistributedRuntime.detached(addr)
+    await be_rt.namespace("hello").component("backend").endpoint(
+        "generate"
+    ).serve(backend())
+
+    mid_rt = await DistributedRuntime.detached(addr)
+    be_client = await (
+        mid_rt.namespace("hello").component("backend").endpoint("generate")
+    ).client()
+    await be_client.wait_for_instances()
+    await mid_rt.namespace("hello").component("middle").endpoint(
+        "generate"
+    ).serve(middle(PushRouter(be_client)))
+
+    fe_rt = await DistributedRuntime.detached(addr)
+    mid_client = await (
+        fe_rt.namespace("hello").component("middle").endpoint("generate")
+    ).client()
+    await mid_client.wait_for_instances()
+    router = PushRouter(mid_client)
+
+    stream = await router.generate(
+        Context.new({"words": ["hello", "distributed", "world"]})
+    )
+    out = [item.data["word"] async for item in stream]
+    print(" ".join(out))
+    assert out == ["<HELLO>", "<DISTRIBUTED>", "<WORLD>"]
+
+    for rt in (fe_rt, mid_rt, be_rt):
+        await rt.shutdown()
+    await hub.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

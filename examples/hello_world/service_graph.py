@@ -1,0 +1,54 @@
+"""SDK hello world: the same graph, declaratively.
+
+Reference: deploy/sdk hello_world (@service + depends + dynamo serve).
+
+Run:  python examples/hello_world/service_graph.py
+"""
+
+import asyncio
+
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.sdk import depends, serve, service
+
+
+@service(namespace="demo")
+class Worker:
+    async def create_engine(self):
+        return MockerEngine(MockerConfig(block_size=4))
+
+
+@service(namespace="demo")
+class Frontend:
+    worker = depends(Worker)
+
+    async def ask(self, tokens, max_tokens=8):
+        req = PreprocessedRequest(
+            token_ids=list(tokens),
+            stop_conditions=StopConditions(max_tokens=max_tokens),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        stream = await self.worker.generate(Context.new(req.to_dict()))
+        out = []
+        async for item in stream:
+            out.extend((item.data or {}).get("token_ids") or [])
+        return out
+
+
+async def main():
+    graph = await serve(Frontend, hub="auto")
+    try:
+        tokens = await graph.get(Frontend).ask([1, 2, 3, 4])
+        print("generated:", tokens)
+        assert len(tokens) == 8
+    finally:
+        await graph.shutdown()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,57 @@
+"""Aggregated serving: OpenAI frontend + one engine, single process.
+
+Reference: examples/llm agg graph.  Equivalent CLI:
+``python -m dynamo_tpu run in=http out=jax --model-path M``.
+
+Run:  python examples/llm/agg.py [--model-path M] [--port 8080]
+"""
+
+import argparse
+import asyncio
+
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import Tokenizer
+from dynamo_tpu.runtime.pipeline import link
+
+
+def build_engine(args):
+    if args.model_path:
+        from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+        return JaxEngine.from_pretrained(
+            args.model_path, EngineConfig(prefill_chunk_tokens=512)
+        )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+
+    return MockerEngine(MockerConfig(block_size=16, vocab_size=512))
+
+
+async def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-path", help="HF dir; omit for the mocker")
+    ap.add_argument("--tokenizer-path", help="defaults to --model-path")
+    ap.add_argument("--port", type=int, default=8080)
+    args = ap.parse_args()
+
+    tok_dir = args.tokenizer_path or args.model_path
+    if not tok_dir:
+        raise SystemExit("need --model-path or --tokenizer-path")
+    tokenizer = Tokenizer.from_model_dir(tok_dir)
+    name = "example"
+    pipeline = link(
+        OpenAIPreprocessor(name, tokenizer), Backend(tokenizer),
+        build_engine(args),
+    )
+    manager = ModelManager()
+    manager.add_chat_model(name, pipeline)
+    manager.add_completion_model(name, pipeline)
+    service = HttpService(manager, port=args.port)
+    await service.start()
+    print(f"POST {service.url}/v1/chat/completions  (model={name!r})")
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,84 @@
+"""KV-aware routed serving: hub + N workers + routed frontend.
+
+Reference: examples/llm agg_router graph.  Spawns everything in one
+process for demonstration; in production each block is its own process
+(`dynamo-tpu hub` / `run in=dyn` / `run in=http out=dyn --router-mode kv`).
+
+Run:  python examples/llm/agg_router.py [--workers 3]
+"""
+
+import argparse
+import asyncio
+
+from dynamo_tpu.llm.kv_router.publisher import (
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+)
+from dynamo_tpu.llm.kv_router.router import KvRouter, KvPushRouter
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime.component import (
+    Context,
+    DistributedRuntime,
+    PushRouter,
+)
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+BLOCK = 16
+
+
+async def spawn_worker(addr):
+    rt = await DistributedRuntime.detached(addr)
+    ns = rt.namespace("demo")
+    comp = ns.component("backend")
+    engine = MockerEngine(MockerConfig(block_size=BLOCK))
+    KvEventPublisher(ns, worker_id=rt.primary_lease).hook(engine)
+    await comp.endpoint("generate").serve(engine)
+    await WorkerMetricsPublisher(engine.metrics).attach(comp)
+    return rt, engine
+
+
+async def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=3)
+    args = ap.parse_args()
+
+    hub = HubServer()
+    host, port = await hub.start()
+    addr = f"{host}:{port}"
+    workers = [await spawn_worker(addr) for _ in range(args.workers)]
+
+    rt = await DistributedRuntime.detached(addr)
+    ns = rt.namespace("demo")
+    chooser = KvRouter(ns, ns.component("backend"), block_size=BLOCK)
+    await chooser.start()
+    client = await ns.component("backend").endpoint("generate").client()
+    await client.wait_for_instances()
+    await chooser.aggregator.scrape_once()
+    router = KvPushRouter(PushRouter(client), chooser)
+
+    prompt = list(range(1, 65))  # 4 shared blocks
+    for i in range(3):
+        req = PreprocessedRequest(
+            token_ids=prompt + [100 + i],
+            stop_conditions=StopConditions(max_tokens=4),
+        )
+        stream = await router.generate(Context.new(req.to_dict()))
+        toks = []
+        async for item in stream:
+            toks.extend((item.data or {}).get("token_ids") or [])
+        wid, overlap = await chooser.find_best_match(prompt)
+        print(f"request {i}: tokens={toks}  best worker={wid:x} "
+              f"overlap={overlap} blocks")
+
+    await chooser.stop()
+    await client.close()
+    await rt.shutdown()
+    for wrt, engine in workers:
+        await engine.stop()
+        await wrt.shutdown()
+    await hub.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,82 @@
+"""Disaggregated prefill/decode, in one process for demonstration.
+
+Reference: examples/llm disagg graph (worker.py + prefill_worker.py).
+Production equivalent: `run in=dyn out=jax --disagg decode|prefill` +
+`run in=http out=dyn` (see .claude/skills/verify/SKILL.md recipes).
+
+Run:  python examples/llm/disagg.py
+"""
+
+import asyncio
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.llm.disagg import (
+    DisaggConfig,
+    DisaggDecodeEngine,
+    KV_DELIVER_ENDPOINT,
+    PrefillWorker,
+)
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime.component import Context, DistributedRuntime
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+
+def tiny_engine():
+    return JaxEngine.random_init(
+        ModelConfig.tiny(),
+        EngineConfig(max_batch_size=4, max_seq_len=64, page_size=4,
+                     num_pages=64),
+    )
+
+
+async def main():
+    # build (and jit-warm) the engines BEFORE connecting to the hub: a
+    # blocking model build starves the lease keepalive and the hub evicts
+    # the half-registered worker (see verify-skill "known traps")
+    decode_engine = tiny_engine()
+    prefill_engine = tiny_engine()
+
+    hub = HubServer()
+    host, port = await hub.start()
+    addr = f"{host}:{port}"
+
+    # decode worker: ships prefills longer than 4 tokens
+    drt = await DistributedRuntime.detached(addr)
+    dns = drt.namespace("demo")
+    decode = DisaggDecodeEngine(
+        decode_engine, dns, "backend", drt.primary_lease,
+        DisaggConfig(max_local_prefill_length=4), block_size=4,
+    )
+    await dns.component("backend").endpoint("generate").serve(decode)
+    await dns.component("backend").endpoint(KV_DELIVER_ENDPOINT).serve(
+        decode.deliver_handler()
+    )
+
+    # prefill worker pool (same weights: same seed)
+    prt = await DistributedRuntime.detached(addr)
+    pw = PrefillWorker(prefill_engine, prt.namespace("demo"))
+    await pw.start()
+
+    req = PreprocessedRequest(
+        token_ids=[3, 1, 4, 1, 5, 9, 2, 6],  # > 4 tokens -> ships remote
+        stop_conditions=StopConditions(max_tokens=6),
+    )
+    stream = await decode.generate(Context.new(req))
+    toks = []
+    async for item in stream:
+        assert not item.is_error(), item.error_message()
+        toks.extend((item.data or {}).get("token_ids") or [])
+    print(f"remote prefills={decode.remote_prefills} "
+          f"local={decode.local_prefills} tokens={toks}")
+    assert decode.remote_prefills == 1 and len(toks) == 6
+
+    await pw.stop()
+    await decode.engine.stop()
+    await pw.engine.stop()
+    await prt.shutdown()
+    await drt.shutdown()
+    await hub.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,69 @@
+"""Planner autoscaling demo: a mocker fleet under a synthetic load ramp.
+
+Reference: examples/llm planner (reactive autoscaler with grace periods).
+Chip-free: the "fleet" is in-process mocker engines managed by the
+LocalConnector; the planner scales it on the fleet's own KV-load metrics.
+
+Run:  python examples/llm/planner_demo.py
+"""
+
+import asyncio
+
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.planner import DECODE, LocalConnector, Planner, PlannerConfig
+
+
+async def main():
+    fleet = []
+
+    async def spawn_decode():
+        engine = MockerEngine(MockerConfig(block_size=4, kv_capacity_blocks=32))
+        await engine.start()
+        fleet.append(engine)
+        return engine
+
+    async def stop(engine):
+        fleet.remove(engine)
+        await engine.stop()
+
+    conn = LocalConnector({DECODE: spawn_decode}, stopper=stop)
+    await conn.add_worker(DECODE)
+
+    # synthetic load: ramp KV usage up, then drop it
+    load = {"kv": 0.95}
+
+    def metrics_source():
+        m = {}
+        for i, engine in enumerate(fleet):
+            fm = engine.metrics()
+            fm.gpu_cache_usage_perc = load["kv"]
+            m[i] = fm
+        return m
+
+    planner = Planner(
+        conn,
+        metrics_source=metrics_source,
+        cfg=PlannerConfig(
+            adjustment_interval_s=0.1,
+            decode_grace_periods=1,
+            max_decode_workers=4,
+        ),
+    )
+    for step in range(6):
+        await planner.step()
+        print(f"step {step}: load={load['kv']:.2f} "
+              f"decode_workers={conn.worker_count(DECODE)}")
+    assert conn.worker_count(DECODE) > 1, "high load must scale up"
+
+    load["kv"] = 0.05
+    for step in range(8):
+        await planner.step()
+    print(f"after ramp-down: decode_workers={conn.worker_count(DECODE)}")
+    assert conn.worker_count(DECODE) == 1, "idle load must scale back down"
+
+    for engine in list(fleet):
+        await stop(engine)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
