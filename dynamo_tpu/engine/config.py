@@ -1,9 +1,10 @@
 """Model architecture config for the first-party JAX engine.
 
 Covers the Llama family surface (Llama 2/3, Mistral, Qwen2 via
-``attention_bias``, Mixtral/DeepSeek-style MoE via ``num_experts``) -- the
-model families the reference serves through vLLM/TRT-LLM configs
-(reference examples/llm/configs/*.yaml, examples/tensorrt_llm/configs).
+``attention_bias``, Mixtral/DeepSeek-style MoE via ``num_experts``, Gemma
+via ``rms_norm_offset``/``gelu``/``scale_embeddings``) -- the model
+families the reference serves through vLLM/TRT-LLM configs (reference
+examples/llm/configs/*.yaml, examples/tensorrt_llm/configs).
 """
 
 from __future__ import annotations
@@ -34,6 +35,11 @@ class ModelConfig:
     # per-expert buffer headroom over perfect balance (GShard capacity
     # factor); assignments past capacity are dropped
     moe_capacity_factor: float = 2.0
+    # Gemma-family switches: RMSNorm multiplies by (1 + w), the MLP uses
+    # tanh-approximated GELU, and embeddings scale by sqrt(hidden)
+    rms_norm_offset: bool = False
+    hidden_act: str = "silu"  # "silu" | "gelu_tanh"
+    scale_embeddings: bool = False
     # activation dtype for compute; params may be stored differently
     dtype: str = "bfloat16"
 
@@ -126,6 +132,15 @@ class ModelConfig:
             ),
             num_experts=cfg.get("num_local_experts", 0),
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            rms_norm_offset=cfg.get("model_type") == "gemma",
+            hidden_act=(
+                "gelu_tanh"
+                if cfg.get("hidden_act", cfg.get("hidden_activation"))
+                in ("gelu_pytorch_tanh", "gelu_tanh")
+                or cfg.get("model_type") == "gemma"
+                else "silu"
+            ),
+            scale_embeddings=cfg.get("model_type") == "gemma",
         )
 
     @classmethod

@@ -90,11 +90,24 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Any = None) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, offset: bool = False
+) -> jax.Array:
+    """RMSNorm; ``offset=True`` multiplies by (1 + w) (Gemma convention,
+    whose checkpoints store weights centered at zero)."""
     dt = x.dtype
     x = x.astype(jnp.float32)
     x = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
-    return (x * weight.astype(jnp.float32)).astype(dt)
+    w = weight.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w
+    return (x * w).astype(dt)
+
+
+def _activate(x: jax.Array, hidden_act: str) -> jax.Array:
+    if hidden_act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
 
 
 def rope_cos_sin(
@@ -122,8 +135,8 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     )
 
 
-def _dense_mlp(lp: Params, x: jax.Array) -> jax.Array:
-    gate = jax.nn.silu(x @ lp["w_gate"])
+def _dense_mlp(lp: Params, x: jax.Array, hidden_act: str = "silu") -> jax.Array:
+    gate = _activate(x @ lp["w_gate"], hidden_act)
     return (gate * (x @ lp["w_up"])) @ lp["w_down"]
 
 
@@ -229,7 +242,7 @@ def transformer_layer(
     loop so the math cannot diverge."""
     B, T, _ = x.shape
     D = cfg.head_dim
-    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps, cfg.rms_norm_offset)
     q = h @ lp["wq"]
     k = h @ lp["wk"]
     v = h @ lp["wv"]
@@ -244,11 +257,11 @@ def transformer_layer(
     k = apply_rope(k, cos, sin)
     attn, new_kv = attn_fn(q, k, v, layer_kv)
     x = x + attn.reshape(B, T, cfg.num_heads * D) @ lp["wo"]
-    h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+    h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps, cfg.rms_norm_offset)
     if cfg.is_moe:
         x = x + _moe_mlp(lp, h2, cfg)
     else:
-        x = x + _dense_mlp(lp, h2)
+        x = x + _dense_mlp(lp, h2, cfg.hidden_act)
     return x, new_kv
 
 
@@ -268,6 +281,8 @@ def transformer(
 
     D = cfg.head_dim
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
+    if cfg.scale_embeddings:  # Gemma: sqrt(hidden) in the embed dtype
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
     cos, sin = rope_cos_sin(positions, D, cfg.rope_theta)  # [B, T, D]
 
     lp_stack = params["layers"]
@@ -280,7 +295,7 @@ def transformer(
         lambda carry, scanned: layer(carry, scanned), x, (lp_stack, kv_pages)
     )
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, cfg.rms_norm_offset)
     if squeeze:
         x = x[:, 0]
     return x, new_kv_pages

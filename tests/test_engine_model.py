@@ -354,3 +354,55 @@ def test_mixtral_moe_matches_hf():
     theirs = ref[-1]
     assert np.argmax(ours) == np.argmax(theirs)
     assert np.max(np.abs(ours - theirs)) < 2e-3
+
+
+def test_gemma_matches_hf():
+    """Gemma-family parity: RMSNorm(1+w), tanh-GELU MLP, sqrt(H)-scaled
+    embeddings, tied lm_head -- a tiny GemmaForCausalLM reproduces through
+    the same weight assembler and trunk."""
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.step import prefill_step
+
+    hf_cfg = GemmaConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=8,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        hidden_act="gelu_pytorch_tanh",
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attention_bias=False,
+    )
+    cfg = ModelConfig.from_hf_config({**hf_cfg.to_dict(), "model_type": "gemma"})
+    assert cfg.rms_norm_offset and cfg.scale_embeddings
+    assert cfg.hidden_act == "gelu_tanh" and cfg.tie_word_embeddings
+    cfg = ModelConfig(**{**cfg.__dict__, "dtype": "float32"})
+
+    torch.manual_seed(0)
+    model = GemmaForCausalLM(hf_cfg).eval()
+    raw = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    params = assemble_params(raw, cfg, jnp.float32)
+
+    tokens = [3, 17, 42, 7, 55, 23, 9, 80]  # one full page of 8
+    ref = hf_logits(model, tokens)
+
+    kv = jnp.zeros((2, 2, 8, 8, 2, 8), jnp.float32)
+    logits, _ = prefill_step(
+        params, cfg, kv,
+        jnp.asarray([tokens], jnp.int32),
+        jnp.asarray([len(tokens)], jnp.int32),
+        jnp.asarray([[1]], jnp.int32),
+    )
+    ours = np.asarray(logits[0])
+    theirs = ref[-1]
+    assert np.argmax(ours) == np.argmax(theirs)
+    assert np.max(np.abs(ours - theirs)) < 2e-3
