@@ -108,10 +108,24 @@ class ModelConfig:
             num_experts_per_tok=2,
         )
 
+    SUPPORTED_MODEL_TYPES = (
+        "llama", "mistral", "qwen2", "mixtral", "gemma",
+    )
+
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":
         """Build from a HuggingFace ``config.json`` dict (llama/mistral/qwen2/
-        mixtral architectures)."""
+        mixtral/gemma architectures).
+
+        Unknown model types raise instead of loading silently: e.g. gemma2
+        carries extra pre/post_feedforward_layernorm tensors the assembler
+        would skip, producing garbage output with no error."""
+        mt = cfg.get("model_type")
+        if mt is not None and mt not in cls.SUPPORTED_MODEL_TYPES:
+            raise ValueError(
+                f"unsupported model_type {mt!r}; supported: "
+                f"{', '.join(cls.SUPPORTED_MODEL_TYPES)}"
+            )
         hidden = cfg["hidden_size"]
         heads = cfg["num_attention_heads"]
         return cls(

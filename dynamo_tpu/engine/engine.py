@@ -62,10 +62,15 @@ def _enable_compilation_cache() -> None:
     import os
 
     path = os.environ.get("DYN_XLA_CACHE_DIR")
-    if path in ("off", "0", ""):
-        if path is not None:
-            return
-        path = None
+    if path is not None and path.lower() in ("off", "0", ""):
+        return
+    # a location the user already configured (JAX's own env var or
+    # jax.config) wins; only fill in the default when nothing is set
+    existing = os.environ.get("JAX_COMPILATION_CACHE_DIR") or getattr(
+        jax.config, "jax_compilation_cache_dir", None
+    )
+    if path is None and existing:
+        return
     if path is None:
         path = os.path.expanduser("~/.cache/dynamo-tpu/xla")
     try:
@@ -793,33 +798,99 @@ class JaxEngine:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def _dispatch_full_prefill(
-        self, seq: SeqState, prompt: List[int], pages: List[int]
+    @staticmethod
+    def _pad_batch(n: int) -> int:
+        """Pad a prefill group to a power-of-two batch so group size does
+        not multiply compile-cache entries (dead rows write trash page 0)."""
+        return 1 << max(n - 1, 0).bit_length()
+
+    def _dispatch_full_prefill_batch(
+        self, items: List[Tuple[SeqState, List[int], List[int]]], Bp: int
     ) -> jax.Array:
-        """Dispatch a full-prompt (no prefix reuse) prefill + first-token
-        sample writing into ``pages``.  Shared by the local prefill path and
-        the disagg export path so they cannot diverge (the disagg-equals-
-        aggregated invariant rests on identical dispatch here)."""
+        """Dispatch full-prompt (no prefix reuse) prefills + first-token
+        samples for up to ``Bp`` lanes; rows past ``len(items)`` are dead
+        (length 0, trash page).  This is THE full-prefill dispatch site --
+        the single-request path and the disagg export path both call it, so
+        they cannot diverge (the disagg-equals-aggregated invariant rests
+        on identical dispatch here)."""
         ps = self.cfg.page_size
-        bucket = pick_bucket(self.buckets, len(prompt))
+        bucket = pick_bucket(
+            self.buckets, max(len(prompt) for _, prompt, _ in items)
+        )
         n_pages = bucket // ps
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, : len(prompt)] = prompt
-        page_table = np.zeros((1, n_pages), np.int32)
-        # the lane may hold growth pages beyond the prompt already
-        # (loop-side ensure_decode_capacity runs before prefill dispatch);
-        # prefill writes only within the prompt's pages
-        k = min(len(pages), n_pages)
-        page_table[0, :k] = pages[:k]
+        tokens = np.zeros((Bp, bucket), np.int32)
+        lens = np.zeros((Bp,), np.int32)
+        page_table = np.zeros((Bp, n_pages), np.int32)
+        seqs: List[Optional[SeqState]] = [None] * Bp
+        for i, (seq, prompt, pages) in enumerate(items):
+            tokens[i, : len(prompt)] = prompt
+            lens[i] = len(prompt)
+            # the lane may hold growth pages beyond the prompt already
+            # (loop-side ensure_decode_capacity runs before prefill
+            # dispatch); prefill writes only within the prompt's pages
+            k = min(len(pages), n_pages)
+            page_table[i, :k] = pages[:k]
+            seqs[i] = seq
         sampled, self.kv.pages = prefill_and_sample(
             self.params,
             self.model_cfg,
             self.kv.pages,
             jnp.asarray(tokens),
-            jnp.asarray([len(prompt)], np.int32),
+            jnp.asarray(lens),
             jnp.asarray(page_table),
             self._next_rng(),
-            self._sampling_arrays([seq]),
+            self._sampling_arrays(seqs),
+        )
+        return sampled
+
+    def _dispatch_full_prefill(
+        self, seq: SeqState, prompt: List[int], pages: List[int]
+    ) -> jax.Array:
+        """Single-lane wrapper over the shared batch dispatch (disagg
+        export path)."""
+        return self._dispatch_full_prefill_batch([(seq, prompt, pages)], 1)
+
+    def _dispatch_suffix_prefill_batch(
+        self, entries: List[Tuple[SeqState, int, int]], Bp: int
+    ) -> jax.Array:
+        """Suffix prefills (cached prefix resident) for up to ``Bp`` lanes;
+        ``entries`` are (seq, prompt_len, cached) with page-aligned cached
+        > 0.  The single-request and group paths share this builder."""
+        ps = self.cfg.page_size
+        bucket = pick_bucket(
+            self.buckets, max(pl - c for _, pl, c in entries)
+        )
+        n_suffix_pages = bucket // ps
+        prefix_P = pick_page_bucket(
+            max(max(c for _, _, c in entries) // ps, 1), self.sched.max_pages
+        )
+        tokens = np.zeros((Bp, bucket), np.int32)
+        offsets = np.zeros((Bp,), np.int32)
+        suffix_lens = np.zeros((Bp,), np.int32)
+        prefix_table = np.zeros((Bp, prefix_P), np.int32)
+        suffix_table = np.zeros((Bp, n_suffix_pages), np.int32)
+        seqs: List[Optional[SeqState]] = [None] * Bp
+        for i, (seq, pl, cached) in enumerate(entries):
+            sl = pl - cached
+            tokens[i, :sl] = seq.prompt[cached:pl]
+            offsets[i] = cached
+            suffix_lens[i] = sl
+            npp = cached // ps
+            prefix_table[i, :npp] = seq.pages[:npp]
+            k = min(len(seq.pages) - npp, n_suffix_pages)
+            suffix_table[i, :k] = seq.pages[npp : npp + k]
+            seqs[i] = seq
+        sampled, self.kv.pages = prefill_suffix_and_sample(
+            self.params,
+            self.model_cfg,
+            self.kv.pages,
+            jnp.asarray(tokens),
+            jnp.asarray(offsets),
+            jnp.asarray(suffix_lens),
+            jnp.asarray(prefix_table),
+            jnp.asarray(suffix_table),
+            self._next_rng(),
+            self._sampling_arrays(seqs),
         )
         return sampled
 
@@ -909,32 +980,11 @@ class JaxEngine:
     ) -> InflightPrefill:
         from ..runtime import tracing
 
-        ps = self.cfg.page_size
         if cached > 0:
-            suffix_len = prompt_len - cached
-            bucket = pick_bucket(self.buckets, suffix_len)
-            n_suffix_pages = bucket // ps
-            n_prefix_pages = cached // ps
-            prefix_P = pick_page_bucket(n_prefix_pages, self.sched.max_pages)
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :suffix_len] = seq.prompt[cached:]
-            prefix_table = np.zeros((1, prefix_P), np.int32)
-            prefix_table[0, :n_prefix_pages] = seq.pages[:n_prefix_pages]
-            suffix_table = np.zeros((1, n_suffix_pages), np.int32)
-            k = min(len(seq.pages) - n_prefix_pages, n_suffix_pages)
-            suffix_table[0, :k] = seq.pages[n_prefix_pages : n_prefix_pages + k]
-            sampled, self.kv.pages = prefill_suffix_and_sample(
-                self.params,
-                self.model_cfg,
-                self.kv.pages,
-                jnp.asarray(tokens),
-                jnp.asarray([cached], np.int32),
-                jnp.asarray([suffix_len], np.int32),
-                jnp.asarray(prefix_table),
-                jnp.asarray(suffix_table),
-                self._next_rng(),
-                self._sampling_arrays([seq]),
+            sampled = self._dispatch_suffix_prefill_batch(
+                [(seq, prompt_len, cached)], 1
             )
+            bucket = pick_bucket(self.buckets, prompt_len - cached)
         else:
             sampled = self._dispatch_full_prefill(seq, seq.prompt, seq.pages)
             bucket = pick_bucket(self.buckets, prompt_len)
@@ -962,8 +1012,13 @@ class JaxEngine:
 
         All lanes share a suffix-length bucket and (when any lane has a
         cached prefix) a prefix-page bucket -- the tick loop groups by
-        exactly those keys, so each group maps to one compiled executable.
-        Ragged true lengths ride the per-lane length/offset arrays."""
+        exactly those keys -- and the batch dimension pads to a power of
+        two, so compile-cache entries stay O(buckets x log(batch)), not
+        O(buckets x batch).  The array construction lives in the shared
+        ``_dispatch_*_prefill_batch`` builders, the same dispatch sites the
+        single-request and disagg-export paths use."""
+        from ..runtime import tracing
+
         for seq, _pl in items:
             if seq.pending_onboard:
                 self._apply_onboards(seq)
@@ -971,69 +1026,15 @@ class JaxEngine:
                 seq.stats_counted = True
                 self._prefix_lookups += len(seq.prompt)
                 self._prefix_hits += seq.cached_prompt_tokens
-        B = len(items)
-        ps = self.cfg.page_size
-        seqs = [seq for seq, _ in items]
-        caches = [seq.cached_prompt_tokens for seq in seqs]
+        Bp = self._pad_batch(len(items))
+        caches = [seq.cached_prompt_tokens for seq, _ in items]
         if not any(caches):
-            # cache-cold group: plain full prefill (same dispatch family as
-            # the disagg export path)
-            bucket = pick_bucket(
-                self.buckets, max(pl for _, pl in items)
-            )
-            n_pages = bucket // ps
-            tokens = np.zeros((B, bucket), np.int32)
-            lens = np.zeros((B,), np.int32)
-            table = np.zeros((B, n_pages), np.int32)
-            for i, (seq, pl) in enumerate(items):
-                tokens[i, :pl] = seq.prompt
-                lens[i] = pl
-                k = min(len(seq.pages), n_pages)
-                table[i, :k] = seq.pages[:k]
-            sampled, self.kv.pages = prefill_and_sample(
-                self.params,
-                self.model_cfg,
-                self.kv.pages,
-                jnp.asarray(tokens),
-                jnp.asarray(lens),
-                jnp.asarray(table),
-                self._next_rng(),
-                self._sampling_arrays(seqs),
+            sampled = self._dispatch_full_prefill_batch(
+                [(seq, seq.prompt, seq.pages) for seq, _ in items], Bp
             )
         else:
-            bucket = pick_bucket(
-                self.buckets, max(pl - c for (_, pl), c in zip(items, caches))
-            )
-            n_suffix_pages = bucket // ps
-            prefix_P = pick_page_bucket(
-                max(max(caches) // ps, 1), self.sched.max_pages
-            )
-            tokens = np.zeros((B, bucket), np.int32)
-            offsets = np.zeros((B,), np.int32)
-            suffix_lens = np.zeros((B,), np.int32)
-            prefix_table = np.zeros((B, prefix_P), np.int32)
-            suffix_table = np.zeros((B, n_suffix_pages), np.int32)
-            for i, (seq, pl) in enumerate(items):
-                cached = caches[i]
-                sl = pl - cached
-                tokens[i, :sl] = seq.prompt[cached:]
-                offsets[i] = cached
-                suffix_lens[i] = sl
-                npp = cached // ps
-                prefix_table[i, :npp] = seq.pages[:npp]
-                k = min(len(seq.pages) - npp, n_suffix_pages)
-                suffix_table[i, :k] = seq.pages[npp : npp + k]
-            sampled, self.kv.pages = prefill_suffix_and_sample(
-                self.params,
-                self.model_cfg,
-                self.kv.pages,
-                jnp.asarray(tokens),
-                jnp.asarray(offsets),
-                jnp.asarray(suffix_lens),
-                jnp.asarray(prefix_table),
-                jnp.asarray(suffix_table),
-                self._next_rng(),
-                self._sampling_arrays(seqs),
+            sampled = self._dispatch_suffix_prefill_batch(
+                [(seq, pl, c) for (seq, pl), c in zip(items, caches)], Bp
             )
         self._sync_device_state()
         out: List[InflightPrefill] = []
@@ -1044,9 +1045,17 @@ class JaxEngine:
             self._dev["tokens"] = inject_token(
                 self._dev["tokens"], seq.slot, tok
             )
+            if tracing.collector.enabled:
+                with tracing.span(
+                    "engine.prefill_dispatch", seq.request_id
+                ) as sp:
+                    sp.set(prompt_len=pl, cached=caches[i], group=len(items))
+            logger.debug(
+                "prefill dispatched id=%s len=%d cached=%d (group of %d)",
+                seq.request_id, pl, caches[i], len(items),
+            )
             out.append(pf)
         self._steps += 1
-        logger.debug("batched prefill dispatched: %d lanes", B)
         return out
 
     def _compute_limits(self) -> np.ndarray:

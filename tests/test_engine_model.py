@@ -406,3 +406,16 @@ def test_gemma_matches_hf():
     theirs = ref[-1]
     assert np.argmax(ours) == np.argmax(theirs)
     assert np.max(np.abs(ours - theirs)) < 2e-3
+
+
+def test_unsupported_model_type_raises():
+    """gemma2 etc. must fail loudly, not load silently as garbage (the
+    assembler would skip their extra norm tensors)."""
+    from dynamo_tpu.engine.config import ModelConfig
+
+    with pytest.raises(ValueError, match="unsupported model_type"):
+        ModelConfig.from_hf_config(
+            {"model_type": "gemma2", "hidden_size": 32,
+             "intermediate_size": 64, "num_hidden_layers": 2,
+             "num_attention_heads": 4, "vocab_size": 64}
+        )
