@@ -1,8 +1,11 @@
 """Attention ops over the paged KV cache (reference-free JAX implementations).
 
-Layout: the KV cache for one layer is ``[2, num_pages, page_size, kv_heads,
-head_dim]``; a request owns a list of pages recorded in its row of the page
-table ``[batch, pages_per_seq]``.  Page 0 is reserved as the trash page:
+Layout: the KV cache is one stacked buffer ``[layers, 2, num_pages,
+page_size, kv_heads, head_dim]``; readers/writers take a scalar layer index
+and scatter/gather in place, so the layer scan carries a single buffer that
+XLA updates without copying.  A request owns a list of pages recorded in
+its row of the page table ``[batch, pages_per_seq]``.  Page 0 is reserved
+as the trash page:
 inactive batch slots scatter their writes there, so dead lanes never corrupt
 live state and every step runs with fully static shapes (XLA requirement).
 
@@ -43,18 +46,20 @@ def _pallas_decode_enabled(page_size: int) -> bool:
 
 def decode_attention_dispatch(
     q: jax.Array,  # [B, Hq, D]
-    kv_pages: jax.Array,  # [2, num_pages, page_size, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page_size, Hkv, D]
     page_table: jax.Array,  # [B, P]
     kv_lens: jax.Array,  # [B]
+    layer: jax.Array,  # scalar i32
 ) -> jax.Array:
     """Decode attention: Pallas page-streaming kernel on TPU, XLA gather
     elsewhere.  Resolved at trace time (static), so each compiled executable
     embeds exactly one backend."""
-    if _pallas_decode_enabled(kv_pages.shape[2]):
+    if _pallas_decode_enabled(kv_pages.shape[3]):
         from ..ops.paged_attention import paged_decode_attention as pallas_decode
 
-        return pallas_decode(q, kv_pages, page_table, kv_lens)
-    return paged_decode_attention(q, kv_pages, page_table, kv_lens)
+        return pallas_decode(q, kv_pages, page_table, kv_lens, layer)
+    layer_kv = jax.lax.dynamic_index_in_dim(kv_pages, layer, 0, keepdims=False)
+    return paged_decode_attention(q, layer_kv, page_table, kv_lens)
 
 
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
@@ -128,7 +133,8 @@ def prefill_prefix_attention(
     q: jax.Array,  # [B, T, Hq, D] suffix queries
     k: jax.Array,  # [B, T, Hkv, D] suffix keys (being prefilled)
     v: jax.Array,  # [B, T, Hkv, D]
-    layer_kv: jax.Array,  # [2, num_pages, page, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    layer: jax.Array,  # scalar i32
     prefix_table: jax.Array,  # [B, Pp] reused-prefix page ids (0-padded)
     offset: jax.Array,  # [B] cached prefix length in tokens
     suffix_lens: jax.Array,  # [B] valid suffix length
@@ -141,11 +147,12 @@ def prefill_prefix_attention(
     pad slots point at trash page 0 and are masked by ``kpos < offset``.
     """
     B, T, Hq, D = q.shape
-    page_size = layer_kv.shape[2]
+    page_size = kv_pages.shape[3]
     Pp = prefix_table.shape[1]
     Hkv = k.shape[2]
     n_rep = Hq // Hkv
 
+    layer_kv = jax.lax.dynamic_index_in_dim(kv_pages, layer, 0, keepdims=False)
     kp = layer_kv[0][prefix_table].reshape(B, Pp * page_size, Hkv, D)
     vp = layer_kv[1][prefix_table].reshape(B, Pp * page_size, Hkv, D)
     keys = repeat_kv(jnp.concatenate([kp, k], axis=1), n_rep)
@@ -171,37 +178,38 @@ def prefill_prefix_attention(
 
 
 def write_prefill_kv(
-    kv_pages: jax.Array,  # [2, num_pages, page, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     k: jax.Array,  # [B, T, Hkv, D]
     v: jax.Array,  # [B, T, Hkv, D]
     page_table: jax.Array,  # [B, P]
+    layer: jax.Array,  # scalar i32
 ) -> jax.Array:
-    """Scatter a full prompt's K/V into its pages.  T must be a multiple of
-    page_size (prompts are bucket-padded); pad lanes land on trash page 0."""
+    """Scatter a full prompt's K/V into its pages (in place -- kv_pages is
+    the scan carry).  T must be a multiple of page_size (prompts are
+    bucket-padded); pad lanes land on trash page 0."""
     B, T, Hkv, D = k.shape
-    page_size = kv_pages.shape[2]
+    page_size = kv_pages.shape[3]
     n_pages = T // page_size
     ids = page_table[:, :n_pages].reshape(-1)  # [B*n_pages]
     kp = k.reshape(B * n_pages, page_size, Hkv, D)
     vp = v.reshape(B * n_pages, page_size, Hkv, D)
-    kv_pages = kv_pages.at[0, ids].set(kp)
-    kv_pages = kv_pages.at[1, ids].set(vp)
+    kv_pages = kv_pages.at[layer, 0, ids].set(kp)
+    kv_pages = kv_pages.at[layer, 1, ids].set(vp)
     return kv_pages
 
 
 def write_decode_kv(
-    kv_pages: jax.Array,  # [2, num_pages, page, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     k: jax.Array,  # [B, Hkv, D] one token
     v: jax.Array,
     page_table: jax.Array,  # [B, P]
     positions: jax.Array,  # [B] position the token lands at
+    layer: jax.Array,  # scalar i32
 ) -> jax.Array:
-    page_size = kv_pages.shape[2]
-    B = k.shape[0]
+    page_size = kv_pages.shape[3]
     page_idx = positions // page_size
     slot = positions % page_size
     ids = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
-    del B
-    kv_pages = kv_pages.at[0, ids, slot].set(k)
-    kv_pages = kv_pages.at[1, ids, slot].set(v)
+    kv_pages = kv_pages.at[layer, 0, ids, slot].set(k)
+    kv_pages = kv_pages.at[layer, 1, ids, slot].set(v)
     return kv_pages

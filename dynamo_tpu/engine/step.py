@@ -43,9 +43,9 @@ def prefill_step(
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
-    def attn_fn(q, k, v, layer_kv):
+    def attn_fn(q, k, v, kv, layer):
         out = att.prefill_attention(q, k, v, seq_lens)
-        new_kv = att.write_prefill_kv(layer_kv, k, v, page_table)
+        new_kv = att.write_prefill_kv(kv, k, v, page_table, layer)
         return out, new_kv
 
     hidden, kv_pages = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)
@@ -65,11 +65,13 @@ def _decode_once(
     """One unjitted decode step.  Returns (logits [B,V], kv)."""
     positions = seq_lens.astype(jnp.int32)  # new token position (0-indexed)
 
-    def attn_fn(q, k, v, layer_kv):
+    def attn_fn(q, k, v, kv, layer):
         # q/k/v arrive [B, 1, H, D]; squeeze the singleton time axis.
         q1, k1, v1 = q[:, 0], k[:, 0], v[:, 0]
-        new_kv = att.write_decode_kv(layer_kv, k1, v1, page_table, positions)
-        out = att.decode_attention_dispatch(q1, new_kv, page_table, positions + 1)
+        new_kv = att.write_decode_kv(kv, k1, v1, page_table, positions, layer)
+        out = att.decode_attention_dispatch(
+            q1, new_kv, page_table, positions + 1, layer
+        )
         return out[:, None], new_kv
 
     hidden, kv_pages = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)
@@ -186,11 +188,11 @@ def prefill_suffix_and_sample(
     B, T = tokens.shape
     positions = offset[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
 
-    def attn_fn(q, k, v, layer_kv):
+    def attn_fn(q, k, v, kv, layer):
         out = att.prefill_prefix_attention(
-            q, k, v, layer_kv, prefix_table, offset, suffix_lens
+            q, k, v, kv, layer, prefix_table, offset, suffix_lens
         )
-        new_kv = att.write_prefill_kv(layer_kv, k, v, suffix_table)
+        new_kv = att.write_prefill_kv(kv, k, v, suffix_table, layer)
         return out, new_kv
 
     hidden, kv_pages = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)

@@ -38,11 +38,12 @@ _NEG_INF = -1e30
 
 def _decode_kernel(
     # scalar prefetch
+    layer_ref,  # [1] layer index (SMEM)
     pt_ref,  # [B, P] page table (SMEM)
     len_ref,  # [B] kv lengths (SMEM)
     # blocked operands
-    k_ref,  # [1, 1, page, Hkv, D] current page's keys (VMEM)
-    v_ref,  # [1, 1, page, Hkv, D] current page's values (VMEM)
+    k_ref,  # [1, 1, 1, page, Hkv, D] current page's keys (VMEM)
+    v_ref,  # [1, 1, 1, page, Hkv, D] current page's values (VMEM)
     q_ref,  # [1, Hq, D] this lane's query (VMEM)
     o_ref,  # [1, Hq, D] output (VMEM)
     # scratch
@@ -52,9 +53,9 @@ def _decode_kernel(
 ):
     b = pl.program_id(0)
     p = pl.program_id(1)
-    page = k_ref.shape[2]
-    Hkv = k_ref.shape[3]
-    D = k_ref.shape[4]
+    page = k_ref.shape[3]
+    Hkv = k_ref.shape[4]
+    D = k_ref.shape[5]
     Hq = q_ref.shape[1]
     n_rep = Hq // Hkv
 
@@ -72,8 +73,8 @@ def _decode_kernel(
     def _attend():
         # [Hkv, n_rep, D] query grouped by kv head
         q = q_ref[0].reshape(Hkv, n_rep, D)
-        k = k_ref[0, 0].transpose(1, 0, 2)  # [Hkv, page, D]
-        v = v_ref[0, 0].transpose(1, 0, 2)  # [Hkv, page, D]
+        k = k_ref[0, 0, 0].transpose(1, 0, 2)  # [Hkv, page, D]
+        v = v_ref[0, 0, 0].transpose(1, 0, 2)  # [Hkv, page, D]
         scale = 1.0 / (D ** 0.5)
         # batched over kv heads: [Hkv, n_rep, page] f32
         s = jax.lax.dot_general(
@@ -112,32 +113,40 @@ def _decode_kernel(
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D] one new query token per lane
-    kv_pages: jax.Array,  # [2, num_pages, page, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     page_table: jax.Array,  # [B, P] int32 page ids
     kv_lens: jax.Array,  # [B] tokens in cache (incl. the one just written)
+    layer: jax.Array | int = 0,  # scalar layer index into kv_pages
     interpret: bool = False,
 ) -> jax.Array:
-    """Drop-in replacement for engine.attention.paged_decode_attention."""
+    """TPU replacement for the XLA gather path (same math as
+    engine.attention.paged_decode_attention run on ``kv_pages[layer]`` --
+    note the interface difference: this takes the FULL stacked buffer plus
+    a (possibly traced) layer index, so the engine's layer scan never
+    slices the cache.  The index rides as scalar prefetch and the BlockSpec
+    maps dereference it per page fetch."""
     B, Hq, D = q.shape
-    _, _, page, Hkv, _ = kv_pages.shape
+    L, _, num_pages, page, Hkv, _ = kv_pages.shape
     P = page_table.shape[1]
-    num_pages = kv_pages.shape[1]
 
     pt = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
     lens = kv_lens.astype(jnp.int32)
+    # clamp like pt above; keeps the Pallas path in-bounds on bad input the
+    # same way dynamic_index_in_dim implicitly clamps the XLA fallback
+    lyr = jnp.clip(jnp.asarray(layer, jnp.int32), 0, L - 1).reshape(1)
 
-    def k_map(b, p, pt_ref, len_ref):
-        return (0, pt_ref[b, p], 0, 0, 0)
+    def k_map(b, p, layer_ref, pt_ref, len_ref):
+        return (layer_ref[0], 0, pt_ref[b, p], 0, 0, 0)
 
-    def v_map(b, p, pt_ref, len_ref):
-        return (1, pt_ref[b, p], 0, 0, 0)
+    def v_map(b, p, layer_ref, pt_ref, len_ref):
+        return (layer_ref[0], 1, pt_ref[b, p], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, P),
         in_specs=[
-            pl.BlockSpec((1, 1, page, Hkv, D), k_map),
-            pl.BlockSpec((1, 1, page, Hkv, D), v_map),
+            pl.BlockSpec((1, 1, 1, page, Hkv, D), k_map),
+            pl.BlockSpec((1, 1, 1, page, Hkv, D), v_map),
             pl.BlockSpec((1, Hq, D), lambda b, p, *_: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, p, *_: (b, 0, 0)),
@@ -152,4 +161,4 @@ def paged_decode_attention(
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(pt, lens, kv_pages, kv_pages, q)
+    )(lyr, pt, lens, kv_pages, kv_pages, q)

@@ -36,7 +36,7 @@ from ..engine.model import (
     lm_logits,
     rms_norm,
     rope_cos_sin,
-    transformer_layer,
+    scan_layers,
 )
 
 
@@ -98,15 +98,11 @@ def pp_prefill_step(
             # bubble ticks write their (garbage) KV to trash page 0
             pt_t = jnp.where(valid, pt_a[mbi_c], 0)
 
-            def attn_fn(q, k, v, layer_kv):
+            def attn_fn(q, k, v, kv_buf, layer):
                 o = att.prefill_attention(q, k, v, lens_t)
-                return o, att.write_prefill_kv(layer_kv, k, v, pt_t)
+                return o, att.write_prefill_kv(kv_buf, k, v, pt_t, layer)
 
-            def layer(xc, scanned):
-                lp, lkv = scanned
-                return transformer_layer(lp, xc, cos_t, sin_t, cfg, attn_fn, lkv)
-
-            x_out, kv = jax.lax.scan(layer, x_in, (lp_local, kv))
+            x_out, kv = scan_layers(lp_local, kv, x_in, cos_t, sin_t, cfg, attn_fn)
             oi = t - (num_stages - 1)
             if oi >= 0:
                 emit = jnp.where(s == num_stages - 1, x_out, 0)

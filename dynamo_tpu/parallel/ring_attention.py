@@ -144,9 +144,9 @@ def ring_prefill_step(
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     ring = make_ring_attention(mesh, axis_name)
 
-    def attn_fn(q, k, v, layer_kv):
+    def attn_fn(q, k, v, kv, layer):
         out = ring(q, k, v, seq_lens)
-        new_kv = att.write_prefill_kv(layer_kv, k, v, page_table)
+        new_kv = att.write_prefill_kv(kv, k, v, page_table, layer)
         return out, new_kv
 
     hidden, kv_pages = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)

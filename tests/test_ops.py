@@ -4,6 +4,12 @@ Runs in interpret mode on the CPU test mesh (conftest pins JAX_PLATFORMS=cpu
 and matmul precision "highest" -- the comparisons here are only meaningful
 at full f32 accumulation).  Real-TPU execution of the same kernel is
 exercised by bench.py on hardware.
+
+The kernel takes the FULL stacked KV buffer [L, 2, N, page, Hkv, D] plus a
+layer index (scalar prefetch), so the engine's layer scan never slices the
+cache; every test here compares against the per-layer XLA reference run on
+the indexed slice, at a nonzero layer to prove the index map actually
+dereferences it.
 """
 
 from __future__ import annotations
@@ -17,10 +23,10 @@ from dynamo_tpu.engine import attention as att
 from dynamo_tpu.ops.paged_attention import paged_decode_attention
 
 
-def _mk(B, Hq, Hkv, D, page, N, P, seed=0):
+def _mk(B, Hq, Hkv, D, page, N, P, L=3, seed=0):
     rs = np.random.RandomState(seed)
     q = jnp.asarray(rs.randn(B, Hq, D), jnp.float32)
-    kv = jnp.asarray(rs.randn(2, N, page, Hkv, D), jnp.float32)
+    kv = jnp.asarray(rs.randn(L, 2, N, page, Hkv, D), jnp.float32)
     pt = jnp.asarray(
         np.stack([rs.permutation(N - 1)[:P] + 1 for _ in range(B)]).astype(np.int32)
     )
@@ -39,9 +45,26 @@ def _mk(B, Hq, Hkv, D, page, N, P, seed=0):
 def test_matches_xla_reference(B, Hq, Hkv, D, page, N, P, lens):
     q, kv, pt = _mk(B, Hq, Hkv, D, page, N, P)
     kv_lens = jnp.asarray(lens, jnp.int32)
-    ref = att.paged_decode_attention(q, kv, pt, kv_lens)
-    got = paged_decode_attention(q, kv, pt, kv_lens, interpret=True)
-    assert float(jnp.max(jnp.abs(ref - got))) < 1e-5
+    for layer in (0, 2):
+        ref = att.paged_decode_attention(q, kv[layer], pt, kv_lens)
+        got = paged_decode_attention(q, kv, pt, kv_lens, layer, interpret=True)
+        assert float(jnp.max(jnp.abs(ref - got))) < 1e-5
+
+
+def test_traced_layer_index():
+    """The layer index arrives traced (the engine scans it); the kernel must
+    still fetch the right slice."""
+    q, kv, pt = _mk(2, 8, 2, 32, 8, 16, 2)
+    kv_lens = jnp.asarray([16, 10], jnp.int32)
+
+    @jax.jit
+    def per_layer(layer):
+        return paged_decode_attention(q, kv, pt, kv_lens, layer, interpret=True)
+
+    for layer in (0, 1, 2):
+        ref = att.paged_decode_attention(q, kv[layer], pt, kv_lens)
+        got = per_layer(jnp.asarray(layer, jnp.int32))
+        assert float(jnp.max(jnp.abs(ref - got))) < 1e-5
 
 
 def test_dead_lane_emits_zeros_not_garbage():
@@ -50,8 +73,8 @@ def test_dead_lane_emits_zeros_not_garbage():
     output as zeros.  Live lanes must still match the reference exactly."""
     q, kv, pt = _mk(3, 8, 2, 32, 8, 16, 2)
     kv_lens = jnp.asarray([16, 0, 7], jnp.int32)
-    ref = att.paged_decode_attention(q, kv, pt, kv_lens)
-    got = paged_decode_attention(q, kv, pt, kv_lens, interpret=True)
+    ref = att.paged_decode_attention(q, kv[1], pt, kv_lens)
+    got = paged_decode_attention(q, kv, pt, kv_lens, 1, interpret=True)
     assert float(jnp.max(jnp.abs(ref[0] - got[0]))) < 1e-5
     assert float(jnp.max(jnp.abs(ref[2] - got[2]))) < 1e-5
     assert float(jnp.max(jnp.abs(got[1]))) == 0.0
@@ -62,8 +85,8 @@ def test_bf16_inputs():
     q = q.astype(jnp.bfloat16)
     kv = kv.astype(jnp.bfloat16)
     kv_lens = jnp.asarray([32, 20], jnp.int32)
-    ref = att.paged_decode_attention(q, kv, pt, kv_lens).astype(jnp.float32)
-    got = paged_decode_attention(q, kv, pt, kv_lens, interpret=True).astype(
+    ref = att.paged_decode_attention(q, kv[1], pt, kv_lens).astype(jnp.float32)
+    got = paged_decode_attention(q, kv, pt, kv_lens, 1, interpret=True).astype(
         jnp.float32
     )
     assert float(jnp.max(jnp.abs(ref - got))) < 0.05
@@ -76,8 +99,8 @@ def test_repeated_pages_in_table():
     q, kv, _ = _mk(1, 4, 2, 16, 8, 8, 3)
     pt = jnp.asarray([[2, 2, 5]], jnp.int32)
     kv_lens = jnp.asarray([24], jnp.int32)
-    ref = att.paged_decode_attention(q, kv, pt, kv_lens)
-    got = paged_decode_attention(q, kv, pt, kv_lens, interpret=True)
+    ref = att.paged_decode_attention(q, kv[0], pt, kv_lens)
+    got = paged_decode_attention(q, kv, pt, kv_lens, 0, interpret=True)
     assert float(jnp.max(jnp.abs(ref - got))) < 1e-5
 
 
@@ -86,6 +109,6 @@ def test_dispatch_uses_xla_on_cpu():
     kernel itself is TPU-only outside interpret mode)."""
     q, kv, pt = _mk(1, 4, 2, 16, 8, 8, 1)
     kv_lens = jnp.asarray([8], jnp.int32)
-    out = att.decode_attention_dispatch(q, kv, pt, kv_lens)
-    ref = att.paged_decode_attention(q, kv, pt, kv_lens)
+    out = att.decode_attention_dispatch(q, kv, pt, kv_lens, jnp.asarray(1, jnp.int32))
+    ref = att.paged_decode_attention(q, kv[1], pt, kv_lens)
     assert float(jnp.max(jnp.abs(out - ref))) == 0.0
