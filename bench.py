@@ -80,8 +80,11 @@ async def main():
 
     # warmup: compiles prefill bucket + decode + sampler.  Two passes: the
     # first runs cache-cold (full-prefill path), the second hits the prefix
-    # cache the first pass registered and compiles the suffix-prefill path --
-    # the measured window must contain zero XLA compiles.
+    # cache the first pass registered and compiles the suffix-prefill path.
+    # Both passes land in the 16-page decode bucket (prompt 128 + budget 128
+    # = 256 tokens exactly; page growth is capped at the useful total), the
+    # same bucket the measured run lives in -- the measured window contains
+    # zero XLA compiles.
     await run_batch(engine, prompts, max_tokens=8)
     await run_batch(engine, prompts, max_tokens=8)
 

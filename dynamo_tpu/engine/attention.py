@@ -207,9 +207,16 @@ def write_decode_kv(
     layer: jax.Array,  # scalar i32
 ) -> jax.Array:
     page_size = kv_pages.shape[3]
+    P = page_table.shape[1]
     page_idx = positions // page_size
     slot = positions % page_size
-    ids = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+    ids = jnp.take_along_axis(
+        page_table, jnp.clip(page_idx, 0, P - 1)[:, None], axis=1
+    )[:, 0]
+    # a lane frozen at its capacity (page_idx == P) must land on trash page
+    # 0, not clamp into its own last live page -- its stale write repeats
+    # every step while other lanes decode
+    ids = jnp.where(page_idx < P, ids, 0)
     kv_pages = kv_pages.at[layer, 0, ids, slot].set(k)
     kv_pages = kv_pages.at[layer, 1, ids, slot].set(v)
     return kv_pages

@@ -794,6 +794,25 @@ class JaxEngine:
             top_k=jnp.asarray(top_k),
         )
 
+    @staticmethod
+    def _sampling_needs_filters(so) -> bool:
+        """Whether this request's settings engage the sorted filter path in
+        ``sampling.sample_tokens`` (the trace-time ``use_filters`` switch at
+        dispatch).  Lives next to ``_sampling_arrays`` so the None->0/1.0
+        normalization and this predicate cannot drift apart: any filter
+        added to SamplingParams + sample_tokens must be reflected in BOTH.
+
+        Greedy rows (effective temperature 0) return the pre-filter argmax,
+        so filters on a greedy request never change its output -- don't pay
+        the sort for them."""
+        has_filter = bool(so.top_k) or (so.top_p is not None and so.top_p < 1.0)
+        if not has_filter:
+            return False
+        # effective temperature mirrors _sampling_arrays: explicit value
+        # wins; unset with filters present means "sample at 1.0"
+        temp = so.temperature if so.temperature is not None else 1.0
+        return temp > 0.0
+
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
@@ -1259,6 +1278,23 @@ class JaxEngine:
             return None  # everything was preempted
         self._sync_device_state()
         d = self._dev
+        # Decode attention streams every page-table slot it is given, so the
+        # dispatch narrows the table to a power-of-two bucket covering the
+        # longest lane's allocated pages (growth lookahead included --
+        # attention can never read past a lane's allocation).  Dead lanes'
+        # rows are zeroed, so clamped gathers land on trash page 0.  Each
+        # bucket is its own cached executable; the floor bounds the count.
+        live_pages = [
+            len(s.pages) for s in self.sched.slots if s is not None and s.pages
+        ]
+        Pb = pick_page_bucket(
+            min(max(8, max(live_pages, default=1)), self.sched.max_pages),
+            self.sched.max_pages,
+        )
+        use_filters = any(
+            s is not None and self._sampling_needs_filters(s.sampling)
+            for s in self.sched.slots
+        )
         (
             sampled,
             d["tokens"],
@@ -1275,10 +1311,11 @@ class JaxEngine:
             d["limit_lens"],
             d["active"],
             d["stop_ids"],
-            d["page_table"],
+            d["page_table"][:, :Pb],
             self._rng,
             d["sampling"],
             K,
+            use_filters,
         )
         self._steps += 1
         try:

@@ -37,13 +37,28 @@ def sample_tokens(
     logits: jax.Array,  # [B, V] float32
     rng: jax.Array,
     params: SamplingParams,
+    use_filters: bool = True,
 ) -> jax.Array:
-    """Returns sampled token ids [B] int32."""
+    """Returns sampled token ids [B] int32.
+
+    ``use_filters`` is a TRACE-TIME switch: when the caller knows no live
+    request asked for top-k/top-p (the engine checks its slots at dispatch),
+    the full-vocab descending sort -- the only expensive op here, hundreds
+    of microseconds per step on TPU for a 32k vocab -- is dropped from the
+    compiled program entirely.  Greedy and plain-temperature sampling need
+    no sort (categorical is gumbel+argmax).  The filtered variant is
+    numerically identical for requests without filters, so flipping the
+    flag between blocks never changes results.
+    """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     temp = jnp.maximum(params.temperature, 1e-6)[:, None]
     scaled = logits / temp
+
+    if not use_filters:
+        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+        return jnp.where(params.temperature <= 0.0, greedy, sampled)
 
     # One descending sort serves both top-k and top-p filtering.
     sorted_logits = -jnp.sort(-scaled, axis=-1)  # [B, V] descending

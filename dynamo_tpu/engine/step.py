@@ -85,7 +85,7 @@ decode_step = partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pa
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "num_steps"),
+    static_argnames=("cfg", "num_steps", "use_filters"),
     donate_argnames=("kv_pages",),
 )
 def decode_block(
@@ -101,6 +101,7 @@ def decode_block(
     rng: jax.Array,
     sampling: SamplingParams,
     num_steps: int,
+    use_filters: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Run ``num_steps`` decode+sample iterations entirely on device.
 
@@ -125,7 +126,7 @@ def decode_block(
         tokens, seq_lens, active, rng, kv = carry
         logits, kv = _decode_once(params, cfg, kv, tokens, seq_lens, page_table)
         rng, sub = jax.random.split(rng)
-        sampled = sample_tokens(logits, sub, sampling)
+        sampled = sample_tokens(logits, sub, sampling, use_filters)
         hit_stop = jnp.any(sampled[:, None] == stop_ids, axis=1)
         emit = active & ~hit_stop  # stop tokens are swallowed, not emitted
         new_seq = seq_lens + emit.astype(jnp.int32)

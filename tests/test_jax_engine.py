@@ -601,3 +601,31 @@ def test_chunked_prefill_chunk_smaller_than_page(run):
             await engine.stop()
 
     run(body())
+
+
+def test_capacity_frozen_write_lands_on_trash_page():
+    """A lane frozen at its page capacity keeps executing (SPMD cannot skip);
+    its repeated KV write at page_idx == table width must route to trash
+    page 0 -- clamping would scribble over the lane's own last live page
+    every step (corrupting KV later reused via regrowth or prefix cache)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine import attention as att
+
+    L, N, page, Hkv, D = 2, 6, 4, 2, 8
+    kv = jnp.zeros((L, 2, N, page, Hkv, D), jnp.float32)
+    # lane owns pages [3, 5]; it is full: position == 2 pages * 4 slots
+    pt = jnp.asarray([[3, 5]], jnp.int32)
+    pos_frozen = jnp.asarray([8], jnp.int32)  # == P * page (out of range)
+    k = jnp.ones((1, Hkv, D), jnp.float32)
+    out = att.write_decode_kv(kv, k, k * 2.0, pt, pos_frozen, jnp.int32(0))
+    # pages 3 and 5 untouched; the write landed on trash page 0
+    assert float(jnp.max(jnp.abs(out[0, :, 3]))) == 0.0
+    assert float(jnp.max(jnp.abs(out[0, :, 5]))) == 0.0
+    assert float(jnp.max(jnp.abs(out[0, 0, 0]))) == 1.0
+    # in-range write still lands where it should (page 5, slot 1)
+    pos_live = jnp.asarray([5], jnp.int32)
+    out2 = att.write_decode_kv(kv, k, k * 2.0, pt, pos_live, jnp.int32(0))
+    assert float(jnp.max(jnp.abs(out2[0, 0, 5, 1] - 1.0))) == 0.0
+    assert float(jnp.max(jnp.abs(out2[0, 1, 5, 1] - 2.0))) == 0.0
