@@ -224,6 +224,16 @@ async def run_http_local(args) -> None:
     manager = ModelManager()
     manager.add_chat_model(name, pipeline)
     manager.add_completion_model(name, pipeline)
+    from .llm.embedding import EmbeddingEngine, fake_embedder
+
+    # /v1/embeddings: the JAX trunk embeds for real; echo/mocker get the
+    # deterministic fake so the route works in every out= mode
+    embed_fn = engine.embed if hasattr(engine, "embed") else fake_embedder()
+    max_in = getattr(getattr(engine, "cfg", None), "max_seq_len", None)
+    manager.add_embedding_model(
+        name,
+        EmbeddingEngine(embed_fn, tokenizer=tokenizer, max_input_tokens=max_in),
+    )
     service = HttpService(manager, host=args.host, port=args.port)
     await service.start()
     print(f"serving {name} at {service.url}  (POST /v1/chat/completions)")
@@ -338,6 +348,21 @@ async def run_worker(args) -> None:
         await ep.serve(disagg)
     else:
         await ep.serve(engine)
+    embed_ep_name = ""
+    if hasattr(engine, "embed") and args.disagg != "prefill":
+        # pooled-embedding leg: a sibling endpoint the frontend watcher
+        # discovers through the model entry's embed_endpoint field
+        from .llm.embedding import EmbeddingEngine
+
+        embed_ep_name = f"{ep_name}_embed"
+        await comp.endpoint(embed_ep_name).serve(
+            EmbeddingEngine(
+                engine.embed,
+                max_input_tokens=getattr(
+                    getattr(engine, "cfg", None), "max_seq_len", None
+                ),
+            )
+        )
     pub = KvEventPublisher(ns, worker_id=runtime.primary_lease)
     pub.hook(engine)
     metrics_pub = WorkerMetricsPublisher(engine.metrics)
@@ -352,6 +377,7 @@ async def run_worker(args) -> None:
             runtime, ep, args.model_path,
             model_name=args.model_name,
             kv_block_size=args.block_size or args.page_size,
+            embed_endpoint=embed_ep_name,
         )
         print(f"worker serving model {card.name} on {args.endpoint} (hub {addr})")
     elif args.disagg != "prefill":

@@ -364,6 +364,62 @@ class JaxEngine:
 
         return ResponseStream(ctx, stream())
 
+    async def embed(self, token_batches: List[List[int]]) -> List[List[float]]:
+        """Pooled embeddings for pre-tokenized inputs (/v1/embeddings).
+
+        Batches inputs into one bucket-padded forward per call (grouped so
+        one oversized outlier doesn't balloon every lane's pad), mean-pools
+        valid positions, L2-normalizes.  Runs on the engine executor thread,
+        serialized with the tick loop -- the trunk forward reads the KV
+        buffer but never writes it, so in-flight decode state is untouched.
+        """
+        if not token_batches:
+            return []
+        for t in token_batches:
+            if not t:
+                raise ValueError("embedding input must be non-empty")
+            if len(t) > self.cfg.max_seq_len:
+                raise ValueError(
+                    f"embedding input of {len(t)} tokens exceeds max_seq_len"
+                    f" {self.cfg.max_seq_len}"
+                )
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._ex, self._embed_sync, token_batches)
+
+    def _embed_sync(self, token_batches: List[List[int]]) -> List[List[float]]:
+        from .step import embed_step
+
+        out: List[Optional[List[float]]] = [None] * len(token_batches)
+        order = sorted(range(len(token_batches)), key=lambda i: len(token_batches[i]))
+        B = self.cfg.max_batch_size
+        for start in range(0, len(order), B):
+            group = order[start : start + B]
+            bucket = pick_bucket(
+                self.buckets, max(len(token_batches[i]) for i in group)
+            )
+            # pad to a power-of-two batch (the _pad_batch convention) so
+            # group size doesn't multiply compile-cache entries; pad lanes
+            # have length 0 and come out as zero rows
+            Bp = min(self._pad_batch(len(group)), B)
+            toks = np.zeros((Bp, bucket), np.int32)
+            lens = np.zeros((Bp,), np.int32)
+            for row, i in enumerate(group):
+                t = token_batches[i]
+                toks[row, : len(t)] = t
+                lens[row] = len(t)
+            vecs = np.asarray(
+                embed_step(
+                    self.params,
+                    self.model_cfg,
+                    self.kv.pages,
+                    jnp.asarray(toks),
+                    jnp.asarray(lens),
+                )
+            )
+            for row, i in enumerate(group):
+                out[i] = vecs[row].tolist()
+        return out  # type: ignore[return-value]
+
     # -- disaggregation (SURVEY.md 5.8: blockset export/import over the data
     # plane replaces NIXL one-sided writes) --------------------------------
 

@@ -203,6 +203,40 @@ def prefill_suffix_and_sample(
     return sample_tokens(logits, rng, sampling), kv_pages
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def embed_step(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D] -- read-only here
+    tokens: jax.Array,  # [B, T] bucket-padded inputs
+    seq_lens: jax.Array,  # [B] true input lengths (0 = pad lane)
+) -> jax.Array:
+    """Pooled-embedding forward: run the trunk, mean-pool the final hidden
+    states over valid positions, L2-normalize.  Serves /v1/embeddings
+    (reference: http/service/openai.rs:212 delegates to embedding engines;
+    here the first-party trunk doubles as the embedder).  KV is passed only
+    to satisfy the trunk signature -- the attn callback never writes, no
+    pages are allocated, and the returned buffer is discarded (NOT donated).
+
+    Returns [B, H] f32 unit vectors (zero rows for pad lanes)."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def attn_fn(q, k, v, kv, layer):
+        out = att.prefill_attention(q, k, v, seq_lens)
+        return out, kv
+
+    hidden, _ = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)
+    valid = (
+        jnp.arange(T)[None, :] < seq_lens[:, None]
+    )  # [B, T]
+    hidden = hidden.astype(jnp.float32) * valid[:, :, None]
+    denom = jnp.maximum(seq_lens[:, None].astype(jnp.float32), 1.0)
+    pooled = jnp.sum(hidden, axis=1) / denom  # [B, H] mean over valid
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)
+
+
 @partial(jax.jit, donate_argnames=("tokens",))
 def inject_token(tokens: jax.Array, slot: jax.Array, token: jax.Array) -> jax.Array:
     """Scatter a freshly-prefilled lane's first token into the device-resident

@@ -19,10 +19,12 @@ from typing import AsyncIterator, Dict, Optional
 from ..protocols.openai import (
     ChatCompletionRequest,
     CompletionRequest,
+    EmbeddingRequest,
     OpenAIError,
     SSE_DONE,
     aggregate_chat,
     aggregate_completion,
+    embedding_response,
     sse_encode,
     sse_error,
 )
@@ -63,6 +65,7 @@ class ModelManager:
     def __init__(self) -> None:
         self._chat: Dict[str, AsyncEngine] = {}
         self._completion: Dict[str, AsyncEngine] = {}
+        self._embedding: Dict[str, AsyncEngine] = {}
 
     def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
         self._chat[name] = engine
@@ -70,9 +73,13 @@ class ModelManager:
     def add_completion_model(self, name: str, engine: AsyncEngine) -> None:
         self._completion[name] = engine
 
+    def add_embedding_model(self, name: str, engine: AsyncEngine) -> None:
+        self._embedding[name] = engine
+
     def remove_model(self, name: str) -> None:
         self._chat.pop(name, None)
         self._completion.pop(name, None)
+        self._embedding.pop(name, None)
 
     def chat_engine(self, name: str) -> AsyncEngine:
         try:
@@ -86,20 +93,26 @@ class ModelManager:
         except KeyError:
             raise ModelNotFound(name) from None
 
+    def embedding_engine(self, name: str) -> AsyncEngine:
+        try:
+            return self._embedding[name]
+        except KeyError:
+            raise ModelNotFound(name) from None
+
     def list_models(self) -> list:
-        names = sorted(set(self._chat) | set(self._completion))
+        names = sorted(set(self._chat) | set(self._completion) | set(self._embedding))
         return [
             {"id": n, "object": "model", "owned_by": "dynamo-tpu"} for n in names
         ]
 
     @property
     def is_empty(self) -> bool:
-        return not self._chat and not self._completion
+        return not self._chat and not self._completion and not self._embedding
 
 
 class HttpService:
     """The OpenAI frontend: /v1/chat/completions, /v1/completions,
-    /v1/models, /health, /live, /metrics."""
+    /v1/embeddings, /v1/models, /health, /live, /metrics."""
 
     def __init__(
         self,
@@ -113,6 +126,7 @@ class HttpService:
         self.server = HttpServer(host, port)
         self.server.route("POST", "/v1/chat/completions", self._chat)
         self.server.route("POST", "/v1/completions", self._completions)
+        self.server.route("POST", "/v1/embeddings", self._embeddings)
         self.server.route("GET", "/v1/models", self._models)
         self.server.route("GET", "/health", self._health)
         self.server.route("GET", "/live", self._health)
@@ -148,11 +162,67 @@ class HttpService:
         body, content_type = self.metrics.render()
         return Response(200, {"Content-Type": content_type}, body)
 
+    def _count_rejected(self, body: Optional[dict], endpoint: str) -> None:
+        """Count a rejected request, labelling with the model name only when
+        it is actually served: client-supplied junk names must not mint
+        unbounded label series."""
+        raw = body.get("model") if body else None
+        known = {m["id"] for m in self.manager.list_models()}
+        self.metrics.requests_total.labels(
+            raw if raw in known else "unknown", endpoint, "rejected"
+        ).inc()
+
     async def _chat(self, req: Request) -> Response:
         return await self._serve(req, chat=True)
 
     async def _completions(self, req: Request) -> Response:
         return await self._serve(req, chat=False)
+
+    async def _embeddings(self, req: Request) -> Response:
+        """/v1/embeddings: single aggregated response, no streaming
+        (reference openai.rs:212)."""
+        endpoint = "embeddings"
+        try:
+            body = req.json()
+            if not isinstance(body, dict):
+                raise OpenAIError("request body must be a JSON object")
+            parsed = EmbeddingRequest.from_dict(body)
+            engine = self.manager.embedding_engine(parsed.model)
+        except OpenAIError as e:
+            self._count_rejected(body if isinstance(body, dict) else None, endpoint)
+            return Response.json(e.to_body(), e.code)
+
+        guard = self.metrics.guard(parsed.model, endpoint)
+        request = Context.new(parsed)
+        try:
+            stream = await as_response_stream(engine, request)
+            vectors, prompt_tokens = None, 0
+            async for item in stream:
+                if not isinstance(item, Annotated):
+                    item = Annotated.from_data(item)
+                if item.is_error():
+                    raise RuntimeError(item.error_message() or "engine error")
+                data = item.data or {}
+                if "embeddings" in data:
+                    vectors = data["embeddings"]
+                    prompt_tokens = int(data.get("prompt_tokens", 0))
+            if vectors is None:
+                raise RuntimeError("embedding engine returned no vectors")
+            guard.mark_ok()
+            return Response.json(
+                embedding_response(parsed.model, vectors, prompt_tokens)
+            )
+        except OpenAIError as e:
+            guard.mark_error()
+            return Response.json(e.to_body(), e.code)
+        except Exception as e:
+            logger.exception("embedding request failed")
+            guard.mark_error()
+            return Response.json(
+                {"error": {"message": str(e), "type": "server_error"}}, 500
+            )
+        finally:
+            guard.finish()
 
     async def _serve(self, req: Request, chat: bool) -> Response:
         endpoint = "chat_completions" if chat else "completions"
@@ -171,13 +241,7 @@ class HttpService:
                 else self.manager.completion_engine(parsed.model)
             )
         except OpenAIError as e:
-            # label with the model name only when it is actually served:
-            # client-supplied junk names must not mint unbounded label series
-            raw = body.get("model") if isinstance(body, dict) else None
-            known = {m["id"] for m in self.manager.list_models()}
-            self.metrics.requests_total.labels(
-                raw if raw in known else "unknown", endpoint, "rejected"
-            ).inc()
+            self._count_rejected(body if isinstance(body, dict) else None, endpoint)
             return Response.json(e.to_body(), e.code)
 
         guard = self.metrics.guard(parsed.model, endpoint)

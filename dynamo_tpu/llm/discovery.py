@@ -47,7 +47,9 @@ class ModelWatcher:
         self.engine_factory = engine_factory
         # model slug -> live registration keys (instances of that model)
         self._instances: Dict[str, Set[str]] = {}
-        self._clients: Dict[str, object] = {}
+        # slug -> clients owned by that model's pipelines (generate endpoint
+        # plus, when the worker embeds, its embed endpoint)
+        self._clients: Dict[str, list] = {}
         # per-model async teardowns (e.g. a KvRouter chooser's stop())
         self._cleanups: Dict[str, object] = {}
         self._watch = None
@@ -76,9 +78,10 @@ class ModelWatcher:
             with contextlib.suppress(Exception):
                 await cleanup()
         self._cleanups.clear()
-        for client in self._clients.values():
-            with contextlib.suppress(Exception):
-                await client.close()
+        for clients in self._clients.values():
+            for client in clients:
+                with contextlib.suppress(Exception):
+                    await client.close()
         self._clients.clear()
 
     async def _loop(self) -> None:
@@ -138,7 +141,7 @@ class ModelWatcher:
                 .endpoint(entry.endpoint)
             )
             client = await endpoint.client()
-            self._clients[slug] = client
+            self._clients[slug] = [client]
             router = PushRouter(client, mode=self.router_mode)
             if self.engine_factory is not None:
                 engine = self.engine_factory(entry, card, client, router)
@@ -156,13 +159,40 @@ class ModelWatcher:
                     Backend(tokenizer),
                     router,
                 )
+            embed_engine = None
+            if entry.embed_endpoint:
+                from .embedding import EmbeddingEngine, router_embedder
+
+                embed_client = await (
+                    self.runtime.namespace(entry.namespace)
+                    .component(entry.component)
+                    .endpoint(entry.embed_endpoint)
+                    .client()
+                )
+                self._clients[slug].append(embed_client)
+                embed_engine = EmbeddingEngine(
+                    router_embedder(
+                        PushRouter(embed_client, mode=self.router_mode)
+                    ),
+                    tokenizer=card.tokenizer(),
+                    max_input_tokens=card.context_length,
+                )
         except Exception:
             # transient failure must not wedge the model: un-claim the key so
             # a later put (this instance's or another's) rebuilds from scratch
             known.discard(key)
+            cleanup = self._cleanups.pop(slug, None)
+            if cleanup is not None:  # factory resources registered pre-failure
+                with contextlib.suppress(Exception):
+                    await cleanup()
+            for client in self._clients.pop(slug, []):
+                with contextlib.suppress(Exception):
+                    await client.close()
             raise
         self.manager.add_chat_model(entry.name, engine)
         self.manager.add_completion_model(entry.name, engine)
+        if embed_engine is not None:
+            self.manager.add_embedding_model(entry.name, embed_engine)
         logger.info("model %s added (endpoint %s)", entry.name, endpoint.path)
 
     async def _handle_delete(self, key: str) -> None:
@@ -178,8 +208,7 @@ class ModelWatcher:
         if cleanup is not None:
             with contextlib.suppress(Exception):
                 await cleanup()
-        client = self._clients.pop(slug, None)
-        if client is not None:
+        for client in self._clients.pop(slug, []):
             with contextlib.suppress(Exception):
                 await client.close()
         # find the display name: manager keys are model names, the key holds

@@ -1,0 +1,150 @@
+"""Embedding serving: /v1/embeddings behind the same engine stack.
+
+Reference parity: lib/llm/src/http/service/openai.rs:212 (the embeddings
+route) and protocols/openai/embeddings.rs + its stream aggregator -- the
+reference delegates the vectors to an embedding-capable engine; here the
+first-party trunk doubles as the embedder (engine/step.py:embed_step:
+mean-pooled, L2-normalized final hidden states).
+
+One engine class serves both deployment shapes:
+
+- **local** (``in=http out=jax``): ``embed_fn`` is ``JaxEngine.embed``.
+- **distributed** (``in=http out=dyn``): the worker serves
+  ``EmbeddingEngine`` over its endpoint; the frontend's watcher builds a
+  second ``EmbeddingEngine`` whose ``embed_fn`` forwards the token batches
+  through a PushRouter to that endpoint (``router_embedder``).
+
+The wire protocol is one request item ``{"token_batches": [[...]]}`` and
+one response item ``{"embeddings": [[...]], "prompt_tokens": N}`` -- the
+request is tokenized at the frontend so workers stay text-free, the same
+split as the generate path (preprocessor tokenizes, backend detokenizes).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import Any, AsyncIterator, Awaitable, Callable, List, Optional
+
+from ..protocols.openai import EmbeddingRequest, OpenAIError
+from ..runtime.engine import Annotated, AsyncEngine, Context, ResponseStream
+from .tokenizer import Tokenizer
+
+Embedder = Callable[[List[List[int]]], Awaitable[List[List[float]]]]
+
+
+class EmbeddingEngine(AsyncEngine):
+    """AsyncEngine for embedding requests.
+
+    Accepts either an ``EmbeddingRequest`` (frontend: texts are tokenized
+    here) or the wire dict ``{"token_batches": [[...]]}`` (worker side).
+    Yields exactly one item: ``{"embeddings": [...], "prompt_tokens": N}``.
+    """
+
+    def __init__(
+        self,
+        embed_fn: Embedder,
+        tokenizer: Optional[Tokenizer] = None,
+        max_input_tokens: Optional[int] = None,
+    ) -> None:
+        """``max_input_tokens`` (the engine's max_seq_len / the card's
+        context_length) turns over-long inputs into 400s at the frontend
+        instead of engine-side ValueErrors surfacing as 500s."""
+        self.embed_fn = embed_fn
+        self.tokenizer = tokenizer
+        self.max_input_tokens = max_input_tokens
+
+    def _tokenize(self, req: EmbeddingRequest) -> List[List[int]]:
+        if req.token_batches is not None:
+            batches = req.token_batches
+        elif self.tokenizer is None:
+            raise OpenAIError(
+                "text input requires a tokenizer (this endpoint accepts"
+                " pre-tokenized input only)"
+            )
+        else:
+            batches = [self.tokenizer.encode(t) for t in req.texts]
+        for i, b in enumerate(batches):
+            if not b:
+                raise OpenAIError(f"input {i} tokenized to zero tokens")
+            if self.max_input_tokens is not None and len(b) > self.max_input_tokens:
+                raise OpenAIError(
+                    f"input {i} has {len(b)} tokens, over the model's"
+                    f" {self.max_input_tokens}-token limit"
+                )
+        return batches
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
+        data = request.data
+        if isinstance(data, EmbeddingRequest):
+            batches = self._tokenize(data)
+        elif isinstance(data, dict) and "token_batches" in data:
+            batches = data["token_batches"]
+            if not (
+                isinstance(batches, list)
+                and batches
+                and all(isinstance(b, list) and b for b in batches)
+            ):
+                raise OpenAIError("'token_batches' must be non-empty token lists")
+            if self.max_input_tokens is not None:
+                for i, b in enumerate(batches):
+                    if len(b) > self.max_input_tokens:
+                        raise OpenAIError(
+                            f"input {i} has {len(b)} tokens, over the"
+                            f" {self.max_input_tokens}-token limit"
+                        )
+        else:
+            raise OpenAIError("expected an embedding request")
+
+        ctx = request.ctx
+
+        async def gen() -> AsyncIterator[Annotated]:
+            vectors = await self.embed_fn(batches)
+            if not ctx.is_stopped():
+                yield Annotated.from_data(
+                    {
+                        "embeddings": vectors,
+                        "prompt_tokens": sum(len(b) for b in batches),
+                    }
+                )
+
+        return ResponseStream(ctx, gen())
+
+
+def router_embedder(router) -> Embedder:
+    """An ``embed_fn`` that forwards token batches to a remote worker's
+    embedding endpoint through a PushRouter (the distributed leg)."""
+
+    async def embed(batches: List[List[int]]) -> List[List[float]]:
+        stream = await router.generate(Context.new({"token_batches": batches}))
+        async for item in stream:
+            data = item.data or {}
+            if "embeddings" in data:
+                return data["embeddings"]
+        raise RuntimeError("embedding worker returned no vectors")
+
+    return embed
+
+
+def fake_embedder(dim: int = 32) -> Embedder:
+    """Deterministic, content-dependent unit vectors with no model -- the
+    echo/mocker leg for wiring tests (same role the echo engines play for
+    the generate path)."""
+
+    async def embed(batches: List[List[int]]) -> List[List[float]]:
+        out: List[List[float]] = []
+        for toks in batches:
+            h = hashlib.sha256(
+                b",".join(str(t).encode() for t in toks)
+            ).digest()
+            vals = []
+            seed = h
+            while len(vals) < dim:
+                seed = hashlib.sha256(seed).digest()
+                vals.extend(b / 255.0 - 0.5 for b in seed)
+            v = vals[:dim]
+            norm = math.sqrt(sum(x * x for x in v)) or 1.0
+            out.append([x / norm for x in v])
+        return out
+
+    return embed

@@ -42,6 +42,10 @@ class ModelEntry:
     component: str
     endpoint: str
     model_type: str = "backend"  # backend = token-level worker behind preproc
+    # endpoint name (same component) serving pooled embeddings; "" = the
+    # worker does not embed.  The frontend watcher builds the /v1/embeddings
+    # pipeline iff set (reference ModelType::Embedding, openai.rs:212).
+    embed_endpoint: str = ""
 
     def to_json(self) -> bytes:
         return json.dumps(self.__dict__, sort_keys=True).encode()
@@ -152,6 +156,7 @@ async def register_llm(
     model_name: Optional[str] = None,
     model_type: str = "backend",
     kv_block_size: int = 16,
+    embed_endpoint: str = "",
 ) -> ModelDeploymentCard:
     """Worker-side model registration (reference bindings lib.rs:98-160
     ``register_llm``): publish the MDC blob, then create the lease-scoped
@@ -166,6 +171,7 @@ async def register_llm(
         component=endpoint.component,
         endpoint=endpoint.name,
         model_type=model_type,
+        embed_endpoint=embed_endpoint,
     )
     lease = runtime.primary_lease
     key = f"{MODEL_ROOT}/{card.slug}/{lease:x}"

@@ -110,6 +110,13 @@ class MockerEngine:
         if self.kv_event_sink is not None:
             self.kv_event_sink(ev)
 
+    async def embed(self, token_batches):
+        """Deterministic fake embeddings (content-hash unit vectors) so the
+        chip-free mocker exercises the /v1/embeddings leg end-to-end."""
+        from ..llm.embedding import fake_embedder
+
+        return await fake_embedder()(token_batches)
+
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self) -> None:

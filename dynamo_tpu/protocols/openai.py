@@ -154,6 +154,73 @@ class CompletionRequest:
         )
 
 
+@dataclass
+class EmbeddingRequest:
+    """/v1/embeddings request (reference: protocols/openai/embeddings.rs).
+
+    ``input`` accepts the OpenAI forms: one string, a list of strings, one
+    token-id list, or a list of token-id lists; normalized here to
+    ``texts`` (strings) or ``token_batches`` (pre-tokenized), exactly one
+    of which is non-None.
+    """
+
+    model: str
+    texts: Optional[List[str]] = None
+    token_batches: Optional[List[List[int]]] = None
+    encoding_format: str = "float"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EmbeddingRequest":
+        model = d.get("model")
+        if not isinstance(model, str) or not model:
+            raise OpenAIError("'model' must be a non-empty string")
+        fmt = d.get("encoding_format", "float")
+        if fmt != "float":
+            raise OpenAIError("only encoding_format='float' is supported")
+        inp = d.get("input")
+        texts: Optional[List[str]] = None
+        batches: Optional[List[List[int]]] = None
+        if isinstance(inp, str):
+            texts = [inp]
+        elif isinstance(inp, list) and inp:
+            if all(isinstance(x, str) for x in inp):
+                texts = list(inp)
+            elif all(isinstance(x, int) and not isinstance(x, bool) for x in inp):
+                batches = [list(inp)]
+            elif all(
+                isinstance(x, list)
+                and x
+                and all(isinstance(t, int) and not isinstance(t, bool) for t in x)
+                for x in inp
+            ):
+                batches = [list(x) for x in inp]
+        if texts is None and batches is None:
+            raise OpenAIError(
+                "'input' must be a string, list of strings, token-id list,"
+                " or list of token-id lists (non-empty)"
+            )
+        return cls(model=model, texts=texts, token_batches=batches,
+                   encoding_format=fmt)
+
+    @property
+    def n_inputs(self) -> int:
+        return len(self.texts if self.texts is not None else self.token_batches)
+
+
+def embedding_response(
+    model: str, vectors: List[List[float]], prompt_tokens: int
+) -> Dict[str, Any]:
+    return {
+        "object": "list",
+        "model": model,
+        "data": [
+            {"object": "embedding", "index": i, "embedding": v}
+            for i, v in enumerate(vectors)
+        ],
+        "usage": {"prompt_tokens": prompt_tokens, "total_tokens": prompt_tokens},
+    }
+
+
 # -- response builders -------------------------------------------------------
 
 

@@ -629,3 +629,63 @@ def test_capacity_frozen_write_lands_on_trash_page():
     out2 = att.write_decode_kv(kv, k, k * 2.0, pt, pos_live, jnp.int32(0))
     assert float(jnp.max(jnp.abs(out2[0, 0, 5, 1] - 1.0))) == 0.0
     assert float(jnp.max(jnp.abs(out2[0, 1, 5, 1] - 2.0))) == 0.0
+
+
+def test_engine_embed_pooled_vectors(run):
+    """JaxEngine.embed: unit-norm mean-pooled vectors, deterministic,
+    pad-invariant (solo == batched), length-sensitive, bounds-checked."""
+
+    async def main():
+        engine = make_engine()
+        try:
+            a = [5, 6, 7, 8]
+            b = [9, 10, 11]
+            batch = await engine.embed([a, b, a])
+            solo = await engine.embed([a])
+            over = None
+            try:
+                await engine.embed([[1] * 100])
+            except ValueError as e:
+                over = str(e)
+            empty = None
+            try:
+                await engine.embed([[]])
+            except ValueError as e:
+                empty = str(e)
+            return batch, solo, over, empty
+        finally:
+            await engine.stop()
+
+    batch, solo, over, empty = run(main())
+    H = ModelConfig.tiny().hidden_size
+    assert len(batch) == 3 and all(len(v) == H for v in batch)
+    for v in batch:
+        assert abs(sum(x * x for x in v) - 1.0) < 1e-4
+    assert batch[0] == batch[2]  # same input -> same vector
+    assert batch[0] != batch[1]
+    # bucketing/padding must not leak across lanes
+    assert np.allclose(batch[0], solo[0], atol=1e-5)
+    assert over and "exceeds" in over
+    assert empty and "non-empty" in empty
+
+
+def test_engine_embed_interleaves_with_generate(run):
+    """Embedding calls share the executor with the decode loop without
+    corrupting in-flight generation (the trunk read never writes KV)."""
+
+    async def main():
+        engine = make_engine()
+        try:
+            ref, _ = await collect(engine, req([3, 4, 5], max_tokens=12))
+            gen_task = asyncio.create_task(
+                collect(engine, req([3, 4, 5], max_tokens=12))
+            )
+            vecs = await engine.embed([[7, 8, 9, 10, 11]])
+            tokens, finish = await gen_task
+            return ref, tokens, vecs
+        finally:
+            await engine.stop()
+
+    ref, tokens, vecs = run(main())
+    assert tokens == ref  # generation unaffected by the concurrent embed
+    assert len(vecs) == 1

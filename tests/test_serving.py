@@ -497,3 +497,112 @@ def test_http_stop_string_via_full_stack(model_dir, run):
     assert "DONE" not in content and "tail" not in content
     assert content.startswith("hello world")
     assert body["choices"][0]["finish_reason"] == "stop"
+
+
+# -- /v1/embeddings ----------------------------------------------------------
+
+
+def test_embedding_request_parsing():
+    from dynamo_tpu.protocols.openai import EmbeddingRequest, OpenAIError
+
+    r = EmbeddingRequest.from_dict({"model": "m", "input": "hello"})
+    assert r.texts == ["hello"] and r.token_batches is None
+    r = EmbeddingRequest.from_dict({"model": "m", "input": ["a", "b"]})
+    assert r.texts == ["a", "b"] and r.n_inputs == 2
+    r = EmbeddingRequest.from_dict({"model": "m", "input": [1, 2, 3]})
+    assert r.token_batches == [[1, 2, 3]]
+    r = EmbeddingRequest.from_dict({"model": "m", "input": [[1, 2], [3]]})
+    assert r.token_batches == [[1, 2], [3]] and r.n_inputs == 2
+    for bad in (
+        {"model": "m"},
+        {"model": "m", "input": []},
+        {"model": "m", "input": [[]]},
+        {"model": "m", "input": [True, False]},
+        {"model": "", "input": "x"},
+        {"model": "m", "input": "x", "encoding_format": "base64"},
+    ):
+        with pytest.raises(OpenAIError):
+            EmbeddingRequest.from_dict(bad)
+
+
+def test_http_embeddings_endpoint(model_dir, run):
+    """/v1/embeddings end-to-end: deterministic unit vectors, usage counts,
+    unknown model 404 (reference openai.rs:212)."""
+    from dynamo_tpu.llm.embedding import EmbeddingEngine, fake_embedder
+
+    async def main():
+        tok = Tokenizer.from_model_dir(model_dir)
+        svc = HttpService()
+        svc.manager.add_embedding_model(
+            "embedder", EmbeddingEngine(fake_embedder(dim=16), tokenizer=tok)
+        )
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, body = await http_request(
+                host, port, "POST", "/v1/embeddings",
+                {"model": "embedder", "input": ["hello world", "the quick fox"]},
+            )
+            status2, _, body2 = await http_request(
+                host, port, "POST", "/v1/embeddings",
+                {"model": "embedder", "input": ["hello world", "the quick fox"]},
+            )
+            status3, _, body3 = await http_request(
+                host, port, "POST", "/v1/embeddings",
+                {"model": "nope", "input": "x"},
+            )
+            status4, _, body4 = await http_request(
+                host, port, "POST", "/v1/embeddings",
+                {"model": "embedder", "input": [[5, 6, 7]]},
+            )
+            models = svc.manager.list_models()
+            return status, body, status2, body2, status3, status4, body4, models
+        finally:
+            await svc.stop()
+
+    status, body, status2, body2, status3, status4, body4, models = run(main())
+    assert status == 200 and body["object"] == "list"
+    assert [d["index"] for d in body["data"]] == [0, 1]
+    for d in body["data"]:
+        v = d["embedding"]
+        assert len(v) == 16
+        assert abs(sum(x * x for x in v) - 1.0) < 1e-6  # unit norm
+    assert body["data"][0]["embedding"] != body["data"][1]["embedding"]
+    assert body["usage"]["prompt_tokens"] > 0
+    assert status2 == 200 and body2["data"] == body["data"]  # deterministic
+    assert status3 == 404
+    assert status4 == 200 and body4["usage"]["prompt_tokens"] == 3
+    assert any(m["id"] == "embedder" for m in models)
+
+
+def test_http_embeddings_overlong_input_is_400(model_dir, run):
+    """Inputs over the model's token limit are client errors (400), not
+    server errors."""
+    from dynamo_tpu.llm.embedding import EmbeddingEngine, fake_embedder
+
+    async def main():
+        tok = Tokenizer.from_model_dir(model_dir)
+        svc = HttpService()
+        svc.manager.add_embedding_model(
+            "embedder",
+            EmbeddingEngine(fake_embedder(), tokenizer=tok, max_input_tokens=4),
+        )
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, body = await http_request(
+                host, port, "POST", "/v1/embeddings",
+                {"model": "embedder", "input": [[1, 2, 3, 4, 5, 6]]},
+            )
+            status2, _, _ = await http_request(
+                host, port, "POST", "/v1/embeddings",
+                {"model": "embedder", "input": [[1, 2, 3]]},
+            )
+            return status, body, status2
+        finally:
+            await svc.stop()
+
+    status, body, status2 = run(main())
+    assert status == 400
+    assert "token limit" in body["error"]["message"] or "over" in body["error"]["message"]
+    assert status2 == 200
