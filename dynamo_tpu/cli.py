@@ -31,6 +31,44 @@ logger = logging.getLogger("dynamo.run")
 from .protocols.endpoint import parse_endpoint_id  # noqa: E402 (re-export)
 
 
+def _add_engine_flags(p) -> None:
+    """Engine-construction flags consumed by ``_make_engine`` -- defined
+    once, shared by every subcommand that builds a local engine (`run`,
+    `profile-sla`), so the flag set and _make_engine's input contract
+    cannot drift apart."""
+    p.add_argument("--echo-delay-ms", type=float, default=0.0,
+                   help="out=echo: per-token delay")
+    p.add_argument("--model-path", help="HF model dir (weights + tokenizer)")
+    p.add_argument("--model-name", help="served model name (default: dir name)")
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--block-size", type=int, default=None,
+                   help="router-visible KV block size (default: page size)")
+    p.add_argument("--decode-block-size", type=int, default=16)
+    p.add_argument("--prefill-chunk-tokens", type=int, default=None,
+                   help="chunked prefill: split long prompts into chunks "
+                        "of this many tokens, interleaved with decode")
+    p.add_argument("--host-offload-blocks", type=int, default=0,
+                   help="G2 host-RAM KV offload capacity (blocks); 0 = off")
+    p.add_argument("--disk-offload-blocks", type=int, default=0,
+                   help="G3 disk KV offload capacity (blocks); 0 = off")
+    p.add_argument("--disk-offload-dir",
+                   help="directory for G3 disk offload files")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree (shards over local devices)")
+    # multi-host engine bootstrap (jax.distributed; env DYN_NUM_NODES /
+    # DYN_NODE_RANK / DYN_LEADER_ADDR also work)
+    p.add_argument("--num-nodes", type=int, default=None,
+                   help="hosts in the engine's multi-host world")
+    p.add_argument("--node-rank", type=int, default=None,
+                   help="this host's rank (0 = leader)")
+    p.add_argument("--leader-addr", default=None,
+                   help="leader host:port for the jax.distributed "
+                        "coordinator")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dynamo-tpu",
@@ -40,10 +78,6 @@ def build_parser() -> argparse.ArgumentParser:
     run = sub.add_parser("run", help="launch an engine/frontend/worker")
     run.add_argument("io", nargs=2, metavar=("in=...", "out=..."),
                      help="in=http|text|dyn out=jax|mocker|echo|dyn")
-    run.add_argument("--echo-delay-ms", type=float, default=0.0,
-                     help="out=echo: per-token delay")
-    run.add_argument("--model-path", help="HF model dir (weights + tokenizer)")
-    run.add_argument("--model-name", help="served model name (default: dir name)")
     run.add_argument("--hub", help="hub address host:port, or 'auto'")
     run.add_argument("--endpoint", default="dyn://dynamo.backend.generate",
                      help="worker endpoint id (dyn://ns.comp.ep)")
@@ -51,34 +85,7 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--port", type=int, default=8080)
     run.add_argument("--router-mode", default="round_robin",
                      choices=["round_robin", "random", "kv"])
-    # engine shape
-    run.add_argument("--max-batch-size", type=int, default=8)
-    run.add_argument("--max-seq-len", type=int, default=2048)
-    run.add_argument("--page-size", type=int, default=16)
-    run.add_argument("--num-pages", type=int, default=512)
-    run.add_argument("--block-size", type=int, default=None,
-                     help="router-visible KV block size (default: page size)")
-    run.add_argument("--decode-block-size", type=int, default=16)
-    run.add_argument("--prefill-chunk-tokens", type=int, default=None,
-                     help="chunked prefill: split long prompts into chunks "
-                          "of this many tokens, interleaved with decode")
-    run.add_argument("--host-offload-blocks", type=int, default=0,
-                     help="G2 host-RAM KV offload capacity (blocks); 0 = off")
-    run.add_argument("--disk-offload-blocks", type=int, default=0,
-                     help="G3 disk KV offload capacity (blocks); 0 = off")
-    run.add_argument("--disk-offload-dir",
-                     help="directory for G3 disk offload files")
-    run.add_argument("--tp", type=int, default=1,
-                     help="tensor-parallel degree (shards over local devices)")
-    # multi-host engine bootstrap (jax.distributed; env DYN_NUM_NODES /
-    # DYN_NODE_RANK / DYN_LEADER_ADDR also work)
-    run.add_argument("--num-nodes", type=int, default=None,
-                     help="hosts in the engine's multi-host world")
-    run.add_argument("--node-rank", type=int, default=None,
-                     help="this host's rank (0 = leader)")
-    run.add_argument("--leader-addr", default=None,
-                     help="leader host:port for the jax.distributed "
-                          "coordinator")
+    _add_engine_flags(run)
     run.add_argument("--prompt", help="in=text: run one prompt and exit")
     run.add_argument("--input-file", help="in=batch: JSONL prompts file")
     run.add_argument("--output-file", help="in=batch: JSONL results path "
@@ -102,6 +109,41 @@ def build_parser() -> argparse.ArgumentParser:
     ctlsub.add_parser("list", help="list registered models + instances")
     rm = ctlsub.add_parser("remove", help="deregister a model by name")
     rm.add_argument("name")
+
+    # datagen: workload analysis + synthesis (reference benchmarks/
+    # data_generator `datagen analyze|synthesize`)
+    dg = sub.add_parser("datagen", help="analyze/synthesize prefix workloads")
+    dgsub = dg.add_subparsers(dest="dgcmd", required=True)
+    an = dgsub.add_parser("analyze", help="prefix-sharing stats for a trace")
+    an.add_argument("--input-file", required=True, help="JSONL trace")
+    an.add_argument("--block-size", type=int, default=512)
+    sy = dgsub.add_parser("synthesize", help="generate a synthetic trace")
+    sy.add_argument("--input-file", required=True, help="JSONL seed trace")
+    sy.add_argument("--output-file", required=True)
+    sy.add_argument("--num-requests", type=int, default=1000)
+    sy.add_argument("--block-size", type=int, default=512)
+    sy.add_argument("--num-copies", type=int, default=1)
+    sy.add_argument("--speedup-ratio", type=float, default=1.0)
+    sy.add_argument("--prefix-len-multiplier", type=int, default=1)
+    sy.add_argument("--prompt-len-multiplier", type=float, default=1.0)
+    sy.add_argument("--seed", type=int, default=0)
+
+    # profile-sla: pre-deployment TTFT/ITL profiling (reference
+    # docs/architecture/planner.md profile_sla workflow)
+    ps = sub.add_parser("profile-sla",
+                        help="measure TTFT/ITL per config, recommend SLO point")
+    ps.add_argument("--out", default="jax", choices=["jax", "mocker", "echo"],
+                    help="engine to profile")
+    ps.add_argument("--isl", default="128,512",
+                    help="comma-separated prefill lengths to probe")
+    ps.add_argument("--batch", default="1,4,8",
+                    help="comma-separated decode batch sizes to probe")
+    ps.add_argument("--osl", type=int, default=64,
+                    help="decode tokens per probe stream (span several "
+                         "decode blocks or ITL reads near zero)")
+    ps.add_argument("--ttft-slo-ms", type=float, default=None)
+    ps.add_argument("--itl-slo-ms", type=float, default=None)
+    _add_engine_flags(ps)
     return p
 
 
@@ -574,6 +616,65 @@ async def run_llmctl(args) -> int:
         await hub.close()
 
 
+async def run_profile_sla(args) -> int:
+    """profile-sla: drive the engine, print the TTFT/ITL table + the SLO
+    recommendation as one JSON object."""
+    import json
+
+    from .planner.profile_sla import SlaProfiler
+
+    isls = [int(x) for x in args.isl.split(",") if x]
+    batches = [int(x) for x in args.batch.split(",") if x]
+    engine = await _make_engine(args)  # same builder as `run` (shared flags)
+    vocab = _tokenizer_for(args).vocab_size if args.model_path else 30000
+    try:
+        prof = await SlaProfiler(engine, vocab_size=vocab).profile(
+            isls=isls, batches=batches, osl=args.osl
+        )
+        print(
+            json.dumps(
+                {
+                    "profile": prof.to_dict(),
+                    "recommendation": prof.recommend(
+                        args.ttft_slo_ms, args.itl_slo_ms
+                    ),
+                },
+                indent=2,
+            )
+        )
+    finally:
+        await engine.stop()
+    return 0
+
+
+def run_datagen(args) -> int:
+    """datagen analyze|synthesize (reference benchmarks/data_generator/cli.py)."""
+    import json
+
+    from .datagen import PrefixAnalyzer, Synthesizer
+    from .datagen.analyzer import load_trace
+
+    if args.dgcmd == "analyze":
+        stats = PrefixAnalyzer.from_file(
+            args.input_file, block_size=args.block_size
+        ).analyze()
+        print(json.dumps(stats, indent=2))
+        return 0
+    syn = Synthesizer(
+        load_trace(args.input_file),
+        block_size=args.block_size,
+        num_copies=args.num_copies,
+        speedup_ratio=args.speedup_ratio,
+        prefix_len_multiplier=args.prefix_len_multiplier,
+        prompt_len_multiplier=args.prompt_len_multiplier,
+        seed=args.seed,
+    )
+    records = syn.synthesize(args.num_requests)
+    Synthesizer.dump(records, args.output_file)
+    print(f"wrote {len(records)} requests to {args.output_file}")
+    return 0
+
+
 def main(argv=None) -> int:
     from .runtime.utils import configure_logging
 
@@ -591,6 +692,10 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "llmctl":
         return asyncio.run(run_llmctl(args))
+    if args.cmd == "datagen":
+        return run_datagen(args)
+    if args.cmd == "profile-sla":
+        return asyncio.run(run_profile_sla(args))
     args.inp, args.out = _parse_io(args.io)
     try:
         if args.inp == "http" and args.out in ("jax", "mocker", "echo"):

@@ -1,0 +1,13 @@
+"""Workload data tools: trace analysis + prefix-structured synthesis.
+
+Reference parity: benchmarks/data_generator (hasher.py, prefix_analyzer.py,
+sampler.py, synthesizer.py + `datagen analyze|synthesize` CLI).  Rebuilt
+here around this repo's own block-identity layer (tokens/hashing.py chained
+xxh64) and a plain-dict prefix tree -- no graph library dependency.
+"""
+
+from .hasher import texts_to_hashes
+from .analyzer import PrefixAnalyzer
+from .synthesizer import Synthesizer
+
+__all__ = ["texts_to_hashes", "PrefixAnalyzer", "Synthesizer"]

@@ -1,0 +1,86 @@
+"""Prefix-trace analysis (reference data_generator/prefix_analyzer.py).
+
+Trace format: JSONL records
+``{"hash_ids": [...], "input_length": n, "output_length": m, "timestamp": ms}``
+(the mooncake-style shape; ``hash_ids`` are per-block chained ids as produced
+by datagen.hasher).  ``input_length``/``output_length``/``timestamp`` are
+optional -- lengths default to blocks*block_size, timestamps to 0.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+
+def load_trace(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _percentile(sorted_vals: List[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(p * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return float(sorted_vals[idx])
+
+
+def _dist(vals: List[float]) -> Dict[str, float]:
+    s = sorted(vals)
+    n = len(s)
+    return {
+        "count": n,
+        "mean": (sum(s) / n) if n else 0.0,
+        "p50": _percentile(s, 0.50),
+        "p90": _percentile(s, 0.90),
+        "p99": _percentile(s, 0.99),
+        "max": s[-1] if n else 0.0,
+    }
+
+
+class PrefixAnalyzer:
+    """Prefix-sharing statistics over a trace: how much of the workload an
+    ideal (infinite) prefix cache could absorb, and the ISL/OSL shape the
+    serving stack must plan for."""
+
+    def __init__(self, records: List[Dict[str, Any]], block_size: int = 1) -> None:
+        self.records = records
+        self.block_size = block_size
+        self.hash_counter: Counter = Counter()
+        for r in records:
+            self.hash_counter.update(r.get("hash_ids") or [])
+
+    @classmethod
+    def from_file(cls, path: str, block_size: int = 1) -> "PrefixAnalyzer":
+        return cls(load_trace(path), block_size)
+
+    def analyze(self) -> Dict[str, Any]:
+        """Returns the summary dict (also the `datagen analyze` output)."""
+        isl, osl = [], []
+        for r in self.records:
+            ids = r.get("hash_ids") or []
+            isl.append(
+                float(r.get("input_length", len(ids) * self.block_size))
+            )
+            osl.append(float(r.get("output_length", 0)))
+        reused = sum(1 for c in self.hash_counter.values() if c > 1)
+        total_blocks = sum(self.hash_counter.values())
+        # infinite cache: every occurrence after a block's first is a hit
+        hit_blocks = total_blocks - len(self.hash_counter)
+        return {
+            "num_requests": len(self.records),
+            "unique_blocks": len(self.hash_counter),
+            "reused_blocks": reused,
+            "total_block_refs": total_blocks,
+            "theoretical_hit_rate": (hit_blocks / total_blocks)
+            if total_blocks
+            else 0.0,
+            "isl": _dist(isl),
+            "osl": _dist(osl),
+        }

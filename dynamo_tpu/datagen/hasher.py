@@ -1,0 +1,43 @@
+"""Texts -> per-block rolling hash ids (reference data_generator/hasher.py).
+
+Tokenizes without special tokens, splits into fixed blocks, hashes each
+block CHAINED on its prefix (so an identical block at a different position
+gets a different id -- the same identity rule the KV router and block
+manager use, via tokens/hashing.py), then remaps the 64-bit hashes to
+small consecutive ints for compact traces.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..tokens.hashing import hash_blocks
+
+
+def tokens_to_hashes(
+    token_lists: List[List[int]], block_size: int = 512
+) -> List[List[int]]:
+    """Block-hash pre-tokenized inputs; ids are consecutive ints assigned in
+    first-seen order (equal prefixes share ids across inputs)."""
+    remap: Dict[int, int] = {}
+    out: List[List[int]] = []
+    for toks in token_lists:
+        _, seq_hashes = hash_blocks(toks, block_size)
+        row = []
+        for h in seq_hashes:  # chained: position-binding identity
+            if h not in remap:
+                remap[h] = len(remap)
+            row.append(remap[h])
+        out.append(row)
+    return out
+
+
+def texts_to_hashes(
+    tokenizer, texts: List[str], block_size: int = 512
+) -> List[List[int]]:
+    """Tokenize (no special tokens) then block-hash.  ``tokenizer`` is this
+    repo's Tokenizer facade or anything with the same ``encode`` shape."""
+    token_lists = [
+        tokenizer.encode(t, add_special_tokens=False) for t in texts
+    ]
+    return tokens_to_hashes(token_lists, block_size)

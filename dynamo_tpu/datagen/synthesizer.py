@@ -1,0 +1,156 @@
+"""Prefix-structured workload synthesis (reference data_generator/
+synthesizer.py + sampler.py, rebuilt tree-first without a graph library).
+
+Model: a trace's ``hash_ids`` paths decompose into a **core prefix tree**
+(blocks seen more than once -- shareable context) plus a **unique suffix**
+per request (the user prompt, visited exactly once).  Synthesis replays
+that structure statistically: walk the core tree by empirical transition
+counts, exit where real requests exited, then append a fresh never-repeated
+suffix of empirically-sampled length.
+
+Knobs (reference-compatible semantics):
+- ``speedup_ratio``       divide inter-arrival times (request-rate scaling)
+- ``num_copies``          replicate the core tree N times with disjoint ids
+                          (dilutes sharing across a bigger working set)
+- ``prefix_len_multiplier``  expand every core block into k synthetic blocks
+                          (longer shared contexts, same tree shape)
+- ``prompt_len_multiplier``  scale the unique-suffix block count
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter, defaultdict
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+_ROOT = -1  # synthetic super-root (reference SUPER_ROOT)
+_EXIT = -2  # transition: leave the core tree into the unique suffix
+
+
+class EmpiricalSampler:
+    """Sample from observed values (with replacement)."""
+
+    def __init__(self, values: Sequence[float], rng: np.random.RandomState):
+        self.values = list(values) or [0.0]
+        self.rng = rng
+
+    def sample(self) -> float:
+        return self.values[self.rng.randint(len(self.values))]
+
+
+class Synthesizer:
+    def __init__(
+        self,
+        records: List[Dict[str, Any]],
+        block_size: int = 512,
+        num_copies: int = 1,
+        speedup_ratio: float = 1.0,
+        prefix_len_multiplier: int = 1,
+        prompt_len_multiplier: float = 1.0,
+        seed: int = 0,
+    ) -> None:
+        if prefix_len_multiplier < 1 or int(prefix_len_multiplier) != prefix_len_multiplier:
+            raise ValueError("prefix_len_multiplier must be a positive integer")
+        self.block_size = block_size
+        self.num_copies = max(1, num_copies)
+        self.speedup = float(speedup_ratio)
+        self.prefix_mult = int(prefix_len_multiplier)
+        self.prompt_mult = float(prompt_len_multiplier)
+        self.rng = np.random.RandomState(seed)
+        self._build(records)
+
+    # -- statistics extraction ---------------------------------------------
+
+    def _build(self, records: List[Dict[str, Any]]) -> None:
+        counts: Counter = Counter()
+        for r in records:
+            counts.update(r.get("hash_ids") or [])
+        self._core_ids = {h for h, c in counts.items() if c > 1}
+
+        # transitions[parent][child] = times a request at core node `parent`
+        # continued to core node `child`; _EXIT = left the core here
+        self.transitions: Dict[int, Counter] = defaultdict(Counter)
+        leaf_lens: List[float] = []
+        osls: List[float] = []
+        arrivals: List[float] = []
+        last_ts: Optional[float] = None
+        for r in records:
+            ids = r.get("hash_ids") or []
+            node = _ROOT
+            i = 0
+            while i < len(ids) and ids[i] in self._core_ids:
+                self.transitions[node][ids[i]] += 1
+                node = ids[i]
+                i += 1
+            self.transitions[node][_EXIT] += 1
+            leaf_lens.append(len(ids) - i)
+            osls.append(float(r.get("output_length", 0)))
+            ts = r.get("timestamp")
+            if ts is not None and last_ts is not None:
+                arrivals.append(max(0.0, float(ts) - last_ts))
+            if ts is not None:
+                last_ts = float(ts)
+
+        self.leaf_len = EmpiricalSampler(leaf_lens, self.rng)
+        self.osl = EmpiricalSampler(osls, self.rng)
+        self.arrival = EmpiricalSampler(arrivals, self.rng)
+        self._max_core = (max(self._core_ids) + 1) if self._core_ids else 0
+        self._next_unique = 0  # fresh suffix ids live above every core copy
+
+    # -- synthesis ----------------------------------------------------------
+
+    def _core_id(self, h: int, copy: int) -> List[int]:
+        """Map a core id into its copy's id space, expanded by the prefix
+        multiplier (k synthetic blocks per observed block -- same sharing
+        shape, longer shared prefix)."""
+        base = (copy * self._max_core + h) * self.prefix_mult
+        return [base + j for j in range(self.prefix_mult)]
+
+    def _fresh_suffix(self, n: int) -> List[int]:
+        lo = self.num_copies * self._max_core * self.prefix_mult
+        ids = [lo + self._next_unique + j for j in range(n)]
+        self._next_unique += n
+        return ids
+
+    def synthesize(self, num_requests: int) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        ts = 0.0
+        for _ in range(num_requests):
+            copy = self.rng.randint(self.num_copies)
+            ids: List[int] = []
+            node = _ROOT
+            while True:
+                choices = self.transitions.get(node)
+                if not choices:
+                    break
+                keys = list(choices.keys())
+                weights = np.asarray([choices[k] for k in keys], np.float64)
+                pick = keys[
+                    int(self.rng.choice(len(keys), p=weights / weights.sum()))
+                ]
+                if pick == _EXIT:
+                    break
+                ids.extend(self._core_id(pick, copy))
+                node = pick
+            n_leaf = int(round(self.leaf_len.sample() * self.prompt_mult))
+            ids.extend(self._fresh_suffix(max(0, n_leaf)))
+            if not ids:  # degenerate trace: emit at least one block
+                ids = self._fresh_suffix(1)
+            ts += self.arrival.sample() / self.speedup
+            out.append(
+                {
+                    "hash_ids": ids,
+                    "input_length": len(ids) * self.block_size,
+                    "output_length": int(self.osl.sample()),
+                    "timestamp": round(ts, 3),
+                }
+            )
+        return out
+
+    @staticmethod
+    def dump(records: List[Dict[str, Any]], path: str) -> None:
+        with open(path, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")

@@ -24,6 +24,10 @@ class EchoEngineCore(AsyncEngine):
     def __init__(self, delay_ms: float = 0.0) -> None:
         self.delay_ms = delay_ms
 
+    async def stop(self) -> None:
+        """Lifecycle parity with the real engines (callers stop() whatever
+        _make_engine built)."""
+
     async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
         data = request.data
         req = (

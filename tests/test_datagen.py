@@ -1,0 +1,155 @@
+"""Datagen tests: block hashing, prefix analysis, workload synthesis
+(reference benchmarks/data_generator/tests: hasher/sampler/synthesizer)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.datagen import PrefixAnalyzer, Synthesizer, texts_to_hashes
+from dynamo_tpu.datagen.hasher import tokens_to_hashes
+
+
+# -- hasher ------------------------------------------------------------------
+
+
+def test_shared_prefix_shares_hash_ids():
+    a = list(range(100, 116))  # two full blocks of 8
+    b = a[:8] + list(range(200, 208))  # same first block, different second
+    rows = tokens_to_hashes([a, b], block_size=8)
+    assert len(rows[0]) == 2 and len(rows[1]) == 2
+    assert rows[0][0] == rows[1][0]  # shared first block
+    assert rows[0][1] != rows[1][1]
+    # ids are consecutive ints in first-seen order
+    assert sorted({i for row in rows for i in row}) == [0, 1, 2]
+
+
+def test_hashes_are_position_chained():
+    """The same block content at a different position gets a different id
+    (chained sequence hashes -- the router/block-manager identity rule)."""
+    blk = list(range(50, 58))
+    rows = tokens_to_hashes([blk + blk], block_size=8)
+    assert rows[0][0] != rows[0][1]
+
+
+def test_partial_blocks_dropped():
+    rows = tokens_to_hashes([list(range(11))], block_size=8)
+    assert len(rows[0]) == 1  # only the complete block hashes
+
+
+def test_texts_to_hashes_uses_tokenizer(model_dir):
+    from dynamo_tpu.llm.tokenizer import Tokenizer
+
+    tok = Tokenizer.from_model_dir(model_dir)
+    rows = texts_to_hashes(
+        tok, ["hello world hello world", "hello world hello fox"], block_size=4
+    )
+    assert rows[0][0] == rows[1][0]  # common text prefix -> common first id
+
+
+# -- analyzer ----------------------------------------------------------------
+
+
+def _trace():
+    # three requests sharing a 2-block context [0, 1]; unique suffixes
+    return [
+        {"hash_ids": [0, 1, 2], "input_length": 24, "output_length": 4,
+         "timestamp": 0.0},
+        {"hash_ids": [0, 1, 3], "input_length": 24, "output_length": 8,
+         "timestamp": 10.0},
+        {"hash_ids": [0, 4], "input_length": 16, "output_length": 6,
+         "timestamp": 30.0},
+    ]
+
+
+def test_analyzer_stats():
+    stats = PrefixAnalyzer(_trace(), block_size=8).analyze()
+    assert stats["num_requests"] == 3
+    assert stats["unique_blocks"] == 5
+    assert stats["reused_blocks"] == 2  # ids 0 and 1
+    assert stats["total_block_refs"] == 8
+    # infinite cache: hits = occurrences after first = 8 - 5
+    assert stats["theoretical_hit_rate"] == pytest.approx(3 / 8)
+    assert stats["isl"]["mean"] == pytest.approx((24 + 24 + 16) / 3)
+    assert stats["osl"]["max"] == 8
+
+
+# -- synthesizer -------------------------------------------------------------
+
+
+def test_synthesizer_preserves_sharing_structure():
+    syn = Synthesizer(_trace(), block_size=8, seed=1)
+    out = syn.synthesize(200)
+    assert len(out) == 200
+    stats = PrefixAnalyzer(out, block_size=8).analyze()
+    # the seed trace shares block 0 across every request; the synthetic
+    # trace must show substantial reuse too (every walk starts at id 0)
+    assert stats["theoretical_hit_rate"] > 0.3
+    # suffix ids never repeat across requests
+    suffix_ids = [i for r in out for i in r["hash_ids"] if i >= syn._max_core]
+    assert len(suffix_ids) == len(set(suffix_ids))
+    # timestamps are non-decreasing
+    ts = [r["timestamp"] for r in out]
+    assert ts == sorted(ts)
+
+
+def test_synthesizer_deterministic_by_seed():
+    a = Synthesizer(_trace(), block_size=8, seed=7).synthesize(50)
+    b = Synthesizer(_trace(), block_size=8, seed=7).synthesize(50)
+    c = Synthesizer(_trace(), block_size=8, seed=8).synthesize(50)
+    assert a == b
+    assert a != c
+
+
+def test_num_copies_dilutes_sharing():
+    one = PrefixAnalyzer(
+        Synthesizer(_trace(), block_size=8, seed=3).synthesize(300)
+    ).analyze()
+    four = PrefixAnalyzer(
+        Synthesizer(_trace(), block_size=8, num_copies=4, seed=3).synthesize(300)
+    ).analyze()
+    # spreading the same walks over 4 disjoint trees lowers the hit rate
+    assert four["theoretical_hit_rate"] < one["theoretical_hit_rate"]
+    assert four["unique_blocks"] > one["unique_blocks"]
+
+
+def test_prefix_multiplier_lengthens_shared_context():
+    base = Synthesizer(_trace(), block_size=8, seed=3).synthesize(100)
+    wide = Synthesizer(
+        _trace(), block_size=8, prefix_len_multiplier=3, seed=3
+    ).synthesize(100)
+    mean = lambda rs: sum(r["input_length"] for r in rs) / len(rs)
+    assert mean(wide) > mean(base)
+    # sharing structure survives the expansion
+    s = PrefixAnalyzer(wide).analyze()
+    assert s["theoretical_hit_rate"] > 0.3
+
+
+def test_speedup_compresses_timestamps():
+    slow = Synthesizer(_trace(), block_size=8, seed=3).synthesize(100)
+    fast = Synthesizer(
+        _trace(), block_size=8, speedup_ratio=10.0, seed=3
+    ).synthesize(100)
+    assert fast[-1]["timestamp"] < slow[-1]["timestamp"] / 5
+
+
+def test_cli_roundtrip(tmp_path):
+    from dynamo_tpu.cli import main
+
+    seed = tmp_path / "seed.jsonl"
+    out = tmp_path / "synth.jsonl"
+    with open(seed, "w") as f:
+        for r in _trace():
+            f.write(json.dumps(r) + "\n")
+    rc = main([
+        "datagen", "synthesize", "--input-file", str(seed),
+        "--output-file", str(out), "--num-requests", "25",
+        "--block-size", "8",
+    ])
+    assert rc == 0
+    lines = [json.loads(l) for l in open(out)]
+    assert len(lines) == 25
+    rc = main([
+        "datagen", "analyze", "--input-file", str(out), "--block-size", "8"
+    ])
+    assert rc == 0
