@@ -86,6 +86,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--router-mode", default="round_robin",
                      choices=["round_robin", "random", "kv"])
     _add_engine_flags(run)
+    run.add_argument("--request-template",
+                     help="JSON file with request defaults "
+                          "{model, temperature, max_completion_tokens} "
+                          "applied when the client omits them")
     run.add_argument("--prompt", help="in=text: run one prompt and exit")
     run.add_argument("--input-file", help="in=batch: JSONL prompts file")
     run.add_argument("--output-file", help="in=batch: JSONL results path "
@@ -145,6 +149,16 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("--itl-slo-ms", type=float, default=None)
     _add_engine_flags(ps)
     return p
+
+
+def _load_template(args):
+    """--request-template JSON -> RequestTemplate (reference
+    request_template.rs:18), or None."""
+    if not getattr(args, "request_template", None):
+        return None
+    from .protocols.openai import RequestTemplate
+
+    return RequestTemplate.load(args.request_template)
 
 
 def _parse_io(io) -> Tuple[str, str]:
@@ -276,7 +290,10 @@ async def run_http_local(args) -> None:
         name,
         EmbeddingEngine(embed_fn, tokenizer=tokenizer, max_input_tokens=max_in),
     )
-    service = HttpService(manager, host=args.host, port=args.port)
+    service = HttpService(
+        manager, host=args.host, port=args.port,
+        template=_load_template(args),
+    )
     await service.start()
     print(f"serving {name} at {service.url}  (POST /v1/chat/completions)")
     try:
@@ -322,7 +339,10 @@ async def run_http_frontend(args) -> None:
             runtime, manager, router_mode=RouterMode(args.router_mode)
         )
     await watcher.start()
-    service = HttpService(manager, host=args.host, port=args.port)
+    service = HttpService(
+        manager, host=args.host, port=args.port,
+        template=_load_template(args),
+    )
     await service.start()
     print(f"frontend at {service.url} (hub {addr}); models appear on discovery")
     stop = asyncio.Event()

@@ -53,6 +53,8 @@ class Synthesizer:
     ) -> None:
         if prefix_len_multiplier < 1 or int(prefix_len_multiplier) != prefix_len_multiplier:
             raise ValueError("prefix_len_multiplier must be a positive integer")
+        if not speedup_ratio > 0:
+            raise ValueError("speedup_ratio must be > 0")
         self.block_size = block_size
         self.num_copies = max(1, num_copies)
         self.speedup = float(speedup_ratio)
@@ -98,6 +100,13 @@ class Synthesizer:
         self.arrival = EmpiricalSampler(arrivals, self.rng)
         self._max_core = (max(self._core_ids) + 1) if self._core_ids else 0
         self._next_unique = 0  # fresh suffix ids live above every core copy
+        # transitions are immutable after this point: precompute each node's
+        # (keys, probabilities) once instead of per walk step
+        self._cdf: Dict[int, tuple] = {}
+        for node, choices in self.transitions.items():
+            keys = list(choices.keys())
+            w = np.asarray([choices[k] for k in keys], np.float64)
+            self._cdf[node] = (keys, w / w.sum())
 
     # -- synthesis ----------------------------------------------------------
 
@@ -122,14 +131,11 @@ class Synthesizer:
             ids: List[int] = []
             node = _ROOT
             while True:
-                choices = self.transitions.get(node)
-                if not choices:
+                entry = self._cdf.get(node)
+                if entry is None:
                     break
-                keys = list(choices.keys())
-                weights = np.asarray([choices[k] for k in keys], np.float64)
-                pick = keys[
-                    int(self.rng.choice(len(keys), p=weights / weights.sum()))
-                ]
+                keys, probs = entry
+                pick = keys[int(self.rng.choice(len(keys), p=probs))]
                 if pick == _EXIT:
                     break
                 ids.extend(self._core_id(pick, copy))

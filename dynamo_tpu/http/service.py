@@ -120,8 +120,10 @@ class HttpService:
         host: str = "127.0.0.1",
         port: int = 0,
         metrics_prefix: str = "dynamo",
+        template=None,  # Optional[RequestTemplate]: body defaults
     ) -> None:
         self.manager = manager or ModelManager()
+        self.template = template
         self.metrics = ServiceMetrics(prefix=metrics_prefix)
         self.server = HttpServer(host, port)
         self.server.route("POST", "/v1/chat/completions", self._chat)
@@ -186,6 +188,8 @@ class HttpService:
             body = req.json()
             if not isinstance(body, dict):
                 raise OpenAIError("request body must be a JSON object")
+            if self.template is not None and self.template.model is not None:
+                body.setdefault("model", self.template.model)
             parsed = EmbeddingRequest.from_dict(body)
             engine = self.manager.embedding_engine(parsed.model)
         except OpenAIError as e:
@@ -230,6 +234,8 @@ class HttpService:
             body = req.json()
             if not isinstance(body, dict):
                 raise OpenAIError("request body must be a JSON object")
+            if self.template is not None:
+                body = self.template.apply(body)
             parsed = (
                 ChatCompletionRequest.from_dict(body)
                 if chat

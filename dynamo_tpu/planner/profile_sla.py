@@ -150,9 +150,19 @@ class SlaProfiler:
         await asyncio.gather(*[one() for _ in range(batch)])
         t_end = time.monotonic()
         itls = [
-            (last - first) / (n - 1) for first, last, n in results if n >= 2
+            (last - first) / (n - 1)
+            for first, last, n in results
+            if n >= 2 and last > first
         ]
-        itl_ms = (sum(itls) / len(itls)) * 1e3 if itls else 0.0
+        if not itls:
+            # every stream delivered in one flush: osl doesn't span multiple
+            # decode blocks, so there is no inter-flush interval to measure.
+            # A confident 0.0 here would bless any batch against any SLO.
+            raise RuntimeError(
+                f"ITL unmeasurable at batch={batch}: every stream arrived in"
+                f" a single flush; raise --osl to span several decode blocks"
+            )
+        itl_ms = (sum(itls) / len(itls)) * 1e3
         done = sum(n for _, _, n in results)
         return itl_ms, done / max(1e-9, t_end - t0)
 

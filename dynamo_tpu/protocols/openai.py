@@ -155,6 +155,38 @@ class CompletionRequest:
 
 
 @dataclass
+class RequestTemplate:
+    """Request defaults from a JSON file (reference request_template.rs:18:
+    ``{model, temperature, max_completion_tokens}``).  Applied to the raw
+    request body BEFORE validation; explicit client fields always win."""
+
+    model: Optional[str] = None
+    temperature: Optional[float] = None
+    max_completion_tokens: Optional[int] = None
+
+    @classmethod
+    def load(cls, path: str) -> "RequestTemplate":
+        with open(path) as f:
+            d = json.load(f)
+        return cls(
+            model=d.get("model"),
+            temperature=d.get("temperature"),
+            max_completion_tokens=d.get("max_completion_tokens"),
+        )
+
+    def apply(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        if self.model is not None:
+            body.setdefault("model", self.model)
+        if self.temperature is not None:
+            body.setdefault("temperature", self.temperature)
+        if self.max_completion_tokens is not None and (
+            "max_tokens" not in body and "max_completion_tokens" not in body
+        ):
+            body["max_tokens"] = self.max_completion_tokens
+        return body
+
+
+@dataclass
 class EmbeddingRequest:
     """/v1/embeddings request (reference: protocols/openai/embeddings.rs).
 

@@ -1,0 +1,171 @@
+"""E-P-D (Encode-Prefill-Decode) multimodal serving skeleton.
+
+Reference: examples/multimodal (encode_worker -> embeddings transferred to
+prefill -> decode, llava-style) and examples/hello_world/disagg_skeleton
+(the engine-free scaffold).  This is the TPU-native wiring of the same
+three-stage graph over the hub runtime:
+
+- **EncodeWorker**: the vision tower.  Here a deterministic stand-in maps
+  an "image" payload to embedding tokens (a real deployment runs a ViT
+  under jit and produces soft-prompt embeddings); the contract is the
+  same: encode output must reach the prefill stage out-of-band of the
+  text tokens.
+- **Prefill/Decode**: the existing disaggregated LLM pair
+  (`dynamo_tpu.llm.disagg`): the decode worker ships long prefills to the
+  prefill pool through the hub queue, KV pages come back over the data
+  plane.
+
+Flow per request: frontend -> encode endpoint (image -> prompt tokens) ->
+decode worker (conditional remote prefill) -> token stream back.
+
+Run:  python examples/multimodal/epd_skeleton.py
+"""
+
+import asyncio
+import hashlib
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.llm.disagg import (
+    DisaggConfig,
+    DisaggDecodeEngine,
+    KV_DELIVER_ENDPOINT,
+    PrefillWorker,
+)
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime.component import (
+    Context,
+    DistributedRuntime,
+    PushRouter,
+)
+from dynamo_tpu.runtime.engine import Annotated, AsyncEngine, ResponseStream
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+
+class EncodeWorker(AsyncEngine):
+    """The encode stage: image payload -> embedding token ids.
+
+    Stand-in for a jitted vision encoder; deterministic on content so the
+    pipeline is testable.  Emits ONE item: {"image_tokens": [...]}."""
+
+    def __init__(self, vocab_size: int = 60, num_image_tokens: int = 8) -> None:
+        self.vocab = vocab_size
+        self.n = num_image_tokens
+
+    async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
+        image: bytes = (request.data or {}).get("image", b"")
+        if isinstance(image, str):
+            image = image.encode()
+        digest = hashlib.sha256(image).digest()
+        tokens = [2 + digest[i % len(digest)] % self.vocab for i in range(self.n)]
+        ctx = request.ctx
+
+        async def gen():
+            yield Annotated.from_data({"image_tokens": tokens})
+
+        return ResponseStream(ctx, gen())
+
+
+class EpdFrontend:
+    """Glue stage: call encode, splice image tokens ahead of the text
+    prompt (llava-style), forward to the decode worker."""
+
+    def __init__(self, encode_router: PushRouter, llm_router: PushRouter) -> None:
+        self.encode = encode_router
+        self.llm = llm_router
+
+    async def generate_text(self, image: str, text_tokens: list, max_tokens: int):
+        enc_stream = await self.encode.generate(Context.new({"image": image}))
+        image_tokens = None
+        async for item in enc_stream:
+            data = item.data or {}
+            if "image_tokens" in data:
+                image_tokens = data["image_tokens"]
+        assert image_tokens is not None, "encode worker returned nothing"
+
+        req = PreprocessedRequest(
+            token_ids=image_tokens + list(text_tokens),
+            stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        )
+        out = []
+        # requests cross the request plane as JSON dicts (wire form)
+        stream = await self.llm.generate(Context.new(req.to_dict()))
+        async for item in stream:
+            data = item.data or {}
+            out.extend(data.get("token_ids") or [])
+        return out
+
+
+def tiny_engine():
+    return JaxEngine.random_init(
+        ModelConfig.tiny(),
+        EngineConfig(max_batch_size=4, max_seq_len=64, page_size=4,
+                     num_pages=64),
+    )
+
+
+async def main():
+    decode_engine = tiny_engine()
+    prefill_engine = tiny_engine()
+
+    hub = HubServer()
+    host, port = await hub.start()
+    addr = f"{host}:{port}"
+
+    # encode worker (its own process in production)
+    ert = await DistributedRuntime.detached(addr)
+    await ert.namespace("mm").component("encoder").endpoint("encode").serve(
+        EncodeWorker()
+    )
+
+    # decode worker: image+text prompts longer than 4 tokens prefill remotely
+    drt = await DistributedRuntime.detached(addr)
+    dns = drt.namespace("mm")
+    decode = DisaggDecodeEngine(
+        decode_engine, dns, "backend", drt.primary_lease,
+        DisaggConfig(max_local_prefill_length=4), block_size=4,
+    )
+    await dns.component("backend").endpoint("generate").serve(decode)
+    await dns.component("backend").endpoint(KV_DELIVER_ENDPOINT).serve(
+        decode.deliver_handler()
+    )
+
+    # prefill worker pool
+    prt = await DistributedRuntime.detached(addr)
+    pw = PrefillWorker(prefill_engine, prt.namespace("mm"))
+    await pw.start()
+
+    # frontend
+    frt = await DistributedRuntime.detached(addr)
+    enc_client = await (
+        frt.namespace("mm").component("encoder").endpoint("encode").client()
+    )
+    llm_client = await (
+        frt.namespace("mm").component("backend").endpoint("generate").client()
+    )
+    front = EpdFrontend(PushRouter(enc_client), PushRouter(llm_client))
+
+    # images cross the wire as base64 strings (the OpenAI image_url
+    # data-URI convention; the request plane is JSON-framed)
+    import base64
+
+    image_b64 = base64.b64encode(b"\x89PNG...demo-image-bytes").decode()
+    tokens = await front.generate_text(
+        image=image_b64, text_tokens=[5, 6, 7], max_tokens=8,
+    )
+    print(f"E-P-D generated {len(tokens)} tokens: {tokens}")
+    assert len(tokens) == 8
+    # the 11-token prompt (8 image + 3 text) exceeded the 4-token local
+    # cap, so the prefill stage really ran remotely
+    assert decode.remote_prefills == 1
+
+    await pw.stop()
+    await decode_engine.stop()
+    await prefill_engine.stop()
+    for rt in (frt, prt, drt, ert):
+        await rt.shutdown()
+    await hub.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

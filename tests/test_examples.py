@@ -1,0 +1,28 @@
+"""Example graphs run as tests (chip-free on the CPU test platform): each
+example's main() carries its own asserts, so these are end-to-end smoke
+tests of the public wiring the docs point users at."""
+
+import asyncio
+import importlib.util
+import os
+
+import pytest
+
+_EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _load(relpath):
+    path = os.path.abspath(os.path.join(_EXAMPLES, relpath))
+    spec = importlib.util.spec_from_file_location(
+        relpath.replace("/", "_").replace(".py", ""), path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_multimodal_epd_skeleton(run):
+    """Encode -> (disagg) Prefill -> Decode three-stage graph over the hub
+    (reference examples/multimodal E-P-D)."""
+    mod = _load("multimodal/epd_skeleton.py")
+    run(mod.main())

@@ -606,3 +606,47 @@ def test_http_embeddings_overlong_input_is_400(model_dir, run):
     assert status == 400
     assert "token limit" in body["error"]["message"] or "over" in body["error"]["message"]
     assert status2 == 200
+
+
+def test_request_template_defaults_applied(model_dir, run, tmp_path):
+    """--request-template semantics (reference request_template.rs): file
+    defaults fill missing model/temperature/max_tokens; explicit client
+    fields win."""
+    import json
+
+    from dynamo_tpu.protocols.openai import RequestTemplate
+
+    tpl_file = tmp_path / "tpl.json"
+    tpl_file.write_text(json.dumps({
+        "model": "mock-model", "temperature": 0.0,
+        "max_completion_tokens": 5,
+    }))
+    tpl = RequestTemplate.load(str(tpl_file))
+
+    async def main():
+        svc, engine = _build_service(model_dir)
+        svc.template = tpl
+        await svc.start()
+        try:
+            host, port = svc.address
+            # no model, no max_tokens -> template fills both
+            status, _, body = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "hello"}]},
+            )
+            # explicit max_tokens wins over the template
+            status2, _, body2 = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "hello"}],
+                 "max_tokens": 2},
+            )
+            return status, body, status2, body2
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    status, body, status2, body2 = run(main())
+    assert status == 200
+    assert body["model"] == "mock-model"
+    assert body["usage"]["completion_tokens"] == 5
+    assert status2 == 200 and body2["usage"]["completion_tokens"] == 2
