@@ -148,6 +148,33 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("--ttft-slo-ms", type=float, default=None)
     ps.add_argument("--itl-slo-ms", type=float, default=None)
     _add_engine_flags(ps)
+
+    # bench: serving benchmark against a running OpenAI frontend (the
+    # north-star measurement: output tok/s + TTFT percentiles on a
+    # ShareGPT-like workload -- BASELINE.md)
+    bn = sub.add_parser("bench",
+                        help="drive a frontend with a workload; report "
+                             "tok/s + TTFT percentiles")
+    bn.add_argument("--host", default="127.0.0.1")
+    bn.add_argument("--port", type=int, required=True)
+    bn.add_argument("--model", required=True)
+    bn.add_argument("--num-requests", type=int, default=None,
+                    help="synthetic: workload size (default 64); trace: "
+                         "cap on records replayed (default: whole trace)")
+    bn.add_argument("--isl", type=int, default=128)
+    bn.add_argument("--osl", type=int, default=64)
+    bn.add_argument("--request-rate", type=float, default=0.0,
+                    help="Poisson arrival rate (req/s); 0 = all at once")
+    bn.add_argument("--concurrency", type=int, default=64)
+    bn.add_argument("--vocab-size", type=int, default=29000)
+    bn.add_argument("--trace", help="datagen JSONL trace to replay instead "
+                                    "of the synthetic workload")
+    bn.add_argument("--trace-block-size", type=int, default=16,
+                    help="tokens per trace hash id (fallback only: the "
+                         "trace's input_length fields take precedence)")
+    bn.add_argument("--speedup-ratio", type=float, default=1.0,
+                    help="trace replay time compression")
+    bn.add_argument("--seed", type=int, default=0)
     return p
 
 
@@ -667,6 +694,36 @@ async def run_profile_sla(args) -> int:
     return 0
 
 
+async def run_bench(args) -> int:
+    """bench: fire the workload at a running frontend, print one JSON
+    summary (output tok/s, TTFT percentiles, error counts)."""
+    import json
+
+    from .bench_serving import run_bench as drive, synth_workload, trace_workload
+
+    if args.trace:
+        workload = trace_workload(
+            args.trace,
+            block_size=args.trace_block_size,
+            vocab=args.vocab_size,
+            speedup=args.speedup_ratio,
+            limit=args.num_requests,  # None = replay the whole trace
+        )
+    else:
+        workload = synth_workload(
+            args.num_requests if args.num_requests is not None else 64,
+            args.isl, args.osl, args.request_rate,
+            vocab=args.vocab_size, seed=args.seed,
+        )
+    report = await drive(
+        args.host, args.port, args.model, workload,
+        concurrency=args.concurrency,
+    )
+    summary = report.summary()
+    print(json.dumps(summary, indent=2))
+    return 0 if summary["num_errors"] == 0 else 1
+
+
 def run_datagen(args) -> int:
     """datagen analyze|synthesize (reference benchmarks/data_generator/cli.py)."""
     import json
@@ -716,6 +773,8 @@ def main(argv=None) -> int:
         return run_datagen(args)
     if args.cmd == "profile-sla":
         return asyncio.run(run_profile_sla(args))
+    if args.cmd == "bench":
+        return asyncio.run(run_bench(args))
     args.inp, args.out = _parse_io(args.io)
     try:
         if args.inp == "http" and args.out in ("jax", "mocker", "echo"):

@@ -1,0 +1,159 @@
+"""Serving-bench harness tests: workload construction, SSE-side metrics,
+trace replay with reproduced prefix sharing (the north-star measurement
+path, chip-free against the mocker)."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.bench_serving import (
+    BenchReport,
+    RequestResult,
+    run_bench,
+    synth_workload,
+    trace_workload,
+)
+
+
+def test_synth_workload_shapes():
+    w = synth_workload(10, isl=32, osl=8, request_rate=0.0, seed=1)
+    assert len(w) == 10
+    assert all(len(i["token_ids"]) == 32 and i["max_tokens"] == 8 for i in w)
+    assert all(i["at"] == 0.0 for i in w)  # rate 0 = all at once
+    w2 = synth_workload(10, isl=32, osl=8, request_rate=100.0, seed=1)
+    ats = [i["at"] for i in w2]
+    assert ats == sorted(ats) and ats[-1] > 0
+
+
+def test_trace_workload_reproduces_sharing(tmp_path):
+    trace = tmp_path / "t.jsonl"
+    recs = [
+        {"hash_ids": [0, 1, 2], "output_length": 4, "timestamp": 0.0},
+        {"hash_ids": [0, 1, 3], "output_length": 4, "timestamp": 2.0},
+    ]
+    trace.write_text("\n".join(json.dumps(r) for r in recs))
+    w = trace_workload(str(trace), block_size=4, speedup=2.0)
+    assert len(w) == 2
+    # shared hash ids 0,1 -> identical first 8 prompt tokens
+    assert w[0]["token_ids"][:8] == w[1]["token_ids"][:8]
+    assert w[0]["token_ids"][8:] != w[1]["token_ids"][8:]
+    assert w[1]["at"] == pytest.approx(1.0)  # 2s gap / speedup 2
+
+
+def test_report_summary_percentiles():
+    rep = BenchReport(
+        results=[
+            RequestResult(ok=True, ttft_s=0.010, latency_s=0.1, output_tokens=8),
+            RequestResult(ok=True, ttft_s=0.020, latency_s=0.2, output_tokens=8),
+            RequestResult(ok=True, ttft_s=0.030, latency_s=0.3, output_tokens=8),
+            RequestResult(ok=False, error="boom"),
+        ],
+        wall_s=2.0,
+    )
+    s = rep.summary()
+    assert s["num_ok"] == 3 and s["num_errors"] == 1
+    assert s["output_tok_s"] == 12.0
+    assert s["ttft_ms"]["p50"] == 20.0
+    assert s["mean_output_tokens"] == 8.0
+
+
+def test_bench_against_mocker_frontend(model_dir, run):
+    """End-to-end: the bench drives a live HTTP frontend (mocker engine)
+    over real sockets and reports nonzero throughput + TTFT."""
+    from dynamo_tpu.http import HttpService
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import Tokenizer
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.runtime.pipeline import link
+
+    async def main():
+        tok = Tokenizer.from_model_dir(model_dir)
+        engine = MockerEngine(
+            MockerConfig(block_size=4, vocab_size=max(2, tok.vocab_size - 1))
+        )
+        svc = HttpService()
+        pipeline = link(OpenAIPreprocessor("m", tok), Backend(tok), engine)
+        svc.manager.add_completion_model("m", pipeline)
+        await svc.start()
+        try:
+            host, port = svc.address
+            w = synth_workload(6, isl=12, osl=6, request_rate=0.0,
+                               vocab=200, seed=2)
+            report = await run_bench(host, port, "m", w, concurrency=4)
+            bad = await run_bench(
+                host, port, "nope", w[:1], concurrency=1
+            )
+            return report.summary(), bad.summary()
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    summary, bad = run(main())
+    assert summary["num_ok"] == 6 and summary["num_errors"] == 0
+    assert summary["output_tok_s"] > 0
+    assert summary["ttft_ms"]["p50"] is not None
+    assert summary["mean_output_tokens"] == 6.0  # usage-accurate counting
+    assert bad["num_errors"] == 1  # unknown model surfaces as an error
+
+
+def test_trace_workload_infers_block_size(tmp_path):
+    """input_length in the trace overrides the caller's block size (a
+    mismatched flag must not silently shrink every prompt)."""
+    trace = tmp_path / "t.jsonl"
+    recs = [
+        {"hash_ids": [0, 1], "input_length": 1024, "output_length": 4,
+         "timestamp": 0.0},
+    ]
+    trace.write_text("\n".join(json.dumps(r) for r in recs))
+    w = trace_workload(str(trace), block_size=16)  # flag says 16...
+    assert len(w[0]["token_ids"]) == 1024  # ...trace says 512/block
+
+
+def test_sse_client_handles_split_chunked_frames(run):
+    """The SSE client must decode chunked framing itself: serve a response
+    whose chunk boundaries fall mid-line and check it still parses."""
+    import asyncio
+
+    from dynamo_tpu.bench_serving import _sse_request
+
+    event1 = b'data: {"choices": [{"text": "hel"}]}\n\n'
+    event2 = b'data: {"choices": [{"text": "lo"}], "usage": {"completion_tokens": 7}}\n\n'
+    done = b"data: [DONE]\n\n"
+    stream = event1 + event2 + done
+    # split at awkward positions: mid-"data:", mid-JSON
+    cuts = [0, 3, 10, 17, len(event1) + 5, len(event1) + 30, len(stream)]
+    parts = [stream[a:b] for a, b in zip(cuts, cuts[1:])]
+
+    async def handle(reader, writer):
+        await reader.readuntil(b"\r\n\r\n")
+        await reader.read(1)  # some body bytes; don't care
+        head = (
+            b"HTTP/1.1 200 OK\r\nContent-Type: text/event-stream\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+        )
+        writer.write(head)
+        for p in parts:
+            if p:
+                writer.write(f"{len(p):x}\r\n".encode() + p + b"\r\n")
+                await writer.drain()
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+        writer.close()
+
+    async def main():
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        host, port = server.sockets[0].getsockname()[:2]
+        try:
+            res = await _sse_request(
+                host, port, "m", {"token_ids": [1, 2], "max_tokens": 4}
+            )
+            return res
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    res = run(main())
+    assert res.ok, res.error
+    assert res.output_tokens == 7  # usage wins
+    assert res.ttft_s is not None
