@@ -50,6 +50,7 @@ def decode_attention_dispatch(
     page_table: jax.Array,  # [B, P]
     kv_lens: jax.Array,  # [B]
     layer: jax.Array,  # scalar i32
+    window: int = 0,  # sliding-window width; 0 = full attention
 ) -> jax.Array:
     """Decode attention: Pallas page-streaming kernel on TPU, XLA gather
     elsewhere.  Resolved at trace time (static), so each compiled executable
@@ -57,9 +58,9 @@ def decode_attention_dispatch(
     if _pallas_decode_enabled(kv_pages.shape[3]):
         from ..ops.paged_attention import paged_decode_attention as pallas_decode
 
-        return pallas_decode(q, kv_pages, page_table, kv_lens, layer)
+        return pallas_decode(q, kv_pages, page_table, kv_lens, layer, window)
     layer_kv = jax.lax.dynamic_index_in_dim(kv_pages, layer, 0, keepdims=False)
-    return paged_decode_attention(q, layer_kv, page_table, kv_lens)
+    return paged_decode_attention(q, layer_kv, page_table, kv_lens, window)
 
 
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
@@ -74,12 +75,15 @@ def prefill_attention(
     k: jax.Array,  # [B, T, Hkv, D]
     v: jax.Array,  # [B, T, Hkv, D]
     seq_lens: jax.Array,  # [B] valid prompt length per slot
+    window: int = 0,  # sliding-window width; 0 = full attention
 ) -> jax.Array:
     """Causal self-attention over the prompt being prefilled.
 
     Assumes the prompt starts at position 0 (no prior cache); prefix-cache
     restarts gather reused pages through the decode path instead.
-    """
+    ``window`` > 0 masks keys more than ``window - 1`` positions behind the
+    query (Mistral/Phi3 sliding-window semantics: the query position itself
+    counts toward the window)."""
     B, T, Hq, D = q.shape
     n_rep = Hq // k.shape[2]
     k = repeat_kv(k, n_rep)
@@ -89,6 +93,8 @@ def prefill_attention(
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     pos = jnp.arange(T)
     causal = pos[None, :] <= pos[:, None]  # [Tq, Tk] keys <= query
+    if window > 0:
+        causal = causal & (pos[:, None] - pos[None, :] < window)
     valid = pos[None, :] < seq_lens[:, None]  # [B, Tk]
     mask = causal[None, None, :, :] & valid[:, None, None, :]
     scores = jnp.where(mask, scores, _NEG_INF)
@@ -101,12 +107,13 @@ def paged_decode_attention(
     kv_pages: jax.Array,  # [2, num_pages, page_size, Hkv, D]
     page_table: jax.Array,  # [B, P] int32 page ids
     kv_lens: jax.Array,  # [B] tokens in cache (incl. the one just written)
+    window: int = 0,  # sliding-window width; 0 = full attention
 ) -> jax.Array:
     """Decode-step attention: gather each slot's pages, mask, softmax.
 
     The gather materializes ``[B, P*page_size, Hkv, D]`` -- the classic
     paged-attention v1 shape.  P (pages per sequence) is static; kv_lens
-    masks the tail.
+    masks the tail (and, with ``window``, the head beyond the window).
     """
     B, Hq, D = q.shape
     _, _, page_size, Hkv, _ = kv_pages.shape
@@ -124,6 +131,8 @@ def paged_decode_attention(
     scores = jnp.einsum("bhd,bkhd->bhk", q, k) * scale  # [B, Hq, P*page]
     idx = jnp.arange(P * page_size)
     mask = idx[None, :] < kv_lens[:, None]  # [B, P*page]
+    if window > 0:
+        mask = mask & (idx[None, :] >= kv_lens[:, None] - window)
     scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhk,bkhd->bhd", probs, v)
@@ -138,6 +147,7 @@ def prefill_prefix_attention(
     prefix_table: jax.Array,  # [B, Pp] reused-prefix page ids (0-padded)
     offset: jax.Array,  # [B] cached prefix length in tokens
     suffix_lens: jax.Array,  # [B] valid suffix length
+    window: int = 0,  # sliding-window width; 0 = full attention
 ) -> jax.Array:
     """Suffix prefill attention with a resident prefix (prefix-cache restart).
 
@@ -165,9 +175,24 @@ def prefill_prefix_attention(
     prefix_valid = jnp.arange(Pp * page_size)[None, :] < offset[:, None]  # [B, Kp]
     suffix_valid = local[None, :] < suffix_lens[:, None]  # [B, T]
     causal = local[None, :] <= local[:, None]  # [Tq, Tk]
-    mask_prefix = jnp.broadcast_to(
-        prefix_valid[:, None, None, :], (B, 1, T, Pp * page_size)
-    )
+    if window > 0:
+        # absolute positions: query = offset + local_q, prefix key = kpos,
+        # suffix key = offset + local_k; keep keys within the window
+        q_abs = offset[:, None] + local[None, :]  # [B, Tq]
+        kpos = jnp.arange(Pp * page_size)
+        prefix_win = (
+            kpos[None, None, :] > q_abs[:, :, None] - window
+        )  # [B, Tq, Kp]
+        mask_prefix = jnp.broadcast_to(
+            (prefix_valid[:, None, :] & prefix_win)[:, None],
+            (B, 1, T, Pp * page_size),
+        )
+        suffix_win = local[:, None] - local[None, :] < window  # [Tq, Tk]
+        causal = causal & suffix_win
+    else:
+        mask_prefix = jnp.broadcast_to(
+            prefix_valid[:, None, None, :], (B, 1, T, Pp * page_size)
+        )
     mask_suffix = jnp.broadcast_to(
         causal[None, None, :, :] & suffix_valid[:, None, None, :], (B, 1, T, T)
     )

@@ -2,7 +2,8 @@
 
 Covers the Llama family surface (Llama 2/3, Mistral, Qwen2 via
 ``attention_bias``, Mixtral/DeepSeek-style MoE via ``num_experts``, Gemma
-via ``rms_norm_offset``/``gelu``/``scale_embeddings``) -- the model
+via ``rms_norm_offset``/``gelu``/``scale_embeddings``, Phi-3 via fused
+qkv/gate_up splitting in the loader, Qwen3 via ``qk_norm``) -- the model
 families the reference serves through vLLM/TRT-LLM configs (reference
 examples/llm/configs/*.yaml, examples/tensorrt_llm/configs).
 """
@@ -40,6 +41,14 @@ class ModelConfig:
     rms_norm_offset: bool = False
     hidden_act: str = "silu"  # "silu" | "gelu_tanh"
     scale_embeddings: bool = False
+    # Qwen3-family: per-head RMSNorm on q and k before RoPE
+    qk_norm: bool = False
+    # Llama-3.1 style frequency-dependent RoPE scaling, stored as a hashable
+    # tuple ("llama3", factor, low_freq_factor, high_freq_factor,
+    # original_max_position) -- ModelConfig rides jit as a static arg
+    rope_scaling: Optional[tuple] = None
+    # sliding-window attention (Mistral/Phi3); None/0 = full attention
+    sliding_window: Optional[int] = None
     # activation dtype for compute; params may be stored differently
     dtype: str = "bfloat16"
 
@@ -109,13 +118,13 @@ class ModelConfig:
         )
 
     SUPPORTED_MODEL_TYPES = (
-        "llama", "mistral", "qwen2", "mixtral", "gemma",
+        "llama", "mistral", "qwen2", "mixtral", "gemma", "phi3", "qwen3",
     )
 
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":
         """Build from a HuggingFace ``config.json`` dict (llama/mistral/qwen2/
-        mixtral/gemma architectures).
+        mixtral/gemma/phi3/qwen3 architectures).
 
         Unknown model types raise instead of loading silently: e.g. gemma2
         carries extra pre/post_feedforward_layernorm tensors the assembler
@@ -126,6 +135,48 @@ class ModelConfig:
                 f"unsupported model_type {mt!r}; supported: "
                 f"{', '.join(cls.SUPPORTED_MODEL_TYPES)}"
             )
+        # RoPE scaling: llama3 frequency-dependent scaling is implemented;
+        # anything else (yarn, longrope, linear, dynamic) must fail loudly
+        # for EVERY model type -- loading a scaled checkpoint with plain
+        # RoPE produces garbage at long context with no error
+        rope_scaling: Optional[tuple] = None
+        rs = cfg.get("rope_scaling") or None
+        if rs is not None:
+            rs_type = rs.get("rope_type") or rs.get("type")
+            if rs_type == "llama3":
+                rope_scaling = (
+                    "llama3",
+                    float(rs["factor"]),
+                    float(rs["low_freq_factor"]),
+                    float(rs["high_freq_factor"]),
+                    int(rs["original_max_position_embeddings"]),
+                )
+            elif rs_type not in (None, "default"):
+                raise ValueError(
+                    f"rope_scaling type {rs_type!r} is not supported"
+                    " (implemented: llama3)"
+                )
+        # sliding-window attention: honored when the config enables it
+        # (qwen2 gates it behind use_sliding_window; mistral/phi3 enable by
+        # presence)
+        window = cfg.get("sliding_window") or None
+        if window is not None and cfg.get("use_sliding_window") is False:
+            window = None
+        if window is not None:
+            # HF qwen2 windows only layers >= max_window_layers; this engine
+            # windows uniformly.  mwl >= num_layers means NO layer windows
+            # (disable); 0 < mwl < num_layers is a genuine per-layer mix --
+            # fail loudly, not silently-different logits
+            mwl = cfg.get("max_window_layers")
+            if mwl is not None:
+                if mwl >= cfg["num_hidden_layers"]:
+                    window = None
+                elif mwl > 0:
+                    raise ValueError(
+                        f"per-layer sliding window (max_window_layers={mwl} <"
+                        f" num_hidden_layers={cfg['num_hidden_layers']}) is"
+                        " not supported"
+                    )
         hidden = cfg["hidden_size"]
         heads = cfg["num_attention_heads"]
         return cls(
@@ -155,6 +206,9 @@ class ModelConfig:
                 else "silu"
             ),
             scale_embeddings=cfg.get("model_type") == "gemma",
+            qk_norm=cfg.get("model_type") == "qwen3",
+            rope_scaling=rope_scaling,
+            sliding_window=window,
         )
 
     @classmethod

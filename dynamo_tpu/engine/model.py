@@ -21,7 +21,7 @@ tests/test_engine_model.py).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +64,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype: Any = None) -> Params:
         layers["bq"] = jnp.zeros((L, Hq * D), dtype)
         layers["bk"] = jnp.zeros((L, Hkv * D), dtype)
         layers["bv"] = jnp.zeros((L, Hkv * D), dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, D), dtype)
+        layers["k_norm"] = jnp.ones((L, D), dtype)
     if cfg.is_moe:
         E = cfg.num_experts
         layers["router"] = w(next(keys), (L, H, E))
@@ -111,13 +114,36 @@ def _activate(x: jax.Array, hidden_act: str) -> jax.Array:
 
 
 def rope_cos_sin(
-    positions: jax.Array, head_dim: int, theta: float
+    positions: jax.Array,
+    head_dim: int,
+    theta: float,
+    scaling: Optional[tuple] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """HF convention: inv_freq over even dims, angles ``pos * inv_freq``,
-    cos/sin tiled as [freqs, freqs]."""
+    cos/sin tiled as [freqs, freqs].
+
+    ``scaling`` = ("llama3", factor, low_freq_factor, high_freq_factor,
+    original_max_position) applies Llama-3.1's frequency-dependent
+    stretch: long-wavelength components slow by ``factor``, short ones
+    stay, the band between interpolates smoothly (matches HF
+    ``_compute_llama3_parameters``)."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling is not None:
+        kind, factor, low_f, high_f, orig_max = scaling
+        if kind != "llama3":  # config validates; belt and braces
+            raise ValueError(f"unknown rope scaling {kind!r}")
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wavelen = orig_max / low_f
+        high_wavelen = orig_max / high_f
+        smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+        smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(
+            wavelen > low_wavelen,
+            inv_freq / factor,
+            jnp.where(wavelen < high_wavelen, inv_freq, smoothed),
+        )
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., D/2]
     emb = jnp.concatenate([angles, angles], axis=-1)  # [..., D]
     return jnp.cos(emb), jnp.sin(emb)
@@ -261,6 +287,9 @@ def transformer_layer(
     q = q.reshape(B, T, cfg.num_heads, D)
     k = k.reshape(B, T, cfg.num_kv_heads, D)
     v = v.reshape(B, T, cfg.num_kv_heads, D)
+    if cfg.qk_norm:  # Qwen3: per-head RMSNorm before RoPE
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn, kv_pages = attn_fn(q, k, v, kv_pages, layer)
@@ -321,7 +350,7 @@ def transformer(
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
     if cfg.scale_embeddings:  # Gemma: sqrt(hidden) in the embed dtype
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
-    cos, sin = rope_cos_sin(positions, D, cfg.rope_theta)  # [B, T, D]
+    cos, sin = rope_cos_sin(positions, D, cfg.rope_theta, cfg.rope_scaling)  # [B, T, D]
 
     x, new_kv_pages = scan_layers(
         params["layers"], kv_pages, x, cos, sin, cfg, attn_fn

@@ -44,7 +44,7 @@ def prefill_step(
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
     def attn_fn(q, k, v, kv, layer):
-        out = att.prefill_attention(q, k, v, seq_lens)
+        out = att.prefill_attention(q, k, v, seq_lens, cfg.sliding_window or 0)
         new_kv = att.write_prefill_kv(kv, k, v, page_table, layer)
         return out, new_kv
 
@@ -70,7 +70,8 @@ def _decode_once(
         q1, k1, v1 = q[:, 0], k[:, 0], v[:, 0]
         new_kv = att.write_decode_kv(kv, k1, v1, page_table, positions, layer)
         out = att.decode_attention_dispatch(
-            q1, new_kv, page_table, positions + 1, layer
+            q1, new_kv, page_table, positions + 1, layer,
+            cfg.sliding_window or 0,
         )
         return out[:, None], new_kv
 
@@ -191,7 +192,8 @@ def prefill_suffix_and_sample(
 
     def attn_fn(q, k, v, kv, layer):
         out = att.prefill_prefix_attention(
-            q, k, v, kv, layer, prefix_table, offset, suffix_lens
+            q, k, v, kv, layer, prefix_table, offset, suffix_lens,
+            cfg.sliding_window or 0,
         )
         new_kv = att.write_prefill_kv(kv, k, v, suffix_table, layer)
         return out, new_kv
@@ -223,7 +225,7 @@ def embed_step(
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
     def attn_fn(q, k, v, kv, layer):
-        out = att.prefill_attention(q, k, v, seq_lens)
+        out = att.prefill_attention(q, k, v, seq_lens, cfg.sliding_window or 0)
         return out, kv
 
     hidden, _ = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)

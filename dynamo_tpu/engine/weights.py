@@ -1,6 +1,6 @@
 """Checkpoint loading: HF safetensors -> stacked-layer JAX pytree.
 
-Maps HuggingFace llama/mistral/qwen2/mixtral parameter names onto the
+Maps HuggingFace llama/mistral/qwen2/mixtral/gemma/phi3/qwen3 parameter names onto the
 stacked ``[num_layers, ...]`` layout of dynamo_tpu.engine.model, transposing
 torch ``[out, in]`` linears to ``[in, out]``.
 
@@ -107,22 +107,63 @@ def assemble_params(
 
     pre = "model."
     layers: Dict[str, Any] = {}
-    attn = {
-        "wq": "self_attn.q_proj.weight",
-        "wk": "self_attn.k_proj.weight",
-        "wv": "self_attn.v_proj.weight",
-        "wo": "self_attn.o_proj.weight",
-    }
-    for key, suffix in attn.items():
-        layers[key] = stack(
-            f"layers/{key}",
-            lambda i, s=suffix: linear(f"{pre}layers.{i}.{s}"),
+    def split_fused(suffix: str, splits) -> None:
+        """One fused [sum(rows), H] tensor per layer -> several stacked
+        [L, H, rows] leaves.  ONE read per layer fills every slice --
+        slicing per projection would re-read/decode the fused tensor once
+        per output (phi3 qkv_proj is the largest attention tensor)."""
+        w0 = get(f"{pre}layers.0.{suffix}")
+        H = w0.shape[1]
+        bufs = {k: np.empty((L, H, rows), w0.dtype) for k, rows in splits}
+        for i in range(L):
+            w = w0 if i == 0 else get(f"{pre}layers.{i}.{suffix}")
+            lo = 0
+            for k, rows in splits:
+                bufs[k][i] = w[lo : lo + rows].T
+                lo += rows
+        del w0
+        for k, _ in splits:
+            layers[k] = put(f"layers/{k}", bufs.pop(k))
+
+    fused_qkv = f"{pre}layers.0.self_attn.qkv_proj.weight" in raw
+    if fused_qkv:
+        # phi3: fused qkv_proj rows are [q | k | v] (torch layout [out, in])
+        q_rows = cfg.num_heads * cfg.head_dim
+        kv_rows = cfg.num_kv_heads * cfg.head_dim
+        split_fused(
+            "self_attn.qkv_proj.weight",
+            [("wq", q_rows), ("wk", kv_rows), ("wv", kv_rows)],
         )
+        layers["wo"] = stack(
+            "layers/wo",
+            lambda i: linear(f"{pre}layers.{i}.self_attn.o_proj.weight"),
+        )
+    else:
+        attn = {
+            "wq": "self_attn.q_proj.weight",
+            "wk": "self_attn.k_proj.weight",
+            "wv": "self_attn.v_proj.weight",
+            "wo": "self_attn.o_proj.weight",
+        }
+        for key, suffix in attn.items():
+            layers[key] = stack(
+                f"layers/{key}",
+                lambda i, s=suffix: linear(f"{pre}layers.{i}.{s}"),
+            )
     if cfg.attention_bias:
         for key, suffix in (
             ("bq", "self_attn.q_proj.bias"),
             ("bk", "self_attn.k_proj.bias"),
             ("bv", "self_attn.v_proj.bias"),
+        ):
+            layers[key] = stack(
+                f"layers/{key}",
+                lambda i, s=suffix: get(f"{pre}layers.{i}.{s}"),
+            )
+    if cfg.qk_norm:  # Qwen3: per-head [D] norms applied before RoPE
+        for key, suffix in (
+            ("q_norm", "self_attn.q_norm.weight"),
+            ("k_norm", "self_attn.k_norm.weight"),
         ):
             layers[key] = stack(
                 f"layers/{key}",
@@ -155,6 +196,16 @@ def assemble_params(
                     ]
                 ),
             )
+    elif f"{pre}layers.0.mlp.gate_up_proj.weight" in raw:
+        # phi3: fused gate_up_proj rows are [gate | up]
+        I = cfg.intermediate_size
+        split_fused(
+            "mlp.gate_up_proj.weight", [("w_gate", I), ("w_up", I)]
+        )
+        layers["w_down"] = stack(
+            "layers/w_down",
+            lambda i: linear(f"{pre}layers.{i}.mlp.down_proj.weight"),
+        )
     else:
         for key, name in (
             ("w_gate", "gate_proj"),

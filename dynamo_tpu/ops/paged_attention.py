@@ -50,6 +50,8 @@ def _decode_kernel(
     m_scr,  # [Hq, 1] f32 running max
     l_scr,  # [Hq, 1] f32 running sum
     acc_scr,  # [Hq, D] f32 running numerator
+    *,
+    window: int = 0,  # sliding-window width (trace-time constant); 0 = full
 ):
     b = pl.program_id(0)
     p = pl.program_id(1)
@@ -68,8 +70,13 @@ def _decode_kernel(
     kv_len = len_ref[b]
 
     # only pages holding live positions contribute; the index map clamps
-    # dead table slots to page 0, whose contents this mask ignores
-    @pl.when(p * page < kv_len)
+    # dead table slots to page 0, whose contents this mask ignores.  With a
+    # sliding window, pages entirely behind the window are skipped too.
+    live = p * page < kv_len
+    if window > 0:
+        live = live & ((p + 1) * page > kv_len - window)
+
+    @pl.when(live)
     def _attend():
         # [Hkv, n_rep, D] query grouped by kv head
         q = q_ref[0].reshape(Hkv, n_rep, D)
@@ -85,7 +92,10 @@ def _decode_kernel(
         pos = p * page + jax.lax.broadcasted_iota(
             jnp.int32, (Hkv, n_rep, page), dimension=2
         )
-        s = jnp.where(pos < kv_len, s, _NEG_INF)
+        keep = pos < kv_len
+        if window > 0:
+            keep = keep & (pos >= kv_len - window)
+        s = jnp.where(keep, s, _NEG_INF)
 
         s2 = s.reshape(Hq, page)
         m_prev = m_scr[:]  # [Hq, 1]
@@ -110,13 +120,14 @@ def _decode_kernel(
         o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D] one new query token per lane
     kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     page_table: jax.Array,  # [B, P] int32 page ids
     kv_lens: jax.Array,  # [B] tokens in cache (incl. the one just written)
     layer: jax.Array | int = 0,  # scalar layer index into kv_pages
+    window: int = 0,  # sliding-window width; 0 = full attention
     interpret: bool = False,
 ) -> jax.Array:
     """TPU replacement for the XLA gather path (same math as
@@ -157,7 +168,7 @@ def paged_decode_attention(
         ],
     )
     return pl.pallas_call(
-        _decode_kernel,
+        functools.partial(_decode_kernel, window=window),
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,

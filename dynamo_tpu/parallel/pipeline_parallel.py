@@ -71,7 +71,7 @@ def pp_prefill_step(
     dtype = jnp.dtype(cfg.dtype)
 
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
-    cos, sin = rope_cos_sin(positions, D, cfg.rope_theta)  # [B, T, D]
+    cos, sin = rope_cos_sin(positions, D, cfg.rope_theta, cfg.rope_scaling)  # [B, T, D]
     x = params["embed"][tokens].astype(dtype)  # [B, T, H]
 
     def split(a):  # [B, ...] -> [M, mb, ...]
@@ -99,7 +99,9 @@ def pp_prefill_step(
             pt_t = jnp.where(valid, pt_a[mbi_c], 0)
 
             def attn_fn(q, k, v, kv_buf, layer):
-                o = att.prefill_attention(q, k, v, lens_t)
+                o = att.prefill_attention(
+                    q, k, v, lens_t, cfg.sliding_window or 0
+                )
                 return o, att.write_prefill_kv(kv_buf, k, v, pt_t, layer)
 
             x_out, kv = scan_layers(lp_local, kv, x_in, cos_t, sin_t, cfg, attn_fn)

@@ -137,6 +137,12 @@ def ring_prefill_step(
     collectives are XLA's problem.  Returns (last-token logits [B, V] f32,
     updated kv_pages)."""
     B, T = tokens.shape
+    if cfg.sliding_window:
+        # the ring accumulates over every shard's keys; silently running it
+        # for a sliding-window model would widen the window
+        raise NotImplementedError(
+            "ring attention does not implement sliding-window masking"
+        )
     if T % mesh.shape[axis_name]:
         raise ValueError(
             f"prefill bucket {T} not divisible by sp={mesh.shape[axis_name]}"

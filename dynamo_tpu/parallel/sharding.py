@@ -44,6 +44,9 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
         specs["layers/bq"] = P(None, "tp")
         specs["layers/bk"] = P(None, "tp")
         specs["layers/bv"] = P(None, "tp")
+    if cfg.qk_norm:  # [L, D] per-head norms replicate (applied per head)
+        specs["layers/q_norm"] = P(None, None)
+        specs["layers/k_norm"] = P(None, None)
     if cfg.is_moe:
         # experts over ep; within an expert, classic column/row TP
         specs["layers/router"] = P(None, None, None)
