@@ -69,6 +69,66 @@ async def run_batch(engine, prompts, max_tokens):
     return sum(results)
 
 
+async def run_disagg(rs):
+    """Disaggregated serving mode: decode engine + prefill engine over the
+    hub (both on the one chip -- they contend, so this tracks the disagg
+    PATH's overhead vs aggregated, not a two-chip speedup).  Every prompt
+    ships remote: hub queue -> prefill engine -> KV blockset delivery ->
+    decode resumes.  Returns decode tok/s."""
+    from dynamo_tpu.llm.disagg import (
+        KV_DELIVER_ENDPOINT,
+        DisaggConfig,
+        DisaggDecodeEngine,
+        PrefillWorker,
+    )
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.transports.hub import HubServer
+
+    cleanups = []
+    try:
+        decode_engine = build_engine()
+        cleanups.append(decode_engine.stop)
+        prefill_engine = build_engine()
+        cleanups.append(prefill_engine.stop)
+        hub = HubServer()
+        host, port = await hub.start()
+        cleanups.append(hub.stop)
+        addr = f"{host}:{port}"
+        drt = await DistributedRuntime.detached(addr)
+        cleanups.append(drt.shutdown)
+        dns = drt.namespace("bench")
+        decode = DisaggDecodeEngine(
+            decode_engine, dns, "backend", drt.primary_lease,
+            DisaggConfig(max_local_prefill_length=0),  # everything ships remote
+            block_size=16,
+        )
+        await dns.component("backend").endpoint(KV_DELIVER_ENDPOINT).serve(
+            decode.deliver_handler()
+        )
+        prt = await DistributedRuntime.detached(addr)
+        cleanups.append(prt.shutdown)
+        pw = PrefillWorker(prefill_engine, prt.namespace("bench"))
+        await pw.start()
+        cleanups.append(pw.stop)
+        prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(8)]
+        await run_batch(decode, prompts, max_tokens=8)  # warm both engines
+        # fresh prompts for the measured pass: reusing the warmup's would
+        # let any prefix reuse shortcut the remote prefill being measured
+        prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(8)]
+        before = decode.remote_prefills
+        t0 = time.monotonic()
+        total = await run_batch(decode, prompts, max_tokens=64)
+        elapsed = time.monotonic() - t0
+        assert decode.remote_prefills - before >= 8, "disagg path not exercised"
+        return total / elapsed
+    finally:
+        for stop in reversed(cleanups):
+            try:
+                await stop()
+            except Exception:
+                pass
+
+
 async def main():
     import numpy as np
 
@@ -121,7 +181,12 @@ async def main():
     decode_steps_s = (total / 8) / elapsed  # token rows per lane per second
     hbm_bw = (pbytes + kv_bytes_per_step) * decode_steps_s
     util = hbm_bw / 819e9
+    # release the aggregated engine BEFORE the disagg leg spins up its two
+    # engines -- three resident models would waste HBM and caps model size
     await engine.stop()
+    del engine
+
+    disagg_tok_s = await run_disagg(rs)
 
     baseline = 51.22  # H100 TP4 per-GPU decode tok/s (reference planner.md:86)
     print(
@@ -134,6 +199,7 @@ async def main():
                 "decode_steps_s": round(decode_steps_s, 2),
                 "dispatches_s": round(steps_s, 2),
                 "prefill_tok_s": round(prefill_tok_s, 1),
+                "disagg_tok_s": round(disagg_tok_s, 2),
                 "est_hbm_util_v5e": round(util, 4),
                 "param_bytes": pbytes,
             }

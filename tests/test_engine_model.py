@@ -712,8 +712,14 @@ def test_qwen2_partial_window_layers_rejected():
     # mwl >= layers means no layer windows at all -> window disabled
     cfg = ModelConfig.from_hf_config({**base, "max_window_layers": 8})
     assert cfg.sliding_window is None
-    # no mwl key -> uniform window honored
+    # qwen2 without use_sliding_window: HF defaults it to False -> disabled
     cfg = ModelConfig.from_hf_config(
         {k: v for k, v in base.items() if k != "use_sliding_window"}
+    )
+    assert cfg.sliding_window is None
+    # mistral enables by presence (no use_sliding_window gate in HF)
+    cfg = ModelConfig.from_hf_config(
+        {**{k: v for k, v in base.items() if k != "use_sliding_window"},
+         "model_type": "mistral"}
     )
     assert cfg.sliding_window == 16
