@@ -56,9 +56,14 @@ def decode_attention_dispatch(
     elsewhere.  Resolved at trace time (static), so each compiled executable
     embeds exactly one backend."""
     if _pallas_decode_enabled(kv_pages.shape[3]):
-        from ..ops.paged_attention import paged_decode_attention as pallas_decode
+        from ..ops.paged_attention import paged_decode_attention_v2
 
-        return pallas_decode(q, kv_pages, page_table, kv_lens, layer, window)
+        # group-of-8 fetches: grid-step overhead dominates per-page v1 at
+        # serving shapes (v2 internally falls back to v1 for table widths
+        # the group doesn't divide)
+        return paged_decode_attention_v2(
+            q, kv_pages, page_table, kv_lens, layer, window, group=8
+        )
     layer_kv = jax.lax.dynamic_index_in_dim(kv_pages, layer, 0, keepdims=False)
     return paged_decode_attention(q, layer_kv, page_table, kv_lens, window)
 

@@ -156,12 +156,12 @@ class ModelConfig:
                     f"rope_scaling type {rs_type!r} is not supported"
                     " (implemented: llama3)"
                 )
-        # sliding-window attention: mistral/phi3 enable by presence; qwen2
-        # gates it behind use_sliding_window, whose HF default is False --
-        # a missing key must DISABLE for qwen2 or this engine would window
-        # checkpoints HF attends fully
+        # sliding-window attention: mistral/phi3 enable by presence; the
+        # qwen families gate it behind use_sliding_window, whose HF default
+        # is False -- a missing key must DISABLE for them or this engine
+        # would window checkpoints HF attends fully
         window = cfg.get("sliding_window") or None
-        if mt == "qwen2" and not cfg.get("use_sliding_window", False):
+        if mt in ("qwen2", "qwen3") and not cfg.get("use_sliding_window", False):
             window = None
         elif window is not None and cfg.get("use_sliding_window") is False:
             window = None

@@ -125,3 +125,33 @@ def test_sliding_window_matches_xla_reference():
             q, kv, pt, kv_lens, 1, window, interpret=True
         )
         assert float(jnp.max(jnp.abs(ref - got))) < 1e-5, f"window={window}"
+
+
+@pytest.mark.parametrize("group", [2, 4, 8])
+def test_v2_group_kernel_matches_xla_reference(group):
+    """The group-fetch v2 kernel (G pages per grid step, K+V per page in
+    one block) against the XLA reference, across fill levels and windows."""
+    from dynamo_tpu.ops.paged_attention import paged_decode_attention_v2
+
+    q, kv, pt = _mk(2, 8, 2, 32, 8, 32, 8)
+    for lens in ([64, 5], [33, 12], [8, 3]):
+        kv_lens = jnp.asarray(lens, jnp.int32)
+        for window in (0, 7, 20):
+            ref = att.paged_decode_attention(q, kv[1], pt, kv_lens, window)
+            got = paged_decode_attention_v2(
+                q, kv, pt, kv_lens, 1, window, group, interpret=True
+            )
+            err = float(jnp.max(jnp.abs(ref - got)))
+            assert err < 1e-5, f"lens={lens} window={window} group={group}"
+
+
+def test_v2_falls_back_when_group_indivisible():
+    from dynamo_tpu.ops.paged_attention import paged_decode_attention_v2
+
+    q, kv, pt = _mk(1, 4, 2, 16, 8, 16, 3)  # P=3 not divisible by 2
+    kv_lens = jnp.asarray([20], jnp.int32)
+    ref = att.paged_decode_attention(q, kv[0], pt, kv_lens)
+    got = paged_decode_attention_v2(
+        q, kv, pt, kv_lens, 0, 0, 2, interpret=True
+    )
+    assert float(jnp.max(jnp.abs(ref - got))) < 1e-5
