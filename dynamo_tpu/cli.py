@@ -106,6 +106,16 @@ def build_parser() -> argparse.ArgumentParser:
     hub.add_argument("--host", default="0.0.0.0")
     hub.add_argument("--port", type=int, default=6650)
 
+    # standalone cluster metrics component (reference components/metrics)
+    mt = sub.add_parser("metrics",
+                        help="cluster Prometheus metrics on :9091")
+    mt.add_argument("--hub", required=True, help="hub address host:port")
+    mt.add_argument("--namespace", default="dynamo")
+    mt.add_argument("--component", default="backend",
+                    help="worker component to scrape")
+    mt.add_argument("--host", default="0.0.0.0")
+    mt.add_argument("--port", type=int, default=9091)
+
     # llmctl: cluster model administration (reference llmctl/src/main.rs)
     ctl = sub.add_parser("llmctl", help="list/remove models on a hub")
     ctl.add_argument("--hub", required=True, help="hub address host:port")
@@ -724,6 +734,29 @@ async def run_bench(args) -> int:
     return 0 if summary["num_errors"] == 0 else 1
 
 
+async def run_metrics(args) -> int:
+    """metrics: the standalone cluster Prometheus component (reference
+    components/metrics :9091) -- scrapes worker load_metrics through the
+    hub, subscribes to kv-hit-rate events, serves GET /metrics."""
+    from .llm.components import MetricsService
+    from .runtime.component import DistributedRuntime
+
+    runtime = await DistributedRuntime.detached(args.hub)
+    svc = MetricsService(runtime, args.namespace, args.component)
+    await svc.start()
+    host, port = await svc.serve_http(args.host, args.port)
+    print(f"cluster metrics at http://{host}:{port}/metrics (hub {args.hub})")
+    stop = asyncio.Event()
+    if hasattr(runtime.hub, "on_connection_lost"):
+        runtime.hub.on_connection_lost = stop.set
+    try:
+        await _wait_forever(stop)
+    finally:
+        await svc.stop()
+        await runtime.shutdown()
+    return 0
+
+
 def run_datagen(args) -> int:
     """datagen analyze|synthesize (reference benchmarks/data_generator/cli.py)."""
     import json
@@ -769,6 +802,8 @@ def main(argv=None) -> int:
         return 0
     if args.cmd == "llmctl":
         return asyncio.run(run_llmctl(args))
+    if args.cmd == "metrics":
+        return asyncio.run(run_metrics(args))
     if args.cmd == "datagen":
         return run_datagen(args)
     if args.cmd == "profile-sla":

@@ -170,4 +170,97 @@ def render_manifests(spec: DeploymentSpec) -> Dict[str, str]:
                 tpu=True,
             ),
         )
+    emit(
+        "metrics.yaml",
+        _deployment(
+            spec, "metrics", 1,
+            py + ["metrics", "--host", "0.0.0.0", "--port", "9091",
+                  "--hub", f"{spec.name}-hub:{spec.hub_port}"],
+            port=9091,
+        ),
+        _service(spec, "metrics", 9091),
+    )
     return out
+
+
+def render_observability(spec: DeploymentSpec) -> Dict[str, str]:
+    """Prometheus scrape config + Grafana dashboard for the graph
+    (reference deploy/metrics compose role).  Kept SEPARATE from
+    render_manifests: these are not k8s objects, and mixing them in would
+    break the `kubectl apply -f outdir/` workflow."""
+    return {
+        "prometheus.yml": render_prometheus_config(spec),
+        "grafana-dashboard.json": render_grafana_dashboard(spec),
+    }
+
+
+def render_prometheus_config(spec: DeploymentSpec) -> str:
+    """Prometheus scrape config for the deployed graph (reference
+    deploy/metrics docker-compose Prometheus): the frontend's /metrics
+    (request/TTFT/ITL histograms) plus the cluster metrics component."""
+    cfg = {
+        "global": {"scrape_interval": "5s"},
+        "scrape_configs": [
+            {
+                "job_name": f"{spec.name}-frontend",
+                "metrics_path": "/metrics",
+                "static_configs": [
+                    {"targets": [f"{spec.name}-frontend:{spec.http_port}"]}
+                ],
+            },
+            {
+                "job_name": f"{spec.name}-cluster",
+                "metrics_path": "/metrics",
+                "static_configs": [
+                    {"targets": [f"{spec.name}-metrics:9091"]}
+                ],
+            },
+        ],
+    }
+    return yaml.safe_dump(cfg, sort_keys=False)
+
+
+def render_grafana_dashboard(spec: DeploymentSpec) -> str:
+    """A Grafana dashboard over the exported metric families (reference
+    deploy/metrics/grafana.json role): request rates, TTFT/ITL quantiles,
+    KV utilization and hit rate."""
+    import json
+
+    def panel(pid, title, exprs, x, y):
+        return {
+            "id": pid,
+            "title": title,
+            "type": "timeseries",
+            "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+            "targets": [
+                {"expr": e, "refId": chr(ord("A") + i)}
+                for i, e in enumerate(exprs)
+            ],
+        }
+
+    dash = {
+        "title": f"{spec.name} serving",
+        "timezone": "browser",
+        "refresh": "10s",
+        "panels": [
+            panel(1, "Request rate by status",
+                  ['sum by (status) (rate(dynamo_http_service_requests_total[1m]))'],
+                  0, 0),
+            panel(2, "TTFT quantiles (s)",
+                  ['histogram_quantile(0.5, sum by (le) (rate(dynamo_http_service_time_to_first_token_seconds_bucket[5m])))',
+                   'histogram_quantile(0.95, sum by (le) (rate(dynamo_http_service_time_to_first_token_seconds_bucket[5m])))'],
+                  12, 0),
+            panel(3, "Inter-token latency quantiles (s)",
+                  ['histogram_quantile(0.5, sum by (le) (rate(dynamo_http_service_inter_token_latency_seconds_bucket[5m])))',
+                   'histogram_quantile(0.95, sum by (le) (rate(dynamo_http_service_inter_token_latency_seconds_bucket[5m])))'],
+                  0, 8),
+            panel(4, "Inflight requests",
+                  ['sum(dynamo_http_service_inflight_requests)'], 12, 8),
+            panel(5, "KV blocks active / total",
+                  ['sum(llm_kv_blocks_active)', 'sum(llm_kv_blocks_total)'],
+                  0, 16),
+            panel(6, "KV hit rate",
+                  ['avg(llm_kv_hit_rate)'], 12, 16),
+        ],
+    }
+    return json.dumps(dash, indent=2)

@@ -15,7 +15,12 @@ def test_aggregated_graph_manifests():
         tpu_chips_per_worker=4,
     )
     m = render_manifests(spec)
-    assert set(m) == {"hub.yaml", "frontend.yaml", "decode-worker.yaml"}
+    assert set(m) == {"hub.yaml", "frontend.yaml", "decode-worker.yaml",
+                      "metrics.yaml"}
+    # every manifest file is pure k8s (kubectl apply -f dir must work)
+    for fname, text in m.items():
+        for doc in _load_all(text):
+            assert "apiVersion" in doc and "kind" in doc, fname
 
     hub_dep, hub_svc = _load_all(m["hub.yaml"])
     assert hub_dep["kind"] == "Deployment" and hub_svc["kind"] == "Service"
@@ -57,3 +62,60 @@ def test_hub_cli_subcommand_parses():
 
     args = build_parser().parse_args(["hub", "--port", "7000"])
     assert args.cmd == "hub" and args.port == 7000
+
+
+def test_observability_configs_rendered():
+    """Prometheus scrape config + Grafana dashboard (reference
+    deploy/metrics compose role): every family the dashboard queries must
+    ACTUALLY exist in a live registry, and every scrape target must map to
+    a rendered Service."""
+    import json
+    import re
+
+    import yaml as _yaml
+
+    from dynamo_tpu.deploy import DeploymentSpec, render_observability
+
+    spec = DeploymentSpec(name="demo", model_path="/m", decode_workers=2)
+    out = render_observability(spec)
+    assert set(out) == {"prometheus.yml", "grafana-dashboard.json"}
+
+    prom = _yaml.safe_load(out["prometheus.yml"])
+    targets = [
+        t for sc in prom["scrape_configs"] for s in sc["static_configs"]
+        for t in s["targets"]
+    ]
+    # each scrape target's host must be a Service the manifests render
+    services = set()
+    for text in render_manifests(spec).values():
+        for doc in _load_all(text):
+            if doc["kind"] == "Service":
+                services.add(doc["metadata"]["name"])
+    for t in targets:
+        host = t.split(":")[0]
+        assert host in services, f"scrape target {t} has no Service"
+
+    # collect the families live code actually exports
+    from dynamo_tpu.http.metrics import ServiceMetrics
+
+    exported = set()
+    for metric in ServiceMetrics(prefix="dynamo").registry.collect():
+        exported.add(metric.name)
+        exported.update(s.name for s in metric.samples)
+    # MetricsService gauge names without standing up a runtime: they are
+    # declared with Gauge(name, ...) in components.py -- parse them out
+    import inspect
+
+    from dynamo_tpu.llm import components as comp_mod
+
+    src = inspect.getsource(comp_mod)
+    exported.update(re.findall(r'g\("([a-z_]+)"', src))
+
+    dash = json.loads(out["grafana-dashboard.json"])
+    exprs = " ".join(t["expr"] for p in dash["panels"] for t in p["targets"])
+    for fam in set(re.findall(r"(dynamo_[a-z_]+|llm_[a-z_]+)", exprs)):
+        base = re.sub(r"_(bucket|count|sum|total)$", "", fam)
+        assert (
+            fam in exported or base in exported
+            or fam.removesuffix("_total") in exported
+        ), f"dashboard queries {fam}, not exported by any component"

@@ -1,0 +1,125 @@
+"""Soak/stress: sustained concurrent load with cancellation and worker
+churn over the full runtime stack (reference test tier: runtime
+tests/soak.rs long-running stress + mock-network churn).
+
+Bounded to seconds, not minutes -- the point is interleaving breadth
+(admissions racing cancels racing a worker death racing a worker join),
+not wall-clock duration.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.runtime.component import (
+    Context,
+    DistributedRuntime,
+    PushRouter,
+    RouterMode,
+)
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+
+class _SlowTokenEngine:
+    def __init__(self, tag):
+        self.tag = tag
+        self.served = 0
+
+    async def generate(self, request):
+        n = request.data["n"]
+        ctx = request.ctx
+        self.served += 1
+
+        async def gen():
+            for i in range(n):
+                if ctx.is_stopped():
+                    return
+                yield {"i": i, "tag": self.tag}
+                await asyncio.sleep(0.001)
+
+        return gen()
+
+
+def test_soak_churn_cancel_and_worker_death(run):
+    async def body():
+        rng = random.Random(0)
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+
+        async def spawn(tag):
+            rt = await DistributedRuntime.detached(addr)
+            eng = _SlowTokenEngine(tag)
+            await rt.namespace("soak").component("b").endpoint("g").serve(eng)
+            return rt, eng
+
+        rt_a, eng_a = await spawn("a")
+        rt_b, eng_b = await spawn("b")
+
+        caller = await DistributedRuntime.detached(addr)
+        client = await (
+            caller.namespace("soak").component("b").endpoint("g").client()
+        )
+        await client.wait_for_instances(5)
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+
+        done = {"full": 0, "cancelled": 0, "failed": 0}
+
+        async def one(i):
+            n = rng.randint(3, 12)
+            cancel_at = rng.choice([None, None, rng.randint(0, 2)])
+            try:
+                req = Context.new({"n": n})
+                stream = await router.generate(req)
+                got = 0
+                async for item in stream:
+                    got += 1
+                    if cancel_at is not None and got > cancel_at:
+                        req.ctx.stop_generating()
+                        break
+                if cancel_at is None:
+                    assert got == n, f"req {i}: {got} != {n}"
+                    done["full"] += 1
+                else:
+                    done["cancelled"] += 1
+            except Exception:
+                # in-flight requests racing the worker kill may fail; they
+                # must fail as EXCEPTIONS, not hangs or silent truncation
+                done["failed"] += 1
+
+        async def churn():
+            # mid-soak: kill worker A (lease revocation on conn drop), then
+            # bring a third worker up; the router view must follow
+            await asyncio.sleep(0.15)
+            await rt_a.shutdown()
+            await asyncio.sleep(0.1)
+            return await spawn("c")
+
+        churn_task = asyncio.create_task(churn())
+        waves = []
+        for wave in range(6):
+            waves.append(
+                asyncio.gather(*[one(wave * 25 + j) for j in range(25)])
+            )
+            await asyncio.sleep(0.06)
+        await asyncio.gather(*waves)
+        rt_c, eng_c = await churn_task
+
+        # steady state after churn: fresh requests all succeed and spread
+        # across the two live workers
+        before_b, before_c = eng_b.served, eng_c.served
+        await asyncio.gather(*[one(1000 + j) for j in range(20)])
+        assert eng_b.served > before_b and eng_c.served > before_c
+
+        total = sum(done.values())
+        assert total == 170
+        assert done["full"] + done["cancelled"] >= 150  # failures only near the kill
+        assert done["full"] > 0 and done["cancelled"] > 0
+
+        await caller.shutdown()
+        await rt_b.shutdown()
+        await rt_c.shutdown()
+        await hub.stop()
+
+    run(body())
