@@ -102,8 +102,8 @@ async def run_disagg(rs):
             DisaggConfig(max_local_prefill_length=0),  # everything ships remote
             block_size=16,
         )
-        await dns.component("backend").endpoint(KV_DELIVER_ENDPOINT).serve(
-            decode.deliver_handler()
+        await dns.component("backend").endpoint(KV_DELIVER_ENDPOINT).serve_raw(
+            decode.kv_deliver_handler()
         )
         prt = await DistributedRuntime.detached(addr)
         cleanups.append(prt.shutdown)

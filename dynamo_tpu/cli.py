@@ -443,7 +443,9 @@ async def run_worker(args) -> None:
         )
         # kv_deliver must exist before any request can be shipped remote, or
         # the prefill worker's write-back races a missing endpoint
-        await comp.endpoint(KV_DELIVER_ENDPOINT).serve(disagg.deliver_handler())
+        await comp.endpoint(KV_DELIVER_ENDPOINT).serve_raw(
+            disagg.kv_deliver_handler()
+        )
         await ep.serve(disagg)
     else:
         await ep.serve(engine)

@@ -582,6 +582,95 @@ class JaxEngine:
         finally:
             self.kv.allocator.free(pages)
 
+    async def prefill_export_batch(
+        self, reqs: List[PreprocessedRequest]
+    ) -> List[Any]:
+        """Batched :meth:`prefill_export`: one padded dispatch + one device
+        transfer for a burst of remote-prefill jobs (the prefill worker
+        drains its queue into this).  Returns one entry per request, either
+        ``(kv_blob, first_token)`` or the per-request ``Exception`` -- one
+        bad prompt must not fail its batch-mates.  Shares the dispatch site
+        with the aggregated path, preserving disagg == aggregated output."""
+        if not self._running:
+            await self.start()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._ex, self._prefill_export_batch, reqs
+        )
+
+    def _prefill_export_batch(
+        self, reqs: List[PreprocessedRequest]
+    ) -> List[Any]:
+        results: List[Any] = [None] * len(reqs)
+        valid: List[int] = []
+        for i, req in enumerate(reqs):
+            if not req.token_ids:
+                results[i] = ValueError("empty prompt")
+            else:
+                valid.append(i)
+        # group similar lengths together so one long prompt doesn't pad the
+        # whole group's bucket (the dispatch buckets to the group max)
+        valid.sort(key=lambda i: len(reqs[i].token_ids))
+        B = self.cfg.max_batch_size
+        for start in range(0, len(valid), B):
+            group = valid[start : start + B]
+            try:
+                self._export_group(reqs, group, results)
+            except Exception:  # noqa: BLE001 - page pressure / bucket overflow
+                # fall back to singles: the failure may be group-induced
+                # (scratch pages for N prompts at once) and per-item errors
+                # must land on their own request
+                for i in group:
+                    try:
+                        results[i] = self._prefill_export(reqs[i])
+                    except Exception as exc:  # noqa: BLE001
+                        results[i] = exc
+        return results
+
+    def _export_group(
+        self,
+        reqs: List[PreprocessedRequest],
+        group: List[int],
+        results: List[Any],
+    ) -> None:
+        ps = self.cfg.page_size
+        allocated: List[List[int]] = []
+        try:
+            for i in group:
+                n_pages = -(-len(reqs[i].token_ids) // ps)
+                allocated.append(self.kv.allocator.alloc(n_pages))
+        except Exception:
+            for pages in allocated:
+                self.kv.allocator.free(pages)
+            raise
+        try:
+            items = [
+                (
+                    SeqState.from_request(
+                        "export", reqs[i], self.sched.block_size
+                    ),
+                    list(reqs[i].token_ids),
+                    pages,
+                )
+                for i, pages in zip(group, allocated)
+            ]
+            Bp = min(self._pad_batch(len(items)), self.cfg.max_batch_size)
+            sampled = self._dispatch_full_prefill_batch(items, Bp)
+            all_ids = np.concatenate(
+                [np.asarray(p, np.int32) for p in allocated]
+            )
+            # one transfer for the whole group's pages
+            blob_all = np.asarray(jax.device_get(self.kv.pages[:, :, all_ids]))
+            firsts = np.asarray(jax.device_get(sampled))
+            off = 0
+            for row, (i, pages) in enumerate(zip(group, allocated)):
+                k = len(pages)
+                results[i] = (blob_all[:, :, off : off + k], int(firsts[row]))
+                off += k
+        finally:
+            for pages in allocated:
+                self.kv.allocator.free(pages)
+
     # -- metrics ------------------------------------------------------------
 
     def metrics(self) -> ForwardPassMetrics:

@@ -256,19 +256,12 @@ class Endpoint:
         # Reference subject shape: "{ns}_{comp}.{ep}-{lease_hex}"
         return f"{self.namespace}_{self.component}.{self.name}-{instance_id:x}"
 
-    async def serve(
-        self,
-        engine: AsyncEngine,
-        *,
-        metrics_handler=None,
-    ) -> Instance:
-        """Serve ``engine`` on this endpoint.
-
-        Registers the subject on the process data-plane server and writes the
-        instance key under the runtime's primary lease: lease loss removes the
-        key, and every watching client drops the instance — identical
-        liveness semantics to reference endpoint.rs:115-134.
-        """
+    async def _register(self, register_subject) -> Instance:
+        """Shared registration: bind the subject on the data-plane server
+        (via ``register_subject(subject)``) and write the instance key under
+        the runtime's primary lease: lease loss removes the key, and every
+        watching client drops the instance — identical liveness semantics to
+        reference endpoint.rs:115-134."""
         rt = self.runtime
         await rt.ensure_data_server()
         instance_id = rt.primary_lease
@@ -283,11 +276,7 @@ class Endpoint:
             port=port,
             subject=subject,
         )
-
-        stats = rt.endpoint_stats.setdefault(self.path, EndpointStats())
-        handler = _IngressHandler(engine, stats)
-        rt.data_server.register(subject, handler)
-        rt.local_engines[subject] = engine
+        register_subject(subject)
         created = await rt.hub.kv_create(
             instance.etcd_key, instance.to_json(), lease=rt.primary_lease
         )
@@ -297,6 +286,24 @@ class Endpoint:
             )
         logger.info("serving %s as instance %x at %s:%d",
                     self.path, instance_id, host, port)
+        return instance
+
+    async def serve(
+        self,
+        engine: AsyncEngine,
+        *,
+        metrics_handler=None,
+    ) -> Instance:
+        """Serve ``engine`` on this endpoint."""
+        rt = self.runtime
+        stats = rt.endpoint_stats.setdefault(self.path, EndpointStats())
+        handler = _IngressHandler(engine, stats)
+
+        def register(subject: str) -> None:
+            rt.data_server.register(subject, handler)
+            rt.local_engines[subject] = engine
+
+        instance = await self._register(register)
         # auto-serve the component's $SRV.STATS equivalent once
         comp_path = f"{self.namespace}/{self.component}"
         if self.name != STATS_ENDPOINT and comp_path not in rt._stats_served:
@@ -305,6 +312,18 @@ class Endpoint:
                 rt, self.namespace, self.component, STATS_ENDPOINT
             ).serve(EngineFn(partial(_stats_handler, rt, self.namespace)))
         return instance
+
+    async def serve_raw(self, handler) -> Instance:
+        """Serve a raw streaming byte handler (upload-capable) on this
+        endpoint.  Same discovery/lease semantics as :meth:`serve`, but the
+        handler receives ``(hdr, chunks: AsyncIterator[bytes], ctx)`` and
+        yields raw response payloads -- no JSON envelope.  This is the bulk
+        data path (disagg KV delivery); the reference's equivalent capability
+        is the NIXL transfer plane (block_manager/storage/nixl.rs)."""
+        rt = self.runtime
+        return await self._register(
+            lambda subject: rt.data_server.register_raw(subject, handler)
+        )
 
     async def client(self) -> "Client":
         c = Client(self)
@@ -529,6 +548,25 @@ class PushRouter:
         for inst in self.client.instances:
             if inst.instance_id == instance_id:
                 return await self._dispatch(inst, request)
+        raise InstanceNotFoundError(f"instance {instance_id:x} not found")
+
+    async def direct_upload(
+        self,
+        instance_id: int,
+        request_id: str,
+        meta: Dict[str, Any],
+        chunks: Any,
+        ctx,
+    ) -> AsyncIterator[bytes]:
+        """Stream a bulk upload to a specific instance's raw endpoint and
+        return its raw response iterator (the P2P KV delivery path)."""
+        for inst in self.client.instances:
+            if inst.instance_id == instance_id:
+                rt = self.client.endpoint.runtime
+                return await rt.data_client.request_upload(
+                    inst.host, inst.port, inst.subject,
+                    request_id, meta, chunks, ctx,
+                )
         raise InstanceNotFoundError(f"instance {instance_id:x} not found")
 
     async def random(self, request: Context[Any]) -> ResponseStream[Annotated]:

@@ -65,4 +65,12 @@ async def read_frame(
 def write_frame(
     writer: asyncio.StreamWriter, header: Dict[str, Any], payload: bytes = b""
 ) -> None:
-    writer.write(encode_frame(header, payload))
+    """Write one frame.  ``payload`` may be any bytes-like (memoryview
+    included): it is written as its own buffer, so multi-MB uploads aren't
+    copied into a concatenated frame first."""
+    hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    if len(hdr) > MAX_FRAME or len(payload) > MAX_FRAME:
+        raise ValueError("frame exceeds MAX_FRAME")
+    writer.write(_LEN.pack(len(hdr), len(payload)) + hdr)
+    if len(payload):
+        writer.write(payload)

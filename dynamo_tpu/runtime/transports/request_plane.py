@@ -12,6 +12,8 @@ fewer connection handshake on the hot path.
 
 Frames (two-part codec, see codec.py):
   client -> server:  {t:"req",  sid, subject, id, meta}  + request payload
+                     {t:"part", sid}  + chunk   -- upload continuation (up:true)
+                     {t:"upend", sid}           -- upload complete
                      {t:"cancel", sid, kill}
   server -> client:  {t:"ack",  sid}            -- prologue: handler accepted
                      {t:"err",  sid, msg}       -- prologue or mid-stream error
@@ -19,6 +21,16 @@ Frames (two-part codec, see codec.py):
                      {t:"end",  sid}            -- stream complete
 
 ``sid`` is a client-chosen stream id unique per connection.
+
+Bulk uploads (the disagg KV delivery path): a ``req`` frame carrying
+``up: true`` opens a client->server chunk stream for the request payload --
+the frame's own payload is the first chunk, ``part`` frames append, ``upend``
+closes.  The receiving handler must be registered raw (``register_raw``) and
+consumes chunks as they arrive, so a multi-hundred-MB KV blockset never
+materializes as one frame (frames cap at codec.MAX_FRAME) and the receive
+side can overlap assembly with the sender's socket writes.  This replaces
+the reference's NIXL one-sided RDMA leg (block_manager/storage/nixl.rs:173):
+same role -- bulk KV moves peer-to-peer, off the control plane.
 """
 
 from __future__ import annotations
@@ -44,6 +56,19 @@ ByteHandler = Callable[
     [Dict[str, Any], bytes, AsyncEngineContext], Awaitable[AsyncIterator[bytes]]
 ]
 
+# A raw streaming handler: receives the request payload as an async iterator
+# of chunks (one for plain requests, many for up:true uploads).
+RawHandler = Callable[
+    [Dict[str, Any], AsyncIterator[bytes], AsyncEngineContext],
+    Awaitable[AsyncIterator[bytes]],
+]
+
+# Bound on buffered upload chunks per stream: past this the connection read
+# loop stalls and TCP flow control pushes back on the sender.
+UPLOAD_QUEUE_DEPTH = 8
+
+_UPLOAD_END = None  # sentinel closing an upload queue
+
 
 class StreamEnd(Exception):
     pass
@@ -65,14 +90,20 @@ class DataPlaneServer:
         self.port = port
         self.advertise_host: Optional[str] = None
         self._handlers: Dict[str, ByteHandler] = {}
+        self._raw_handlers: Dict[str, RawHandler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_writers: set = set()
 
     def register(self, subject: str, handler: ByteHandler) -> None:
         self._handlers[subject] = handler
 
+    def register_raw(self, subject: str, handler: RawHandler) -> None:
+        """Register a streaming byte handler (upload-capable subjects)."""
+        self._raw_handlers[subject] = handler
+
     def unregister(self, subject: str) -> None:
         self._handlers.pop(subject, None)
+        self._raw_handlers.pop(subject, None)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -101,6 +132,7 @@ class DataPlaneServer:
         self._conn_writers.add(writer)
         send_lock = asyncio.Lock()
         live: Dict[int, AsyncEngineContext] = {}
+        uploads: Dict[int, asyncio.Queue] = {}
         tasks: set = set()  # strong refs: loop holds only weak task refs
 
         async def send(hdr: Dict[str, Any], payload: bytes = b"") -> None:
@@ -112,22 +144,50 @@ class DataPlaneServer:
                     pass
 
         async def run_stream(
-            sid: int, hdr: Dict[str, Any], payload: bytes, ctx: AsyncEngineContext
+            sid: int,
+            hdr: Dict[str, Any],
+            payload: bytes,
+            ctx: AsyncEngineContext,
+            uq: Optional[asyncio.Queue],
         ) -> None:
-            handler = self._handlers.get(hdr.get("subject", ""))
-            if handler is None:
+            subject = hdr.get("subject", "")
+            raw = self._raw_handlers.get(subject)
+            handler = self._handlers.get(subject) if raw is None else None
+            if raw is None and handler is None:
                 live.pop(sid, None)
+                uploads.pop(sid, None)
                 await send(
                     {"t": "err", "sid": sid,
-                     "msg": f"no handler for subject {hdr.get('subject')!r}"}
+                     "msg": f"no handler for subject {subject!r}"}
                 )
                 return
             try:
-                stream = await handler(hdr, payload, ctx)
+                if raw is not None:
+                    # uq is captured at req time by the read loop: the upend
+                    # frame may be processed (and the uploads entry popped)
+                    # before this task first runs
+                    async def chunk_iter() -> AsyncIterator[bytes]:
+                        if uq is None:
+                            yield payload
+                            return
+                        while True:
+                            chunk = await uq.get()
+                            if chunk is _UPLOAD_END:
+                                return
+                            yield chunk
+
+                    stream = await raw(hdr, chunk_iter(), ctx)
+                elif hdr.get("up"):
+                    raise RuntimeError(
+                        f"subject {subject!r} does not accept uploads"
+                    )
+                else:
+                    stream = await handler(hdr, payload, ctx)
             except Exception as exc:  # noqa: BLE001 - prologue error to caller
-                logger.exception("handler prologue failed for %s", hdr.get("subject"))
+                logger.exception("handler prologue failed for %s", subject)
                 await send({"t": "err", "sid": sid, "msg": str(exc)})
                 live.pop(sid, None)
+                uploads.pop(sid, None)
                 return
             await send({"t": "ack", "sid": sid})
             try:
@@ -146,6 +206,7 @@ class DataPlaneServer:
             finally:
                 ctx.set_complete()
                 live.pop(sid, None)
+                uploads.pop(sid, None)
 
         try:
             while True:
@@ -161,22 +222,76 @@ class DataPlaneServer:
                     # race past the stream it targets.
                     ctx = AsyncEngineContext(hdr.get("id"))
                     live[sid] = ctx
-                    task = asyncio.create_task(run_stream(sid, hdr, payload, ctx))
+                    uq = None
+                    if hdr.get("up"):
+                        uq = asyncio.Queue(maxsize=UPLOAD_QUEUE_DEPTH)
+                        uploads[sid] = uq
+                        if payload:
+                            uq.put_nowait(payload)  # fresh queue: has room
+                    task = asyncio.create_task(
+                        run_stream(sid, hdr, payload, ctx, uq)
+                    )
                     tasks.add(task)
                     task.add_done_callback(tasks.discard)
+                elif t in ("part", "upend"):
+                    usid0 = int(hdr["sid"])
+                    uq = (
+                        uploads.get(usid0) if t == "part"
+                        else uploads.pop(usid0, None)
+                    )
+                    if uq is not None:
+                        item = payload if t == "part" else _UPLOAD_END
+                        # Bounded queue: a slow consumer stalls this read
+                        # loop and TCP flow control reaches the uploader
+                        # (accepted HOL cost, as on the response path).  A
+                        # consumer stalled past the deadline is abandoned.
+                        try:
+                            await asyncio.wait_for(
+                                uq.put(item), ABANDONED_STREAM_TIMEOUT
+                            )
+                        except asyncio.TimeoutError:
+                            usid = int(hdr["sid"])
+                            logger.warning(
+                                "upload %s abandoned (consumer stalled "
+                                "%.0fs); dropping", usid,
+                                ABANDONED_STREAM_TIMEOUT,
+                            )
+                            uploads.pop(usid, None)
+                            uctx = live.get(usid)
+                            if uctx is not None:
+                                uctx.kill()
                 elif t == "cancel":
-                    ctx = live.get(int(hdr["sid"]))
+                    sid = int(hdr["sid"])
+                    ctx = live.get(sid)
                     if ctx is not None:
                         if hdr.get("kill"):
                             ctx.kill()
                         else:
                             ctx.stop_generating()
+                    # unblock a handler draining this stream's upload; make
+                    # room first -- the sentinel must land even on a full
+                    # queue or the handler blocks on get() forever
+                    uq = uploads.pop(sid, None)
+                    if uq is not None:
+                        if uq.full():
+                            with contextlib.suppress(asyncio.QueueEmpty):
+                                uq.get_nowait()
+                        with contextlib.suppress(asyncio.QueueFull):
+                            uq.put_nowait(_UPLOAD_END)
         except ConnectionError as exc:
             logger.warning("data-plane connection failed mid-frame: %s", exc)
         finally:
-            # Peer went away: kill all of its in-flight streams.
+            # Peer went away: kill all of its in-flight streams and unblock
+            # handlers mid-upload (their chunk iterator must terminate).
             for ctx in list(live.values()):
                 ctx.kill()
+            for uq in list(uploads.values()):
+                if uq.full():
+                    with contextlib.suppress(asyncio.QueueEmpty):
+                        uq.get_nowait()
+                with contextlib.suppress(asyncio.QueueFull):
+                    uq.put_nowait(_UPLOAD_END)
+            uploads.clear()
             self._conn_writers.discard(writer)
             with contextlib.suppress(Exception):
                 writer.close()
@@ -333,6 +448,78 @@ class _Connection:
                 {"t": "cancel", "sid": sid, "kill": ctx.is_killed()}
             )
 
+    async def request_upload(
+        self,
+        subject: str,
+        request_id: str,
+        meta: Dict[str, Any],
+        chunks: Any,
+        ctx: AsyncEngineContext,
+    ) -> AsyncIterator[bytes]:
+        """Issue an upload-stream request: send every chunk, then read the
+        response stream.  ``chunks`` is an iterable or async iterable of
+        bytes-like objects, each < codec.MAX_FRAME.
+
+        Chunks are sent eagerly (TCP flow control is the backpressure); the
+        prologue is read only after ``upend``, so a handler that assembles
+        the full payload before opening its response stream cannot deadlock
+        against a client waiting for the ack.
+        """
+        sid = next(self._sid)
+        q: asyncio.Queue = asyncio.Queue(maxsize=512)
+        self._streams[sid] = q
+        try:
+            await self.send(
+                {"t": "req", "sid": sid, "subject": subject,
+                 "id": request_id, "meta": meta, "up": True}
+            )
+            if hasattr(chunks, "__aiter__"):
+                async for chunk in chunks:
+                    await self.send({"t": "part", "sid": sid}, chunk)
+            else:
+                for chunk in chunks:
+                    await self.send({"t": "part", "sid": sid}, chunk)
+            await self.send({"t": "upend", "sid": sid})
+        except Exception:
+            self._streams.pop(sid, None)
+            raise
+
+        # Prologue: ack or err (may arrive mid-upload; the queue holds it).
+        hdr, _ = await q.get()
+        if hdr.get("t") == "err":
+            self._streams.pop(sid, None)
+            raise RemoteError(hdr.get("msg", "remote error"))
+        assert hdr.get("t") == "ack", f"bad prologue {hdr}"
+
+        async def gen() -> AsyncIterator[bytes]:
+            cancel_sent = [False]
+            watcher = asyncio.create_task(
+                self._cancel_watch(sid, ctx, cancel_sent)
+            )
+            ended = False
+            try:
+                while True:
+                    hdr, payload = await q.get()
+                    t = hdr.get("t")
+                    if t == "data":
+                        yield payload
+                    elif t == "end":
+                        ended = True
+                        return
+                    elif t == "err":
+                        ended = True
+                        raise RemoteError(hdr.get("msg", "remote error"))
+            finally:
+                watcher.cancel()
+                if not ended and ctx.is_stopped() and not cancel_sent[0]:
+                    cancel_sent[0] = True
+                    with contextlib.suppress(ConnectionError, RuntimeError):
+                        await self.send(
+                            {"t": "cancel", "sid": sid, "kill": ctx.is_killed()}
+                        )
+                self._streams.pop(sid, None)
+        return gen()
+
 
 class DataPlaneClient:
     """Connection pool: one multiplexed connection per (host, port)."""
@@ -364,6 +551,19 @@ class DataPlaneClient:
     ) -> AsyncIterator[bytes]:
         conn = await self._get(host, port)
         return await conn.request(subject, request_id, meta, payload, ctx)
+
+    async def request_upload(
+        self,
+        host: str,
+        port: int,
+        subject: str,
+        request_id: str,
+        meta: Dict[str, Any],
+        chunks: Any,
+        ctx: AsyncEngineContext,
+    ) -> AsyncIterator[bytes]:
+        conn = await self._get(host, port)
+        return await conn.request_upload(subject, request_id, meta, chunks, ctx)
 
     async def close(self) -> None:
         for conn in self._conns.values():

@@ -427,3 +427,155 @@ def test_component_stats_scrape(run):
             await hub_server.stop()
 
     run(body())
+
+
+def test_raw_endpoint_upload_stream(run):
+    """Chunked upload to a raw endpoint: the handler receives every chunk in
+    order, assembly equals the sent bytes, and the response stream carries
+    raw payloads (the P2P bulk-KV delivery primitive)."""
+
+    async def body():
+        hub_server = HubServer()
+        host, port = await hub_server.start()
+        addr = f"{host}:{port}"
+        worker = await DistributedRuntime.detached(addr)
+        received = []
+
+        async def raw_handler(hdr, chunks, ctx):
+            async def gen():
+                total = 0
+                async for chunk in chunks:
+                    received.append(bytes(chunk))
+                    total += len(chunk)
+                yield json.dumps(
+                    {"total": total, "meta": hdr.get("meta")}
+                ).encode()
+
+            return gen()
+
+        ep = worker.namespace("test").component("backend").endpoint("ingest")
+        await ep.serve_raw(raw_handler)
+
+        caller = await DistributedRuntime.detached(addr)
+        try:
+            cep = caller.namespace("test").component("backend").endpoint("ingest")
+            client = await cep.client()
+            await client.wait_for_instances(5)
+            router = PushRouter(client)
+            from dynamo_tpu.runtime.engine import AsyncEngineContext
+
+            chunks = [bytes([i]) * (100_000 + i) for i in range(5)]
+            stream = await router.direct_upload(
+                client.instances[0].instance_id,
+                "up-1",
+                {"name": "blob"},
+                iter(chunks),
+                AsyncEngineContext("up-1"),
+            )
+            acks = [json.loads(a) async for a in stream]
+            assert len(acks) == 1
+            assert acks[0]["total"] == sum(len(c) for c in chunks)
+            assert acks[0]["meta"] == {"name": "blob"}
+            assert b"".join(received) == b"".join(chunks)
+            assert len(received) == 5  # chunk boundaries preserved
+            await client.close()
+        finally:
+            await caller.shutdown()
+            await worker.shutdown()
+            await hub_server.stop()
+
+    run(body())
+
+
+def test_upload_to_json_endpoint_is_rejected(run):
+    """An up:true request to a classic (JSON-ingress) subject must fail the
+    prologue loudly, not deliver a mangled payload."""
+
+    async def body():
+        hub_server, workers, caller = await _make_distributed(1)
+        try:
+            ep = caller.namespace("test").component("backend").endpoint("generate")
+            client = await ep.client()
+            await client.wait_for_instances(5)
+            router = PushRouter(client)
+            from dynamo_tpu.runtime.engine import AsyncEngineContext
+
+            with pytest.raises(RemoteError, match="does not accept uploads"):
+                stream = await router.direct_upload(
+                    client.instances[0].instance_id,
+                    "up-2",
+                    {},
+                    iter([b"x"]),
+                    AsyncEngineContext("up-2"),
+                )
+                async for _ in stream:
+                    pass
+            await client.close()
+        finally:
+            await caller.shutdown()
+            for w in workers:
+                await w.shutdown()
+            await hub_server.stop()
+
+    run(body())
+
+
+def test_upload_interleaves_with_rpc_streams(run):
+    """A bulk upload and a normal RPC multiplexed on the same connection must
+    not corrupt each other (frames interleave per-chunk)."""
+
+    async def body():
+        hub_server = HubServer()
+        host, port = await hub_server.start()
+        addr = f"{host}:{port}"
+        worker = await DistributedRuntime.detached(addr)
+        ns = worker.namespace("test").component("backend")
+        await ns.endpoint("generate").serve(TokenEngine())
+        got = bytearray()
+
+        async def raw_handler(hdr, chunks, ctx):
+            async def gen():
+                async for chunk in chunks:
+                    got.extend(chunk)
+                    await asyncio.sleep(0)  # let other frames interleave
+                yield b"done"
+
+            return gen()
+
+        await ns.endpoint("ingest").serve_raw(raw_handler)
+
+        caller = await DistributedRuntime.detached(addr)
+        try:
+            cns = caller.namespace("test").component("backend")
+            gen_client = await cns.endpoint("generate").client()
+            ing_client = await cns.endpoint("ingest").client()
+            await gen_client.wait_for_instances(5)
+            await ing_client.wait_for_instances(5)
+            from dynamo_tpu.runtime.engine import AsyncEngineContext
+
+            async def do_upload():
+                chunks = [b"z" * 50_000 for _ in range(20)]
+                stream = await PushRouter(ing_client).direct_upload(
+                    ing_client.instances[0].instance_id,
+                    "up-3", {}, iter(chunks), AsyncEngineContext("up-3"),
+                )
+                return [a async for a in stream]
+
+            async def do_rpc():
+                stream = await PushRouter(gen_client).generate(
+                    Context.new({"n": 50})
+                )
+                return [it.data["i"] async for it in stream]
+
+            acks, tokens = await asyncio.gather(do_upload(), do_rpc())
+            assert acks == [b"done"]
+            assert tokens == list(range(50))
+            assert len(got) == 20 * 50_000 and set(got) == {ord("z")}
+            await gen_client.close()
+            await ing_client.close()
+        finally:
+            await caller.shutdown()
+            await worker.shutdown()
+            await hub_server.stop()
+
+    run(body())
