@@ -58,6 +58,14 @@ def _add_engine_flags(p) -> None:
                    help="directory for G3 disk offload files")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree (shards over local devices)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel degree (decode batch sharded over dp)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel degree (ring-attention prefill)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel degree (microbatched prefill)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree (MoE experts sharded)")
     # multi-host engine bootstrap (jax.distributed; env DYN_NUM_NODES /
     # DYN_NODE_RANK / DYN_LEADER_ADDR also work)
     p.add_argument("--num-nodes", type=int, default=None,
@@ -250,26 +258,33 @@ async def _make_engine(args):
     if args.leader_addr is not None:
         mn.leader_addr = args.leader_addr
     initialize_multihost(mn)  # must precede the first jax backend touch
-    if args.tp > 1:
+    mesh_cfg = None
+    if max(args.tp, args.dp, args.sp, args.pp, args.ep) > 1:
+        from .parallel.mesh import MeshConfig
+
+        mesh_cfg = MeshConfig(
+            dp=args.dp, tp=args.tp, pp=args.pp, sp=args.sp, ep=args.ep
+        )
+    if mesh_cfg is not None:
         import jax
-        from jax.sharding import NamedSharding
 
         from .engine.config import ModelConfig
-        from .engine.weights import load_safetensors_params
-        from .parallel.mesh import MeshConfig, build_mesh
-        from .parallel.sharding import kv_pspec, param_shardings
+        from .parallel.mesh import build_mesh
 
         devices = jax.devices()
-        if len(devices) < args.tp:
-            raise SystemExit(f"--tp {args.tp} but only {len(devices)} devices")
-        mesh = build_mesh(MeshConfig(tp=args.tp), devices[: args.tp])
-        model_cfg = ModelConfig.from_pretrained(args.model_path)
-        params = load_safetensors_params(
-            args.model_path, model_cfg,
-            shardings=param_shardings(model_cfg, mesh),
-        )
-        kv_sharding = NamedSharding(mesh, kv_pspec(model_cfg))
-        return JaxEngine(model_cfg, params, cfg, kv_sharding=kv_sharding)
+        if len(devices) < mesh_cfg.num_devices:
+            raise SystemExit(
+                f"mesh dp={args.dp} tp={args.tp} pp={args.pp} sp={args.sp} "
+                f"ep={args.ep} needs {mesh_cfg.num_devices} devices, have "
+                f"{len(devices)}"
+            )
+        if args.dp > 1 and args.max_batch_size % args.dp:
+            raise SystemExit(
+                f"--max-batch-size {args.max_batch_size} must be divisible "
+                f"by --dp {args.dp} (batch lanes shard over dp)"
+            )
+        mesh = build_mesh(mesh_cfg, devices[: mesh_cfg.num_devices])
+        return JaxEngine.from_pretrained(args.model_path, cfg, mesh=mesh)
     return JaxEngine.from_pretrained(args.model_path, cfg)
 
 

@@ -68,6 +68,42 @@ def decode_attention_dispatch(
     return paged_decode_attention(q, layer_kv, page_table, kv_lens, window)
 
 
+def _pallas_prefill_enabled(T: int, Hq: int, Hkv: int, D: int) -> bool:
+    """Trace-time choice of the prefill-attention backend.
+
+    ``DYN_PALLAS_PREFILL=1/0`` forces it; default is auto -- on when the
+    backend is a TPU, the GQA group divides cleanly, and the sequence is
+    long enough that score materialization dominates (the flash win).  The
+    XLA path stays as the universal fallback."""
+    env = os.environ.get("DYN_PALLAS_PREFILL")
+    if env is not None:
+        return env not in ("0", "false", "")
+    if T < 128 or Hq % Hkv or D % 8:
+        return False
+    try:
+        return any("TPU" in d.device_kind for d in jax.devices())
+    except Exception:
+        return False
+
+
+def prefill_attention_dispatch(
+    q: jax.Array,  # [B, T, Hq, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,  # [B, T, Hkv, D]
+    seq_lens: jax.Array,  # [B]
+    window: int = 0,
+) -> jax.Array:
+    """Prefill attention: Pallas flash kernel on TPU, XLA einsum elsewhere.
+    Resolved at trace time, so each compiled executable embeds exactly one
+    backend (same pattern as decode_attention_dispatch)."""
+    B, T, Hq, D = q.shape
+    if _pallas_prefill_enabled(T, Hq, k.shape[2], D):
+        from ..ops.flash_prefill import flash_prefill_attention
+
+        return flash_prefill_attention(q, k, v, seq_lens, window)
+    return prefill_attention(q, k, v, seq_lens, window)
+
+
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     """[.., kv_heads, d] -> [.., kv_heads * n_rep, d] (GQA expansion)."""
     if n_rep == 1:

@@ -149,11 +149,29 @@ class JaxEngine:
         params: Params,
         cfg: Optional[EngineConfig] = None,
         kv_sharding: Optional[jax.sharding.Sharding] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
     ) -> None:
         _enable_compilation_cache()
         self.model_cfg = model_cfg
         self.cfg = cfg or EngineConfig()
         self.params = params
+        # Serving-integrated parallelism (VERDICT r3 #2): a dp/tp/pp/sp/ep
+        # mesh makes every dispatch GSPMD-sharded -- batch arrays placed
+        # over ``dp``, params/KV over ``tp``/``ep`` (the caller shards them
+        # at load), and long full prefills route through ring (sp) or
+        # pipeline (pp) step functions.  Reference capability: engines.rs:43
+        # MultiNodeConfig + dynamo-run flags.rs:82-100.
+        self.mesh = mesh
+        self._dp = int(mesh.shape.get("dp", 1)) if mesh is not None else 1
+        self._sp = int(mesh.shape.get("sp", 1)) if mesh is not None else 1
+        self._pp = int(mesh.shape.get("pp", 1)) if mesh is not None else 1
+        if mesh is not None and kv_sharding is None:
+            from ..parallel.sharding import kv_pspec
+
+            kv_sharding = jax.sharding.NamedSharding(mesh, kv_pspec(model_cfg))
+        # counters: how many prefill dispatches took the sp/pp route
+        self.sp_prefills = 0
+        self.pp_prefills = 0
         # KV event sink: fn(event_dict) -- wired to the router event publisher
         self.kv_event_sink: Optional[Callable[[Dict[str, Any]], None]] = None
         block_size = self.cfg.block_size or self.cfg.page_size
@@ -263,19 +281,32 @@ class JaxEngine:
         model_cfg: ModelConfig,
         cfg: Optional[EngineConfig] = None,
         seed: int = 0,
+        mesh: Optional[jax.sharding.Mesh] = None,
     ) -> "JaxEngine":
         params = init_params(model_cfg, jax.random.PRNGKey(seed))
-        return cls(model_cfg, params, cfg)
+        if mesh is not None:
+            from ..parallel.sharding import shard_params
+
+            params = shard_params(params, model_cfg, mesh)
+        return cls(model_cfg, params, cfg, mesh=mesh)
 
     @classmethod
     def from_pretrained(
-        cls, model_path: str, cfg: Optional[EngineConfig] = None
+        cls,
+        model_path: str,
+        cfg: Optional[EngineConfig] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
     ) -> "JaxEngine":
         from .weights import load_safetensors_params
 
         model_cfg = ModelConfig.from_pretrained(model_path)
-        params = load_safetensors_params(model_path, model_cfg)
-        return cls(model_cfg, params, cfg)
+        shardings = None
+        if mesh is not None:
+            from ..parallel.sharding import param_shardings
+
+            shardings = param_shardings(model_cfg, mesh)
+        params = load_safetensors_params(model_path, model_cfg, shardings=shardings)
+        return cls(model_cfg, params, cfg, mesh=mesh)
 
     async def start(self) -> None:
         if self._running:
@@ -543,7 +574,13 @@ class JaxEngine:
         if bucket > n_pages:
             pad = [(0, 0)] * blob.ndim
             pad[2] = (0, bucket - n_pages)
-            padded = np.pad(blob, pad)
+            # a device-resident blob (same-process delivery) pads on device;
+            # np.pad would silently pull it to host and re-upload
+            padded = (
+                jnp.pad(blob, pad)
+                if isinstance(blob, jax.Array)
+                else np.pad(blob, pad)
+            )
         self.kv.pages = scatter_block_pages(
             self.kv.pages, jnp.asarray(ids), jnp.asarray(padded)
         )
@@ -583,23 +620,30 @@ class JaxEngine:
             self.kv.allocator.free(pages)
 
     async def prefill_export_batch(
-        self, reqs: List[PreprocessedRequest]
+        self, reqs: List[PreprocessedRequest], device: bool = False
     ) -> List[Any]:
         """Batched :meth:`prefill_export`: one padded dispatch + one device
         transfer for a burst of remote-prefill jobs (the prefill worker
         drains its queue into this).  Returns one entry per request, either
         ``(kv_blob, first_token)`` or the per-request ``Exception`` -- one
         bad prompt must not fail its batch-mates.  Shares the dispatch site
-        with the aggregated path, preserving disagg == aggregated output."""
+        with the aggregated path, preserving disagg == aggregated output.
+
+        ``device=True`` keeps the KV blobs device-resident (jax arrays) for
+        same-process delivery into a colocated decode engine -- the TPU
+        equivalent of the reference's NIXL device-to-device DMA
+        (block_manager/storage/nixl.rs:173): the blob never transits the
+        host.  Only the sampled first tokens come back (one tiny
+        transfer)."""
         if not self._running:
             await self.start()
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            self._ex, self._prefill_export_batch, reqs
+            self._ex, self._prefill_export_batch, reqs, device
         )
 
     def _prefill_export_batch(
-        self, reqs: List[PreprocessedRequest]
+        self, reqs: List[PreprocessedRequest], device: bool = False
     ) -> List[Any]:
         results: List[Any] = [None] * len(reqs)
         valid: List[int] = []
@@ -615,7 +659,7 @@ class JaxEngine:
         for start in range(0, len(valid), B):
             group = valid[start : start + B]
             try:
-                self._export_group(reqs, group, results)
+                self._export_group(reqs, group, results, device)
             except Exception:  # noqa: BLE001 - page pressure / bucket overflow
                 # fall back to singles: the failure may be group-induced
                 # (scratch pages for N prompts at once) and per-item errors
@@ -632,6 +676,7 @@ class JaxEngine:
         reqs: List[PreprocessedRequest],
         group: List[int],
         results: List[Any],
+        device: bool = False,
     ) -> None:
         ps = self.cfg.page_size
         allocated: List[List[int]] = []
@@ -659,8 +704,16 @@ class JaxEngine:
             all_ids = np.concatenate(
                 [np.asarray(p, np.int32) for p in allocated]
             )
-            # one transfer for the whole group's pages
-            blob_all = np.asarray(jax.device_get(self.kv.pages[:, :, all_ids]))
+            if device:
+                # device-resident export: the gather materializes a copy of
+                # the group's pages on device (freeing the scratch pages
+                # below is safe), and only the first tokens come to host
+                blob_all = self.kv.pages[:, :, jnp.asarray(all_ids)]
+            else:
+                # one transfer for the whole group's pages
+                blob_all = np.asarray(
+                    jax.device_get(self.kv.pages[:, :, all_ids])
+                )
             firsts = np.asarray(jax.device_get(sampled))
             off = 0
             for row, (i, pages) in enumerate(zip(group, allocated)):
@@ -934,9 +987,9 @@ class JaxEngine:
             top_p[i] = so.top_p if so.top_p is not None else 1.0
             top_k[i] = so.top_k or 0
         return SamplingParams(
-            temperature=jnp.asarray(temp),
-            top_p=jnp.asarray(top_p),
-            top_k=jnp.asarray(top_k),
+            temperature=self._put_batch(temp),
+            top_p=self._put_batch(top_p),
+            top_k=self._put_batch(top_k),
         )
 
     @staticmethod
@@ -961,6 +1014,22 @@ class JaxEngine:
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    def _put_batch(self, arr: np.ndarray) -> jax.Array:
+        """Place a batch-major host array: sharded over ``dp`` on a mesh
+        (when the leading dim divides), plain transfer otherwise.  Explicit
+        placement keeps GSPMD from replicating per-lane compute across the
+        dp groups."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharding import _compatible_spec
+
+        spec = _compatible_spec(
+            P(*(["dp"] + [None] * (arr.ndim - 1))), arr.shape, self.mesh
+        )
+        return jax.device_put(np.asarray(arr), NamedSharding(self.mesh, spec))
 
     @staticmethod
     def _pad_batch(n: int) -> int:
@@ -995,17 +1064,80 @@ class JaxEngine:
             k = min(len(pages), n_pages)
             page_table[i, :k] = pages[:k]
             seqs[i] = seq
+        routed = self._dispatch_parallel_prefill(
+            tokens, lens, page_table, seqs, bucket
+        )
+        if routed is not None:
+            return routed
         sampled, self.kv.pages = prefill_and_sample(
             self.params,
             self.model_cfg,
             self.kv.pages,
-            jnp.asarray(tokens),
-            jnp.asarray(lens),
-            jnp.asarray(page_table),
+            self._put_batch(tokens),
+            self._put_batch(lens),
+            self._put_batch(page_table),
             self._next_rng(),
             self._sampling_arrays(seqs),
         )
         return sampled
+
+    def _dispatch_parallel_prefill(
+        self,
+        tokens: np.ndarray,
+        lens: np.ndarray,
+        page_table: np.ndarray,
+        seqs: List[Optional[SeqState]],
+        bucket: int,
+    ) -> Optional[jax.Array]:
+        """Route a full prefill through ring attention (sp) or pipeline (pp)
+        when the serving mesh has those axes and the shapes qualify; returns
+        the sampled first tokens, or None to take the plain GSPMD path.
+
+        sp wins when both axes exist (one dispatch can't compose both shard
+        maps; sequence parallelism is the long-context lever, SURVEY.md 5.7).
+        Shape guards mirror the step functions' own: ring needs the bucket
+        divisible by sp and no sliding window; pp needs the layer count
+        divisible by pp and the batch divisible by the microbatch count."""
+        if self.mesh is None or (self._sp <= 1 and self._pp <= 1):
+            return None
+        Bp = tokens.shape[0]
+        use_sp = (
+            self._sp > 1
+            and bucket % self._sp == 0
+            and not self.model_cfg.sliding_window
+        )
+        use_pp = (
+            not use_sp
+            and self._pp > 1
+            and self.model_cfg.num_layers % self._pp == 0
+            and Bp % min(self._pp, Bp) == 0
+        )
+        if not use_sp and not use_pp:
+            return None
+        from .step import sample_step
+
+        if use_sp:
+            from ..parallel.ring_attention import ring_prefill_step
+
+            logits, self.kv.pages = ring_prefill_step(
+                self.params, self.model_cfg, self.kv.pages,
+                self._put_batch(tokens), self._put_batch(lens),
+                self._put_batch(page_table), self.mesh,
+            )
+            self.sp_prefills += 1
+        else:
+            from ..parallel.pipeline_parallel import pp_prefill_step
+
+            logits, self.kv.pages = pp_prefill_step(
+                self.params, self.model_cfg, self.kv.pages,
+                self._put_batch(tokens), self._put_batch(lens),
+                self._put_batch(page_table), self.mesh,
+                num_microbatches=min(self._pp, Bp),
+            )
+            self.pp_prefills += 1
+        return sample_step(
+            logits, self._next_rng(), self._sampling_arrays(seqs)
+        )
 
     def _dispatch_full_prefill(
         self, seq: SeqState, prompt: List[int], pages: List[int]
@@ -1048,11 +1180,11 @@ class JaxEngine:
             self.params,
             self.model_cfg,
             self.kv.pages,
-            jnp.asarray(tokens),
-            jnp.asarray(offsets),
-            jnp.asarray(suffix_lens),
-            jnp.asarray(prefix_table),
-            jnp.asarray(suffix_table),
+            self._put_batch(tokens),
+            self._put_batch(offsets),
+            self._put_batch(suffix_lens),
+            self._put_batch(prefix_table),
+            self._put_batch(suffix_table),
             self._next_rng(),
             self._sampling_arrays(seqs),
         )
@@ -1123,11 +1255,11 @@ class JaxEngine:
             self.params,
             self.model_cfg,
             self.kv.pages,
-            jnp.asarray(tokens),
-            jnp.asarray([start], np.int32),
-            jnp.asarray([suffix_len], np.int32),
-            jnp.asarray(prefix_table),
-            jnp.asarray(suffix_table),
+            self._put_batch(tokens),
+            self._put_batch(np.asarray([start], np.int32)),
+            self._put_batch(np.asarray([suffix_len], np.int32)),
+            self._put_batch(prefix_table),
+            self._put_batch(suffix_table),
             self._next_rng(),
             self._sampling_arrays([seq]),
         )
@@ -1356,8 +1488,8 @@ class JaxEngine:
             # _revive_paused_lanes marking them dirty.
             limit = self._compute_limits()
             # numpy copy for the same aliasing reason as _push_device_state
-            self._dev["page_table"] = jnp.asarray(sched.page_table.copy())
-            self._dev["limit_lens"] = jnp.asarray(limit)
+            self._dev["page_table"] = self._put_batch(sched.page_table.copy())
+            self._dev["limit_lens"] = self._put_batch(limit)
             self._dev_growth = sched.growth_version
             self._limit_host = limit
 
@@ -1394,12 +1526,12 @@ class JaxEngine:
         # pages alive.  The .copy() is owned by JAX alone, so aliasing it is
         # safe.
         self._dev = {
-            "tokens": jnp.asarray(sched.tokens.copy()),
-            "seq_lens": jnp.asarray(sched.seq_lens.copy()),
-            "limit_lens": jnp.asarray(limit),
-            "active": jnp.asarray(active),
-            "stop_ids": jnp.asarray(stop_ids),
-            "page_table": jnp.asarray(sched.page_table.copy()),
+            "tokens": self._put_batch(sched.tokens.copy()),
+            "seq_lens": self._put_batch(sched.seq_lens.copy()),
+            "limit_lens": self._put_batch(limit),
+            "active": self._put_batch(active),
+            "stop_ids": self._put_batch(stop_ids),
+            "page_table": self._put_batch(sched.page_table.copy()),
             "sampling": self._sampling_arrays(list(sched.slots)),
         }
         # mirrors hold a placeholder for lanes whose prefilled first token is

@@ -44,7 +44,9 @@ def prefill_step(
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
     def attn_fn(q, k, v, kv, layer):
-        out = att.prefill_attention(q, k, v, seq_lens, cfg.sliding_window or 0)
+        out = att.prefill_attention_dispatch(
+            q, k, v, seq_lens, cfg.sliding_window or 0
+        )
         new_kv = att.write_prefill_kv(kv, k, v, page_table, layer)
         return out, new_kv
 
@@ -225,7 +227,9 @@ def embed_step(
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
     def attn_fn(q, k, v, kv, layer):
-        out = att.prefill_attention(q, k, v, seq_lens, cfg.sliding_window or 0)
+        out = att.prefill_attention_dispatch(
+            q, k, v, seq_lens, cfg.sliding_window or 0
+        )
         return out, kv
 
     hidden, _ = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)

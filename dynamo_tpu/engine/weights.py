@@ -91,7 +91,15 @@ def assemble_params(
     def put(path: str, arr: np.ndarray) -> jax.Array:
         x = jnp.asarray(arr, dtype=dtype)
         if shardings and path in shardings:
-            x = jax.device_put(x, shardings[path])
+            sh = shardings[path]
+            # mesh axes that don't divide the dim fall back to replication
+            # for that tensor (same rule as sharding.shard_params -- e.g. a
+            # vocab or kv-head count the tp degree doesn't divide)
+            if hasattr(sh, "spec") and hasattr(sh, "mesh"):
+                from ..parallel.sharding import _compatible_spec
+
+                sh = type(sh)(sh.mesh, _compatible_spec(sh.spec, x.shape, sh.mesh))
+            x = jax.device_put(x, sh)
         return x
 
     def stack(path: str, layer_fn: Callable[[int], np.ndarray]) -> jax.Array:

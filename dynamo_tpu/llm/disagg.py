@@ -33,6 +33,7 @@ import contextlib
 import json
 import logging
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Dict, Iterator, Optional
 
@@ -55,6 +56,23 @@ KV_CHUNK_BYTES = 8 * 1024 * 1024
 # per window instead of one per long request (the depth only gates a
 # heuristic ship/local decision; sub-window staleness is harmless).
 DEPTH_CACHE_TTL_S = 0.25
+
+# Process-local decode-engine registry for same-process delivery: when the
+# prefill worker and a decode worker share one process (one-host serving,
+# colocated engine pairs), the KV blob is handed over as a device-resident
+# array -- zero host transit, the TPU analog of NIXL's device-to-device DMA
+# (reference block_manager/storage/nixl.rs:173).  Keyed by (hub identity,
+# namespace, component, instance) so two hubs in one process cannot collide;
+# weak values so a stopped decode engine drops out instead of pinning.
+_LOCAL_DECODE: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+def _local_key(namespace: Namespace, component: str, instance_id: int):
+    hub = namespace.runtime.hub
+    hub_id = (getattr(hub, "host", None), getattr(hub, "port", None))
+    if hub_id == (None, None):
+        hub_id = id(hub)  # static mode: the hub object is the identity
+    return (hub_id, namespace.name, component, int(instance_id))
 
 
 @dataclass
@@ -149,6 +167,10 @@ class DisaggDecodeEngine:
         self.local_prefills = 0
         self._depth_at = -1e9  # monotonic time of the last depth fetch
         self._depth = 0
+        # same-process delivery fast path (see _LOCAL_DECODE)
+        _LOCAL_DECODE[
+            _local_key(namespace, component_name, instance_id)
+        ] = engine
 
     async def _queue_depth(self) -> int:
         """Queue depth with a short-TTL cache: the ship/local heuristic
@@ -292,12 +314,15 @@ class PrefillWorker:
         engine,
         namespace: Namespace,
         max_batch: int = 8,
+        allow_local: bool = True,
     ) -> None:
         self.engine = engine
         self.namespace = namespace
         self.queue = PrefillQueue(namespace)
         self.max_batch = max_batch
+        self.allow_local = allow_local  # same-process device handoff opt-out
         self.prefills_done = 0
+        self.local_deliveries = 0  # same-process device handoffs
         self._task: Optional[asyncio.Task] = None
         self._clients: Dict[str, PushRouter] = {}
 
@@ -338,6 +363,17 @@ class PrefillWorker:
                 # the loop hot re-raising the same error
                 await asyncio.sleep(0.5)
 
+    def _local_engine(self, msg: Dict[str, Any]):
+        if not self.allow_local:
+            return None
+        return _LOCAL_DECODE.get(
+            _local_key(
+                self.namespace,
+                msg["decode_component"],
+                int(msg["decode_instance"]),
+            )
+        )
+
     async def _process_batch(self, batch: list) -> None:
         # per-item decode: one malformed queue item must fail alone, not
         # discard its batch-mates (their lanes would ride out the delivery
@@ -345,16 +381,25 @@ class PrefillWorker:
         parsed: list = []
         for msg in batch:
             try:
+                # validate the return address too: _deliver and the locality
+                # probe both dereference it, and one malformed item must not
+                # abort the batch
+                _ = (msg["decode_component"], int(msg["decode_instance"]))
                 parsed.append(PreprocessedRequest.from_dict(msg["request"]))
             except Exception as e:  # noqa: BLE001
                 logger.exception("malformed prefill queue item")
                 parsed.append(e)
         good = [i for i, p in enumerate(parsed) if not isinstance(p, Exception)]
         results: list = list(parsed)
+        # device-resident export when every target decode engine lives in
+        # this process (colocated serving): the blob never touches the host
+        all_local = bool(good) and all(
+            self._local_engine(batch[i]) is not None for i in good
+        )
         if good:
             try:
                 exported = await self.engine.prefill_export_batch(
-                    [parsed[i] for i in good]
+                    [parsed[i] for i in good], device=all_local
                 )
             except Exception as e:  # noqa: BLE001 - engine-wide failure
                 logger.exception("prefill_export_batch failed")
@@ -377,6 +422,10 @@ class PrefillWorker:
             # tell the decode worker so its parked lane fails immediately
             # (the decode-side timeout is only the backstop for lost items)
             logger.error("prefill failed for request %s: %s", rid, result)
+            local = self._local_engine(msg)
+            if local is not None:
+                local.fail_external(rid, str(result))
+                return
             try:
                 await self._upload(
                     msg, {"request_id": rid, "error": str(result)}, iter(())
@@ -388,17 +437,29 @@ class PrefillWorker:
                 )
             return
         blob, first = result
-        meta = {
-            "request_id": rid,
-            "dtype": str(blob.dtype),
-            "shape": list(blob.shape),
-            "first_token": int(first),
-        }
-        try:
-            await self._upload(msg, meta, _blob_chunks(blob))
-        except Exception:
-            logger.exception("KV delivery failed for request %s", rid)
-            raise
+        local = self._local_engine(msg)
+        if local is not None and not isinstance(blob, np.ndarray):
+            # same-process handoff: the device-resident blob goes straight
+            # into the decode engine's delivery queue; the scatter is a
+            # device-to-device copy at its next tick
+            self.local_deliveries += 1
+            local.deliver_external(rid, blob, int(first))
+        else:
+            meta = {
+                "request_id": rid,
+                "dtype": str(blob.dtype),
+                "shape": list(blob.shape),
+                "first_token": int(first),
+            }
+            if not isinstance(blob, np.ndarray):
+                # mixed batch: a device export targeting a remote decode
+                # worker still ships over the wire
+                blob = np.asarray(blob)
+            try:
+                await self._upload(msg, meta, _blob_chunks(blob))
+            except Exception:
+                logger.exception("KV delivery failed for request %s", rid)
+                raise
         self.prefills_done += 1
         logger.info(
             "prefilled %d tokens for %s -> %s/%d",

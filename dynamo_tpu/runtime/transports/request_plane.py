@@ -468,11 +468,13 @@ class _Connection:
         sid = next(self._sid)
         q: asyncio.Queue = asyncio.Queue(maxsize=512)
         self._streams[sid] = q
+        req_sent = False
         try:
             await self.send(
                 {"t": "req", "sid": sid, "subject": subject,
                  "id": request_id, "meta": meta, "up": True}
             )
+            req_sent = True
             if hasattr(chunks, "__aiter__"):
                 async for chunk in chunks:
                     await self.send({"t": "part", "sid": sid}, chunk)
@@ -482,6 +484,14 @@ class _Connection:
             await self.send({"t": "upend", "sid": sid})
         except Exception:
             self._streams.pop(sid, None)
+            if req_sent:
+                # a chunk-source failure with a healthy connection (e.g. the
+                # blob iterator raised) must not leave the server's raw
+                # handler blocked on its chunk queue forever: kill the
+                # half-sent stream so its byte-count check fails fast
+                with contextlib.suppress(Exception):
+                    await self.send({"t": "cancel", "sid": sid, "kill": True})
+                    await self.send({"t": "upend", "sid": sid})
             raise
 
         # Prologue: ack or err (may arrive mid-upload; the queue holds it).

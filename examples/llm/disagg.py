@@ -48,8 +48,8 @@ async def main():
         DisaggConfig(max_local_prefill_length=4), block_size=4,
     )
     await dns.component("backend").endpoint("generate").serve(decode)
-    await dns.component("backend").endpoint(KV_DELIVER_ENDPOINT).serve(
-        decode.deliver_handler()
+    await dns.component("backend").endpoint(KV_DELIVER_ENDPOINT).serve_raw(
+        decode.kv_deliver_handler()
     )
 
     # prefill worker pool (same weights: same seed)

@@ -236,7 +236,9 @@ def test_disagg_end_to_end_matches_aggregated(run):
         prt = await DistributedRuntime.detached(addr)
         pns = prt.namespace("disagg")
         prefill_engine = make_engine()
-        pw = PrefillWorker(prefill_engine, pns)
+        # pin the network path: both workers share this test process, and
+        # the same-process device handoff would bypass the wire under test
+        pw = PrefillWorker(prefill_engine, pns, allow_local=False)
         await pw.start()
 
         # caller
@@ -278,6 +280,66 @@ def test_disagg_end_to_end_matches_aggregated(run):
             await decode_engine.stop()
             await gen_client.close()
             for rt in (drt, prt, crt):
+                await rt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_local_device_delivery_matches_aggregated(run):
+    """Colocated decode + prefill workers (one process, same hub) hand the
+    KV over device-to-device -- no wire upload, identical greedy output."""
+
+    async def body():
+        long_prompt = [7, 3, 7, 3, 5, 5, 9, 1, 2, 8, 4, 6]
+        agg = make_engine()
+        try:
+            expect, _ = await collect(agg, req(long_prompt, max_tokens=6))
+        finally:
+            await agg.stop()
+
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        drt = await DistributedRuntime.detached(addr)
+        dns = drt.namespace("disagg")
+        decode_engine = make_engine()
+        disagg = DisaggDecodeEngine(
+            decode_engine, dns, "decode", instance_id=drt.primary_lease,
+            cfg=DisaggConfig(max_local_prefill_length=8), block_size=4,
+        )
+        await dns.component("decode").endpoint(KV_DELIVER_ENDPOINT).serve_raw(
+            disagg.kv_deliver_handler()
+        )
+        prt = await DistributedRuntime.detached(addr)
+        prefill_engine = make_engine()
+        pw = PrefillWorker(prefill_engine, prt.namespace("disagg"))
+        uploads = []
+        orig_upload = pw._upload
+
+        async def spy_upload(msg, meta, chunks):
+            uploads.append(meta)
+            return await orig_upload(msg, meta, chunks)
+
+        pw._upload = spy_upload
+        await pw.start()
+        try:
+            from dynamo_tpu.runtime.engine import Context
+
+            ctx = Context.new(req(long_prompt, max_tokens=6).to_dict())
+            stream = await disagg.generate(ctx)
+            toks = []
+            async for item in stream:
+                assert not item.is_error(), item.error_message()
+                toks.extend((item.data or {}).get("token_ids") or [])
+            assert toks == expect
+            assert pw.local_deliveries == 1
+            assert uploads == []  # the wire was never touched
+        finally:
+            await pw.stop()
+            await prefill_engine.stop()
+            await decode_engine.stop()
+            for rt in (drt, prt):
                 await rt.shutdown()
             await hub.stop()
 

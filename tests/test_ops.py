@@ -155,3 +155,92 @@ def test_v2_falls_back_when_group_indivisible():
         q, kv, pt, kv_lens, 0, 0, 2, interpret=True
     )
     assert float(jnp.max(jnp.abs(ref - got))) < 1e-5
+
+
+# -- flash prefill kernel ----------------------------------------------------
+
+from dynamo_tpu.ops.flash_prefill import flash_prefill_attention
+
+
+def _mk_prefill(B, T, Hq, Hkv, D, seed=0, dtype=jnp.float32):
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(B, T, Hq, D), dtype)
+    k = jnp.asarray(rs.randn(B, T, Hkv, D), dtype)
+    v = jnp.asarray(rs.randn(B, T, Hkv, D), dtype)
+    return q, k, v
+
+
+def _valid_mask(T, lens):
+    """[B, T, 1, 1] -- only rows below seq_len carry defined outputs (the
+    kernel zeroes fully-masked rows; the XLA path averages -inf scores)."""
+    return (np.arange(T)[None, :] < np.asarray(lens)[:, None])[:, :, None, None]
+
+
+@pytest.mark.parametrize(
+    "B,T,Hq,Hkv,D,lens,bq,bk",
+    [
+        (2, 16, 4, 4, 16, [16, 9], 8, 8),      # MHA, partial lane
+        (2, 32, 8, 2, 64, [32, 5], 16, 16),    # GQA n_rep=4
+        (1, 64, 32, 4, 64, [64], 32, 32),      # TinyLlama heads
+        (3, 16, 4, 2, 32, [16, 1, 0], 16, 16), # single block + dead lane
+        (1, 32, 4, 2, 32, [20], 8, 16),        # BQ != BK
+    ],
+)
+def test_flash_prefill_matches_xla(B, T, Hq, Hkv, D, lens, bq, bk):
+    q, k, v = _mk_prefill(B, T, Hq, Hkv, D)
+    seq_lens = jnp.asarray(lens, jnp.int32)
+    ref = att.prefill_attention(q, k, v, seq_lens)
+    got = flash_prefill_attention(
+        q, k, v, seq_lens, block_q=bq, block_k=bk, interpret=True
+    )
+    m = _valid_mask(T, lens)
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * m
+    assert float(diff.max()) < 1e-5
+
+
+@pytest.mark.parametrize("window", [4, 8, 16])
+def test_flash_prefill_sliding_window(window):
+    B, T, Hq, Hkv, D = 2, 32, 8, 2, 32
+    q, k, v = _mk_prefill(B, T, Hq, Hkv, D, seed=3)
+    seq_lens = jnp.asarray([32, 17], jnp.int32)
+    ref = att.prefill_attention(q, k, v, seq_lens, window)
+    got = flash_prefill_attention(
+        q, k, v, seq_lens, window, block_q=8, block_k=8, interpret=True
+    )
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * _valid_mask(T, [32, 17])
+    assert float(diff.max()) < 1e-5
+
+
+def test_flash_prefill_bf16():
+    B, T, Hq, Hkv, D = 2, 32, 4, 2, 32
+    q, k, v = _mk_prefill(B, T, Hq, Hkv, D, seed=5, dtype=jnp.bfloat16)
+    seq_lens = jnp.asarray([32, 11], jnp.int32)
+    ref = att.prefill_attention(q, k, v, seq_lens).astype(jnp.float32)
+    got = flash_prefill_attention(
+        q, k, v, seq_lens, block_q=16, block_k=16, interpret=True
+    ).astype(jnp.float32)
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * _valid_mask(T, [32, 11])
+    assert float(diff.max()) < 0.06  # bf16 probs @ V accumulation
+
+
+def test_flash_prefill_indivisible_T_degrades_to_single_block():
+    B, T, Hq, Hkv, D = 1, 24, 4, 4, 16  # 24 % 16 != 0 -> one T-block
+    q, k, v = _mk_prefill(B, T, Hq, Hkv, D, seed=7)
+    seq_lens = jnp.asarray([24], jnp.int32)
+    ref = att.prefill_attention(q, k, v, seq_lens)
+    got = flash_prefill_attention(
+        q, k, v, seq_lens, block_q=16, block_k=16, interpret=True
+    )
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * _valid_mask(T, [24])
+    assert float(diff.max()) < 1e-5
+
+
+def test_prefill_dispatch_uses_xla_on_cpu():
+    """On the CPU test platform the dispatch must pick the XLA path (the
+    kernel is TPU-only outside interpret mode)."""
+    B, T, Hq, Hkv, D = 1, 16, 4, 2, 16
+    q, k, v = _mk_prefill(B, T, Hq, Hkv, D)
+    seq_lens = jnp.asarray([16], jnp.int32)
+    got = att.prefill_attention_dispatch(q, k, v, seq_lens)
+    ref = att.prefill_attention(q, k, v, seq_lens)
+    assert float(jnp.max(jnp.abs(ref - got))) == 0.0

@@ -1,0 +1,171 @@
+"""Serving-integrated parallelism: the same engine.generate() surface the
+HTTP stack drives, running over multi-device meshes (virtual CPU devices).
+
+VERDICT r3 #2: dp/tp/sp/pp must be reachable from a *served* engine, not
+just verified step functions.  Greedy outputs must match the unsharded
+engine (reference capability: engines.rs:43 MultiNodeConfig +
+dynamo-run flags.rs:82-100)."""
+
+import jax
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.http import HttpService
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.tokenizer import Tokenizer
+from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+from dynamo_tpu.runtime.pipeline import link
+
+from tests.test_jax_engine import collect, req
+from tests.test_serving import http_request
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs >= 4 (virtual) devices"
+)
+
+
+def _mesh_engine(mesh_cfg, **cfg_kw):
+    defaults = dict(max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64)
+    defaults.update(cfg_kw)
+    mesh = build_mesh(mesh_cfg, jax.devices()[: mesh_cfg.num_devices])
+    return JaxEngine.random_init(
+        ModelConfig.tiny(), EngineConfig(**defaults), mesh=mesh
+    )
+
+
+def _plain_engine(**cfg_kw):
+    defaults = dict(max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64)
+    defaults.update(cfg_kw)
+    return JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**defaults))
+
+
+def test_dp_tp_engine_matches_unsharded(run):
+    """A dp=2 x tp=2 engine produces the same greedy tokens as the plain
+    engine across a concurrent batch (batch lanes shard over dp, heads
+    over tp; same weights seed)."""
+
+    async def body():
+        import asyncio
+
+        prompts = [
+            [1, 2, 3, 4, 5],
+            [9, 8, 7],
+            [3, 3, 3, 3, 3, 3, 3, 3],
+            [5, 1],
+        ]
+        plain = _plain_engine()
+        try:
+            expect = [
+                (await collect(plain, req(p, max_tokens=6)))[0] for p in prompts
+            ]
+        finally:
+            await plain.stop()
+
+        sharded = _mesh_engine(MeshConfig(dp=2, tp=2))
+        try:
+            got = await asyncio.gather(
+                *[collect(sharded, req(p, max_tokens=6)) for p in prompts]
+            )
+            assert [g[0] for g in got] == expect
+        finally:
+            await sharded.stop()
+
+    run(body())
+
+
+def test_sp_engine_routes_ring_prefill(run):
+    """With sp>1 the served engine's full prefills run through ring
+    attention; greedy output still matches the unsharded engine."""
+
+    async def body():
+        prompt = list(range(1, 17))  # 16 tokens: bucket 16 % sp(4) == 0
+        plain = _plain_engine()
+        try:
+            expect, _ = await collect(plain, req(prompt, max_tokens=6))
+        finally:
+            await plain.stop()
+
+        sharded = _mesh_engine(MeshConfig(sp=4))
+        try:
+            got, _ = await collect(sharded, req(prompt, max_tokens=6))
+            assert got == expect
+            assert sharded.sp_prefills >= 1  # the ring path actually ran
+        finally:
+            await sharded.stop()
+
+    run(body())
+
+
+def test_pp_engine_routes_pipeline_prefill(run):
+    """With pp>1 (and no sp) full prefills run through the microbatched
+    pipeline; greedy output still matches."""
+
+    async def body():
+        prompt = [4, 7, 1, 1, 8, 2, 6, 5, 3, 5]
+        plain = _plain_engine()
+        try:
+            expect, _ = await collect(plain, req(prompt, max_tokens=6))
+        finally:
+            await plain.stop()
+
+        sharded = _mesh_engine(MeshConfig(pp=2))
+        try:
+            got, _ = await collect(sharded, req(prompt, max_tokens=6))
+            assert got == expect
+            assert sharded.pp_prefills >= 1
+        finally:
+            await sharded.stop()
+
+    run(body())
+
+
+def test_http_serving_through_dp_tp_engine(model_dir, run):
+    """Real HTTP requests (chat + SSE) through the full pipeline backed by a
+    dp x tp sharded engine -- the end-to-end surface a user drives."""
+
+    async def body():
+        tok = Tokenizer.from_model_dir(model_dir)
+        engine = _mesh_engine(
+            MeshConfig(dp=2, tp=2),
+            max_seq_len=64,
+        )
+        name = "sharded-model"
+        pipeline = link(OpenAIPreprocessor(name, tok), Backend(tok), engine)
+        svc = HttpService()
+        svc.manager.add_chat_model(name, pipeline)
+        svc.manager.add_completion_model(name, pipeline)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, body_ = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": name,
+                    "messages": [{"role": "user", "content": "hello world"}],
+                    "max_tokens": 6,
+                    "temperature": 0,
+                },
+            )
+            assert status == 200
+            assert body_["usage"]["completion_tokens"] == 6
+            assert isinstance(
+                body_["choices"][0]["message"]["content"], str
+            )
+            # streaming leg over the same sharded engine
+            status, headers, events = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": name,
+                    "messages": [{"role": "user", "content": "again"}],
+                    "max_tokens": 4,
+                    "stream": True,
+                },
+            )
+            assert status == 200
+            assert events[-1] == "[DONE]"
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    run(body())
