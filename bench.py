@@ -1,13 +1,15 @@
 """Benchmark: serving throughput of the first-party JAX engine on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-
-Measures end-to-end engine decode throughput (continuous batching, paged KV,
-sampling, async streaming -- the serving hot path) on a TinyLlama-1.1B-shaped
-model in bfloat16, batch 8.  ``vs_baseline`` is the ratio against the
-reference's published per-device decode number (51.22 tok/s/GPU, H100 TP4,
-Llama-70B -- docs/architecture/planner.md:86, see BASELINE.md); the models
-differ in size, so the ratio is a tracking index, not a same-model claim.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus a
+decode batch sweep, a served-path measurement (HTTP frontend: output tok/s
+AND TTFT p50, the north-star pair -- BASELINE.md), and the disaggregated
+leg.  The model is a TinyLlama-1.1B-shaped random-init in bfloat16 (no
+checkpoint ships with this environment -- zero egress; shapes, dtypes and
+kernels are identical to real weights, logit VALUES are not, so this is a
+throughput tracker, not a quality benchmark).  ``vs_baseline`` is the ratio
+against the reference's published per-device decode number (51.22 tok/s/GPU,
+H100 TP4, Llama-70B -- docs/architecture/planner.md:86); the models differ
+in size, so the ratio is a tracking index, not a same-model claim.
 """
 
 from __future__ import annotations
@@ -17,7 +19,7 @@ import json
 import time
 
 
-def build_engine():
+def build_engine(max_batch_size: int = 8, num_pages: int = 768):
     import jax
 
     from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
@@ -35,10 +37,10 @@ def build_engine():
         dtype="bfloat16",
     )
     cfg = EngineConfig(
-        max_batch_size=8,
+        max_batch_size=max_batch_size,
         max_seq_len=1024,
         page_size=16,
-        num_pages=768,
+        num_pages=num_pages,
         seed=0,
     )
     return JaxEngine.random_init(model_cfg, cfg)
@@ -129,6 +131,100 @@ async def run_disagg(rs):
                 pass
 
 
+def _build_tokenizer(tmpdir: str):
+    """Minimal BPE tokenizer dir for the serving leg's detok path."""
+    import json as _json
+    import os
+
+    from tokenizers import Tokenizer as _Tok
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+
+    tok = _Tok(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.train_from_iterator(
+        ["the quick brown fox jumps over the lazy dog " * 8],
+        trainers.BpeTrainer(vocab_size=128, special_tokens=["<unk>"]),
+    )
+    tok.decoder = decoders.BPEDecoder()
+    os.makedirs(tmpdir, exist_ok=True)
+    tok.save(os.path.join(tmpdir, "tokenizer.json"))
+    with open(os.path.join(tmpdir, "tokenizer_config.json"), "w") as f:
+        _json.dump({}, f)
+    from dynamo_tpu.llm.tokenizer import Tokenizer
+
+    return Tokenizer.from_model_dir(tmpdir)
+
+
+async def run_serving(engine) -> dict:
+    """Served-path measurement: HTTP frontend + SSE streaming over the live
+    engine; reports output tok/s and TTFT percentiles together (the
+    north-star pair, BASELINE.md row 1)."""
+    import tempfile
+
+    from dynamo_tpu.bench_serving import run_bench, synth_workload
+    from dynamo_tpu.http import HttpService
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.runtime.pipeline import link
+
+    with tempfile.TemporaryDirectory() as td:
+        tok = _build_tokenizer(td)
+        name = "bench-model"
+        pipeline = link(OpenAIPreprocessor(name, tok), Backend(tok), engine)
+        svc = HttpService()
+        svc.manager.add_chat_model(name, pipeline)
+        svc.manager.add_completion_model(name, pipeline)
+        await svc.start()
+        try:
+            host, port = svc.address
+            vocab = max(3, tok.vocab_size - 1)
+            warm = synth_workload(8, isl=128, osl=8, request_rate=0.0,
+                                  vocab=vocab, seed=7)
+            await run_bench(host, port, name, warm, concurrency=8)
+            work = synth_workload(48, isl=128, osl=64, request_rate=0.0,
+                                  vocab=vocab, seed=8)
+            report = await run_bench(host, port, name, work, concurrency=16)
+            s = report.summary()
+            assert s["num_errors"] == 0, f"serving bench errors: {s}"
+            return {
+                "serving_tok_s": s["output_tok_s"],
+                "ttft_p50_ms": s["ttft_ms"]["p50"],
+                "ttft_p99_ms": s["ttft_ms"]["p99"],
+            }
+        finally:
+            await svc.stop()
+
+
+async def run_decode_sweep(rs) -> dict:
+    """Decode throughput at larger batches on a 64-lane engine (the bs=8
+    headline engine stays separate for round-over-round comparability)."""
+    from dynamo_tpu.engine.weights import param_bytes
+
+    engine = build_engine(max_batch_size=64, num_pages=1536)
+    out = {}
+    try:
+        for bs in (32, 64):
+            prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(bs)]
+            await run_batch(engine, prompts, max_tokens=8)  # compile/warm
+            prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(bs)]
+            t0 = time.monotonic()
+            total = await run_batch(engine, prompts, max_tokens=128)
+            elapsed = time.monotonic() - t0
+            tok_s = total / elapsed
+            pbytes = param_bytes(engine.params)
+            steps_s = (total / bs) / elapsed
+            kv_per_step = (
+                bs * 320 * engine.kv.bytes_per_page // engine.kv.page_size
+            )
+            out[f"decode_tok_s_bs{bs}"] = round(tok_s, 2)
+            out[f"est_hbm_util_bs{bs}"] = round(
+                (pbytes + kv_per_step) * steps_s / 819e9, 4
+            )
+    finally:
+        await engine.stop()
+    return out
+
+
 async def main():
     import numpy as np
 
@@ -181,11 +277,16 @@ async def main():
     decode_steps_s = (total / 8) / elapsed  # token rows per lane per second
     hbm_bw = (pbytes + kv_bytes_per_step) * decode_steps_s
     util = hbm_bw / 819e9
-    # release the aggregated engine BEFORE the disagg leg spins up its two
-    # engines -- three resident models would waste HBM and caps model size
+
+    # served path: HTTP + SSE over the same engine (tok/s + TTFT together)
+    serving = await run_serving(engine)
+
+    # release the aggregated engine BEFORE the other legs spin up their
+    # engines -- multiple resident models would waste HBM and cap model size
     await engine.stop()
     del engine
 
+    sweep = await run_decode_sweep(rs)
     disagg_tok_s = await run_disagg(rs)
 
     baseline = 51.22  # H100 TP4 per-GPU decode tok/s (reference planner.md:86)
@@ -202,6 +303,8 @@ async def main():
                 "disagg_tok_s": round(disagg_tok_s, 2),
                 "est_hbm_util_v5e": round(util, 4),
                 "param_bytes": pbytes,
+                **sweep,
+                **serving,
             }
         )
     )

@@ -132,13 +132,29 @@ def trace_workload(
         )
         records = records[:limit]
 
+    # infer tokens-per-block, preferring a record whose input_length is an
+    # exact multiple of its block count (a trailing partial block skews the
+    # floor division); when no record divides exactly, fall back to the
+    # approximate floor-division inference rather than an arbitrary default
+    # -- a ~1-off block size beats a ~30x-off one
     inferred: Optional[int] = None
+    approx: Optional[int] = None
     for r in records:
         ids = r.get("hash_ids") or []
         if ids and r.get("input_length"):
-            inferred = max(1, int(r["input_length"]) // len(ids))
-            break
-    per_block = inferred or block_size or 16
+            n = int(r["input_length"])
+            if approx is None:
+                approx = max(1, n // len(ids))
+            if n % len(ids) == 0:
+                inferred = max(1, n // len(ids))
+                break
+    per_block = inferred or approx or block_size or 16
+    if inferred and block_size and inferred != block_size:
+        print(
+            f"bench: trace implies {inferred} tokens/block; overriding "
+            f"--trace-block-size {block_size}",
+            file=sys.stderr,
+        )
 
     out = []
     t0: Optional[float] = None
@@ -150,6 +166,11 @@ def trace_workload(
             toks.extend(rs.randint(2, vocab, (per_block,)).tolist())
         if not toks:
             continue
+        # honour the trace's exact prompt length: the last block may be
+        # partial (input_length = (blocks-1)*block + leftover)
+        want = int(r.get("input_length") or 0)
+        if 0 < want < len(toks):
+            toks = toks[:want]
         ts = float(r.get("timestamp", 0.0))
         if t0 is None:
             t0 = ts

@@ -132,6 +132,16 @@ def build_parser() -> argparse.ArgumentParser:
     rm = ctlsub.add_parser("remove", help="deregister a model by name")
     rm.add_argument("name")
 
+    # disagg-conf: live-reload the disagg routing policy (reference
+    # disagg_router.rs:38-90 etcd watch); decode workers pick it up without
+    # restarts
+    dc = sub.add_parser("disagg-conf",
+                        help="update the live disagg routing policy")
+    dc.add_argument("--hub", required=True, help="hub address host:port")
+    dc.add_argument("--namespace", default="dynamo")
+    dc.add_argument("--max-local-prefill-length", type=int, default=None)
+    dc.add_argument("--max-prefill-queue-depth", type=int, default=None)
+
     # datagen: workload analysis + synthesis (reference benchmarks/
     # data_generator `datagen analyze|synthesize`)
     dg = sub.add_parser("datagen", help="analyze/synthesize prefix workloads")
@@ -146,7 +156,9 @@ def build_parser() -> argparse.ArgumentParser:
     sy.add_argument("--block-size", type=int, default=512)
     sy.add_argument("--num-copies", type=int, default=1)
     sy.add_argument("--speedup-ratio", type=float, default=1.0)
-    sy.add_argument("--prefix-len-multiplier", type=int, default=1)
+    sy.add_argument("--prefix-len-multiplier", type=float, default=1.0,
+                    help="scale shared-prefix lengths (any positive float; "
+                         "<1 shrinks, like the reference synthesizer)")
     sy.add_argument("--prompt-len-multiplier", type=float, default=1.0)
     sy.add_argument("--seed", type=int, default=0)
 
@@ -235,6 +247,11 @@ async def _make_engine(args):
 
     if not args.model_path:
         raise SystemExit("out=jax requires --model-path")
+    from .llm.local_model import resolve_model_path
+
+    # local dir used as-is; an org/repo id resolves through the HF hub
+    # (reference local_model.rs:27 + hub.rs)
+    args.model_path = resolve_model_path(args.model_path)
     cfg = EngineConfig(
         max_batch_size=args.max_batch_size,
         max_seq_len=args.max_seq_len,
@@ -293,6 +310,9 @@ def _tokenizer_for(args):
 
     if not args.model_path:
         raise SystemExit("this mode needs --model-path for the tokenizer")
+    from .llm.local_model import resolve_model_path
+
+    args.model_path = resolve_model_path(args.model_path)
     return Tokenizer.from_model_dir(args.model_path)
 
 
@@ -461,9 +481,12 @@ async def run_worker(args) -> None:
         await comp.endpoint(KV_DELIVER_ENDPOINT).serve_raw(
             disagg.kv_deliver_handler()
         )
-        await ep.serve(disagg)
+        await disagg.start_config_watch()  # live policy reload from the hub
+        served = await _wire_prefix_onboard(disagg, engine, ns, comp, comp_name)
+        await ep.serve(served)
     else:
-        await ep.serve(engine)
+        served = await _wire_prefix_onboard(engine, engine, ns, comp, comp_name)
+        await ep.serve(served)
     embed_ep_name = ""
     if hasattr(engine, "embed") and args.disagg != "prefill":
         # pooled-embedding leg: a sibling endpoint the frontend watcher
@@ -802,6 +825,58 @@ def run_datagen(args) -> int:
     return 0
 
 
+async def _wire_prefix_onboard(served, engine, ns, comp, comp_name):
+    """Enable cross-worker prefix onboarding (G4) when the engine has a host
+    offload tier to stage imports in: serve ``kv_export`` (donor side) and
+    wrap the serving engine (importer side)."""
+    if getattr(engine, "offload", None) is None:
+        return served
+    from .llm.prefix_onboard import (
+        KV_EXPORT_ENDPOINT,
+        PrefixOnboardEngine,
+        kv_export_handler,
+    )
+
+    await comp.endpoint(KV_EXPORT_ENDPOINT).serve_raw(kv_export_handler(engine))
+    return PrefixOnboardEngine(served, ns, comp_name, engine=engine)
+
+
+async def run_disagg_conf(args) -> int:
+    """Write the live disagg routing policy to the hub; every decode worker
+    watching the key reloads it (llm/disagg.py start_config_watch)."""
+    import json as _json
+
+    from .llm.disagg import disagg_conf_key
+    from .runtime.component import DistributedRuntime
+
+    conf = {}
+    if args.max_local_prefill_length is not None:
+        conf["max_local_prefill_length"] = args.max_local_prefill_length
+    if args.max_prefill_queue_depth is not None:
+        conf["max_prefill_queue_depth"] = args.max_prefill_queue_depth
+    if not conf:
+        print("nothing to update (pass --max-local-prefill-length and/or "
+              "--max-prefill-queue-depth)")
+        return 2
+    rt = await DistributedRuntime.detached(args.hub)
+    try:
+        # read-modify-write: a partial update must not drop fields an
+        # earlier update set -- workers that join later apply the snapshot
+        key = disagg_conf_key(args.namespace)
+        merged: dict = {}
+        for _k, value in await rt.hub.kv_get_prefix(key):
+            try:
+                merged.update(_json.loads(value))
+            except Exception:
+                pass  # malformed old value: overwrite it
+        merged.update(conf)
+        await rt.hub.kv_put(key, _json.dumps(merged).encode())
+        print(f"disagg conf updated for namespace {args.namespace}: {merged}")
+    finally:
+        await rt.shutdown()
+    return 0
+
+
 def main(argv=None) -> int:
     from .runtime.utils import configure_logging
 
@@ -827,6 +902,8 @@ def main(argv=None) -> int:
         return asyncio.run(run_profile_sla(args))
     if args.cmd == "bench":
         return asyncio.run(run_bench(args))
+    if args.cmd == "disagg-conf":
+        return asyncio.run(run_disagg_conf(args))
     args.inp, args.out = _parse_io(args.io)
     try:
         if args.inp == "http" and args.out in ("jax", "mocker", "echo"):

@@ -47,18 +47,25 @@ class Synthesizer:
         block_size: int = 512,
         num_copies: int = 1,
         speedup_ratio: float = 1.0,
-        prefix_len_multiplier: int = 1,
+        prefix_len_multiplier: float = 1.0,
         prompt_len_multiplier: float = 1.0,
         seed: int = 0,
     ) -> None:
-        if prefix_len_multiplier < 1 or int(prefix_len_multiplier) != prefix_len_multiplier:
-            raise ValueError("prefix_len_multiplier must be a positive integer")
+        if not prefix_len_multiplier > 0:
+            raise ValueError("prefix_len_multiplier must be > 0")
         if not speedup_ratio > 0:
             raise ValueError("speedup_ratio must be > 0")
         self.block_size = block_size
         self.num_copies = max(1, num_copies)
         self.speedup = float(speedup_ratio)
-        self.prefix_mult = int(prefix_len_multiplier)
+        # any positive float, like the reference synthesizer: k >= 1
+        # stretches each observed core block into ~k synthetic blocks,
+        # k < 1 shrinks shared prefixes by dropping ~(1-k) of the blocks.
+        # The per-block count is a deterministic function of the block id,
+        # so every request sharing a prefix sees the identical expansion
+        # and the sharing structure is preserved exactly.
+        self.prefix_mult = float(prefix_len_multiplier)
+        self._mult_span = max(1, int(np.ceil(self.prefix_mult)))
         self.prompt_mult = float(prompt_len_multiplier)
         self.rng = np.random.RandomState(seed)
         self._build(records)
@@ -110,15 +117,29 @@ class Synthesizer:
 
     # -- synthesis ----------------------------------------------------------
 
+    def _core_count(self, h: int) -> int:
+        """Deterministic per-block expansion count for fractional
+        multipliers: floor(k) everywhere plus one extra block for the
+        (k - floor(k)) fraction of ids, chosen by a hash of the id so the
+        choice is identical across every request that shares the block."""
+        k = self.prefix_mult
+        base = int(k)
+        frac = k - base
+        if frac <= 0:
+            return base
+        # Knuth multiplicative hash -> uniform in [0, 1)
+        u = ((h * 2654435761) & 0xFFFFFFFF) / 2**32
+        return base + (1 if u < frac else 0)
+
     def _core_id(self, h: int, copy: int) -> List[int]:
-        """Map a core id into its copy's id space, expanded by the prefix
-        multiplier (k synthetic blocks per observed block -- same sharing
-        shape, longer shared prefix)."""
-        base = (copy * self._max_core + h) * self.prefix_mult
-        return [base + j for j in range(self.prefix_mult)]
+        """Map a core id into its copy's id space, expanded (or thinned) by
+        the prefix multiplier -- same sharing shape, scaled shared-prefix
+        length."""
+        base = (copy * self._max_core + h) * self._mult_span
+        return [base + j for j in range(self._core_count(h))]
 
     def _fresh_suffix(self, n: int) -> List[int]:
-        lo = self.num_copies * self._max_core * self.prefix_mult
+        lo = self.num_copies * self._max_core * self._mult_span
         ids = [lo + self._next_unique + j for j in range(n)]
         self._next_unique += n
         return ids

@@ -42,6 +42,7 @@ from .scheduler import Scheduler, SchedulerConfig, SeqState, StepEvent
 from .step import (
     decode_block,
     inject_token,
+    inject_tokens,
     pick_bucket,
     pick_page_bucket,
     prefill_and_sample,
@@ -403,6 +404,13 @@ class JaxEngine:
         valid positions, L2-normalizes.  Runs on the engine executor thread,
         serialized with the tick loop -- the trunk forward reads the KV
         buffer but never writes it, so in-flight decode state is untouched.
+
+        Latency note: that serialization means a large embedding call
+        head-of-line-blocks every in-flight token stream for its full
+        forward, inflating ITL by roughly the embed duration.  For
+        latency-sensitive graphs, run embeddings on a dedicated worker
+        (``run in=dyn out=jax`` serving only the embed endpoint) rather
+        than colocating them with decode.
         """
         if not token_batches:
             return []
@@ -723,6 +731,67 @@ class JaxEngine:
         finally:
             for pages in allocated:
                 self.kv.allocator.free(pages)
+
+    async def export_blocks(
+        self, seq_hashes: List[int]
+    ) -> List[Tuple[int, np.ndarray, Dict[str, int]]]:
+        """Export the longest resident prefix of ``seq_hashes`` as
+        ``(hash, blob, meta)`` triples -- the donor side of cross-worker
+        prefix onboarding (reference block_manager.rs:119-146 blockset
+        export/import; G4).  Consults G1 (HBM pool, one bundled device
+        transfer) then the offload tiers; stops at the first miss, because
+        an importer can only use a contiguous prefix."""
+        if not self._running:
+            await self.start()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._ex, self._export_blocks, seq_hashes
+        )
+
+    def _export_blocks(self, seq_hashes):
+        out: List[Tuple[int, np.ndarray, Dict[str, int]]] = []
+        pool = self.kv.allocator
+        acquired: List[Any] = []
+        if isinstance(pool, PagePool):
+            try:
+                for blk in pool.match(seq_hashes):
+                    if pool.acquire(blk.sequence_hash) is None:
+                        break
+                    acquired.append(blk)
+                if acquired:
+                    all_ids = np.concatenate(
+                        [np.asarray(b.pages, np.int32) for b in acquired]
+                    )
+                    blob_all = np.asarray(
+                        jax.device_get(self.kv.pages[:, :, all_ids])
+                    )
+                    off = 0
+                    for blk in acquired:
+                        k = len(blk.pages)
+                        out.append(
+                            (
+                                blk.sequence_hash,
+                                blob_all[:, :, off : off + k],
+                                {
+                                    "block_hash": blk.block_hash,
+                                    "parent_sequence_hash": blk.parent_sequence_hash,
+                                    "position": blk.position,
+                                },
+                            )
+                        )
+                        off += k
+            finally:
+                for blk in acquired:
+                    pool.release(blk.sequence_hash)
+        # continue the chain into the offload tiers
+        if self.offload is not None:
+            for h in seq_hashes[len(out) :]:
+                hit = self.offload.get(h)
+                if hit is None:
+                    break
+                blob, meta = hit
+                out.append((h, blob, meta.to_dict()))
+        return out
 
     # -- metrics ------------------------------------------------------------
 
@@ -1064,6 +1133,10 @@ class JaxEngine:
             k = min(len(pages), n_pages)
             page_table[i, :k] = pages[:k]
             seqs[i] = seq
+        if any(s is not None and s.mm_embeds is not None for s in seqs):
+            return self._dispatch_mm_prefill_batch(
+                tokens, lens, page_table, seqs, Bp
+            )
         routed = self._dispatch_parallel_prefill(
             tokens, lens, page_table, seqs, bucket
         )
@@ -1076,6 +1149,46 @@ class JaxEngine:
             self._put_batch(tokens),
             self._put_batch(lens),
             self._put_batch(page_table),
+            self._next_rng(),
+            self._sampling_arrays(seqs),
+        )
+        return sampled
+
+    def _dispatch_mm_prefill_batch(
+        self,
+        tokens: np.ndarray,
+        lens: np.ndarray,
+        page_table: np.ndarray,
+        seqs: List[Optional[SeqState]],
+        Bp: int,
+    ) -> jax.Array:
+        """Soft-prompt (multimodal) full prefill: inject each lane's vision
+        embeddings over its leading positions.  The soft-prompt length pads
+        to a power-of-two bucket so compile-cache entries stay bounded."""
+        from .step import prefill_mm_and_sample
+
+        H = self.model_cfg.hidden_size
+        mm_lens = [
+            0 if s is None or s.mm_embeds is None else len(s.mm_embeds)
+            for s in seqs
+        ]
+        M = 1 << max(max(mm_lens) - 1, 0).bit_length()  # >= 1, power of two
+        mm = np.zeros((Bp, M, H), np.float32)
+        mml = np.zeros((Bp,), np.int32)
+        for i, s in enumerate(seqs):
+            if s is not None and s.mm_embeds is not None:
+                k = len(s.mm_embeds)
+                mm[i, :k] = s.mm_embeds
+                mml[i] = k
+        sampled, self.kv.pages = prefill_mm_and_sample(
+            self.params,
+            self.model_cfg,
+            self.kv.pages,
+            self._put_batch(tokens),
+            self._put_batch(lens),
+            self._put_batch(page_table),
+            self._put_batch(mm),
+            self._put_batch(mml),
             self._next_rng(),
             self._sampling_arrays(seqs),
         )
@@ -1214,7 +1327,12 @@ class JaxEngine:
             self._prefix_hits += seq.cached_prompt_tokens
         chunk = self._chunk_tokens
         start = seq.cached_prompt_tokens
-        if chunk is not None and prompt_len - start > chunk:
+        if (
+            chunk is not None
+            and prompt_len - start > chunk
+            and seq.mm_embeds is None  # mm prompts prefill in one dispatch:
+            # the soft-prompt injection indexes absolute positions from 0
+        ):
             seq.prefilling = True
             seq.prefilled_tokens = start
             # the admission row must land (lane inactive while chunking)
@@ -1333,14 +1451,21 @@ class JaxEngine:
                 [(seq, pl, c) for (seq, pl), c in zip(items, caches)], Bp
             )
         self._sync_device_state()
+        # one batched scatter for the whole group's first tokens: per-lane
+        # inject_token dispatches were the dominant group overhead on a
+        # high-RTT device link (pad rows carry slot=B and are dropped)
+        Bpad = self._pad_batch(len(items))
+        slots = np.full((Bpad,), self.cfg.max_batch_size, np.int32)
+        for i, (seq, _pl) in enumerate(items):
+            slots[i] = seq.slot
+        self._dev["tokens"] = inject_tokens(
+            self._dev["tokens"], jnp.asarray(slots), sampled[:Bpad]
+        )
         out: List[InflightPrefill] = []
         for i, (seq, pl) in enumerate(items):
             tok = sampled[i : i + 1]
             pf = InflightPrefill(sampled=tok, seq=seq, slot=seq.slot)
             self._pending_injects[seq.slot] = pf
-            self._dev["tokens"] = inject_token(
-                self._dev["tokens"], seq.slot, tok
-            )
             if tracing.collector.enabled:
                 with tracing.span(
                     "engine.prefill_dispatch", seq.request_id

@@ -339,8 +339,15 @@ def transformer(
     positions: jax.Array,  # same leading shape as tokens
     kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     attn_fn: AttnFn,
+    mm: "Optional[Tuple[jax.Array, jax.Array]]" = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Run the trunk; returns (hidden [.., H], updated kv_pages)."""
+    """Run the trunk; returns (hidden [.., H], updated kv_pages).
+
+    ``mm = (mm_embeds [B, M, H], mm_len [B])`` injects a llava-style soft
+    prompt: lane b's first ``mm_len[b]`` positions take rows from
+    ``mm_embeds`` instead of the token-embedding lookup (the vision
+    projector's output lands here; reference examples/multimodal
+    encode_worker -> prefill embedding splice)."""
     squeeze = tokens.ndim == 1
     if squeeze:
         tokens = tokens[:, None]
@@ -350,6 +357,16 @@ def transformer(
     x = params["embed"][tokens].astype(jnp.dtype(cfg.dtype))
     if cfg.scale_embeddings:  # Gemma: sqrt(hidden) in the embed dtype
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+    if mm is not None:
+        mm_embeds, mm_len = mm
+        M = mm_embeds.shape[1]
+        T = x.shape[1]
+        inj = jnp.zeros_like(x)
+        k = min(M, T)
+        inj = inj.at[:, :k].set(mm_embeds[:, :k].astype(x.dtype))
+        pos_t = jnp.arange(T, dtype=jnp.int32)
+        take = pos_t[None, :] < jnp.minimum(mm_len, k)[:, None]  # [B, T]
+        x = jnp.where(take[:, :, None], inj, x)
     cos, sin = rope_cos_sin(positions, D, cfg.rope_theta, cfg.rope_scaling)  # [B, T, D]
 
     x, new_kv_pages = scan_layers(

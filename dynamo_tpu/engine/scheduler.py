@@ -62,6 +62,9 @@ class SeqState:
     # page_table view: shared (reused) pages first, then owned pages
     pages: List[int] = field(default_factory=list)
     blocks: Optional[TokenBlockSequence] = None  # router-visible block identity
+    # llava-style soft prompt: [T_img, hidden] f32 rows injected over the
+    # first T_img prompt positions at prefill (None = text-only)
+    mm_embeds: Optional[Any] = None
     num_generated: int = 0
     # tokens generated before the last preemption (already streamed to the
     # client); stop-condition accounting uses prior_generated + num_generated
@@ -95,13 +98,26 @@ class SeqState:
 
     @classmethod
     def from_request(cls, request_id: str, req: PreprocessedRequest, block_size: int) -> "SeqState":
+        import numpy as np
+
+        mm = None
+        if req.mm_embeds:
+            mm = np.asarray(req.mm_embeds, np.float32)
         return cls(
             request_id=request_id,
             prompt=list(req.token_ids),
             stop=req.stop_conditions,
             sampling=req.sampling_options,
             eos_ids=list(req.eos_token_ids),
-            blocks=TokenBlockSequence(req.token_ids, block_size=block_size),
+            # multimodal prompts opt out of prefix caching: the block hash
+            # chain is computed over token ids, and the placeholder ids for
+            # embedding positions would alias across different images
+            blocks=(
+                None
+                if mm is not None
+                else TokenBlockSequence(req.token_ids, block_size=block_size)
+            ),
+            mm_embeds=mm,
         )
 
 

@@ -172,6 +172,44 @@ def prefill_and_sample(
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
+def prefill_mm_and_sample(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,
+    tokens: jax.Array,  # [B, T]; positions < mm_len[b] are placeholders
+    seq_lens: jax.Array,
+    page_table: jax.Array,
+    mm_embeds: jax.Array,  # [B, M, H] f32 soft-prompt rows
+    mm_len: jax.Array,  # [B] rows valid per lane (0 = text-only lane)
+    rng: jax.Array,
+    sampling: SamplingParams,
+) -> Tuple[jax.Array, jax.Array]:
+    """Multimodal prefill: llava-style soft-prompt injection over the first
+    ``mm_len`` positions, then the standard causal prefill + sample.  A
+    separate executable from :func:`prefill_and_sample` so text-only serving
+    never pays the injection (or a recompile) for a feature it doesn't
+    use."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def attn_fn(q, k, v, kv, layer):
+        out = att.prefill_attention_dispatch(
+            q, k, v, seq_lens, cfg.sliding_window or 0
+        )
+        new_kv = att.write_prefill_kv(kv, k, v, page_table, layer)
+        return out, new_kv
+
+    hidden, kv_pages = transformer(
+        params, cfg, tokens, positions, kv_pages, attn_fn,
+        mm=(mm_embeds, mm_len),
+    )
+    last = jnp.clip(seq_lens - 1, 0, T - 1)
+    hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
+    logits = lm_logits(params, cfg, hidden_last)
+    return sample_tokens(logits, rng, sampling), kv_pages
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
 def prefill_suffix_and_sample(
     params: Params,
     cfg: ModelConfig,
@@ -248,6 +286,19 @@ def inject_token(tokens: jax.Array, slot: jax.Array, token: jax.Array) -> jax.Ar
     """Scatter a freshly-prefilled lane's first token into the device-resident
     decode token vector (dynamic slot index -> one cached executable)."""
     return tokens.at[slot].set(token[0])
+
+
+@partial(jax.jit, donate_argnames=("tokens",))
+def inject_tokens(
+    tokens: jax.Array,  # [B]
+    slots: jax.Array,  # [G] lane indices; out-of-range rows are pad (dropped)
+    toks: jax.Array,  # [G]
+) -> jax.Array:
+    """Batched :func:`inject_token`: one scatter for a whole prefill group
+    instead of one dispatch per lane (the per-lane dispatches were the
+    dominant group overhead on a high-RTT device link).  Pad rows carry an
+    out-of-range slot and are dropped by the scatter."""
+    return tokens.at[slots].set(toks, mode="drop")
 
 
 @partial(

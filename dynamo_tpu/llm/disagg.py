@@ -48,6 +48,15 @@ logger = logging.getLogger("dynamo.disagg")
 PREFILL_QUEUE_SUFFIX = "_prefill_queue"  # reference {ns}_prefill_queue
 KV_DELIVER_ENDPOINT = "kv_deliver"
 
+# Hub key carrying a live DisaggConfig override for a namespace; decode
+# workers watch it and hot-reload the routing policy (reference
+# disagg_router.rs:38-90 watches the same concept in etcd).
+DISAGG_CONF_KEY = "disagg/{ns}/router_conf"
+
+
+def disagg_conf_key(namespace: str) -> str:
+    return DISAGG_CONF_KEY.format(ns=namespace)
+
 # Upload chunk size: large enough to amortize framing, comfortably under
 # codec.MAX_FRAME, small enough that assembly overlaps the socket.
 KV_CHUNK_BYTES = 8 * 1024 * 1024
@@ -171,6 +180,57 @@ class DisaggDecodeEngine:
         _LOCAL_DECODE[
             _local_key(namespace, component_name, instance_id)
         ] = engine
+        self._conf_watch = None
+        self._conf_task: Optional[asyncio.Task] = None
+
+    async def start_config_watch(self) -> None:
+        """Hot-reload the routing policy from the hub (reference
+        disagg_router.rs:38-90: etcd watch on the router conf).  An operator
+        updates the key (``dynamo-tpu disagg-conf``) and every decode
+        worker's local/remote threshold follows without restarts."""
+        self._conf_watch = await self.namespace.runtime.hub.watch_prefix(
+            disagg_conf_key(self.namespace.name)
+        )
+        for _key, value in self._conf_watch.snapshot:
+            self._apply_conf(value)
+        self._conf_task = asyncio.create_task(
+            self._conf_loop(), name="disagg-conf-watch"
+        )
+
+    async def stop_config_watch(self) -> None:
+        if self._conf_task is not None:
+            self._conf_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await self._conf_task
+            self._conf_task = None
+        if self._conf_watch is not None:
+            with contextlib.suppress(Exception):
+                await self._conf_watch.close()
+            self._conf_watch = None
+
+    async def _conf_loop(self) -> None:
+        assert self._conf_watch is not None
+        with contextlib.suppress(asyncio.CancelledError):
+            while True:
+                ev = await self._conf_watch.events.get()
+                if ev.type == "put":
+                    self._apply_conf(ev.value)
+
+    def _apply_conf(self, raw: bytes) -> None:
+        try:
+            d = json.loads(raw)
+            cfg = self.router.cfg
+            if "max_local_prefill_length" in d:
+                cfg.max_local_prefill_length = int(d["max_local_prefill_length"])
+            if "max_prefill_queue_depth" in d:
+                cfg.max_prefill_queue_depth = int(d["max_prefill_queue_depth"])
+            logger.info(
+                "disagg conf reloaded: max_local_prefill_length=%d "
+                "max_prefill_queue_depth=%d",
+                cfg.max_local_prefill_length, cfg.max_prefill_queue_depth,
+            )
+        except Exception:
+            logger.exception("malformed disagg conf update ignored")
 
     async def _queue_depth(self) -> int:
         """Queue depth with a short-TTL cache: the ship/local heuristic

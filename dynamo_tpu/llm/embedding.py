@@ -26,7 +26,7 @@ import hashlib
 import math
 from typing import Any, AsyncIterator, Awaitable, Callable, List, Optional
 
-from ..protocols.openai import EmbeddingRequest, OpenAIError
+from ..protocols.openai import INVALID_MARK, EmbeddingRequest, OpenAIError
 from ..runtime.engine import Annotated, AsyncEngine, Context, ResponseStream
 from .tokenizer import Tokenizer
 
@@ -76,25 +76,33 @@ class EmbeddingEngine(AsyncEngine):
 
     async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
         data = request.data
-        if isinstance(data, EmbeddingRequest):
-            batches = self._tokenize(data)
-        elif isinstance(data, dict) and "token_batches" in data:
-            batches = data["token_batches"]
-            if not (
-                isinstance(batches, list)
-                and batches
-                and all(isinstance(b, list) and b for b in batches)
-            ):
-                raise OpenAIError("'token_batches' must be non-empty token lists")
-            if self.max_input_tokens is not None:
-                for i, b in enumerate(batches):
-                    if len(b) > self.max_input_tokens:
-                        raise OpenAIError(
-                            f"input {i} has {len(b)} tokens, over the"
-                            f" {self.max_input_tokens}-token limit"
-                        )
-        else:
-            raise OpenAIError("expected an embedding request")
+        try:
+            if isinstance(data, EmbeddingRequest):
+                batches = self._tokenize(data)
+            elif isinstance(data, dict) and "token_batches" in data:
+                batches = data["token_batches"]
+                if not (
+                    isinstance(batches, list)
+                    and batches
+                    and all(isinstance(b, list) and b for b in batches)
+                ):
+                    raise OpenAIError(
+                        "'token_batches' must be non-empty token lists"
+                    )
+                if self.max_input_tokens is not None:
+                    for i, b in enumerate(batches):
+                        if len(b) > self.max_input_tokens:
+                            raise OpenAIError(
+                                f"input {i} has {len(b)} tokens, over the"
+                                f" {self.max_input_tokens}-token limit"
+                            )
+            else:
+                raise OpenAIError("expected an embedding request")
+        except OpenAIError as e:
+            # stable wire marker: the distributed leg (router_embedder) maps
+            # prologue errors carrying it back to a client-facing 400; other
+            # prologue failures (engine crash) stay 500s
+            raise OpenAIError(f"{INVALID_MARK}{e}") from e
 
         ctx = request.ctx
 
@@ -116,8 +124,30 @@ def router_embedder(router) -> Embedder:
     embedding endpoint through a PushRouter (the distributed leg)."""
 
     async def embed(batches: List[List[int]]) -> List[List[float]]:
-        stream = await router.generate(Context.new({"token_batches": batches}))
+        from ..runtime.transports.request_plane import RemoteError
+
+        try:
+            stream = await router.generate(
+                Context.new({"token_batches": batches})
+            )
+        except RemoteError as e:
+            # the worker's EmbeddingEngine marks validation failures
+            # (INVALID_MARK) before they cross the wire as flat RemoteError
+            # messages; map those back to OpenAIError so the frontend
+            # answers 400 with the worker's real reason, and leave genuine
+            # worker faults as 500s
+            msg = str(e)
+            if INVALID_MARK in msg:
+                raise OpenAIError(
+                    msg.split(INVALID_MARK, 1)[1] or "invalid request"
+                ) from e
+            raise
         async for item in stream:
+            if item.is_error():
+                msg = item.error_message() or "embedding worker error"
+                if INVALID_MARK in msg:
+                    raise OpenAIError(msg.split(INVALID_MARK, 1)[1]) from None
+                raise RuntimeError(msg)
             data = item.data or {}
             if "embeddings" in data:
                 return data["embeddings"]

@@ -128,10 +128,33 @@ class KvRouter:
     async def find_best_match(self, tokens: Sequence[int]) -> Tuple[int, int]:
         """Returns (worker_id, overlap_blocks) (reference kv_router.rs:
         176-196)."""
+        worker_id, overlap, _donor = await self.find_best_match_with_donor(
+            tokens
+        )
+        return worker_id, overlap
+
+    async def find_best_match_with_donor(
+        self, tokens: Sequence[int]
+    ) -> Tuple[int, int, Optional[Tuple[int, int]]]:
+        """Best-cost worker plus the best prefix *donor* when they differ.
+
+        The cost function may send a request to a lightly-loaded worker even
+        though another worker holds a longer cached prefix; that other
+        worker is the onboarding donor (G4 cross-worker block import,
+        reference block_manager.rs:119-146).  Returns ``(worker_id,
+        overlap_blocks, donor)`` with ``donor = (instance, blocks)`` or
+        None when nobody beats the chosen worker's own cache."""
         _, seq_hashes = hash_blocks(tokens, self.block_size)
         overlap = self.indexer.find_matches(seq_hashes)
         worker_id = self.scheduler.schedule(overlap, len(tokens))
-        return worker_id, overlap.scores.get(worker_id, 0)
+        own = overlap.scores.get(worker_id, 0)
+        donor: Optional[Tuple[int, int]] = None
+        for w, blocks in overlap.scores.items():
+            if w != worker_id and blocks > own and (
+                donor is None or blocks > donor[1]
+            ):
+                donor = (w, blocks)
+        return worker_id, own, donor
 
 
 class KvPushRouter:
@@ -157,12 +180,25 @@ class KvPushRouter:
             )
 
         try:
-            instance_id, overlap = await self.chooser.find_best_match(token_ids)
+            (
+                instance_id,
+                overlap,
+                donor,
+            ) = await self.chooser.find_best_match_with_donor(token_ids)
         except Exception:
             # no metrics yet / no workers known to the scheduler: degrade to
             # plain load balancing over the live instances rather than failing
             logger.debug("kv selection failed; falling back", exc_info=True)
             return await self.inner.generate(request)
+        if donor is not None:
+            # another worker holds a longer prefix: tell the chosen worker
+            # where to import it from (llm/prefix_onboard.py consumes this)
+            from ..prefix_onboard import DONOR_META_KEY
+
+            request.metadata[DONOR_META_KEY] = {
+                "instance": donor[0],
+                "blocks": donor[1],
+            }
         try:
             return await self.inner.direct(stamp(overlap), instance_id)
         except (InstanceNotFoundError, ConnectionRefusedError):

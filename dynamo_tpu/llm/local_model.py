@@ -1,0 +1,64 @@
+"""Model path resolution: local directory or Hugging Face repo id.
+
+Reference lib/llm/src/local_model.rs:27 + hub.rs: ``--model-path`` accepts
+either a local directory (used as-is) or a HF repo id, which is resolved by
+downloading the snapshot into the local HF cache.  Same contract here via
+``huggingface_hub.snapshot_download`` (honours HF_HOME/HF_HUB_OFFLINE and
+reuses cached snapshots, so airgapped deployments that pre-seed the cache
+never touch the network).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+
+logger = logging.getLogger("dynamo.local_model")
+
+# org/name with the HF id charset; a path that exists locally always wins
+_REPO_ID_RE = re.compile(r"^[\w.-]+/[\w.-]+$")
+
+# weights + tokenizer + config: everything the engine/tokenizer loaders read
+_SNAPSHOT_PATTERNS = [
+    "*.safetensors",
+    "*.json",
+    "tokenizer.model",
+    "*.txt",
+]
+
+
+def resolve_model_path(model_path: str) -> str:
+    """Return a local directory for ``model_path``.
+
+    A path that exists on disk is returned unchanged; otherwise a string
+    shaped like ``org/repo`` is resolved through the HF hub (download or
+    cache hit).  Anything else fails with a clear error."""
+    if os.path.isdir(model_path):
+        return model_path
+    if not _REPO_ID_RE.match(model_path):
+        raise SystemExit(
+            f"--model-path {model_path!r} is neither a local directory nor "
+            f"an org/repo Hugging Face id"
+        )
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # pragma: no cover - baked into this image
+        raise SystemExit(
+            f"--model-path {model_path!r} looks like a HF repo id but "
+            f"huggingface_hub is not installed: {e}"
+        )
+    logger.info("resolving %s via the Hugging Face hub ...", model_path)
+    try:
+        local = snapshot_download(
+            model_path, allow_patterns=_SNAPSHOT_PATTERNS
+        )
+    except Exception as e:  # noqa: BLE001 - network/auth/id errors
+        raise SystemExit(
+            f"could not resolve {model_path!r} from the Hugging Face hub "
+            f"({e.__class__.__name__}: {e}); pass a local directory, "
+            f"pre-seed the HF cache, or set HF_HUB_OFFLINE=1 with a cached "
+            f"snapshot"
+        )
+    logger.info("resolved %s -> %s", model_path, local)
+    return local

@@ -1,10 +1,11 @@
-from .connector import Connector, LocalConnector
+from .connector import Connector, KubernetesConnector, LocalConnector
 from .planner import DECODE, PREFILL, Adjustment, Planner, PlannerConfig
 
 __all__ = [
     "Adjustment",
     "Connector",
     "DECODE",
+    "KubernetesConnector",
     "LocalConnector",
     "PREFILL",
     "Planner",

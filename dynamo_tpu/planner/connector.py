@@ -2,14 +2,19 @@
 
 Reference parity: ``dynamo.planner`` connectors -- LocalConnector drives
 circus watchers (components/planner/src/dynamo/planner/local_connector.py),
-KubernetesConnector patches DynamoGraphDeployment replicas.  Here the local
-connector drives in-process worker handles through user-supplied factories:
-production wires factories that spawn real engine processes; tests wire
-mocker engines.  The k8s leg is out of scope until the operator exists.
+KubernetesConnector patches deployment replicas
+(components/planner/src/dynamo/planner/kubernetes_connector.py:75,
+kube.py:164).  Here the local connector drives in-process worker handles
+through user-supplied factories (production wires factories that spawn real
+engine processes; tests wire mocker engines), and the k8s connector scales
+the Deployments that ``deploy.py`` renders ("kubectl apply is the
+reconciler" -- the planner actuates by patching ``.spec.replicas``).
 """
 
 from __future__ import annotations
 
+import asyncio
+import json
 import logging
 from abc import ABC, abstractmethod
 from typing import Any, Awaitable, Callable, Dict, List, Optional
@@ -66,3 +71,79 @@ class LocalConnector(Connector):
 
     def worker_count(self, kind: str) -> int:
         return len(self.workers.get(kind) or [])
+
+
+class KubernetesConnector(Connector):
+    """Scale the Deployments ``deploy.py`` renders by patching
+    ``.spec.replicas`` through kubectl.
+
+    Reference kubernetes_connector.py:75 resolves the component's deployment
+    and kube.py:164 issues the replicas patch; the equivalent here targets
+    ``{graph}-{kind}`` (the ``_meta`` naming rule in deploy.py).  Counts are
+    cached from the last ``refresh()`` -- the planner refreshes once per
+    adjustment round, so decisions and actuation see one consistent
+    snapshot.  kubectl is injectable for tests (fake binary) and
+    deliberately the only dependency: no python k8s client to vendor, and
+    the operator story stays "kubectl apply is the reconciler".
+    """
+
+    def __init__(
+        self,
+        graph_name: str,
+        namespace: str = "default",
+        kinds: tuple = ("decode", "prefill"),
+        kubectl: str = "kubectl",
+    ) -> None:
+        self.graph_name = graph_name
+        self.namespace = namespace
+        self.kubectl = kubectl
+        self._counts: Dict[str, int] = {k: 0 for k in kinds}
+
+    def deployment(self, kind: str) -> str:
+        return f"{self.graph_name}-{kind}"
+
+    async def _run(self, *args: str) -> str:
+        proc = await asyncio.create_subprocess_exec(
+            self.kubectl, *args,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        out, err = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"kubectl {' '.join(args)} failed (rc={proc.returncode}): "
+                f"{err.decode().strip()}"
+            )
+        return out.decode()
+
+    async def refresh(self) -> None:
+        """Pull current replica counts (planner calls this once per round)."""
+        for kind in list(self._counts):
+            out = await self._run(
+                "get", "deployment", self.deployment(kind),
+                "-n", self.namespace,
+                "-o", "jsonpath={.spec.replicas}",
+            )
+            self._counts[kind] = int(out.strip() or 0)
+
+    async def _scale(self, kind: str, replicas: int) -> None:
+        patch = json.dumps({"spec": {"replicas": replicas}})
+        await self._run(
+            "patch", "deployment", self.deployment(kind),
+            "-n", self.namespace, "-p", patch,
+        )
+        self._counts[kind] = replicas
+        logger.info(
+            "k8s connector: %s -> %d replicas", self.deployment(kind), replicas
+        )
+
+    async def add_worker(self, kind: str) -> None:
+        await self._scale(kind, self._counts.get(kind, 0) + 1)
+
+    async def remove_worker(self, kind: str) -> None:
+        n = self._counts.get(kind, 0)
+        if n > 0:
+            await self._scale(kind, n - 1)
+
+    def worker_count(self, kind: str) -> int:
+        return self._counts.get(kind, 0)

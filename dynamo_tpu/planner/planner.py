@@ -86,6 +86,7 @@ class Planner:
         self.adjustments: List[Adjustment] = []
         self._decode_grace = 0
         self._prefill_grace = 0
+        self._prev_queue_depth: Optional[int] = None
         self._task: Optional[asyncio.Task] = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -111,12 +112,18 @@ class Planner:
     # -- one adjustment round (reference make_adjustments) --------------------
 
     async def step(self) -> None:
+        # connectors that actuate an external system (k8s) pull one fresh
+        # replica snapshot per round so decisions and actuation agree
+        refresh = getattr(self.connector, "refresh", None)
+        if refresh is not None:
+            await refresh()
         metrics = self.metrics_source()
         queue_depth = 0
         if self.queue_depth_source is not None:
             queue_depth = await self.queue_depth_source()
         await self._adjust_decode(metrics)
         await self._adjust_prefill(queue_depth)
+        self._prev_queue_depth = queue_depth
 
     async def _adjust_decode(self, metrics: Dict[int, ForwardPassMetrics]) -> None:
         cfg = self.cfg
@@ -155,6 +162,24 @@ class Planner:
             return
         per_worker = queue_depth / max(n, 1)
         if per_worker > cfg.queue_scale_up_per_worker and n < cfg.max_prefill_workers:
+            # trend suppression (reference planner.py:281-291): a new prefill
+            # worker takes ~the buffer period to start, so project the queue
+            # forward by the observed per-interval change and skip the
+            # scale-up when the backlog is predicted to drain on its own
+            # before the worker would help
+            change = (
+                queue_depth - self._prev_queue_depth
+                if self._prev_queue_depth is not None
+                else 0
+            )
+            predicted = queue_depth + change * cfg.prefill_grace_periods
+            if predicted / max(n, 1) <= cfg.queue_scale_up_per_worker:
+                self._record(
+                    PREFILL, "hold",
+                    f"trend predicts drain (now {queue_depth}, "
+                    f"predicted {predicted})", n,
+                )
+                return
             self._record(PREFILL, "up", f"queue/worker {per_worker:.1f}", n)
             if not cfg.no_op:
                 await self.connector.add_worker(PREFILL)

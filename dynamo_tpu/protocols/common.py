@@ -84,6 +84,10 @@ class PreprocessedRequest:
     annotations: List[str] = field(default_factory=list)
     mdc_sum: Optional[str] = None
     estimated_prefix_hit_num_blocks: Optional[int] = None
+    # Multimodal soft prompt (llava-style): embedding rows occupying the
+    # FIRST len(mm_embeds) prompt positions; the corresponding token_ids are
+    # placeholders the embed lookup ignores.  [T_img][hidden] floats.
+    mm_embeds: Optional[List[List[float]]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -94,6 +98,7 @@ class PreprocessedRequest:
             "annotations": list(self.annotations),
             "mdc_sum": self.mdc_sum,
             "estimated_prefix_hit_num_blocks": self.estimated_prefix_hit_num_blocks,
+            "mm_embeds": self.mm_embeds,
         }
 
     @classmethod
@@ -106,6 +111,7 @@ class PreprocessedRequest:
             annotations=list(d.get("annotations") or []),
             mdc_sum=d.get("mdc_sum"),
             estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
+            mm_embeds=d.get("mm_embeds"),
         )
 
 

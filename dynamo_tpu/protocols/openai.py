@@ -21,6 +21,12 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Union
 
 
+# Wire marker distinguishing validation failures from other remote errors
+# when an OpenAIError crosses the data plane as a flat message (the
+# distributed embedding leg); stripped before anything user-facing.
+INVALID_MARK = "[invalid_request] "
+
+
 class OpenAIError(ValueError):
     """Invalid request -> HTTP 400 with an OpenAI-shaped error body."""
 
@@ -29,9 +35,12 @@ class OpenAIError(ValueError):
         self.code = code
 
     def to_body(self) -> Dict[str, Any]:
+        msg = str(self)
+        if msg.startswith(INVALID_MARK):  # wire marker is not user-facing
+            msg = msg[len(INVALID_MARK):]
         return {
             "error": {
-                "message": str(self),
+                "message": msg,
                 "type": "invalid_request_error",
                 "code": self.code,
             }

@@ -542,13 +542,16 @@ class PushRouter:
     ) -> ResponseStream[Annotated]:
         return await self._dispatch(self._pick(), request)
 
+    def _find_instance(self, instance_id: int) -> Instance:
+        for inst in self.client.instances:
+            if inst.instance_id == instance_id:
+                return inst
+        raise InstanceNotFoundError(f"instance {instance_id:x} not found")
+
     async def direct(
         self, request: Context[Any], instance_id: int
     ) -> ResponseStream[Annotated]:
-        for inst in self.client.instances:
-            if inst.instance_id == instance_id:
-                return await self._dispatch(inst, request)
-        raise InstanceNotFoundError(f"instance {instance_id:x} not found")
+        return await self._dispatch(self._find_instance(instance_id), request)
 
     async def direct_upload(
         self,
@@ -560,14 +563,28 @@ class PushRouter:
     ) -> AsyncIterator[bytes]:
         """Stream a bulk upload to a specific instance's raw endpoint and
         return its raw response iterator (the P2P KV delivery path)."""
-        for inst in self.client.instances:
-            if inst.instance_id == instance_id:
-                rt = self.client.endpoint.runtime
-                return await rt.data_client.request_upload(
-                    inst.host, inst.port, inst.subject,
-                    request_id, meta, chunks, ctx,
-                )
-        raise InstanceNotFoundError(f"instance {instance_id:x} not found")
+        inst = self._find_instance(instance_id)
+        rt = self.client.endpoint.runtime
+        return await rt.data_client.request_upload(
+            inst.host, inst.port, inst.subject, request_id, meta, chunks, ctx,
+        )
+
+    async def direct_raw(
+        self,
+        instance_id: int,
+        request_id: str,
+        meta: Dict[str, Any],
+        payload: bytes,
+        ctx,
+    ) -> AsyncIterator[bytes]:
+        """Plain request to a raw endpoint, yielding raw response payloads
+        (no Annotated/JSON envelope) -- the bulk download path (cross-worker
+        block export)."""
+        inst = self._find_instance(instance_id)
+        rt = self.client.endpoint.runtime
+        return await rt.data_client.request(
+            inst.host, inst.port, inst.subject, request_id, meta, payload, ctx,
+        )
 
     async def random(self, request: Context[Any]) -> ResponseStream[Annotated]:
         self.mode = RouterMode.RANDOM

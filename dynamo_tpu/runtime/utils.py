@@ -47,9 +47,15 @@ class CriticalTaskExecutionHandle:
     ) -> None:
         self.name = name
         self._on_failure = on_failure
+        # held until the guard first runs: a cancel() that lands before the
+        # guard task is ever scheduled must close the inner coroutine, or
+        # it is garbage-collected un-awaited ("coroutine ... was never
+        # awaited" at interpreter shutdown)
+        self._pending_coro: Optional[Any] = coro
         self._task = asyncio.ensure_future(self._guard(coro))
 
     async def _guard(self, coro: Awaitable[Any]) -> Any:
+        self._pending_coro = None
         try:
             return await coro
         except asyncio.CancelledError:
@@ -69,6 +75,10 @@ class CriticalTaskExecutionHandle:
 
     def cancel(self) -> None:
         """Non-blocking, drop-in for asyncio.Task.cancel()."""
+        coro = self._pending_coro
+        if coro is not None and asyncio.iscoroutine(coro):
+            self._pending_coro = None
+            coro.close()
         self._task.cancel()
 
     async def wait_stopped(self) -> None:

@@ -1,0 +1,155 @@
+"""Vision encoder: a CLIP-class ViT trunk + multimodal projector, in JAX.
+
+The encode stage of the E-P-D multimodal graph (reference
+examples/multimodal/components/encode_worker.py runs llava-1.5's CLIP
+tower; this is the TPU-native equivalent at configurable scale): patchify
+-> linear patch embedding + learned positions -> pre-LN transformer blocks
+-> final LN -> linear projector into the LLM's hidden space.  The output
+rows are a llava-style soft prompt, injected over the leading prompt
+positions by ``prefill_mm_and_sample`` (engine/step.py).
+
+TPU notes: the patch embedding is a reshape + one [P*P*3, H] matmul (no
+conv -- XLA maps it straight onto the MXU), attention is full bidirectional
+(no mask, no cache) so it is three batched GEMMs + softmax that XLA fuses,
+and the whole encode is one jit with static config.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 32
+    patch_size: int = 8
+    hidden_size: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    mlp_size: int = 128
+    out_dim: int = 64  # the LLM's hidden size (projector target)
+    eps: float = 1e-5
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls, out_dim: int = 64) -> "VisionConfig":
+        return cls(out_dim=out_dim)
+
+
+def init_vision_params(cfg: VisionConfig, key: jax.Array) -> Params:
+    H, P = cfg.hidden_size, cfg.patch_size
+    keys = iter(jax.random.split(key, 8 + 8 * cfg.num_layers))
+
+    def w(shape, scale=0.02):
+        return jax.random.normal(next(keys), shape, jnp.float32) * scale
+
+    params: Params = {
+        "patch_w": w((P * P * 3, H)),
+        "patch_b": jnp.zeros((H,), jnp.float32),
+        "pos": w((cfg.num_patches, H)),
+        "final_ln_g": jnp.ones((H,), jnp.float32),
+        "final_ln_b": jnp.zeros((H,), jnp.float32),
+        "proj": w((H, cfg.out_dim)),
+        "layers": [],
+    }
+    for _ in range(cfg.num_layers):
+        params["layers"].append(
+            {
+                "ln1_g": jnp.ones((H,), jnp.float32),
+                "ln1_b": jnp.zeros((H,), jnp.float32),
+                "ln2_g": jnp.ones((H,), jnp.float32),
+                "ln2_b": jnp.zeros((H,), jnp.float32),
+                "wqkv": w((H, 3 * H)),
+                "wo": w((H, H)),
+                "w1": w((H, cfg.mlp_size)),
+                "b1": jnp.zeros((cfg.mlp_size,), jnp.float32),
+                "w2": w((cfg.mlp_size, H)),
+                "b2": jnp.zeros((H,), jnp.float32),
+            }
+        )
+    return params
+
+
+def _layer_norm(x: jax.Array, g: jax.Array, b: jax.Array, eps: float) -> jax.Array:
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def encode_image(
+    params: Params,
+    cfg: VisionConfig,
+    images: jax.Array,  # [B, image_size, image_size, 3] f32 in [0, 1]
+) -> jax.Array:
+    """Images -> soft-prompt rows [B, num_patches, out_dim]."""
+    B = images.shape[0]
+    P, H, nH = cfg.patch_size, cfg.hidden_size, cfg.num_heads
+    g = cfg.image_size // P
+    # patchify: [B, g, P, g, P, 3] -> [B, g*g, P*P*3]
+    x = images.reshape(B, g, P, g, P, 3).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(B, g * g, P * P * 3)
+    x = x @ params["patch_w"] + params["patch_b"] + params["pos"]
+
+    D = H // nH
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    for lp in params["layers"]:
+        h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.eps)
+        qkv = (h @ lp["wqkv"]).reshape(B, -1, 3, nH, D)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(B, -1, H)
+        x = x + o @ lp["wo"]
+        h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.eps)
+        x = x + (jax.nn.gelu(h @ lp["w1"] + lp["b1"])) @ lp["w2"] + lp["b2"]
+
+    x = _layer_norm(x, params["final_ln_g"], params["final_ln_b"], cfg.eps)
+    return x @ params["proj"]  # [B, num_patches, out_dim]
+
+
+def decode_image_payload(
+    payload: Any, image_size: int
+) -> "jax.Array":
+    """Best-effort image decode for the encode worker's wire payload.
+
+    Accepts a nested list/array ``[H, W, 3]`` (already-decoded pixels), or
+    raw bytes / base64 text (hashed into a deterministic pseudo-image --
+    environments with PIL can decode real formats upstream and pass
+    pixels)."""
+    import base64
+    import hashlib
+
+    import numpy as np
+
+    if isinstance(payload, (list, tuple)) or (
+        isinstance(payload, np.ndarray) and payload.ndim == 3
+    ):
+        arr = np.asarray(payload, np.float32)
+    else:
+        if isinstance(payload, str):
+            try:
+                payload = base64.b64decode(payload)
+            except Exception:
+                payload = payload.encode()
+        digest = hashlib.sha256(bytes(payload)).digest()
+        rs = np.random.RandomState(
+            int.from_bytes(digest[:4], "big")
+        )
+        arr = rs.rand(image_size, image_size, 3).astype(np.float32)
+    # normalize/crop to the trunk's square input
+    out = np.zeros((image_size, image_size, 3), np.float32)
+    h = min(image_size, arr.shape[0])
+    w = min(image_size, arr.shape[1])
+    out[:h, :w] = arr[:h, :w, :3]
+    return jnp.asarray(out)

@@ -1,29 +1,31 @@
-"""E-P-D (Encode-Prefill-Decode) multimodal serving skeleton.
+"""E-P-D (Encode-Prefill-Decode) multimodal serving graph.
 
 Reference: examples/multimodal (encode_worker -> embeddings transferred to
-prefill -> decode, llava-style) and examples/hello_world/disagg_skeleton
-(the engine-free scaffold).  This is the TPU-native wiring of the same
-three-stage graph over the hub runtime:
+prefill -> decode, llava-style; the reference runs llava-1.5's CLIP tower,
+encode_worker.py).  This is the TPU-native wiring of the same three-stage
+graph over the hub runtime:
 
-- **EncodeWorker**: the vision tower.  Here a deterministic stand-in maps
-  an "image" payload to embedding tokens (a real deployment runs a ViT
-  under jit and produces soft-prompt embeddings); the contract is the
-  same: encode output must reach the prefill stage out-of-band of the
-  text tokens.
+- **EncodeWorker**: a real jitted ViT trunk + multimodal projector
+  (`dynamo_tpu.vision`): image -> patch embeddings -> transformer ->
+  soft-prompt rows in the LLM's hidden space.  The embeddings cross the
+  wire to the LLM stage out-of-band of the text tokens.
 - **Prefill/Decode**: the existing disaggregated LLM pair
   (`dynamo_tpu.llm.disagg`): the decode worker ships long prefills to the
-  prefill pool through the hub queue, KV pages come back over the data
-  plane.
+  prefill pool through the hub queue, and the soft prompt rides the
+  PreprocessedRequest (``mm_embeds``) into `prefill_mm_and_sample`'s
+  llava-style injection -- including across the remote-prefill hop.
 
-Flow per request: frontend -> encode endpoint (image -> prompt tokens) ->
+Flow per request: frontend -> encode endpoint (image -> embeddings) ->
 decode worker (conditional remote prefill) -> token stream back.
 
 Run:  python examples/multimodal/epd_skeleton.py
 """
 
 import asyncio
-import hashlib
 from typing import Any, AsyncIterator
+
+import jax
+import numpy as np
 
 from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
 from dynamo_tpu.llm.disagg import (
@@ -40,34 +42,39 @@ from dynamo_tpu.runtime.component import (
 )
 from dynamo_tpu.runtime.engine import Annotated, AsyncEngine, ResponseStream
 from dynamo_tpu.runtime.transports.hub import HubServer
+from dynamo_tpu.vision import (
+    VisionConfig,
+    decode_image_payload,
+    encode_image,
+    init_vision_params,
+)
 
 
 class EncodeWorker(AsyncEngine):
-    """The encode stage: image payload -> embedding token ids.
+    """The encode stage: a jitted CLIP-class ViT + projector.
 
-    Stand-in for a jitted vision encoder; deterministic on content so the
-    pipeline is testable.  Emits ONE item: {"image_tokens": [...]}."""
+    Emits ONE item: {"mm_embeds": [[...], ...]} -- soft-prompt rows in the
+    LLM's hidden space (reference encode_worker.py's embedding handoff)."""
 
-    def __init__(self, vocab_size: int = 60, num_image_tokens: int = 8) -> None:
-        self.vocab = vocab_size
-        self.n = num_image_tokens
+    def __init__(self, llm_hidden: int, seed: int = 0) -> None:
+        self.cfg = VisionConfig.tiny(out_dim=llm_hidden)
+        self.params = init_vision_params(self.cfg, jax.random.PRNGKey(seed))
 
     async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
-        image: bytes = (request.data or {}).get("image", b"")
-        if isinstance(image, str):
-            image = image.encode()
-        digest = hashlib.sha256(image).digest()
-        tokens = [2 + digest[i % len(digest)] % self.vocab for i in range(self.n)]
+        image = (request.data or {}).get("image", b"")
+        pixels = decode_image_payload(image, self.cfg.image_size)
+        embeds = encode_image(self.params, self.cfg, pixels[None])[0]
+        rows = np.asarray(embeds).tolist()
         ctx = request.ctx
 
         async def gen():
-            yield Annotated.from_data({"image_tokens": tokens})
+            yield Annotated.from_data({"mm_embeds": rows})
 
         return ResponseStream(ctx, gen())
 
 
 class EpdFrontend:
-    """Glue stage: call encode, splice image tokens ahead of the text
+    """Glue stage: call encode, splice the soft prompt ahead of the text
     prompt (llava-style), forward to the decode worker."""
 
     def __init__(self, encode_router: PushRouter, llm_router: PushRouter) -> None:
@@ -76,16 +83,19 @@ class EpdFrontend:
 
     async def generate_text(self, image: str, text_tokens: list, max_tokens: int):
         enc_stream = await self.encode.generate(Context.new({"image": image}))
-        image_tokens = None
+        mm_embeds = None
         async for item in enc_stream:
             data = item.data or {}
-            if "image_tokens" in data:
-                image_tokens = data["image_tokens"]
-        assert image_tokens is not None, "encode worker returned nothing"
+            if "mm_embeds" in data:
+                mm_embeds = data["mm_embeds"]
+        assert mm_embeds is not None, "encode worker returned nothing"
 
+        # placeholder ids hold the soft prompt's positions (ignored by the
+        # injected embed rows); text tokens follow
         req = PreprocessedRequest(
-            token_ids=image_tokens + list(text_tokens),
+            token_ids=[0] * len(mm_embeds) + list(text_tokens),
             stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+            mm_embeds=mm_embeds,
         )
         out = []
         # requests cross the request plane as JSON dicts (wire form)
@@ -115,7 +125,7 @@ async def main():
     # encode worker (its own process in production)
     ert = await DistributedRuntime.detached(addr)
     await ert.namespace("mm").component("encoder").endpoint("encode").serve(
-        EncodeWorker()
+        EncodeWorker(llm_hidden=decode_engine.model_cfg.hidden_size)
     )
 
     # decode worker: image+text prompts longer than 4 tokens prefill remotely
@@ -155,8 +165,10 @@ async def main():
     )
     print(f"E-P-D generated {len(tokens)} tokens: {tokens}")
     assert len(tokens) == 8
-    # the 11-token prompt (8 image + 3 text) exceeded the 4-token local
-    # cap, so the prefill stage really ran remotely
+    # the 19-token prompt (16 soft-prompt patches + 3 text) exceeded the
+    # 4-token local cap, so the prefill stage really ran remotely -- the
+    # soft prompt crossed BOTH wire hops (encode -> frontend -> queue ->
+    # prefill worker) and was injected by the remote prefill dispatch
     assert decode.remote_prefills == 1
 
     await pw.stop()

@@ -211,3 +211,38 @@ def test_batch_mode_runs_prompt_file(run, tmp_path, model_dir, capsys):
     assert lines[0]["text"] == "hello world"
     assert all(l["response"] for l in lines)
     assert all("error" not in l for l in lines)
+
+
+def test_resolve_model_path(tmp_path, monkeypatch):
+    """Local dirs pass through; org/repo ids resolve via the HF hub;
+    anything else fails loudly (reference local_model.rs:27)."""
+    import pytest
+
+    from dynamo_tpu.llm.local_model import resolve_model_path
+
+    assert resolve_model_path(str(tmp_path)) == str(tmp_path)
+
+    with pytest.raises(SystemExit, match="neither a local directory"):
+        resolve_model_path("/no/such/dir")
+
+    calls = {}
+
+    def fake_snapshot(repo_id, allow_patterns=None):
+        calls["repo"] = repo_id
+        calls["patterns"] = allow_patterns
+        return str(tmp_path / "snap")
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", fake_snapshot)
+    got = resolve_model_path("org/some-model")
+    assert got == str(tmp_path / "snap")
+    assert calls["repo"] == "org/some-model"
+    assert "*.safetensors" in calls["patterns"]
+
+    def failing_snapshot(repo_id, allow_patterns=None):
+        raise ConnectionError("no egress")
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", failing_snapshot)
+    with pytest.raises(SystemExit, match="could not resolve"):
+        resolve_model_path("org/other-model")

@@ -476,3 +476,50 @@ def test_delivery_while_waiting_for_slot_outlives_timeout(run):
             await prefiller.stop()
 
     run(body())
+
+
+def test_disagg_conf_live_reload(run):
+    """An operator hub write (dynamo-tpu disagg-conf) hot-reloads the
+    decode worker's routing thresholds -- no restart (reference
+    disagg_router.rs:38-90)."""
+
+    async def body():
+        import json
+
+        from dynamo_tpu.llm.disagg import disagg_conf_key
+
+        hub = HubServer()
+        host, port = await hub.start()
+        rt = await DistributedRuntime.detached(f"{host}:{port}")
+        ns = rt.namespace("disagg")
+        engine = make_engine()
+        disagg = DisaggDecodeEngine(
+            engine, ns, "decode", instance_id=0,
+            cfg=DisaggConfig(max_local_prefill_length=8,
+                             max_prefill_queue_depth=4),
+        )
+        await disagg.start_config_watch()
+        try:
+            assert disagg.router.cfg.max_local_prefill_length == 8
+            await rt.hub.kv_put(
+                disagg_conf_key("disagg"),
+                json.dumps({"max_local_prefill_length": 100,
+                            "max_prefill_queue_depth": 2}).encode(),
+            )
+            for _ in range(50):
+                if disagg.router.cfg.max_local_prefill_length == 100:
+                    break
+                await asyncio.sleep(0.05)
+            assert disagg.router.cfg.max_local_prefill_length == 100
+            assert disagg.router.cfg.max_prefill_queue_depth == 2
+            # malformed update is ignored, policy untouched
+            await rt.hub.kv_put(disagg_conf_key("disagg"), b"not json")
+            await asyncio.sleep(0.2)
+            assert disagg.router.cfg.max_local_prefill_length == 100
+        finally:
+            await disagg.stop_config_watch()
+            await engine.stop()
+            await rt.shutdown()
+            await hub.stop()
+
+    run(body())

@@ -1,0 +1,172 @@
+"""Multimodal (llava-style soft prompt) tests: the vision trunk, the
+engine's embedding injection, and delivery across the disagg hop.
+
+Reference: examples/multimodal/components/encode_worker.py (CLIP tower ->
+embedding handoff to prefill)."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.vision import (
+    VisionConfig,
+    decode_image_payload,
+    encode_image,
+    init_vision_params,
+)
+
+from tests.test_jax_engine import collect, make_engine, req
+
+
+def mm_req(mm_embeds, text_tokens, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=[0] * len(mm_embeds) + list(text_tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.0),
+        mm_embeds=[list(map(float, r)) for r in np.asarray(mm_embeds)],
+    )
+
+
+def test_vision_trunk_shapes_and_determinism():
+    cfg = VisionConfig.tiny(out_dim=48)
+    params = init_vision_params(cfg, jax.random.PRNGKey(0))
+    imgs = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    out1 = np.asarray(encode_image(params, cfg, imgs))
+    out2 = np.asarray(encode_image(params, cfg, imgs))
+    assert out1.shape == (2, cfg.num_patches, 48)
+    np.testing.assert_array_equal(out1, out2)
+    assert np.isfinite(out1).all()
+    # different images -> different embeddings
+    imgs2 = imgs.copy()
+    imgs2[0, :8, :8] = 0.0
+    out3 = np.asarray(encode_image(params, cfg, imgs2))
+    assert np.abs(out3[0] - out1[0]).max() > 1e-4
+
+
+def test_decode_image_payload_forms():
+    px = decode_image_payload([[ [0.5]*3 ]*4]*4, image_size=8)
+    assert px.shape == (8, 8, 3)
+    a = decode_image_payload(b"some-bytes", image_size=8)
+    b = decode_image_payload(b"some-bytes", image_size=8)
+    c = decode_image_payload(b"other-bytes", image_size=8)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-4
+
+
+def test_mm_injection_of_token_embeddings_matches_token_prompt(run):
+    """The precise injection semantics: feeding the model's OWN embedding
+    rows as mm_embeds must reproduce the plain token prompt's greedy output
+    exactly -- same values enter the trunk either way."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [5, 9, 2, 6, 3, 1]
+            expect, _ = await collect(engine, req(prompt, max_tokens=6))
+
+            embed = np.asarray(engine.params["embed"], np.float32)
+            rows = embed[prompt[:4]]  # soft prompt = first 4 tokens' rows
+            r = mm_req(rows, prompt[4:], max_tokens=6)
+            got, _ = await collect(engine, r)
+            assert got == expect
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_mm_requests_differ_by_image_and_are_deterministic(run):
+    async def body():
+        engine = make_engine()
+        try:
+            rs = np.random.RandomState(0)
+            e1 = rs.randn(4, engine.model_cfg.hidden_size) * 0.02
+            e2 = rs.randn(4, engine.model_cfg.hidden_size) * 0.02
+            t1, _ = await collect(engine, mm_req(e1, [5, 6, 7]))
+            t1b, _ = await collect(engine, mm_req(e1, [5, 6, 7]))
+            t2, _ = await collect(engine, mm_req(e2, [5, 6, 7]))
+            assert t1 == t1b  # deterministic
+            assert t1 != t2  # the soft prompt actually reaches the trunk
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_mm_soft_prompt_survives_disagg_hop(run):
+    """The embedding delivery test: a remote prefill must inject the same
+    soft prompt the aggregated engine does -- identical greedy output."""
+
+    async def body():
+        from dynamo_tpu.llm.disagg import (
+            KV_DELIVER_ENDPOINT,
+            DisaggConfig,
+            DisaggDecodeEngine,
+            PrefillWorker,
+        )
+        from dynamo_tpu.runtime.component import DistributedRuntime, PushRouter
+        from dynamo_tpu.runtime.transports.hub import HubServer
+
+        rs = np.random.RandomState(3)
+        agg = make_engine()
+        try:
+            embeds = rs.randn(8, agg.model_cfg.hidden_size) * 0.02
+            r = mm_req(embeds, [5, 6, 7], max_tokens=6)
+            expect, _ = await collect(agg, PreprocessedRequest.from_dict(r.to_dict()))
+        finally:
+            await agg.stop()
+
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        drt = await DistributedRuntime.detached(addr)
+        dns = drt.namespace("mm")
+        decode_engine = make_engine()
+        disagg = DisaggDecodeEngine(
+            decode_engine, dns, "decode", instance_id=drt.primary_lease,
+            cfg=DisaggConfig(max_local_prefill_length=4), block_size=4,
+        )
+        await dns.component("decode").endpoint(KV_DELIVER_ENDPOINT).serve_raw(
+            disagg.kv_deliver_handler()
+        )
+        await dns.component("decode").endpoint("generate").serve(disagg)
+        prt = await DistributedRuntime.detached(addr)
+        prefill_engine = make_engine()
+        pw = PrefillWorker(prefill_engine, prt.namespace("mm"),
+                           allow_local=False)
+        await pw.start()
+        crt = await DistributedRuntime.detached(addr)
+        client = await (
+            crt.namespace("mm").component("decode").endpoint("generate").client()
+        )
+        await client.wait_for_instances()
+        try:
+            r = mm_req(embeds, [5, 6, 7], max_tokens=6)
+            stream = await PushRouter(client).generate(
+                Context.new(r.to_dict())
+            )
+            toks = []
+            async for item in stream:
+                assert not item.is_error(), item.error_message()
+                toks.extend((item.data or {}).get("token_ids") or [])
+            assert toks == expect
+            assert disagg.remote_prefills == 1  # 11 tokens > 4: went remote
+            assert pw.prefills_done == 1
+        finally:
+            await pw.stop()
+            await client.close()
+            await prefill_engine.stop()
+            await decode_engine.stop()
+            for rt in (drt, prt, crt):
+                await rt.shutdown()
+            await hub.stop()
+
+    run(body())

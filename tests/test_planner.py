@@ -210,3 +210,153 @@ def test_load_ramp_scales_mocker_fleet(run):
                 await e.stop()
 
     run(body())
+
+
+def test_prefill_trend_suppresses_scale_up(run):
+    """Reference planner.py:281-291: when the queue is above threshold but
+    the per-interval trend predicts it drains within the buffer period, the
+    scale-up is suppressed; a rising trend still scales."""
+
+    async def body():
+        conn = FakeConnector(prefill=1)
+        depth = {"v": 12}
+
+        async def qdepth():
+            return depth["v"]
+
+        planner = Planner(
+            conn,
+            metrics_source=lambda: {},
+            queue_depth_source=qdepth,
+            cfg=PlannerConfig(prefill_grace_periods=3, max_prefill_workers=4),
+        )
+        await planner.step()  # first step: no trend yet, scales up
+        assert conn.counts[PREFILL] == 2
+        # ride out the grace window with a draining queue
+        depth["v"] = 9
+        await planner.step()
+        depth["v"] = 8
+        await planner.step()
+        depth["v"] = 7
+        await planner.step()
+        assert conn.counts[PREFILL] == 2  # grace held
+        # still above threshold (5/2 = 2.5 > 2.0) but the trend (-2/interval)
+        # predicts 5 - 6 < 0 -> <= threshold: hold
+        depth["v"] = 5
+        await planner.step()
+        assert conn.counts[PREFILL] == 2
+        assert planner.adjustments[-1].action == "hold"
+        assert "trend" in planner.adjustments[-1].reason
+        # rising queue: trend no longer saves it, scale up
+        depth["v"] = 40
+        await planner.step()
+        assert conn.counts[PREFILL] == 3
+
+    run(body())
+
+
+def _fake_kubectl(tmp_path):
+    """A kubectl stand-in: replica state lives in a JSON file; supports the
+    two invocations the connector issues (get jsonpath / patch -p)."""
+    import json as _json
+    import os
+    import stat
+
+    state = tmp_path / "k8s_state.json"
+    state.write_text(_json.dumps({}))
+    script = tmp_path / "kubectl"
+    script.write_text(
+        "#!/usr/bin/env python3\n"
+        "import json, sys\n"
+        f"STATE = {str(state)!r}\n"
+        "args = sys.argv[1:]\n"
+        "state = json.load(open(STATE))\n"
+        "verb = args[0]\n"
+        "name = args[2]\n"
+        "if verb == 'get':\n"
+        "    if name not in state:\n"
+        "        sys.stderr.write('NotFound')\n"
+        "        sys.exit(1)\n"
+        "    sys.stdout.write(str(state[name]))\n"
+        "elif verb == 'patch':\n"
+        "    patch = json.loads(args[args.index('-p') + 1])\n"
+        "    state[name] = patch['spec']['replicas']\n"
+        "    json.dump(state, open(STATE, 'w'))\n"
+        "else:\n"
+        "    sys.exit(2)\n"
+    )
+    script.chmod(script.stat().st_mode | stat.S_IXUSR)
+    return script, state
+
+
+def test_kubernetes_connector_scales_rendered_deployment(run, tmp_path):
+    """End-to-end against the deploy.py-rendered graph: the connector's
+    deployment names match the manifests', and the planner drives replica
+    counts up and down through (fake) kubectl."""
+    import json
+
+    import yaml
+
+    from dynamo_tpu.deploy import DeploymentSpec, render_manifests
+    from dynamo_tpu.planner.connector import KubernetesConnector
+
+    spec = DeploymentSpec(
+        name="graph", model_path="/models/m", prefill_workers=1,
+        decode_workers=2,
+    )
+    manifests = render_manifests(spec)
+    decode = yaml.safe_load(manifests["decode-worker.yaml"])
+    kubectl, state = _fake_kubectl(tmp_path)
+    # seed the fake cluster from the rendered manifests ("kubectl apply")
+    seeded = {
+        decode["metadata"]["name"]: decode["spec"]["replicas"],
+        "graph-prefill": 1,
+    }
+    state.write_text(json.dumps(seeded))
+
+    async def body():
+        conn = KubernetesConnector("graph", kubectl=str(kubectl))
+        await conn.refresh()
+        # the connector targets exactly the names deploy.py rendered
+        assert conn.deployment(DECODE) == decode["metadata"]["name"]
+        assert conn.worker_count(DECODE) == 2
+
+        metrics = {1: fpm(0.95)}
+        depth = {"v": 0}
+
+        async def qdepth():
+            return depth["v"]
+
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            queue_depth_source=qdepth,
+            cfg=PlannerConfig(decode_grace_periods=0, max_decode_workers=4),
+        )
+        await planner.step()  # hot kv load: decode scales up via kubectl
+        assert json.loads(state.read_text())["graph-decode"] == 3
+        metrics[1] = fpm(0.05, waiting=0)
+        await planner.step()
+        await planner.step()
+        assert json.loads(state.read_text())["graph-decode"] == 1
+        # floor respected
+        await planner.step()
+        assert json.loads(state.read_text())["graph-decode"] == 1
+
+    run(body())
+
+
+def test_kubernetes_connector_missing_deployment_is_loud(run, tmp_path):
+    async def body():
+        from dynamo_tpu.planner.connector import KubernetesConnector
+
+        kubectl, _state = _fake_kubectl(tmp_path)
+        conn = KubernetesConnector("absent", kubectl=str(kubectl))
+        try:
+            await conn.refresh()
+        except RuntimeError as e:
+            assert "NotFound" in str(e)
+        else:
+            raise AssertionError("expected RuntimeError")
+
+    run(body())

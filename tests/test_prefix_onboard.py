@@ -1,0 +1,177 @@
+"""Cross-worker prefix onboarding (KVBM G4): worker B imports blocks that
+worker A prefilled, instead of recomputing them.
+
+Reference block_manager.rs:119-146 (blockset export/import across
+workers)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.prefix_onboard import (
+    DONOR_META_KEY,
+    KV_EXPORT_ENDPOINT,
+    PrefixOnboardEngine,
+    kv_export_handler,
+)
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+from tests.test_jax_engine import collect, make_engine, req
+
+
+def test_cross_worker_prefix_onboarding(run):
+    """Worker B serves a prefix prefilled on worker A without recompute:
+    the donor's blocks arrive via kv_export, stage in B's host tier, and
+    the normal offload-onboarding path scatters them into HBM."""
+
+    async def body():
+        prompt = [7, 3, 7, 3, 5, 5, 9, 1, 2, 8, 4, 6]  # 12 tokens, 2 blocks
+
+        plain = make_engine()
+        try:
+            expect, _ = await collect(plain, req(prompt, max_tokens=6))
+        finally:
+            await plain.stop()
+
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+
+        # worker A (donor): run the prompt so its pool registers the blocks
+        art = await DistributedRuntime.detached(addr)
+        a_engine = make_engine()
+        a_ns = art.namespace("onb")
+        await a_ns.component("workers").endpoint(KV_EXPORT_ENDPOINT).serve_raw(
+            kv_export_handler(a_engine)
+        )
+        got_a, _ = await collect(a_engine, req(prompt, max_tokens=6))
+        assert got_a == expect
+
+        # worker B (importer): fresh engine, host tier for import staging
+        brt = await DistributedRuntime.detached(addr)
+        b_engine = make_engine(host_offload_blocks=8)
+        wrapper = PrefixOnboardEngine(
+            b_engine, brt.namespace("onb"), "workers"
+        )
+        try:
+            ctx = Context.new(req(prompt, max_tokens=6))
+            ctx.metadata[DONOR_META_KEY] = {
+                "instance": art.primary_lease,
+                "blocks": 2,
+            }
+            stream = await wrapper.generate(ctx)
+            toks = []
+            async for item in stream:
+                assert not item.is_error(), item.error_message()
+                toks.extend((item.data or {}).get("token_ids") or [])
+            assert toks == expect
+            assert wrapper.onboarded_blocks == 2
+            assert wrapper.failed_fetches == 0
+            # the prefix really was reused, not recomputed: 2 blocks x 4
+            # tokens of the prompt hit B's cache
+            assert b_engine._prefix_hits == 8
+        finally:
+            await wrapper.close()
+            await b_engine.stop()
+            await a_engine.stop()
+            await art.shutdown()
+            await brt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_onboarding_donor_failure_recomputes(run):
+    """A dead/absent donor must not fail the request -- it just recomputes
+    (onboarding is an optimization, never a correctness dependency)."""
+
+    async def body():
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        plain = make_engine()
+        try:
+            expect, _ = await collect(plain, req(prompt, max_tokens=4))
+        finally:
+            await plain.stop()
+
+        hub = HubServer()
+        host, port = await hub.start()
+        brt = await DistributedRuntime.detached(f"{host}:{port}")
+        b_engine = make_engine(host_offload_blocks=8)
+        wrapper = PrefixOnboardEngine(
+            b_engine, brt.namespace("onb"), "workers"
+        )
+        try:
+            ctx = Context.new(req(prompt, max_tokens=4))
+            ctx.metadata[DONOR_META_KEY] = {"instance": 0xDEAD, "blocks": 2}
+            stream = await wrapper.generate(ctx)
+            toks = []
+            async for item in stream:
+                assert not item.is_error(), item.error_message()
+                toks.extend((item.data or {}).get("token_ids") or [])
+            assert toks == expect
+            assert wrapper.failed_fetches == 1
+            assert wrapper.onboarded_blocks == 0
+        finally:
+            await wrapper.close()
+            await b_engine.stop()
+            await brt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_router_donor_hint():
+    """find_best_match_with_donor surfaces the best *other* worker when it
+    holds a longer prefix than the chosen one."""
+    import asyncio as aio
+
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+    from dynamo_tpu.llm.kv_router.router import KvRouter
+    from dynamo_tpu.tokens.hashing import hash_blocks
+
+    class OneWorkerScheduler:
+        def __init__(self, pick):
+            self.pick = pick
+
+        def schedule(self, overlap, isl):
+            return self.pick
+
+    tokens = list(range(32))
+    block_size = 4
+    _, hashes = hash_blocks(tokens, block_size)
+
+    indexer = KvIndexer(block_size)
+    # worker 1 holds 6 blocks of the prefix, worker 2 holds 2
+    for i, h in enumerate(hashes[:6]):
+        indexer.apply_event(
+            1,
+            {"type": "stored", "blocks": [
+                {"block_hash": i, "sequence_hash": h,
+                 "parent_sequence_hash": 0, "position": i}
+            ]},
+        )
+    for i, h in enumerate(hashes[:2]):
+        indexer.apply_event(
+            2,
+            {"type": "stored", "blocks": [
+                {"block_hash": i, "sequence_hash": h,
+                 "parent_sequence_hash": 0, "position": i}
+            ]},
+        )
+
+    router = KvRouter.__new__(KvRouter)
+    router.indexer = indexer
+    router.scheduler = OneWorkerScheduler(pick=2)
+    router.block_size = block_size
+
+    wid, own, donor = aio.run(router.find_best_match_with_donor(tokens))
+    assert wid == 2 and own == 2
+    assert donor == (1, 6)
+
+    # chosen worker already best: no donor
+    router.scheduler = OneWorkerScheduler(pick=1)
+    wid, own, donor = aio.run(router.find_best_match_with_donor(tokens))
+    assert wid == 1 and own == 6
+    assert donor is None
