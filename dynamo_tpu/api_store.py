@@ -1,0 +1,243 @@
+"""api-store: the deployment-artifact registry behind ``dynamo deploy``.
+
+Reference ``deploy/cloud/api-store`` (FastAPI + Postgres + S3, ~2.5k LoC):
+a REST service where built graph components are registered, versioned,
+uploaded, downloaded, and where deployment records live.  The TPU-native
+rebuild keeps the same REST surface shape but stores everything in the
+first-party hub -- component/version/deployment records in the KV space
+(``apistore/…``), artifact blobs in the object store -- so the registry
+shares the cluster's one control plane instead of dragging in a SQL
+database and an S3 bucket.
+
+Routes (`/api/v1`, mirroring the reference's dynamo_components API):
+
+  POST /api/v1/components                     {"name", "description"?}
+  GET  /api/v1/components
+  GET  /api/v1/components/{name}
+  POST /api/v1/components/{name}/versions     {"version", "manifest"?}
+  GET  /api/v1/components/{name}/versions
+  PUT  /api/v1/components/{name}/versions/{v}/artifact   (raw body)
+  GET  /api/v1/components/{name}/versions/{v}/artifact
+  POST /api/v1/deployments                    {"name", "spec"}
+  GET  /api/v1/deployments
+  GET  /health
+
+Run: ``dynamo-tpu api-store --hub H:P [--port 8282]``.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import time
+from typing import Any, Dict, Optional
+
+from .http.server import HttpServer, Request, Response
+
+logger = logging.getLogger("dynamo.api_store")
+
+KV_COMPONENT = "apistore/components/{name}"
+KV_VERSION = "apistore/components/{name}/versions/{version}"
+KV_DEPLOYMENT = "apistore/deployments/{name}"
+OBJ_ARTIFACT = "apistore/artifacts/{name}/{version}"
+
+_NAME_RE = re.compile(r"^[\w][\w.-]{0,127}$")
+
+
+def _bad(msg: str, status: int = 400) -> Response:
+    return Response.json({"error": msg}, status)
+
+
+class ApiStoreService:
+    """REST registry over the hub (see module docstring)."""
+
+    def __init__(self, hub, host: str = "0.0.0.0", port: int = 8282) -> None:
+        self.hub = hub
+        self.server = HttpServer(host=host, port=port)
+        self.server.fallback = self._dispatch
+
+    @property
+    def address(self):
+        return self.server.address
+
+    async def start(self) -> None:
+        await self.server.start()
+        logger.info("api-store listening on %s:%d", *self.server.address)
+
+    async def stop(self) -> None:
+        await self.server.stop()
+
+    # -- routing (path-parameterized, so the fallback handler does it) ------
+
+    async def _dispatch(self, req: Request) -> Response:
+        try:
+            parts = [p for p in req.path.split("?")[0].split("/") if p]
+            m = req.method.upper()
+            if parts == ["health"]:
+                return Response.json({"status": "ok"})
+            if len(parts) < 2 or parts[0] != "api" or parts[1] != "v1":
+                return _bad("not found", 404)
+            rest = parts[2:]
+            if rest == ["components"]:
+                if m == "POST":
+                    return await self._create_component(req)
+                if m == "GET":
+                    return await self._list(KV_COMPONENT.format(name=""))
+            elif len(rest) == 2 and rest[0] == "components":
+                if m == "GET":
+                    return await self._get(KV_COMPONENT.format(name=rest[1]))
+            elif len(rest) == 3 and rest[0] == "components" and rest[2] == "versions":
+                if m == "POST":
+                    return await self._create_version(req, rest[1])
+                if m == "GET":
+                    return await self._list(
+                        KV_VERSION.format(name=rest[1], version="")
+                    )
+            elif (
+                len(rest) == 5
+                and rest[0] == "components"
+                and rest[2] == "versions"
+                and rest[4] == "artifact"
+            ):
+                if m == "PUT":
+                    return await self._put_artifact(req, rest[1], rest[3])
+                if m == "GET":
+                    return await self._get_artifact(rest[1], rest[3])
+            elif rest == ["deployments"]:
+                if m == "POST":
+                    return await self._create_deployment(req)
+                if m == "GET":
+                    return await self._list(KV_DEPLOYMENT.format(name=""))
+            elif len(rest) == 2 and rest[0] == "deployments":
+                if m == "GET":
+                    return await self._get(KV_DEPLOYMENT.format(name=rest[1]))
+            return _bad("not found", 404)
+        except Exception as e:  # noqa: BLE001 - REST boundary
+            logger.exception("api-store request failed")
+            return _bad(f"internal error: {e}", 500)
+
+    # -- records -------------------------------------------------------------
+
+    async def _create_component(self, req: Request) -> Response:
+        import json
+
+        body = req.json() or {}
+        name = body.get("name") or ""
+        if not _NAME_RE.match(name):
+            return _bad("'name' must match [A-Za-z0-9_.-]{1,128}")
+        record = {
+            "name": name,
+            "description": body.get("description") or "",
+            "created_at": time.time(),
+        }
+        created = await self.hub.kv_create(
+            KV_COMPONENT.format(name=name), json.dumps(record).encode()
+        )
+        if not created:
+            return _bad(f"component {name!r} already exists", 409)
+        return Response.json(record, 201)
+
+    async def _create_version(self, req: Request, name: str) -> Response:
+        import json
+
+        if not await self._exists(KV_COMPONENT.format(name=name)):
+            return _bad(f"component {name!r} not found", 404)
+        body = req.json() or {}
+        version = body.get("version") or ""
+        if not _NAME_RE.match(version):
+            return _bad("'version' must match [A-Za-z0-9_.-]{1,128}")
+        record = {
+            "name": name,
+            "version": version,
+            "manifest": body.get("manifest") or {},
+            "upload_status": "pending",  # reference DynamoComponentUploadStatus
+            "created_at": time.time(),
+        }
+        created = await self.hub.kv_create(
+            KV_VERSION.format(name=name, version=version),
+            json.dumps(record).encode(),
+        )
+        if not created:
+            return _bad(f"version {name}:{version} already exists", 409)
+        return Response.json(record, 201)
+
+    async def _put_artifact(self, req: Request, name: str, version: str) -> Response:
+        import json
+
+        key = KV_VERSION.format(name=name, version=version)
+        match = [
+            v for k, v in await self.hub.kv_get_prefix(key) if k == key
+        ]
+        if not match:
+            return _bad(f"version {name}:{version} not found", 404)
+        await self.hub.obj_put(
+            OBJ_ARTIFACT.format(name=name, version=version), req.body
+        )
+        record = json.loads(match[0])
+        record["upload_status"] = "success"
+        record["artifact_bytes"] = len(req.body)
+        await self.hub.kv_put(key, json.dumps(record).encode())
+        return Response.json(record)
+
+    async def _get_artifact(self, name: str, version: str) -> Response:
+        blob = await self.hub.obj_get(
+            OBJ_ARTIFACT.format(name=name, version=version)
+        )
+        if blob is None:
+            return _bad(f"artifact {name}:{version} not found", 404)
+        return Response(
+            status=200,
+            headers={"Content-Type": "application/octet-stream"},
+            body=blob,
+        )
+
+    async def _create_deployment(self, req: Request) -> Response:
+        import json
+
+        body = req.json() or {}
+        name = body.get("name") or ""
+        if not _NAME_RE.match(name):
+            return _bad("'name' must match [A-Za-z0-9_.-]{1,128}")
+        record = {
+            "name": name,
+            "spec": body.get("spec") or {},
+            "created_at": time.time(),
+        }
+        # deployments are upserts: re-deploying a graph updates the record
+        await self.hub.kv_put(
+            KV_DEPLOYMENT.format(name=name), json.dumps(record).encode()
+        )
+        return Response.json(record, 201)
+
+    # -- shared helpers ------------------------------------------------------
+
+    async def _exists(self, key: str) -> bool:
+        # exact-key check: a prefix hit on a sibling ("comp" vs "comp2")
+        # must not count
+        return any(k == key for k, _v in await self.hub.kv_get_prefix(key))
+
+    async def _get(self, key: str) -> Response:
+        import json
+
+        entries = await self.hub.kv_get_prefix(key)
+        for k, v in entries:
+            if k == key:
+                return Response.json(json.loads(v))
+        return _bad("not found", 404)
+
+    async def _list(self, prefix: str) -> Response:
+        import json
+
+        entries = await self.hub.kv_get_prefix(prefix)
+        items = []
+        for k, v in entries:
+            # versions live UNDER component keys; a component listing must
+            # not include them
+            tail = k[len(prefix):]
+            if "/" in tail:
+                continue
+            try:
+                items.append(json.loads(v))
+            except Exception:
+                continue
+        return Response.json({"items": items, "total": len(items)})

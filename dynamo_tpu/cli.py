@@ -132,6 +132,34 @@ def build_parser() -> argparse.ArgumentParser:
     rm = ctlsub.add_parser("remove", help="deregister a model by name")
     rm.add_argument("name")
 
+    # api-store: deployment-artifact registry (reference deploy/cloud/
+    # api-store -- FastAPI+Postgres+S3 there, the hub here)
+    ap = sub.add_parser("api-store",
+                        help="run the deployment-artifact registry")
+    ap.add_argument("--hub", required=True, help="hub address host:port")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8282)
+
+    # build/deploy: graph packaging against the api-store (reference
+    # `dynamo build` -> api-store upload, `dynamo deploy` -> manifests)
+    bd = sub.add_parser("build",
+                        help="package a graph dir and push it to api-store")
+    bd.add_argument("--store", required=True,
+                    help="api-store base url, e.g. http://H:8282")
+    bd.add_argument("--name", required=True)
+    bd.add_argument("--version", required=True)
+    bd.add_argument("--path", required=True, help="graph directory to package")
+    dp = sub.add_parser("deploy",
+                        help="fetch a built graph and render its k8s manifests")
+    dp.add_argument("--store", required=True)
+    dp.add_argument("--name", required=True)
+    dp.add_argument("--version", required=True)
+    dp.add_argument("--out-dir", required=True,
+                    help="where manifests + the unpacked artifact land")
+    dp.add_argument("--model-path", default="/models/model",
+                    help="model path the rendered workers mount")
+    dp.add_argument("--image", default="dynamo-tpu:latest")
+
     # disagg-conf: live-reload the disagg routing policy (reference
     # disagg_router.rs:38-90 etcd watch); decode workers pick it up without
     # restarts
@@ -841,6 +869,144 @@ async def _wire_prefix_onboard(served, engine, ns, comp, comp_name):
     return PrefixOnboardEngine(served, ns, comp_name, engine=engine)
 
 
+def run_build(args) -> int:
+    """Package a graph directory (tar.gz) and register it with api-store
+    (reference `dynamo build`: containerize + push; here the artifact is the
+    graph source + manifest, and the runtime image is container/Dockerfile's
+    -- one image serves every graph, args select the role)."""
+    import io
+    import json as _json
+    import tarfile
+    import urllib.request
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        tar.add(args.path, arcname=args.name)
+    blob = buf.getvalue()
+
+    base = args.store.rstrip("/")
+
+    def post(path, body):
+        req = urllib.request.Request(
+            base + path, data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, _json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read() or b"{}")
+
+    status, out = post("/api/v1/components", {"name": args.name})
+    if status not in (201, 409):  # existing component is fine
+        raise SystemExit(f"component create failed: {status} {out}")
+    status, out = post(
+        f"/api/v1/components/{args.name}/versions",
+        {"version": args.version, "manifest": {"entry": args.name}},
+    )
+    if status != 201:
+        raise SystemExit(f"version create failed: {status} {out}")
+    req = urllib.request.Request(
+        f"{base}/api/v1/components/{args.name}/versions/{args.version}/artifact",
+        data=blob, method="PUT",
+        headers={"Content-Type": "application/octet-stream"},
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            out = _json.load(r)
+    except urllib.error.HTTPError as e:
+        raise SystemExit(
+            f"artifact upload failed: HTTP {e.code} {e.read()[:200]!r}"
+        )
+    print(
+        f"built {args.name}:{args.version} "
+        f"({out.get('artifact_bytes', len(blob))} bytes) -> {base}"
+    )
+    return 0
+
+
+def run_deploy(args) -> int:
+    """Fetch a built graph from api-store, unpack it, render its k8s
+    manifests, and record the deployment (reference `dynamo deploy`)."""
+    import io
+    import json as _json
+    import os
+    import tarfile
+    import urllib.request
+
+    base = args.store.rstrip("/")
+    url = (
+        f"{base}/api/v1/components/{args.name}/versions/"
+        f"{args.version}/artifact"
+    )
+    try:
+        with urllib.request.urlopen(url) as r:
+            blob = r.read()
+    except urllib.error.HTTPError as e:
+        raise SystemExit(
+            f"{args.name}:{args.version} not fetchable from {base}: "
+            f"HTTP {e.code} {e.read()[:200]!r}"
+        )
+    os.makedirs(args.out_dir, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+        try:
+            tar.extractall(args.out_dir, filter="data")
+        except TypeError:  # 3.10 < 3.10.12 lacks the filter kwarg
+            tar.extractall(args.out_dir)  # noqa: S202 - own-store artifact
+
+    from .deploy import DeploymentSpec, render_manifests
+
+    spec = DeploymentSpec(
+        name=args.name, model_path=args.model_path, image=args.image
+    )
+    mdir = os.path.join(args.out_dir, "manifests")
+    os.makedirs(mdir, exist_ok=True)
+    for fname, text in render_manifests(spec).items():
+        with open(os.path.join(mdir, fname), "w") as f:
+            f.write(text)
+    req = urllib.request.Request(
+        base + "/api/v1/deployments",
+        data=_json.dumps(
+            {"name": args.name,
+             "spec": {"version": args.version, "image": args.image,
+                      "model_path": args.model_path}}
+        ).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req):
+            pass
+    except urllib.error.HTTPError as e:
+        raise SystemExit(
+            f"deployment record failed: HTTP {e.code} {e.read()[:200]!r}"
+        )
+    print(
+        f"deployed {args.name}:{args.version}: artifact + manifests under "
+        f"{args.out_dir} (kubectl apply -f {mdir})"
+    )
+    return 0
+
+
+async def run_api_store(args) -> int:
+    """Serve the deployment-artifact registry over the hub."""
+    from .api_store import ApiStoreService
+    from .runtime.component import DistributedRuntime
+
+    rt = await DistributedRuntime.detached(args.hub)
+    svc = ApiStoreService(rt.hub, host=args.host, port=args.port)
+    await svc.start()
+    print(f"api-store at http://{args.host}:{svc.address[1]} (hub {args.hub})")
+    stop = asyncio.Event()
+    rt.hub.on_connection_lost = stop.set
+    try:
+        await stop.wait()
+        print("hub connection lost; exiting", file=sys.stderr)
+        return 1
+    finally:
+        await svc.stop()
+        await rt.shutdown()
+
+
 async def run_disagg_conf(args) -> int:
     """Write the live disagg routing policy to the hub; every decode worker
     watching the key reloads it (llm/disagg.py start_config_watch)."""
@@ -904,6 +1070,12 @@ def main(argv=None) -> int:
         return asyncio.run(run_bench(args))
     if args.cmd == "disagg-conf":
         return asyncio.run(run_disagg_conf(args))
+    if args.cmd == "api-store":
+        return asyncio.run(run_api_store(args))
+    if args.cmd == "build":
+        return run_build(args)
+    if args.cmd == "deploy":
+        return run_deploy(args)
     args.inp, args.out = _parse_io(args.io)
     try:
         if args.inp == "http" and args.out in ("jax", "mocker", "echo"):

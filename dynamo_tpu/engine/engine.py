@@ -1209,16 +1209,13 @@ class JaxEngine:
         sp wins when both axes exist (one dispatch can't compose both shard
         maps; sequence parallelism is the long-context lever, SURVEY.md 5.7).
         Shape guards mirror the step functions' own: ring needs the bucket
-        divisible by sp and no sliding window; pp needs the layer count
-        divisible by pp and the batch divisible by the microbatch count."""
+        divisible by sp (sliding windows mask over global positions); pp
+        needs the layer count divisible by pp and the batch divisible by
+        the microbatch count."""
         if self.mesh is None or (self._sp <= 1 and self._pp <= 1):
             return None
         Bp = tokens.shape[0]
-        use_sp = (
-            self._sp > 1
-            and bucket % self._sp == 0
-            and not self.model_cfg.sliding_window
-        )
+        use_sp = self._sp > 1 and bucket % self._sp == 0
         use_pp = (
             not use_sp
             and self._pp > 1

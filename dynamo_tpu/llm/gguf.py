@@ -1,0 +1,201 @@
+"""GGUF tokenizer support: metadata parsing + HF-tokenizers conversion.
+
+Reference ``lib/llm/src/gguf`` (gguf_metadata.rs, gguf_tokenizer.rs):
+llama.cpp-ecosystem models ship as one ``.gguf`` file whose metadata embeds
+the tokenizer (tokens, scores/merges, special ids).  The reference parses
+the metadata and converts to a ``tokenizers`` object -- ``llama``-model
+files become Unigram (SentencePiece semantics: byte fallback, ``▁`` word
+boundaries), ``gpt2``-model files become byte-level BPE.  Same two
+conversions here, feeding the standard `llm.tokenizer.Tokenizer` facade:
+``--model-path model.gguf`` (or a dir containing one) gets its tokenizer
+from the GGUF metadata.
+
+Weights stay on the safetensors path: GGUF weight blocks are mostly
+llama.cpp quantization formats (Q4_K & co) whose TPU story is a separate
+dequantization design, documented as out of scope -- the reference
+likewise hands GGUF *inference* to its engines and only reads tokenizer +
+config metadata itself (SURVEY.md 2.2).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+from typing import Any, BinaryIO, Dict, Optional, Tuple
+
+logger = logging.getLogger("dynamo.gguf")
+
+GGUF_MAGIC = 0x46554747  # "GGUF" little-endian
+
+# metadata value types (gguf spec / gguf_metadata.rs)
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32 = 0, 1, 2, 3, 4, 5
+_T_F32, _T_BOOL, _T_STRING, _T_ARRAY, _T_U64, _T_I64, _T_F64 = (
+    6, 7, 8, 9, 10, 11, 12,
+)
+
+_SCALAR_FMT = {
+    _T_U8: "<B", _T_I8: "<b", _T_U16: "<H", _T_I16: "<h",
+    _T_U32: "<I", _T_I32: "<i", _T_F32: "<f", _T_U64: "<Q",
+    _T_I64: "<q", _T_F64: "<d",
+}
+
+
+def _read_scalar(f: BinaryIO, vtype: int) -> Any:
+    if vtype == _T_BOOL:
+        return struct.unpack("<B", f.read(1))[0] != 0
+    if vtype == _T_STRING:
+        (n,) = struct.unpack("<Q", f.read(8))
+        return f.read(n).decode("utf-8", errors="replace")
+    fmt = _SCALAR_FMT.get(vtype)
+    if fmt is None:
+        raise ValueError(f"unsupported GGUF value type {vtype}")
+    return struct.unpack(fmt, f.read(struct.calcsize(fmt)))[0]
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype == _T_ARRAY:
+        (etype,) = struct.unpack("<I", f.read(4))
+        (count,) = struct.unpack("<Q", f.read(8))
+        return [_read_value(f, etype) for _ in range(count)]
+    return _read_scalar(f, vtype)
+
+
+def read_gguf_metadata(path: str) -> Dict[str, Any]:
+    """Parse a GGUF file's metadata key/value section (tensors skipped)."""
+    with open(path, "rb") as f:
+        magic, version = struct.unpack("<II", f.read(8))
+        if magic != GGUF_MAGIC:
+            raise ValueError(f"{path}: not a GGUF file (magic {magic:#x})")
+        if version < 2:
+            raise ValueError(f"{path}: GGUF version {version} unsupported")
+        _tensor_count, kv_count = struct.unpack("<QQ", f.read(16))
+        meta: Dict[str, Any] = {}
+        for _ in range(kv_count):
+            (klen,) = struct.unpack("<Q", f.read(8))
+            key = f.read(klen).decode("utf-8", errors="replace")
+            (vtype,) = struct.unpack("<I", f.read(4))
+            meta[key] = _read_value(f, vtype)
+        return meta
+
+
+def find_gguf_file(model_path: str) -> Optional[str]:
+    """``model.gguf`` itself, or the single ``.gguf`` inside a directory."""
+    if model_path.endswith(".gguf") and os.path.isfile(model_path):
+        return model_path
+    if os.path.isdir(model_path):
+        ggufs = sorted(
+            f for f in os.listdir(model_path) if f.endswith(".gguf")
+        )
+        if ggufs:
+            return os.path.join(model_path, ggufs[0])
+    return None
+
+
+def gguf_tokenizer(path: str):
+    """Build a ``tokenizers.Tokenizer`` from GGUF metadata.
+
+    Returns ``(tokenizer, info)`` where info carries the special ids the
+    facade needs (bos/eos/add_bos).  Conversion mirrors
+    gguf_tokenizer.rs: ``llama``/``replit`` -> Unigram with SentencePiece
+    normalizer/decoder chains; ``gpt2`` -> byte-level BPE."""
+    from tokenizers import AddedToken, Tokenizer, decoders, normalizers
+    from tokenizers import models as tok_models
+    from tokenizers import pre_tokenizers
+
+    meta = read_gguf_metadata(path)
+
+    def g(key: str, required: bool = False) -> Any:
+        v = meta.get(f"tokenizer.ggml.{key}")
+        if v is None and required:
+            raise ValueError(f"{path}: missing tokenizer.ggml.{key}")
+        return v
+
+    model = g("model", required=True)
+    tokens = g("tokens", required=True)
+    bos = g("bos_token_id", required=True)
+    eos = g("eos_token_id", required=True)
+    unk = g("unknown_token_id")
+
+    if model in ("llama", "replit"):
+        scores = g("scores")
+        if scores is None:
+            raise ValueError(
+                f"{path}: `llama` unigram tokenizer requires "
+                "tokenizer.ggml.scores"
+            )
+        unk_id = int(unk) if unk is not None else 0
+        tok = Tokenizer(
+            tok_models.Unigram(
+                [(t, float(s)) for t, s in zip(tokens, scores)],
+                unk_id=unk_id,
+                byte_fallback=True,
+            )
+        )
+        tok.normalizer = normalizers.Sequence(
+            [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
+        )
+        tok.decoder = decoders.Sequence(
+            [
+                decoders.Replace("▁", " "),
+                decoders.ByteFallback(),
+                decoders.Fuse(),
+                decoders.Strip(" ", 1, 0),
+            ]
+        )
+    elif model == "gpt2":
+        merges_raw = g("merges")
+        if merges_raw is None:
+            raise ValueError(f"{path}: BPE tokenizer requires merges")
+        merges = []
+        for m in merges_raw:
+            a, _, b = m.partition(" ")
+            merges.append((a, b))
+        vocab = {t: i for i, t in enumerate(tokens)}
+        tok = Tokenizer(
+            tok_models.BPE(
+                vocab, merges,
+                unk_token=(tokens[int(unk)] if unk is not None else None),
+            )
+        )
+        tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        tok.decoder = decoders.ByteLevel()
+    else:
+        raise ValueError(f"{path}: tokenizer model {model!r} not supported")
+
+    specials = [tokens[int(bos)], tokens[int(eos)]]
+    if unk is not None:
+        specials.append(tokens[int(unk)])
+    tok.add_special_tokens([AddedToken(s, special=True) for s in specials])
+
+    # llama.cpp convention: SPM ("llama") tokenizers default to add_bos=true
+    # when the key is absent; BPE defaults to false
+    default_add_bos = model in ("llama", "replit")
+    add_bos = bool(meta.get("tokenizer.ggml.add_bos_token", default_add_bos))
+    if add_bos:
+        # llama-family semantics: encode(add_special_tokens=True) prepends
+        # BOS (llama.cpp/HF GGUF conversion installs the same
+        # post-processor; without it prompt ids silently lose their BOS)
+        from tokenizers import processors
+
+        bos_tok = tokens[int(bos)]
+        tok.post_processor = processors.TemplateProcessing(
+            single=f"{bos_tok} $A",
+            pair=f"{bos_tok} $A {bos_tok} $B",
+            special_tokens=[(bos_tok, int(bos))],
+        )
+
+    info = {
+        "bos_token_id": int(bos),
+        "eos_token_id": int(eos),
+        "unk_token_id": int(unk) if unk is not None else None,
+        "add_bos_token": add_bos,
+        # chat-tuned GGUFs embed their template in standard metadata
+        "chat_template": meta.get("tokenizer.chat_template"),
+        "model": model,
+    }
+    logger.info(
+        "gguf tokenizer: model=%s tokens=%d bos=%d eos=%d",
+        model, len(tokens), int(bos), int(eos),
+    )
+    return tok, info

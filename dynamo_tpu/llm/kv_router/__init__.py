@@ -7,7 +7,7 @@ cost-function scheduler over live worker metrics, and a PushRouter wrapper
 that sends each request to the worker with the best prefix overlap.
 """
 
-from .indexer import KvIndexer, OverlapScores
+from .indexer import KvIndexer, KvIndexerSharded, OverlapScores
 from .scheduler import KvRouterConfig, KvScheduler, DefaultWorkerSelector
 from .publisher import KvEventPublisher, WorkerMetricsPublisher
 from .metrics_aggregator import KvMetricsAggregator
@@ -18,6 +18,7 @@ __all__ = [
     "KV_EVENT_SUBJECT",
     "KvEventPublisher",
     "KvIndexer",
+    "KvIndexerSharded",
     "KvMetricsAggregator",
     "KvPushRouter",
     "KvRouter",

@@ -69,15 +69,23 @@ class _PyIndex:
                 if not ws:
                     del self.blocks[h]
 
-    def find_matches(self, hashes: Sequence[int]) -> Dict[int, int]:
+    def find_matches(
+        self, hashes: Sequence[int], early_exit: bool = True
+    ) -> Dict[int, int]:
         scores: Dict[int, int] = {}
         for h in hashes:
             ws = self.blocks.get(h)
             if not ws:
-                break  # early exit: deeper blocks chain through this one
+                if early_exit:
+                    break  # deeper blocks chain through this one
+                continue
             for w in ws:
                 scores[w] = scores.get(w, 0) + 1
         return scores
+
+    def coverage(self, hashes: Sequence[int]) -> List[bool]:
+        """Per-position: does ANY worker here hold the hash (sharded merge)."""
+        return [bool(self.blocks.get(h)) for h in hashes]
 
     @property
     def num_blocks(self) -> int:
@@ -113,6 +121,18 @@ class _NativeIndex:
         lib.dyn_radix_num_blocks.argtypes = [ctypes.c_void_p]
         lib.dyn_radix_num_workers.restype = ctypes.c_size_t
         lib.dyn_radix_num_workers.argtypes = [ctypes.c_void_p]
+        # sharded-index entry points (absent in pre-r4 cached builds; the
+        # sharded wrapper degrades to the py index when missing)
+        self.has_sharded_api = hasattr(lib, "dyn_radix_find_matches_all")
+        if self.has_sharded_api:
+            lib.dyn_radix_find_matches_all.restype = ctypes.c_size_t
+            lib.dyn_radix_find_matches_all.argtypes = (
+                lib.dyn_radix_find_matches.argtypes
+            )
+            lib.dyn_radix_coverage.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_void_p,
+            ]
         self._ptr = lib.dyn_radix_new()
         # reused across queries (single-threaded by contract): find_matches
         # is the per-request routing hot path
@@ -139,14 +159,29 @@ class _NativeIndex:
     def remove_worker(self, worker: int) -> None:
         self._lib.dyn_radix_remove_worker(self._ptr, worker)
 
-    def find_matches(self, hashes: Sequence[int]) -> Dict[int, int]:
+    def find_matches(
+        self, hashes: Sequence[int], early_exit: bool = True
+    ) -> Dict[int, int]:
         a = self._arr(hashes)
         out_w, out_s = self._out_w, self._out_s
-        k = self._lib.dyn_radix_find_matches(
+        fn = (
+            self._lib.dyn_radix_find_matches
+            if early_exit
+            else self._lib.dyn_radix_find_matches_all
+        )
+        k = fn(
             self._ptr, a.ctypes.data, len(a),
             out_w.ctypes.data, out_s.ctypes.data, self.MAX_WORKERS,
         )
         return {int(out_w[i]): int(out_s[i]) for i in range(k)}
+
+    def coverage(self, hashes: Sequence[int]) -> List[bool]:
+        a = self._arr(hashes)
+        out = np.zeros(len(a), dtype=np.uint8)
+        self._lib.dyn_radix_coverage(
+            self._ptr, a.ctypes.data, len(a), out.ctypes.data
+        )
+        return [bool(x) for x in out]
 
     @property
     def num_blocks(self) -> int:
@@ -215,3 +250,130 @@ class KvIndexer:
     @property
     def num_workers(self) -> int:
         return self._index.num_workers
+
+
+class KvIndexerSharded:
+    """Worker-sharded KV index (reference indexer.rs:696 KvIndexerSharded).
+
+    Large fleets overwhelm one index: the reference pins each worker to a
+    shard (least-loaded assignment), routes that worker's event stream to
+    its shard's thread, broadcasts match requests to every shard, and
+    merges the per-shard overlap scores.  Same structure here over N
+    :class:`KvIndexer` shards; a match executes the shards through a small
+    thread pool when the native index is in use (the ctypes calls drop the
+    GIL, so shard matching genuinely overlaps), and falls back to a
+    sequential sweep on the pure-Python index.
+    """
+
+    def __init__(
+        self,
+        block_size: int = 16,
+        num_shards: int = 4,
+        use_native: bool = True,
+    ) -> None:
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.block_size = block_size
+        self.shards = [
+            KvIndexer(block_size, use_native=use_native)
+            for _ in range(num_shards)
+        ]
+        if self.shards[0].native and not getattr(
+            self.shards[0]._index, "has_sharded_api", False
+        ):
+            # stale pre-r4 native build without coverage/no-exit entry
+            # points: correctness over speed, use the python index
+            self.shards = [
+                KvIndexer(block_size, use_native=False)
+                for _ in range(num_shards)
+            ]
+        self._assignment: Dict[int, int] = {}  # worker -> shard
+        self._counts = [0] * num_shards
+        self._pool = None
+        if self.shards[0].native and num_shards > 1:
+            import concurrent.futures
+
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=num_shards, thread_name_prefix="kv-index-shard"
+            )
+
+    def _shard_of(self, worker_id: int) -> int:
+        s = self._assignment.get(worker_id)
+        if s is None:
+            # least-loaded assignment (reference worker_counts)
+            s = min(range(len(self.shards)), key=lambda i: self._counts[i])
+            self._assignment[worker_id] = s
+            self._counts[s] += 1
+        return s
+
+    def apply_event(self, worker_id: int, event: Dict) -> None:
+        self.shards[self._shard_of(worker_id)].apply_event(worker_id, event)
+
+    def remove_worker(self, worker_id: int) -> None:
+        s = self._assignment.pop(worker_id, None)
+        if s is not None:
+            self._counts[s] -= 1
+            self.shards[s].remove_worker(worker_id)
+
+    def find_matches(self, sequence_hashes: Sequence[int]) -> OverlapScores:
+        """Two-pass match preserving the flat index's semantics exactly.
+
+        The flat walk stops at the first hash held by NO worker fleet-wide;
+        a single shard cannot see that boundary (a hole in its own workers'
+        holdings is not a fleet-wide hole).  Pass 1 ORs per-shard coverage
+        to find the global early-exit point; pass 2 sweeps each shard over
+        the truncated chain without a shard-local exit and merges (worker
+        sets are disjoint across shards)."""
+        hashes = list(sequence_hashes)
+        if not hashes:
+            return OverlapScores(scores={})
+
+        def shard_cov(sh):
+            return sh._index.coverage(hashes)
+
+        if self._pool is not None:
+            covs = list(self._pool.map(shard_cov, self.shards))
+        else:
+            covs = [shard_cov(sh) for sh in self.shards]
+        L = len(hashes)
+        for i in range(len(hashes)):
+            if not any(c[i] for c in covs):
+                L = i
+                break
+        prefix = hashes[:L]
+        if not prefix:
+            return OverlapScores(scores={})
+
+        def shard_match(sh):
+            return sh._index.find_matches(prefix, early_exit=False)
+
+        if self._pool is not None:
+            results = list(self._pool.map(shard_match, self.shards))
+        else:
+            results = [shard_match(sh) for sh in self.shards]
+        merged: Dict[int, int] = {}
+        for r in results:
+            merged.update(r)
+        return OverlapScores(scores=merged)
+
+    def find_matches_for_tokens(self, tokens: Sequence[int]) -> OverlapScores:
+        _, seq_hashes = _hashing.hash_blocks(tokens, self.block_size)
+        return self.find_matches(seq_hashes)
+
+    def close(self) -> None:
+        """Release the shard-matching thread pool (long-lived routers that
+        rebuild their index must not leak a pool per rebuild)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    @property
+    def num_blocks(self) -> int:
+        # sum of per-shard uniques: a block cached by workers on different
+        # shards counts once per shard (the reference's per-shard tries
+        # have the same property)
+        return sum(sh.num_blocks for sh in self.shards)
+
+    @property
+    def num_workers(self) -> int:
+        return len(self._assignment)

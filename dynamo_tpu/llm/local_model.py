@@ -31,14 +31,26 @@ _SNAPSHOT_PATTERNS = [
 def resolve_model_path(model_path: str) -> str:
     """Return a local directory for ``model_path``.
 
-    A path that exists on disk is returned unchanged; otherwise a string
-    shaped like ``org/repo`` is resolved through the HF hub (download or
-    cache hit).  Anything else fails with a clear error."""
+    A path that exists on disk (a model directory, or a single ``.gguf``
+    file) is returned unchanged; otherwise a string shaped like
+    ``org/repo`` is resolved through the HF hub (download or cache hit).
+    Anything else fails with a clear error."""
     if os.path.isdir(model_path):
         return model_path
+    if os.path.isfile(model_path):
+        # existence wins over the repo-id shape: a relative
+        # "models/weights.gguf" must never trigger a hub download.  Only
+        # .gguf is a meaningful single-file model; anything else fails
+        # here with a clear message instead of deep in a loader.
+        if model_path.endswith(".gguf"):
+            return model_path
+        raise SystemExit(
+            f"--model-path {model_path!r} is a file but not a .gguf; pass "
+            f"the model directory instead"
+        )
     if not _REPO_ID_RE.match(model_path):
         raise SystemExit(
-            f"--model-path {model_path!r} is neither a local directory nor "
+            f"--model-path {model_path!r} is neither a local path nor "
             f"an org/repo Hugging Face id"
         )
     try:

@@ -1,11 +1,12 @@
 """Tokenizer facade: one interface over HF ``tokenizers`` artifacts.
 
 Reference parity: lib/llm/src/tokenizers.rs:83-92 (``Tokenizer`` facade over
-HF tokenizers), :158-191 (``DecodeStream`` incremental decoding).  The TPU
-build drops the GGUF leg (gguf/gguf_tokenizer.rs) -- checkpoints arrive as HF
-model directories (tokenizer.json) -- and rides the same Rust ``tokenizers``
-core through its Python binding, so token ids are bit-identical with the
-reference for the same artifact.
+HF tokenizers), :158-191 (``DecodeStream`` incremental decoding), and the
+GGUF leg (gguf/gguf_tokenizer.rs -> ``llm/gguf.py``): a model dir carrying
+a ``.gguf`` file (or a ``.gguf`` path itself) gets its tokenizer converted
+from the GGUF metadata.  Either way the same Rust ``tokenizers`` core runs
+underneath through its Python binding, so token ids are bit-identical with
+the reference for the same artifact.
 """
 
 from __future__ import annotations
@@ -46,9 +47,22 @@ class Tokenizer:
 
     @classmethod
     def from_model_dir(cls, path: str) -> "Tokenizer":
-        tok_file = os.path.join(path, "tokenizer.json")
-        if not os.path.exists(tok_file):
-            raise TokenizerError(f"no tokenizer.json under {path}")
+        tok_file = os.path.join(path, "tokenizer.json") if os.path.isdir(path) else path
+        if not os.path.exists(tok_file) or tok_file.endswith(".gguf"):
+            # GGUF fallback: tokenizer.json absent but a .gguf present (or
+            # the path IS the gguf file) -- convert from GGUF metadata
+            from .gguf import find_gguf_file, gguf_tokenizer
+
+            gguf_path = find_gguf_file(path)
+            if gguf_path is not None:
+                hf, info = gguf_tokenizer(gguf_path)
+                return cls(
+                    hf,
+                    chat_template=info.get("chat_template"),
+                    eos_token=hf.id_to_token(info["eos_token_id"]),
+                    bos_token=hf.id_to_token(info["bos_token_id"]),
+                )
+            raise TokenizerError(f"no tokenizer.json or .gguf under {path}")
         hf = _HFTokenizer.from_file(tok_file)
         chat_template = eos = bos = None
         cfg_file = os.path.join(path, "tokenizer_config.json")

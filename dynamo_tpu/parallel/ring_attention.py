@@ -44,6 +44,7 @@ def ring_attention_chunk(
     seq_lens: jax.Array,  # [B] global valid length (replicated)
     axis_name: str,
     axis_size: int,
+    window: int = 0,  # sliding-window width; 0 = full attention
 ) -> jax.Array:
     """Per-shard body (run under shard_map over ``axis_name``).
 
@@ -67,7 +68,13 @@ def ring_attention_chunk(
         kr = att.repeat_kv(k, n_rep).astype(jnp.float32)
         vr = att.repeat_kv(v, n_rep).astype(jnp.float32)
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kr) * scale  # [B, Hq, C, C]
-        mask = (kpos[None, :] <= qpos[:, None])[None, None] & (
+        causal = kpos[None, :] <= qpos[:, None]
+        if window > 0:
+            # sliding window over GLOBAL positions: a key further than
+            # window-1 behind the query contributes nothing regardless of
+            # which shard holds it
+            causal = causal & (qpos[:, None] - kpos[None, :] < window)
+        mask = causal[None, None] & (
             kpos[None, None, None, :] < seq_lens[:, None, None, None]
         )
         s = jnp.where(mask, s, _NEG_INF)
@@ -92,7 +99,7 @@ def ring_attention_chunk(
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
+def make_ring_attention(mesh: Mesh, axis_name: str = "sp", window: int = 0):
     """shard_map'ed causal attention over sequence-sharded [B, T, H, D]
     arrays; composes inside a jit whose other axes GSPMD shards."""
     axis_size = mesh.shape[axis_name]
@@ -100,7 +107,8 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp"):
 
     fn = jax.shard_map(
         partial(
-            ring_attention_chunk, axis_name=axis_name, axis_size=axis_size
+            ring_attention_chunk, axis_name=axis_name, axis_size=axis_size,
+            window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec, P(None)),
@@ -137,18 +145,12 @@ def ring_prefill_step(
     collectives are XLA's problem.  Returns (last-token logits [B, V] f32,
     updated kv_pages)."""
     B, T = tokens.shape
-    if cfg.sliding_window:
-        # the ring accumulates over every shard's keys; silently running it
-        # for a sliding-window model would widen the window
-        raise NotImplementedError(
-            "ring attention does not implement sliding-window masking"
-        )
     if T % mesh.shape[axis_name]:
         raise ValueError(
             f"prefill bucket {T} not divisible by sp={mesh.shape[axis_name]}"
         )
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
-    ring = make_ring_attention(mesh, axis_name)
+    ring = make_ring_attention(mesh, axis_name, cfg.sliding_window or 0)
 
     def attn_fn(q, k, v, kv, layer):
         out = ring(q, k, v, seq_lens)

@@ -1,0 +1,190 @@
+"""Process supervisor: restart-on-death worker management.
+
+Reference parity: the SDK's ``dynamo serve`` runs each service under a
+circus watcher (components/planner local_connector.py drives circus
+add/remove), so a crashed worker restarts without operator action.  The
+TPU build supervises plain subprocesses with asyncio -- no daemon
+dependency -- and exposes the same two capabilities the reference uses:
+
+  * **watchers**: a named command spec with a target replica count;
+    crashed processes restart with exponential backoff, and a process
+    that flaps too fast is parked (fail loud, don't spin);
+  * **scaling**: ``scale(name, n)`` adds/removes replicas -- the planner's
+    LocalConnector can drive a Supervisor factory to scale real worker
+    processes instead of in-process handles.
+
+Use standalone, or through ``LocalConnector`` factories:
+
+    sup = Supervisor()
+    sup.add_watcher("decode", [sys.executable, "-m", "dynamo_tpu", "run",
+                    "in=dyn", "out=jax", "--hub", hub, ...], replicas=1)
+    await sup.start()
+    ...
+    await sup.scale("decode", 3)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import os
+import signal
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("dynamo.supervisor")
+
+# a process that exits faster than this is counted as a flap
+FLAP_WINDOW_S = 2.0
+# consecutive flaps before the replica is parked (fail loud)
+MAX_FLAPS = 5
+BACKOFF_BASE_S = 0.2
+BACKOFF_CAP_S = 10.0
+
+
+@dataclass
+class _Replica:
+    proc: Optional[asyncio.subprocess.Process] = None
+    task: Optional[asyncio.Task] = None
+    flaps: int = 0
+    parked: bool = False
+
+
+@dataclass
+class Watcher:
+    name: str
+    cmd: List[str]
+    replicas: int
+    env: Optional[Dict[str, str]] = None
+    cwd: Optional[str] = None
+    stop_signal: int = signal.SIGTERM
+    stop_grace_s: float = 5.0
+    restarts: int = 0  # observability: total restart count
+    _procs: List[_Replica] = field(default_factory=list)
+
+
+class Supervisor:
+    """Asyncio process supervisor (see module docstring)."""
+
+    def __init__(self) -> None:
+        self.watchers: Dict[str, Watcher] = {}
+        self._running = False
+
+    def add_watcher(
+        self,
+        name: str,
+        cmd: List[str],
+        replicas: int = 1,
+        env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+    ) -> Watcher:
+        if name in self.watchers:
+            raise ValueError(f"watcher {name!r} already exists")
+        w = Watcher(name=name, cmd=list(cmd), replicas=replicas,
+                    env=env, cwd=cwd)
+        self.watchers[name] = w
+        return w
+
+    async def start(self) -> None:
+        self._running = True
+        for w in self.watchers.values():
+            await self._reconcile(w)
+
+    async def stop(self) -> None:
+        self._running = False
+        for w in self.watchers.values():
+            await self._scale_down_to(w, 0)
+
+    async def scale(self, name: str, replicas: int) -> None:
+        w = self.watchers[name]
+        w.replicas = max(0, replicas)
+        await self._reconcile(w)
+
+    def replica_count(self, name: str) -> int:
+        """Live (non-parked) replicas."""
+        w = self.watchers[name]
+        return sum(1 for r in w._procs if not r.parked)
+
+    async def _reconcile(self, w: Watcher) -> None:
+        # parked slots are dead weight: drop them so the target count is
+        # measured against LIVE replicas -- this is also what re-arms a
+        # parked watcher on scale() (the logged remedy)
+        w._procs = [r for r in w._procs if not r.parked]
+        while len(w._procs) < w.replicas:
+            r = _Replica()
+            w._procs.append(r)
+            r.task = asyncio.create_task(
+                self._run_replica(w, r), name=f"sup-{w.name}-{len(w._procs)}"
+            )
+        if len(w._procs) > w.replicas:
+            await self._scale_down_to(w, w.replicas)
+
+    async def _scale_down_to(self, w: Watcher, n: int) -> None:
+        # LIFO: the youngest replica drains first (coldest cache)
+        while len(w._procs) > n:
+            r = w._procs.pop()
+            if r.task is not None:
+                r.task.cancel()
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    await r.task
+            await self._kill(w, r)
+
+    async def _kill(self, w: Watcher, r: _Replica) -> None:
+        proc = r.proc
+        r.proc = None
+        if proc is None or proc.returncode is not None:
+            return
+        with contextlib.suppress(ProcessLookupError):
+            proc.send_signal(w.stop_signal)
+        try:
+            await asyncio.wait_for(proc.wait(), w.stop_grace_s)
+        except asyncio.TimeoutError:
+            with contextlib.suppress(ProcessLookupError):
+                proc.kill()
+            await proc.wait()
+
+    async def _run_replica(self, w: Watcher, r: _Replica) -> None:
+        """Spawn-watch-restart loop for one replica slot."""
+        try:
+            while self._running and not r.parked:
+                started = time.monotonic()
+                env = dict(os.environ)
+                if w.env:
+                    env.update(w.env)
+                try:
+                    r.proc = await asyncio.create_subprocess_exec(
+                        *w.cmd, env=env, cwd=w.cwd,
+                        stdout=sys.stderr, stderr=sys.stderr,
+                    )
+                except Exception as e:  # noqa: BLE001 - spawn failure
+                    logger.error(
+                        "watcher %s: spawn failed: %s", w.name, e
+                    )
+                    r.flaps += 1
+                else:
+                    rc = await r.proc.wait()
+                    if not self._running:
+                        return
+                    lived = time.monotonic() - started
+                    logger.warning(
+                        "watcher %s: process exited rc=%s after %.1fs",
+                        w.name, rc, lived,
+                    )
+                    r.flaps = r.flaps + 1 if lived < FLAP_WINDOW_S else 0
+                    w.restarts += 1
+                if r.flaps >= MAX_FLAPS:
+                    r.parked = True
+                    logger.error(
+                        "watcher %s: replica flapping (%d fast exits); "
+                        "parked -- fix the command and scale to re-arm",
+                        w.name, r.flaps,
+                    )
+                    return
+                await asyncio.sleep(
+                    min(BACKOFF_CAP_S, BACKOFF_BASE_S * (2 ** r.flaps))
+                )
+        except asyncio.CancelledError:
+            raise

@@ -64,13 +64,20 @@ struct Index {
 
   // Accumulate per-worker match counts over the query's sequence-hash chain;
   // stop at the first level held by nobody (early exit: deeper blocks cannot
-  // match because their sequence hashes chain through this one).
+  // match because their sequence hashes chain through this one).  With
+  // early_exit false, every level is scored (the sharded index truncates the
+  // query globally first, then sweeps each shard without a local exit -- a
+  // shard-local hole must not hide a worker's deeper holdings).
   size_t find_matches(const uint64_t* hashes, size_t n, uint64_t* out_workers,
-                      uint32_t* out_scores, size_t max_out) const {
+                      uint32_t* out_scores, size_t max_out,
+                      bool early_exit = true) const {
     std::unordered_map<uint64_t, uint32_t> scores;
     for (size_t i = 0; i < n; ++i) {
       auto it = blocks.find(hashes[i]);
-      if (it == blocks.end()) break;
+      if (it == blocks.end()) {
+        if (early_exit) break;
+        continue;
+      }
       for (uint64_t w : it->second) scores[w] += 1;
     }
     size_t k = 0;
@@ -81,6 +88,15 @@ struct Index {
       ++k;
     }
     return k;
+  }
+
+  // Per-position coverage: out[i] = 1 iff some worker in THIS index holds
+  // hashes[i] (the sharded index ORs shard coverages to find the global
+  // early-exit point).
+  void coverage(const uint64_t* hashes, size_t n, uint8_t* out) const {
+    for (size_t i = 0; i < n; ++i) {
+      out[i] = blocks.count(hashes[i]) ? 1 : 0;
+    }
   }
 };
 
@@ -111,6 +127,18 @@ size_t dyn_radix_find_matches(void* p, const uint64_t* hashes, size_t n,
                               size_t max_out) {
   return static_cast<Index*>(p)->find_matches(hashes, n, out_workers,
                                               out_scores, max_out);
+}
+
+size_t dyn_radix_find_matches_all(void* p, const uint64_t* hashes, size_t n,
+                                  uint64_t* out_workers, uint32_t* out_scores,
+                                  size_t max_out) {
+  return static_cast<Index*>(p)->find_matches(hashes, n, out_workers,
+                                              out_scores, max_out, false);
+}
+
+void dyn_radix_coverage(void* p, const uint64_t* hashes, size_t n,
+                        uint8_t* out) {
+  static_cast<Index*>(p)->coverage(hashes, n, out);
 }
 
 size_t dyn_radix_num_blocks(void* p) {

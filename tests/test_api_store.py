@@ -1,0 +1,188 @@
+"""api-store tests: component/version/artifact/deployment CRUD over the hub
+(reference deploy/cloud/api-store's dynamo_components REST surface)."""
+
+import asyncio
+import json
+
+from dynamo_tpu.api_store import ApiStoreService
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+from tests.test_serving import http_request
+
+
+async def _setup():
+    hub = HubServer()
+    host, port = await hub.start()
+    rt = await DistributedRuntime.detached(f"{host}:{port}")
+    svc = ApiStoreService(rt.hub, host="127.0.0.1", port=0)
+    await svc.start()
+    return hub, rt, svc
+
+
+def test_component_version_artifact_roundtrip(run):
+    async def body():
+        hub, rt, svc = await _setup()
+        try:
+            h, p = svc.address
+            status, _, out = await http_request(
+                h, p, "POST", "/api/v1/components",
+                {"name": "agg-graph", "description": "aggregated serving"},
+            )
+            assert status == 201 and out["name"] == "agg-graph"
+            # duplicate -> 409
+            status, _, _ = await http_request(
+                h, p, "POST", "/api/v1/components", {"name": "agg-graph"}
+            )
+            assert status == 409
+            # bad name -> 400
+            status, _, _ = await http_request(
+                h, p, "POST", "/api/v1/components", {"name": "no/slash"}
+            )
+            assert status == 400
+
+            status, _, out = await http_request(
+                h, p, "POST", "/api/v1/components/agg-graph/versions",
+                {"version": "v1", "manifest": {"services": ["frontend"]}},
+            )
+            assert status == 201 and out["upload_status"] == "pending"
+            # version for a missing component -> 404
+            status, _, _ = await http_request(
+                h, p, "POST", "/api/v1/components/ghost/versions",
+                {"version": "v1"},
+            )
+            assert status == 404
+
+            # artifact upload flips upload_status and round-trips bytes
+            blob = b"tar-bytes-" * 100
+            status, _, out = await http_request(
+                h, p, "PUT",
+                "/api/v1/components/agg-graph/versions/v1/artifact",
+                raw_body=blob,
+            )
+            assert status == 200
+            assert out["upload_status"] == "success"
+            assert out["artifact_bytes"] == len(blob)
+            status, headers, got = await http_request(
+                h, p, "GET",
+                "/api/v1/components/agg-graph/versions/v1/artifact",
+                raw_response=True,
+            )
+            assert status == 200 and got == blob
+
+            status, _, out = await http_request(h, p, "GET", "/api/v1/components")
+            assert status == 200 and out["total"] == 1
+            status, _, out = await http_request(
+                h, p, "GET", "/api/v1/components/agg-graph/versions"
+            )
+            assert out["total"] == 1 and out["items"][0]["version"] == "v1"
+        finally:
+            await svc.stop()
+            await rt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_deployments_upsert_and_list(run):
+    async def body():
+        hub, rt, svc = await _setup()
+        try:
+            h, p = svc.address
+            spec = {"name": "g", "model_path": "/m", "decode_workers": 2}
+            status, _, out = await http_request(
+                h, p, "POST", "/api/v1/deployments",
+                {"name": "prod", "spec": spec},
+            )
+            assert status == 201
+            spec["decode_workers"] = 4  # re-deploy updates the record
+            await http_request(
+                h, p, "POST", "/api/v1/deployments",
+                {"name": "prod", "spec": spec},
+            )
+            status, _, out = await http_request(
+                h, p, "GET", "/api/v1/deployments/prod"
+            )
+            assert out["spec"]["decode_workers"] == 4
+            status, _, out = await http_request(h, p, "GET", "/api/v1/deployments")
+            assert out["total"] == 1
+            status, _, out = await http_request(h, p, "GET", "/health")
+            assert status == 200 and out["status"] == "ok"
+            # records survive the service process: a fresh api-store on the
+            # same hub sees them (the hub is the store, not the process)
+            svc2 = ApiStoreService(rt.hub, host="127.0.0.1", port=0)
+            await svc2.start()
+            try:
+                h2, p2 = svc2.address
+                status, _, out = await http_request(
+                    h2, p2, "GET", "/api/v1/deployments/prod"
+                )
+                assert status == 200 and out["name"] == "prod"
+            finally:
+                await svc2.stop()
+        finally:
+            await svc.stop()
+            await rt.shutdown()
+            await hub.stop()
+
+    run(body())
+
+
+def test_build_deploy_roundtrip(run, tmp_path):
+    """`dynamo-tpu build` packages a graph dir into api-store;
+    `dynamo-tpu deploy` fetches it, unpacks, renders manifests, records the
+    deployment (reference dynamo build/deploy against the cloud store).
+    The sync CLI entrypoints run on an executor thread while this loop
+    serves the store."""
+
+    async def body():
+        import argparse
+        import os
+
+        from dynamo_tpu.cli import run_build, run_deploy
+
+        hub, rt, svc = await _setup()
+        try:
+            loop = asyncio.get_running_loop()
+            graph = tmp_path / "graph"
+            graph.mkdir()
+            (graph / "graph.py").write_text("# my serving graph\n")
+            h, p = svc.address
+            store = f"http://{h}:{p}"
+
+            for version in ("v1", "v2"):  # component create is idempotent
+                rc = await loop.run_in_executor(
+                    None,
+                    run_build,
+                    argparse.Namespace(
+                        store=store, name="prod-graph", version=version,
+                        path=str(graph),
+                    ),
+                )
+                assert rc == 0
+
+            out = tmp_path / "deployed"
+            rc = await loop.run_in_executor(
+                None,
+                run_deploy,
+                argparse.Namespace(
+                    store=store, name="prod-graph", version="v2",
+                    out_dir=str(out), model_path="/models/m",
+                    image="dynamo-tpu:latest",
+                ),
+            )
+            assert rc == 0
+            assert (out / "prod-graph" / "graph.py").exists()  # unpacked
+            manifests = os.listdir(out / "manifests")
+            assert "decode-worker.yaml" in manifests and "hub.yaml" in manifests
+
+            status, _, rec = await http_request(
+                h, p, "GET", "/api/v1/deployments/prod-graph"
+            )
+            assert status == 200 and rec["spec"]["version"] == "v2"
+        finally:
+            await svc.stop()
+            await rt.shutdown()
+            await hub.stop()
+
+    run(body())

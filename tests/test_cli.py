@@ -222,7 +222,12 @@ def test_resolve_model_path(tmp_path, monkeypatch):
 
     assert resolve_model_path(str(tmp_path)) == str(tmp_path)
 
-    with pytest.raises(SystemExit, match="neither a local directory"):
+    # a .gguf FILE is a valid local model path (GGUF checkpoints)
+    gguf = tmp_path / "model.gguf"
+    gguf.write_bytes(b"GGUF")
+    assert resolve_model_path(str(gguf)) == str(gguf)
+
+    with pytest.raises(SystemExit, match="neither a local path"):
         resolve_model_path("/no/such/dir")
 
     calls = {}

@@ -1,0 +1,158 @@
+"""GGUF tokenizer tests: binary metadata parsing + conversion to the HF
+tokenizers core (reference gguf/gguf_metadata.rs + gguf_tokenizer.rs).
+
+The GGUF files are written by the test itself (spec-conformant v3 headers),
+so no model download is involved."""
+
+import struct
+
+import pytest
+
+from dynamo_tpu.llm.gguf import (
+    find_gguf_file,
+    gguf_tokenizer,
+    read_gguf_metadata,
+)
+from dynamo_tpu.llm.tokenizer import Tokenizer
+
+_T_U32, _T_F32, _T_BOOL, _T_STRING, _T_ARRAY = 4, 6, 7, 8, 9
+
+
+def _s(x: str) -> bytes:
+    b = x.encode()
+    return struct.pack("<Q", len(b)) + b
+
+
+def _kv(key: str, vtype: int, payload: bytes) -> bytes:
+    return _s(key) + struct.pack("<I", vtype) + payload
+
+
+def _arr(etype: int, items) -> bytes:
+    out = struct.pack("<IQ", etype, len(items))
+    for it in items:
+        if etype == _T_STRING:
+            out += _s(it)
+        elif etype == _T_F32:
+            out += struct.pack("<f", it)
+        else:
+            raise AssertionError(etype)
+    return out
+
+
+def _write_gguf(path, kvs):
+    blob = struct.pack("<IIQQ", 0x46554747, 3, 0, len(kvs))
+    for k in kvs:
+        blob += k
+    path.write_bytes(blob)
+
+
+def _llama_gguf(tmp_path):
+    # SentencePiece-flavoured vocab: ▁-prefixed word pieces + specials
+    tokens = ["<unk>", "<s>", "</s>", "▁hello", "▁world", "▁he", "llo", "▁"]
+    scores = [0.0, 0.0, 0.0, -1.0, -1.5, -4.0, -4.0, -6.0]
+    path = tmp_path / "model.gguf"
+    _write_gguf(path, [
+        _kv("general.architecture", _T_STRING, _s("llama")),
+        _kv("tokenizer.ggml.model", _T_STRING, _s("llama")),
+        _kv("tokenizer.ggml.tokens", _T_ARRAY, _arr(_T_STRING, tokens)),
+        _kv("tokenizer.ggml.scores", _T_ARRAY, _arr(_T_F32, scores)),
+        _kv("tokenizer.ggml.bos_token_id", _T_U32, struct.pack("<I", 1)),
+        _kv("tokenizer.ggml.eos_token_id", _T_U32, struct.pack("<I", 2)),
+        _kv("tokenizer.ggml.unknown_token_id", _T_U32, struct.pack("<I", 0)),
+        _kv("tokenizer.ggml.add_bos_token", _T_BOOL, b"\x01"),
+    ])
+    return path
+
+
+def test_metadata_parse_roundtrip(tmp_path):
+    path = _llama_gguf(tmp_path)
+    meta = read_gguf_metadata(str(path))
+    assert meta["general.architecture"] == "llama"
+    assert meta["tokenizer.ggml.model"] == "llama"
+    assert meta["tokenizer.ggml.tokens"][3] == "▁hello"
+    assert meta["tokenizer.ggml.bos_token_id"] == 1
+    assert meta["tokenizer.ggml.add_bos_token"] is True
+    assert abs(meta["tokenizer.ggml.scores"][4] + 1.5) < 1e-6
+
+
+def test_bad_magic_rejected(tmp_path):
+    p = tmp_path / "bad.gguf"
+    p.write_bytes(b"NOPE" + b"\x00" * 32)
+    with pytest.raises(ValueError, match="not a GGUF file"):
+        read_gguf_metadata(str(p))
+
+
+def test_llama_unigram_tokenizer(tmp_path):
+    path = _llama_gguf(tmp_path)
+    tok, info = gguf_tokenizer(str(path))
+    assert info["model"] == "llama" and info["bos_token_id"] == 1
+    ids = tok.encode("hello world", add_special_tokens=False).ids
+    assert ids, "encoded to nothing"
+    # best-score segmentation picks the whole-word pieces
+    assert ids == [3, 4]  # ▁hello ▁world
+    assert tok.decode(ids) == "hello world"
+
+
+def test_gpt2_bpe_tokenizer(tmp_path):
+    # byte-level BPE: base vocab of the bytes we use + one merge
+    tokens = ["h", "e", "l", "o", " ", "he", "<eos>", "<bos>"]
+    merges = ["h e"]
+    path = tmp_path / "bpe.gguf"
+    _write_gguf(path, [
+        _kv("tokenizer.ggml.model", _T_STRING, _s("gpt2")),
+        _kv("tokenizer.ggml.tokens", _T_ARRAY, _arr(_T_STRING, tokens)),
+        _kv("tokenizer.ggml.merges", _T_ARRAY, _arr(_T_STRING, merges)),
+        _kv("tokenizer.ggml.bos_token_id", _T_U32, struct.pack("<I", 7)),
+        _kv("tokenizer.ggml.eos_token_id", _T_U32, struct.pack("<I", 6)),
+    ])
+    tok, info = gguf_tokenizer(str(path))
+    assert info["eos_token_id"] == 6
+    ids = tok.encode("hello", add_special_tokens=False).ids
+    assert ids[0] == 5  # the h+e merge applied
+    assert tok.decode(ids) == "hello"
+
+
+def test_facade_loads_gguf_model_dir(tmp_path):
+    """Tokenizer.from_model_dir picks up a .gguf when tokenizer.json is
+    absent -- the user-facing --model-path path for GGUF checkpoints."""
+    _llama_gguf(tmp_path)
+    t = Tokenizer.from_model_dir(str(tmp_path))
+    assert t.eos_token == "</s>" and t.bos_token == "<s>"
+    assert t.eos_token_ids == [2]
+    ids = t.encode("hello world", add_special_tokens=False)
+    assert t.decode(ids) == "hello world"
+    # incremental decode works through the same facade
+    stream = t.decode_stream()
+    out = "".join(filter(None, (stream.step(i) for i in ids)))
+    assert out.strip() == "hello world"
+    assert find_gguf_file(str(tmp_path)) is not None
+
+
+def test_add_bos_token_installs_post_processor(tmp_path):
+    """add_bos_token=true must make encode(add_special_tokens=True) prepend
+    BOS (llama-family prompt semantics)."""
+    path = _llama_gguf(tmp_path)
+    tok, info = gguf_tokenizer(str(path))
+    assert info["add_bos_token"] is True
+    ids = tok.encode("hello world", add_special_tokens=True).ids
+    assert ids[0] == 1  # <s>
+    assert tok.encode("hello world", add_special_tokens=False).ids[0] != 1
+
+
+def test_chat_template_metadata_reaches_facade(tmp_path):
+    tokens = ["<unk>", "<s>", "</s>", "▁hi"]
+    scores = [0.0, 0.0, 0.0, -1.0]
+    tpl = "{% for m in messages %}{{ m['content'] }}{% endfor %}"
+    path = tmp_path / "chat.gguf"
+    _write_gguf(path, [
+        _kv("tokenizer.ggml.model", _T_STRING, _s("llama")),
+        _kv("tokenizer.ggml.tokens", _T_ARRAY, _arr(_T_STRING, tokens)),
+        _kv("tokenizer.ggml.scores", _T_ARRAY, _arr(_T_F32, scores)),
+        _kv("tokenizer.ggml.bos_token_id", _T_U32, struct.pack("<I", 1)),
+        _kv("tokenizer.ggml.eos_token_id", _T_U32, struct.pack("<I", 2)),
+        _kv("tokenizer.chat_template", _T_STRING, _s(tpl)),
+    ])
+    _tok, info = gguf_tokenizer(str(path))
+    assert info["chat_template"] == tpl
+    t = Tokenizer.from_model_dir(str(path))
+    assert t.chat_template == tpl

@@ -330,3 +330,73 @@ def test_kv_push_router_falls_back_without_metrics(run):
             await hub.stop()
 
     run(body())
+
+
+def test_sharded_indexer_matches_flat():
+    """KvIndexerSharded (reference indexer.rs:696) must answer queries
+    identically to the flat index: workers pin to shards (least-loaded),
+    matches merge across shards, dead workers drop from their shard."""
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer, KvIndexerSharded
+    from dynamo_tpu.tokens.hashing import hash_blocks
+
+    flat = KvIndexer(block_size=4)
+    sharded = KvIndexerSharded(block_size=4, num_shards=3)
+
+    tokens = list(range(40))
+    _, hashes = hash_blocks(tokens, 4)
+    for worker, n in ((1, 8), (2, 5), (3, 2), (4, 9)):
+        ev = {"type": "stored", "blocks": [
+            {"sequence_hash": h, "block_hash": i,
+             "parent_sequence_hash": 0, "position": i}
+            for i, h in enumerate(hashes[:n])
+        ]}
+        flat.apply_event(worker, ev)
+        sharded.apply_event(worker, ev)
+
+    q = hashes[:10]
+    assert sharded.find_matches(q).scores == flat.find_matches(q).scores
+    assert sharded.num_workers == 4
+    # per-shard uniques: >= the flat unique count, <= the per-worker sum
+    assert flat.num_blocks <= sharded.num_blocks <= 8 + 5 + 2 + 9
+
+    # workers spread over shards, not piled on one
+    used = {sharded._assignment[w] for w in (1, 2, 3, 4)}
+    assert len(used) == 3
+
+    flat.remove_worker(4)
+    sharded.remove_worker(4)
+    assert sharded.find_matches(q).scores == flat.find_matches(q).scores
+    assert sharded.num_workers == 3
+
+    # token-level query path too
+    assert (
+        sharded.find_matches_for_tokens(tokens).scores
+        == flat.find_matches_for_tokens(tokens).scores
+    )
+
+
+def test_sharded_indexer_non_contiguous_holdings():
+    """A worker holding a deeper block but not a shallower one (a 'removed'
+    event punched a hole) must still score its deeper holdings, exactly as
+    the flat index does -- the shard-local walk must not early-exit on a
+    hole only the fleet-wide view can judge."""
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer, KvIndexerSharded
+    from dynamo_tpu.tokens.hashing import hash_blocks
+
+    _, hashes = hash_blocks(list(range(16)), 4)
+    h0, h1 = hashes[0], hashes[1]
+
+    flat = KvIndexer(block_size=4)
+    sharded = KvIndexerSharded(block_size=4, num_shards=2)
+    for idx in (flat, sharded):
+        # worker 1 -> shard 0, worker 2 -> shard 1 (least-loaded order)
+        idx.apply_event(1, {"type": "stored", "blocks": [
+            {"sequence_hash": h0}, {"sequence_hash": h1}]})
+        idx.apply_event(2, {"type": "stored", "blocks": [
+            {"sequence_hash": h0}, {"sequence_hash": h1}]})
+        # punch worker 1's h0: its h1 must still count (h0 covered by 2)
+        idx.apply_event(1, {"type": "removed", "sequence_hashes": [h0]})
+
+    q = [h0, h1]
+    assert sharded.find_matches(q).scores == flat.find_matches(q).scores
+    assert flat.find_matches(q).scores == {1: 1, 2: 2}

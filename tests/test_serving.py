@@ -32,15 +32,23 @@ from dynamo_tpu.runtime.pipeline import link
 # -- HTTP test client --------------------------------------------------------
 
 
-async def http_request(host, port, method, path, body=None, stream=False):
+async def http_request(
+    host, port, method, path, body=None, stream=False,
+    raw_body=None, raw_response=False,
+):
     """Minimal HTTP/1.1 client: returns (status, headers, payload).
 
     payload is parsed JSON for full responses, or the list of SSE data
     payloads (parsed JSON, '[DONE]' literal last) for event streams.
+    ``raw_body`` sends opaque bytes; ``raw_response=True`` returns the raw
+    payload bytes (artifact up/downloads).
     """
     reader, writer = await asyncio.open_connection(host, port)
     try:
-        data = json.dumps(body).encode() if body is not None else b""
+        if raw_body is not None:
+            data = raw_body
+        else:
+            data = json.dumps(body).encode() if body is not None else b""
         req = (
             f"{method} {path} HTTP/1.1\r\nHost: {host}\r\n"
             f"Content-Length: {len(data)}\r\nConnection: close\r\n"
@@ -69,6 +77,8 @@ async def http_request(host, port, method, path, body=None, stream=False):
                 rest = rest[size + 2 :]
         else:
             payload = raw
+        if raw_response:
+            return status, headers, payload
         if headers.get("content-type", "").startswith("text/event-stream"):
             events = []
             for block in payload.decode().split("\n\n"):

@@ -1,0 +1,123 @@
+"""Process supervisor tests: restart-on-death, flap parking, scaling
+(reference: dynamo serve's circus watchers / local_connector add-remove)."""
+
+import asyncio
+import sys
+
+import pytest
+
+from dynamo_tpu.supervisor import Supervisor
+
+
+def test_restart_on_death_and_scale(run, tmp_path):
+    async def body():
+        marker = tmp_path / "beats"
+        # each run appends a line then sleeps forever; killing it simulates
+        # a crash and the supervisor must respawn (a new line appears)
+        script = (
+            "import sys, time\n"
+            f"open({str(marker)!r}, 'a').write('x\\n')\n"
+            "time.sleep(60)\n"
+        )
+        sup = Supervisor()
+        sup.add_watcher("w", [sys.executable, "-c", script], replicas=1)
+        await sup.start()
+        try:
+            for _ in range(100):
+                if marker.exists() and marker.read_text().count("x") >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert marker.read_text().count("x") == 1
+
+            # crash it: the supervisor restarts the replica
+            w = sup.watchers["w"]
+            w._procs[0].proc.kill()
+            for _ in range(200):
+                if marker.read_text().count("x") >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert marker.read_text().count("x") >= 2
+            assert w.restarts >= 1
+
+            # scale to 3: two more processes appear
+            await sup.scale("w", 3)
+            for _ in range(200):
+                if marker.read_text().count("x") >= 4:
+                    break
+                await asyncio.sleep(0.05)
+            assert marker.read_text().count("x") >= 4
+            assert sup.replica_count("w") == 3
+
+            # scale back down: LIFO teardown, count drops
+            await sup.scale("w", 1)
+            assert sup.replica_count("w") == 1
+        finally:
+            await sup.stop()
+
+    run(body())
+
+
+def test_flapping_replica_is_parked(run):
+    async def body():
+        sup = Supervisor()
+        # exits immediately every time -> flap counter trips
+        sup.add_watcher("bad", [sys.executable, "-c", "raise SystemExit(3)"],
+                        replicas=1)
+        # tighten the backoff so the test is fast
+        import dynamo_tpu.supervisor as sv
+
+        old = sv.BACKOFF_BASE_S
+        sv.BACKOFF_BASE_S = 0.01
+        try:
+            await sup.start()
+            w = sup.watchers["bad"]
+            for _ in range(400):
+                if w._procs and w._procs[0].parked:
+                    break
+                await asyncio.sleep(0.05)
+            assert w._procs[0].parked
+            assert sup.replica_count("bad") == 0
+        finally:
+            sv.BACKOFF_BASE_S = old
+            await sup.stop()
+
+    run(body())
+
+
+def test_parked_replica_rearms_on_scale(run, tmp_path):
+    """The logged remedy must work: after fixing the command, scale()
+    drops parked slots and spawns fresh replicas."""
+
+    async def body():
+        import dynamo_tpu.supervisor as sv
+
+        marker = tmp_path / "ok"
+        sup = Supervisor()
+        sup.add_watcher("w", [sys.executable, "-c", "raise SystemExit(1)"],
+                        replicas=1)
+        old = sv.BACKOFF_BASE_S
+        sv.BACKOFF_BASE_S = 0.01
+        try:
+            await sup.start()
+            w = sup.watchers["w"]
+            for _ in range(400):
+                if w._procs and w._procs[0].parked:
+                    break
+                await asyncio.sleep(0.05)
+            assert sup.replica_count("w") == 0
+            # operator fixes the command, then re-arms via scale()
+            w.cmd = [sys.executable, "-c",
+                     f"import time; open({str(marker)!r},'w').write('y'); "
+                     "time.sleep(60)"]
+            await sup.scale("w", 1)
+            for _ in range(200):
+                if marker.exists():
+                    break
+                await asyncio.sleep(0.05)
+            assert marker.exists()
+            assert sup.replica_count("w") == 1
+        finally:
+            sv.BACKOFF_BASE_S = old
+            await sup.stop()
+
+    run(body())
