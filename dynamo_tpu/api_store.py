@@ -27,12 +27,13 @@ Run: ``dynamo-tpu api-store --hub H:P [--port 8282]``.
 
 from __future__ import annotations
 
+import json
 import logging
 import re
 import time
 from typing import Any, Dict, Optional
 
-from .http.server import HttpServer, Request, Response
+from .http.server import BadRequest, HttpServer, Request, Response
 
 logger = logging.getLogger("dynamo.api_store")
 
@@ -112,6 +113,10 @@ class ApiStoreService:
                 if m == "GET":
                     return await self._get(KV_DEPLOYMENT.format(name=rest[1]))
             return _bad("not found", 404)
+        except BadRequest as e:
+            # malformed client input is a 400, same as the server's own
+            # registered routes -- not a logged server fault
+            return _bad(str(e), 400)
         except Exception as e:  # noqa: BLE001 - REST boundary
             logger.exception("api-store request failed")
             return _bad(f"internal error: {e}", 500)
@@ -119,8 +124,6 @@ class ApiStoreService:
     # -- records -------------------------------------------------------------
 
     async def _create_component(self, req: Request) -> Response:
-        import json
-
         body = req.json() or {}
         name = body.get("name") or ""
         if not _NAME_RE.match(name):
@@ -138,8 +141,6 @@ class ApiStoreService:
         return Response.json(record, 201)
 
     async def _create_version(self, req: Request, name: str) -> Response:
-        import json
-
         if not await self._exists(KV_COMPONENT.format(name=name)):
             return _bad(f"component {name!r} not found", 404)
         body = req.json() or {}
@@ -162,8 +163,6 @@ class ApiStoreService:
         return Response.json(record, 201)
 
     async def _put_artifact(self, req: Request, name: str, version: str) -> Response:
-        import json
-
         key = KV_VERSION.format(name=name, version=version)
         match = [
             v for k, v in await self.hub.kv_get_prefix(key) if k == key
@@ -192,8 +191,6 @@ class ApiStoreService:
         )
 
     async def _create_deployment(self, req: Request) -> Response:
-        import json
-
         body = req.json() or {}
         name = body.get("name") or ""
         if not _NAME_RE.match(name):
@@ -217,8 +214,6 @@ class ApiStoreService:
         return any(k == key for k, _v in await self.hub.kv_get_prefix(key))
 
     async def _get(self, key: str) -> Response:
-        import json
-
         entries = await self.hub.kv_get_prefix(key)
         for k, v in entries:
             if k == key:
@@ -226,8 +221,6 @@ class ApiStoreService:
         return _bad("not found", 404)
 
     async def _list(self, prefix: str) -> Response:
-        import json
-
         entries = await self.hub.kv_get_prefix(prefix)
         items = []
         for k, v in entries:

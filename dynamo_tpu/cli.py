@@ -895,7 +895,13 @@ def run_build(args) -> int:
             with urllib.request.urlopen(req) as r:
                 return r.status, _json.load(r)
         except urllib.error.HTTPError as e:
-            return e.code, _json.loads(e.read() or b"{}")
+            raw = e.read() or b"{}"
+            try:
+                return e.code, _json.loads(raw)
+            except ValueError:  # non-JSON error page (proxy, wrong server)
+                return e.code, {"error": raw[:200].decode("latin1")}
+        except urllib.error.URLError as e:
+            raise SystemExit(f"cannot reach api-store at {base}: {e.reason}")
 
     status, out = post("/api/v1/components", {"name": args.name})
     if status not in (201, 409):  # existing component is fine
@@ -918,6 +924,8 @@ def run_build(args) -> int:
         raise SystemExit(
             f"artifact upload failed: HTTP {e.code} {e.read()[:200]!r}"
         )
+    except urllib.error.URLError as e:
+        raise SystemExit(f"cannot reach api-store at {base}: {e.reason}")
     print(
         f"built {args.name}:{args.version} "
         f"({out.get('artifact_bytes', len(blob))} bytes) -> {base}"
@@ -947,6 +955,8 @@ def run_deploy(args) -> int:
             f"{args.name}:{args.version} not fetchable from {base}: "
             f"HTTP {e.code} {e.read()[:200]!r}"
         )
+    except urllib.error.URLError as e:
+        raise SystemExit(f"cannot reach api-store at {base}: {e.reason}")
     os.makedirs(args.out_dir, exist_ok=True)
     with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
         try:
@@ -980,6 +990,8 @@ def run_deploy(args) -> int:
         raise SystemExit(
             f"deployment record failed: HTTP {e.code} {e.read()[:200]!r}"
         )
+    except urllib.error.URLError as e:
+        raise SystemExit(f"cannot reach api-store at {base}: {e.reason}")
     print(
         f"deployed {args.name}:{args.version}: artifact + manifests under "
         f"{args.out_dir} (kubectl apply -f {mdir})"

@@ -307,6 +307,12 @@ class KvIndexerSharded:
         return s
 
     def apply_event(self, worker_id: int, event: Dict) -> None:
+        if event.get("type") == "cleared":
+            # the flat index forgets the worker entirely on "cleared"; the
+            # assignment and load count must follow, or dead-cleared
+            # workers skew least-loaded pinning forever
+            self.remove_worker(worker_id)
+            return
         self.shards[self._shard_of(worker_id)].apply_event(worker_id, event)
 
     def remove_worker(self, worker_id: int) -> None:

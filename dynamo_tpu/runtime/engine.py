@@ -185,6 +185,13 @@ class ResponseStream(Generic[U]):
             self._kill_waiter.cancel()
         self._kill_waiter = None
 
+    def __del__(self) -> None:
+        # a consumer that breaks out of iteration without aclose() must not
+        # leak the kill-race task ("Task was destroyed but it is pending")
+        w = self._kill_waiter
+        if w is not None and not w.done():
+            w.cancel()
+
     async def _shutdown_killed(self) -> None:
         self._cleanup_waiter()
         await self._dispose()

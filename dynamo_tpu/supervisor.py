@@ -154,16 +154,31 @@ class Supervisor:
                 env = dict(os.environ)
                 if w.env:
                     env.update(w.env)
-                try:
-                    r.proc = await asyncio.create_subprocess_exec(
+                spawn = asyncio.ensure_future(
+                    asyncio.create_subprocess_exec(
                         *w.cmd, env=env, cwd=w.cwd,
                         stdout=sys.stderr, stderr=sys.stderr,
                     )
+                )
+                try:
+                    # shield: a cancel landing mid-fork must not orphan the
+                    # just-spawned process -- the reaper below kills it when
+                    # the (uncancelled) spawn future completes
+                    r.proc = await asyncio.shield(spawn)
+                except asyncio.CancelledError:
+                    def _reap(f: asyncio.Future) -> None:
+                        if not f.cancelled() and f.exception() is None:
+                            with contextlib.suppress(ProcessLookupError):
+                                f.result().kill()
+
+                    spawn.add_done_callback(_reap)
+                    raise
                 except Exception as e:  # noqa: BLE001 - spawn failure
                     logger.error(
                         "watcher %s: spawn failed: %s", w.name, e
                     )
                     r.flaps += 1
+                    r.proc = None
                 else:
                     rc = await r.proc.wait()
                     if not self._running:
