@@ -93,6 +93,9 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--port", type=int, default=8080)
     run.add_argument("--router-mode", default="round_robin",
                      choices=["round_robin", "random", "kv"])
+    run.add_argument("--router-index-shards", type=int, default=1,
+                     help="KV router index shards (>1 = worker-sharded "
+                          "index for large fleets)")
     _add_engine_flags(run)
     run.add_argument("--request-template",
                      help="JSON file with request defaults "
@@ -423,7 +426,10 @@ async def run_http_frontend(args) -> None:
         async def kv_factory(entry, card, client, router):
             ns = runtime.namespace(entry.namespace)
             comp = ns.component(entry.component)
-            chooser = KvRouter(ns, comp, block_size=card.kv_block_size)
+            chooser = KvRouter(
+                ns, comp, block_size=card.kv_block_size,
+                index_shards=args.router_index_shards,
+            )
             await chooser.start()
             tokenizer = card.tokenizer()
             engine = link(

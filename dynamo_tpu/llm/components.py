@@ -44,6 +44,7 @@ class RouterService:
         worker_component: str = "backend",
         block_size: int = 16,
         config: Optional[KvRouterConfig] = None,
+        index_shards: int = 1,
     ) -> None:
         self.runtime = runtime
         self.ns = runtime.namespace(namespace)
@@ -52,6 +53,7 @@ class RouterService:
             self.ns.component(worker_component),
             block_size=block_size,
             config=config,
+            index_shards=index_shards,
         )
 
     async def start(self) -> None:

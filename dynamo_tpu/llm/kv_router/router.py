@@ -49,11 +49,23 @@ class KvRouter:
         block_size: int = 16,
         config: Optional[KvRouterConfig] = None,
         scrape_interval_s: float = 0.2,
+        index_shards: int = 1,
     ) -> None:
         self.namespace = namespace
         self.component = component
         self.block_size = block_size
-        self.indexer = KvIndexer(block_size=block_size)
+        if index_shards < 1:
+            raise ValueError("index_shards must be >= 1")
+        # index_shards > 1 switches to the worker-sharded index (reference
+        # KvIndexerSharded) for large fleets
+        if index_shards > 1:
+            from .indexer import KvIndexerSharded
+
+            self.indexer = KvIndexerSharded(
+                block_size=block_size, num_shards=index_shards
+            )
+        else:
+            self.indexer = KvIndexer(block_size=block_size)
         self.scheduler = KvScheduler(
             block_size, DefaultWorkerSelector(config)
         )
@@ -88,6 +100,10 @@ class KvRouter:
         if self._sub is not None:
             await self._sub.close()
         await self.aggregator.stop()
+        # release the sharded index's matching pool (flat index: no-op)
+        close = getattr(self.indexer, "close", None)
+        if close is not None:
+            close()
 
     def _publish_hit_rate(self, ev) -> None:
         payload = {

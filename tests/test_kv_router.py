@@ -176,9 +176,12 @@ async def _drain(stream):
     return toks
 
 
-def test_kv_router_end_to_end(run):
+@pytest.mark.parametrize("index_shards", [1, 2])
+def test_kv_router_end_to_end(run, index_shards):
     """Repeated-prefix requests must route to the worker holding the prefix,
-    and a dead worker's index entries must vanish."""
+    and a dead worker's index entries must vanish.  Runs with the flat and
+    the worker-sharded index (run --router-index-shards) -- routing
+    decisions must be identical."""
 
     async def body():
         hub = HubServer()
@@ -188,7 +191,7 @@ def test_kv_router_end_to_end(run):
         router_rt = await DistributedRuntime.detached(addr)
         ns = router_rt.namespace("kvr")
         comp = ns.component("backend")
-        chooser = KvRouter(ns, comp, block_size=BLOCK)
+        chooser = KvRouter(ns, comp, block_size=BLOCK, index_shards=index_shards)
         await chooser.start()
         try:
             gen_client = await comp.endpoint("generate").client()
