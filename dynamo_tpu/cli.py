@@ -77,6 +77,13 @@ def _add_engine_flags(p) -> None:
                         "coordinator")
 
 
+def _positive_int(v: str) -> int:
+    n = int(v)
+    if n < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+    return n
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dynamo-tpu",
@@ -93,7 +100,7 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--port", type=int, default=8080)
     run.add_argument("--router-mode", default="round_robin",
                      choices=["round_robin", "random", "kv"])
-    run.add_argument("--router-index-shards", type=int, default=1,
+    run.add_argument("--router-index-shards", type=_positive_int, default=1,
                      help="KV router index shards (>1 = worker-sharded "
                           "index for large fleets)")
     _add_engine_flags(run)

@@ -73,12 +73,15 @@ def _pallas_prefill_enabled(T: int, Hq: int, Hkv: int, D: int) -> bool:
 
     ``DYN_PALLAS_PREFILL=1/0`` forces it; default is auto -- on when the
     backend is a TPU, the GQA group divides cleanly, and the sequence is
-    long enough that score materialization dominates (the flash win).  The
-    XLA path stays as the universal fallback."""
+    long enough that score materialization dominates.  Measured on v5e
+    (bench heads, 256-token tiles): T=512 XLA's fused chain still matches;
+    T=1024 flash wins 102 vs 109 ms; T=2048 it wins 86 vs 117 ms (-26%);
+    T=4096 106 vs 108 ms -- so auto engages at T >= 1024.  The XLA path
+    stays as the universal fallback."""
     env = os.environ.get("DYN_PALLAS_PREFILL")
     if env is not None:
         return env not in ("0", "false", "")
-    if T < 128 or Hq % Hkv or D % 8:
+    if T < 1024 or Hq % Hkv or D % 8:
         return False
     try:
         return any("TPU" in d.device_kind for d in jax.devices())

@@ -29,7 +29,7 @@ from ...runtime.component import (
 )
 from ...runtime.engine import Annotated, Context, ResponseStream
 from ...tokens.hashing import hash_blocks
-from .indexer import KvIndexer, OverlapScores
+from .indexer import KvIndexer, KvIndexerSharded, OverlapScores
 from .metrics_aggregator import KvMetricsAggregator
 from .scheduler import DefaultWorkerSelector, KvRouterConfig, KvScheduler
 
@@ -59,8 +59,6 @@ class KvRouter:
         # index_shards > 1 switches to the worker-sharded index (reference
         # KvIndexerSharded) for large fleets
         if index_shards > 1:
-            from .indexer import KvIndexerSharded
-
             self.indexer = KvIndexerSharded(
                 block_size=block_size, num_shards=index_shards
             )

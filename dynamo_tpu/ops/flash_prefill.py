@@ -1,13 +1,14 @@
 """Pallas flash prefill-attention kernel (TPU).
 
 Replaces the XLA prefill path (engine/attention.py prefill_attention) on
-TPU.  The XLA path materializes the full score tensor ``[B, Hq, T, T]`` in
-f32 -- at the bench shape (B=8, Hq=32, T=512) that is ~268 MB of HBM write
-+ read per layer, which is why prefill sat at ~14% MFU (VERDICT r3 weak #2:
-the FLOPs are there, the bandwidth is wasted on scores).  This kernel tiles
-queries and keys into VMEM blocks and keeps the flash-style online-softmax
-state (running max / sum / accumulator, f32) in VMEM scratch: scores never
-touch HBM, K/V stream in once.
+TPU for long prompts.  The XLA path materializes the full score tensor
+``[B, Hq, T, T]``; this kernel tiles queries and keys into VMEM blocks and
+keeps the flash-style online-softmax state (running max / sum /
+accumulator, f32) in VMEM scratch: scores never touch HBM, K/V stream in
+once.  Measured on v5e (bench heads, 256-token tiles) XLA's fused softmax
+chain keeps up through T=512, so the auto dispatch
+(attention.prefill_attention_dispatch) engages this kernel at T >= 1024,
+where it wins -- by 26% at T=2048.
 
 Mechanics: grid ``(B, Hkv, T/BQ, T/BK)`` -- the causally-dead tail
 (k-block strictly after the q-block) skips both math (``pl.when``) and
@@ -127,8 +128,8 @@ def flash_prefill_attention(
     v: jax.Array,  # [B, T, Hkv, D]
     seq_lens: jax.Array,  # [B] valid prompt length per lane
     window: int = 0,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 256,
     interpret: bool = False,
 ) -> jax.Array:
     """Causal prefill attention, flash-tiled.  Same contract as
