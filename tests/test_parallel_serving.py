@@ -25,19 +25,21 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _mesh_engine(mesh_cfg, **cfg_kw):
+def _mesh_engine(mesh_cfg, model=None, **cfg_kw):
     defaults = dict(max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64)
     defaults.update(cfg_kw)
     mesh = build_mesh(mesh_cfg, jax.devices()[: mesh_cfg.num_devices])
     return JaxEngine.random_init(
-        ModelConfig.tiny(), EngineConfig(**defaults), mesh=mesh
+        model or ModelConfig.tiny(), EngineConfig(**defaults), mesh=mesh
     )
 
 
-def _plain_engine(**cfg_kw):
+def _plain_engine(model=None, **cfg_kw):
     defaults = dict(max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64)
     defaults.update(cfg_kw)
-    return JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**defaults))
+    return JaxEngine.random_init(
+        model or ModelConfig.tiny(), EngineConfig(**defaults)
+    )
 
 
 def test_dp_tp_engine_matches_unsharded(run):
@@ -167,5 +169,39 @@ def test_http_serving_through_dp_tp_engine(model_dir, run):
         finally:
             await svc.stop()
             await engine.stop()
+
+    run(body())
+
+
+def test_ep_engine_matches_unsharded_moe(run):
+    """An expert-parallel (ep=4) MoE engine serves generate() with the same
+    greedy tokens as the unsharded engine -- EP reachable from serving, not
+    just the dryrun (expert weights shard over ep; GSPMD inserts the
+    dispatch all_to_all)."""
+
+    async def body():
+        moe = ModelConfig.tiny(num_experts=4, num_experts_per_tok=2,
+                               moe_capacity_factor=4.0)
+
+        plain = _plain_engine(model=moe)
+        try:
+            expect, _ = await collect(
+                plain, req([7, 1, 8, 2, 8, 1, 8], max_tokens=6)
+            )
+        finally:
+            await plain.stop()
+
+        sharded = _mesh_engine(MeshConfig(ep=4), model=moe)
+        try:
+            # the EP path must actually engage: expert weights sharded over
+            # the ep axis, not silently replicated by a divisibility fallback
+            spec = sharded.params["layers"]["w_gate"].sharding.spec
+            assert "ep" in [ax for ax in spec if ax], spec
+            got, _ = await collect(
+                sharded, req([7, 1, 8, 2, 8, 1, 8], max_tokens=6)
+            )
+            assert got == expect
+        finally:
+            await sharded.stop()
 
     run(body())
