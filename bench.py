@@ -20,7 +20,10 @@ import time
 
 
 def build_engine(
-    max_batch_size: int = 8, num_pages: int = 768, decode_block: int = 64
+    max_batch_size: int = 8,
+    num_pages: int = 768,
+    decode_block: int = 64,
+    quantize=None,
 ):
     """decode_block is the throughput/latency dial: 64 steps per host round
     trip is +20% decode tok/s on the tunneled bench chip (measured 1491 vs
@@ -49,6 +52,7 @@ def build_engine(
         page_size=16,
         num_pages=num_pages,
         decode_block_size=decode_block,
+        quantize=quantize,
         seed=0,
     )
     return JaxEngine.random_init(model_cfg, cfg)
@@ -278,6 +282,26 @@ async def main():
     await engine.stop()
     del engine
 
+    # weight-only int8: the HBM-stream lever (engine/quant.py; interleaved
+    # A/B measured +26-57% decode over bf16 on this chip).  Methodology
+    # mirrors the bf16 headline exactly -- same prompts re-measured (warm
+    # prefix cache, decode-dominated window), best of two passes -- so the
+    # two numbers are directly comparable.
+    q_engine = build_engine(quantize="int8")
+    q_prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(8)]
+    await run_batch(q_engine, q_prompts, max_tokens=8)
+    await run_batch(q_engine, q_prompts, max_tokens=8)
+    int8_best = None
+    for _ in range(2):
+        t0 = time.monotonic()
+        q_total = await run_batch(q_engine, q_prompts, max_tokens=128)
+        q_elapsed = time.monotonic() - t0
+        if int8_best is None or q_elapsed < int8_best[1]:
+            int8_best = (q_total, q_elapsed)
+    int8_tok_s = int8_best[0] / int8_best[1]
+    await q_engine.stop()
+    del q_engine
+
     # latency-sensitive legs on the K=16 serving config: prefill TTFT and
     # the served SSE path must not wait out a 64-step decode block for
     # their first token
@@ -315,6 +339,7 @@ async def main():
                 "dispatches_s": round(steps_s, 2),
                 "prefill_tok_s": round(prefill_tok_s, 1),
                 "disagg_tok_s": round(disagg_tok_s, 2),
+                "decode_tok_s_int8": round(int8_tok_s, 2),
                 "est_hbm_util_v5e": round(util, 4),
                 "param_bytes": pbytes,
                 **sweep,

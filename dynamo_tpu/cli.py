@@ -47,6 +47,9 @@ def _add_engine_flags(p) -> None:
     p.add_argument("--block-size", type=int, default=None,
                    help="router-visible KV block size (default: page size)")
     p.add_argument("--decode-block-size", type=int, default=16)
+    p.add_argument("--quantize", choices=["int8"], default=None,
+                   help="weight-only quantization (int8 + per-channel "
+                        "scales; ~half the HBM stream per decode step)")
     p.add_argument("--prefill-chunk-tokens", type=int, default=None,
                    help="chunked prefill: split long prompts into chunks "
                         "of this many tokens, interleaved with decode")
@@ -301,6 +304,7 @@ async def _make_engine(args):
         host_offload_blocks=args.host_offload_blocks,
         disk_offload_blocks=args.disk_offload_blocks,
         disk_offload_dir=args.disk_offload_dir,
+        quantize=args.quantize,
     )
     logger.info("loading %s ...", args.model_path)
     from .parallel.multihost import MultiNodeConfig, initialize_multihost
@@ -315,6 +319,13 @@ async def _make_engine(args):
     initialize_multihost(mn)  # must precede the first jax backend touch
     mesh_cfg = None
     if max(args.tp, args.dp, args.sp, args.pp, args.ep) > 1:
+        if getattr(args, "quantize", None):
+            # fail before the (possibly minutes-long) checkpoint load; the
+            # engine would reject the combination anyway
+            raise SystemExit(
+                "--quantize is not supported together with a mesh "
+                "(--dp/--tp/--sp/--pp/--ep) yet"
+            )
         from .parallel.mesh import MeshConfig
 
         mesh_cfg = MeshConfig(

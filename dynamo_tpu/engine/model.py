@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from .config import ModelConfig
+from .quant import mat
 
 Params = Dict[str, Any]
 
@@ -162,8 +163,8 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _dense_mlp(lp: Params, x: jax.Array, hidden_act: str = "silu") -> jax.Array:
-    gate = _activate(x @ lp["w_gate"], hidden_act)
-    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+    gate = _activate(x @ mat(lp["w_gate"]), hidden_act)
+    return (gate * (x @ mat(lp["w_up"]))) @ mat(lp["w_down"])
 
 
 def _moe_mlp_dense(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
@@ -178,9 +179,9 @@ def _moe_mlp_dense(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     topw = jax.nn.softmax(topw, axis=-1).astype(x.dtype)  # [N, K]
     one_hot = jax.nn.one_hot(topi, cfg.num_experts, dtype=x.dtype)  # [N, K, E]
     combine = jnp.einsum("nk,nke->ne", topw, one_hot)  # [N, E]
-    gate = jax.nn.silu(jnp.einsum("nh,ehi->eni", xf, lp["w_gate"]))
-    up = jnp.einsum("nh,ehi->eni", xf, lp["w_up"])
-    down = jnp.einsum("eni,eih->enh", gate * up, lp["w_down"])  # [E, N, H]
+    gate = jax.nn.silu(jnp.einsum("nh,ehi->eni", xf, mat(lp["w_gate"])))
+    up = jnp.einsum("nh,ehi->eni", xf, mat(lp["w_up"]))
+    down = jnp.einsum("eni,eih->enh", gate * up, mat(lp["w_down"]))  # [E, N, H]
     out = jnp.einsum("enh,ne->nh", down, combine)
     return out.reshape(orig_shape)
 
@@ -232,9 +233,9 @@ def _moe_mlp(lp: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     buf = buf.at[dispatch].set(xf[token_of], mode="drop")
     buf = buf.reshape(E, C, H)
 
-    gate = jax.nn.silu(jnp.einsum("ech,ehi->eci", buf, lp["w_gate"]))
-    up = jnp.einsum("ech,ehi->eci", buf, lp["w_up"])
-    down = jnp.einsum("eci,eih->ech", gate * up, lp["w_down"])  # [E, C, H]
+    gate = jax.nn.silu(jnp.einsum("ech,ehi->eci", buf, mat(lp["w_gate"])))
+    up = jnp.einsum("ech,ehi->eci", buf, mat(lp["w_up"]))
+    down = jnp.einsum("eci,eih->ech", gate * up, mat(lp["w_down"]))  # [E, C, H]
 
     per_assign = down.reshape(E * C, H).at[jnp.minimum(dispatch, E * C - 1)].get(
         mode="fill", fill_value=0
@@ -277,9 +278,9 @@ def transformer_layer(
     B, T, _ = x.shape
     D = cfg.head_dim
     h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps, cfg.rms_norm_offset)
-    q = h @ lp["wq"]
-    k = h @ lp["wk"]
-    v = h @ lp["wv"]
+    q = h @ mat(lp["wq"])
+    k = h @ mat(lp["wk"])
+    v = h @ mat(lp["wv"])
     if "bq" in lp:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -293,7 +294,7 @@ def transformer_layer(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn, kv_pages = attn_fn(q, k, v, kv_pages, layer)
-    x = x + attn.reshape(B, T, cfg.num_heads * D) @ lp["wo"]
+    x = x + attn.reshape(B, T, cfg.num_heads * D) @ mat(lp["wo"])
     h2 = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps, cfg.rms_norm_offset)
     if cfg.is_moe:
         x = x + _moe_mlp(lp, h2, cfg)
@@ -383,5 +384,5 @@ def lm_logits(params: Params, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
     if cfg.tie_word_embeddings:
         w = params["embed"].T
     else:
-        w = params["lm_head"]
+        w = mat(params["lm_head"])
     return (hidden @ w).astype(jnp.float32)
