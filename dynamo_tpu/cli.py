@@ -319,13 +319,6 @@ async def _make_engine(args):
     initialize_multihost(mn)  # must precede the first jax backend touch
     mesh_cfg = None
     if max(args.tp, args.dp, args.sp, args.pp, args.ep) > 1:
-        if getattr(args, "quantize", None):
-            # fail before the (possibly minutes-long) checkpoint load; the
-            # engine would reject the combination anyway
-            raise SystemExit(
-                "--quantize is not supported together with a mesh "
-                "(--dp/--tp/--sp/--pp/--ep) yet"
-            )
         from .parallel.mesh import MeshConfig
 
         mesh_cfg = MeshConfig(

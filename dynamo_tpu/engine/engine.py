@@ -183,11 +183,9 @@ class JaxEngine:
                 raise ValueError(
                     f"unsupported quantize={self.cfg.quantize!r} (int8 only)"
                 )
-            if mesh is not None:
-                # the sharding specs don't know QuantizedTensor leaves yet
-                raise ValueError(
-                    "quantize='int8' is not supported together with a mesh"
-                )
+            # with a mesh, params arrive already sharded (random_init /
+            # from_pretrained shard first) and the quantization ops
+            # propagate those shardings onto q and s
             from .quant import quantize_params
 
             self.params = quantize_params(self.params, model_cfg)

@@ -83,8 +83,19 @@ def quantize_tensor(w: jax.Array, dtype: Any) -> QuantizedTensor:
     Stacked weights ([L, ...] or [L, E, ...]) quantize one leading slice at
     a time: the f32 upcast the rounding needs then peaks at ONE layer's
     size, not the whole stack -- a model loaded near HBM capacity (the
-    primary reason to quantize) must not 2x its footprint during init."""
-    if w.ndim >= 3:
+    primary reason to quantize) must not 2x its footprint during init.
+
+    Genuinely *partitioned* weights take the whole-tensor path instead:
+    every op here is elementwise or an axis reduction, so GSPMD propagates
+    the input sharding onto q and s (a per-slice stack would gather
+    shards), and the f32 transient is per-device shard-sized.  Replicated
+    weights on a multi-device mesh (dp-only meshes, or leaves whose axis
+    didn't divide) still chunk per slice -- replication would otherwise
+    materialize the full-stack f32 upcast on every device."""
+    sharded = (
+        hasattr(w, "sharding") and not w.sharding.is_fully_replicated
+    )
+    if w.ndim >= 3 and not sharded:
         parts = [_quantize_slice(w[i], dtype) for i in range(w.shape[0])]
         return QuantizedTensor(
             q=jnp.stack([p.q for p in parts]),

@@ -88,14 +88,32 @@ def test_quantized_engine_serves(run):
     run(body())
 
 
-def test_quantize_mesh_combination_rejected():
+def test_quantized_tp_engine_matches_unsharded_quantized(run):
+    """int8 composes with mesh sharding: quantization runs on the already-
+    sharded params (GSPMD propagates the tp sharding onto q and s), and the
+    served output matches the unsharded quantized engine exactly."""
     from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
 
-    mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
-    with pytest.raises(ValueError, match="not supported together"):
-        JaxEngine.random_init(
-            ModelConfig.tiny(),
-            EngineConfig(max_batch_size=4, max_seq_len=64, page_size=4,
-                         num_pages=64, quantize="int8"),
-            mesh=mesh,
+    async def body():
+        cfg = dict(max_batch_size=4, max_seq_len=64, page_size=4,
+                   num_pages=64, quantize="int8")
+        plain = JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**cfg))
+        try:
+            expect, _ = await collect(plain, req([5, 1, 4, 2, 8], max_tokens=6))
+        finally:
+            await plain.stop()
+
+        mesh = build_mesh(MeshConfig(tp=2), jax.devices()[:2])
+        sharded = JaxEngine.random_init(
+            ModelConfig.tiny(), EngineConfig(**cfg), mesh=mesh
         )
+        try:
+            # the int8 payload really is sharded, not gathered by quantize
+            spec = sharded.params["layers"]["wq"].q.sharding.spec
+            assert "tp" in [ax for ax in spec if ax], spec
+            got, _ = await collect(sharded, req([5, 1, 4, 2, 8], max_tokens=6))
+            assert got == expect
+        finally:
+            await sharded.stop()
+
+    run(body())
