@@ -1842,14 +1842,16 @@ class JaxEngine:
         pool = self.sched.pool
         for ev in events:
             queue = self._queues.get(ev.seq.request_id)
-            if ev.token is not None:
-                self._tokens_generated += 1
+            if ev.tokens:
+                self._tokens_generated += len(ev.tokens)
             if ev.completed_blocks and pool is None:
                 self._publish_stored(ev.seq, ev.completed_blocks)
             if queue is None:
                 continue
-            if ev.token is not None:
-                out = LLMEngineOutput(token_ids=[ev.token])
+            if ev.tokens:
+                # one stream item carries the whole coalesced batch of tokens
+                # (a decode block's worth); consumers iterate token_ids
+                out = LLMEngineOutput(token_ids=list(ev.tokens))
                 queue.put_nowait(Annotated.from_data(out.to_dict()))
             if ev.finished is not None:
                 out = LLMEngineOutput.finished(ev.finished)

@@ -133,12 +133,23 @@ class TickPlan:
 
 @dataclass
 class StepEvent:
-    """Per-request outcome of a tick (token emitted and/or finished)."""
+    """Per-request outcome of a tick (tokens emitted and/or finished).
+
+    ``tokens`` carries every token the tick emitted for the request -- a
+    whole decode block's worth coalesces into ONE event (commit_block), so
+    downstream per-event costs (queue put, consumer wakeup, SSE frame build)
+    are paid per block, not per token.  Order within the list is emission
+    order."""
 
     seq: SeqState
-    token: Optional[int] = None
+    tokens: List[int] = field(default_factory=list)
     finished: Optional[FinishReason] = None
     completed_blocks: List[TokenBlock] = field(default_factory=list)
+
+    @property
+    def token(self) -> Optional[int]:
+        """Single-token view for the prefill/first-token paths (and tests)."""
+        return self.tokens[0] if self.tokens else None
 
 
 class Scheduler:
@@ -491,6 +502,29 @@ class Scheduler:
                 self._release_slot(seq)
         return events
 
+    def _commit_lane_column(self, seq: SeqState, column: np.ndarray) -> StepEvent:
+        """Commit one lane's K sampled tokens as a single coalesced event.
+
+        Host-side replay of the device loop for one lane: per token the
+        exact stop-condition rules run (``_commit_token``); ``-1`` marks a
+        step the device already knew was dead.  Once the lane finishes, the
+        rest of the column was speculative decode and is discarded."""
+        tokens: List[int] = []
+        blocks: List[TokenBlock] = []
+        finished: Optional[FinishReason] = None
+        for raw in column.tolist():
+            if raw < 0:
+                continue
+            ev = self._commit_token(seq, raw)
+            tokens.extend(ev.tokens)
+            blocks.extend(ev.completed_blocks)
+            if ev.finished is not None:
+                finished = ev.finished
+                break
+        return StepEvent(
+            seq=seq, tokens=tokens, finished=finished, completed_blocks=blocks
+        )
+
     def commit_block(
         self,
         sampled: np.ndarray,
@@ -498,10 +532,12 @@ class Scheduler:
     ) -> List[StepEvent]:
         """Apply a device-decoded block of raw sampled tokens [B, K].
 
-        Host-side replay of the device loop: per step, per lane, the exact
-        stop-condition rules run here (``_commit_token``); ``-1`` marks a
-        lane the device already knew was dead.  Once a lane finishes, the
-        rest of its column was speculative decode and is discarded.
+        Each live lane's column commits through ``_commit_lane_column``,
+        which replays the device stop rules token by token but returns ONE
+        coalesced event for the block -- the per-event downstream cost
+        (queue put, consumer wakeup, SSE frame) is paid per block per lane,
+        not per token, which is what keeps large-batch decode off the host's
+        critical path.
 
         ``slot_snapshot`` is the slot list captured when the block was
         dispatched -- with pipelined blocks a slot may have been released (or
@@ -513,19 +549,16 @@ class Scheduler:
         slots_at_entry = (
             list(slot_snapshot) if slot_snapshot is not None else list(self.slots)
         )
-        for k in range(K):
-            for b in range(B):
-                seq = slots_at_entry[b]
-                if seq is None or seq.finish is not None or seq.slot != b:
-                    continue
-                token = int(sampled[b, k])
-                if token < 0:
-                    continue
-                ev = self._commit_token(seq, token)
+        for b in range(B):
+            seq = slots_at_entry[b]
+            if seq is None or seq.finish is not None or seq.slot != b:
+                continue
+            ev = self._commit_lane_column(seq, sampled[b])
+            if ev.finished is not None:
+                seq.finish = ev.finished
+                self._release_slot(seq)
+            if ev.tokens or ev.finished is not None:
                 events.append(ev)
-                if ev.finished is not None:
-                    seq.finish = ev.finished
-                    self._release_slot(seq)
         return events
 
     def commit_prefill_token(self, seq: SeqState, token: int) -> StepEvent:
@@ -546,9 +579,9 @@ class Scheduler:
         min_ok = stop.min_tokens is None or n_gen >= stop.min_tokens
 
         if token in hidden_stop and min_ok:
-            return StepEvent(seq=seq, token=None, finished=FinishReason.STOP)
+            return StepEvent(seq=seq, finished=FinishReason.STOP)
         if is_eos and not stop.ignore_eos and min_ok:
-            return StepEvent(seq=seq, token=None, finished=FinishReason.EOS)
+            return StepEvent(seq=seq, finished=FinishReason.EOS)
 
         seq.num_generated += 1
         completed: List[TokenBlock] = []
@@ -572,7 +605,7 @@ class Scheduler:
         elif seq.seq_len >= self.cfg.max_seq_len:
             finished = FinishReason.LENGTH
         return StepEvent(
-            seq=seq, token=token, finished=finished, completed_blocks=completed
+            seq=seq, tokens=[token], finished=finished, completed_blocks=completed
         )
 
     def _register_ready(self, seq: SeqState) -> None:

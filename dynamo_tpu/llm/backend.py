@@ -89,14 +89,26 @@ class Backend(Operator):
                 data: Dict[str, Any] = dict(item.data)
                 token_ids = data.get("token_ids") or []
                 pieces = [decoder.step(t) for t in token_ids]
-                delta = "".join(p for p in pieces if p)
-                text, hit = jail.push(delta) if delta else ("", False)
+                # push per piece so a stop string completing mid-chunk cuts
+                # the chunk at the completing token: tokens decoded after the
+                # stop (a coalesced decode block can carry many) must be
+                # neither emitted nor counted toward usage
+                text, hit, n_used = "", False, len(token_ids)
+                if jail.stops:
+                    for i, p in enumerate(pieces):
+                        t, hit = jail.push(p) if p else ("", False)
+                        text += t
+                        if hit:
+                            n_used = i + 1
+                            break
+                else:
+                    text = "".join(p for p in pieces if p)
                 if hit:
                     # stop string completed: emit the releasable prefix, end
                     # the request, and tell the engine to stop decoding
                     stopped = True
                     out = {
-                        "token_ids": token_ids,
+                        "token_ids": token_ids[:n_used],
                         "text": text or None,
                         "finish_reason": FinishReason.STOP.value,
                     }

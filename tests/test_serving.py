@@ -299,6 +299,57 @@ def test_backend_stop_string_cuts_and_stops_engine(model_dir, run):
     assert finish == "stop"
 
 
+def test_backend_stop_mid_coalesced_chunk_truncates_token_ids(model_dir, run):
+    """A stop string completing inside one multi-token stream item (a
+    coalesced decode block) must cut token_ids at the completing token:
+    post-stop tokens are neither emitted nor counted toward usage."""
+    tok = Tokenizer.from_model_dir(model_dir)
+    ids = tok.encode("tell me a story STOP hidden tail")
+
+    class _ChunkEngine(_ScriptEngine):
+        async def generate(self, request):
+            async def gen():
+                # the whole script arrives as ONE coalesced item
+                yield Annotated.from_data(
+                    LLMEngineOutput(token_ids=list(self.token_ids)).to_dict()
+                )
+
+            return gen()
+
+    async def main():
+        eng = link(Backend(tok), _ChunkEngine(ids))
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest,
+            StopConditions,
+        )
+
+        req = PreprocessedRequest(
+            token_ids=[1], stop_conditions=StopConditions(stop=["STOP"])
+        )
+        stream = await eng.generate(Context.new(req))
+        parts, finish, emitted = [], None, []
+        async for item in stream:
+            d = item.data or {}
+            if d.get("text"):
+                parts.append(d["text"])
+            if d.get("finish_reason"):
+                finish = d["finish_reason"]
+            emitted.extend(d.get("token_ids") or [])
+        return "".join(parts), finish, emitted
+
+    out, finish, emitted = run(main())
+    assert "STOP" not in out and "hidden" not in out
+    assert out.startswith("tell me a story")
+    assert finish == "stop"
+    # emitted token ids stop at (or just past) the stop-completing token --
+    # strictly fewer than the full script, never the post-stop tail
+    assert 0 < len(emitted) < len(ids)
+    tail_ids = tok.encode(" hidden tail")
+    decoded = tok.decode(emitted)
+    assert "hidden" not in decoded
+    assert len(emitted) <= len(ids) - len(tail_ids) + 1
+
+
 # -- HTTP service e2e against the mocker ------------------------------------
 
 
