@@ -43,13 +43,13 @@ from .step import (
     decode_block,
     inject_token,
     inject_tokens,
+    update_lanes,
     pick_bucket,
     pick_page_bucket,
     prefill_and_sample,
     prefill_buckets,
     prefill_suffix_and_sample,
     scatter_block_pages,
-    update_lane,
 )
 
 logger = logging.getLogger("dynamo.engine")
@@ -144,6 +144,18 @@ class InflightPrefill:
     sampled: Any  # jax.Array [1]
     seq: SeqState
     slot: int
+
+
+@dataclass
+class InflightPrefillGroup:
+    """A batched prefill dispatch awaiting commit: ``sampled`` is the whole
+    group's first tokens as ONE device array, fetched with ONE transfer at
+    commit (per-lane [1] handles each cost a device->host round trip on a
+    high-RTT link).  ``entries`` keep the per-lane [1] slices for the
+    pending-inject re-apply path, which never leaves the device."""
+
+    sampled: Any  # jax.Array [Bp]
+    entries: List[InflightPrefill]
 
 
 class JaxEngine:
@@ -1433,7 +1445,7 @@ class JaxEngine:
 
     def _do_prefill_group(
         self, items: List[Tuple[SeqState, int]]
-    ) -> List[InflightPrefill]:
+    ) -> List["InflightPrefillGroup"]:
         """One batched prefill dispatch for same-shape admissions (executor
         thread): the whole group pays a single weight-streaming pass.
 
@@ -1467,16 +1479,15 @@ class JaxEngine:
         # one batched scatter for the whole group's first tokens: per-lane
         # inject_token dispatches were the dominant group overhead on a
         # high-RTT device link (pad rows carry slot=B and are dropped)
-        Bpad = self._pad_batch(len(items))
-        slots = np.full((Bpad,), self.cfg.max_batch_size, np.int32)
+        slots = np.full((Bp,), self.cfg.max_batch_size, np.int32)
         for i, (seq, _pl) in enumerate(items):
             slots[i] = seq.slot
         self._dev["tokens"] = inject_tokens(
-            self._dev["tokens"], jnp.asarray(slots), sampled[:Bpad]
+            self._dev["tokens"], jnp.asarray(slots), sampled[:Bp]
         )
-        out: List[InflightPrefill] = []
+        entries: List[InflightPrefill] = []
         for i, (seq, pl) in enumerate(items):
-            tok = sampled[i : i + 1]
+            tok = sampled[i : i + 1]  # device slice: inject re-apply only
             pf = InflightPrefill(sampled=tok, seq=seq, slot=seq.slot)
             self._pending_injects[seq.slot] = pf
             if tracing.collector.enabled:
@@ -1488,9 +1499,15 @@ class JaxEngine:
                 "prefill dispatched id=%s len=%d cached=%d (group of %d)",
                 seq.request_id, pl, caches[i], len(items),
             )
-            out.append(pf)
+            entries.append(pf)
         self._steps += 1
-        return out
+        try:
+            sampled.copy_to_host_async()
+        except Exception:
+            pass  # optional fast path; the commit device_get still works
+        # ONE group handle: commit fetches the [Bp] array in one transfer
+        # instead of one round trip per lane's [1] slice
+        return [InflightPrefillGroup(sampled=sampled, entries=entries)]
 
     def _compute_limits(self) -> np.ndarray:
         """Absolute per-lane cache-length caps from the host mirrors.
@@ -1545,66 +1562,90 @@ class JaxEngine:
         d = self._dev
         assert d is not None
         limits = self._compute_limits()
-        for b in sorted(sched.dirty_slots):
+        dirty = sorted(sched.dirty_slots)
+        G = self._pad_batch(len(dirty))
+        E = self.cfg.device_stop_width
+        P = sched.page_table.shape[1]
+        slots = np.full((G,), self.cfg.max_batch_size, np.int32)  # pad = drop
+        rows = {
+            "token": np.zeros((G,), np.int32),
+            "seq_len": np.zeros((G,), np.int32),
+            "limit": np.zeros((G,), np.int32),
+            "active": np.zeros((G,), bool),
+            "stop": np.full((G, E), -1, np.int32),
+            "pages": np.zeros((G, P), np.int32),
+            "temp": np.zeros((G,), np.float32),
+            "top_p": np.ones((G,), np.float32),
+            "top_k": np.zeros((G,), np.int32),
+        }
+        for i, b in enumerate(dirty):
             seq = sched.slots[b]
-            row = {
-                "token": np.int32(sched.tokens[b]),
-                "seq_len": np.int32(sched.seq_lens[b]),
-                "limit": np.int32(limits[b]),
-                "active": np.bool_(
-                    seq is not None
-                    and limits[b] > int(sched.seq_lens[b])
-                    and not seq.awaiting_kv
-                    and not seq.prefilling
-                ),
-                "stop": self._lane_stop_row(seq),
-                "pages": sched.page_table[b].copy(),
-                "temp": np.float32(0.0),
-                "top_p": np.float32(1.0),
-                "top_k": np.int32(0),
-            }
+            slots[i] = b
+            rows["token"][i] = sched.tokens[b]
+            rows["seq_len"][i] = sched.seq_lens[b]
+            rows["limit"][i] = limits[b]
+            rows["active"][i] = (
+                seq is not None
+                and limits[b] > int(sched.seq_lens[b])
+                and not seq.awaiting_kv
+                and not seq.prefilling
+            )
+            rows["stop"][i] = self._lane_stop_row(seq)
+            rows["pages"][i] = sched.page_table[b]
             if seq is not None:
                 so = seq.sampling
                 if so.temperature is not None:
-                    row["temp"] = np.float32(so.temperature)
+                    rows["temp"][i] = so.temperature
                 elif so.top_p is not None or so.top_k is not None:
-                    row["temp"] = np.float32(1.0)
-                row["top_p"] = np.float32(so.top_p if so.top_p is not None else 1.0)
-                row["top_k"] = np.int32(so.top_k or 0)
-            samp = d["sampling"]
-            (
-                d["tokens"],
-                d["seq_lens"],
-                d["limit_lens"],
-                d["active"],
-                d["stop_ids"],
-                d["page_table"],
-                temp,
-                top_p,
-                top_k,
-            ) = update_lane(
-                d["tokens"],
-                d["seq_lens"],
-                d["limit_lens"],
-                d["active"],
-                d["stop_ids"],
-                d["page_table"],
-                samp.temperature,
-                samp.top_p,
-                samp.top_k,
-                jnp.int32(b),
-                row,
-            )
-            d["sampling"] = SamplingParams(temperature=temp, top_p=top_p, top_k=top_k)
+                    rows["temp"][i] = 1.0
+                rows["top_p"][i] = so.top_p if so.top_p is not None else 1.0
+                rows["top_k"][i] = so.top_k or 0
             self._limit_host[b] = limits[b]
-            # a pending inject for this slot holds the real first token (the
-            # mirror still has the placeholder); re-apply it on top
+        samp = d["sampling"]
+        (
+            d["tokens"],
+            d["seq_lens"],
+            d["limit_lens"],
+            d["active"],
+            d["stop_ids"],
+            d["page_table"],
+            temp,
+            top_p,
+            top_k,
+        ) = update_lanes(
+            d["tokens"],
+            d["seq_lens"],
+            d["limit_lens"],
+            d["active"],
+            d["stop_ids"],
+            d["page_table"],
+            samp.temperature,
+            samp.top_p,
+            samp.top_k,
+            jnp.asarray(slots),
+            rows,
+        )
+        d["sampling"] = SamplingParams(temperature=temp, top_p=top_p, top_k=top_k)
+        # pending injects hold the real first token for lanes whose mirror
+        # still has the placeholder; re-apply them on top of the row scatter
+        # (batched: one scatter, not one dispatch per lane)
+        injects: List[Tuple[int, Any]] = []
+        for b in dirty:
             pf = self._pending_injects.get(b)
             if pf is not None:
                 if sched.slots[b] is pf.seq and pf.seq.finish is None:
-                    d["tokens"] = inject_token(d["tokens"], jnp.int32(b), pf.sampled)
+                    injects.append((b, pf.sampled))
                 else:
                     del self._pending_injects[b]
+        if len(injects) == 1:
+            b, samp = injects[0]
+            d["tokens"] = inject_token(d["tokens"], jnp.int32(b), samp)
+        elif injects:
+            d["tokens"] = inject_tokens(
+                d["tokens"],
+                jnp.asarray(np.asarray([b for b, _ in injects], np.int32)),
+                jnp.concatenate([s for _, s in injects]),
+            )
         sched.dirty_slots.clear()
         self._dev_version = sched.layout_version
 
@@ -1813,21 +1854,27 @@ class JaxEngine:
         mats = jax.device_get([e.sampled for e in entries])
         self._drain_offload()
         events: List[StepEvent] = []
+
+        def commit_prefill(pf: InflightPrefill, token: int) -> None:
+            seq = pf.seq
+            if self._pending_injects.get(pf.slot) is pf:
+                del self._pending_injects[pf.slot]
+            if (
+                seq.finish is not None
+                or seq.slot != pf.slot
+                or self.sched.slots[pf.slot] is not seq
+                or seq.num_generated > 0
+            ):
+                return  # preempted/cancelled before the commit landed
+            events.append(self.sched.commit_prefill_token(seq, token))
+
         for e, mat in zip(entries, mats):
-            if isinstance(e, InflightPrefill):
-                seq = e.seq
-                if self._pending_injects.get(e.slot) is e:
-                    del self._pending_injects[e.slot]
-                if (
-                    seq.finish is not None
-                    or seq.slot != e.slot
-                    or self.sched.slots[e.slot] is not seq
-                    or seq.num_generated > 0
-                ):
-                    continue  # preempted/cancelled before the commit landed
-                events.append(
-                    self.sched.commit_prefill_token(seq, int(np.asarray(mat)[0]))
-                )
+            if isinstance(e, InflightPrefillGroup):
+                arr = np.asarray(mat)
+                for i, pf in enumerate(e.entries):
+                    commit_prefill(pf, int(arr[i]))
+            elif isinstance(e, InflightPrefill):
+                commit_prefill(e, int(np.asarray(mat)[0]))
             else:
                 events.extend(self.sched.commit_block(np.asarray(mat), e.slots))
         return events

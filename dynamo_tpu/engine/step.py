@@ -125,7 +125,7 @@ def decode_block(
     for the next block.
     """
 
-    def body(carry, _):
+    def live_step(carry):
         tokens, seq_lens, active, rng, kv = carry
         logits, kv = _decode_once(params, cfg, kv, tokens, seq_lens, page_table)
         rng, sub = jax.random.split(rng)
@@ -137,6 +137,17 @@ def decode_block(
         new_tokens = jnp.where(emit, sampled, tokens)
         out = jnp.where(active, sampled, -1)  # -1 = lane was already dead
         return (new_tokens, new_seq, new_active, rng, kv), out
+
+    def dead_step(carry):
+        # every lane is dead: skip the weight stream entirely.  Tail steps
+        # after the last lane finishes (and speculative blocks dispatched
+        # while a short request's commit is still in flight) would otherwise
+        # each pay a full per-step weight read for no output.
+        return carry, jnp.full_like(carry[0], -1)
+
+    def body(carry, _):
+        active = carry[2]
+        return jax.lax.cond(jnp.any(active), live_step, dead_step, carry)
 
     (tokens, seq_lens, active, rng, kv_pages), sampled = jax.lax.scan(
         body, (tokens, seq_lens, active, rng, kv_pages), None, length=num_steps
@@ -308,7 +319,7 @@ def inject_tokens(
         "page_table", "temp", "top_p", "top_k",
     ),
 )
-def update_lane(
+def update_lanes(
     tokens: jax.Array,  # [B]
     seq_lens: jax.Array,  # [B]
     limit_lens: jax.Array,  # [B]
@@ -318,27 +329,32 @@ def update_lane(
     temp: jax.Array,  # [B]
     top_p: jax.Array,  # [B]
     top_k: jax.Array,  # [B]
-    slot: jax.Array,  # scalar i32 (dynamic -> one cached executable)
-    row: dict,  # per-lane values: token/seq_len/limit/active/stop/pages/...
+    slots: jax.Array,  # [G] lane indices; out-of-range rows are pad (dropped)
+    rows: dict,  # stacked per-lane values: token [G], stop [G, E], pages [G, P], ...
 ) -> Tuple[jax.Array, ...]:
-    """Fold one lane's host-side state into the device-resident decode state.
+    """Fold G lanes' host-side state into the device-resident decode state
+    with ONE dispatch.
 
     This is how batch membership changes (admission, completion, revival,
     external-KV arrival) reach the device WITHOUT draining the decode
     pipeline: the scatter is dispatched after any in-flight decode blocks,
     so those blocks run against the old state (their stale lanes' output is
     discarded at commit via slot snapshots) and every later block sees the
-    new lane.  One dispatch, no host round trip."""
+    new lanes.  Batched because per-lane scatter calls each blocked ~a
+    tunnel one-way on their row transfers -- an admission burst of G lanes
+    cost G x ~40ms on a high-RTT device link; stacking the rows pays the
+    transfer once.  G pads to a power of two (pad rows carry an
+    out-of-range slot and drop) so compile-cache entries stay O(log B)."""
     return (
-        tokens.at[slot].set(row["token"]),
-        seq_lens.at[slot].set(row["seq_len"]),
-        limit_lens.at[slot].set(row["limit"]),
-        active.at[slot].set(row["active"]),
-        stop_ids.at[slot].set(row["stop"]),
-        page_table.at[slot].set(row["pages"]),
-        temp.at[slot].set(row["temp"]),
-        top_p.at[slot].set(row["top_p"]),
-        top_k.at[slot].set(row["top_k"]),
+        tokens.at[slots].set(rows["token"], mode="drop"),
+        seq_lens.at[slots].set(rows["seq_len"], mode="drop"),
+        limit_lens.at[slots].set(rows["limit"], mode="drop"),
+        active.at[slots].set(rows["active"], mode="drop"),
+        stop_ids.at[slots].set(rows["stop"], mode="drop"),
+        page_table.at[slots].set(rows["pages"], mode="drop"),
+        temp.at[slots].set(rows["temp"], mode="drop"),
+        top_p.at[slots].set(rows["top_p"], mode="drop"),
+        top_k.at[slots].set(rows["top_k"], mode="drop"),
     )
 
 
