@@ -24,6 +24,7 @@ def build_engine(
     num_pages: int = 768,
     decode_block: int = 64,
     quantize=None,
+    max_seq_len: int = 1024,
 ):
     """decode_block is the throughput/latency dial: 64 steps per host round
     trip is +20% decode tok/s on the tunneled bench chip (measured 1491 vs
@@ -48,7 +49,7 @@ def build_engine(
     )
     cfg = EngineConfig(
         max_batch_size=max_batch_size,
-        max_seq_len=1024,
+        max_seq_len=max_seq_len,
         page_size=16,
         num_pages=num_pages,
         decode_block_size=decode_block,
@@ -170,7 +171,13 @@ def _build_tokenizer(tmpdir: str):
 async def run_serving(engine) -> dict:
     """Served-path measurement: HTTP frontend + SSE streaming over the live
     engine; reports output tok/s and TTFT percentiles together (the
-    north-star pair, BASELINE.md row 1)."""
+    north-star pair, BASELINE.md row 1).
+
+    Two legs: a *throughput* leg (concurrency 16 over a bs-8 engine --
+    requests queue, so its TTFT is saturation-shaped) and a *latency* leg
+    (concurrency 4 <= bs, no self-inflicted queueing) whose TTFT is what an
+    SLO-governed deployment would observe.  Reference comparison point:
+    ~48 ms prefill TTFT on H100 (BASELINE.md row 4)."""
     import tempfile
 
     from dynamo_tpu.bench_serving import run_bench, synth_workload
@@ -198,10 +205,17 @@ async def run_serving(engine) -> dict:
             report = await run_bench(host, port, name, work, concurrency=16)
             s = report.summary()
             assert s["num_errors"] == 0, f"serving bench errors: {s}"
+            lat = synth_workload(16, isl=128, osl=64, request_rate=0.0,
+                                 vocab=vocab, seed=9)
+            lat_report = await run_bench(host, port, name, lat, concurrency=4)
+            ls = lat_report.summary()
+            assert ls["num_errors"] == 0, f"latency bench errors: {ls}"
             return {
                 "serving_tok_s": s["output_tok_s"],
                 "ttft_p50_ms": s["ttft_ms"]["p50"],
                 "ttft_p99_ms": s["ttft_ms"]["p99"],
+                "ttft_lat_p50_ms": ls["ttft_ms"]["p50"],
+                "ttft_lat_p99_ms": ls["ttft_ms"]["p99"],
             }
         finally:
             await svc.stop()
@@ -237,6 +251,21 @@ async def run_decode_sweep(rs) -> dict:
     return out
 
 
+async def best_of(n: int, run):
+    """Best of ``n`` timed passes of ``run()`` (fresh-args coroutine
+    factory): the tunneled chip's round-trip latency drifts with ambient
+    load, and the metrics track the engine, not the tunnel's worst moment.
+    Returns ``(result_of_best_pass, best_elapsed_s)``."""
+    best = None
+    for _ in range(n):
+        t0 = time.monotonic()
+        result = await run()
+        elapsed = time.monotonic() - t0
+        if best is None or elapsed < best[1]:
+            best = (result, elapsed)
+    return best
+
+
 async def main():
     import numpy as np
 
@@ -256,19 +285,12 @@ async def main():
     await run_batch(engine, prompts, max_tokens=8)
     await run_batch(engine, prompts, max_tokens=8)
 
-    # best of two measured passes: the tunneled chip's round-trip latency
-    # drifts with ambient load, and the metric tracks the engine, not the
-    # tunnel's worst moment
-    best = None
-    for _ in range(2):
+    async def _headline_pass():
         steps0 = engine._steps
-        t0 = time.monotonic()
         total = await run_batch(engine, prompts, max_tokens=128)
-        elapsed = time.monotonic() - t0
-        steps = engine._steps - steps0
-        if best is None or elapsed < best[1]:
-            best = (total, elapsed, steps)
-    total, elapsed, steps = best
+        return total, engine._steps - steps0
+
+    (total, steps), elapsed = await best_of(2, _headline_pass)
 
     tok_s = total / elapsed
     steps_s = steps / elapsed
@@ -291,14 +313,10 @@ async def main():
     q_prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(8)]
     await run_batch(q_engine, q_prompts, max_tokens=8)
     await run_batch(q_engine, q_prompts, max_tokens=8)
-    int8_best = None
-    for _ in range(2):
-        t0 = time.monotonic()
-        q_total = await run_batch(q_engine, q_prompts, max_tokens=128)
-        q_elapsed = time.monotonic() - t0
-        if int8_best is None or q_elapsed < int8_best[1]:
-            int8_best = (q_total, q_elapsed)
-    int8_tok_s = int8_best[0] / int8_best[1]
+    q_total, q_elapsed = await best_of(
+        2, lambda: run_batch(q_engine, q_prompts, max_tokens=128)
+    )
+    int8_tok_s = q_total / q_elapsed
     await q_engine.stop()
     del q_engine
 
@@ -310,17 +328,34 @@ async def main():
     # fresh token ids), one token each -- measures prompt ingestion
     pf_prompts = [rs.randint(1, 30000, (512,)).tolist() for _ in range(8)]
     await run_batch(engine, pf_prompts, max_tokens=1)  # compile the bucket
-    pf_prompts = [rs.randint(1, 30000, (512,)).tolist() for _ in range(8)]
-    t0 = time.monotonic()
-    await run_batch(engine, pf_prompts, max_tokens=1)
-    pf_elapsed = time.monotonic() - t0
-    prefill_tok_s = 8 * 512 / pf_elapsed
+
+    def _cold_prefill(T: int, eng):
+        # fresh token ids per pass: repeats would hit the prefix cache and
+        # measure the suffix path instead of cold prompt ingestion
+        async def run():
+            ps = [rs.randint(1, 30000, (T,)).tolist() for _ in range(8)]
+            await run_batch(eng, ps, max_tokens=1)
+        return run
+
+    _, best_pf = await best_of(2, _cold_prefill(512, engine))
+    prefill_tok_s = 8 * 512 / best_pf
 
     # served path: HTTP + SSE over the live engine (tok/s + TTFT together)
     serving = await run_serving(engine)
 
     # release the aggregated engine BEFORE the other legs spin up their
     # engines -- multiple resident models would waste HBM and cap model size
+    await engine.stop()
+    del engine
+
+    # long-prompt prefill: 8 cold 2048-token prompts, the regime where the
+    # Pallas flash kernel carries the score tensor (attention.py auto
+    # threshold T >= 1024; the T=512 leg above stays XLA-composed)
+    engine = build_engine(decode_block=16, max_seq_len=2048, num_pages=1160)
+    long_prompts = [rs.randint(1, 30000, (2048,)).tolist() for _ in range(8)]
+    await run_batch(engine, long_prompts, max_tokens=1)  # compile the bucket
+    _, best_long = await best_of(2, _cold_prefill(2048, engine))
+    prefill_tok_s_t2048 = 8 * 2048 / best_long
     await engine.stop()
     del engine
 
@@ -338,6 +373,7 @@ async def main():
                 "decode_steps_s": round(decode_steps_s, 2),
                 "dispatches_s": round(steps_s, 2),
                 "prefill_tok_s": round(prefill_tok_s, 1),
+                "prefill_tok_s_t2048": round(prefill_tok_s_t2048, 1),
                 "disagg_tok_s": round(disagg_tok_s, 2),
                 "decode_tok_s_int8": round(int8_tok_s, 2),
                 "est_hbm_util_v5e": round(util, 4),
