@@ -26,6 +26,22 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
+def _env_flag(name: str):
+    """Tri-state env override shared by every Pallas dispatch gate:
+    True/False when the variable is set, None for auto."""
+    env = os.environ.get(name)
+    if env is None:
+        return None
+    return env not in ("0", "false", "")
+
+
+def _on_tpu() -> bool:
+    try:
+        return any("TPU" in d.device_kind for d in jax.devices())
+    except Exception:
+        return False
+
+
 def _pallas_decode_enabled(page_size: int) -> bool:
     """Trace-time choice of the decode-attention backend.
 
@@ -33,15 +49,10 @@ def _pallas_decode_enabled(page_size: int) -> bool:
     backend is a TPU and the page size meets the kernel's sublane tiling
     (>= 8).  The XLA path stays as the universal fallback (CPU tests, tiny
     page sizes)."""
-    env = os.environ.get("DYN_PALLAS_DECODE")
-    if env is not None:
-        return env not in ("0", "false", "")
-    if page_size < 8:
-        return False
-    try:
-        return any("TPU" in d.device_kind for d in jax.devices())
-    except Exception:
-        return False
+    forced = _env_flag("DYN_PALLAS_DECODE")
+    if forced is not None:
+        return forced
+    return page_size >= 8 and _on_tpu()
 
 
 def decode_attention_dispatch(
@@ -78,15 +89,12 @@ def _pallas_prefill_enabled(T: int, Hq: int, Hkv: int, D: int) -> bool:
     T=1024 flash wins 102 vs 109 ms; T=2048 it wins 86 vs 117 ms (-26%);
     T=4096 106 vs 108 ms -- so auto engages at T >= 1024.  The XLA path
     stays as the universal fallback."""
-    env = os.environ.get("DYN_PALLAS_PREFILL")
-    if env is not None:
-        return env not in ("0", "false", "")
+    forced = _env_flag("DYN_PALLAS_PREFILL")
+    if forced is not None:
+        return forced
     if T < 1024 or Hq % Hkv or D % 8:
         return False
-    try:
-        return any("TPU" in d.device_kind for d in jax.devices())
-    except Exception:
-        return False
+    return _on_tpu()
 
 
 def prefill_attention_dispatch(
@@ -105,6 +113,81 @@ def prefill_attention_dispatch(
 
         return flash_prefill_attention(q, k, v, seq_lens, window)
     return prefill_attention(q, k, v, seq_lens, window)
+
+
+def _pallas_prefix_prefill_enabled(
+    T: int, Kp: int, Hq: int, Hkv: int, D: int
+) -> bool:
+    """Trace-time choice for the prefix-suffix prefill backend.
+
+    Same knob as the full-prefill dispatch (``DYN_PALLAS_PREFILL``); the
+    auto threshold engages earlier than plain prefill because the score
+    tensor the kernel avoids is ``[B, Hq, T, Kp+T]`` -- the resident
+    prefix widens the key axis beyond what T alone suggests."""
+    forced = _env_flag("DYN_PALLAS_PREFILL")
+    if forced is not None:
+        return forced
+    if Hq % Hkv or D % 8:
+        return False
+    if T < 1024 and (T < 512 or Kp < 512):
+        return False
+    return _on_tpu()
+
+
+def prefill_prefix_attention_dispatch(
+    q: jax.Array,  # [B, T, Hq, D] suffix queries
+    k: jax.Array,  # [B, T, Hkv, D] suffix keys (being prefilled)
+    v: jax.Array,  # [B, T, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    layer: jax.Array,  # scalar i32
+    prefix_table: jax.Array,  # [B, Pp] reused-prefix page ids (0-padded)
+    offset: jax.Array,  # [B] cached prefix length in tokens
+    suffix_lens: jax.Array,  # [B] valid suffix length
+    window: int = 0,
+) -> jax.Array:
+    """Prefix-suffix prefill attention: flash-tiled on TPU, XLA gather +
+    einsum elsewhere.  Resolved at trace time (same pattern as the other
+    dispatches).  The flash path pre-gathers the prefix pages into
+    contiguous K/V (a few MB, XLA-fused) and never materializes the
+    ``[B, Hq, T, Kp+T]`` score tensor -- this is the common path under KV
+    routing, where most admissions restart on a cached prefix."""
+    B, T, Hq, D = q.shape
+    Hkv = k.shape[2]
+    page_size = kv_pages.shape[3]
+    Kp = prefix_table.shape[1] * page_size
+    if _pallas_prefix_prefill_enabled(T, Kp, Hq, Hkv, D):
+        import math
+
+        from ..ops.flash_prefill import flash_prefix_prefill_attention
+
+        layer_kv = jax.lax.dynamic_index_in_dim(
+            kv_pages, layer, 0, keepdims=False
+        )
+        kp = layer_kv[0][prefix_table].reshape(B, Kp, Hkv, D)
+        vp = layer_kv[1][prefix_table].reshape(B, Kp, Hkv, D)
+        # pad the prefix span to a key-tile multiple (BK = gcd(T, 256),
+        # mirroring the kernel's tile choice): a tiny cached prefix must
+        # not collapse the whole key axis to its width, and non-pow2 top
+        # buckets must still tile exactly.  Pad keys are masked by
+        # ``kpos < offset`` (offset <= Kp <= padded span).
+        BK = math.gcd(T, 256)
+        pad = (-Kp) % BK
+        if pad:
+            widths = [(0, 0)] * 4
+            widths[1] = (0, pad)
+            kp = jnp.pad(kp, widths)
+            vp = jnp.pad(vp, widths)
+        return flash_prefix_prefill_attention(
+            q,
+            jnp.concatenate([kp, k], axis=1),
+            jnp.concatenate([vp, v], axis=1),
+            offset,
+            suffix_lens,
+            window,
+        )
+    return prefill_prefix_attention(
+        q, k, v, kv_pages, layer, prefix_table, offset, suffix_lens, window
+    )
 
 
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:

@@ -242,7 +242,7 @@ def prefill_suffix_and_sample(
     positions = offset[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
 
     def attn_fn(q, k, v, kv, layer):
-        out = att.prefill_prefix_attention(
+        out = att.prefill_prefix_attention_dispatch(
             q, k, v, kv, layer, prefix_table, offset, suffix_lens,
             cfg.sliding_window or 0,
         )

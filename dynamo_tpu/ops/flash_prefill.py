@@ -189,3 +189,202 @@ def flash_prefill_attention(
         interpret=interpret,
     )(lens, qg, kg, vg)
     return out.transpose(0, 3, 1, 2, 4).reshape(B, T, Hq, D)
+
+
+def _flash_prefix_kernel(
+    off_ref,  # [B] cached prefix lengths (SMEM scalar prefetch)
+    len_ref,  # [B] suffix lens (SMEM scalar prefetch)
+    q_ref,  # [1, 1, n_rep, BQ, D]
+    k_ref,  # [1, 1, BK, D] from the concatenated [prefix | suffix] keys
+    v_ref,  # [1, 1, BK, D]
+    o_ref,  # [1, 1, n_rep, BQ, D]
+    m_scr,  # [n_rep, BQ, 1] f32
+    l_scr,  # [n_rep, BQ, 1] f32
+    acc_scr,  # [n_rep, BQ, D] f32
+    *,
+    BQ: int,
+    BK: int,
+    Kp: int,  # prefix span of the key axis (kpos < Kp = prefix keys)
+    window: int,
+):
+    """Flash tile for suffix-prefill over [resident prefix | fresh suffix].
+
+    Key positions below ``Kp`` are gathered prefix tokens at absolute
+    positions ``kpos`` (valid while ``kpos < offset[b]``; always causally
+    visible to suffix queries, which live at ``offset + local >= offset``).
+    Keys at ``kpos >= Kp`` are the suffix being prefilled, causal in local
+    coordinates.  Sliding windows compare absolute positions across both
+    spans."""
+    b = pl.program_id(0)
+    qb = pl.program_id(2)
+    kb = pl.program_id(3)
+    n_rep, D = q_ref.shape[2], q_ref.shape[4]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    off = off_ref[b]
+    slen = len_ref[b]
+    q_lo = qb * BQ  # first local suffix position of this block
+    k_lo = kb * BK
+    is_prefix_blk = k_lo + BK <= Kp  # Kp % BK == 0: blocks never straddle
+    live = jax.lax.select(
+        is_prefix_blk,
+        k_lo < off,  # prefix block holds at least one cached token
+        (k_lo - Kp <= q_lo + BQ - 1) & (k_lo - Kp < slen),  # causal+valid
+    )
+    if window > 0:
+        # the OLDEST query in the block (absolute off + q_lo) has the lowest
+        # window floor; a block whose newest key is at/below even that floor
+        # is dead for every query it holds
+        k_hi_abs = jax.lax.select(
+            is_prefix_blk, k_lo + BK - 1, off + (k_lo + BK - 1 - Kp)
+        )
+        live = live & (k_hi_abs > off + q_lo - window)
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)  # [n_rep, BQ, D]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        scale = 1.0 / (D ** 0.5)
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            dimension_numbers=(((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [n_rep, BQ, BK]
+        q_local = q_lo + jax.lax.broadcasted_iota(
+            jnp.int32, (n_rep, BQ, BK), dimension=1
+        )
+        kpos = k_lo + jax.lax.broadcasted_iota(
+            jnp.int32, (n_rep, BQ, BK), dimension=2
+        )
+        is_suffix = kpos >= Kp
+        k_local = kpos - Kp
+        # boolean algebra, not jnp.where: Mosaic can't lower an i1 vector
+        # select at these shapes (arith.trunci i8->i1 is unsupported)
+        keep = (is_suffix & (k_local <= q_local) & (k_local < slen)) | (
+            ~is_suffix & (kpos < off)
+        )
+        if window > 0:
+            q_abs = off + q_local
+            # arithmetic, not jnp.where: same Mosaic i1-select limitation
+            # as the keep mask above (suffix keys shift by off - Kp)
+            k_abs = kpos + is_suffix.astype(jnp.int32) * (off - Kp)
+            keep = keep & (q_abs - k_abs < window)
+        s = jnp.where(keep, s, _NEG_INF)
+
+        m_prev = m_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(s - m_new)
+        pv = jax.lax.dot_general(
+            probs.astype(v.dtype), v,
+            dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [n_rep, BQ, D]
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(kb == pl.num_programs(3) - 1)
+    def _finish():
+        l = l_scr[:]
+        safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "block_q", "block_k", "interpret"),
+)
+def flash_prefix_prefill_attention(
+    q: jax.Array,  # [B, T, Hq, D] suffix queries
+    k_cat: jax.Array,  # [B, Kp + T, Hkv, D]: [gathered prefix | suffix keys]
+    v_cat: jax.Array,  # [B, Kp + T, Hkv, D]
+    offset: jax.Array,  # [B] cached prefix length in tokens (<= Kp)
+    suffix_lens: jax.Array,  # [B] valid suffix length
+    window: int = 0,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Suffix-prefill attention with a resident prefix, flash-tiled.  Same
+    contract as engine.attention.prefill_prefix_attention, taking the prefix
+    K/V pre-gathered and concatenated with the suffix (the gather is a few
+    MB and XLA-fused; the win here is the [B, Hq, T, Kp+T] score tensor that
+    never materializes).  ``BK = gcd(T, block_k)`` tiles the suffix exactly,
+    and the caller (prefill_prefix_attention_dispatch) pads the prefix span
+    to a BK multiple, so blocks never straddle the seam and no key position
+    is dropped; the kernel asserts both divisibility invariants."""
+    import math
+
+    B, T, Hq, D = q.shape
+    Hkv = k_cat.shape[2]
+    n_rep = Hq // Hkv
+    Kp = k_cat.shape[1] - T
+    BQ = min(block_q, T)
+    if T % BQ:
+        BQ = T
+    BK = math.gcd(T, block_k)
+    if Kp % BK:
+        raise ValueError(
+            f"prefix span {Kp} must be a multiple of BK={BK} "
+            f"(pad the gathered prefix; see the dispatch wrapper)"
+        )
+
+    qg = q.reshape(B, T, Hkv, n_rep, D).transpose(0, 2, 3, 1, 4)
+    kg = k_cat.transpose(0, 2, 1, 3)  # [B, Hkv, Kp+T, D]
+    vg = v_cat.transpose(0, 2, 1, 3)
+    off = offset.astype(jnp.int32)
+    lens = suffix_lens.astype(jnp.int32)
+
+    def k_map(b, h, qb, kb, off_ref, len_ref):
+        del len_ref
+        # dead block: point the fetch at block 0 (its math is skipped)
+        k_lo = kb * BK
+        is_prefix = k_lo + BK <= Kp
+        live = jax.lax.select(
+            is_prefix,
+            k_lo < off_ref[b],
+            k_lo - Kp <= qb * BQ + BQ - 1,
+        )
+        if window > 0:
+            k_hi_abs = jax.lax.select(
+                is_prefix, k_lo + BK - 1, off_ref[b] + (k_lo + BK - 1 - Kp)
+            )
+            live = live & (k_hi_abs > off_ref[b] + qb * BQ - window)
+        return (b, h, jax.lax.select(live, kb, 0), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv, T // BQ, (Kp + T) // BK),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, n_rep, BQ, D), lambda b, h, qb, kb, *_: (b, h, 0, qb, 0)
+            ),
+            pl.BlockSpec((1, 1, BK, D), k_map),
+            pl.BlockSpec((1, 1, BK, D), k_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, n_rep, BQ, D), lambda b, h, qb, kb, *_: (b, h, 0, qb, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_rep, BQ, 1), jnp.float32),
+            pltpu.VMEM((n_rep, BQ, 1), jnp.float32),
+            pltpu.VMEM((n_rep, BQ, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_prefix_kernel, BQ=BQ, BK=BK, Kp=Kp, window=window
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, n_rep, T, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(off, lens, qg, kg, vg)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, Hq, D)

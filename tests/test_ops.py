@@ -244,3 +244,115 @@ def test_prefill_dispatch_uses_xla_on_cpu():
     got = att.prefill_attention_dispatch(q, k, v, seq_lens)
     ref = att.prefill_attention(q, k, v, seq_lens)
     assert float(jnp.max(jnp.abs(ref - got))) == 0.0
+
+
+# -- flash prefix-suffix prefill kernel --------------------------------------
+
+from dynamo_tpu.ops.flash_prefill import flash_prefix_prefill_attention
+
+
+def _mk_prefix_case(B, T, Pp, page, Hq, Hkv, D, offsets, slens, seed=0,
+                    L=2, layer=1, dtype=jnp.float32):
+    """Build a paged prefix + suffix K/V pair and both paths' inputs.
+
+    The XLA reference (att.prefill_prefix_attention) reads the prefix from
+    the paged cache; the flash kernel takes the same pages pre-gathered and
+    concatenated with the suffix, exactly as the dispatch wrapper does."""
+    rs = np.random.RandomState(seed)
+    num_pages = 1 + B * Pp
+    kv_pages = jnp.asarray(
+        rs.randn(L, 2, num_pages, page, Hkv, D), dtype
+    )
+    # zero the trash page so 0-padded table slots carry no content
+    kv_pages = kv_pages.at[:, :, 0].set(0.0)
+    prefix_table = np.zeros((B, Pp), np.int32)
+    for b in range(B):
+        used = -(-offsets[b] // page)
+        prefix_table[b, :used] = 1 + b * Pp + np.arange(used)
+    prefix_table = jnp.asarray(prefix_table)
+    q = jnp.asarray(rs.randn(B, T, Hq, D), dtype)
+    k = jnp.asarray(rs.randn(B, T, Hkv, D), dtype)
+    v = jnp.asarray(rs.randn(B, T, Hkv, D), dtype)
+    offset = jnp.asarray(offsets, jnp.int32)
+    suffix_lens = jnp.asarray(slens, jnp.int32)
+    layer_kv = kv_pages[layer]
+    Kp = Pp * page
+    kp = layer_kv[0][prefix_table].reshape(B, Kp, Hkv, D)
+    vp = layer_kv[1][prefix_table].reshape(B, Kp, Hkv, D)
+    k_cat = jnp.concatenate([kp, k], axis=1)
+    v_cat = jnp.concatenate([vp, v], axis=1)
+    return kv_pages, prefix_table, q, k, v, offset, suffix_lens, k_cat, v_cat
+
+
+@pytest.mark.parametrize(
+    "B,T,Pp,page,Hq,Hkv,D,offsets,slens,bq,bk",
+    [
+        (2, 16, 2, 8, 4, 4, 16, [16, 8], [16, 9], 8, 8),    # MHA, partial
+        (2, 32, 4, 8, 8, 2, 64, [32, 0], [32, 5], 16, 16),  # GQA + no prefix
+        (1, 32, 2, 16, 32, 4, 64, [24], [32], 16, 16),      # partial page
+        (3, 16, 1, 16, 4, 2, 32, [16, 16, 0], [16, 1, 16], 16, 16),
+    ],
+)
+def test_flash_prefix_prefill_matches_xla(
+    B, T, Pp, page, Hq, Hkv, D, offsets, slens, bq, bk
+):
+    kv_pages, pt, q, k, v, offset, slen, k_cat, v_cat = _mk_prefix_case(
+        B, T, Pp, page, Hq, Hkv, D, offsets, slens
+    )
+    ref = att.prefill_prefix_attention(
+        q, k, v, kv_pages, 1, pt, offset, slen
+    )
+    got = flash_prefix_prefill_attention(
+        q, k_cat, v_cat, offset, slen, block_q=bq, block_k=bk, interpret=True
+    )
+    m = _valid_mask(T, slens)
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * m
+    assert float(diff.max()) < 1e-5
+
+
+@pytest.mark.parametrize("window", [4, 12, 24])
+def test_flash_prefix_prefill_sliding_window(window):
+    B, T, Pp, page, Hq, Hkv, D = 2, 16, 2, 8, 4, 2, 32
+    offsets, slens = [16, 8], [16, 11]
+    kv_pages, pt, q, k, v, offset, slen, k_cat, v_cat = _mk_prefix_case(
+        B, T, Pp, page, Hq, Hkv, D, offsets, slens, seed=3
+    )
+    ref = att.prefill_prefix_attention(
+        q, k, v, kv_pages, 1, pt, offset, slen, window
+    )
+    got = flash_prefix_prefill_attention(
+        q, k_cat, v_cat, offset, slen, window,
+        block_q=8, block_k=8, interpret=True,
+    )
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * _valid_mask(T, slens)
+    assert float(diff.max()) < 1e-5
+
+
+def test_flash_prefix_prefill_bf16():
+    B, T, Pp, page, Hq, Hkv, D = 2, 16, 2, 8, 4, 2, 32
+    offsets, slens = [12, 16], [16, 7]
+    kv_pages, pt, q, k, v, offset, slen, k_cat, v_cat = _mk_prefix_case(
+        B, T, Pp, page, Hq, Hkv, D, offsets, slens, seed=5, dtype=jnp.bfloat16
+    )
+    ref = att.prefill_prefix_attention(
+        q, k, v, kv_pages, 1, pt, offset, slen
+    ).astype(jnp.float32)
+    got = flash_prefix_prefill_attention(
+        q, k_cat, v_cat, offset, slen, block_q=8, block_k=8, interpret=True
+    ).astype(jnp.float32)
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * _valid_mask(T, slens)
+    assert float(diff.max()) < 0.06
+
+
+def test_prefix_prefill_dispatch_uses_xla_on_cpu():
+    """On the CPU test platform the prefix dispatch must pick the XLA path
+    (the kernel is TPU-only outside interpret mode)."""
+    B, T, Pp, page, Hq, Hkv, D = 1, 16, 1, 16, 4, 2, 16
+    kv_pages, pt, q, k, v, offset, slen, _, _ = _mk_prefix_case(
+        B, T, Pp, page, Hq, Hkv, D, [16], [16]
+    )
+    got = att.prefill_prefix_attention_dispatch(
+        q, k, v, kv_pages, 1, pt, offset, slen
+    )
+    ref = att.prefill_prefix_attention(q, k, v, kv_pages, 1, pt, offset, slen)
+    assert float(jnp.max(jnp.abs(ref - got))) == 0.0
