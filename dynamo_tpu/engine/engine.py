@@ -1563,7 +1563,11 @@ class JaxEngine:
         assert d is not None
         limits = self._compute_limits()
         dirty = sorted(sched.dirty_slots)
-        G = self._pad_batch(len(dirty))
+        # fixed G = max_batch_size: the rows are a few KB, so a single
+        # always-warm executable beats per-burst-size pad buckets (a G
+        # bucket first seen mid-serving would compile inside the measured
+        # window; pad rows carry an out-of-range slot and drop)
+        G = self.cfg.max_batch_size
         E = self.cfg.device_stop_width
         P = sched.page_table.shape[1]
         slots = np.full((G,), self.cfg.max_batch_size, np.int32)  # pad = drop
