@@ -268,15 +268,22 @@ async def run_decode_sweep(rs) -> dict:
         for mt in (64, 192):
             _, els[mt] = await best_of(2, lambda m=mt: run_batch(engine, mk(), max_tokens=m))
         d_tok = bs * (192 - 64)
-        d_el = max(els[192] - els[64], 1e-9)
-        marginal = d_tok / d_el
-        pbytes = param_bytes(engine.params)
-        steps_s = (192 - 64) / d_el
-        kv_per_step = bs * 320 * engine.kv.bytes_per_page // engine.kv.page_size
-        out["decode_marginal_tok_s_bs64"] = round(marginal, 2)
-        out["est_hbm_util_marginal_bs64"] = round(
-            (pbytes + kv_per_step) * steps_s / 819e9, 4
-        )
+        d_el = els[192] - els[64]
+        if d_el > 0:
+            marginal = d_tok / d_el
+            pbytes = param_bytes(engine.params)
+            steps_s = (192 - 64) / d_el
+            kv_per_step = (
+                bs * 320 * engine.kv.bytes_per_page // engine.kv.page_size
+            )
+            out["decode_marginal_tok_s_bs64"] = round(marginal, 2)
+            out["est_hbm_util_marginal_bs64"] = round(
+                (pbytes + kv_per_step) * steps_s / 819e9, 4
+            )
+        else:
+            # tunnel drift inverted the two legs: a difference metric from
+            # them would be garbage; record the invalidity explicitly
+            out["decode_marginal_tok_s_bs64"] = None
     finally:
         await engine.stop()
     return out

@@ -82,3 +82,63 @@ def sample_tokens(
 
     sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
     return jnp.where(params.temperature <= 0.0, greedy, sampled)
+
+def token_logprobs(
+    logits: jax.Array,  # [B, V] float32
+    sampled: jax.Array,  # [B] int32
+    top_n: int = 0,
+) -> "tuple[jax.Array, jax.Array, jax.Array]":
+    """Log-probabilities for OpenAI-style ``logprobs`` reporting.
+
+    Returns ``(chosen_lp [B], top_ids [B, N], top_lps [B, N])`` computed
+    from the raw model distribution (log-softmax of the unscaled logits --
+    the reference protocol reports model logprobs, not post-temperature /
+    post-filter sampling probabilities; aggregator parity:
+    lib/llm/src/protocols/openai/completions/aggregator.rs:43).  ``top_n``
+    is a trace-time width; 0 returns empty [B, 0] tops so callers keep one
+    packing layout."""
+    lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    logp = logits - lse  # [B, V]
+    chosen = jnp.take_along_axis(logp, sampled[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    if top_n <= 0:
+        B = logits.shape[0]
+        empty = jnp.zeros((B, 0), jnp.float32)
+        return chosen, empty.astype(jnp.int32), empty
+    top_lps, top_ids = jax.lax.top_k(logp, top_n)
+    return chosen, top_ids.astype(jnp.int32), top_lps
+
+
+def pack_sampled_logprobs(
+    sampled: jax.Array,  # [B] int32
+    chosen_lp: jax.Array,  # [B] f32
+    top_ids: jax.Array,  # [B, N] int32
+    top_lps: jax.Array,  # [B, N] f32
+) -> jax.Array:
+    """Pack token + logprob data into ONE int32 array [B, 2 + 2N]
+    (floats bitcast) so the host fetches a single array per commit --
+    device_get of an array list pays one round trip per element on a
+    high-RTT link."""
+    lp_bits = jax.lax.bitcast_convert_type(chosen_lp.astype(jnp.float32), jnp.int32)
+    top_bits = jax.lax.bitcast_convert_type(top_lps.astype(jnp.float32), jnp.int32)
+    return jnp.concatenate(
+        [sampled[:, None], lp_bits[:, None], top_ids, top_bits], axis=-1
+    )
+
+
+def unpack_sampled_logprobs(packed, top_n: int):
+    """Host-side inverse of :func:`pack_sampled_logprobs` (numpy).
+
+    ``packed`` is [..., 2 + 2N] int32; returns (tokens [...], lps [...],
+    top_ids [..., N], top_lps [..., N]) with float views bitcast back."""
+    import numpy as np
+
+    arr = np.asarray(packed)
+    tokens = arr[..., 0]
+    lps = arr[..., 1].view(np.float32) if arr.size else arr[..., 1].astype(np.float32)
+    top_ids = arr[..., 2 : 2 + top_n]
+    top_lps = (
+        arr[..., 2 + top_n : 2 + 2 * top_n].view(np.float32)
+        if arr.size
+        else arr[..., 2 + top_n :].astype(np.float32)
+    )
+    return tokens, lps, top_ids, top_lps

@@ -343,8 +343,10 @@ def update_lanes(
     new lanes.  Batched because per-lane scatter calls each blocked ~a
     tunnel one-way on their row transfers -- an admission burst of G lanes
     cost G x ~40ms on a high-RTT device link; stacking the rows pays the
-    transfer once.  G pads to a power of two (pad rows carry an
-    out-of-range slot and drop) so compile-cache entries stay O(log B)."""
+    transfer once.  The engine always calls this at G = max_batch_size
+    (rows are a few KB), so exactly ONE executable exists per engine and
+    no burst size can trigger a compile inside a serving window; unused
+    rows carry an out-of-range slot and drop."""
     return (
         tokens.at[slots].set(rows["token"], mode="drop"),
         seq_lens.at[slots].set(rows["seq_len"], mode="drop"),
