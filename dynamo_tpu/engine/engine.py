@@ -131,7 +131,9 @@ class InflightBlock:
     """A dispatched-but-uncommitted decode block (device handle + the slot
     mapping captured at dispatch time)."""
 
-    sampled: Any  # jax.Array [B, K], still computing on device
+    # packed [B, K, 2 + 2N] int32: token | logprob bits | top ids | top lps
+    # (sampling.pack_sampled_logprobs layout; N inferred from the width)
+    sampled: Any
     slots: List[Optional[SeqState]]
 
 
@@ -141,7 +143,8 @@ class InflightPrefill:
     device (already injected into the decode state); the host commits it when
     the handle is materialized alongside the next block."""
 
-    sampled: Any  # jax.Array [1]
+    sampled: Any  # packed row, jax.Array [1, 2 + 2N]
+    tok: Any  # jax.Array [1] token slice (inject re-apply path, device-only)
     seq: SeqState
     slot: int
 
@@ -503,15 +506,27 @@ class JaxEngine:
         return request_id in self._external
 
     def deliver_external(
-        self, request_id: str, kv_blob: np.ndarray, first_token: int
+        self,
+        request_id: str,
+        kv_blob: np.ndarray,
+        first_token: int,
+        lp_row: Optional[np.ndarray] = None,
     ) -> bool:
         """Hand over a remote prefill's KV (``[L, 2, n_pages, page, Hkv, D]``)
-        plus its sampled first token.  Returns False when the request is no
+        plus its sampled first token (and, optionally, the packed logprob
+        row the prefill worker sampled it from -- without it a logprobs
+        request's first token would ship without its logprob, leaving the
+        OpenAI arrays one short).  Returns False when the request is no
         longer waiting (cancelled/failed).  Applied by the tick loop at its
         next iteration -- scheduler state is never touched from here."""
         if request_id not in self._external:
             return False
-        self._deliveries[request_id] = (kv_blob, int(first_token))
+        arr = np.asarray(first_token).reshape(-1)
+        if arr.size > 1 and lp_row is None:
+            # caller handed the packed row itself as first_token (the
+            # prefill_export return): use it for the logprob too
+            lp_row = arr.astype(np.int32)
+        self._deliveries[request_id] = (kv_blob, int(arr[0]), lp_row)
         # the KV is in hand: the remote-prefill deadline's job is done.  A
         # delivery that arrives while the request still waits for a slot
         # must not be discarded by the timeout scan (the remaining wait is
@@ -554,9 +569,9 @@ class JaxEngine:
         for rid, msg in list(self._external_errors.items()):
             self._external_errors.pop(rid)
             self._drop_external(rid, f"remote prefill failed: {msg}")
-        out: List[Tuple[SeqState, int]] = []
+        out: List[Tuple[SeqState, int, Optional[np.ndarray]]] = []
         for rid in list(self._deliveries):
-            blob, first = self._deliveries.pop(rid)
+            blob, first, lp_row = self._deliveries.pop(rid)
             seq = self._external.pop(rid, None)
             if seq is None or seq.finish is not None:
                 continue
@@ -564,7 +579,7 @@ class JaxEngine:
                 # not yet admitted: re-queue the delivery until plan() gives
                 # the seq a slot and pages (or it dies)
                 self._external[rid] = seq
-                self._deliveries[rid] = (blob, first)
+                self._deliveries[rid] = (blob, first, lp_row)
                 continue
             expect = self._expected_blob_shape(seq)
             if tuple(blob.shape) != expect or expect[2] > len(seq.pages):
@@ -580,7 +595,7 @@ class JaxEngine:
                 continue
             self._external_deadline.pop(rid, None)
             seq._kv_blob = blob  # type: ignore[attr-defined]
-            out.append((seq, first))
+            out.append((seq, first, lp_row))
         if self._external_deadline:
             now = time.monotonic()
             for rid, deadline in list(self._external_deadline.items()):
@@ -592,7 +607,12 @@ class JaxEngine:
                     )
         return out
 
-    def _apply_external_kv(self, seq: SeqState, first_token: int) -> StepEvent:
+    def _apply_external_kv(
+        self,
+        seq: SeqState,
+        first_token: int,
+        lp_row: Optional[np.ndarray] = None,
+    ) -> StepEvent:
         """Executor thread: scatter the delivered KV into the lane's pages,
         then commit the remotely-sampled first token."""
         blob = seq._kv_blob  # type: ignore[attr-defined]
@@ -621,7 +641,18 @@ class JaxEngine:
             self.kv.pages, jnp.asarray(ids), jnp.asarray(padded)
         )
         seq.awaiting_kv = False
-        ev = self.sched.commit_prefill_token(seq, first_token)
+        lp, top = None, None
+        if lp_row is not None and len(lp_row) >= 2:
+            from .sampling import unpack_sampled_logprobs
+
+            N = (len(lp_row) - 2) // 2
+            _tok, lp_v, tids, tlps = unpack_sampled_logprobs(
+                np.asarray(lp_row, np.int32), N
+            )
+            lp = float(lp_v)
+            if N:
+                top = [[int(i), float(l)] for i, l in zip(tids, tlps)]
+        ev = self.sched.commit_prefill_token(seq, first_token, lp, top)
         # membership semantics changed (parked -> live): fold the lane into
         # the device state at the next dispatch
         if seq.slot >= 0:
@@ -650,8 +681,10 @@ class JaxEngine:
             sampled = self._dispatch_full_prefill(seq, prompt, pages)
             ids = np.asarray(pages, np.int32)
             blob = np.asarray(jax.device_get(self.kv.pages[:, :, ids]))
-            first = int(np.asarray(jax.device_get(sampled))[0])
-            return blob, first
+            # the full packed row (token | logprob | tops): delivery carries
+            # it so a logprobs request's first token keeps its logprob
+            row = np.asarray(jax.device_get(sampled))[0]
+            return blob, row
         finally:
             self.kv.allocator.free(pages)
 
@@ -750,11 +783,11 @@ class JaxEngine:
                 blob_all = np.asarray(
                     jax.device_get(self.kv.pages[:, :, all_ids])
                 )
-            firsts = np.asarray(jax.device_get(sampled))
+            firsts = np.asarray(jax.device_get(sampled))  # [Bp, 2 + 2N]
             off = 0
             for row, (i, pages) in enumerate(zip(group, allocated)):
                 k = len(pages)
-                results[i] = (blob_all[:, :, off : off + k], int(firsts[row]))
+                results[i] = (blob_all[:, :, off : off + k], firsts[row])
                 off += k
         finally:
             for pages in allocated:
@@ -866,9 +899,9 @@ class JaxEngine:
         while self._running:
             try:
                 self._process_cancellations()
-                for seq, first in self._process_deliveries():
+                for seq, first, lp_row in self._process_deliveries():
                     ev = await loop.run_in_executor(
-                        self._ex, self._apply_external_kv, seq, first
+                        self._ex, self._apply_external_kv, seq, first, lp_row
                     )
                     self._dispatch([ev])
                 if (
@@ -1179,6 +1212,7 @@ class JaxEngine:
             self._put_batch(page_table),
             self._next_rng(),
             self._sampling_arrays(seqs),
+            self._lp_top(seqs),
         )
         return sampled
 
@@ -1219,6 +1253,7 @@ class JaxEngine:
             self._put_batch(mml),
             self._next_rng(),
             self._sampling_arrays(seqs),
+            self._lp_top(seqs),
         )
         return sampled
 
@@ -1252,7 +1287,7 @@ class JaxEngine:
         )
         if not use_sp and not use_pp:
             return None
-        from .step import sample_step
+        from .step import sample_step_packed
 
         if use_sp:
             from ..parallel.ring_attention import ring_prefill_step
@@ -1273,8 +1308,9 @@ class JaxEngine:
                 num_microbatches=min(self._pp, Bp),
             )
             self.pp_prefills += 1
-        return sample_step(
-            logits, self._next_rng(), self._sampling_arrays(seqs)
+        return sample_step_packed(
+            logits, self._next_rng(), self._sampling_arrays(seqs),
+            self._lp_top(seqs),
         )
 
     def _dispatch_full_prefill(
@@ -1325,8 +1361,19 @@ class JaxEngine:
             self._put_batch(suffix_table),
             self._next_rng(),
             self._sampling_arrays(seqs),
+            self._lp_top(seqs),
         )
         return sampled
+
+    def _lp_top(self, seqs) -> int:
+        """Trace-time top-logprobs width for a dispatch: 8 when any live
+        request asked for alternatives (OpenAI allows up to 5 completions /
+        20 chat; widths bucket to {0, 8} so at most two executables exist
+        per step shape -- requests above 8 are clamped, PARITY.md)."""
+        for s in seqs:
+            if s is not None and s.sampling is not None and s.sampling.logprobs:
+                return 8
+        return 0
 
     def _do_prefill(
         self, seq: SeqState, prompt_len: int
@@ -1430,9 +1477,10 @@ class JaxEngine:
         # bring decode state current (admission marked the lane dirty),
         # then inject the device-resident first token into its lane
         self._sync_device_state()
-        pf = InflightPrefill(sampled=sampled, seq=seq, slot=seq.slot)
+        tok = sampled[:, 0]  # device slice from the packed [1, C] row
+        pf = InflightPrefill(sampled=sampled, tok=tok, seq=seq, slot=seq.slot)
         self._pending_injects[seq.slot] = pf
-        self._dev["tokens"] = inject_token(self._dev["tokens"], seq.slot, sampled)
+        self._dev["tokens"] = inject_token(self._dev["tokens"], seq.slot, tok)
         self._steps += 1
         if tracing.collector.enabled:
             with tracing.span(
@@ -1483,12 +1531,16 @@ class JaxEngine:
         for i, (seq, _pl) in enumerate(items):
             slots[i] = seq.slot
         self._dev["tokens"] = inject_tokens(
-            self._dev["tokens"], jnp.asarray(slots), sampled[:Bp]
+            self._dev["tokens"], jnp.asarray(slots), sampled[:Bp, 0]
         )
         entries: List[InflightPrefill] = []
         for i, (seq, pl) in enumerate(items):
-            tok = sampled[i : i + 1]  # device slice: inject re-apply only
-            pf = InflightPrefill(sampled=tok, seq=seq, slot=seq.slot)
+            pf = InflightPrefill(
+                sampled=sampled[i : i + 1],  # packed row (commit data)
+                tok=sampled[i : i + 1, 0],  # device slice: inject re-apply
+                seq=seq,
+                slot=seq.slot,
+            )
             self._pending_injects[seq.slot] = pf
             if tracing.collector.enabled:
                 with tracing.span(
@@ -1638,7 +1690,7 @@ class JaxEngine:
             pf = self._pending_injects.get(b)
             if pf is not None:
                 if sched.slots[b] is pf.seq and pf.seq.finish is None:
-                    injects.append((b, pf.sampled))
+                    injects.append((b, pf.tok))
                 else:
                     del self._pending_injects[b]
         if len(injects) == 1:
@@ -1722,7 +1774,7 @@ class JaxEngine:
         for slot, pf in list(self._pending_injects.items()):
             if sched.slots[slot] is pf.seq and pf.seq.finish is None:
                 self._dev["tokens"] = inject_token(
-                    self._dev["tokens"], slot, pf.sampled
+                    self._dev["tokens"], slot, pf.tok
                 )
             else:
                 del self._pending_injects[slot]
@@ -1776,6 +1828,7 @@ class JaxEngine:
             d["sampling"],
             K,
             use_filters,
+            self._lp_top(self.sched.slots),
         )
         self._steps += 1
         try:
@@ -1855,11 +1908,28 @@ class JaxEngine:
     def _commit_all(self, entries: List[Any]) -> List[StepEvent]:
         """Materialize and commit pending prefills/blocks in dispatch order
         (one bundled device_get instead of one round trip per handle)."""
-        mats = jax.device_get([e.sampled for e in entries])
+        from .sampling import unpack_sampled_logprobs
+
+        handles = [e.sampled for e in entries]
+        if jax.process_count() > 1:
+            # multi-host mesh (v5e pod): a batch-sharded result's shards
+            # live partly on other processes, so a plain device_get raises
+            # on non-addressable arrays.  process_allgather is a collective
+            # -- safe because serving runs SPMD-lockstep across processes
+            # (every process commits the same dispatch sequence).
+            from jax.experimental import multihost_utils
+
+            mats = [
+                multihost_utils.process_allgather(h, tiled=True)
+                for h in handles
+            ]
+        else:
+            mats = jax.device_get(handles)
         self._drain_offload()
         events: List[StepEvent] = []
 
-        def commit_prefill(pf: InflightPrefill, token: int) -> None:
+        def commit_prefill(pf: InflightPrefill, row: np.ndarray) -> None:
+            # row: packed [2 + 2N] (token | lp bits | top ids | top lps)
             seq = pf.seq
             if self._pending_injects.get(pf.slot) is pf:
                 del self._pending_injects[pf.slot]
@@ -1870,17 +1940,34 @@ class JaxEngine:
                 or seq.num_generated > 0
             ):
                 return  # preempted/cancelled before the commit landed
-            events.append(self.sched.commit_prefill_token(seq, token))
+            N = (row.shape[-1] - 2) // 2
+            tok, lp, tids, tlps = unpack_sampled_logprobs(row, N)
+            top = (
+                [[int(i), float(l)] for i, l in zip(tids, tlps)] if N else None
+            )
+            events.append(
+                self.sched.commit_prefill_token(
+                    seq, int(tok), float(lp), top
+                )
+            )
 
         for e, mat in zip(entries, mats):
             if isinstance(e, InflightPrefillGroup):
-                arr = np.asarray(mat)
+                arr = np.asarray(mat)  # [Bp, 2 + 2N]
                 for i, pf in enumerate(e.entries):
-                    commit_prefill(pf, int(arr[i]))
+                    commit_prefill(pf, arr[i])
             elif isinstance(e, InflightPrefill):
-                commit_prefill(e, int(np.asarray(mat)[0]))
+                commit_prefill(e, np.asarray(mat)[0])
             else:
-                events.extend(self.sched.commit_block(np.asarray(mat), e.slots))
+                arr = np.asarray(mat)  # [B, K, 2 + 2N]
+                N = (arr.shape[-1] - 2) // 2
+                toks, lps, tids, tlps = unpack_sampled_logprobs(arr, N)
+                events.extend(
+                    self.sched.commit_block(
+                        toks, e.slots, lps,
+                        tids if N else None, tlps if N else None,
+                    )
+                )
         return events
 
     # -- event/output dispatch (loop thread) --------------------------------
@@ -1903,6 +1990,11 @@ class JaxEngine:
                 # one stream item carries the whole coalesced batch of tokens
                 # (a decode block's worth); consumers iterate token_ids
                 out = LLMEngineOutput(token_ids=list(ev.tokens))
+                want = ev.seq.sampling.logprobs
+                if want is not None and ev.logprobs:
+                    out.logprobs = list(ev.logprobs)
+                    if want > 0 and ev.top_logprobs is not None:
+                        out.top_logprobs = [t[:want] for t in ev.top_logprobs]
                 queue.put_nowait(Annotated.from_data(out.to_dict()))
             if ev.finished is not None:
                 out = LLMEngineOutput.finished(ev.finished)

@@ -145,6 +145,11 @@ class StepEvent:
     tokens: List[int] = field(default_factory=list)
     finished: Optional[FinishReason] = None
     completed_blocks: List[TokenBlock] = field(default_factory=list)
+    # aligned with ``tokens`` when the dispatch carried logprob data:
+    # chosen-token logprobs, and per-token top-N alternatives as
+    # [[token_id, logprob], ...] (None when the dispatch ran without tops)
+    logprobs: List[float] = field(default_factory=list)
+    top_logprobs: Optional[List[List[List[float]]]] = None
 
     @property
     def token(self) -> Optional[int]:
@@ -502,7 +507,14 @@ class Scheduler:
                 self._release_slot(seq)
         return events
 
-    def _commit_lane_column(self, seq: SeqState, column: np.ndarray) -> StepEvent:
+    def _commit_lane_column(
+        self,
+        seq: SeqState,
+        column: np.ndarray,
+        lps: Optional[np.ndarray] = None,  # [K] chosen-token logprobs
+        top_ids: Optional[np.ndarray] = None,  # [K, N]
+        top_lps: Optional[np.ndarray] = None,  # [K, N]
+    ) -> StepEvent:
         """Commit one lane's K sampled tokens as a single coalesced event.
 
         Host-side replay of the device loop for one lane: per token the
@@ -511,24 +523,42 @@ class Scheduler:
         rest of the column was speculative decode and is discarded."""
         tokens: List[int] = []
         blocks: List[TokenBlock] = []
+        logprobs: List[float] = []
+        tops: Optional[List[List[List[float]]]] = (
+            [] if top_ids is not None else None
+        )
         finished: Optional[FinishReason] = None
-        for raw in column.tolist():
+        for k, raw in enumerate(column.tolist()):
             if raw < 0:
                 continue
             ev = self._commit_token(seq, raw)
-            tokens.extend(ev.tokens)
+            if ev.tokens:
+                tokens.extend(ev.tokens)
+                if lps is not None:
+                    logprobs.append(float(lps[k]))
+                if tops is not None:
+                    tops.append(
+                        [
+                            [int(i), float(l)]
+                            for i, l in zip(top_ids[k], top_lps[k])
+                        ]
+                    )
             blocks.extend(ev.completed_blocks)
             if ev.finished is not None:
                 finished = ev.finished
                 break
         return StepEvent(
-            seq=seq, tokens=tokens, finished=finished, completed_blocks=blocks
+            seq=seq, tokens=tokens, finished=finished, completed_blocks=blocks,
+            logprobs=logprobs, top_logprobs=tops,
         )
 
     def commit_block(
         self,
         sampled: np.ndarray,
         slot_snapshot: Optional[List[Optional[SeqState]]] = None,
+        lps: Optional[np.ndarray] = None,  # [B, K] chosen-token logprobs
+        top_ids: Optional[np.ndarray] = None,  # [B, K, N]
+        top_lps: Optional[np.ndarray] = None,  # [B, K, N]
     ) -> List[StepEvent]:
         """Apply a device-decoded block of raw sampled tokens [B, K].
 
@@ -553,7 +583,12 @@ class Scheduler:
             seq = slots_at_entry[b]
             if seq is None or seq.finish is not None or seq.slot != b:
                 continue
-            ev = self._commit_lane_column(seq, sampled[b])
+            ev = self._commit_lane_column(
+                seq, sampled[b],
+                lps[b] if lps is not None else None,
+                top_ids[b] if top_ids is not None else None,
+                top_lps[b] if top_lps is not None else None,
+            )
             if ev.finished is not None:
                 seq.finish = ev.finished
                 self._release_slot(seq)
@@ -561,9 +596,20 @@ class Scheduler:
                 events.append(ev)
         return events
 
-    def commit_prefill_token(self, seq: SeqState, token: int) -> StepEvent:
+    def commit_prefill_token(
+        self,
+        seq: SeqState,
+        token: int,
+        logprob: Optional[float] = None,
+        top: Optional[List[List[float]]] = None,
+    ) -> StepEvent:
         """Apply the first token sampled from prefill logits."""
         ev = self._commit_token(seq, token)
+        if ev.tokens:
+            if logprob is not None:
+                ev.logprobs = [logprob]
+            if top is not None:
+                ev.top_logprobs = [top]
         if ev.finished is not None:
             seq.finish = ev.finished
             self._release_slot(seq)

@@ -24,7 +24,12 @@ import jax.numpy as jnp
 from . import attention as att
 from .config import ModelConfig
 from .model import Params, lm_logits, transformer
-from .sampling import SamplingParams, sample_tokens
+from .sampling import (
+    SamplingParams,
+    pack_sampled_logprobs,
+    sample_tokens,
+    token_logprobs,
+)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
@@ -88,7 +93,7 @@ decode_step = partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pa
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "num_steps", "use_filters"),
+    static_argnames=("cfg", "num_steps", "use_filters", "top_n"),
     donate_argnames=("kv_pages",),
 )
 def decode_block(
@@ -105,6 +110,7 @@ def decode_block(
     sampling: SamplingParams,
     num_steps: int,
     use_filters: bool = True,
+    top_n: int = 0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Run ``num_steps`` decode+sample iterations entirely on device.
 
@@ -120,8 +126,12 @@ def decode_block(
     ``_commit_token``), so device masking is purely an optimization that
     stops dead lanes from burning HBM bandwidth.
 
-    Returns ``(sampled [B, num_steps] raw tokens, tokens, seq_lens, active,
-    kv_pages, rng)`` -- everything except ``sampled`` stays device-resident
+    Returns ``(packed [B, num_steps, 2 + 2*top_n], tokens, seq_lens,
+    active, kv_pages, rng)``: packed rows carry (raw token | chosen
+    logprob | top-N ids | top-N logprobs) per sampling.pack_sampled_logprobs
+    -- one int32 array, one device->host transfer, logprobs always
+    available (token at [..., 0] is ``-1`` for lanes the device already
+    knew were dead).  Everything except ``packed`` stays device-resident
     for the next block.
     """
 
@@ -130,29 +140,35 @@ def decode_block(
         logits, kv = _decode_once(params, cfg, kv, tokens, seq_lens, page_table)
         rng, sub = jax.random.split(rng)
         sampled = sample_tokens(logits, sub, sampling, use_filters)
+        lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
         hit_stop = jnp.any(sampled[:, None] == stop_ids, axis=1)
         emit = active & ~hit_stop  # stop tokens are swallowed, not emitted
         new_seq = seq_lens + emit.astype(jnp.int32)
         new_active = emit & (new_seq < limit_lens)
         new_tokens = jnp.where(emit, sampled, tokens)
         out = jnp.where(active, sampled, -1)  # -1 = lane was already dead
-        return (new_tokens, new_seq, new_active, rng, kv), out
+        packed = pack_sampled_logprobs(out, lp, top_ids, top_lps)
+        return (new_tokens, new_seq, new_active, rng, kv), packed
 
     def dead_step(carry):
         # every lane is dead: skip the weight stream entirely.  Tail steps
         # after the last lane finishes (and speculative blocks dispatched
         # while a short request's commit is still in flight) would otherwise
         # each pay a full per-step weight read for no output.
-        return carry, jnp.full_like(carry[0], -1)
+        B = carry[0].shape[0]
+        packed = jnp.full((B, 2 + 2 * top_n), -1, jnp.int32)
+        return carry, packed
 
     def body(carry, _):
         active = carry[2]
         return jax.lax.cond(jnp.any(active), live_step, dead_step, carry)
 
-    (tokens, seq_lens, active, rng, kv_pages), sampled = jax.lax.scan(
+    (tokens, seq_lens, active, rng, kv_pages), packed = jax.lax.scan(
         body, (tokens, seq_lens, active, rng, kv_pages), None, length=num_steps
     )
-    return sampled.T, tokens, seq_lens, active, kv_pages, rng
+    return (
+        packed.transpose(1, 0, 2), tokens, seq_lens, active, kv_pages, rng
+    )
 
 
 @jax.jit
@@ -162,7 +178,21 @@ def sample_step(
     return sample_tokens(logits, rng, params)
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
+@partial(jax.jit, static_argnames=("top_n",))
+def sample_step_packed(
+    logits: jax.Array, rng: jax.Array, params: SamplingParams, top_n: int = 0
+) -> jax.Array:
+    """Sample + logprob packing: [B, 2 + 2*top_n] int32 (token | chosen
+    logprob bits | top ids | top logprob bits) -- the layout every engine
+    sampling site shares (sampling.pack_sampled_logprobs)."""
+    sampled = sample_tokens(logits, rng, params)
+    lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
+    return pack_sampled_logprobs(sampled, lp, top_ids, top_lps)
+
+
+@partial(
+    jax.jit, static_argnames=("cfg", "top_n"), donate_argnames=("kv_pages",)
+)
 def prefill_and_sample(
     params: Params,
     cfg: ModelConfig,
@@ -172,17 +202,21 @@ def prefill_and_sample(
     page_table: jax.Array,
     rng: jax.Array,
     sampling: SamplingParams,
+    top_n: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Prefill + first-token sampling fused into one dispatch.
 
-    Returns (sampled [B], kv) -- the sampled handle stays on device so the
-    first token can be injected into the decode state without a host round
-    trip (engine._do_prefill)."""
+    Returns (packed [B, 2 + 2*top_n], kv) -- token at [:, 0], chosen/top
+    logprobs bitcast alongside.  The handle stays on device so the first
+    token can be injected into the decode state without a host round trip
+    (engine._do_prefill)."""
     logits, kv_pages = prefill_step(params, cfg, kv_pages, tokens, seq_lens, page_table)
-    return sample_tokens(logits, rng, sampling), kv_pages
+    return sample_step_packed(logits, rng, sampling, top_n), kv_pages
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
+@partial(
+    jax.jit, static_argnames=("cfg", "top_n"), donate_argnames=("kv_pages",)
+)
 def prefill_mm_and_sample(
     params: Params,
     cfg: ModelConfig,
@@ -194,6 +228,7 @@ def prefill_mm_and_sample(
     mm_len: jax.Array,  # [B] rows valid per lane (0 = text-only lane)
     rng: jax.Array,
     sampling: SamplingParams,
+    top_n: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Multimodal prefill: llava-style soft-prompt injection over the first
     ``mm_len`` positions, then the standard causal prefill + sample.  A
@@ -217,10 +252,12 @@ def prefill_mm_and_sample(
     last = jnp.clip(seq_lens - 1, 0, T - 1)
     hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
     logits = lm_logits(params, cfg, hidden_last)
-    return sample_tokens(logits, rng, sampling), kv_pages
+    return sample_step_packed(logits, rng, sampling, top_n), kv_pages
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
+@partial(
+    jax.jit, static_argnames=("cfg", "top_n"), donate_argnames=("kv_pages",)
+)
 def prefill_suffix_and_sample(
     params: Params,
     cfg: ModelConfig,
@@ -232,12 +269,13 @@ def prefill_suffix_and_sample(
     suffix_table: jax.Array,  # [B, T//page_size] pages the suffix writes into
     rng: jax.Array,
     sampling: SamplingParams,
+    top_n: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """Prefix-cache restart: prefill only the suffix, attending to the
     resident prefix pages; sample the first token (engine-side prefix reuse,
     reference block_manager/pool.rs match + vLLM prefix caching semantics).
 
-    Returns (sampled [B], kv)."""
+    Returns (packed [B, 2 + 2*top_n], kv) -- token at [:, 0]."""
     B, T = tokens.shape
     positions = offset[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
 
@@ -253,7 +291,7 @@ def prefill_suffix_and_sample(
     last = jnp.clip(suffix_lens - 1, 0, T - 1)
     hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
     logits = lm_logits(params, cfg, hidden_last)
-    return sample_tokens(logits, rng, sampling), kv_pages
+    return sample_step_packed(logits, rng, sampling, top_n), kv_pages
 
 
 @partial(jax.jit, static_argnames=("cfg",))

@@ -112,6 +112,11 @@ class Backend(Operator):
                         "text": text or None,
                         "finish_reason": FinishReason.STOP.value,
                     }
+                    # logprob lists stay aligned with the truncated tokens
+                    if data.get("logprobs") is not None:
+                        out["logprobs"] = data["logprobs"][:n_used]
+                    if data.get("top_logprobs") is not None:
+                        out["top_logprobs"] = data["top_logprobs"][:n_used]
                     yield Annotated.from_data(out)
                     ctx.stop_generating()
                     break

@@ -344,8 +344,10 @@ class DisaggDecodeEngine:
                     f"KV delivery truncated: got {off} of {size} bytes",
                 )
             else:
+                lp_row = meta.get("lp_row")
                 ok = self.engine.deliver_external(
-                    rid, buf, int(meta["first_token"])
+                    rid, buf, int(meta["first_token"]),
+                    np.asarray(lp_row, np.int32) if lp_row else None,
                 )
 
         yield json.dumps({"ok": ok}).encode()
@@ -496,20 +498,25 @@ class PrefillWorker:
                     "error notification failed for request %s", rid
                 )
             return
-        blob, first = result
+        blob, row = result  # row: packed [2 + 2N] (token | logprob | tops)
+        first = int(np.asarray(row).reshape(-1)[0])
+        lp_row = [int(x) for x in np.asarray(row).reshape(-1)]
         local = self._local_engine(msg)
         if local is not None and not isinstance(blob, np.ndarray):
             # same-process handoff: the device-resident blob goes straight
             # into the decode engine's delivery queue; the scatter is a
             # device-to-device copy at its next tick
             self.local_deliveries += 1
-            local.deliver_external(rid, blob, int(first))
+            local.deliver_external(
+                rid, blob, first, np.asarray(lp_row, np.int32)
+            )
         else:
             meta = {
                 "request_id": rid,
                 "dtype": str(blob.dtype),
                 "shape": list(blob.shape),
-                "first_token": int(first),
+                "first_token": first,
+                "lp_row": lp_row,
             }
             if not isinstance(blob, np.ndarray):
                 # mixed batch: a device export targeting a remote decode
@@ -521,9 +528,11 @@ class PrefillWorker:
                 logger.exception("KV delivery failed for request %s", rid)
                 raise
         self.prefills_done += 1
+        prompt_tokens = len((msg.get("request") or {}).get("token_ids") or ())
         logger.info(
             "prefilled %d tokens for %s -> %s/%d",
-            blob.shape[2] * blob.shape[3], rid,
+            # the true prompt length, not the page-padded blob capacity
+            prompt_tokens or blob.shape[2] * blob.shape[3], rid,
             msg["decode_component"], int(msg["decode_instance"]),
         )
 

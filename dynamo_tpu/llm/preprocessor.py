@@ -118,12 +118,78 @@ class OpenAIPreprocessor(Operator):
                 frequency_penalty=s.frequency_penalty,
                 presence_penalty=s.presence_penalty,
                 seed=s.seed,
+                logprobs=s.logprobs,
             ),
             eos_token_ids=self.tokenizer.eos_token_ids,
         )
         out.annotations = list(getattr(req, "annotations", []) or [])
         out._formatted_prompt = prompt  # for the formatted_prompt annotation
         return out
+
+    def _format_logprobs(
+        self, data: Dict[str, Any], is_chat: bool, text_off: int
+    ) -> Dict[str, Any]:
+        """Engine logprob payload -> OpenAI response structures.
+
+        Chat: ``{"content": [{token, logprob, bytes, top_logprobs}]}``;
+        completions: ``{tokens, token_logprobs, top_logprobs, text_offset}``
+        (reference aggregator shapes, openai/completions/aggregator.rs:43).
+        Token strings come from single-id detokenization; ``text_offset``
+        is the offset of this chunk's first token within the emitted
+        completion text (per-token offsets inside a multi-token chunk are
+        approximated from the token strings' lengths -- the stop jail can
+        hold back text, so exact alignment is not reconstructible in a
+        stream)."""
+        ids = data.get("token_ids") or []
+        lps = data.get("logprobs") or []
+        tops = data.get("top_logprobs")
+        tok_str = [self.tokenizer.decode([t]) for t in ids]
+
+        def top_entries(i: int):
+            if tops is None or i >= len(tops):
+                return None
+            return [
+                (self.tokenizer.decode([int(tid)]), float(tlp))
+                for tid, tlp in tops[i]
+            ]
+
+        if is_chat:
+            content = []
+            for i, (t, lp) in enumerate(zip(tok_str, lps)):
+                entry: Dict[str, Any] = {
+                    "token": t,
+                    "logprob": lp,
+                    "bytes": list(t.encode("utf-8")),
+                }
+                te = top_entries(i)
+                if te is not None:
+                    entry["top_logprobs"] = [
+                        {
+                            "token": s,
+                            "logprob": l,
+                            "bytes": list(s.encode("utf-8")),
+                        }
+                        for s, l in te
+                    ]
+                content.append(entry)
+            return {"content": content}
+        offsets, off = [], text_off
+        for t in tok_str:
+            offsets.append(off)
+            off += len(t)
+        return {
+            "tokens": tok_str,
+            "token_logprobs": list(lps),
+            "top_logprobs": (
+                [
+                    {s: l for s, l in (top_entries(i) or [])}
+                    for i in range(len(ids))
+                ]
+                if tops is not None
+                else None
+            ),
+            "text_offset": offsets,
+        }
 
     # -- Operator ------------------------------------------------------------
 
@@ -154,6 +220,7 @@ class OpenAIPreprocessor(Operator):
                 )
             completion_tokens = 0
             finish: Optional[str] = None
+            text_off = 0  # running offset into the emitted completion text
             async for item in stream:
                 if not isinstance(item, Annotated):
                     item = Annotated.from_data(item)
@@ -170,14 +237,32 @@ class OpenAIPreprocessor(Operator):
                     from ..protocols.common import FinishReason
 
                     finish = FinishReason(fr).to_openai()
-                if text:
+                # a token whose incremental detok produced no text yet (e.g.
+                # a byte-level partial) must still ship its logprobs
+                has_lp = (
+                    data.get("logprobs") is not None
+                    and data.get("token_ids")
+                )
+                if text or has_lp:
+                    lp = (
+                        self._format_logprobs(data, is_chat, text_off)
+                        if has_lp
+                        else None
+                    )
+                    text_off += len(text or "")
                     if is_chat:
                         yield Annotated.from_data(
-                            chat_chunk(rid, model, created, content=text)
+                            chat_chunk(
+                                rid, model, created, content=text or "",
+                                logprobs=lp,
+                            )
                         )
                     else:
                         yield Annotated.from_data(
-                            completion_chunk(rid, model, created, text=text)
+                            completion_chunk(
+                                rid, model, created, text=text or "",
+                                logprobs=lp,
+                            )
                         )
             final = (
                 chat_chunk(rid, model, created, finish_reason=finish or "stop")

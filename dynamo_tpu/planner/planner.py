@@ -58,6 +58,11 @@ class PlannerConfig:
     prefill_grace_periods: int = 3
     # observe and log decisions without acting (reference no-operation mode)
     no_op: bool = False
+    # machine-readable adjustment history: one JSON line per decision,
+    # appended here (the reference planner writes each adjustment to a
+    # tensorboard sink, examples/llm/components/planner.py; JSONL serves
+    # the same threshold-tuning loop without a TB dependency)
+    adjustment_log_path: Optional[str] = None
 
 
 @dataclass
@@ -201,5 +206,27 @@ class Planner:
         )
         if action != "hold":
             logger.info("planner: %s %s (%s), count was %d", kind, action, reason, count)
+        if self.cfg.adjustment_log_path:
+            try:
+                import json
+
+                with open(self.cfg.adjustment_log_path, "a") as f:
+                    f.write(
+                        json.dumps(
+                            {
+                                "ts": time.time(),
+                                "kind": kind,
+                                "action": action,
+                                "reason": reason,
+                                "count_before": count,
+                                "no_op": self.cfg.no_op,
+                            }
+                        )
+                        + "\n"
+                    )
+            except OSError:
+                logger.warning(
+                    "planner adjustment log write failed", exc_info=True
+                )
         if len(self.adjustments) > 4096:
             del self.adjustments[:2048]

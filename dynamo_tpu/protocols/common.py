@@ -59,6 +59,10 @@ class SamplingOptions:
     presence_penalty: Optional[float] = None
     repetition_penalty: Optional[float] = None
     seed: Optional[int] = None
+    # OpenAI logprobs: None = off; 0 = chosen-token logprob only; N > 0 =
+    # chosen + top-N alternatives per position (reference protocol parity:
+    # openai/completions/aggregator.rs:43,159)
+    logprobs: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
@@ -150,6 +154,10 @@ class LLMEngineOutput:
     tokens: Optional[List[str]] = None
     text: Optional[str] = None
     cum_log_probs: Optional[float] = None
+    # per-token logprobs aligned with token_ids, and per-token top-N
+    # alternatives as [[token_id, logprob], ...] lists (JSON-able)
+    logprobs: Optional[List[float]] = None
+    top_logprobs: Optional[List[List[List[float]]]] = None
     finish_reason: Optional[FinishReason] = None
     # completed KV blocks for this step (router/event feedback)
     completed_blocks: Optional[List[Dict[str, int]]] = None
@@ -162,6 +170,10 @@ class LLMEngineOutput:
             out["text"] = self.text
         if self.cum_log_probs is not None:
             out["cum_log_probs"] = self.cum_log_probs
+        if self.logprobs is not None:
+            out["logprobs"] = self.logprobs
+        if self.top_logprobs is not None:
+            out["top_logprobs"] = self.top_logprobs
         if self.finish_reason is not None:
             out["finish_reason"] = self.finish_reason.value
         if self.completed_blocks is not None:
@@ -176,6 +188,8 @@ class LLMEngineOutput:
             tokens=d.get("tokens"),
             text=d.get("text"),
             cum_log_probs=d.get("cum_log_probs"),
+            logprobs=d.get("logprobs"),
+            top_logprobs=d.get("top_logprobs"),
             finish_reason=FinishReason(fr) if fr else None,
             completed_blocks=d.get("completed_blocks"),
         )

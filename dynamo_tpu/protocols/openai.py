@@ -71,9 +71,14 @@ class SamplingFields:
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     ignore_eos: bool = False
+    # normalized logprobs request: None = off, 0 = chosen-token only,
+    # N > 0 = chosen + top-N alternatives (clamped to 8, PARITY.md)
+    logprobs: Optional[int] = None
 
     @classmethod
-    def from_dict(cls, d: Dict[str, Any]) -> "SamplingFields":
+    def from_dict(
+        cls, d: Dict[str, Any], chat: bool = False
+    ) -> "SamplingFields":
         nvext = d.get("nvext") or {}
         max_tokens = d.get("max_completion_tokens", d.get("max_tokens"))
         out = cls(
@@ -87,6 +92,7 @@ class SamplingFields:
             frequency_penalty=d.get("frequency_penalty"),
             presence_penalty=d.get("presence_penalty"),
             ignore_eos=bool(d.get("ignore_eos", nvext.get("ignore_eos", False))),
+            logprobs=_parse_logprobs(d, chat),
         )
         if out.temperature is not None and not 0.0 <= out.temperature <= 2.0:
             raise OpenAIError("'temperature' must be in [0, 2]")
@@ -95,6 +101,29 @@ class SamplingFields:
         if out.max_tokens is not None and out.max_tokens < 1:
             raise OpenAIError("'max_tokens' must be >= 1")
         return out
+
+
+def _parse_logprobs(d: Dict[str, Any], chat: bool) -> Optional[int]:
+    """OpenAI logprobs fields -> normalized top-N (None = off).
+
+    Chat: ``logprobs: bool`` + ``top_logprobs: int``; completions:
+    ``logprobs: int`` (N alternatives alongside the chosen token).
+    Reference protocol parity: openai/completions/aggregator.rs:43."""
+    lp = d.get("logprobs")
+    if lp is None or lp is False:
+        return None
+    if chat:
+        if not isinstance(lp, bool):
+            raise OpenAIError("chat 'logprobs' must be a boolean")
+        top = d.get("top_logprobs", 0)
+        if not isinstance(top, int) or top < 0:
+            raise OpenAIError("'top_logprobs' must be a non-negative integer")
+        return min(top, 8)
+    if isinstance(lp, bool):  # completions logprobs is numeric
+        raise OpenAIError("'logprobs' must be an integer for completions")
+    if not isinstance(lp, int) or lp < 0:
+        raise OpenAIError("'logprobs' must be a non-negative integer")
+    return min(lp, 8)
 
 
 @dataclass
@@ -124,7 +153,7 @@ class ChatCompletionRequest:
         return cls(
             model=model,
             messages=messages,
-            sampling=SamplingFields.from_dict(d),
+            sampling=SamplingFields.from_dict(d, chat=True),
             stream=bool(d.get("stream", False)),
             annotations=list(nvext.get("annotations") or []),
         )
@@ -277,20 +306,24 @@ def chat_chunk(
     content: Optional[str] = None,
     role: Optional[str] = None,
     finish_reason: Optional[str] = None,
+    logprobs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     delta: Dict[str, Any] = {}
     if role is not None:
         delta["role"] = role
     if content is not None:
         delta["content"] = content
+    choice: Dict[str, Any] = {
+        "index": 0, "delta": delta, "finish_reason": finish_reason
+    }
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     return {
         "id": response_id,
         "object": "chat.completion.chunk",
         "created": created,
         "model": model,
-        "choices": [
-            {"index": 0, "delta": delta, "finish_reason": finish_reason}
-        ],
+        "choices": [choice],
     }
 
 
@@ -301,15 +334,19 @@ def completion_chunk(
     *,
     text: str = "",
     finish_reason: Optional[str] = None,
+    logprobs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
+    choice: Dict[str, Any] = {
+        "index": 0, "text": text, "finish_reason": finish_reason
+    }
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     return {
         "id": response_id,
         "object": "text_completion",
         "created": created,
         "model": model,
-        "choices": [
-            {"index": 0, "text": text, "finish_reason": finish_reason}
-        ],
+        "choices": [choice],
     }
 
 
@@ -325,6 +362,7 @@ def aggregate_chat(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold a chunk stream into one chat.completion response (reference
     aggregator, protocols/openai/chat_completions/aggregator.rs)."""
     content: List[str] = []
+    lp_content: List[Dict[str, Any]] = []
     finish = None
     rid, model, created, usage = "", "", int(time.time()), None
     for ch in chunks:
@@ -336,6 +374,9 @@ def aggregate_chat(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
             delta = choice.get("delta") or {}
             if delta.get("content"):
                 content.append(delta["content"])
+            lp = choice.get("logprobs")
+            if lp and lp.get("content"):
+                lp_content.extend(lp["content"])
             if choice.get("finish_reason"):
                 finish = choice["finish_reason"]
     out = {
@@ -351,6 +392,8 @@ def aggregate_chat(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
             }
         ],
     }
+    if lp_content:
+        out["choices"][0]["logprobs"] = {"content": lp_content}
     if usage:
         out["usage"] = usage
     return out
@@ -360,6 +403,7 @@ def aggregate_completion(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
     text: List[str] = []
     finish = None
     rid, model, created, usage = "", "", int(time.time()), None
+    lp: Optional[Dict[str, List[Any]]] = None
     for ch in chunks:
         rid = ch.get("id") or rid
         model = ch.get("model") or model
@@ -368,6 +412,21 @@ def aggregate_completion(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
         for choice in ch.get("choices") or []:
             if choice.get("text"):
                 text.append(choice["text"])
+            clp = choice.get("logprobs")
+            if clp:
+                if lp is None:
+                    lp = {
+                        "tokens": [], "token_logprobs": [],
+                        "top_logprobs": [], "text_offset": [],
+                    }
+                lp["tokens"].extend(clp.get("tokens") or [])
+                lp["token_logprobs"].extend(clp.get("token_logprobs") or [])
+                tops = clp.get("top_logprobs")
+                lp["top_logprobs"].extend(
+                    tops if tops is not None
+                    else [None] * len(clp.get("tokens") or [])
+                )
+                lp["text_offset"].extend(clp.get("text_offset") or [])
             if choice.get("finish_reason"):
                 finish = choice["finish_reason"]
     out = {
@@ -379,6 +438,8 @@ def aggregate_completion(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
             {"index": 0, "text": "".join(text), "finish_reason": finish or "stop"}
         ],
     }
+    if lp is not None:
+        out["choices"][0]["logprobs"] = lp
     if usage:
         out["usage"] = usage
     return out

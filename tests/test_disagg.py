@@ -368,7 +368,14 @@ def test_prefill_export_batch_matches_singles(run):
             assert isinstance(results[2], Exception)
             got = [results[0], results[1], results[3]]
             for (blob_s, first_s), (blob_b, first_b) in zip(singles, got):
-                assert first_s == first_b
+                # packed rows: tokens agree exactly; logprob bits only to
+                # ~1 ulp (same bs=1 vs padded-batch rounding as the blob)
+                rs, rb = np.asarray(first_s), np.asarray(first_b)
+                assert rs[0] == rb[0]
+                np.testing.assert_allclose(
+                    rs[1:2].view(np.float32), rb[1:2].view(np.float32),
+                    rtol=1e-4, atol=1e-4,
+                )
                 assert blob_s.shape == blob_b.shape
                 # bitwise equality is too strict: XLA's codegen rounds
                 # differently for a bs=1 vs a padded-batch matmul (~1 ulp)
@@ -416,7 +423,7 @@ def test_truncated_kv_delivery_fails_parked_lane(run):
                     "request_id": ctx.id,
                     "dtype": str(blob.dtype),
                     "shape": list(blob.shape),
-                    "first_token": int(first),
+                    "first_token": int(np.asarray(first).reshape(-1)[0]),
                 }
             }
             out = disagg._kv_deliver(hdr, short_chunks(), None)

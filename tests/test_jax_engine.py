@@ -689,3 +689,77 @@ def test_engine_embed_interleaves_with_generate(run):
     ref, tokens, vecs = run(main())
     assert tokens == ref  # generation unaffected by the concurrent embed
     assert len(vecs) == 1
+
+
+# -- logprobs ----------------------------------------------------------------
+
+
+def test_logprobs_emitted_when_requested(run):
+    """A request with sampling_options.logprobs gets per-token logprobs (and
+    top-N alternatives) aligned with its tokens; a plain request gets none.
+    Greedy decoding makes the chosen token the top-1 alternative, pinning
+    the device's log-softmax against its own top-k (reference protocol:
+    openai/completions/aggregator.rs:43)."""
+
+    async def main():
+        engine = make_engine()
+        r = PreprocessedRequest(
+            token_ids=[1, 2, 3, 4],
+            stop_conditions=StopConditions(max_tokens=6),
+            sampling_options=SamplingOptions(temperature=0.0, logprobs=2),
+        )
+        stream = await engine.generate(Context.new(r))
+        toks, lps, tops = [], [], []
+        async for item in stream:
+            d = item.data or {}
+            toks.extend(d.get("token_ids") or [])
+            lps.extend(d.get("logprobs") or [])
+            tops.extend(d.get("top_logprobs") or [])
+        # plain request on the same engine: no logprob keys in its stream
+        stream2 = await engine.generate(Context.new(req([5, 6, 7])))
+        saw_lp = False
+        async for item in stream2:
+            d = item.data or {}
+            if d.get("logprobs") is not None:
+                saw_lp = True
+        await engine.stop()
+        return toks, lps, tops, saw_lp
+
+    toks, lps, tops, saw_lp = run(main())
+    assert len(toks) == 6
+    assert len(lps) == 6 and len(tops) == 6
+    assert not saw_lp
+    import math
+
+    for t, lp, top in zip(toks, lps, tops):
+        assert math.isfinite(lp) and lp <= 0.0
+        assert len(top) == 2  # clamped to the requested width
+        # greedy: the chosen token IS the argmax -> top-1 matches exactly
+        assert top[0][0] == t
+        assert abs(top[0][1] - lp) < 1e-5
+        assert top[0][1] >= top[1][1]
+
+
+def test_logprobs_chosen_only(run):
+    """logprobs=0: chosen-token logprobs flow, no alternatives."""
+
+    async def main():
+        engine = make_engine()
+        r = PreprocessedRequest(
+            token_ids=[1, 2, 3],
+            stop_conditions=StopConditions(max_tokens=4),
+            sampling_options=SamplingOptions(temperature=0.0, logprobs=0),
+        )
+        stream = await engine.generate(Context.new(r))
+        lps, tops = [], None
+        async for item in stream:
+            d = item.data or {}
+            lps.extend(d.get("logprobs") or [])
+            if d.get("top_logprobs") is not None:
+                tops = d["top_logprobs"]
+        await engine.stop()
+        return lps, tops
+
+    lps, tops = run(main())
+    assert len(lps) == 4 and all(lp <= 0.0 for lp in lps)
+    assert tops is None

@@ -360,3 +360,35 @@ def test_kubernetes_connector_missing_deployment_is_loud(run, tmp_path):
             raise AssertionError("expected RuntimeError")
 
     run(body())
+
+
+def test_adjustment_jsonl_sink(run, tmp_path):
+    """Every decision appends one JSON line (reference planner's tensorboard
+    sink equivalent): machine-readable history for threshold tuning."""
+    import json
+
+    path = tmp_path / "adjust.jsonl"
+
+    async def body():
+        conn = FakeConnector()
+        metrics = {1: fpm(0.95)}
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(
+                decode_grace_periods=1,
+                adjustment_log_path=str(path),
+            ),
+        )
+        await planner.step()  # scale up
+        metrics[1] = fpm(0.1)
+        await planner.step()  # grace hold
+        await planner.step()  # scale down
+
+    run(body())
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) >= 2
+    for rec in lines:
+        assert {"ts", "kind", "action", "reason", "count_before"} <= set(rec)
+    actions = [r["action"] for r in lines]
+    assert "up" in actions and "down" in actions

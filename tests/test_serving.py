@@ -711,3 +711,70 @@ def test_request_template_defaults_applied(model_dir, run, tmp_path):
     assert body["model"] == "mock-model"
     assert body["usage"]["completion_tokens"] == 5
     assert status2 == 200 and body2["usage"]["completion_tokens"] == 2
+
+
+def test_http_logprobs_full_stack(model_dir, run):
+    """OpenAI logprobs through the whole stack: request parse -> engine
+    log-softmax -> backend -> response format, completions and chat."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+
+    async def main():
+        tok = Tokenizer.from_model_dir(model_dir)
+        engine = JaxEngine.random_init(
+            ModelConfig.tiny(vocab_size=512),
+            EngineConfig(max_batch_size=2, max_seq_len=64, page_size=4,
+                         num_pages=64),
+        )
+        name = "lp-model"
+        pipeline = link(OpenAIPreprocessor(name, tok), Backend(tok), engine)
+        svc = HttpService()
+        svc.manager.add_chat_model(name, pipeline)
+        svc.manager.add_completion_model(name, pipeline)
+        await svc.start()
+        try:
+            host, port = svc.address
+            _, _, comp = await http_request(
+                host, port, "POST", "/v1/completions",
+                {"model": name, "prompt": "hello world", "max_tokens": 5,
+                 "temperature": 0, "logprobs": 2},
+            )
+            _, _, chat = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {"model": name,
+                 "messages": [{"role": "user", "content": "hi"}],
+                 "max_tokens": 4, "temperature": 0,
+                 "logprobs": True, "top_logprobs": 2},
+            )
+            _, _, plain = await http_request(
+                host, port, "POST", "/v1/completions",
+                {"model": name, "prompt": "hello", "max_tokens": 3,
+                 "temperature": 0},
+            )
+            return comp, chat, plain
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    comp, chat, plain = run(main())
+    lp = comp["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == 5
+    assert len(lp["token_logprobs"]) == 5
+    assert all(v <= 0.0 for v in lp["token_logprobs"])
+    assert len(lp["top_logprobs"]) == 5
+    assert all(len(t) == 2 for t in lp["top_logprobs"])
+    assert lp["text_offset"][0] == 0
+    assert lp["text_offset"] == sorted(lp["text_offset"])
+    # greedy: the chosen token's logprob equals its top-alternative entry
+    first_tok = lp["tokens"][0]
+    assert first_tok in lp["top_logprobs"][0]
+    assert abs(lp["top_logprobs"][0][first_tok] - lp["token_logprobs"][0]) < 1e-4
+
+    clp = chat["choices"][0]["logprobs"]["content"]
+    assert len(clp) == 4
+    for entry in clp:
+        assert entry["logprob"] <= 0.0
+        assert isinstance(entry["bytes"], list)
+        assert len(entry["top_logprobs"]) == 2
+        assert entry["top_logprobs"][0]["logprob"] >= entry["top_logprobs"][1]["logprob"]
+
+    assert "logprobs" not in plain["choices"][0]
