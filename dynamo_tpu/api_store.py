@@ -111,7 +111,7 @@ class ApiStoreService:
                     return await self._list(KV_DEPLOYMENT.format(name=""))
             elif len(rest) == 2 and rest[0] == "deployments":
                 if m == "GET":
-                    return await self._get(KV_DEPLOYMENT.format(name=rest[1]))
+                    return await self._get_deployment(rest[1])
             return _bad("not found", 404)
         except BadRequest as e:
             # malformed client input is a 400, same as the server's own
@@ -205,6 +205,30 @@ class ApiStoreService:
             KV_DEPLOYMENT.format(name=name), json.dumps(record).encode()
         )
         return Response.json(record, 201)
+
+    async def _get_deployment(self, name: str) -> Response:
+        """Record + operator status, merged on read.
+
+        The operator writes status under ``{record}/status`` (its own key,
+        so a concurrent re-deploy upsert can never be clobbered -- the k8s
+        status-subresource isolation); the GET view presents them as one
+        object, the CRD-with-status shape."""
+        key = KV_DEPLOYMENT.format(name=name)
+        record = None
+        status = None
+        for k, v in await self.hub.kv_get_prefix(key):
+            try:
+                if k == key:
+                    record = json.loads(v)
+                elif k == key + "/status":
+                    status = json.loads(v)
+            except Exception:
+                continue
+        if record is None:
+            return _bad("not found", 404)
+        if status is not None:
+            record["status"] = status
+        return Response.json(record)
 
     # -- shared helpers ------------------------------------------------------
 

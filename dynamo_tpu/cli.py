@@ -126,6 +126,9 @@ def build_parser() -> argparse.ArgumentParser:
     hub = sub.add_parser("hub", help="run a standalone hub server")
     hub.add_argument("--host", default="0.0.0.0")
     hub.add_argument("--port", type=int, default=6650)
+    hub.add_argument("--data-dir", default=None,
+                     help="persist state (WAL + snapshot) here; a restart "
+                          "restores KV/leases/queues/objects")
 
     # standalone cluster metrics component (reference components/metrics)
     mt = sub.add_parser("metrics",
@@ -152,6 +155,19 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--hub", required=True, help="hub address host:port")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8282)
+
+    # operator: the reconcile controller over api-store deployment records
+    # (reference deploy/cloud/operator controller loop)
+    op = sub.add_parser("operator",
+                        help="reconcile deployment records against the "
+                             "cluster (controller loop)")
+    op.add_argument("--hub", required=True, help="hub address host:port")
+    op.add_argument("--kubectl", default="kubectl")
+    op.add_argument("--namespace", default="default")
+    op.add_argument("--interval", type=float, default=10.0)
+    op.add_argument("--image", default="dynamo-tpu:latest")
+    op.add_argument("--once", action="store_true",
+                    help="run one reconcile round and exit")
 
     # build/deploy: graph packaging against the api-store (reference
     # `dynamo build` -> api-store upload, `dynamo deploy` -> manifests)
@@ -1036,6 +1052,41 @@ async def run_api_store(args) -> int:
         await rt.shutdown()
 
 
+async def run_operator(args) -> int:
+    """Run the reconcile controller (reference operator equivalent)."""
+    from .operator import KubectlBackend, Operator, OperatorConfig
+    from .runtime.component import DistributedRuntime
+
+    rt = await DistributedRuntime.detached(args.hub)
+    op = Operator(
+        rt.hub,
+        KubectlBackend(kubectl=args.kubectl, namespace=args.namespace),
+        OperatorConfig(
+            interval_s=args.interval,
+            image=args.image,
+            namespace=args.namespace,
+        ),
+    )
+    try:
+        if args.once:
+            actions = await op.reconcile_once()
+            for a in actions:
+                if a.action != "ok":
+                    print(f"{a.deployment}: {a.action}")
+            print(f"reconciled ({len(actions)} deployments checked)")
+            return 0
+        await op.start()
+        print(f"operator reconciling every {args.interval}s (hub {args.hub})")
+        stop = asyncio.Event()
+        rt.hub.on_connection_lost = stop.set
+        await stop.wait()
+        print("hub connection lost; exiting", file=sys.stderr)
+        return 1
+    finally:
+        await op.stop()
+        await rt.shutdown()
+
+
 async def run_disagg_conf(args) -> int:
     """Write the live disagg routing policy to the hub; every decode worker
     watching the key reloads it (llm/disagg.py start_config_watch)."""
@@ -1082,7 +1133,9 @@ def main(argv=None) -> int:
 
         try:
             asyncio.run(
-                HubServer(host=args.host, port=args.port).serve_forever()
+                HubServer(
+                    host=args.host, port=args.port, data_dir=args.data_dir
+                ).serve_forever()
             )
         except KeyboardInterrupt:
             pass
@@ -1101,6 +1154,8 @@ def main(argv=None) -> int:
         return asyncio.run(run_disagg_conf(args))
     if args.cmd == "api-store":
         return asyncio.run(run_api_store(args))
+    if args.cmd == "operator":
+        return asyncio.run(run_operator(args))
     if args.cmd == "build":
         return run_build(args)
     if args.cmd == "deploy":

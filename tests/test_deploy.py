@@ -136,3 +136,166 @@ def test_dockerfile_builds_the_manifest_image():
     assert default_image.split(":")[0] in src  # image name documented
     assert "python -m" in src or "dynamo_tpu" in src  # runs the package
     assert "ENTRYPOINT" in src
+
+
+# -- operator reconcile loop (reference operator controller equivalent) ------
+
+
+def _fake_kubectl_full(tmp_path):
+    """kubectl stand-in for the operator: state in a JSON file; supports
+    get jsonpath / patch -p / apply -f - (stdin yaml)."""
+    import json as _json
+    import stat
+
+    state = tmp_path / "k8s_state.json"
+    state.write_text(_json.dumps({}))
+    script = tmp_path / "kubectl"
+    script.write_text(
+        "#!/usr/bin/env python3\n"
+        "import json, sys, yaml\n"
+        f"STATE = {str(state)!r}\n"
+        "args = sys.argv[1:]\n"
+        "state = json.load(open(STATE))\n"
+        "verb = args[0]\n"
+        "if verb == 'get':\n"
+        "    name = args[2]\n"
+        "    if name not in state:\n"
+        "        sys.stderr.write('NotFound')\n"
+        "        sys.exit(1)\n"
+        "    sys.stdout.write(str(state[name]))\n"
+        "elif verb == 'patch':\n"
+        "    name = args[2]\n"
+        "    patch = json.loads(args[args.index('-p') + 1])\n"
+        "    state[name] = patch['spec']['replicas']\n"
+        "    json.dump(state, open(STATE, 'w'))\n"
+        "elif verb == 'apply':\n"
+        "    for doc in yaml.safe_load_all(sys.stdin.read()):\n"
+        "        if doc and doc.get('kind') == 'Deployment':\n"
+        "            state[doc['metadata']['name']] = doc['spec']['replicas']\n"
+        "    json.dump(state, open(STATE, 'w'))\n"
+        "else:\n"
+        "    sys.exit(2)\n"
+    )
+    script.chmod(script.stat().st_mode | stat.S_IXUSR)
+    return script, state
+
+
+def _k8s_state(state_path):
+    import json as _json
+
+    return _json.loads(state_path.read_text())
+
+
+def test_operator_creates_converges_and_repairs_drift(tmp_path):
+    """The controller loop end-to-end: a deployment record converges to
+    child Deployments, a deleted Deployment is re-created, a diverged
+    pinned replica count is repaired, planner-owned counts are left alone,
+    and status is written back (reference
+    dynamographdeployment_controller.go:263)."""
+    import asyncio
+    import json as _json
+
+    from dynamo_tpu.operator import KubectlBackend, Operator, OperatorConfig
+    from dynamo_tpu.runtime.transports.client import StaticHub
+
+    kubectl, state = _fake_kubectl_full(tmp_path)
+
+    async def body():
+        hub = StaticHub()
+        record = {
+            "name": "graph",
+            "spec": {
+                "model_path": "/models/m",
+                "image": "img:1",
+                # pin frontend explicitly; decode stays planner-owned
+                "replicas": {"frontend": 2},
+            },
+        }
+        await hub.kv_put(
+            "apistore/deployments/graph", _json.dumps(record).encode()
+        )
+        op = Operator(
+            hub, KubectlBackend(kubectl=str(kubectl)), OperatorConfig()
+        )
+
+        # round 1: nothing exists -> every child Deployment is created
+        acts = await op.reconcile_once()
+        assert {a.action for a in acts} == {"created"}
+        st = _k8s_state(state)
+        assert st["graph-frontend"] == 2 and st["graph-decode"] == 1
+        assert st["graph-hub"] == 1 and st["graph-metrics"] == 1
+
+        # round 2: converged -> all ok, status Ready with observed counts
+        acts = await op.reconcile_once()
+        assert all(a.action == "ok" for a in acts)
+        st_rec = _json.loads(
+            dict(await hub.kv_get_prefix("apistore/deployments/graph"))[
+                "apistore/deployments/graph/status"
+            ]
+        )
+        assert st_rec["phase"] == "Ready"
+        assert st_rec["components"]["graph-frontend"] == 2
+
+        # drift: delete one Deployment, scale the pinned frontend down,
+        # and scale planner-owned decode up (an autoscaler decision)
+        st = _k8s_state(state)
+        del st["graph-metrics"]
+        st["graph-frontend"] = 0
+        st["graph-decode"] = 5
+        state.write_text(_json.dumps(st))
+
+        acts = await op.reconcile_once()
+        by_name = {a.deployment: a.action for a in acts}
+        assert by_name["graph-metrics"] == "created"
+        assert by_name["graph-frontend"] == "scaled"
+        assert by_name["graph-decode"] == "ok"  # planner-owned: untouched
+        st = _k8s_state(state)
+        assert st["graph-metrics"] == 1
+        assert st["graph-frontend"] == 2
+        assert st["graph-decode"] == 5
+        st_rec = _json.loads(
+            dict(await hub.kv_get_prefix("apistore/deployments/graph"))[
+                "apistore/deployments/graph/status"
+            ]
+        )
+        assert st_rec["phase"] == "Progressing"
+        assert {x["deployment"] for x in st_rec["actions"]} == {
+            "graph-metrics", "graph-frontend"
+        }
+
+    asyncio.run(body())
+
+
+def test_operator_pinned_decode_repaired(tmp_path):
+    """A record that pins decode replicas turns the planner-owned exemption
+    off for that component: drift is repaired to the pinned count."""
+    import asyncio
+    import json as _json
+
+    from dynamo_tpu.operator import KubectlBackend, Operator
+    from dynamo_tpu.runtime.transports.client import StaticHub
+
+    kubectl, state = _fake_kubectl_full(tmp_path)
+
+    async def body():
+        hub = StaticHub()
+        record = {
+            "name": "g2",
+            "spec": {"model_path": "/m", "replicas": {"decode": 3}},
+        }
+        await hub.kv_put(
+            "apistore/deployments/g2", _json.dumps(record).encode()
+        )
+        op = Operator(hub, KubectlBackend(kubectl=str(kubectl)))
+        await op.reconcile_once()
+        st = _k8s_state(state)
+        assert st["g2-decode"] == 3
+        st["g2-decode"] = 1  # drift below the pin
+        state.write_text(_json.dumps(st))
+        acts = await op.reconcile_once()
+        assert {a.action for a in acts if a.deployment == "g2-decode"} == {
+            "scaled"
+        }
+        assert _k8s_state(state)["g2-decode"] == 3
+
+    asyncio.run(body())
