@@ -118,12 +118,24 @@ class DistributedRuntime:
 
     @classmethod
     async def detached(
-        cls, hub_addr: Optional[str] = None, lease_ttl: float = 5.0
+        cls,
+        hub_addr: Optional[str] = None,
+        lease_ttl: float = 5.0,
+        reconnect_window: Optional[float] = None,
     ) -> "DistributedRuntime":
-        """Connect to a hub (``host:port``; env ``DYN_HUB_ADDRESS``)."""
+        """Connect to a hub (``host:port``; env ``DYN_HUB_ADDRESS``).
+
+        ``reconnect_window`` > 0 lets the client ride out a hub restart
+        (durable hub: leases + keys are restored, the client reconnects and
+        resumes keepalives/watches).  None reads ``DYN_HUB_RECONNECT``
+        seconds (default 0 = loss is fatal, the pre-durability behavior)."""
         addr = hub_addr or os.environ.get("DYN_HUB_ADDRESS", "127.0.0.1:6650")
         host, _, port = addr.rpartition(":")
-        hub = await HubClient(host or "127.0.0.1", int(port)).connect()
+        if reconnect_window is None:
+            reconnect_window = float(os.environ.get("DYN_HUB_RECONNECT", "0"))
+        hub = await HubClient(
+            host or "127.0.0.1", int(port), reconnect_window=reconnect_window
+        ).connect()
         rt = cls(hub, static_mode=False)
         rt.primary_lease = await hub.lease_grant(ttl=lease_ttl)
         return rt

@@ -87,25 +87,39 @@ class HubClient:
     owning handle's queue by id.
     """
 
-    def __init__(self, host: str, port: int) -> None:
+    def __init__(
+        self, host: str, port: int, reconnect_window: float = 0.0
+    ) -> None:
         self.host = host
         self.port = port
         # fires once when the connection drops un-asked (not on close());
         # components register shutdown here -- the reference gets the same
         # property from etcd lease loss + CriticalTaskExecutionHandle
         self.on_connection_lost: Optional[Any] = None
+        # > 0: on connection loss, retry connecting for this many seconds
+        # (backoff), then re-establish watches/subscriptions and resume
+        # lease keepalives -- the durable-hub restart-survival path.  The
+        # restored hub holds this client's lease-bound keys (HubJournal),
+        # so reconnect + keepalive is a full recovery with no
+        # re-registration.  0 keeps loss fatal (fail-fast mode).
+        self.reconnect_window = reconnect_window
         self._closing = False
         self._conn_lost = False
+        self._connected = asyncio.Event()
         self._seq = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._watches: Dict[int, asyncio.Queue] = {}
+        self._watch_prefixes: Dict[int, str] = {}
         self._subs: Dict[int, asyncio.Queue] = {}
+        self._sub_patterns: Dict[int, str] = {}
         # Events for ids whose local queue isn't registered yet: the pump can
         # see a watch/sub frame before the registering coroutine resumes.
         self._early: Dict[Tuple[str, int], list] = {}
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pump: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
+        self._reconnecting = False
         self._keepalives: Dict[int, asyncio.Task] = {}
         self._send_lock = asyncio.Lock()
 
@@ -114,12 +128,21 @@ class HubClient:
             self.host, self.port
         )
         self._pump = asyncio.create_task(self._pump_loop())
+        self._connected.set()
         return self
 
     async def close(self) -> None:
         self._closing = True
+        # release callers parked on the reconnect gate: they re-check
+        # _conn_lost and raise instead of riding out the window
+        self._conn_lost = True
+        self._connected.set()
         for task in self._keepalives.values():
             task.cancel()
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._reconnect_task
         if self._pump:
             self._pump.cancel()
             with contextlib.suppress(asyncio.CancelledError):
@@ -165,34 +188,143 @@ class HubClient:
                     fut.set_exception(ConnectionError("hub connection closed"))
             self._pending.clear()
             if not self._closing:
-                # unexpected loss: every watch, subscription and lease this
-                # client held is orphaned server-side.  Poison the local
-                # streams and notify, so the process fails loudly instead of
-                # serving from a silently frozen view of the cluster.
-                self._conn_lost = True
-                for task in self._keepalives.values():
-                    task.cancel()
-                for q in self._watches.values():
-                    q.put_nowait(_CONN_LOST)
-                for q in self._subs.values():
-                    q.put_nowait(_CONN_LOST)
-                logger.error(
-                    "hub connection lost: %d watches, %d subscriptions and "
-                    "%d leases orphaned",
-                    len(self._watches), len(self._subs), len(self._keepalives),
-                )
-                cb = self.on_connection_lost
-                if cb is not None:
-                    with contextlib.suppress(Exception):
-                        res = cb()
-                        if asyncio.iscoroutine(res):
-                            asyncio.ensure_future(res)
+                self._connected.clear()
+                if self.reconnect_window > 0:
+                    if self._reconnecting:
+                        # this pump belonged to a reconnect attempt that
+                        # failed mid-reestablish; the active reconnect loop
+                        # owns recovery -- a second loop would race it
+                        return
+                    # durable-hub mode: try to ride out a hub restart before
+                    # declaring the cluster view dead
+                    logger.warning(
+                        "hub connection lost; reconnecting for up to %.0fs",
+                        self.reconnect_window,
+                    )
+                    self._reconnect_task = asyncio.create_task(
+                        self._reconnect_loop(), name="hub-reconnect"
+                    )
+                else:
+                    self._fail_connection()
 
-    async def _call(
+    def _fail_connection(self) -> None:
+        """Unrecoverable loss: every watch, subscription and lease this
+        client held is orphaned server-side.  Poison the local streams and
+        notify, so the process fails loudly instead of serving from a
+        silently frozen view of the cluster."""
+        self._conn_lost = True
+        # wake callers parked on the reconnect gate; they re-check
+        # _conn_lost and raise immediately instead of riding out the window
+        self._connected.set()
+        for task in self._keepalives.values():
+            task.cancel()
+        for q in self._watches.values():
+            q.put_nowait(_CONN_LOST)
+        for q in self._subs.values():
+            q.put_nowait(_CONN_LOST)
+        logger.error(
+            "hub connection lost: %d watches, %d subscriptions and "
+            "%d leases orphaned",
+            len(self._watches), len(self._subs), len(self._keepalives),
+        )
+        cb = self.on_connection_lost
+        if cb is not None:
+            with contextlib.suppress(Exception):
+                res = cb()
+                if asyncio.iscoroutine(res):
+                    asyncio.ensure_future(res)
+
+    async def _reconnect_loop(self) -> None:
+        """Retry the connection with backoff; on success, re-establish
+        server-side registrations (watches get their current prefix state
+        replayed as synthetic puts -- level-triggered catch-up; deletes
+        missed while down surface when the restored hub expires the dead
+        owners' leases)."""
+        self._reconnecting = True
+        try:
+            deadline = asyncio.get_running_loop().time() + self.reconnect_window
+            delay = 0.2
+            while not self._closing:
+                try:
+                    self._reader, self._writer = await asyncio.open_connection(
+                        self.host, self.port
+                    )
+                except OSError:
+                    if asyncio.get_running_loop().time() + delay > deadline:
+                        self._fail_connection()
+                        return
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+                    continue
+                self._pump = asyncio.create_task(self._pump_loop())
+                try:
+                    await self._reestablish()
+                except Exception:
+                    logger.exception("hub re-establish failed; retrying")
+                    with contextlib.suppress(Exception):
+                        self._writer.close()
+                    if asyncio.get_running_loop().time() + delay > deadline:
+                        self._fail_connection()
+                        return
+                    await asyncio.sleep(delay)
+                    continue
+                self._connected.set()
+                logger.info(
+                    "hub reconnected (%d watches, %d subscriptions resumed)",
+                    len(self._watches), len(self._subs),
+                )
+                return
+        finally:
+            self._reconnecting = False
+
+    async def _reestablish(self) -> None:
+        """Re-register every watch and subscription on a fresh connection.
+
+        Transactional against retries: the registration maps are swapped
+        only after EVERY re-register RPC succeeded, so a connection that
+        dies mid-reestablish leaves the old maps intact for the next
+        attempt (nothing is popped-then-lost)."""
+        new_watches: Dict[int, asyncio.Queue] = {}
+        new_prefixes: Dict[int, str] = {}
+        replays: list = []
+        for old_wid, prefix in list(self._watch_prefixes.items()):
+            q = self._watches[old_wid]
+            hdr, blob = await self._call_raw({"op": "watch", "prefix": prefix})
+            self._check(hdr)
+            wid = int(hdr["watch_id"])
+            new_watches[wid] = q
+            new_prefixes[wid] = prefix
+            replays.append((q, _split_entries(hdr["entries"], blob)))
+        new_subs: Dict[int, asyncio.Queue] = {}
+        new_patterns: Dict[int, str] = {}
+        for old_sid, pattern in list(self._sub_patterns.items()):
+            q = self._subs[old_sid]
+            hdr, _ = await self._call_raw(
+                {"op": "subscribe", "pattern": pattern}
+            )
+            self._check(hdr)
+            sid = int(hdr["sub_id"])
+            new_subs[sid] = q
+            new_patterns[sid] = pattern
+        # commit: swap maps, replay watch snapshots as puts, then drain any
+        # events the pump parked in _early before the ids were mapped
+        self._watches = new_watches
+        self._watch_prefixes = new_prefixes
+        self._subs = new_subs
+        self._sub_patterns = new_patterns
+        for q, entries in replays:
+            for key, value in entries:
+                q.put_nowait(WatchEvent("put", key, value))
+        for wid, q in self._watches.items():
+            for ev in self._early.pop(("w", wid), ()):
+                q.put_nowait(ev)
+        for sid, q in self._subs.items():
+            for msg in self._early.pop(("s", sid), ()):
+                q.put_nowait(msg)
+
+    async def _call_raw(
         self, hdr: Dict[str, Any], payload: bytes = b""
     ) -> Tuple[Dict[str, Any], bytes]:
-        if self._conn_lost:
-            raise ConnectionError("hub connection lost")
         assert self._writer is not None, "not connected"
         seq = next(self._seq)
         hdr["seq"] = seq
@@ -202,6 +334,24 @@ class HubClient:
             write_frame(self._writer, hdr, payload)
             await self._writer.drain()
         return await fut
+
+    async def _call(
+        self, hdr: Dict[str, Any], payload: bytes = b""
+    ) -> Tuple[Dict[str, Any], bytes]:
+        if self._conn_lost:
+            raise ConnectionError("hub connection lost")
+        if not self._connected.is_set() and self.reconnect_window > 0:
+            # a reconnect is in progress: park until it lands (or fails,
+            # which sets _conn_lost and wakes us to raise)
+            try:
+                await asyncio.wait_for(
+                    self._connected.wait(), self.reconnect_window + 5.0
+                )
+            except asyncio.TimeoutError:
+                raise ConnectionError("hub reconnect timed out") from None
+            if self._conn_lost:
+                raise ConnectionError("hub connection lost")
+        return await self._call_raw(hdr, payload)
 
     @staticmethod
     def _check(hdr: Dict[str, Any]) -> Dict[str, Any]:
@@ -270,13 +420,26 @@ class HubClient:
 
     async def _keepalive_loop(self, lease: int, ttl: float) -> None:
         interval = max(ttl / 3.0, 0.2)
-        with contextlib.suppress(asyncio.CancelledError, ConnectionError):
+        with contextlib.suppress(asyncio.CancelledError):
             while True:
                 await asyncio.sleep(interval)
-                hdr, _ = await self._call({"op": "lease_keepalive", "lease": lease})
-                if not hdr.get("ok"):
-                    logger.error("lease %#x lost (keepalive rejected)", lease)
+                try:
+                    hdr, _ = await self._call(
+                        {"op": "lease_keepalive", "lease": lease}
+                    )
+                except ConnectionError:
+                    if self.reconnect_window > 0 and not self._conn_lost:
+                        continue  # reconnect in progress; retry next beat
                     return
+                if not hdr.get("ok"):
+                    # the lease genuinely expired (e.g. an outage longer
+                    # than TTL + reconnect): every key it held is gone --
+                    # raising lets CriticalTaskExecutionHandle promote this
+                    # to connection-lost so the process fails loudly
+                    # instead of serving while invisible to discovery
+                    raise RuntimeError(
+                        f"lease {lease:#x} lost (keepalive rejected)"
+                    )
 
     async def lease_revoke(self, lease: int) -> None:
         task = self._keepalives.pop(lease, None)
@@ -295,14 +458,21 @@ class HubClient:
         self._check(hdr)
         wid = int(hdr["watch_id"])
         self._watches[wid] = q
+        self._watch_prefixes[wid] = prefix
         for ev in self._early.pop(("w", wid), ()):
             q.put_nowait(ev)
         snapshot = _split_entries(hdr["entries"], blob)
 
         async def close() -> None:
-            self._watches.pop(wid, None)
-            with contextlib.suppress(Exception):
-                await self._call({"op": "unwatch", "watch_id": wid})
+            # find the watch's CURRENT id: reconnects remap it
+            cur = next(
+                (w for w, qq in self._watches.items() if qq is q), None
+            )
+            if cur is not None:
+                self._watches.pop(cur, None)
+                self._watch_prefixes.pop(cur, None)
+                with contextlib.suppress(Exception):
+                    await self._call({"op": "unwatch", "watch_id": cur})
 
         return WatchHandle(snapshot=snapshot, events=q, watch_id=wid, _close=close)
 
@@ -319,13 +489,17 @@ class HubClient:
         sid = int(hdr["sub_id"])
         q: asyncio.Queue = asyncio.Queue()
         self._subs[sid] = q
+        self._sub_patterns[sid] = pattern
         for msg in self._early.pop(("s", sid), ()):
             q.put_nowait(msg)
 
         async def close() -> None:
-            self._subs.pop(sid, None)
-            with contextlib.suppress(Exception):
-                await self._call({"op": "unsubscribe", "sub_id": sid})
+            cur = next((s for s, qq in self._subs.items() if qq is q), None)
+            if cur is not None:
+                self._subs.pop(cur, None)
+                self._sub_patterns.pop(cur, None)
+                with contextlib.suppress(Exception):
+                    await self._call({"op": "unsubscribe", "sub_id": cur})
 
         return Subscription(queue=q, sub_id=sid, _close=close)
 

@@ -99,6 +99,12 @@ class HubState:
         self.lease_ttl: Dict[int, float] = {}
         self.lease_keys: Dict[int, set] = collections.defaultdict(set)
         self._lease_seq = itertools.count(0x1000)
+        # durability hook: called with (record_dict, payload_bytes) after
+        # every state mutation; None = in-memory only (StaticHub, tests).
+        # The journal (HubJournal) makes a hub restart recoverable -- the
+        # reference gets this property from etcd raft + NATS JetStream
+        # persistence (transports/etcd.rs:41-58, nats.rs:50-123).
+        self.journal: Optional[Callable[[Dict[str, Any], bytes], None]] = None
         # prefix -> list of callbacks(WatchEvent)
         self.watchers: Dict[int, Tuple[str, Callable[[WatchEvent], None]]] = {}
         self._watch_seq = itertools.count(1)
@@ -127,6 +133,8 @@ class HubState:
         self.kv[key] = KvEntry(key, value, lease_id, self.revision)
         if lease_id:
             self.lease_keys[lease_id].add(key)
+        if self.journal is not None:
+            self.journal({"op": "kv_put", "key": key, "lease": lease_id}, value)
         self._notify(WatchEvent("put", key, value))
         return self.revision
 
@@ -146,6 +154,8 @@ class HubState:
         if entry.lease_id:
             self.lease_keys[entry.lease_id].discard(key)
         self.revision += 1
+        if self.journal is not None:
+            self.journal({"op": "kv_delete", "key": key}, b"")
         self._notify(WatchEvent("delete", key))
         return True
 
@@ -161,17 +171,23 @@ class HubState:
         lease_id = next(self._lease_seq)
         self.leases[lease_id] = time.monotonic() + ttl
         self.lease_ttl[lease_id] = ttl
+        if self.journal is not None:
+            self.journal({"op": "lease", "id": lease_id, "ttl": ttl}, b"")
         return lease_id
 
     def lease_keepalive(self, lease_id: int) -> bool:
+        # deliberately NOT journaled (high frequency): a restore re-arms
+        # every lease with one fresh TTL of grace instead
         if lease_id not in self.leases:
             return False
         self.leases[lease_id] = time.monotonic() + self.lease_ttl[lease_id]
         return True
 
     def lease_revoke(self, lease_id: int) -> None:
-        self.leases.pop(lease_id, None)
+        had = self.leases.pop(lease_id, None) is not None
         self.lease_ttl.pop(lease_id, None)
+        if had and self.journal is not None:
+            self.journal({"op": "lease_revoke", "id": lease_id}, b"")
         for key in list(self.lease_keys.pop(lease_id, ())):
             self.kv_delete(key)
 
@@ -217,14 +233,23 @@ class HubState:
         while waiters:
             fut = waiters.popleft()
             if not fut.done():
+                # direct handoff to a blocked popper: the item never enters
+                # stored state, so nothing is journaled -- an in-flight
+                # delivery lost to a crash is the same at-most-once window
+                # core NATS has (JetStream-grade redelivery is out of scope)
                 fut.set_result(payload)
                 return
         self.queues[queue].append(payload)
+        if self.journal is not None:
+            self.journal({"op": "qpush", "queue": queue}, payload)
 
     def queue_try_pop(self, queue: str) -> Optional[bytes]:
         q = self.queues.get(queue)
         if q:
-            return q.popleft()
+            item = q.popleft()
+            if self.journal is not None:
+                self.journal({"op": "qpop", "queue": queue}, b"")
+            return item
         return None
 
     def queue_depth(self, queue: str) -> int:
@@ -234,6 +259,253 @@ class HubState:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self.queue_waiters[queue].append(fut)
         return fut
+
+    # -- objects ----------------------------------------------------------
+
+    def obj_put(self, name: str, blob: bytes) -> None:
+        self.objects[name] = blob
+        if self.journal is not None:
+            self.journal({"op": "obj_put", "name": name}, blob)
+
+    def obj_del(self, name: str) -> bool:
+        existed = self.objects.pop(name, None) is not None
+        if existed and self.journal is not None:
+            self.journal({"op": "obj_del", "name": name}, b"")
+        return existed
+
+
+# ---------------------------------------------------------------------------
+# Durability: write-ahead journal + snapshot
+# ---------------------------------------------------------------------------
+
+
+class HubJournal:
+    """Append-only journal + snapshot making a hub restart recoverable.
+
+    The reference's control plane survives restarts because etcd is raft-
+    replicated and the prefill queue / object store ride NATS JetStream
+    (transports/etcd.rs:41-58, nats.rs:50-123).  The first-party hub gets
+    the single-node equivalent: every mutation appends one framed record
+    (json header + payload) to ``wal.bin``; past ``compact_every`` records
+    the full state is rewritten as ``snapshot.bin`` (atomic rename) and the
+    WAL truncates.  On start, snapshot then WAL replay rebuild the state.
+
+    Leases are restored with ONE fresh TTL of grace: a surviving owner
+    reconnects and keepalives within it (its keys never vanished); a dead
+    owner's lease expires and drops its keys exactly as a live hub would
+    have.  Keepalives themselves are not journaled (high frequency).
+
+    Writes flush on every record; fsync only with ``DYN_HUB_FSYNC=1``
+    (power-loss durability costs ~ms per mutation, process-crash
+    durability is free)."""
+
+    REC_HDR = 8  # two u32 LE: header length, payload length
+
+    def __init__(self, data_dir: str, compact_every: int = 8192) -> None:
+        import os
+        import struct
+
+        self._struct = struct
+        self.dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.snap_path = os.path.join(data_dir, "snapshot.bin")
+        self.wal_path = os.path.join(data_dir, "wal.bin")
+        # mid-compaction segment: records between the state capture and the
+        # snapshot landing (restore replays snapshot -> wal.old -> wal)
+        self.wal_old_path = os.path.join(data_dir, "wal.old.bin")
+        self.compact_every = compact_every
+        self.fsync = os.environ.get("DYN_HUB_FSYNC") == "1"
+        self._wal = None
+        self._pending = 0
+        self._compacting = False
+
+    # -- record framing ----------------------------------------------------
+
+    def _write_record(self, f, rec: Dict[str, Any], payload: bytes) -> None:
+        import json
+
+        hdr = json.dumps(rec, separators=(",", ":")).encode()
+        f.write(self._struct.pack("<II", len(hdr), len(payload)))
+        f.write(hdr)
+        f.write(payload)
+
+    def _read_records(self, path: str):
+        import json
+        import os
+
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(self.REC_HDR)
+                if len(head) < self.REC_HDR:
+                    break  # clean end or torn tail record: stop replay here
+                hlen, plen = self._struct.unpack("<II", head)
+                hdr = f.read(hlen)
+                payload = f.read(plen)
+                if len(hdr) < hlen or len(payload) < plen:
+                    logger.warning("hub journal: torn record in %s", path)
+                    break
+                try:
+                    yield json.loads(hdr), payload
+                except ValueError:
+                    logger.warning("hub journal: corrupt record in %s", path)
+                    break
+
+    # -- restore -----------------------------------------------------------
+
+    def load_into(self, state: HubState) -> None:
+        """Snapshot + WAL replay (journaling disabled while replaying)."""
+        assert state.journal is None
+        max_lease = 0
+        for src in (self.snap_path, self.wal_old_path, self.wal_path):
+            for rec, payload in self._read_records(src):
+                op = rec.get("op")
+                if op == "lease":
+                    lid = int(rec["id"])
+                    ttl = float(rec["ttl"])
+                    state.leases[lid] = time.monotonic() + ttl  # grace
+                    state.lease_ttl[lid] = ttl
+                    max_lease = max(max_lease, lid)
+                elif op == "lease_revoke":
+                    state.lease_revoke(int(rec["id"]))
+                elif op == "kv_put":
+                    lid = int(rec.get("lease", 0))
+                    if lid and lid not in state.leases:
+                        continue  # lease already gone; key would be too
+                    state.kv_put(rec["key"], payload, lid)
+                elif op == "kv_delete":
+                    state.kv_delete(rec["key"])
+                elif op == "qpush":
+                    state.queues[rec["queue"]].append(payload)
+                elif op == "qpop":
+                    q = state.queues.get(rec["queue"])
+                    if q:
+                        q.popleft()
+                elif op == "obj_put":
+                    state.objects[rec["name"]] = payload
+                elif op == "obj_del":
+                    state.objects.pop(rec["name"], None)
+        # fresh lease ids must not collide with restored ones
+        state._lease_seq = itertools.count(max(0x1000, max_lease + 1))
+
+    # -- append + compaction -------------------------------------------------
+
+    def open(self) -> None:
+        self._wal = open(self.wal_path, "ab")
+
+    def append(self, state: HubState, rec: Dict[str, Any], payload: bytes) -> None:
+        import os
+
+        if self._wal is None:
+            self.open()
+        self._write_record(self._wal, rec, payload)
+        self._wal.flush()
+        if self.fsync:
+            os.fsync(self._wal.fileno())
+        self._pending += 1
+        if self._pending >= self.compact_every and not self._compacting:
+            # compaction must not stall the hub's event loop (the snapshot
+            # can carry every api-store artifact blob): capture + rotate
+            # synchronously (dict copies of immutable values -- cheap),
+            # write + fsync in a worker thread
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                self.compact(state)  # no loop (tests): synchronous
+                return
+            self._compacting = True
+            self._pending = 0
+            capture = self._capture(state)
+            self._rotate_wal()
+            task = loop.create_task(self._compact_async(capture))
+            task.add_done_callback(lambda t: t.exception())
+
+    def _capture(self, state: HubState) -> Dict[str, Any]:
+        """Shallow-copy the state for a consistent snapshot (values are
+        immutable bytes; runs on the loop, O(entries) pointer copies)."""
+        now = time.monotonic()
+        return {
+            "leases": [
+                (lid, state.lease_ttl.get(lid, max(exp - now, 1.0)))
+                for lid, exp in state.leases.items()
+            ],
+            "kv": [
+                (key, e.lease_id, e.value)
+                for key, e in sorted(state.kv.items())
+            ],
+            "queues": {q: list(items) for q, items in state.queues.items()},
+            "objects": dict(state.objects),
+        }
+
+    def _rotate_wal(self) -> None:
+        import os
+
+        if self._wal is not None:
+            self._wal.close()
+        if os.path.exists(self.wal_old_path):
+            # a previous compaction failed before its snapshot landed:
+            # wal.old still holds the only copy of that segment.  Merge the
+            # current segment onto it instead of clobbering it -- replay
+            # order (snapshot -> wal.old -> wal) stays chronological.
+            with open(self.wal_old_path, "ab") as dst, open(
+                self.wal_path, "rb"
+            ) as src:
+                while True:
+                    chunk = src.read(1 << 20)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+            os.remove(self.wal_path)
+        else:
+            with contextlib.suppress(FileNotFoundError):
+                os.replace(self.wal_path, self.wal_old_path)
+        self._wal = open(self.wal_path, "wb")
+
+    async def _compact_async(self, capture: Dict[str, Any]) -> None:
+        try:
+            await asyncio.to_thread(self._write_snapshot, capture)
+        except Exception:
+            logger.exception("hub snapshot compaction failed")
+        finally:
+            self._compacting = False
+
+    def _write_snapshot(self, capture: Dict[str, Any]) -> None:
+        import os
+
+        tmp = self.snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for lid, ttl in capture["leases"]:
+                self._write_record(f, {"op": "lease", "id": lid, "ttl": ttl}, b"")
+            for key, lease_id, value in capture["kv"]:
+                self._write_record(
+                    f, {"op": "kv_put", "key": key, "lease": lease_id}, value
+                )
+            for queue, items in capture["queues"].items():
+                for item in items:
+                    self._write_record(f, {"op": "qpush", "queue": queue}, item)
+            for name, blob in capture["objects"].items():
+                self._write_record(f, {"op": "obj_put", "name": name}, blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        # the snapshot covers everything through the rotation point: the
+        # rotated-out segment is now redundant
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(self.wal_old_path)
+
+    def compact(self, state: HubState) -> None:
+        """Synchronous compaction (tests / shutdown): capture, rotate,
+        write, all inline."""
+        capture = self._capture(state)
+        self._rotate_wal()
+        self._write_snapshot(capture)
+        self._pending = 0
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
 
 
 # ---------------------------------------------------------------------------
@@ -251,10 +523,22 @@ class HubServer:
     lease in the reference).
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        data_dir: Optional[str] = None,
+    ) -> None:
         self.host = host
         self.port = port
         self.state = HubState()
+        self.journal: Optional[HubJournal] = None
+        if data_dir:
+            self.journal = HubJournal(data_dir)
+            self.journal.load_into(self.state)
+            self.state.journal = lambda rec, payload: self.journal.append(
+                self.state, rec, payload
+            )
         self._server: Optional[asyncio.AbstractServer] = None
         self._expiry_task: Optional[asyncio.Task] = None
         self._conn_writers: set = set()
@@ -265,7 +549,10 @@ class HubServer:
         )
         self.port = self._server.sockets[0].getsockname()[1]
         self._expiry_task = asyncio.create_task(self._expiry_loop())
-        logger.info("hub listening on %s:%d", self.host, self.port)
+        logger.info(
+            "hub listening on %s:%d%s", self.host, self.port,
+            f" (journal {self.journal.dir})" if self.journal else "",
+        )
         return self.host, self.port
 
     async def serve_forever(self) -> None:
@@ -291,6 +578,8 @@ class HubServer:
                 with contextlib.suppress(Exception):
                     w.close()
             await self._server.wait_closed()
+        if self.journal is not None:
+            self.journal.close()
 
     async def _expiry_loop(self) -> None:
         while True:
@@ -304,7 +593,6 @@ class HubServer:
         self._conn_writers.add(writer)
         conn_watches: list = []
         conn_subs: list = []
-        conn_leases: list = []
         conn_qwaiters: set = set()
         send_tasks: set = set()  # strong refs: loop holds only weak task refs
         send_lock = asyncio.Lock()
@@ -359,7 +647,6 @@ class HubServer:
                         await send({"seq": seq, "ok": True, "count": n})
                     elif op == "lease_grant":
                         lease = st.lease_grant(float(hdr["ttl"]))
-                        conn_leases.append(lease)
                         await send({"seq": seq, "ok": True, "lease": lease})
                     elif op == "lease_keepalive":
                         ok = st.lease_keepalive(hdr["lease"])
@@ -455,7 +742,7 @@ class HubServer:
                              "depth": st.queue_depth(hdr["queue"])}
                         )
                     elif op == "obj_put":
-                        st.objects[hdr["name"]] = payload
+                        st.obj_put(hdr["name"], payload)
                         await send({"seq": seq, "ok": True})
                     elif op == "obj_get":
                         blob = st.objects.get(hdr["name"])
@@ -464,7 +751,7 @@ class HubServer:
                         else:
                             await send({"seq": seq, "ok": True}, blob)
                     elif op == "obj_del":
-                        existed = st.objects.pop(hdr["name"], None) is not None
+                        existed = st.obj_del(hdr["name"])
                         await send({"seq": seq, "ok": True, "found": existed})
                     elif op == "ping":
                         await send({"seq": seq, "ok": True})
@@ -480,8 +767,15 @@ class HubServer:
                 st.watch_remove(wid)
             for sid in conn_subs:
                 st.unsubscribe(sid)
-            for lease in conn_leases:
-                st.lease_revoke(lease)
+            # etcd semantics for conn loss: the lease is NOT revoked on a
+            # dropped connection -- its keepalives simply stop, and it
+            # expires after its TTL unless the owner reconnects (client
+            # reconnect_window) and resumes them.  Instant revocation here
+            # would make any transient disconnect erase a live worker's
+            # registration behind its back (and, with a journal, persist
+            # the erasure).  Crash detection latency is therefore <= TTL,
+            # exactly as with reference etcd leases (transports/etcd.rs).
+            # Graceful shutdown still revokes explicitly (lease_revoke op).
             # Cancel parked blocking pops so a future queue_push doesn't hand
             # a job to this dead connection (queue_push skips done futures).
             for fut in list(conn_qwaiters):

@@ -579,3 +579,167 @@ def test_upload_interleaves_with_rpc_streams(run):
             await hub_server.stop()
 
     run(body())
+
+
+# -- hub durability + restart survival (reference: etcd raft + JetStream) ----
+
+
+def test_hub_journal_restores_state(run, tmp_path):
+    """KV (incl. lease-bound keys), queues and objects survive a stop +
+    restart from the same data dir; leases come back with one TTL of grace
+    and expire if their owner never returns."""
+
+    async def body():
+        d = str(tmp_path / "hub")
+        server = HubServer(port=0, data_dir=d)
+        host, port = await server.start()
+        client = await HubClient(host, port).connect()
+        lease = await client.lease_grant(ttl=1.0, keepalive=False)
+        await client.kv_put("plain/a", b"1")
+        await client.kv_put("leased/b", b"2", lease=lease)
+        await client.queue_push("jobs", b"j1")
+        await client.queue_push("jobs", b"j2")
+        assert await client.queue_pop("jobs", block=False) == b"j1"
+        await client.obj_put("card", b"blob")
+        await client.kv_put("plain/gone", b"x")
+        await client.kv_delete("plain/gone")
+        await client.close()
+        await server.stop()
+
+        # restart from the same dir on a fresh port
+        server2 = HubServer(port=0, data_dir=d)
+        host2, port2 = await server2.start()
+        c2 = await HubClient(host2, port2).connect()
+        got = dict(await c2.kv_get_prefix(""))
+        assert got["plain/a"] == b"1"
+        assert got["leased/b"] == b"2"  # lease restored with grace
+        assert "plain/gone" not in got
+        assert await c2.queue_pop("jobs", block=False) == b"j2"
+        assert await c2.obj_get("card") == b"blob"
+        # nobody keepalives the restored lease: its keys expire
+        await asyncio.sleep(1.8)
+        got = dict(await c2.kv_get_prefix(""))
+        assert "leased/b" not in got
+        assert got["plain/a"] == b"1"
+        await c2.close()
+        await server2.stop()
+
+    run(body())
+
+
+def test_hub_journal_compaction(run, tmp_path):
+    """Compaction rewrites the snapshot and truncates the WAL without
+    changing observable state."""
+
+    async def body():
+        d = str(tmp_path / "hub")
+        server = HubServer(port=0, data_dir=d)
+        host, port = await server.start()
+        client = await HubClient(host, port).connect()
+        for i in range(50):
+            await client.kv_put(f"k/{i:03d}", str(i).encode())
+        for i in range(0, 50, 2):
+            await client.kv_delete(f"k/{i:03d}")
+        server.journal.compact(server.state)
+        await client.kv_put("k/after", b"post-compact")
+        await client.close()
+        await server.stop()
+
+        server2 = HubServer(port=0, data_dir=d)
+        host2, port2 = await server2.start()
+        c2 = await HubClient(host2, port2).connect()
+        got = dict(await c2.kv_get_prefix("k/"))
+        assert got["k/after"] == b"post-compact"
+        assert len(got) == 26  # 25 odd survivors + k/after
+        assert "k/002" not in got and got["k/003"] == b"3"
+        await c2.close()
+        await server2.stop()
+
+    run(body())
+
+
+def test_workers_survive_hub_restart(run, tmp_path):
+    """The round-4 verdict's bar: kill and restart the hub mid-serving;
+    the worker's lease-bound instance key survives (journal + grace), the
+    client reconnects, keepalives resume, watches replay, and requests
+    keep flowing end to end."""
+
+    async def body():
+        d = str(tmp_path / "hub")
+        server = HubServer(port=0, data_dir=d)
+        host, port = await server.start()
+        addr = f"{host}:{port}"
+
+        from dynamo_tpu.runtime.component import DistributedRuntime
+
+        # worker: serve an echo endpoint under its primary lease
+        wrt = await DistributedRuntime.detached(
+            addr, lease_ttl=2.0, reconnect_window=10.0
+        )
+        ns = wrt.namespace("surv")
+        ep = ns.component("backend").endpoint("gen")
+
+        class Echo:
+            async def generate(self, request):
+                async def gen():
+                    yield {"echo": request.data}
+
+                return gen()
+
+        await ep.serve(Echo())
+
+        # client: watch + call through a PushRouter
+        crt = await DistributedRuntime.detached(
+            addr, lease_ttl=2.0, reconnect_window=10.0
+        )
+        cep = crt.namespace("surv").component("backend").endpoint("gen")
+        client = await cep.client()
+        await client.wait_for_instances(timeout=5)
+        from dynamo_tpu.runtime.component import PushRouter
+        from dynamo_tpu.runtime.engine import Context
+
+        router = PushRouter(client)
+
+        async def call_once(x):
+            stream = await router.generate(Context.new(x))
+            out = []
+            async for item in stream:
+                out.append(item.data if hasattr(item, "data") else item)
+            return out
+
+        assert (await call_once("before"))[0]["echo"] == "before"
+
+        # kill the hub (simulated crash: no graceful conn teardown needed
+        # -- but stop() also must not erase state) and restart on the SAME
+        # port from the same dir
+        await server.stop()
+        await asyncio.sleep(0.3)
+        server2 = HubServer(host=host, port=port, data_dir=d)
+        await server2.start()
+
+        # instance key survived the restart (no re-registration happened)
+        entries = server2.state.kv_get_prefix("instances/")
+        assert entries, "worker instance key lost across restart"
+
+        # give both clients time to reconnect + keepalive
+        await asyncio.sleep(1.0)
+        assert (await call_once("after"))[0]["echo"] == "after"
+
+        # watch resumption: a worker that registers AFTER the restart must
+        # reach the pre-restart client's (re-established) discovery watch
+        wrt2 = await DistributedRuntime.detached(addr, lease_ttl=2.0)
+        await wrt2.namespace("surv").component("backend").endpoint(
+            "gen"
+        ).serve(Echo())
+        for _ in range(50):
+            if len(client.instances) >= 2:
+                break
+            await asyncio.sleep(0.1)
+        assert len(client.instances) >= 2, "post-restart watch missed a worker"
+
+        await crt.shutdown()
+        await wrt.shutdown()
+        await wrt2.shutdown()
+        await server2.stop()
+
+    run(body())
