@@ -910,6 +910,7 @@ def run_build(args) -> int:
     import io
     import json as _json
     import tarfile
+    import urllib.error
     import urllib.request
 
     buf = io.BytesIO()
@@ -973,6 +974,7 @@ def run_deploy(args) -> int:
     import json as _json
     import os
     import tarfile
+    import urllib.error
     import urllib.request
 
     base = args.store.rstrip("/")

@@ -217,20 +217,31 @@ class DisaggDecodeEngine:
                     self._apply_conf(ev.value)
 
     def _apply_conf(self, raw: bytes) -> None:
+        # parse + validate EVERY field before assigning any: a conf update
+        # with one good and one malformed field must be ignored whole, not
+        # half-applied while the log claims it was ignored
         try:
             d = json.loads(raw)
-            cfg = self.router.cfg
+            updates = {}
             if "max_local_prefill_length" in d:
-                cfg.max_local_prefill_length = int(d["max_local_prefill_length"])
+                updates["max_local_prefill_length"] = int(
+                    d["max_local_prefill_length"]
+                )
             if "max_prefill_queue_depth" in d:
-                cfg.max_prefill_queue_depth = int(d["max_prefill_queue_depth"])
-            logger.info(
-                "disagg conf reloaded: max_local_prefill_length=%d "
-                "max_prefill_queue_depth=%d",
-                cfg.max_local_prefill_length, cfg.max_prefill_queue_depth,
-            )
+                updates["max_prefill_queue_depth"] = int(
+                    d["max_prefill_queue_depth"]
+                )
         except Exception:
             logger.exception("malformed disagg conf update ignored")
+            return
+        cfg = self.router.cfg
+        for field_name, value in updates.items():
+            setattr(cfg, field_name, value)
+        logger.info(
+            "disagg conf reloaded: max_local_prefill_length=%d "
+            "max_prefill_queue_depth=%d",
+            cfg.max_local_prefill_length, cfg.max_prefill_queue_depth,
+        )
 
     async def _queue_depth(self) -> int:
         """Queue depth with a short-TTL cache: the ship/local heuristic

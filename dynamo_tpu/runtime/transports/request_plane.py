@@ -206,7 +206,18 @@ class DataPlaneServer:
             finally:
                 ctx.set_complete()
                 live.pop(sid, None)
-                uploads.pop(sid, None)
+                uq_dead = uploads.pop(sid, None)
+                if uq_dead is not None:
+                    # the read loop may be parked in put() on this queue; a
+                    # dead consumer must not head-of-line-block every other
+                    # stream for ABANDONED_STREAM_TIMEOUT -- drain so the
+                    # parked put completes immediately (later parts find the
+                    # sid deregistered and are dropped)
+                    while True:
+                        try:
+                            uq_dead.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
 
         try:
             while True:

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -119,19 +119,25 @@ def encode_image(
 
 
 def decode_image_payload(
-    payload: Any, image_size: int
+    payload: Any, image_size: int, allow_pseudo: Optional[bool] = None
 ) -> "jax.Array":
-    """Best-effort image decode for the encode worker's wire payload.
+    """Image decode for the encode worker's wire payload.
 
     Accepts a nested list/array ``[H, W, 3]`` (already-decoded pixels), or
-    raw bytes / base64 text (hashed into a deterministic pseudo-image --
-    environments with PIL can decode real formats upstream and pass
-    pixels)."""
+    raw bytes / base64 text decoded via PIL when available.  Undecodable
+    byte payloads RAISE: a real JPEG silently turning into deterministic
+    noise embeddings would generate from garbage with no error surfaced.
+    The hash-seeded pseudo-image fallback is test-only, behind
+    ``allow_pseudo`` / ``DYN_MM_ALLOW_PSEUDO=1``."""
     import base64
     import hashlib
+    import io
+    import os
 
     import numpy as np
 
+    if allow_pseudo is None:
+        allow_pseudo = os.environ.get("DYN_MM_ALLOW_PSEUDO") == "1"
     if isinstance(payload, (list, tuple)) or (
         isinstance(payload, np.ndarray) and payload.ndim == 3
     ):
@@ -142,11 +148,30 @@ def decode_image_payload(
                 payload = base64.b64decode(payload)
             except Exception:
                 payload = payload.encode()
-        digest = hashlib.sha256(bytes(payload)).digest()
-        rs = np.random.RandomState(
-            int.from_bytes(digest[:4], "big")
-        )
-        arr = rs.rand(image_size, image_size, 3).astype(np.float32)
+        arr = None
+        try:
+            from PIL import Image  # noqa: PLC0415 - optional dependency
+
+            img = Image.open(io.BytesIO(bytes(payload))).convert("RGB")
+            arr = np.asarray(img, np.float32) / 255.0
+        except ImportError:
+            pass
+        except Exception as exc:
+            if not allow_pseudo:
+                raise ValueError(
+                    f"undecodable image payload: {exc}"
+                ) from exc
+        if arr is None:
+            if not allow_pseudo:
+                raise ValueError(
+                    "image payload is raw bytes but no image decoder is "
+                    "available (install PIL, or pass decoded [H, W, 3] "
+                    "pixels; DYN_MM_ALLOW_PSEUDO=1 enables the test-only "
+                    "pseudo-image fallback)"
+                )
+            digest = hashlib.sha256(bytes(payload)).digest()
+            rs = np.random.RandomState(int.from_bytes(digest[:4], "big"))
+            arr = rs.rand(image_size, image_size, 3).astype(np.float32)
     # normalize/crop to the trunk's square input
     out = np.zeros((image_size, image_size, 3), np.float32)
     h = min(image_size, arr.shape[0])

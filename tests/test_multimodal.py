@@ -54,9 +54,9 @@ def test_vision_trunk_shapes_and_determinism():
 def test_decode_image_payload_forms():
     px = decode_image_payload([[ [0.5]*3 ]*4]*4, image_size=8)
     assert px.shape == (8, 8, 3)
-    a = decode_image_payload(b"some-bytes", image_size=8)
-    b = decode_image_payload(b"some-bytes", image_size=8)
-    c = decode_image_payload(b"other-bytes", image_size=8)
+    a = decode_image_payload(b"some-bytes", image_size=8, allow_pseudo=True)
+    b = decode_image_payload(b"some-bytes", image_size=8, allow_pseudo=True)
+    c = decode_image_payload(b"other-bytes", image_size=8, allow_pseudo=True)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-4
 
@@ -170,3 +170,26 @@ def test_mm_soft_prompt_survives_disagg_hop(run):
             await hub.stop()
 
     run(body())
+
+
+def test_decode_image_payload_real_png_and_loud_garbage():
+    """A real encoded image decodes to its pixels; undecodable bytes raise
+    instead of silently becoming noise embeddings (round-4 advisor)."""
+    import io
+
+    import numpy as np
+    import pytest
+    from PIL import Image
+
+    img = Image.fromarray(
+        (np.arange(64 * 64 * 3).reshape(64, 64, 3) % 255).astype("uint8")
+    )
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    px = decode_image_payload(buf.getvalue(), image_size=32)
+    assert px.shape == (32, 32, 3)
+    ref = np.asarray(img, np.float32)[:32, :32] / 255.0
+    assert np.allclose(np.asarray(px), ref, atol=1e-3)
+
+    with pytest.raises(ValueError, match="undecodable"):
+        decode_image_payload(b"definitely-not-an-image", image_size=8)
