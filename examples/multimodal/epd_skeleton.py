@@ -62,7 +62,11 @@ class EncodeWorker(AsyncEngine):
 
     async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
         image = (request.data or {}).get("image", b"")
-        pixels = decode_image_payload(image, self.cfg.image_size)
+        # demo skeleton: synthetic payloads may take the pseudo-image path
+        # (production encode workers pass real pixels / decodable bytes)
+        pixels = decode_image_payload(
+            image, self.cfg.image_size, allow_pseudo=True
+        )
         embeds = encode_image(self.params, self.cfg, pixels[None])[0]
         rows = np.asarray(embeds).tolist()
         ctx = request.ctx
