@@ -86,12 +86,16 @@ async def run_batch(engine, prompts, max_tokens):
     return sum(results)
 
 
-async def run_disagg(rs):
+async def run_disagg(rs, allow_local: bool = True):
     """Disaggregated serving mode: decode engine + prefill engine over the
     hub (both on the one chip -- they contend, so this tracks the disagg
     PATH's overhead vs aggregated, not a two-chip speedup).  Every prompt
     ships remote: hub queue -> prefill engine -> KV blockset delivery ->
-    decode resumes.  Returns decode tok/s."""
+    decode resumes.
+
+    ``allow_local`` selects the delivery leg: True takes the same-process
+    device-resident handoff (NIXL-DMA analog), False forces the chunked
+    wire upload.  Returns (decode tok/s, transfer stats)."""
     from dynamo_tpu.llm.disagg import (
         KV_DELIVER_ENDPOINT,
         DisaggConfig,
@@ -124,7 +128,9 @@ async def run_disagg(rs):
         )
         prt = await DistributedRuntime.detached(addr)
         cleanups.append(prt.shutdown)
-        pw = PrefillWorker(prefill_engine, prt.namespace("bench"))
+        pw = PrefillWorker(
+            prefill_engine, prt.namespace("bench"), allow_local=allow_local
+        )
         await pw.start()
         cleanups.append(pw.stop)
         prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(8)]
@@ -137,7 +143,10 @@ async def run_disagg(rs):
         total = await run_batch(decode, prompts, max_tokens=64)
         elapsed = time.monotonic() - t0
         assert decode.remote_prefills - before >= 8, "disagg path not exercised"
-        return total / elapsed
+        stats = pw.transfer_stats()
+        expect = "device" if allow_local else "wire"
+        assert expect in stats, f"{expect} leg not exercised: {stats}"
+        return total / elapsed, stats.get(expect) or {}
     finally:
         for stop in reversed(cleanups):
             try:
@@ -398,7 +407,8 @@ async def main():
     del engine
 
     sweep = await run_decode_sweep(rs)
-    disagg_tok_s = await run_disagg(rs)
+    disagg_tok_s, _dev_stats = await run_disagg(rs, allow_local=True)
+    disagg_wire_tok_s, wire_stats = await run_disagg(rs, allow_local=False)
 
     baseline = 51.22  # H100 TP4 per-GPU decode tok/s (reference planner.md:86)
     print(
@@ -413,6 +423,10 @@ async def main():
                 "prefill_tok_s": round(prefill_tok_s, 1),
                 "prefill_tok_s_t2048": round(prefill_tok_s_t2048, 1),
                 "disagg_tok_s": round(disagg_tok_s, 2),
+                "disagg_wire_tok_s": round(disagg_wire_tok_s, 2),
+                "disagg_transfer_ms_p50": wire_stats.get("deliver_ms_p50"),
+                "disagg_transfer_bytes_p50": wire_stats.get("bytes_p50"),
+                "disagg_export_ms_p50": wire_stats.get("export_ms_p50"),
                 "decode_tok_s_int8": round(int8_tok_s, 2),
                 "est_hbm_util_v5e": round(util, 4),
                 "param_bytes": pbytes,

@@ -703,13 +703,33 @@ class JaxEngine:
         equivalent of the reference's NIXL device-to-device DMA
         (block_manager/storage/nixl.rs:173): the blob never transits the
         host.  Only the sampled first tokens come back (one tiny
-        transfer)."""
+        transfer).
+
+        The wire path (``device=False``) dispatches device-resident slices
+        on the engine executor but materializes them in a SEPARATE thread:
+        the device->host transfer of the blobs no longer occupies the
+        executor, so decode/prefill ticks overlap the transfer instead of
+        serializing behind it (round-4 verdict #8)."""
         if not self._running:
             await self.start()
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
-            self._ex, self._prefill_export_batch, reqs, device
+        results = await loop.run_in_executor(
+            self._ex, self._prefill_export_batch, reqs, True
         )
+        if device:
+            return results
+
+        def materialize() -> List[Any]:
+            out: List[Any] = []
+            for r in results:
+                if isinstance(r, tuple):
+                    blob, row = r
+                    out.append((np.asarray(jax.device_get(blob)), row))
+                else:
+                    out.append(r)
+            return out
+
+        return await asyncio.to_thread(materialize)
 
     def _prefill_export_batch(
         self, reqs: List[PreprocessedRequest], device: bool = False

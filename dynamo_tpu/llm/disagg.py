@@ -29,6 +29,7 @@ Wire pieces:
 from __future__ import annotations
 
 import asyncio
+import collections
 import contextlib
 import json
 import logging
@@ -398,6 +399,38 @@ class PrefillWorker:
         self.local_deliveries = 0  # same-process device handoffs
         self._task: Optional[asyncio.Task] = None
         self._clients: Dict[str, PushRouter] = {}
+        # per-delivery transfer instrumentation (VERDICT r4 #8: separate
+        # transfer-plane cost from chip contention): bytes moved, amortized
+        # export (dispatch+compute+materialize) ms, upload/handoff ms
+        self.delivery_stats: "collections.deque" = collections.deque(
+            maxlen=512
+        )
+        self._export_ms = 0.0
+
+    def transfer_stats(self) -> Dict[str, Any]:
+        """Percentile summary of the recorded deliveries (bench/metrics
+        surface): separates transfer-plane cost (deliver_ms, bytes) from
+        prefill compute (export_ms) per path."""
+
+        def pct(vals, p):
+            if not vals:
+                return None
+            s = sorted(vals)
+            return round(s[min(int(p * (len(s) - 1) + 0.5), len(s) - 1)], 2)
+
+        out: Dict[str, Any] = {"deliveries": len(self.delivery_stats)}
+        for path in ("wire", "device"):
+            rows = [r for r in self.delivery_stats if r["path"] == path]
+            if not rows:
+                continue
+            out[path] = {
+                "count": len(rows),
+                "bytes_p50": pct([r["bytes"] for r in rows], 0.5),
+                "deliver_ms_p50": pct([r["deliver_ms"] for r in rows], 0.5),
+                "deliver_ms_p99": pct([r["deliver_ms"] for r in rows], 0.99),
+                "export_ms_p50": pct([r["export_ms"] for r in rows], 0.5),
+            }
+        return out
 
     async def start(self) -> None:
         self._task = asyncio.create_task(self._loop(), name="prefill-worker")
@@ -469,7 +502,9 @@ class PrefillWorker:
         all_local = bool(good) and all(
             self._local_engine(batch[i]) is not None for i in good
         )
+        export_ms_per_item = 0.0
         if good:
+            t0 = time.perf_counter()
             try:
                 exported = await self.engine.prefill_export_batch(
                     [parsed[i] for i in good], device=all_local
@@ -477,8 +512,12 @@ class PrefillWorker:
             except Exception as e:  # noqa: BLE001 - engine-wide failure
                 logger.exception("prefill_export_batch failed")
                 exported = [e] * len(good)
+            export_ms_per_item = (
+                (time.perf_counter() - t0) * 1000.0 / max(len(good), 1)
+            )
             for i, res in zip(good, exported):
                 results[i] = res
+        self._export_ms = export_ms_per_item
         # deliver concurrently: uploads to distinct decode workers ride
         # distinct connections; to the same worker they multiplex
         await asyncio.gather(
@@ -513,6 +552,7 @@ class PrefillWorker:
         first = int(np.asarray(row).reshape(-1)[0])
         lp_row = [int(x) for x in np.asarray(row).reshape(-1)]
         local = self._local_engine(msg)
+        t0 = time.perf_counter()
         if local is not None and not isinstance(blob, np.ndarray):
             # same-process handoff: the device-resident blob goes straight
             # into the decode engine's delivery queue; the scatter is a
@@ -521,6 +561,8 @@ class PrefillWorker:
             local.deliver_external(
                 rid, blob, first, np.asarray(lp_row, np.int32)
             )
+            nbytes = int(np.prod(blob.shape)) * blob.dtype.itemsize
+            path = "device"
         else:
             meta = {
                 "request_id": rid,
@@ -538,6 +580,16 @@ class PrefillWorker:
             except Exception:
                 logger.exception("KV delivery failed for request %s", rid)
                 raise
+            nbytes = blob.nbytes
+            path = "wire"
+        self.delivery_stats.append(
+            {
+                "path": path,
+                "bytes": nbytes,
+                "export_ms": self._export_ms,
+                "deliver_ms": (time.perf_counter() - t0) * 1000.0,
+            }
+        )
         self.prefills_done += 1
         prompt_tokens = len((msg.get("request") or {}).get("token_ids") or ())
         logger.info(
