@@ -156,6 +156,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8282)
 
+    # eval: perplexity of a checkpoint on real text (first-party accuracy
+    # flow; the reference reaches this through its engines' lm-eval docs)
+    ev = sub.add_parser("eval",
+                        help="score a checkpoint's perplexity on a text")
+    ev.add_argument("--model-path", required=True)
+    ev.add_argument("--text-file", help="UTF-8 text to score")
+    ev.add_argument("--text", help="inline text to score")
+    ev.add_argument("--window", type=int, default=512,
+                    help="independent scoring window (tokens)")
+    ev.add_argument("--quantize", choices=["int8"], default=None)
+
     # operator: the reconcile controller over api-store deployment records
     # (reference deploy/cloud/operator controller loop)
     op = sub.add_parser("operator",
@@ -1054,6 +1065,36 @@ async def run_api_store(args) -> int:
         await rt.shutdown()
 
 
+def run_eval(args) -> int:
+    """Perplexity of a checkpoint on text: load weights exactly as serving
+    would (incl. --quantize int8), score with llm/evaluate.py, print one
+    JSON line."""
+    import json as _json
+
+    from .engine.config import ModelConfig
+    from .engine.weights import load_safetensors_params
+    from .llm.evaluate import evaluate_perplexity
+    from .llm.tokenizer import Tokenizer
+
+    if not args.text and not args.text_file:
+        raise SystemExit("need --text or --text-file")
+    text = args.text or open(args.text_file, encoding="utf-8").read()
+    model_cfg = ModelConfig.from_pretrained(args.model_path)
+    params = load_safetensors_params(args.model_path, model_cfg)
+    if args.quantize == "int8":
+        from .engine.quant import quantize_params
+
+        params = quantize_params(params, model_cfg)
+    tok = Tokenizer.from_model_dir(args.model_path)
+    ids = tok.encode(text)
+    out = evaluate_perplexity(params, model_cfg, ids, window=args.window)
+    out["model"] = args.model_path
+    out["quantize"] = args.quantize
+    print(_json.dumps({k: (round(v, 4) if isinstance(v, float) else v)
+                       for k, v in out.items()}))
+    return 0
+
+
 async def run_operator(args) -> int:
     """Run the reconcile controller (reference operator equivalent)."""
     from .operator import KubectlBackend, Operator, OperatorConfig
@@ -1156,6 +1197,8 @@ def main(argv=None) -> int:
         return asyncio.run(run_disagg_conf(args))
     if args.cmd == "api-store":
         return asyncio.run(run_api_store(args))
+    if args.cmd == "eval":
+        return run_eval(args)
     if args.cmd == "operator":
         return asyncio.run(run_operator(args))
     if args.cmd == "build":

@@ -720,13 +720,13 @@ class JaxEngine:
             return results
 
         def materialize() -> List[Any]:
-            out: List[Any] = []
-            for r in results:
-                if isinstance(r, tuple):
-                    blob, row = r
-                    out.append((np.asarray(jax.device_get(blob)), row))
-                else:
-                    out.append(r)
+            # ONE bundled device_get for every blob (a per-item get would
+            # pay one device round trip each on a high-RTT link)
+            idx = [i for i, r in enumerate(results) if isinstance(r, tuple)]
+            blobs = jax.device_get([results[i][0] for i in idx])
+            out: List[Any] = list(results)
+            for i, blob in zip(idx, blobs):
+                out[i] = (np.asarray(blob), results[i][1])
             return out
 
         return await asyncio.to_thread(materialize)

@@ -405,7 +405,6 @@ class PrefillWorker:
         self.delivery_stats: "collections.deque" = collections.deque(
             maxlen=512
         )
-        self._export_ms = 0.0
 
     def transfer_stats(self) -> Dict[str, Any]:
         """Percentile summary of the recorded deliveries (bench/metrics
@@ -517,18 +516,19 @@ class PrefillWorker:
             )
             for i, res in zip(good, exported):
                 results[i] = res
-        self._export_ms = export_ms_per_item
         # deliver concurrently: uploads to distinct decode workers ride
         # distinct connections; to the same worker they multiplex
         await asyncio.gather(
             *[
-                self._deliver(msg, res)
+                self._deliver(msg, res, export_ms_per_item)
                 for msg, res in zip(batch, results)
             ],
             return_exceptions=True,
         )
 
-    async def _deliver(self, msg: Dict[str, Any], result: Any) -> None:
+    async def _deliver(
+        self, msg: Dict[str, Any], result: Any, export_ms: float = 0.0
+    ) -> None:
         rid = msg["request_id"]
         if isinstance(result, Exception):
             # tell the decode worker so its parked lane fails immediately
@@ -586,7 +586,7 @@ class PrefillWorker:
             {
                 "path": path,
                 "bytes": nbytes,
-                "export_ms": self._export_ms,
+                "export_ms": export_ms,
                 "deliver_ms": (time.perf_counter() - t0) * 1000.0,
             }
         )

@@ -1,0 +1,234 @@
+"""Real-checkpoint serve-path validation (round-4 verdict #4).
+
+A torch-exported tiny llama (real weights on disk, real tokenizer) is
+served through the FULL stack -- HTTP parse -> preprocessor -> engine ->
+backend detok -> response -- and its greedy transcript must equal
+``transformers.generate`` on the same checkpoint.  Perplexity from the
+``dynamo-tpu eval`` harness must match a torch teacher-forced
+cross-entropy to float tolerance, and int8 must stay within a small
+perplexity delta of the full-precision score, replacing the tiny random
+cosine as int8's quality evidence.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.weights import load_safetensors_params
+from dynamo_tpu.http import HttpService
+from dynamo_tpu.llm import Backend, OpenAIPreprocessor, Tokenizer
+from dynamo_tpu.llm.evaluate import evaluate_perplexity
+from dynamo_tpu.runtime.pipeline import link
+
+from tests.test_serving import http_request
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    """A complete on-disk model dir: tokenizer + config.json + safetensors,
+    exported from a seeded torch LlamaForCausalLM."""
+    from safetensors.torch import save_file
+    from tokenizers import (
+        Tokenizer as TkTokenizer,
+        decoders,
+        models,
+        pre_tokenizers,
+        trainers,
+    )
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    d = tmp_path_factory.mktemp("real-ckpt")
+    tok = TkTokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    tok.train_from_iterator(
+        [
+            "the quick brown fox jumps over the lazy dog",
+            "perplexity measures how well a model predicts text",
+            "paged attention over a device mesh with sharded kv heads",
+            "0123456789 abcdefghijklmnopqrstuvwxyz .,!?",
+        ],
+        trainers.BpeTrainer(vocab_size=384, special_tokens=["<unk>", "<s>", "</s>"]),
+    )
+    tok.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(
+        json.dumps({"eos_token": "</s>", "bos_token": "<s>"})
+    )
+    V = tok.get_vocab_size()
+    hf_cfg = LlamaConfig(
+        vocab_size=V, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=False, attention_bias=False,
+    )
+    (d / "config.json").write_text(
+        json.dumps(
+            {
+                "architectures": ["LlamaForCausalLM"],
+                "model_type": "llama",
+                "vocab_size": V, "hidden_size": 64,
+                "intermediate_size": 128, "num_hidden_layers": 2,
+                "num_attention_heads": 4, "num_key_value_heads": 2,
+                "head_dim": 16, "max_position_embeddings": 256,
+                "rms_norm_eps": 1e-5, "rope_theta": 10000.0,
+                "tie_word_embeddings": False, "torch_dtype": "float32",
+                "eos_token_id": 2, "bos_token_id": 1,
+            }
+        )
+    )
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    save_file(
+        {k: v.contiguous() for k, v in model.state_dict().items()},
+        str(d / "model.safetensors"),
+    )
+    return str(d), model
+
+
+def _hf_greedy_text(model, tokenizer, prompt: str, n: int) -> str:
+    ids = tokenizer.encode(prompt)
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor([ids], dtype=torch.long),
+            max_new_tokens=n,
+            do_sample=False,
+            eos_token_id=None,  # fixed-length: the served side sets ignore_eos
+            pad_token_id=0,
+        )
+    return tokenizer.decode(out[0][len(ids):].tolist())
+
+
+def test_served_greedy_transcript_matches_transformers(checkpoint, run):
+    """HTTP -> engine -> detok on a real checkpoint == transformers.generate."""
+    path, model = checkpoint
+    tok = Tokenizer.from_model_dir(path)
+    prompts = ["the quick brown", "perplexity measures how"]
+    N = 12
+    expected = [_hf_greedy_text(model, tok, p, N) for p in prompts]
+
+    async def main():
+        engine = JaxEngine.from_pretrained(
+            path,
+            EngineConfig(max_batch_size=2, max_seq_len=128, page_size=8,
+                         num_pages=64, decode_block_size=4),
+        )
+        pipeline = link(OpenAIPreprocessor("ck", tok), Backend(tok), engine)
+        svc = HttpService()
+        svc.manager.add_completion_model("ck", pipeline)
+        await svc.start()
+        try:
+            host, port = svc.address
+            outs = []
+            for p in prompts:
+                _, _, body = await http_request(
+                    host, port, "POST", "/v1/completions",
+                    {"model": "ck", "prompt": p, "max_tokens": N,
+                     "temperature": 0, "ignore_eos": True},
+                )
+                outs.append(body["choices"][0]["text"])
+            return outs
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    got = run(main())
+    assert got == expected
+
+
+def test_served_int8_real_checkpoint(checkpoint, run):
+    """The int8 path serves the real checkpoint end to end over HTTP
+    (transcript-level quality is pinned by the perplexity-delta test --
+    a tiny model's near-uniform logits make exact int8 transcripts
+    brittle by construction)."""
+    path, _model = checkpoint
+    tok = Tokenizer.from_model_dir(path)
+
+    async def main():
+        engine = JaxEngine.from_pretrained(
+            path,
+            EngineConfig(max_batch_size=2, max_seq_len=128, page_size=8,
+                         num_pages=64, decode_block_size=4, quantize="int8"),
+        )
+        pipeline = link(OpenAIPreprocessor("q8", tok), Backend(tok), engine)
+        svc = HttpService()
+        svc.manager.add_completion_model("q8", pipeline)
+        await svc.start()
+        try:
+            host, port = svc.address
+            _, _, body = await http_request(
+                host, port, "POST", "/v1/completions",
+                {"model": "q8", "prompt": "the quick brown", "max_tokens": 8,
+                 "temperature": 0, "ignore_eos": True},
+            )
+            return body
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    body = run(main())
+    assert body["usage"]["completion_tokens"] == 8
+    assert isinstance(body["choices"][0]["text"], str)
+
+
+def test_perplexity_matches_torch_cross_entropy(checkpoint):
+    """The eval harness's NLL == torch teacher-forced cross-entropy."""
+    path, model = checkpoint
+    tok = Tokenizer.from_model_dir(path)
+    text = "the quick brown fox jumps over the lazy dog . " \
+           "perplexity measures how well a model predicts text"
+    ids = tok.encode(text)
+    assert len(ids) >= 16
+
+    cfg = ModelConfig.from_pretrained(path)
+    params = load_safetensors_params(path, cfg)
+    got = evaluate_perplexity(params, cfg, ids, window=256)
+
+    with torch.no_grad():
+        t = torch.tensor([ids], dtype=torch.long)
+        logits = model(t).logits[0]
+        lp = torch.log_softmax(logits[:-1].double(), dim=-1)
+        nll = -lp[torch.arange(len(ids) - 1), t[0, 1:]].sum().item()
+    ref_avg = nll / (len(ids) - 1)
+    assert got["tokens_scored"] == len(ids) - 1
+    assert abs(got["avg_nll"] - ref_avg) < 2e-3
+    assert abs(got["perplexity"] - np.exp(ref_avg)) / np.exp(ref_avg) < 5e-3
+
+
+def test_int8_perplexity_delta_small(checkpoint):
+    """int8's quality claim: perplexity within a few percent of full
+    precision on the same real checkpoint + text."""
+    from dynamo_tpu.engine.quant import quantize_params
+
+    path, _model = checkpoint
+    tok = Tokenizer.from_model_dir(path)
+    text = "the quick brown fox jumps over the lazy dog . " \
+           "paged attention over a device mesh with sharded kv heads"
+    ids = tok.encode(text)
+    cfg = ModelConfig.from_pretrained(path)
+    params = load_safetensors_params(path, cfg)
+    base = evaluate_perplexity(params, cfg, ids, window=256)
+    q = evaluate_perplexity(
+        quantize_params(params, cfg), cfg, ids, window=256
+    )
+    rel = abs(q["perplexity"] - base["perplexity"]) / base["perplexity"]
+    assert rel < 0.05, (base, q)
+
+
+def test_eval_cli(checkpoint, capsys, monkeypatch):
+    """dynamo-tpu eval prints one JSON line with the score."""
+    from dynamo_tpu.cli import build_parser, run_eval
+
+    path, _model = checkpoint
+    args = build_parser().parse_args(
+        ["eval", "--model-path", path, "--text",
+         "the quick brown fox jumps over the lazy dog", "--window", "64"]
+    )
+    assert run_eval(args) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["perplexity"] > 1.0 and out["tokens_scored"] > 4
