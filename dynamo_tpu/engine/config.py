@@ -216,5 +216,16 @@ class ModelConfig:
 
     @classmethod
     def from_pretrained(cls, model_path: str) -> "ModelConfig":
-        with open(os.path.join(model_path, "config.json")) as f:
-            return cls.from_hf_config(json.load(f))
+        cfg_json = os.path.join(model_path, "config.json")
+        if os.path.exists(cfg_json):
+            with open(cfg_json) as f:
+                return cls.from_hf_config(json.load(f))
+        # GGUF checkpoint: the architecture config lives in its metadata
+        from ..llm.gguf import find_gguf_file, gguf_model_config
+
+        gguf = find_gguf_file(model_path)
+        if gguf is not None:
+            return gguf_model_config(gguf)
+        raise FileNotFoundError(
+            f"{model_path}: no config.json and no .gguf file"
+        )

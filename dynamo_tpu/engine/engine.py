@@ -329,6 +329,8 @@ class JaxEngine:
         cfg: Optional[EngineConfig] = None,
         mesh: Optional[jax.sharding.Mesh] = None,
     ) -> "JaxEngine":
+        import os
+
         from .weights import load_safetensors_params
 
         model_cfg = ModelConfig.from_pretrained(model_path)
@@ -337,7 +339,25 @@ class JaxEngine:
             from ..parallel.sharding import param_shardings
 
             shardings = param_shardings(model_cfg, mesh)
-        params = load_safetensors_params(model_path, model_cfg, shardings=shardings)
+        has_st = os.path.isdir(model_path) and any(
+            f.endswith(".safetensors") for f in os.listdir(model_path)
+        )
+        if has_st:
+            params = load_safetensors_params(
+                model_path, model_cfg, shardings=shardings
+            )
+        else:
+            # GGUF checkpoint: dequantize-on-load (llm/gguf.py)
+            from ..llm.gguf import find_gguf_file, load_gguf_params
+
+            gguf = find_gguf_file(model_path)
+            if gguf is None:
+                raise FileNotFoundError(
+                    f"{model_path}: no .safetensors and no .gguf weights"
+                )
+            params = load_gguf_params(
+                gguf, model_cfg, shardings=shardings
+            )
         return cls(model_cfg, params, cfg, mesh=mesh)
 
     async def start(self) -> None:

@@ -1,4 +1,4 @@
-"""GGUF tokenizer support: metadata parsing + HF-tokenizers conversion.
+"""GGUF support: metadata + tokenizer conversion + quantized weight loading.
 
 Reference ``lib/llm/src/gguf`` (gguf_metadata.rs, gguf_tokenizer.rs):
 llama.cpp-ecosystem models ship as one ``.gguf`` file whose metadata embeds
@@ -10,11 +10,13 @@ conversions here, feeding the standard `llm.tokenizer.Tokenizer` facade:
 ``--model-path model.gguf`` (or a dir containing one) gets its tokenizer
 from the GGUF metadata.
 
-Weights stay on the safetensors path: GGUF weight blocks are mostly
-llama.cpp quantization formats (Q4_K & co) whose TPU story is a separate
-dequantization design, documented as out of scope -- the reference
-likewise hands GGUF *inference* to its engines and only reads tokenizer +
-config metadata itself (SURVEY.md 2.2).
+WEIGHTS load first-party too (the reference serves GGUF checkpoints via
+llamacpp/mistralrs delegation; here the engine consumes them directly):
+F32/F16/BF16 plus the ubiquitous block formats Q8_0 and Q4_0 dequantize
+on load into the engine dtype (llama architecture; q/k rows un-permute
+from llama.cpp's interleaved-rope layout back to the HF convention the
+engine's RoPE uses).  K-quants (Q4_K & co) remain out of scope --
+re-export those via llama.cpp to Q8_0, or use safetensors.
 """
 
 from __future__ import annotations
@@ -61,22 +63,29 @@ def _read_value(f: BinaryIO, vtype: int) -> Any:
     return _read_scalar(f, vtype)
 
 
+def _read_header(f: BinaryIO, path: str) -> Tuple[int, Dict[str, Any]]:
+    """Magic/version check + the metadata KV section.  Returns
+    ``(tensor_count, metadata)`` with ``f`` positioned at the tensor-info
+    section -- the single parser behind both readers."""
+    magic, version = struct.unpack("<II", f.read(8))
+    if magic != GGUF_MAGIC:
+        raise ValueError(f"{path}: not a GGUF file (magic {magic:#x})")
+    if version < 2:
+        raise ValueError(f"{path}: GGUF version {version} unsupported")
+    tensor_count, kv_count = struct.unpack("<QQ", f.read(16))
+    meta: Dict[str, Any] = {}
+    for _ in range(kv_count):
+        (klen,) = struct.unpack("<Q", f.read(8))
+        key = f.read(klen).decode("utf-8", errors="replace")
+        (vtype,) = struct.unpack("<I", f.read(4))
+        meta[key] = _read_value(f, vtype)
+    return tensor_count, meta
+
+
 def read_gguf_metadata(path: str) -> Dict[str, Any]:
     """Parse a GGUF file's metadata key/value section (tensors skipped)."""
     with open(path, "rb") as f:
-        magic, version = struct.unpack("<II", f.read(8))
-        if magic != GGUF_MAGIC:
-            raise ValueError(f"{path}: not a GGUF file (magic {magic:#x})")
-        if version < 2:
-            raise ValueError(f"{path}: GGUF version {version} unsupported")
-        _tensor_count, kv_count = struct.unpack("<QQ", f.read(16))
-        meta: Dict[str, Any] = {}
-        for _ in range(kv_count):
-            (klen,) = struct.unpack("<Q", f.read(8))
-            key = f.read(klen).decode("utf-8", errors="replace")
-            (vtype,) = struct.unpack("<I", f.read(4))
-            meta[key] = _read_value(f, vtype)
-        return meta
+        return _read_header(f, path)[1]
 
 
 def find_gguf_file(model_path: str) -> Optional[str]:
@@ -199,3 +208,224 @@ def gguf_tokenizer(path: str):
         model, len(tokens), int(bos), int(eos),
     )
     return tok, info
+
+
+# ---------------------------------------------------------------------------
+# Quantized weight loading (llama architecture)
+# ---------------------------------------------------------------------------
+
+# ggml tensor types (ggml.h)
+GGML_F32, GGML_F16, GGML_Q4_0, GGML_Q8_0, GGML_BF16 = 0, 1, 2, 8, 30
+
+_GGML_BLOCK = {  # type -> (elements per block, bytes per block)
+    GGML_Q4_0: (32, 18),  # f16 scale + 16 nibble bytes
+    GGML_Q8_0: (32, 34),  # f16 scale + 32 int8
+}
+
+
+def read_gguf_tensors(path: str):
+    """Parse header + tensor-info section.
+
+    Returns ``(metadata, tensors, data_start)`` where tensors maps name ->
+    ``(ggml_type, numpy_shape, offset)`` (offset relative to data_start;
+    numpy shape is the reversed ggml ``ne`` -- ggml lists the contiguous
+    dimension first)."""
+    with open(path, "rb") as f:
+        tensor_count, meta = _read_header(f, path)
+        tensors: Dict[str, Tuple[int, Tuple[int, ...], int]] = {}
+        for _ in range(tensor_count):
+            (nlen,) = struct.unpack("<Q", f.read(8))
+            name = f.read(nlen).decode("utf-8", errors="replace")
+            (n_dims,) = struct.unpack("<I", f.read(4))
+            dims = struct.unpack(f"<{n_dims}Q", f.read(8 * n_dims))
+            gtype, offset = struct.unpack("<IQ", f.read(4 + 8))
+            tensors[name] = (gtype, tuple(reversed(dims)), offset)
+        align = int(meta.get("general.alignment", 32) or 32)
+        pos = f.tell()
+        data_start = (pos + align - 1) // align * align
+        return meta, tensors, data_start
+
+
+def dequantize_ggml(buf: bytes, gtype: int, shape: Tuple[int, ...]):
+    """Raw tensor bytes -> float numpy array of ``shape``."""
+    import numpy as np
+
+    n = 1
+    for d in shape:
+        n *= d
+    if gtype == GGML_F32:
+        return np.frombuffer(buf, np.float32, n).reshape(shape)
+    if gtype == GGML_F16:
+        return np.frombuffer(buf, np.float16, n).reshape(shape)
+    if gtype == GGML_BF16:
+        u = np.frombuffer(buf, np.uint16, n).astype(np.uint32) << 16
+        return u.view(np.float32).reshape(shape)
+    if gtype == GGML_Q8_0:
+        per, nbytes = _GGML_BLOCK[gtype]
+        blocks = n // per
+        raw = np.frombuffer(buf, np.uint8, blocks * nbytes).reshape(
+            blocks, nbytes
+        )
+        d = raw[:, :2].copy().view(np.float16).astype(np.float32)  # [B,1]
+        q = raw[:, 2:].view(np.int8).astype(np.float32)  # [B,32]
+        return (q * d).reshape(shape)
+    if gtype == GGML_Q4_0:
+        per, nbytes = _GGML_BLOCK[gtype]
+        blocks = n // per
+        raw = np.frombuffer(buf, np.uint8, blocks * nbytes).reshape(
+            blocks, nbytes
+        )
+        d = raw[:, :2].copy().view(np.float16).astype(np.float32)  # [B,1]
+        qs = raw[:, 2:]  # [B,16] nibble pairs
+        lo = (qs & 0x0F).astype(np.int8) - 8
+        hi = (qs >> 4).astype(np.int8) - 8
+        # llama.cpp layout: byte j holds elements j (low) and j+16 (high)
+        vals = np.concatenate([lo, hi], axis=1).astype(np.float32)  # [B,32]
+        return (vals * d).reshape(shape)
+    raise ValueError(
+        f"unsupported ggml tensor type {gtype} (supported: F32/F16/BF16/"
+        f"Q8_0/Q4_0; re-export K-quants via llama.cpp or use safetensors)"
+    )
+
+
+def _unpermute_rope(w, n_head: int):
+    """Invert convert_hf_to_gguf's q/k permutation (interleaved-rope rows
+    back to HF rotate_half order).  ``w`` is [out, in]."""
+    out, inn = w.shape
+    return (
+        w.reshape(n_head, out // n_head // 2, 2, inn)
+        .swapaxes(1, 2)
+        .reshape(out, inn)
+    )
+
+
+def _require_llama_arch(meta: Dict[str, Any], path: str) -> None:
+    """First-party GGUF weights are llama-only: other architectures may
+    share the blk.N tensor naming but NOT llama.cpp's q/k rope permutation
+    -- loading them would silently scramble attention."""
+    arch = meta.get("general.architecture", "llama")
+    if arch != "llama":
+        raise ValueError(
+            f"{path}: GGUF architecture {arch!r} unsupported for "
+            f"first-party weights (llama only); use safetensors"
+        )
+
+
+class _GgufHFView:
+    """Lazy GGUF tensor mapping presented under HF names, so the standard
+    ``engine.weights.assemble_params`` consumes GGUF files unchanged."""
+
+    _STATIC = {
+        "token_embd.weight": "model.embed_tokens.weight",
+        "output_norm.weight": "model.norm.weight",
+        "output.weight": "lm_head.weight",
+    }
+    _BLK = {
+        "attn_q.weight": "self_attn.q_proj.weight",
+        "attn_k.weight": "self_attn.k_proj.weight",
+        "attn_v.weight": "self_attn.v_proj.weight",
+        "attn_output.weight": "self_attn.o_proj.weight",
+        "ffn_gate.weight": "mlp.gate_proj.weight",
+        "ffn_up.weight": "mlp.up_proj.weight",
+        "ffn_down.weight": "mlp.down_proj.weight",
+        "attn_norm.weight": "input_layernorm.weight",
+        "ffn_norm.weight": "post_attention_layernorm.weight",
+    }
+
+    def __init__(self, path: str, n_head: int, n_kv_head: int) -> None:
+        self.path = path
+        self.meta, self.tensors, self.data_start = read_gguf_tensors(path)
+        _require_llama_arch(self.meta, path)
+        self.n_head = n_head
+        self.n_kv_head = n_kv_head
+        self._by_hf: Dict[str, str] = {}
+        for gname in self.tensors:
+            hf = self._hf_name(gname)
+            if hf is not None:
+                self._by_hf[hf] = gname
+
+    def _hf_name(self, gname: str) -> Optional[str]:
+        if gname in self._STATIC:
+            return self._STATIC[gname]
+        if gname.startswith("blk."):
+            _, idx, rest = gname.split(".", 2)
+            mapped = self._BLK.get(rest)
+            if mapped is not None:
+                return f"model.layers.{idx}.{mapped}"
+        return None
+
+    def __contains__(self, hf_name: str) -> bool:
+        return hf_name in self._by_hf
+
+    def __getitem__(self, hf_name: str):
+        import numpy as np
+
+        gname = self._by_hf[hf_name]
+        gtype, shape, offset = self.tensors[gname]
+        n = 1
+        for d in shape:
+            n *= d
+        if gtype in _GGML_BLOCK:
+            per, nbytes = _GGML_BLOCK[gtype]
+            size = n // per * nbytes
+        else:
+            size = n * {GGML_F32: 4, GGML_F16: 2, GGML_BF16: 2}.get(gtype, 4)
+        with open(self.path, "rb") as f:
+            f.seek(self.data_start + offset)
+            buf = f.read(size)
+        arr = dequantize_ggml(buf, gtype, shape)
+        if hf_name.endswith("q_proj.weight"):
+            arr = _unpermute_rope(np.ascontiguousarray(arr), self.n_head)
+        elif hf_name.endswith("k_proj.weight"):
+            arr = _unpermute_rope(np.ascontiguousarray(arr), self.n_kv_head)
+        return arr
+
+
+def gguf_model_config(path: str):
+    """ModelConfig from GGUF metadata (llama architecture)."""
+    from ..engine.config import ModelConfig
+
+    meta, tensors, _ = read_gguf_tensors(path)
+    _require_llama_arch(meta, path)
+    p = "llama."
+    n_head = int(meta[p + "attention.head_count"])
+    n_kv = int(meta.get(p + "attention.head_count_kv", n_head))
+    hidden = int(meta[p + "embedding_length"])
+    vocab = meta.get(p + "vocab_size")
+    if vocab is None:
+        vocab = len(meta.get("tokenizer.ggml.tokens") or [])
+    return ModelConfig(
+        vocab_size=int(vocab),
+        hidden_size=hidden,
+        intermediate_size=int(meta[p + "feed_forward_length"]),
+        num_layers=int(meta[p + "block_count"]),
+        num_heads=n_head,
+        num_kv_heads=n_kv,
+        head_dim=int(
+            meta.get(p + "attention.key_length", hidden // n_head)
+        ),
+        rope_theta=float(meta.get(p + "rope.freq_base", 10000.0)),
+        rms_norm_eps=float(
+            meta.get(p + "attention.layer_norm_rms_epsilon", 1e-5)
+        ),
+        max_position=int(meta.get(p + "context_length", 4096)),
+        tie_word_embeddings="output.weight" not in tensors,
+        dtype="bfloat16",
+    )
+
+
+def load_gguf_params(
+    path: str,
+    cfg,
+    dtype: Any = None,
+    shardings: Optional[Dict[str, Any]] = None,
+):
+    """Assemble the engine's parameter pytree straight from a GGUF file."""
+    from ..engine.weights import assemble_params
+
+    view = _GgufHFView(path, cfg.num_heads, cfg.num_kv_heads)
+    import jax.numpy as jnp
+
+    return assemble_params(
+        view, cfg, jnp.dtype(dtype or cfg.dtype), shardings
+    )
