@@ -135,7 +135,13 @@ def flash_prefill_attention(
     """Causal prefill attention, flash-tiled.  Same contract as
     engine.attention.prefill_attention (prompt starts at position 0); T must
     divide by the chosen blocks -- callers pass power-of-two buckets, and
-    the blocks clamp down to T."""
+    the blocks clamp down to T.
+
+    Tile note (v5e, interleaved A/B): with the kernel benchmarked STANDALONE,
+    BK=1024 beats 256 by 10-37% (T=1024..4096) -- but inside the engine's
+    fused layer-scan graph the same BK=1024 collapses whole-model prefill
+    ~20x (VMEM pressure against the surrounding fusion), so the default
+    stays 256.  Tune block_k only against engine-level measurements."""
     B, T, Hq, D = q.shape
     Hkv = k.shape[2]
     n_rep = Hq // Hkv
