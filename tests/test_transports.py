@@ -743,3 +743,26 @@ def test_workers_survive_hub_restart(run, tmp_path):
         await server2.stop()
 
     run(body())
+
+
+def test_reconnect_window_exhausted_fails_loudly(run, tmp_path):
+    """A hub that never comes back must still end in the loud-failure
+    path: watches get poisoned and on_connection_lost fires after the
+    reconnect window, not a silent forever-retry."""
+
+    async def body():
+        server = HubServer(port=0, data_dir=str(tmp_path / "h"))
+        host, port = await server.start()
+        client = await HubClient(host, port, reconnect_window=0.6).connect()
+        lost = asyncio.Event()
+        client.on_connection_lost = lost.set
+        watch = await client.watch_prefix("models/")
+        await server.stop()  # gone for good
+        await asyncio.wait_for(lost.wait(), 10)
+        ev = await asyncio.wait_for(watch.events.get(), 2)
+        assert getattr(ev, "type", None) == "conn_lost" or ev is not None
+        with pytest.raises(ConnectionError):
+            await client.kv_put("x", b"1")
+        await client.close()
+
+    run(body())
