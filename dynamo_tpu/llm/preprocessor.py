@@ -221,6 +221,21 @@ class OpenAIPreprocessor(Operator):
             completion_tokens = 0
             finish: Optional[str] = None
             text_off = 0  # running offset into the emitted completion text
+            if not is_chat and getattr(req, "echo", False):
+                # OpenAI completions echo: the prompt text leads the
+                # completion (its length counts into text_offset)
+                prompt_text = (
+                    req.prompt
+                    if isinstance(req.prompt, str)
+                    else self.tokenizer.decode(list(req.prompt))
+                )
+                if prompt_text:
+                    yield Annotated.from_data(
+                        completion_chunk(
+                            rid, model, created, text=prompt_text
+                        )
+                    )
+                    text_off = len(prompt_text)
             async for item in stream:
                 if not isinstance(item, Annotated):
                     item = Annotated.from_data(item)

@@ -183,13 +183,21 @@ class CompletionRequest:
             raise OpenAIError("'prompt' must not be empty")
         if d.get("n") not in (None, 1):
             raise OpenAIError("only n=1 is supported")
-        return cls(
+        out = cls(
             model=model,
             prompt=prompt,
             sampling=SamplingFields.from_dict(d),
             stream=bool(d.get("stream", False)),
             echo=bool(d.get("echo", False)),
         )
+        if out.echo and out.sampling.logprobs is not None:
+            # echo+logprobs asks for PROMPT logprobs (legacy OpenAI); the
+            # engine computes completion logprobs only -- fail loudly
+            # instead of returning silently misaligned arrays
+            raise OpenAIError(
+                "'echo' with 'logprobs' (prompt logprobs) is not supported"
+            )
+        return out
 
 
 @dataclass

@@ -778,3 +778,35 @@ def test_http_logprobs_full_stack(model_dir, run):
         assert entry["top_logprobs"][0]["logprob"] >= entry["top_logprobs"][1]["logprob"]
 
     assert "logprobs" not in plain["choices"][0]
+
+
+def test_completions_echo_prepends_prompt(model_dir, run):
+    """OpenAI completions echo=true: the prompt text leads the completion
+    (previously parsed but silently ignored); echo+logprobs (prompt
+    logprobs) rejects loudly."""
+
+    async def main():
+        svc, engine = _build_service(model_dir)
+        await svc.start()
+        try:
+            host, port = svc.address
+            _, _, body = await http_request(
+                host, port, "POST", "/v1/completions",
+                {"model": "mock-model", "prompt": "hello world",
+                 "max_tokens": 4, "echo": True},
+            )
+            _, _, err = await http_request(
+                host, port, "POST", "/v1/completions",
+                {"model": "mock-model", "prompt": "hi", "max_tokens": 2,
+                 "echo": True, "logprobs": 1},
+            )
+            return body, err
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    body, err = run(main())
+    assert body["choices"][0]["text"].startswith("hello world")
+    assert len(body["choices"][0]["text"]) > len("hello world")
+    assert err["error"]["type"] == "invalid_request_error"
+    assert "echo" in err["error"]["message"]
