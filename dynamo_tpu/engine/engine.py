@@ -1139,11 +1139,20 @@ class JaxEngine:
 
     # -- device work (executor thread) --------------------------------------
 
+    @staticmethod
+    def _norm_seed(so) -> int:
+        """User seed -> device u32 with 0 reserved for 'unseeded' (a user
+        seed of 0 is valid OpenAI input, so it maps into 1..2^32-1)."""
+        if so is None or so.seed is None:
+            return 0
+        return (int(so.seed) % 0xFFFFFFFF) + 1
+
     def _sampling_arrays(self, seqs: List[Optional[SeqState]]) -> SamplingParams:
         n = len(seqs)
         temp = np.zeros((n,), np.float32)
         top_p = np.ones((n,), np.float32)
         top_k = np.zeros((n,), np.int32)
+        seed = np.zeros((n,), np.uint32)
         for i, s in enumerate(seqs):
             if s is None:
                 continue
@@ -1156,10 +1165,12 @@ class JaxEngine:
                 temp[i] = 1.0
             top_p[i] = so.top_p if so.top_p is not None else 1.0
             top_k[i] = so.top_k or 0
+            seed[i] = self._norm_seed(so)
         return SamplingParams(
             temperature=self._put_batch(temp),
             top_p=self._put_batch(top_p),
             top_k=self._put_batch(top_k),
+            seed=self._put_batch(seed),
         )
 
     @staticmethod
@@ -1350,7 +1361,7 @@ class JaxEngine:
             self.pp_prefills += 1
         return sample_step_packed(
             logits, self._next_rng(), self._sampling_arrays(seqs),
-            self._lp_top(seqs),
+            self._lp_top(seqs), positions=self._put_batch(lens),
         )
 
     def _dispatch_full_prefill(
@@ -1673,6 +1684,7 @@ class JaxEngine:
             "temp": np.zeros((G,), np.float32),
             "top_p": np.ones((G,), np.float32),
             "top_k": np.zeros((G,), np.int32),
+            "seed": np.zeros((G,), np.uint32),
         }
         for i, b in enumerate(dirty):
             seq = sched.slots[b]
@@ -1696,6 +1708,7 @@ class JaxEngine:
                     rows["temp"][i] = 1.0
                 rows["top_p"][i] = so.top_p if so.top_p is not None else 1.0
                 rows["top_k"][i] = so.top_k or 0
+                rows["seed"][i] = self._norm_seed(so)
             self._limit_host[b] = limits[b]
         samp = d["sampling"]
         (
@@ -1708,6 +1721,7 @@ class JaxEngine:
             temp,
             top_p,
             top_k,
+            seed,
         ) = update_lanes(
             d["tokens"],
             d["seq_lens"],
@@ -1718,10 +1732,13 @@ class JaxEngine:
             samp.temperature,
             samp.top_p,
             samp.top_k,
+            samp.seed,
             jnp.asarray(slots),
             rows,
         )
-        d["sampling"] = SamplingParams(temperature=temp, top_p=top_p, top_k=top_k)
+        d["sampling"] = SamplingParams(
+            temperature=temp, top_p=top_p, top_k=top_k, seed=seed
+        )
         # pending injects hold the real first token for lanes whose mirror
         # still has the placeholder; re-apply them on top of the row scatter
         # (batched: one scatter, not one dispatch per lane)

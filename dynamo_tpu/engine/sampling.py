@@ -23,14 +23,39 @@ class SamplingParams(NamedTuple):
     temperature: jax.Array  # [B] f32; <= 0 means greedy
     top_p: jax.Array  # [B] f32 in (0, 1]; 1 disables
     top_k: jax.Array  # [B] i32; 0 disables
+    # per-request RNG seed; 0 = unseeded (engine stream).  A seeded lane
+    # samples from fold_in(PRNGKey(seed), position), so its output depends
+    # only on (seed, prompt) -- never on batchmates or block boundaries.
+    seed: jax.Array = None  # [B] u32
 
     @classmethod
-    def fill(cls, batch: int, temperature=0.0, top_p=1.0, top_k=0):
+    def fill(cls, batch: int, temperature=0.0, top_p=1.0, top_k=0, seed=0):
         return cls(
             temperature=jnp.full((batch,), temperature, jnp.float32),
             top_p=jnp.full((batch,), top_p, jnp.float32),
             top_k=jnp.full((batch,), top_k, jnp.int32),
+            seed=jnp.full((batch,), seed, jnp.uint32),
         )
+
+
+def _lane_gumbel(
+    rng: jax.Array,
+    params: SamplingParams,
+    positions,  # [B] i32 cache position (step identity for seeded lanes)
+    shape,
+) -> jax.Array:
+    """Per-lane gumbel noise: unseeded lanes draw from the engine stream,
+    seeded lanes from a key that is a pure function of (seed, position)."""
+    B, V = shape
+    if params.seed is None:
+        return jax.random.gumbel(rng, (B, V))
+    lane_keys = jax.random.split(rng, B)
+    seeded_keys = jax.vmap(
+        lambda s, p: jax.random.fold_in(jax.random.PRNGKey(s), p)
+    )(params.seed, positions.astype(jnp.uint32))
+    use = (params.seed > 0)[:, None]
+    keys = jnp.where(use, seeded_keys, lane_keys)
+    return jax.vmap(lambda k: jax.random.gumbel(k, (V,)))(keys)
 
 
 def sample_tokens(
@@ -38,6 +63,7 @@ def sample_tokens(
     rng: jax.Array,
     params: SamplingParams,
     use_filters: bool = True,
+    positions=None,  # [B] i32; required for per-request seeds
 ) -> jax.Array:
     """Returns sampled token ids [B] int32.
 
@@ -52,12 +78,15 @@ def sample_tokens(
     """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if positions is None:
+        positions = jnp.zeros((B,), jnp.int32)
 
     temp = jnp.maximum(params.temperature, 1e-6)[:, None]
     scaled = logits / temp
 
     if not use_filters:
-        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+        gumbel = _lane_gumbel(rng, params, positions, (B, V))
+        sampled = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
         return jnp.where(params.temperature <= 0.0, greedy, sampled)
 
     # One descending sort serves both top-k and top-p filtering.
@@ -80,7 +109,8 @@ def sample_tokens(
     )
     masked = jnp.where(scaled >= thresh, masked, _NEG_INF)
 
-    sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
+    gumbel = _lane_gumbel(rng, params, positions, (B, V))
+    sampled = jnp.argmax(masked + gumbel, axis=-1).astype(jnp.int32)
     return jnp.where(params.temperature <= 0.0, greedy, sampled)
 
 def token_logprobs(

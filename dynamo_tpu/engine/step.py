@@ -139,7 +139,12 @@ def decode_block(
         tokens, seq_lens, active, rng, kv = carry
         logits, kv = _decode_once(params, cfg, kv, tokens, seq_lens, page_table)
         rng, sub = jax.random.split(rng)
-        sampled = sample_tokens(logits, sub, sampling, use_filters)
+        # seeded lanes key their noise by the position being FILLED
+        # (seq_lens + 1): distinct from the prefill-sampled first token's
+        # key (= prompt length) and from every other step of the request
+        sampled = sample_tokens(
+            logits, sub, sampling, use_filters, positions=seq_lens + 1
+        )
         lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
         hit_stop = jnp.any(sampled[:, None] == stop_ids, axis=1)
         emit = active & ~hit_stop  # stop tokens are swallowed, not emitted
@@ -180,12 +185,16 @@ def sample_step(
 
 @partial(jax.jit, static_argnames=("top_n",))
 def sample_step_packed(
-    logits: jax.Array, rng: jax.Array, params: SamplingParams, top_n: int = 0
+    logits: jax.Array,
+    rng: jax.Array,
+    params: SamplingParams,
+    top_n: int = 0,
+    positions=None,  # [B] i32: step identity for per-request seeds
 ) -> jax.Array:
     """Sample + logprob packing: [B, 2 + 2*top_n] int32 (token | chosen
     logprob bits | top ids | top logprob bits) -- the layout every engine
     sampling site shares (sampling.pack_sampled_logprobs)."""
-    sampled = sample_tokens(logits, rng, params)
+    sampled = sample_tokens(logits, rng, params, positions=positions)
     lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
     return pack_sampled_logprobs(sampled, lp, top_ids, top_lps)
 
@@ -211,7 +220,10 @@ def prefill_and_sample(
     token can be injected into the decode state without a host round trip
     (engine._do_prefill)."""
     logits, kv_pages = prefill_step(params, cfg, kv_pages, tokens, seq_lens, page_table)
-    return sample_step_packed(logits, rng, sampling, top_n), kv_pages
+    return (
+        sample_step_packed(logits, rng, sampling, top_n, positions=seq_lens),
+        kv_pages,
+    )
 
 
 @partial(
@@ -252,7 +264,10 @@ def prefill_mm_and_sample(
     last = jnp.clip(seq_lens - 1, 0, T - 1)
     hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
     logits = lm_logits(params, cfg, hidden_last)
-    return sample_step_packed(logits, rng, sampling, top_n), kv_pages
+    return (
+        sample_step_packed(logits, rng, sampling, top_n, positions=seq_lens),
+        kv_pages,
+    )
 
 
 @partial(
@@ -291,7 +306,12 @@ def prefill_suffix_and_sample(
     last = jnp.clip(suffix_lens - 1, 0, T - 1)
     hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
     logits = lm_logits(params, cfg, hidden_last)
-    return sample_step_packed(logits, rng, sampling, top_n), kv_pages
+    return (
+        sample_step_packed(
+            logits, rng, sampling, top_n, positions=offset + suffix_lens
+        ),
+        kv_pages,
+    )
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -354,7 +374,7 @@ def inject_tokens(
     jax.jit,
     donate_argnames=(
         "tokens", "seq_lens", "limit_lens", "active", "stop_ids",
-        "page_table", "temp", "top_p", "top_k",
+        "page_table", "temp", "top_p", "top_k", "seed",
     ),
 )
 def update_lanes(
@@ -367,6 +387,7 @@ def update_lanes(
     temp: jax.Array,  # [B]
     top_p: jax.Array,  # [B]
     top_k: jax.Array,  # [B]
+    seed: jax.Array,  # [B] u32
     slots: jax.Array,  # [G] lane indices; out-of-range rows are pad (dropped)
     rows: dict,  # stacked per-lane values: token [G], stop [G, E], pages [G, P], ...
 ) -> Tuple[jax.Array, ...]:
@@ -395,6 +416,7 @@ def update_lanes(
         temp.at[slots].set(rows["temp"], mode="drop"),
         top_p.at[slots].set(rows["top_p"], mode="drop"),
         top_k.at[slots].set(rows["top_k"], mode="drop"),
+        seed.at[slots].set(rows["seed"], mode="drop"),
     )
 
 

@@ -763,3 +763,40 @@ def test_logprobs_chosen_only(run):
     lps, tops = run(main())
     assert len(lps) == 4 and all(lp <= 0.0 for lp in lps)
     assert tops is None
+
+
+def test_per_request_seed_deterministic(run):
+    """A seeded sampling request reproduces its output exactly -- across
+    runs AND regardless of batchmates -- and different seeds diverge
+    (seed was previously parsed but silently ignored)."""
+
+    async def main():
+        engine = make_engine()
+
+        async def one(seed, prompt=(1, 2, 3, 4)):
+            r = PreprocessedRequest(
+                token_ids=list(prompt),
+                stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+                sampling_options=SamplingOptions(temperature=1.0, seed=seed),
+            )
+            stream = await engine.generate(Context.new(r))
+            toks = []
+            async for item in stream:
+                toks.extend((item.data or {}).get("token_ids") or [])
+            return toks
+
+        solo = await one(1234)
+        again = await one(1234)
+        other = await one(99)
+        # same seed with a concurrent batchmate occupying another lane
+        import asyncio as _a
+
+        batched, _ = await _a.gather(one(1234), one(7, prompt=(9, 8, 7)))
+        await engine.stop()
+        return solo, again, other, batched
+
+    solo, again, other, batched = run(main())
+    assert len(solo) == 8
+    assert solo == again
+    assert solo == batched  # lane placement / batchmates don't matter
+    assert solo != other

@@ -810,3 +810,33 @@ def test_completions_echo_prepends_prompt(model_dir, run):
     assert len(body["choices"][0]["text"]) > len("hello world")
     assert err["error"]["type"] == "invalid_request_error"
     assert "echo" in err["error"]["message"]
+
+
+def test_nonzero_penalties_rejected_loudly(model_dir, run):
+    """frequency/presence penalties are protocol-parsed but engine-
+    unsupported: non-zero values 400 instead of silently sampling
+    unpenalized; zero/omitted passes."""
+
+    async def main():
+        svc, engine = _build_service(model_dir)
+        await svc.start()
+        try:
+            host, port = svc.address
+            s1, _, err = await http_request(
+                host, port, "POST", "/v1/completions",
+                {"model": "mock-model", "prompt": "hi", "max_tokens": 2,
+                 "frequency_penalty": 0.5},
+            )
+            s2, _, ok = await http_request(
+                host, port, "POST", "/v1/completions",
+                {"model": "mock-model", "prompt": "hi", "max_tokens": 2,
+                 "frequency_penalty": 0.0, "presence_penalty": 0},
+            )
+            return s1, err, s2, ok
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    s1, err, s2, ok = run(main())
+    assert s1 == 400 and "frequency_penalty" in err["error"]["message"]
+    assert s2 == 200 and ok["choices"][0]["finish_reason"]
