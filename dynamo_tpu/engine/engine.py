@@ -1153,6 +1153,8 @@ class JaxEngine:
         top_p = np.ones((n,), np.float32)
         top_k = np.zeros((n,), np.int32)
         seed = np.zeros((n,), np.uint32)
+        freq = np.zeros((n,), np.float32)
+        pres = np.zeros((n,), np.float32)
         for i, s in enumerate(seqs):
             if s is None:
                 continue
@@ -1166,11 +1168,15 @@ class JaxEngine:
             top_p[i] = so.top_p if so.top_p is not None else 1.0
             top_k[i] = so.top_k or 0
             seed[i] = self._norm_seed(so)
+            freq[i] = so.frequency_penalty or 0.0
+            pres[i] = so.presence_penalty or 0.0
         return SamplingParams(
             temperature=self._put_batch(temp),
             top_p=self._put_batch(top_p),
             top_k=self._put_batch(top_k),
             seed=self._put_batch(seed),
+            freq=self._put_batch(freq),
+            pres=self._put_batch(pres),
         )
 
     @staticmethod
@@ -1532,6 +1538,13 @@ class JaxEngine:
         pf = InflightPrefill(sampled=sampled, tok=tok, seq=seq, slot=seq.slot)
         self._pending_injects[seq.slot] = pf
         self._dev["tokens"] = inject_token(self._dev["tokens"], seq.slot, tok)
+        if self._dev.get("counts") is not None:
+            from .step import bump_counts
+
+            self._dev["counts"] = bump_counts(
+                self._dev["counts"],
+                jnp.asarray([seq.slot], jnp.int32), tok,
+            )
         self._steps += 1
         if tracing.collector.enabled:
             with tracing.span(
@@ -1584,6 +1597,12 @@ class JaxEngine:
         self._dev["tokens"] = inject_tokens(
             self._dev["tokens"], jnp.asarray(slots), sampled[:Bp, 0]
         )
+        if self._dev.get("counts") is not None:
+            from .step import bump_counts
+
+            self._dev["counts"] = bump_counts(
+                self._dev["counts"], jnp.asarray(slots), sampled[:Bp, 0]
+            )
         entries: List[InflightPrefill] = []
         for i, (seq, pl) in enumerate(items):
             pf = InflightPrefill(
@@ -1685,6 +1704,8 @@ class JaxEngine:
             "top_p": np.ones((G,), np.float32),
             "top_k": np.zeros((G,), np.int32),
             "seed": np.zeros((G,), np.uint32),
+            "freq": np.zeros((G,), np.float32),
+            "pres": np.zeros((G,), np.float32),
         }
         for i, b in enumerate(dirty):
             seq = sched.slots[b]
@@ -1709,6 +1730,8 @@ class JaxEngine:
                 rows["top_p"][i] = so.top_p if so.top_p is not None else 1.0
                 rows["top_k"][i] = so.top_k or 0
                 rows["seed"][i] = self._norm_seed(so)
+                rows["freq"][i] = so.frequency_penalty or 0.0
+                rows["pres"][i] = so.presence_penalty or 0.0
             self._limit_host[b] = limits[b]
         samp = d["sampling"]
         (
@@ -1722,6 +1745,8 @@ class JaxEngine:
             top_p,
             top_k,
             seed,
+            freq,
+            pres,
         ) = update_lanes(
             d["tokens"],
             d["seq_lens"],
@@ -1733,12 +1758,43 @@ class JaxEngine:
             samp.top_p,
             samp.top_k,
             samp.seed,
+            samp.freq,
+            samp.pres,
             jnp.asarray(slots),
             rows,
         )
         d["sampling"] = SamplingParams(
-            temperature=temp, top_p=top_p, top_k=top_k, seed=seed
+            temperature=temp, top_p=top_p, top_k=top_k, seed=seed,
+            freq=freq, pres=pres,
         )
+        # penalty histograms: zero the flushed lanes, then re-seed each
+        # penalized lane's row from its committed output history (a dirty
+        # flush can hit a mid-request lane -- growth revival, external KV;
+        # tokens of a still-uncommitted in-flight block are skipped, a
+        # bounded one-block skew on a rare path)
+        if d.get("counts") is not None and dirty:
+            from .step import seed_count_rows, zero_count_rows
+
+            d["counts"] = zero_count_rows(
+                d["counts"], jnp.asarray(np.asarray(dirty, np.int32))
+            )
+            for b in dirty:
+                seq = sched.slots[b]
+                if seq is None:
+                    continue
+                so = seq.sampling
+                if not (so.frequency_penalty or so.presence_penalty):
+                    continue
+                hist = self._output_tokens(seq)
+                if not hist:
+                    continue
+                pad = 1 << max(len(hist) - 1, 0).bit_length()
+                buf = np.zeros((pad,), np.int32)
+                buf[: len(hist)] = hist
+                d["counts"] = seed_count_rows(
+                    d["counts"], jnp.int32(b), jnp.asarray(buf),
+                    jnp.int32(len(hist)),
+                )
         # pending injects hold the real first token for lanes whose mirror
         # still has the placeholder; re-apply them on top of the row scatter
         # (batched: one scatter, not one dispatch per lane)
@@ -1756,6 +1812,17 @@ class JaxEngine:
         elif injects:
             d["tokens"] = inject_tokens(
                 d["tokens"],
+                jnp.asarray(np.asarray([b for b, _ in injects], np.int32)),
+                jnp.concatenate([s for _, s in injects]),
+            )
+        if injects and d.get("counts") is not None:
+            # the re-applied first tokens follow the same rule as their
+            # original injection: they are output, so they count (the lane
+            # was just zeroed+reseeded above, so exactly once)
+            from .step import bump_counts
+
+            d["counts"] = bump_counts(
+                d["counts"],
                 jnp.asarray(np.asarray([b for b, _ in injects], np.int32)),
                 jnp.concatenate([s for _, s in injects]),
             )
@@ -1840,6 +1907,36 @@ class JaxEngine:
         self._limit_host = limit
         sched.dirty_slots.clear()
 
+    def _output_tokens(self, seq: SeqState) -> List[int]:
+        """Full committed output history for penalty accounting: tokens
+        generated this life PLUS the tail that recompute preemption folded
+        into the prompt (the last ``prior_generated`` prompt entries are
+        previous lives' output -- vLLM keeps output_token_ids across
+        preemption; this reconstructs the same set)."""
+        folded = (
+            list(seq.prompt[len(seq.prompt) - seq.prior_generated:])
+            if seq.prior_generated
+            else []
+        )
+        return folded + self.sched._generated_tokens(seq)
+
+    def _counts_host(self) -> np.ndarray:
+        """Generated-token histograms rebuilt from scheduler state (lanes
+        with penalties only; other rows stay zero and are never read)."""
+        B = self.cfg.max_batch_size
+        V = self.model_cfg.vocab_size
+        counts = np.zeros((B, V), np.int32)
+        for b, seq in enumerate(self.sched.slots):
+            if seq is None:
+                continue
+            so = seq.sampling
+            if not (so.frequency_penalty or so.presence_penalty):
+                continue
+            toks = np.asarray(self._output_tokens(seq), np.int64)
+            if toks.size:
+                np.add.at(counts[b], toks, 1)
+        return counts
+
     def _dispatch_block(self) -> Optional["InflightBlock"]:
         """Enqueue one decode block; does not wait for results."""
         K = self.cfg.decode_block_size
@@ -1864,6 +1961,32 @@ class JaxEngine:
             s is not None and self._sampling_needs_filters(s.sampling)
             for s in self.sched.slots
         )
+        use_penalties = any(
+            s is not None
+            and (s.sampling.frequency_penalty or s.sampling.presence_penalty)
+            for s in self.sched.slots
+        )
+        if use_penalties and d.get("counts") is None:
+            d["counts"] = self._put_batch(self._counts_host())
+            # pending first tokens are device-only (not yet in committed
+            # history): fold them in so device and host views agree
+            pend = [
+                (slot, pf.tok)
+                for slot, pf in self._pending_injects.items()
+                if self.sched.slots[slot] is pf.seq
+            ]
+            if pend:
+                from .step import bump_counts
+
+                d["counts"] = bump_counts(
+                    d["counts"],
+                    jnp.asarray(
+                        np.asarray([p[0] for p in pend], np.int32)
+                    ),
+                    jnp.concatenate([p[1] for p in pend]),
+                )
+        elif not use_penalties:
+            d["counts"] = None  # free the 8MB-class buffer when unused
         (
             sampled,
             d["tokens"],
@@ -1871,6 +1994,7 @@ class JaxEngine:
             d["active"],
             self.kv.pages,
             self._rng,
+            counts_out,
         ) = decode_block(
             self.params,
             self.model_cfg,
@@ -1886,7 +2010,11 @@ class JaxEngine:
             K,
             use_filters,
             self._lp_top(self.sched.slots),
+            d.get("counts"),
+            use_penalties,
         )
+        if use_penalties:
+            d["counts"] = counts_out
         self._steps += 1
         try:
             sampled.copy_to_host_async()

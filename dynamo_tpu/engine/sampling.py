@@ -27,14 +27,21 @@ class SamplingParams(NamedTuple):
     # samples from fold_in(PRNGKey(seed), position), so its output depends
     # only on (seed, prompt) -- never on batchmates or block boundaries.
     seed: jax.Array = None  # [B] u32
+    # OpenAI frequency/presence penalties (0 = off); applied over
+    # generated-token histograms the decode block carries device-side
+    freq: jax.Array = None  # [B] f32
+    pres: jax.Array = None  # [B] f32
 
     @classmethod
-    def fill(cls, batch: int, temperature=0.0, top_p=1.0, top_k=0, seed=0):
+    def fill(cls, batch: int, temperature=0.0, top_p=1.0, top_k=0, seed=0,
+             freq=0.0, pres=0.0):
         return cls(
             temperature=jnp.full((batch,), temperature, jnp.float32),
             top_p=jnp.full((batch,), top_p, jnp.float32),
             top_k=jnp.full((batch,), top_k, jnp.int32),
             seed=jnp.full((batch,), seed, jnp.uint32),
+            freq=jnp.full((batch,), freq, jnp.float32),
+            pres=jnp.full((batch,), pres, jnp.float32),
         )
 
 
@@ -172,3 +179,21 @@ def unpack_sampled_logprobs(packed, top_n: int):
         else arr[..., 2 + top_n :].astype(np.float32)
     )
     return tokens, lps, top_ids, top_lps
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V] f32
+    counts: jax.Array,  # [B, V] i32 generated-token histogram per lane
+    freq: jax.Array,  # [B] f32 frequency_penalty
+    pres: jax.Array,  # [B] f32 presence_penalty
+) -> jax.Array:
+    """OpenAI frequency/presence penalties over GENERATED tokens (vLLM
+    semantics: the prompt does not count).  Subtracted from the raw
+    logits before temperature scaling, exactly the OpenAI formula:
+    ``logit - count*frequency_penalty - (count>0)*presence_penalty``."""
+    c = counts.astype(jnp.float32)
+    return (
+        logits
+        - freq[:, None] * c
+        - pres[:, None] * (c > 0).astype(jnp.float32)
+    )

@@ -26,6 +26,7 @@ from .config import ModelConfig
 from .model import Params, lm_logits, transformer
 from .sampling import (
     SamplingParams,
+    apply_penalties,
     pack_sampled_logprobs,
     sample_tokens,
     token_logprobs,
@@ -93,8 +94,9 @@ decode_step = partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pa
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "num_steps", "use_filters", "top_n"),
-    donate_argnames=("kv_pages",),
+    static_argnames=("cfg", "num_steps", "use_filters", "top_n",
+                     "use_penalties"),
+    donate_argnames=("kv_pages", "counts"),
 )
 def decode_block(
     params: Params,
@@ -111,7 +113,9 @@ def decode_block(
     num_steps: int,
     use_filters: bool = True,
     top_n: int = 0,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    counts: jax.Array = None,  # [B, V] i32 generated-token histograms
+    use_penalties: bool = False,
+) -> Tuple[jax.Array, ...]:
     """Run ``num_steps`` decode+sample iterations entirely on device.
 
     The TPU-native decode loop: ONE host dispatch and ONE device->host
@@ -135,16 +139,30 @@ def decode_block(
     for the next block.
     """
 
+    if counts is None:
+        # dummy carry so the scan signature is stable; never read
+        counts = jnp.zeros((tokens.shape[0], 1), jnp.int32)
+
     def live_step(carry):
-        tokens, seq_lens, active, rng, kv = carry
+        tokens, seq_lens, active, rng, kv, counts = carry
         logits, kv = _decode_once(params, cfg, kv, tokens, seq_lens, page_table)
         rng, sub = jax.random.split(rng)
+        if use_penalties:
+            # frequency/presence over the lane's generated-token histogram
+            # (raw logits; sample_tokens applies temperature after)
+            logits_s = apply_penalties(
+                logits, counts, sampling.freq, sampling.pres
+            )
+        else:
+            logits_s = logits
         # seeded lanes key their noise by the position being FILLED
         # (seq_lens + 1): distinct from the prefill-sampled first token's
         # key (= prompt length) and from every other step of the request
         sampled = sample_tokens(
-            logits, sub, sampling, use_filters, positions=seq_lens + 1
+            logits_s, sub, sampling, use_filters, positions=seq_lens + 1
         )
+        # logprobs report the RAW model distribution (protocol contract),
+        # penalties included only in what gets sampled
         lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
         hit_stop = jnp.any(sampled[:, None] == stop_ids, axis=1)
         emit = active & ~hit_stop  # stop tokens are swallowed, not emitted
@@ -153,7 +171,12 @@ def decode_block(
         new_tokens = jnp.where(emit, sampled, tokens)
         out = jnp.where(active, sampled, -1)  # -1 = lane was already dead
         packed = pack_sampled_logprobs(out, lp, top_ids, top_lps)
-        return (new_tokens, new_seq, new_active, rng, kv), packed
+        if use_penalties:
+            B = tokens.shape[0]
+            counts = counts.at[jnp.arange(B), sampled].add(
+                emit.astype(jnp.int32), mode="drop"
+            )
+        return (new_tokens, new_seq, new_active, rng, kv, counts), packed
 
     def dead_step(carry):
         # every lane is dead: skip the weight stream entirely.  Tail steps
@@ -168,11 +191,13 @@ def decode_block(
         active = carry[2]
         return jax.lax.cond(jnp.any(active), live_step, dead_step, carry)
 
-    (tokens, seq_lens, active, rng, kv_pages), packed = jax.lax.scan(
-        body, (tokens, seq_lens, active, rng, kv_pages), None, length=num_steps
+    (tokens, seq_lens, active, rng, kv_pages, counts), packed = jax.lax.scan(
+        body, (tokens, seq_lens, active, rng, kv_pages, counts), None,
+        length=num_steps,
     )
     return (
-        packed.transpose(1, 0, 2), tokens, seq_lens, active, kv_pages, rng
+        packed.transpose(1, 0, 2), tokens, seq_lens, active, kv_pages, rng,
+        counts,
     )
 
 
@@ -374,7 +399,7 @@ def inject_tokens(
     jax.jit,
     donate_argnames=(
         "tokens", "seq_lens", "limit_lens", "active", "stop_ids",
-        "page_table", "temp", "top_p", "top_k", "seed",
+        "page_table", "temp", "top_p", "top_k", "seed", "freq", "pres",
     ),
 )
 def update_lanes(
@@ -388,6 +413,8 @@ def update_lanes(
     top_p: jax.Array,  # [B]
     top_k: jax.Array,  # [B]
     seed: jax.Array,  # [B] u32
+    freq: jax.Array,  # [B] f32
+    pres: jax.Array,  # [B] f32
     slots: jax.Array,  # [G] lane indices; out-of-range rows are pad (dropped)
     rows: dict,  # stacked per-lane values: token [G], stop [G, E], pages [G, P], ...
 ) -> Tuple[jax.Array, ...]:
@@ -417,7 +444,40 @@ def update_lanes(
         top_p.at[slots].set(rows["top_p"], mode="drop"),
         top_k.at[slots].set(rows["top_k"], mode="drop"),
         seed.at[slots].set(rows["seed"], mode="drop"),
+        freq.at[slots].set(rows["freq"], mode="drop"),
+        pres.at[slots].set(rows["pres"], mode="drop"),
     )
+
+
+@partial(jax.jit, donate_argnames=("counts",))
+def zero_count_rows(counts: jax.Array, slots: jax.Array) -> jax.Array:
+    """Zero the generated-token histograms of re-assigned lanes (penalty
+    state; out-of-range pad slots drop)."""
+    return counts.at[slots].set(0, mode="drop")
+
+
+@partial(jax.jit, donate_argnames=("counts",))
+def bump_counts(
+    counts: jax.Array,  # [B, V]
+    slots: jax.Array,  # [G] lane indices (out-of-range pads drop)
+    toks: jax.Array,  # [G] token ids (device values fine)
+) -> jax.Array:
+    """Count injected first tokens into the penalty histograms: prefill-
+    sampled tokens never pass through the decode scan's own increment."""
+    return counts.at[slots, toks].add(1, mode="drop")
+
+
+@partial(jax.jit, donate_argnames=("counts",))
+def seed_count_rows(
+    counts: jax.Array,  # [B, V]
+    slot: jax.Array,  # scalar i32
+    toks: jax.Array,  # [Tpad] committed output tokens (pow2-padded)
+    length: jax.Array,  # scalar i32 valid prefix of toks
+) -> jax.Array:
+    """Rebuild one lane's histogram from its committed output history
+    (mid-request dirty flushes zero the row first; pad entries add 0)."""
+    add = (jnp.arange(toks.shape[0]) < length).astype(jnp.int32)
+    return counts.at[slot, toks].add(add, mode="drop")
 
 
 @partial(jax.jit, donate_argnames=("kv_pages",))

@@ -100,16 +100,10 @@ class SamplingFields:
             raise OpenAIError("'top_p' must be in (0, 1]")
         if out.max_tokens is not None and out.max_tokens < 1:
             raise OpenAIError("'max_tokens' must be >= 1")
-        # penalties are parsed for protocol compatibility but the engine
-        # does not apply them yet: a non-zero value must fail loudly, not
-        # silently sample unpenalized (PARITY.md)
         for fname in ("frequency_penalty", "presence_penalty"):
             v = getattr(out, fname)
-            if v:
-                raise OpenAIError(
-                    f"'{fname}' is not supported by this engine "
-                    f"(send 0 or omit it)"
-                )
+            if v is not None and not -2.0 <= v <= 2.0:
+                raise OpenAIError(f"'{fname}' must be in [-2, 2]")
         return out
 
 

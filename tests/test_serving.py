@@ -812,10 +812,9 @@ def test_completions_echo_prepends_prompt(model_dir, run):
     assert "echo" in err["error"]["message"]
 
 
-def test_nonzero_penalties_rejected_loudly(model_dir, run):
-    """frequency/presence penalties are protocol-parsed but engine-
-    unsupported: non-zero values 400 instead of silently sampling
-    unpenalized; zero/omitted passes."""
+def test_penalties_validated(model_dir, run):
+    """frequency/presence penalties: out-of-range 400s, in-range passes
+    through to the engine (applied there; see test_jax_engine)."""
 
     async def main():
         svc, engine = _build_service(model_dir)
@@ -825,12 +824,12 @@ def test_nonzero_penalties_rejected_loudly(model_dir, run):
             s1, _, err = await http_request(
                 host, port, "POST", "/v1/completions",
                 {"model": "mock-model", "prompt": "hi", "max_tokens": 2,
-                 "frequency_penalty": 0.5},
+                 "frequency_penalty": 3.5},
             )
             s2, _, ok = await http_request(
                 host, port, "POST", "/v1/completions",
                 {"model": "mock-model", "prompt": "hi", "max_tokens": 2,
-                 "frequency_penalty": 0.0, "presence_penalty": 0},
+                 "frequency_penalty": 0.5, "presence_penalty": 1.0},
             )
             return s1, err, s2, ok
         finally:
