@@ -237,7 +237,9 @@ def test_two_process_served_engine_matches_single(tmp_path):
             )
         )
     try:
-        outs = [p.communicate(timeout=300)[0] for p in procs]
+        # generous: a cold XLA-compile storm (2 processes x several fresh
+        # executables on one CI core) can take minutes before serving starts
+        outs = [p.communicate(timeout=900)[0] for p in procs]
     finally:
         for p in procs:
             p.kill()
