@@ -882,3 +882,59 @@ def test_penalty_history_survives_preemption(run):
     toks, hist = run(main())
     assert len(toks) == 6
     assert hist[:2] == [41, 42]  # folded output reconstructed as output
+
+
+def test_mixed_sampling_features_isolate(run):
+    """A batch mixing greedy, seeded sampling, top-k/top-p, logprobs, and
+    penalties: every request completes, and the greedy request's output is
+    bit-identical to running it alone -- no cross-lane contamination from
+    any feature's device state (filters flag, logprob packing, penalty
+    histograms, seeded gumbel)."""
+
+    async def main():
+        engine = make_engine()
+
+        async def greedy_alone():
+            eng2 = make_engine()
+            toks, _ = await collect(eng2, req([5, 6, 7, 8], max_tokens=10))
+            await eng2.stop()
+            return toks
+
+        solo = await greedy_alone()
+
+        async def one(opts, prompt, want_lp=False):
+            r = PreprocessedRequest(
+                token_ids=list(prompt),
+                stop_conditions=StopConditions(max_tokens=10, ignore_eos=True),
+                sampling_options=opts,
+            )
+            stream = await engine.generate(Context.new(r))
+            toks, lps = [], []
+            async for item in stream:
+                d = item.data or {}
+                assert not item.is_error(), item.error_message()
+                toks.extend(d.get("token_ids") or [])
+                lps.extend(d.get("logprobs") or [])
+            if want_lp:
+                assert len(lps) == len(toks)
+            return toks
+
+        import asyncio as _a
+
+        results = await _a.gather(
+            one(SamplingOptions(temperature=0.0), (5, 6, 7, 8)),
+            one(SamplingOptions(temperature=1.0, seed=42), (1, 2)),
+            one(SamplingOptions(temperature=0.9, top_k=5, top_p=0.9,
+                                seed=7), (3, 4, 5)),
+            one(SamplingOptions(temperature=0.0, logprobs=3), (9, 10),
+                want_lp=True),
+            one(SamplingOptions(temperature=1.0, seed=11,
+                                frequency_penalty=1.5,
+                                presence_penalty=0.5), (11, 12, 13)),
+        )
+        await engine.stop()
+        return solo, results
+
+    solo, results = run(main())
+    assert all(len(t) == 10 for t in results)
+    assert results[0] == solo  # greedy untouched by any batchmate feature
