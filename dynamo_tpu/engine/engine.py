@@ -404,6 +404,18 @@ class JaxEngine:
             )
         ctx = request.ctx
         try:
+            if self._seq_penalized(seq) and self.cfg.max_seq_len >= (
+                1 << 15
+            ):
+                # packed-histogram bound (sampling.PROMPT_FLAG): prompt
+                # occurrences accumulate FLAG each, so max_seq_len must
+                # stay below 2^15 or the int32 packing can overflow --
+                # fail the request loudly instead of sampling from a
+                # silently corrupted penalty state
+                raise ValueError(
+                    "sampling penalties are unavailable at max_seq_len "
+                    f">= 32768 (engine max_seq_len {self.cfg.max_seq_len})"
+                )
             self.sched.enqueue(seq)
         except ValueError as e:
             # surface as an error item, matching the remote prologue-error path
@@ -1155,6 +1167,7 @@ class JaxEngine:
         seed = np.zeros((n,), np.uint32)
         freq = np.zeros((n,), np.float32)
         pres = np.zeros((n,), np.float32)
+        rep = np.ones((n,), np.float32)
         for i, s in enumerate(seqs):
             if s is None:
                 continue
@@ -1170,6 +1183,7 @@ class JaxEngine:
             seed[i] = self._norm_seed(so)
             freq[i] = so.frequency_penalty or 0.0
             pres[i] = so.presence_penalty or 0.0
+            rep[i] = so.repetition_penalty or 1.0
         return SamplingParams(
             temperature=self._put_batch(temp),
             top_p=self._put_batch(top_p),
@@ -1177,6 +1191,7 @@ class JaxEngine:
             seed=self._put_batch(seed),
             freq=self._put_batch(freq),
             pres=self._put_batch(pres),
+            rep=self._put_batch(rep),
         )
 
     @staticmethod
@@ -1270,6 +1285,7 @@ class JaxEngine:
             self._next_rng(),
             self._sampling_arrays(seqs),
             self._lp_top(seqs),
+            any(s is not None and self._seq_penalized(s) for s in seqs),
         )
         return sampled
 
@@ -1311,6 +1327,7 @@ class JaxEngine:
             self._next_rng(),
             self._sampling_arrays(seqs),
             self._lp_top(seqs),
+            any(s is not None and self._seq_penalized(s) for s in seqs),
         )
         return sampled
 
@@ -1419,6 +1436,7 @@ class JaxEngine:
             self._next_rng(),
             self._sampling_arrays(seqs),
             self._lp_top(seqs),
+            any(s is not None and self._seq_penalized(s) for s in seqs),
         )
         return sampled
 
@@ -1706,6 +1724,7 @@ class JaxEngine:
             "seed": np.zeros((G,), np.uint32),
             "freq": np.zeros((G,), np.float32),
             "pres": np.zeros((G,), np.float32),
+            "rep": np.ones((G,), np.float32),
         }
         for i, b in enumerate(dirty):
             seq = sched.slots[b]
@@ -1732,6 +1751,7 @@ class JaxEngine:
                 rows["seed"][i] = self._norm_seed(so)
                 rows["freq"][i] = so.frequency_penalty or 0.0
                 rows["pres"][i] = so.presence_penalty or 0.0
+                rows["rep"][i] = so.repetition_penalty or 1.0
             self._limit_host[b] = limits[b]
         samp = d["sampling"]
         (
@@ -1747,6 +1767,7 @@ class JaxEngine:
             seed,
             freq,
             pres,
+            rep,
         ) = update_lanes(
             d["tokens"],
             d["seq_lens"],
@@ -1760,12 +1781,13 @@ class JaxEngine:
             samp.seed,
             samp.freq,
             samp.pres,
+            samp.rep,
             jnp.asarray(slots),
             rows,
         )
         d["sampling"] = SamplingParams(
             temperature=temp, top_p=top_p, top_k=top_k, seed=seed,
-            freq=freq, pres=pres,
+            freq=freq, pres=pres, rep=rep,
         )
         # penalty histograms: zero the flushed lanes, then re-seed each
         # penalized lane's row from its committed output history (a dirty
@@ -1780,20 +1802,19 @@ class JaxEngine:
             )
             for b in dirty:
                 seq = sched.slots[b]
-                if seq is None:
+                if seq is None or not self._seq_penalized(seq):
                     continue
-                so = seq.sampling
-                if not (so.frequency_penalty or so.presence_penalty):
+                toks, amts = self._penalty_history(seq)
+                if not toks:
                     continue
-                hist = self._output_tokens(seq)
-                if not hist:
-                    continue
-                pad = 1 << max(len(hist) - 1, 0).bit_length()
+                pad = 1 << max(len(toks) - 1, 0).bit_length()
                 buf = np.zeros((pad,), np.int32)
-                buf[: len(hist)] = hist
+                amounts = np.zeros((pad,), np.int32)
+                buf[: len(toks)] = toks
+                amounts[: len(toks)] = amts
                 d["counts"] = seed_count_rows(
                     d["counts"], jnp.int32(b), jnp.asarray(buf),
-                    jnp.int32(len(hist)),
+                    jnp.asarray(amounts),
                 )
         # pending injects hold the real first token for lanes whose mirror
         # still has the placeholder; re-apply them on top of the row scatter
@@ -1920,6 +1941,28 @@ class JaxEngine:
         )
         return folded + self.sched._generated_tokens(seq)
 
+    @staticmethod
+    def _seq_penalized(seq: SeqState) -> bool:
+        so = seq.sampling
+        return bool(
+            so.frequency_penalty
+            or so.presence_penalty
+            or (so.repetition_penalty and so.repetition_penalty != 1.0)
+        )
+
+    def _penalty_history(self, seq: SeqState):
+        """(tokens, amounts) for the packed histogram: committed output
+        occurrences count 1, prompt-proper occurrences add PROMPT_FLAG
+        (the prompt tail of length prior_generated is folded OUTPUT from
+        recompute preemption, not prompt -- the single home of that
+        invariant for both the device reseed and the host rebuild)."""
+        from .sampling import PROMPT_FLAG
+
+        out = self._output_tokens(seq)
+        plen = len(seq.prompt) - seq.prior_generated
+        ptoks = list(seq.prompt[:plen])
+        return out + ptoks, [1] * len(out) + [PROMPT_FLAG] * len(ptoks)
+
     def _counts_host(self) -> np.ndarray:
         """Generated-token histograms rebuilt from scheduler state (lanes
         with penalties only; other rows stay zero and are never read)."""
@@ -1927,14 +1970,14 @@ class JaxEngine:
         V = self.model_cfg.vocab_size
         counts = np.zeros((B, V), np.int32)
         for b, seq in enumerate(self.sched.slots):
-            if seq is None:
+            if seq is None or not self._seq_penalized(seq):
                 continue
-            so = seq.sampling
-            if not (so.frequency_penalty or so.presence_penalty):
-                continue
-            toks = np.asarray(self._output_tokens(seq), np.int64)
-            if toks.size:
-                np.add.at(counts[b], toks, 1)
+            toks, amounts = self._penalty_history(seq)
+            if toks:
+                np.add.at(
+                    counts[b], np.asarray(toks, np.int64),
+                    np.asarray(amounts, np.int64),
+                )
         return counts
 
     def _dispatch_block(self) -> Optional["InflightBlock"]:
@@ -1962,9 +2005,7 @@ class JaxEngine:
             for s in self.sched.slots
         )
         use_penalties = any(
-            s is not None
-            and (s.sampling.frequency_penalty or s.sampling.presence_penalty)
-            for s in self.sched.slots
+            s is not None and self._seq_penalized(s) for s in self.sched.slots
         )
         if use_penalties and d.get("counts") is None:
             d["counts"] = self._put_batch(self._counts_host())

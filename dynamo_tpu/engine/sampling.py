@@ -31,10 +31,12 @@ class SamplingParams(NamedTuple):
     # generated-token histograms the decode block carries device-side
     freq: jax.Array = None  # [B] f32
     pres: jax.Array = None  # [B] f32
+    # HF repetition_penalty (1 = off); applies to prompt AND output tokens
+    rep: jax.Array = None  # [B] f32
 
     @classmethod
     def fill(cls, batch: int, temperature=0.0, top_p=1.0, top_k=0, seed=0,
-             freq=0.0, pres=0.0):
+             freq=0.0, pres=0.0, rep=1.0):
         return cls(
             temperature=jnp.full((batch,), temperature, jnp.float32),
             top_p=jnp.full((batch,), top_p, jnp.float32),
@@ -42,6 +44,7 @@ class SamplingParams(NamedTuple):
             seed=jnp.full((batch,), seed, jnp.uint32),
             freq=jnp.full((batch,), freq, jnp.float32),
             pres=jnp.full((batch,), pres, jnp.float32),
+            rep=jnp.full((batch,), rep, jnp.float32),
         )
 
 
@@ -181,19 +184,35 @@ def unpack_sampled_logprobs(packed, top_n: int):
     return tokens, lps, top_ids, top_lps
 
 
+# Penalty histograms pack two facts into ONE [B, V] int32 buffer so the
+# engine maintains a single device state: the low 16 bits count GENERATED
+# occurrences (frequency/presence, vLLM output-only semantics) and each
+# PROMPT occurrence adds PROMPT_FLAG (repetition penalty sees prompt +
+# output, HF semantics).  Bounds: prompts <= a few thousand tokens and
+# outputs < 65536, so neither field overflows into the other.
+PROMPT_FLAG = 1 << 16
+
+
 def apply_penalties(
     logits: jax.Array,  # [B, V] f32
-    counts: jax.Array,  # [B, V] i32 generated-token histogram per lane
+    counts: jax.Array,  # [B, V] i32 packed histogram (see PROMPT_FLAG)
     freq: jax.Array,  # [B] f32 frequency_penalty
     pres: jax.Array,  # [B] f32 presence_penalty
+    rep: jax.Array = None,  # [B] f32 repetition_penalty (1 = off)
 ) -> jax.Array:
-    """OpenAI frequency/presence penalties over GENERATED tokens (vLLM
-    semantics: the prompt does not count).  Subtracted from the raw
-    logits before temperature scaling, exactly the OpenAI formula:
-    ``logit - count*frequency_penalty - (count>0)*presence_penalty``."""
-    c = counts.astype(jnp.float32)
+    """OpenAI frequency/presence penalties (generated tokens only) plus HF
+    repetition_penalty (prompt + output), applied to the raw logits before
+    temperature scaling:
+    ``l' = l/rep if seen and l>0 else l*rep if seen else l``
+    then ``l' - out_count*frequency_penalty - (out_count>0)*presence``."""
+    out_count = (counts % PROMPT_FLAG).astype(jnp.float32)
+    if rep is not None:
+        seen = counts > 0  # any prompt or output occurrence
+        r = rep[:, None]
+        rep_applied = jnp.where(logits > 0, logits / r, logits * r)
+        logits = jnp.where(seen, rep_applied, logits)
     return (
         logits
-        - freq[:, None] * c
-        - pres[:, None] * (c > 0).astype(jnp.float32)
+        - freq[:, None] * out_count
+        - pres[:, None] * (out_count > 0).astype(jnp.float32)
     )

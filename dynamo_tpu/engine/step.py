@@ -25,12 +25,36 @@ from . import attention as att
 from .config import ModelConfig
 from .model import Params, lm_logits, transformer
 from .sampling import (
+    PROMPT_FLAG,
     SamplingParams,
     apply_penalties,
     pack_sampled_logprobs,
     sample_tokens,
     token_logprobs,
 )
+
+
+def _prompt_penalized_logits(
+    logits: jax.Array,  # [B, V]
+    tokens: jax.Array,  # [B, T] the tokens this dispatch carries
+    seq_lens: jax.Array,  # [B] valid lengths
+    sampling: SamplingParams,
+) -> jax.Array:
+    """Repetition-penalize first-token logits over the dispatch's own
+    prompt tokens (HF semantics penalize the prompt from the very first
+    sample; frequency/presence are output-only and out_count stays 0
+    here, so the shared apply_penalties call leaves them inert).  A
+    suffix-prefill dispatch carries only the suffix, so a cached prefix
+    is not penalized for this ONE token -- the decode histogram covers
+    every later step exactly."""
+    B, T = tokens.shape
+    valid = (jnp.arange(T)[None, :] < seq_lens[:, None]).astype(jnp.int32)
+    seen = jnp.zeros(logits.shape, jnp.int32).at[
+        jnp.arange(B)[:, None], tokens
+    ].add(valid * PROMPT_FLAG, mode="drop")
+    return apply_penalties(
+        logits, seen, sampling.freq, sampling.pres, sampling.rep
+    )
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pages",))
@@ -151,7 +175,7 @@ def decode_block(
             # frequency/presence over the lane's generated-token histogram
             # (raw logits; sample_tokens applies temperature after)
             logits_s = apply_penalties(
-                logits, counts, sampling.freq, sampling.pres
+                logits, counts, sampling.freq, sampling.pres, sampling.rep
             )
         else:
             logits_s = logits
@@ -215,17 +239,21 @@ def sample_step_packed(
     params: SamplingParams,
     top_n: int = 0,
     positions=None,  # [B] i32: step identity for per-request seeds
+    sample_logits=None,  # penalized logits to SAMPLE from (logprobs
+    # always report the raw model distribution in ``logits``)
 ) -> jax.Array:
     """Sample + logprob packing: [B, 2 + 2*top_n] int32 (token | chosen
     logprob bits | top ids | top logprob bits) -- the layout every engine
     sampling site shares (sampling.pack_sampled_logprobs)."""
-    sampled = sample_tokens(logits, rng, params, positions=positions)
+    src = logits if sample_logits is None else sample_logits
+    sampled = sample_tokens(src, rng, params, positions=positions)
     lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
     return pack_sampled_logprobs(sampled, lp, top_ids, top_lps)
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "top_n"), donate_argnames=("kv_pages",)
+    jax.jit, static_argnames=("cfg", "top_n", "use_penalties"),
+    donate_argnames=("kv_pages",),
 )
 def prefill_and_sample(
     params: Params,
@@ -237,6 +265,7 @@ def prefill_and_sample(
     rng: jax.Array,
     sampling: SamplingParams,
     top_n: int = 0,
+    use_penalties: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Prefill + first-token sampling fused into one dispatch.
 
@@ -245,14 +274,23 @@ def prefill_and_sample(
     token can be injected into the decode state without a host round trip
     (engine._do_prefill)."""
     logits, kv_pages = prefill_step(params, cfg, kv_pages, tokens, seq_lens, page_table)
+    pen = (
+        _prompt_penalized_logits(logits, tokens, seq_lens, sampling)
+        if use_penalties
+        else None
+    )
     return (
-        sample_step_packed(logits, rng, sampling, top_n, positions=seq_lens),
+        sample_step_packed(
+            logits, rng, sampling, top_n, positions=seq_lens,
+            sample_logits=pen,
+        ),
         kv_pages,
     )
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "top_n"), donate_argnames=("kv_pages",)
+    jax.jit, static_argnames=("cfg", "top_n", "use_penalties"),
+    donate_argnames=("kv_pages",),
 )
 def prefill_mm_and_sample(
     params: Params,
@@ -266,6 +304,7 @@ def prefill_mm_and_sample(
     rng: jax.Array,
     sampling: SamplingParams,
     top_n: int = 0,
+    use_penalties: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Multimodal prefill: llava-style soft-prompt injection over the first
     ``mm_len`` positions, then the standard causal prefill + sample.  A
@@ -289,14 +328,23 @@ def prefill_mm_and_sample(
     last = jnp.clip(seq_lens - 1, 0, T - 1)
     hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
     logits = lm_logits(params, cfg, hidden_last)
+    pen = (
+        _prompt_penalized_logits(logits, tokens, seq_lens, sampling)
+        if use_penalties
+        else None
+    )
     return (
-        sample_step_packed(logits, rng, sampling, top_n, positions=seq_lens),
+        sample_step_packed(
+            logits, rng, sampling, top_n, positions=seq_lens,
+            sample_logits=pen,
+        ),
         kv_pages,
     )
 
 
 @partial(
-    jax.jit, static_argnames=("cfg", "top_n"), donate_argnames=("kv_pages",)
+    jax.jit, static_argnames=("cfg", "top_n", "use_penalties"),
+    donate_argnames=("kv_pages",),
 )
 def prefill_suffix_and_sample(
     params: Params,
@@ -310,6 +358,7 @@ def prefill_suffix_and_sample(
     rng: jax.Array,
     sampling: SamplingParams,
     top_n: int = 0,
+    use_penalties: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Prefix-cache restart: prefill only the suffix, attending to the
     resident prefix pages; sample the first token (engine-side prefix reuse,
@@ -331,9 +380,15 @@ def prefill_suffix_and_sample(
     last = jnp.clip(suffix_lens - 1, 0, T - 1)
     hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
     logits = lm_logits(params, cfg, hidden_last)
+    pen = (
+        _prompt_penalized_logits(logits, tokens, suffix_lens, sampling)
+        if use_penalties
+        else None
+    )
     return (
         sample_step_packed(
-            logits, rng, sampling, top_n, positions=offset + suffix_lens
+            logits, rng, sampling, top_n, positions=offset + suffix_lens,
+            sample_logits=pen,
         ),
         kv_pages,
     )
@@ -400,6 +455,7 @@ def inject_tokens(
     donate_argnames=(
         "tokens", "seq_lens", "limit_lens", "active", "stop_ids",
         "page_table", "temp", "top_p", "top_k", "seed", "freq", "pres",
+        "rep",
     ),
 )
 def update_lanes(
@@ -415,6 +471,7 @@ def update_lanes(
     seed: jax.Array,  # [B] u32
     freq: jax.Array,  # [B] f32
     pres: jax.Array,  # [B] f32
+    rep: jax.Array,  # [B] f32
     slots: jax.Array,  # [G] lane indices; out-of-range rows are pad (dropped)
     rows: dict,  # stacked per-lane values: token [G], stop [G, E], pages [G, P], ...
 ) -> Tuple[jax.Array, ...]:
@@ -446,6 +503,7 @@ def update_lanes(
         seed.at[slots].set(rows["seed"], mode="drop"),
         freq.at[slots].set(rows["freq"], mode="drop"),
         pres.at[slots].set(rows["pres"], mode="drop"),
+        rep.at[slots].set(rows["rep"], mode="drop"),
     )
 
 
@@ -471,13 +529,13 @@ def bump_counts(
 def seed_count_rows(
     counts: jax.Array,  # [B, V]
     slot: jax.Array,  # scalar i32
-    toks: jax.Array,  # [Tpad] committed output tokens (pow2-padded)
-    length: jax.Array,  # scalar i32 valid prefix of toks
+    toks: jax.Array,  # [Tpad] history tokens (pow2-padded)
+    amounts: jax.Array,  # [Tpad] i32 per-token increment (0 = pad;
+    # 1 = generated occurrence; PROMPT_FLAG = prompt occurrence)
 ) -> jax.Array:
-    """Rebuild one lane's histogram from its committed output history
-    (mid-request dirty flushes zero the row first; pad entries add 0)."""
-    add = (jnp.arange(toks.shape[0]) < length).astype(jnp.int32)
-    return counts.at[slot, toks].add(add, mode="drop")
+    """Rebuild one lane's packed histogram from its prompt + committed
+    output history (mid-request dirty flushes zero the row first)."""
+    return counts.at[slot, toks].add(amounts, mode="drop")
 
 
 @partial(jax.jit, donate_argnames=("kv_pages",))

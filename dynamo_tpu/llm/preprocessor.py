@@ -117,6 +117,7 @@ class OpenAIPreprocessor(Operator):
                 top_k=s.top_k,
                 frequency_penalty=s.frequency_penalty,
                 presence_penalty=s.presence_penalty,
+                repetition_penalty=s.repetition_penalty,
                 seed=s.seed,
                 logprobs=s.logprobs,
             ),

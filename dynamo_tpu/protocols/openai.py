@@ -71,6 +71,8 @@ class SamplingFields:
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     ignore_eos: bool = False
+    # HF-style repetition penalty (nvext field, reference SamplingOptions)
+    repetition_penalty: Optional[float] = None
     # normalized logprobs request: None = off, 0 = chosen-token only,
     # N > 0 = chosen + top-N alternatives (clamped to 8, PARITY.md)
     logprobs: Optional[int] = None
@@ -92,6 +94,9 @@ class SamplingFields:
             frequency_penalty=d.get("frequency_penalty"),
             presence_penalty=d.get("presence_penalty"),
             ignore_eos=bool(d.get("ignore_eos", nvext.get("ignore_eos", False))),
+            repetition_penalty=d.get(
+                "repetition_penalty", nvext.get("repetition_penalty")
+            ),
             logprobs=_parse_logprobs(d, chat),
         )
         if out.temperature is not None and not 0.0 <= out.temperature <= 2.0:
@@ -104,6 +109,8 @@ class SamplingFields:
             v = getattr(out, fname)
             if v is not None and not -2.0 <= v <= 2.0:
                 raise OpenAIError(f"'{fname}' must be in [-2, 2]")
+        if out.repetition_penalty is not None and out.repetition_penalty <= 0:
+            raise OpenAIError("'repetition_penalty' must be > 0")
         return out
 
 

@@ -938,3 +938,67 @@ def test_mixed_sampling_features_isolate(run):
     solo, results = run(main())
     assert all(len(t) == 10 for t in results)
     assert results[0] == solo  # greedy untouched by any batchmate feature
+
+
+def test_apply_penalties_formula():
+    """Unit math vs the OpenAI + HF formulas on a packed histogram:
+    prompt-only tokens feel repetition but NOT frequency/presence
+    (output-only semantics); generated tokens feel all three."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine.sampling import PROMPT_FLAG, apply_penalties
+
+    logits = jnp.asarray([[2.0, -1.0, 0.5, 3.0]], jnp.float32)
+    counts = jnp.asarray(
+        [[PROMPT_FLAG, 2, 0, PROMPT_FLAG + 1]], jnp.int32
+    )  # tok0: prompt-only; tok1: generated x2; tok2: unseen; tok3: both
+    freq = jnp.asarray([0.5], jnp.float32)
+    pres = jnp.asarray([0.25], jnp.float32)
+    rep = jnp.asarray([2.0], jnp.float32)
+    got = np.asarray(apply_penalties(logits, counts, freq, pres, rep))[0]
+    # tok0: rep only (logit>0 -> /2), no freq/pres (out_count 0)
+    assert abs(got[0] - 1.0) < 1e-6
+    # tok1: rep (logit<0 -> *2), freq 2*0.5, pres 0.25
+    assert abs(got[1] - (-2.0 - 1.0 - 0.25)) < 1e-6
+    # tok2: untouched
+    assert abs(got[2] - 0.5) < 1e-6
+    # tok3: rep (/2), freq 1*0.5, pres 0.25
+    assert abs(got[3] - (1.5 - 0.5 - 0.25)) < 1e-6
+
+
+def test_repetition_penalty_changes_output_rep1_noop(run):
+    """rep=1.0 is bit-identical to no penalty; a strong rep (prompt tokens
+    included, HF semantics) changes greedy output."""
+
+    async def main():
+        engine = make_engine()
+
+        async def one(rp):
+            r = PreprocessedRequest(
+                token_ids=[1, 2, 3, 4],
+                stop_conditions=StopConditions(max_tokens=10, ignore_eos=True),
+                sampling_options=SamplingOptions(
+                    temperature=0.0, repetition_penalty=rp
+                ),
+            )
+            stream = await engine.generate(Context.new(r))
+            toks = []
+            async for item in stream:
+                toks.extend((item.data or {}).get("token_ids") or [])
+            return toks
+
+        base = await one(None)
+        noop = await one(1.0)
+        strong = await one(50.0)
+        await engine.stop()
+        return base, noop, strong
+
+    base, noop, strong = run(main())
+    assert len(base) == 10
+    assert noop == base
+    assert strong != base
+    # a crushing rep forbids re-sampling anything seen -- including the
+    # PROMPT tokens for the very first (prefill-sampled) token
+    assert len(set(strong)) == 10
+    assert strong[0] not in (1, 2, 3, 4)
