@@ -766,3 +766,64 @@ def test_reconnect_window_exhausted_fails_loudly(run, tmp_path):
         await client.close()
 
     run(body())
+
+
+def test_hub_async_compaction_and_failed_rotation_merge(run, tmp_path):
+    """The production compaction path: (1) crossing compact_every on a
+    LIVE hub triggers the off-loop snapshot (capture + rotate on-loop,
+    write in a thread) without losing any mutation; (2) a leftover
+    wal.old from a failed compaction is MERGED on the next rotation,
+    never clobbered -- both proven by restart-restore."""
+    import os
+
+    from dynamo_tpu.runtime.transports.hub import HubJournal
+
+    async def body():
+        d = str(tmp_path / "hub")
+        server = HubServer(port=0, data_dir=d)
+        server.journal.compact_every = 8  # tiny threshold for the test
+        host, port = await server.start()
+        client = await HubClient(host, port).connect()
+        for i in range(30):  # crosses the threshold several times
+            await client.kv_put(f"k/{i:02d}", str(i).encode())
+        await client.queue_push("q", b"item")
+        # let the background snapshot writes land
+        for _ in range(100):
+            if not server.journal._compacting:
+                break
+            await asyncio.sleep(0.05)
+        assert os.path.exists(server.journal.snap_path)
+        await client.close()
+        await server.stop()
+
+        server2 = HubServer(port=0, data_dir=d)
+        host2, port2 = await server2.start()
+        c2 = await HubClient(host2, port2).connect()
+        got = dict(await c2.kv_get_prefix("k/"))
+        assert len(got) == 30 and got["k/29"] == b"29"
+        assert await c2.queue_pop("q", block=False) == b"item"
+        await c2.close()
+        await server2.stop()
+
+        # (2) simulate a failed compaction: a wal.old holding committed
+        # records that no snapshot covers, then force another rotation
+        j = HubJournal(d, compact_every=4)
+        with open(j.wal_old_path, "wb") as f:
+            j._write_record(f, {"op": "kv_put", "key": "orphan/a",
+                                "lease": 0}, b"precious")
+        j.open()
+        j._write_record(j._wal, {"op": "kv_put", "key": "fresh/b",
+                                 "lease": 0}, b"new")
+        j._wal.flush()
+        j._rotate_wal()  # must MERGE, not clobber
+        j.close()
+        from dynamo_tpu.runtime.transports.hub import HubState
+
+        st = HubState()
+        HubJournal(d).load_into(st)
+        keys = {e.key for e in st.kv_get_prefix("")}
+        assert "orphan/a" in keys, "failed-compaction segment was clobbered"
+        assert "fresh/b" in keys
+        assert st.kv["orphan/a"].value == b"precious"
+
+    run(body())
