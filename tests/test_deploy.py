@@ -299,3 +299,39 @@ def test_operator_pinned_decode_repaired(tmp_path):
         assert _k8s_state(state)["g2-decode"] == 3
 
     asyncio.run(body())
+
+
+def test_operator_cli_once(tmp_path):
+    """`dynamo-tpu operator --once` end to end: hub + record + fake
+    kubectl, one reconcile round creates the children and exits 0."""
+    import asyncio
+    import json as _json
+
+    from dynamo_tpu.cli import build_parser, run_operator
+    from dynamo_tpu.runtime.transports.hub import HubServer
+
+    kubectl, state = _fake_kubectl_full(tmp_path)
+
+    async def body():
+        server = HubServer(port=0)
+        host, port = await server.start()
+        from dynamo_tpu.runtime.transports.client import HubClient
+
+        c = await HubClient(host, port).connect()
+        await c.kv_put(
+            "apistore/deployments/gcli",
+            _json.dumps({"name": "gcli", "spec": {"model_path": "/m"}}).encode(),
+        )
+        await c.close()
+        args = build_parser().parse_args(
+            ["operator", "--hub", f"{host}:{port}", "--kubectl", str(kubectl),
+             "--once"]
+        )
+        rc = await run_operator(args)
+        await server.stop()
+        return rc
+
+    rc = asyncio.run(body())
+    assert rc == 0
+    st = _k8s_state(state)
+    assert st["gcli-decode"] == 1 and st["gcli-frontend"] == 1

@@ -839,3 +839,52 @@ def test_penalties_validated(model_dir, run):
     s1, err, s2, ok = run(main())
     assert s1 == 400 and "frequency_penalty" in err["error"]["message"]
     assert s2 == 200 and ok["choices"][0]["finish_reason"]
+
+
+def test_http_logprobs_streaming_chunks(model_dir, run):
+    """Streamed SSE chunks carry per-chunk logprobs structures (not just
+    the aggregate): chat delta chunks hold logprobs.content entries
+    aligned with their delta."""
+    import json as _json
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+
+    async def main():
+        tok = Tokenizer.from_model_dir(model_dir)
+        engine = JaxEngine.random_init(
+            ModelConfig.tiny(vocab_size=512),
+            EngineConfig(max_batch_size=2, max_seq_len=64, page_size=4,
+                         num_pages=64),
+        )
+        name = "lps"
+        pipeline = link(OpenAIPreprocessor(name, tok), Backend(tok), engine)
+        svc = HttpService()
+        svc.manager.add_chat_model(name, pipeline)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, body = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {"model": name,
+                 "messages": [{"role": "user", "content": "hi"}],
+                 "max_tokens": 5, "temperature": 0, "stream": True,
+                 "logprobs": True, "top_logprobs": 1},
+                stream=True,
+            )
+            return status, body
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    status, payloads = run(main())
+    assert status == 200
+    chunks = [c for c in payloads if isinstance(c, dict)]
+    entries = []
+    for ch in chunks:
+        lp = (ch["choices"][0] or {}).get("logprobs")
+        if lp and lp.get("content"):
+            entries.extend(lp["content"])
+    assert len(entries) == 5
+    for e in entries:
+        assert e["logprob"] <= 0.0 and isinstance(e["bytes"], list)
+        assert len(e["top_logprobs"]) == 1
