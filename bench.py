@@ -95,7 +95,11 @@ async def run_disagg(rs, allow_local: bool = True):
 
     ``allow_local`` selects the delivery leg: True takes the same-process
     device-resident handoff (NIXL-DMA analog), False forces the chunked
-    wire upload.  Returns (decode tok/s, transfer stats)."""
+    wire upload -- layer-group chunks stream onto the wire as they
+    materialize (engine.prefill_export_batch_stream), so ``export_ms`` is
+    export-BEFORE-FIRST-BYTE, ``export_total_ms`` the full materialize,
+    and ``overlap_ratio`` the fraction of export that overlapped transfer.
+    Returns (decode tok/s, transfer stats)."""
     from dynamo_tpu.llm.disagg import (
         KV_DELIVER_ENDPOINT,
         DisaggConfig,
@@ -426,7 +430,16 @@ async def main():
                 "disagg_wire_tok_s": round(disagg_wire_tok_s, 2),
                 "disagg_transfer_ms_p50": wire_stats.get("deliver_ms_p50"),
                 "disagg_transfer_bytes_p50": wire_stats.get("bytes_p50"),
+                # export-before-first-byte of the chunked pipeline (the
+                # legacy monolithic path reported whole-blob materialize
+                # here -- 431 ms p50 in BENCH_r05)
                 "disagg_export_ms_p50": wire_stats.get("export_ms_p50"),
+                "disagg_export_total_ms_p50": wire_stats.get(
+                    "export_total_ms_p50"
+                ),
+                "disagg_chunk_overlap_ratio": wire_stats.get(
+                    "overlap_ratio_p50"
+                ),
                 "decode_tok_s_int8": round(int8_tok_s, 2),
                 "est_hbm_util_v5e": round(util, 4),
                 "param_bytes": pbytes,

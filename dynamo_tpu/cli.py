@@ -121,6 +121,16 @@ def build_parser() -> argparse.ArgumentParser:
                      help="serve as a disaggregated decode or prefill worker")
     run.add_argument("--max-local-prefill-length", type=int, default=512)
     run.add_argument("--max-prefill-queue-depth", type=int, default=16)
+    run.add_argument(
+        "--kv-chunk-layers", type=int, default=None,
+        help="layers per chunk for the streamed KV export (prefill "
+             "workers; default splits the stack into ~8 groups)",
+    )
+    run.add_argument(
+        "--no-chunked-kv", action="store_true",
+        help="legacy monolithic KV export/upload (disables the pipelined "
+             "chunked transfer path)",
+    )
 
     # standalone hub (the control plane process; k8s hub Deployment)
     hub = sub.add_parser("hub", help="run a standalone hub server")
@@ -525,7 +535,11 @@ async def run_worker(args) -> None:
         # queue consumer only: no generate endpoint, no model registration
         from .llm.disagg import PrefillWorker
 
-        prefill_worker = PrefillWorker(engine, ns)
+        prefill_worker = PrefillWorker(
+            engine, ns,
+            chunked=not args.no_chunked_kv,
+            layers_per_chunk=args.kv_chunk_layers,
+        )
         await prefill_worker.start()
         print(f"prefill worker consuming {ns_name}_prefill_queue (hub {addr})")
     elif args.disagg == "decode":
@@ -1070,6 +1084,7 @@ def run_eval(args) -> int:
     would (incl. --quantize int8), score with llm/evaluate.py, print one
     JSON line."""
     import json as _json
+    import os
 
     from .engine.config import ModelConfig
     from .engine.weights import load_safetensors_params
@@ -1080,7 +1095,22 @@ def run_eval(args) -> int:
         raise SystemExit("need --text or --text-file")
     text = args.text or open(args.text_file, encoding="utf-8").read()
     model_cfg = ModelConfig.from_pretrained(args.model_path)
-    params = load_safetensors_params(args.model_path, model_cfg)
+    # load weights exactly as JaxEngine.from_pretrained would: safetensors
+    # when present, else a GGUF checkpoint (dequantize-on-load)
+    has_st = os.path.isdir(args.model_path) and any(
+        f.endswith(".safetensors") for f in os.listdir(args.model_path)
+    )
+    if has_st:
+        params = load_safetensors_params(args.model_path, model_cfg)
+    else:
+        from .llm.gguf import find_gguf_file, load_gguf_params
+
+        gguf = find_gguf_file(args.model_path)
+        if gguf is None:
+            raise SystemExit(
+                f"{args.model_path}: no .safetensors and no .gguf weights"
+            )
+        params = load_gguf_params(gguf, model_cfg)
     if args.quantize == "int8":
         from .engine.quant import quantize_params
 

@@ -149,6 +149,132 @@ class InflightPrefill:
     slot: int
 
 
+# layer-group count the chunked KV export aims for when the caller doesn't
+# pin a granularity: enough chunks that the first hits the wire after ~1/8 of
+# the device->host transfer, few enough that framing stays negligible
+DEFAULT_EXPORT_CHUNKS = 8
+
+
+class _GroupSpanExport:
+    """Shared device->host materializer for one export group's layer-group
+    slices: every request in the group views the same span arrays, so each
+    span pays ONE transfer no matter how many uploads consume it.  The
+    device copies were dispatched (and ``copy_to_host_async`` started) on
+    the engine executor; ``host_span`` completes them lazily off-thread, so
+    span i+1 transfers while span i is already on the wire."""
+
+    def __init__(self, span_devs: List[Any]) -> None:
+        self._devs: List[Any] = span_devs
+        self._host: List[Optional[np.ndarray]] = [None] * len(span_devs)
+        self._tasks: List[Optional[asyncio.Task]] = [None] * len(span_devs)
+
+    def _materialize(self, idx: int) -> np.ndarray:
+        arr = np.asarray(jax.device_get(self._devs[idx]))
+        self._host[idx] = arr
+        self._devs[idx] = None  # release the device copy
+        return arr
+
+    async def host_span(self, idx: int) -> np.ndarray:
+        got = self._host[idx]
+        if got is not None:
+            return got
+        task = self._tasks[idx]
+        if task is None:
+            task = self._tasks[idx] = asyncio.ensure_future(
+                asyncio.to_thread(self._materialize, idx)
+            )
+        return await task
+
+
+@dataclass
+class KVExportStream:
+    """One remote prefill's KV export as a stream of layer-group chunks.
+
+    The prefill dispatch and the per-span device gathers are already in
+    flight when this is handed out; :meth:`chunks` yields each group as it
+    lands on host, so the consumer (PrefillWorker) puts the first bytes on
+    the wire after one span's transfer instead of the whole blob's.
+    ``first_ready_at``/``last_ready_at`` record the pipeline's
+    export-before-first-byte and total-materialize times."""
+
+    shape: Tuple[int, ...]  # [L, 2, n_pages, page, Hkv, D]
+    dtype: str
+    row: np.ndarray  # packed [2 + 2N] (token | logprob | tops)
+    spans: List[Tuple[int, int]]  # per-chunk [layer_lo, layer_hi)
+    started_at: float = 0.0
+    first_ready_at: Optional[float] = None
+    last_ready_at: Optional[float] = None
+    _group: Optional[_GroupSpanExport] = None
+    _page_off: int = 0
+    _blob: Optional[np.ndarray] = None  # pre-materialized fallback path
+
+    @classmethod
+    def from_blob(cls, blob: np.ndarray, row: np.ndarray) -> "KVExportStream":
+        """Wrap an already-materialized export (single-request fallback)."""
+        return cls(
+            shape=tuple(blob.shape),
+            dtype=str(blob.dtype),
+            row=np.asarray(row),
+            spans=[(0, blob.shape[0])],
+            _blob=np.asarray(blob),
+        )
+
+    @property
+    def nbytes(self) -> int:
+        return int(
+            np.prod(self.shape) * jnp.dtype(self.dtype).itemsize
+        )
+
+    @property
+    def chunk_bounds(self) -> List[Tuple[int, int]]:
+        """Byte range of each chunk in the C-order blob (layer slabs are
+        contiguous, so chunk i covers its layers' bytes exactly)."""
+        bpl = self.nbytes // self.shape[0]
+        return [(lo * bpl, hi * bpl) for lo, hi in self.spans]
+
+    async def chunks(self):
+        """Yield ``(idx, layer_lo, layer_hi, array)`` in span order as each
+        group materializes; the array is a view, C-contiguity not
+        guaranteed."""
+        k = self.shape[2]
+        for idx, (lo, hi) in enumerate(self.spans):
+            if self._blob is not None:
+                part = self._blob[lo:hi]
+            else:
+                assert self._group is not None
+                span = await self._group.host_span(idx)
+                part = span[:, :, self._page_off : self._page_off + k]
+            now = time.perf_counter()
+            if self.first_ready_at is None:
+                self.first_ready_at = now
+            self.last_ready_at = now
+            yield idx, lo, hi, part
+
+    async def assemble(self) -> np.ndarray:
+        """Materialize the full blob (same-process handoff / tests)."""
+        parts = [part async for _, _, _, part in self.chunks()]
+        if len(parts) == 1:
+            return np.ascontiguousarray(parts[0])
+        return np.concatenate(parts, axis=0)
+
+
+@dataclass
+class _ChunkedDelivery:
+    """Decode-side staging record for an in-flight chunked KV delivery:
+    layer-group parts queue here until the tick loop scatters them (the
+    lane may not even hold a slot yet); ``done`` + all layers applied is
+    the completion barrier before the first decode step."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    parts: List[Tuple[int, int, np.ndarray]] = field(default_factory=list)
+    applied_layers: int = 0
+    validated: bool = False
+    done: bool = False
+    first: int = 0
+    lp_row: Optional[np.ndarray] = None
+
+
 @dataclass
 class InflightPrefillGroup:
     """A batched prefill dispatch awaiting commit: ``sampled`` is the whole
@@ -277,6 +403,9 @@ class JaxEngine:
         # are applied by the tick loop at a controlled point
         self._external: Dict[str, SeqState] = {}
         self._deliveries: Dict[str, Tuple[np.ndarray, int]] = {}
+        # chunked deliveries stage layer-group parts here until the tick
+        # loop scatters them (incremental onboard with a completion barrier)
+        self._chunked: Dict[str, _ChunkedDelivery] = {}
         self._external_deadline: Dict[str, float] = {}
         # chunked prefill: slotted seqs with prompt KV still being written,
         # one chunk dispatched per tick (interleaves with decode blocks)
@@ -568,6 +697,70 @@ class JaxEngine:
             self._wake.set()
         return True
 
+    def begin_external_chunked(
+        self,
+        request_id: str,
+        shape: Tuple[int, ...],
+        dtype: str,
+    ) -> bool:
+        """Open a chunked KV delivery for a parked external request: the
+        sender streams layer-group chunks via :meth:`deliver_external_chunk`
+        and closes with :meth:`commit_external_chunked`.  The pipelined
+        counterpart of :meth:`deliver_external` -- pages scatter as chunks
+        arrive instead of after the whole blob lands.  The completion
+        barrier is layer coverage against ``shape[0]``, so chunk
+        granularity is entirely the sender's choice."""
+        if request_id not in self._external:
+            return False
+        self._chunked[request_id] = _ChunkedDelivery(
+            shape=tuple(int(s) for s in shape),
+            dtype=str(dtype),
+        )
+        return True
+
+    def deliver_external_chunk(
+        self,
+        request_id: str,
+        layer_lo: int,
+        layer_hi: int,
+        arr: np.ndarray,
+    ) -> bool:
+        """Stage one layer-group chunk ``[layer_hi-layer_lo, 2, n_pages,
+        page, Hkv, D]``; the tick loop scatters it into the lane's pages at
+        its next iteration (or as soon as the lane gets a slot)."""
+        rec = self._chunked.get(request_id)
+        if rec is None or request_id not in self._external:
+            return False
+        rec.parts.append((int(layer_lo), int(layer_hi), arr))
+        if self._wake is not None:
+            self._wake.set()
+        return True
+
+    def commit_external_chunked(
+        self,
+        request_id: str,
+        first_token: int,
+        lp_row: Optional[np.ndarray] = None,
+    ) -> bool:
+        """Close a chunked delivery: all chunks are in (or staged); commit
+        the remotely-sampled first token once every layer has scattered --
+        the completion barrier before the lane's first decode step."""
+        rec = self._chunked.get(request_id)
+        if rec is None or request_id not in self._external:
+            return False
+        arr = np.asarray(first_token).reshape(-1)
+        if arr.size > 1 and lp_row is None:
+            lp_row = arr.astype(np.int32)
+        rec.first = int(arr[0])
+        rec.lp_row = lp_row
+        rec.done = True
+        # the KV is in hand; any remaining wait is for decode capacity, not
+        # the prefill worker (mirrors deliver_external)
+        self._external_deadline.pop(request_id, None)
+        if self._wake is not None:
+            self._wake.set()
+        return True
+
     def fail_external(self, request_id: str, message: str) -> bool:
         """Remote prefill reported failure: fail the parked request instead of
         letting it ride out the delivery timeout."""
@@ -588,20 +781,24 @@ class JaxEngine:
         batch (the _fail_all hammer is for engine-wide faults only)."""
         seq = self._external.pop(rid, None)
         self._deliveries.pop(rid, None)
+        self._chunked.pop(rid, None)
         self._external_deadline.pop(rid, None)
         if seq is None or seq.finish is not None:
             return
         self._fail_seq(seq, message)
         self.sched.cancel(seq)
 
-    def _process_deliveries(self) -> List[Tuple[SeqState, int]]:
-        """Tick-loop side: returns (seq, first_token) pairs whose KV scatter
-        must be dispatched; drops deliveries for dead requests, fails parked
-        lanes whose prefill errored, mis-shaped, or timed out."""
+    def _process_deliveries(self) -> List[Tuple[Any, ...]]:
+        """Tick-loop side: returns work items whose device dispatch is due --
+        ``("blob", seq, first, lp_row)`` for a monolithic delivery,
+        ``("chunks", seq, parts)`` for staged layer-group scatters, and
+        ``("commit", seq, first, lp_row)`` once a chunked delivery's barrier
+        clears.  Drops deliveries for dead requests; fails parked lanes
+        whose prefill errored, mis-shaped, or timed out."""
         for rid, msg in list(self._external_errors.items()):
             self._external_errors.pop(rid)
             self._drop_external(rid, f"remote prefill failed: {msg}")
-        out: List[Tuple[SeqState, int, Optional[np.ndarray]]] = []
+        out: List[Tuple[Any, ...]] = []
         for rid in list(self._deliveries):
             blob, first, lp_row = self._deliveries.pop(rid)
             seq = self._external.pop(rid, None)
@@ -627,7 +824,8 @@ class JaxEngine:
                 continue
             self._external_deadline.pop(rid, None)
             seq._kv_blob = blob  # type: ignore[attr-defined]
-            out.append((seq, first, lp_row))
+            out.append(("blob", seq, first, lp_row))
+        out.extend(self._process_chunked_deliveries())
         if self._external_deadline:
             now = time.monotonic()
             for rid, deadline in list(self._external_deadline.items()):
@@ -639,6 +837,111 @@ class JaxEngine:
                     )
         return out
 
+    def _process_chunked_deliveries(self) -> List[Tuple[Any, ...]]:
+        """Chunked-delivery bookkeeping for :meth:`_process_deliveries`:
+        release staged layer-group parts of admitted lanes for scatter, and
+        emit the first-token commit once a delivery's barrier (``done`` +
+        every layer applied or in this tick's scatter list) clears."""
+        out: List[Tuple[Any, ...]] = []
+        for rid in list(self._chunked):
+            rec = self._chunked[rid]
+            seq = self._external.get(rid)
+            if seq is None or seq.finish is not None:
+                del self._chunked[rid]
+                continue
+            if seq.slot < 0:
+                continue  # not admitted yet: parts stay staged
+            if not rec.validated:
+                expect = self._expected_blob_shape(seq)
+                if rec.shape != expect or expect[2] > len(seq.pages):
+                    del self._chunked[rid]
+                    self._external.pop(rid, None)
+                    self._external_deadline.pop(rid, None)
+                    self._fail_seq(
+                        seq,
+                        f"remote prefill KV shape {rec.shape} does not "
+                        f"match decode geometry {expect}",
+                    )
+                    self.sched.cancel(seq)
+                    continue
+                rec.validated = True
+            L = rec.shape[0]
+            bad = next(
+                (
+                    (lo, hi, arr)
+                    for lo, hi, arr in rec.parts
+                    if not (0 <= lo < hi <= L)
+                    or tuple(arr.shape) != (hi - lo,) + rec.shape[1:]
+                ),
+                None,
+            )
+            if bad is not None:
+                lo, hi, arr = bad
+                del self._chunked[rid]
+                self._external.pop(rid, None)
+                self._external_deadline.pop(rid, None)
+                self._fail_seq(
+                    seq,
+                    f"remote prefill KV chunk layers [{lo},{hi}) shape "
+                    f"{tuple(arr.shape)} does not match decode geometry "
+                    f"{rec.shape}",
+                )
+                self.sched.cancel(seq)
+                continue
+            if rec.parts:
+                parts, rec.parts = rec.parts, []
+                rec.applied_layers += sum(hi - lo for lo, hi, _ in parts)
+                out.append(("chunks", seq, parts))
+            if rec.done and not rec.parts:
+                del self._chunked[rid]
+                self._external.pop(rid, None)
+                self._external_deadline.pop(rid, None)
+                if rec.applied_layers != L:
+                    self._fail_seq(
+                        seq,
+                        f"incomplete chunked KV delivery: "
+                        f"{rec.applied_layers} of {L} layers",
+                    )
+                    self.sched.cancel(seq)
+                    continue
+                out.append(("commit", seq, rec.first, rec.lp_row))
+        return out
+
+    def _lane_scatter_ids(self, seq: SeqState) -> Tuple[int, int, np.ndarray]:
+        """Page-bucketed destination ids for scattering a delivered blob
+        into ``seq``'s pages: pad slots target trash page 0 with zero
+        content, so compile-cache entries stay few across prompt sizes.
+        The single source of the bucket/trash-page convention for both the
+        monolithic and the chunked delivery scatters."""
+        n_pages = -(-len(seq.prompt) // self.cfg.page_size)
+        bucket = pick_page_bucket(n_pages, self.sched.max_pages)
+        ids = np.zeros((bucket,), np.int32)
+        ids[:n_pages] = seq.pages[:n_pages]
+        return n_pages, bucket, ids
+
+    def _apply_external_chunks(
+        self, seq: SeqState, parts: List[Tuple[int, int, np.ndarray]]
+    ) -> None:
+        """Executor thread: scatter staged layer-group chunks into the
+        lane's pages (the incremental half of a chunked delivery; the
+        first-token commit waits for the barrier)."""
+        from .step import scatter_layer_pages
+
+        n_pages, bucket, ids = self._lane_scatter_ids(seq)
+        ids_dev = jnp.asarray(ids)
+        for lo, hi, arr in parts:
+            padded = np.asarray(arr)
+            if bucket > n_pages:
+                pad = [(0, 0)] * padded.ndim
+                pad[2] = (0, bucket - n_pages)
+                padded = np.pad(padded, pad)
+            self.kv.pages = scatter_layer_pages(
+                self.kv.pages,
+                jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
+                ids_dev,
+                jnp.asarray(padded),
+            )
+
     def _apply_external_kv(
         self,
         seq: SeqState,
@@ -649,15 +952,12 @@ class JaxEngine:
         then commit the remotely-sampled first token."""
         blob = seq._kv_blob  # type: ignore[attr-defined]
         del seq._kv_blob  # type: ignore[attr-defined]
-        n_pages = blob.shape[2]
         # donated, jitted scatter (scatter_block_pages): an out-of-jit
         # .at[].set would materialize a full copy of the KV pool per
-        # delivery.  Pad the page list to a power-of-two bucket (extra
-        # slots target trash page 0 with zero content) so compile-cache
-        # entries stay few across prompt sizes.
-        bucket = pick_page_bucket(n_pages, self.sched.max_pages)
-        ids = np.zeros((bucket,), np.int32)
-        ids[:n_pages] = seq.pages[:n_pages]
+        # delivery.  Destination ids are page-bucketed by the shared
+        # helper (blob shape was validated against the prompt's page count
+        # in _process_deliveries).
+        n_pages, bucket, ids = self._lane_scatter_ids(seq)
         padded = blob
         if bucket > n_pages:
             pad = [(0, 0)] * blob.ndim
@@ -672,6 +972,17 @@ class JaxEngine:
         self.kv.pages = scatter_block_pages(
             self.kv.pages, jnp.asarray(ids), jnp.asarray(padded)
         )
+        return self._apply_external_commit(seq, first_token, lp_row)
+
+    def _apply_external_commit(
+        self,
+        seq: SeqState,
+        first_token: int,
+        lp_row: Optional[np.ndarray] = None,
+    ) -> StepEvent:
+        """Executor thread: the KV is fully in the lane's pages (monolithic
+        scatter or chunked barrier cleared); commit the remotely-sampled
+        first token and wake the lane."""
         seq.awaiting_kv = False
         lp, top = None, None
         if lp_row is not None and len(lp_row) >= 2:
@@ -845,6 +1156,139 @@ class JaxEngine:
             for pages in allocated:
                 self.kv.allocator.free(pages)
 
+    async def prefill_export_batch_stream(
+        self,
+        reqs: List[PreprocessedRequest],
+        layers_per_chunk: Optional[int] = None,
+    ) -> List[Any]:
+        """Chunked, layer-pipelined :meth:`prefill_export_batch`: the batch
+        prefill dispatches once, then each layer group is gathered on
+        device, its device->host copy started asynchronously, and a
+        :class:`KVExportStream` handed back BEFORE any blob materializes.
+        The consumer streams chunk 0 onto the wire while chunks 1..N-1 are
+        still transferring -- export-before-first-byte drops from the whole
+        blob's transfer to one group's.
+
+        ``layers_per_chunk`` pins the chunk granularity; None splits the
+        stack into ~``DEFAULT_EXPORT_CHUNKS`` groups.  Returns one entry per
+        request: a :class:`KVExportStream` or the per-request ``Exception``.
+        Shares the dispatch site with the aggregated path, preserving
+        disagg == aggregated output."""
+        if not self._running:
+            await self.start()
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._ex, self._prefill_export_batch_stream, reqs,
+            layers_per_chunk,
+        )
+
+    def _prefill_export_batch_stream(
+        self,
+        reqs: List[PreprocessedRequest],
+        layers_per_chunk: Optional[int] = None,
+    ) -> List[Any]:
+        results: List[Any] = [None] * len(reqs)
+        valid: List[int] = []
+        for i, req in enumerate(reqs):
+            if not req.token_ids:
+                results[i] = ValueError("empty prompt")
+            else:
+                valid.append(i)
+        valid.sort(key=lambda i: len(reqs[i].token_ids))
+        B = self.cfg.max_batch_size
+        for start in range(0, len(valid), B):
+            group = valid[start : start + B]
+            try:
+                self._export_group_stream(
+                    reqs, group, results, layers_per_chunk
+                )
+            except Exception:  # noqa: BLE001 - page pressure, as in batch
+                for i in group:
+                    try:
+                        results[i] = KVExportStream.from_blob(
+                            *self._prefill_export(reqs[i])
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        results[i] = exc
+        return results
+
+    def _export_group_stream(
+        self,
+        reqs: List[PreprocessedRequest],
+        group: List[int],
+        results: List[Any],
+        layers_per_chunk: Optional[int] = None,
+    ) -> None:
+        """Executor thread: one padded prefill dispatch for the group, then
+        per-layer-group device gathers with async host copies started; the
+        scratch pages free as soon as the gathers are dispatched (device
+        program order) and nothing blocks on the bulk transfer here --
+        only the tiny sampled rows come to host."""
+        from .kv_cache import layer_chunk_spans
+        from .step import gather_layer_pages
+
+        ps = self.cfg.page_size
+        allocated: List[List[int]] = []
+        try:
+            for i in group:
+                n_pages = -(-len(reqs[i].token_ids) // ps)
+                allocated.append(self.kv.allocator.alloc(n_pages))
+        except Exception:
+            for pages in allocated:
+                self.kv.allocator.free(pages)
+            raise
+        try:
+            items = [
+                (
+                    SeqState.from_request(
+                        "export", reqs[i], self.sched.block_size
+                    ),
+                    list(reqs[i].token_ids),
+                    pages,
+                )
+                for i, pages in zip(group, allocated)
+            ]
+            Bp = min(self._pad_batch(len(items)), self.cfg.max_batch_size)
+            sampled = self._dispatch_full_prefill_batch(items, Bp)
+            all_ids = np.concatenate(
+                [np.asarray(p, np.int32) for p in allocated]
+            )
+            L = self.model_cfg.num_layers
+            spans = layer_chunk_spans(
+                L, layers_per_chunk, DEFAULT_EXPORT_CHUNKS
+            )
+            ids_dev = jnp.asarray(all_ids)
+            span_devs: List[Any] = []
+            for lo, hi in spans:
+                sl = gather_layer_pages(
+                    self.kv.pages,
+                    jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
+                    ids_dev,
+                )
+                try:
+                    sl.copy_to_host_async()
+                except Exception:
+                    pass  # optional fast path; device_get still works
+                span_devs.append(sl)
+            firsts = np.asarray(jax.device_get(sampled))  # [Bp, 2 + 2N]
+            shared = _GroupSpanExport(span_devs)
+            tail = tuple(self.kv.pages.shape[3:])
+            off = 0
+            for row, (i, pages) in enumerate(zip(group, allocated)):
+                k = len(pages)
+                results[i] = KVExportStream(
+                    shape=(L, 2, k) + tail,
+                    dtype=str(self.kv.pages.dtype),
+                    row=firsts[row],
+                    spans=spans,
+                    _group=shared,
+                    _page_off=off,
+                )
+                off += k
+        finally:
+            for pages in allocated:
+                self.kv.allocator.free(pages)
+
     async def export_blocks(
         self, seq_hashes: List[int]
     ) -> List[Tuple[int, np.ndarray, Dict[str, int]]]:
@@ -951,11 +1395,26 @@ class JaxEngine:
         while self._running:
             try:
                 self._process_cancellations()
-                for seq, first, lp_row in self._process_deliveries():
-                    ev = await loop.run_in_executor(
-                        self._ex, self._apply_external_kv, seq, first, lp_row
-                    )
-                    self._dispatch([ev])
+                for work in self._process_deliveries():
+                    if work[0] == "blob":
+                        _, seq, first, lp_row = work
+                        ev = await loop.run_in_executor(
+                            self._ex, self._apply_external_kv, seq, first,
+                            lp_row,
+                        )
+                        self._dispatch([ev])
+                    elif work[0] == "chunks":
+                        _, seq, parts = work
+                        await loop.run_in_executor(
+                            self._ex, self._apply_external_chunks, seq, parts
+                        )
+                    else:  # "commit": the chunked barrier cleared
+                        _, seq, first, lp_row = work
+                        ev = await loop.run_in_executor(
+                            self._ex, self._apply_external_commit, seq,
+                            first, lp_row,
+                        )
+                        self._dispatch([ev])
                 if (
                     not self.sched.has_runnable_work
                     and not pending
@@ -1114,6 +1573,7 @@ class JaxEngine:
         # a failed external request must not resurrect via a late delivery
         self._external.pop(seq.request_id, None)
         self._deliveries.pop(seq.request_id, None)
+        self._chunked.pop(seq.request_id, None)
         self._external_deadline.pop(seq.request_id, None)
         queue = self._queues.get(seq.request_id)
         if queue is not None:
@@ -1140,6 +1600,7 @@ class JaxEngine:
             self._cancelled.discard(rid)
             self._external.pop(rid, None)
             self._deliveries.pop(rid, None)
+            self._chunked.pop(rid, None)
             self._external_deadline.pop(rid, None)
             seq = by_id.get(rid)
             if seq is not None:
@@ -1797,9 +2258,11 @@ class JaxEngine:
         if d.get("counts") is not None and dirty:
             from .step import seed_count_rows, zero_count_rows
 
-            d["counts"] = zero_count_rows(
-                d["counts"], jnp.asarray(np.asarray(dirty, np.int32))
-            )
+            # the fixed-G padded slot array from above: a dirty-set-sized
+            # array would compile one executable per distinct burst size
+            # (pad slots are out of range; mode='drop' skips them), matching
+            # update_lanes
+            d["counts"] = zero_count_rows(d["counts"], jnp.asarray(slots))
             for b in dirty:
                 seq = sched.slots[b]
                 if seq is None or not self._seq_penalized(seq):

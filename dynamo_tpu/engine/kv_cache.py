@@ -114,6 +114,32 @@ class PagedKVCache:
         return self.allocator.used_pages / total if total else 0.0
 
 
+def layer_chunk_spans(
+    num_layers: int,
+    layers_per_chunk: Optional[int] = None,
+    target_chunks: int = 8,
+) -> List[tuple]:
+    """Split the layer stack into contiguous [lo, hi) spans -- the chunk
+    granularity of the pipelined KV export (engine.prefill_export_batch_stream)
+    and the unit the decode side scatters incrementally.  ``layers_per_chunk``
+    pins the group size; None aims for ``target_chunks`` groups.  Lives with
+    the cache geometry so export and onboard can never disagree on what one
+    chunk spans."""
+    if num_layers <= 0:
+        raise ValueError(f"num_layers must be positive, got {num_layers}")
+    if layers_per_chunk is not None and layers_per_chunk <= 0:
+        # fail at configuration time: a negative value would yield zero
+        # spans (every export delivering 0 of L layers), and 0 would
+        # silently mean "default"
+        raise ValueError(
+            f"layers_per_chunk must be positive, got {layers_per_chunk}"
+        )
+    g = layers_per_chunk or max(1, -(-num_layers // target_chunks))
+    return [
+        (lo, min(lo + g, num_layers)) for lo in range(0, num_layers, g)
+    ]
+
+
 def choose_num_pages(
     cfg: ModelConfig,
     page_size: int,

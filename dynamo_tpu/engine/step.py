@@ -557,6 +557,16 @@ def slice_block_pages(kv_pages: jax.Array, ids: jax.Array) -> jax.Array:
     return kv_pages[:, :, ids]
 
 
+# Layer-range variants of slice/scatter_block_pages -- the chunked KV
+# export/onboard primitives.  They live with the Pallas page kernels
+# (ops/paged_attention.py) but are re-exported here so engine code imports
+# every jitted page operation from one module.
+from ..ops.paged_attention import (  # noqa: E402,F401
+    gather_layer_pages,
+    scatter_layer_pages,
+)
+
+
 def prefill_buckets(page_size: int, max_len: int) -> list:
     """Power-of-two length buckets, all multiples of page_size."""
     max_len = -(-max_len // page_size) * page_size  # round up to a page multiple

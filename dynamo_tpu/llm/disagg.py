@@ -43,6 +43,7 @@ import numpy as np
 from ..protocols.common import PreprocessedRequest
 from ..runtime.component import Namespace, PushRouter
 from ..runtime.engine import Annotated, AsyncEngineContext, Context
+from ..runtime.transports.codec import ChunkAssembler, iter_chunk_frames
 
 logger = logging.getLogger("dynamo.disagg")
 
@@ -328,6 +329,8 @@ class DisaggDecodeEngine:
             async for _chunk in chunks:
                 pass
             ok = self.engine.fail_external(rid, str(meta["error"]))
+        elif meta.get("chunked"):
+            ok = await self._kv_deliver_chunked(rid, meta, chunks)
         else:
             dtype = jnp.dtype(meta["dtype"])  # resolves bfloat16 via ml_dtypes
             shape = tuple(int(s) for s in meta["shape"])
@@ -364,6 +367,91 @@ class DisaggDecodeEngine:
 
         yield json.dumps({"ok": ok}).encode()
 
+    async def _kv_deliver_chunked(
+        self, rid: str, meta: Dict[str, Any], chunks: AsyncIterator[bytes]
+    ) -> bool:
+        """Pipelined delivery leg: each wire frame carries (chunk index,
+        byte offset, payload); bytes land in a preallocated host buffer as
+        they arrive (out-of-order chunks welcome), and every COMPLETED
+        layer-group chunk is staged into the engine immediately -- the
+        decode-side pages fill while later chunks are still on the wire.
+        The engine holds the completion barrier: the first decode step
+        waits for every layer plus the final commit."""
+        import jax.numpy as jnp
+
+        from ..offload import KVStagingBuffer
+
+        cm = meta["chunked"]
+        error: Optional[str] = None
+        begun = False
+        spans: list = []
+        staging = asm = None
+        try:
+            dtype = jnp.dtype(meta["dtype"])  # resolves bfloat16
+            shape = tuple(int(s) for s in meta["shape"])
+            spans = [(int(a), int(b)) for a, b in cm["layers"]]
+            # spans must tile [0, L) disjointly in order: duplicate or
+            # gapped spans could sum to L layers while leaving some layer
+            # never written, and the engine's applied-layer barrier counts,
+            # it does not track coverage
+            expect_lo = 0
+            for lo, hi in spans:
+                if lo != expect_lo or hi <= lo:
+                    raise ValueError(
+                        f"layer spans {spans} do not tile [0, {shape[0]})"
+                    )
+                expect_lo = hi
+            if expect_lo != shape[0]:
+                raise ValueError(
+                    f"layer spans {spans} do not tile [0, {shape[0]})"
+                )
+            staging = KVStagingBuffer.for_layer_spans(shape, dtype, spans)
+            if int(cm.get("total_bytes", staging.flat.size)) != staging.flat.size:
+                raise ValueError(
+                    f"sender claims {cm['total_bytes']} bytes, geometry "
+                    f"holds {staging.flat.size}"
+                )
+            asm = ChunkAssembler(staging.memoryview, staging.bounds)
+            begun = self.engine.begin_external_chunked(rid, shape, str(dtype))
+        except (ValueError, KeyError, TypeError) as e:
+            error = str(e)
+        async for chunk in chunks:
+            if error is not None:
+                # drain: stopping mid-upload would stall the connection
+                # read loop on the bounded chunk queue
+                continue
+            try:
+                for done_idx in asm.add(chunk):
+                    if begun:
+                        lo, hi = spans[done_idx]
+                        # a view into the staging buffer: the completed
+                        # chunk's bytes never change again
+                        self.engine.deliver_external_chunk(
+                            rid, lo, hi, staging.layer_slice(lo, hi)
+                        )
+            except ValueError as e:
+                error = str(e)
+        if error is not None:
+            return self.engine.fail_external(
+                rid, f"chunked KV delivery rejected: {error}"
+            )
+        if not asm.complete:
+            # connection died mid-upload (the chunk iterator terminates on
+            # peer loss): fail fast, don't commit a half-filled cache
+            return self.engine.fail_external(
+                rid,
+                f"KV delivery truncated: got {asm.received_bytes} of "
+                f"{staging.flat.size} bytes",
+            )
+        if not begun:
+            return False  # request no longer waiting (cancelled/failed)
+        lp_row = meta.get("lp_row")
+        return self.engine.commit_external_chunked(
+            rid,
+            int(meta["first_token"]),
+            np.asarray(lp_row, np.int32) if lp_row else None,
+        )
+
     def kv_deliver_handler(self):
         """Raw handler for ``Endpoint.serve_raw`` on ``kv_deliver``."""
 
@@ -389,12 +477,27 @@ class PrefillWorker:
         namespace: Namespace,
         max_batch: int = 8,
         allow_local: bool = True,
+        chunked: bool = True,
+        layers_per_chunk: Optional[int] = None,
     ) -> None:
         self.engine = engine
         self.namespace = namespace
         self.queue = PrefillQueue(namespace)
         self.max_batch = max_batch
         self.allow_local = allow_local  # same-process device handoff opt-out
+        # chunked wire path: stream layer-group chunks as they materialize
+        # (export overlaps transfer); False forces the legacy monolithic
+        # blob upload.  layers_per_chunk pins the chunk granularity (None =
+        # engine default, ~DEFAULT_EXPORT_CHUNKS groups).
+        self.chunked = chunked and hasattr(
+            engine, "prefill_export_batch_stream"
+        )
+        if layers_per_chunk is not None and layers_per_chunk <= 0:
+            # fail at startup, not per-request inside the export fallback
+            raise ValueError(
+                f"layers_per_chunk must be positive, got {layers_per_chunk}"
+            )
+        self.layers_per_chunk = layers_per_chunk
         self.prefills_done = 0
         self.local_deliveries = 0  # same-process device handoffs
         self._task: Optional[asyncio.Task] = None
@@ -428,6 +531,18 @@ class PrefillWorker:
                 "deliver_ms_p50": pct([r["deliver_ms"] for r in rows], 0.5),
                 "deliver_ms_p99": pct([r["deliver_ms"] for r in rows], 0.99),
                 "export_ms_p50": pct([r["export_ms"] for r in rows], 0.5),
+                # chunked-path pipeline metrics (absent rows = legacy path)
+                "export_total_ms_p50": pct(
+                    [r["export_total_ms"] for r in rows
+                     if "export_total_ms" in r], 0.5,
+                ),
+                "overlap_ratio_p50": pct(
+                    [r["overlap_ratio"] for r in rows
+                     if "overlap_ratio" in r], 0.5,
+                ),
+                "chunks_p50": pct(
+                    [r["chunks"] for r in rows if "chunks" in r], 0.5
+                ),
             }
         return out
 
@@ -505,11 +620,22 @@ class PrefillWorker:
         if good:
             t0 = time.perf_counter()
             try:
-                exported = await self.engine.prefill_export_batch(
-                    [parsed[i] for i in good], device=all_local
-                )
+                if not all_local and self.chunked:
+                    # chunked wire path: streams come back BEFORE any blob
+                    # materializes; per-delivery export timing rides the
+                    # stream's own first/last-chunk timestamps
+                    exported = await self.engine.prefill_export_batch_stream(
+                        [parsed[i] for i in good], self.layers_per_chunk
+                    )
+                    for res in exported:
+                        if not isinstance(res, Exception):
+                            res.started_at = t0
+                else:
+                    exported = await self.engine.prefill_export_batch(
+                        [parsed[i] for i in good], device=all_local
+                    )
             except Exception as e:  # noqa: BLE001 - engine-wide failure
-                logger.exception("prefill_export_batch failed")
+                logger.exception("prefill export batch failed")
                 exported = [e] * len(good)
             export_ms_per_item = (
                 (time.perf_counter() - t0) * 1000.0 / max(len(good), 1)
@@ -547,6 +673,11 @@ class PrefillWorker:
                 logger.exception(
                     "error notification failed for request %s", rid
                 )
+            return
+        if not isinstance(result, tuple):
+            # chunked export stream: layer-group chunks go on the wire as
+            # they materialize
+            await self._deliver_stream(msg, result)
             return
         blob, row = result  # row: packed [2 + 2N] (token | logprob | tops)
         first = int(np.asarray(row).reshape(-1)[0])
@@ -597,6 +728,74 @@ class PrefillWorker:
             # the true prompt length, not the page-padded blob capacity
             prompt_tokens or blob.shape[2] * blob.shape[3], rid,
             msg["decode_component"], int(msg["decode_instance"]),
+        )
+
+    async def _deliver_stream(self, msg: Dict[str, Any], stream) -> None:
+        """Upload a chunked export: frame each layer-group chunk with its
+        index + absolute byte offset (codec.encode_chunk_frame) and send it
+        the moment it lands on host -- chunk i rides the socket while chunk
+        i+1 is still in device->host flight.  A same-process decode target
+        takes the wire too: the chunked path exists to pipeline the host
+        transit that the device handoff never pays."""
+        rid = msg["request_id"]
+        row = np.asarray(stream.row).reshape(-1)
+        bounds = stream.chunk_bounds
+        meta = {
+            "request_id": rid,
+            "dtype": stream.dtype,
+            "shape": list(stream.shape),
+            "first_token": int(row[0]),
+            "lp_row": [int(x) for x in row],
+            "chunked": {
+                "layers": [list(s) for s in stream.spans],
+                "total_bytes": stream.nbytes,
+            },
+        }
+
+        async def frames() -> AsyncIterator[bytes]:
+            async for idx, _lo, _hi, part in stream.chunks():
+                raw = part.tobytes()  # C-order bytes of the layer slab
+                for frame in iter_chunk_frames(
+                    idx, bounds[idx][0], raw, KV_CHUNK_BYTES
+                ):
+                    yield frame
+
+        t0 = time.perf_counter()
+        try:
+            await self._upload(msg, meta, frames())
+        except Exception:
+            logger.exception("KV delivery failed for request %s", rid)
+            raise
+        started = stream.started_at or t0
+        first_at = stream.first_ready_at or started
+        last_at = stream.last_ready_at or first_at
+        export_first = (first_at - started) * 1000.0
+        export_total = (last_at - started) * 1000.0
+        self.delivery_stats.append(
+            {
+                "path": "wire",
+                "bytes": stream.nbytes,
+                # export-before-first-byte: the number the chunked pipeline
+                # exists to shrink (the legacy path's export_ms covers the
+                # WHOLE blob's dispatch+compute+materialize)
+                "export_ms": export_first,
+                "export_total_ms": export_total,
+                # fraction of export materialization that overlapped wire
+                # transfer (0 = monolithic behavior, -> 1 = fully pipelined)
+                "overlap_ratio": (
+                    1.0 - export_first / export_total
+                    if export_total > 0 else 0.0
+                ),
+                "chunks": len(stream.spans),
+                "deliver_ms": (time.perf_counter() - t0) * 1000.0,
+            }
+        )
+        self.prefills_done += 1
+        prompt_tokens = len((msg.get("request") or {}).get("token_ids") or ())
+        logger.info(
+            "prefilled %d tokens for %s -> %s/%d (%d chunks)",
+            prompt_tokens, rid, msg["decode_component"],
+            int(msg["decode_instance"]), len(stream.spans),
         )
 
     async def _upload(

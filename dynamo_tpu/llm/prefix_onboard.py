@@ -32,19 +32,27 @@ from typing import Any, AsyncIterator, Dict, List, Optional
 
 import numpy as np
 
-from ..offload import BlockMeta
+from ..offload import BlockMeta, KVStagingBuffer
 from ..runtime.component import Namespace, PushRouter
 from ..runtime.engine import Annotated, AsyncEngineContext, Context
+from ..runtime.transports.codec import ChunkAssembler, iter_chunk_frames
 
 logger = logging.getLogger("dynamo.prefix_onboard")
 
 KV_EXPORT_ENDPOINT = "kv_export"
 DONOR_META_KEY = "prefix_donor"  # request metadata: {"instance": i, "blocks": n}
 
+# Block blobs ride the wire in chunk frames of this size: big models' blocks
+# can exceed codec.MAX_FRAME as one payload, and the importer stages each
+# block incrementally instead of buffering whole frames (same framing as the
+# disagg KV delivery, runtime/transports/codec.py).
+EXPORT_CHUNK_BYTES = 8 * 1024 * 1024
+
 
 def kv_export_handler(engine):
     """Raw handler for the ``kv_export`` endpoint: meta carries the hash
-    chain; the response alternates JSON-meta frames and blob frames."""
+    chain; the response alternates JSON-meta frames and the block's chunk
+    frames (index + offset framed, codec.encode_chunk_frame)."""
 
     async def handler(
         hdr: Dict[str, Any],
@@ -59,16 +67,30 @@ def kv_export_handler(engine):
             hashes = [int(h) for h in (hdr.get("meta") or {}).get("hashes", [])]
             found = await engine.export_blocks(hashes)
             for seq_hash, blob, meta in found:
-                blob = np.ascontiguousarray(blob)
+                raw = np.asarray(blob).tobytes()  # C-order bytes
                 yield json.dumps(
                     {
                         "seq_hash": int(seq_hash),
                         "dtype": str(blob.dtype),
                         "shape": list(blob.shape),
+                        "chunk_bytes": EXPORT_CHUNK_BYTES,
+                        "total_bytes": len(raw),
                         "meta": meta,
                     }
                 ).encode()
-                yield blob.tobytes()
+                view = memoryview(raw)
+                # zero-byte blobs emit no chunk frames: the importer's
+                # assembler is already complete at meta time.  Chunk i
+                # covers bytes [i*CB, (i+1)*CB) -- the same bounds
+                # KVStagingBuffer.for_byte_chunks derives on the importer.
+                for idx, off in enumerate(
+                    range(0, len(view), EXPORT_CHUNK_BYTES)
+                ):
+                    for frame in iter_chunk_frames(
+                        idx, off, view[off : off + EXPORT_CHUNK_BYTES],
+                        EXPORT_CHUNK_BYTES,
+                    ):
+                        yield frame
 
         return gen()
 
@@ -163,18 +185,42 @@ class PrefixOnboardEngine:
             b"",
             AsyncEngineContext(request.id),
         )
+        import jax.numpy as jnp
+
         pending_meta: Optional[Dict[str, Any]] = None
+        staging: Optional[KVStagingBuffer] = None
+        asm: Optional[ChunkAssembler] = None
         fetched = 0
+
+        def _store() -> None:
+            nonlocal fetched, pending_meta, staging, asm
+            offload.put(
+                int(pending_meta["seq_hash"]),
+                staging.array,
+                BlockMeta.from_dict(pending_meta["meta"]),
+            )
+            fetched += 1
+            pending_meta = staging = asm = None
+
         async for frame in stream:
             if pending_meta is None:
                 pending_meta = json.loads(frame)
-            else:
-                import jax.numpy as jnp
-
                 dtype = jnp.dtype(pending_meta["dtype"])
-                blob = np.frombuffer(frame, dtype).reshape(
-                    pending_meta["shape"]
+                if "chunk_bytes" not in pending_meta:
+                    # legacy donor: the whole blob rides the next frame
+                    staging = asm = None
+                    continue
+                staging = KVStagingBuffer.for_byte_chunks(
+                    pending_meta["shape"], dtype,
+                    int(pending_meta["chunk_bytes"]),
                 )
+                asm = ChunkAssembler(staging.memoryview, staging.bounds)
+                if asm.complete:  # zero-byte blob: no chunk frames follow
+                    _store()
+            elif asm is None:
+                blob = np.frombuffer(
+                    frame, jnp.dtype(pending_meta["dtype"])
+                ).reshape(pending_meta["shape"])
                 offload.put(
                     int(pending_meta["seq_hash"]),
                     blob,
@@ -182,6 +228,17 @@ class PrefixOnboardEngine:
                 )
                 fetched += 1
                 pending_meta = None
+            else:
+                asm.add(frame)
+                if asm.complete:
+                    _store()
+        if pending_meta is not None:
+            # stream ended mid-block (donor died): the partial block is
+            # dropped; everything already stored still onboards
+            logger.warning(
+                "donor stream ended mid-block for %x; partial block dropped",
+                int(pending_meta.get("seq_hash", 0)),
+            )
         self.onboarded_blocks += fetched
         if fetched:
             logger.info(

@@ -178,14 +178,21 @@ class OpenAIPreprocessor(Operator):
         for t in tok_str:
             offsets.append(off)
             off += len(t)
+        def top_map(i: int) -> Dict[str, float]:
+            # entries arrive probability-sorted; two token ids can decode to
+            # the same string, and the later (lower-probability) alternative
+            # must not overwrite the earlier one
+            out: Dict[str, float] = {}
+            for s, l in top_entries(i) or []:
+                if s not in out:
+                    out[s] = l
+            return out
+
         return {
             "tokens": tok_str,
             "token_logprobs": list(lps),
             "top_logprobs": (
-                [
-                    {s: l for s, l in (top_entries(i) or [])}
-                    for i in range(len(ids))
-                ]
+                [top_map(i) for i in range(len(ids))]
                 if tops is not None
                 else None
             ),

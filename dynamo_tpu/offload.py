@@ -51,6 +51,57 @@ class BlockMeta:
         )
 
 
+class KVStagingBuffer:
+    """Host-RAM landing zone for an incoming chunked KV transfer.
+
+    The decode side of disaggregation (and the prefix-onboard importer)
+    assembles wire chunks here before the device scatter; this class owns
+    the geometry arithmetic -- the preallocated ndarray, its flat byte
+    view, and each chunk's [start, end) byte range -- so sender and
+    receiver derive identical bounds from the same metadata.  Layer spans
+    map to byte ranges because layer slabs are contiguous in the C-order
+    blob ``[L, 2, pages, page, Hkv, D]``."""
+
+    def __init__(self, shape, dtype, bounds) -> None:
+        self.array = np.empty(tuple(int(s) for s in shape), dtype)
+        self.flat = self.array.view(np.uint8).reshape(-1)
+        self.bounds = [(int(s), int(e)) for s, e in bounds]
+        if self.bounds and self.bounds[-1][1] != self.flat.size:
+            raise ValueError(
+                f"chunk bounds end at {self.bounds[-1][1]}, blob holds "
+                f"{self.flat.size} bytes"
+            )
+
+    @classmethod
+    def for_layer_spans(cls, shape, dtype, spans) -> "KVStagingBuffer":
+        """One chunk per layer-group span [lo, hi) over axis 0."""
+        shape = tuple(int(s) for s in shape)
+        total = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        bpl = total // max(shape[0], 1)
+        return cls(shape, dtype, [(lo * bpl, hi * bpl) for lo, hi in spans])
+
+    @classmethod
+    def for_byte_chunks(cls, shape, dtype, chunk_bytes: int) -> "KVStagingBuffer":
+        """Fixed-size byte chunks (the block-blob transfer framing)."""
+        shape = tuple(int(s) for s in shape)
+        total = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        if total == 0:
+            return cls(shape, dtype, [(0, 0)])
+        bounds = [
+            (off, min(off + chunk_bytes, total))
+            for off in range(0, total, chunk_bytes)
+        ]
+        return cls(shape, dtype, bounds)
+
+    @property
+    def memoryview(self) -> memoryview:
+        return memoryview(self.flat)
+
+    def layer_slice(self, lo: int, hi: int) -> np.ndarray:
+        """View of layers [lo, hi) -- stable once their bytes landed."""
+        return self.array[lo:hi]
+
+
 class DiskTier:
     """G3: one ``.npz`` file per block under ``root``, LRU-capped."""
 

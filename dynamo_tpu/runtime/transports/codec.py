@@ -15,7 +15,7 @@ from __future__ import annotations
 import asyncio
 import json
 import struct
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 _LEN = struct.Struct(">II")
 
@@ -60,6 +60,127 @@ async def read_frame(
     except (asyncio.IncompleteReadError, ConnectionResetError) as exc:
         raise TruncatedFrame("EOF inside frame body") from exc
     return json.loads(hdr_bytes), payload
+
+
+# ---------------------------------------------------------------------------
+# Chunked binary messages (the disagg KV streaming wire format)
+#
+# A large binary payload split into N logical chunks rides the upload plane as
+# a sequence of self-describing sub-frames, each tagged with its chunk index
+# and absolute byte offset, so the receiver can (a) place bytes into a
+# preallocated buffer as they land, (b) tolerate whole chunks arriving out of
+# order (retried/parallel senders), and (c) reject truncated or overlapping
+# streams instead of assembling garbage.  The chunk boundaries themselves are
+# carried in the message header (layer spans for KV exports), not here.
+# ---------------------------------------------------------------------------
+
+CHUNK_MAGIC = 0x4B564331  # "KVC1"
+_CHUNK_HDR = struct.Struct(">IIQ")  # magic, chunk index, absolute byte offset
+
+
+def encode_chunk_frame(index: int, offset: int, payload) -> bytearray:
+    """Frame one piece of chunk ``index`` starting at absolute ``offset``.
+    ``payload`` is any bytes-like; the result is a single upload part.
+    One payload copy total (pack_into + slice assign), not the two a
+    bytes-concat would pay -- this sits on the bulk KV upload path."""
+    out = bytearray(_CHUNK_HDR.size + len(payload))
+    _CHUNK_HDR.pack_into(out, 0, CHUNK_MAGIC, index, offset)
+    out[_CHUNK_HDR.size :] = payload
+    return out
+
+
+def iter_chunk_frames(index: int, base_offset: int, payload, chunk_bytes: int):
+    """Split one chunk's payload into wire frames of at most
+    ``chunk_bytes`` each, all tagged with the chunk's ``index`` and their
+    absolute byte offset.  The single framing loop both KV emitters
+    (disagg delivery, prefix-onboard export) share."""
+    view = memoryview(payload)
+    for off in range(0, len(view), chunk_bytes):
+        yield encode_chunk_frame(
+            index, base_offset + off, view[off : off + chunk_bytes]
+        )
+
+
+def decode_chunk_frame(frame) -> Tuple[int, int, memoryview]:
+    """Inverse of :func:`encode_chunk_frame`; the payload view is zero-copy."""
+    view = memoryview(frame)
+    if len(view) < _CHUNK_HDR.size:
+        raise ValueError("chunk frame shorter than its header")
+    magic, index, offset = _CHUNK_HDR.unpack_from(view)
+    if magic != CHUNK_MAGIC:
+        raise ValueError(f"bad chunk magic {magic:#x}")
+    return index, offset, view[_CHUNK_HDR.size :]
+
+
+class ChunkAssembler:
+    """Assemble chunk frames into a caller-provided buffer.
+
+    ``bounds`` gives each chunk's [start, end) byte range in the full
+    message; frames may arrive in any chunk order and a chunk may span
+    several frames, but every frame must land entirely inside its chunk's
+    range and never overlap previously received bytes.  ``add`` returns the
+    indices of chunks the frame completed, so the consumer can act on each
+    chunk (e.g. scatter a layer group) without waiting for the whole
+    message; ``complete`` is the end-of-stream truncation check.
+    """
+
+    def __init__(self, buffer: memoryview, bounds: List[Tuple[int, int]]) -> None:
+        total = len(buffer)
+        if bounds and bounds[-1][1] != total:
+            raise ValueError(
+                f"chunk bounds end at {bounds[-1][1]}, buffer holds {total}"
+            )
+        self.buffer = buffer
+        self.bounds = [(int(s), int(e)) for s, e in bounds]
+        # per-chunk merged received intervals (few per chunk: senders emit
+        # sequential sub-frames; out-of-order support is per whole chunk)
+        self._got: List[List[Tuple[int, int]]] = [[] for _ in bounds]
+        self.received_bytes = 0
+
+    def _merge(self, idx: int, start: int, end: int) -> None:
+        ivs = self._got[idx]
+        for s, e in ivs:
+            if start < e and s < end:
+                raise ValueError(
+                    f"chunk {idx}: bytes [{start},{end}) overlap [{s},{e})"
+                )
+        ivs.append((start, end))
+        ivs.sort()
+        merged = [ivs[0]]
+        for s, e in ivs[1:]:
+            ls, le = merged[-1]
+            if s == le:
+                merged[-1] = (ls, e)
+            else:
+                merged.append((s, e))
+        self._got[idx] = merged
+
+    def chunk_complete(self, idx: int) -> bool:
+        start, end = self.bounds[idx]
+        return start == end or self._got[idx] == [(start, end)]
+
+    @property
+    def complete(self) -> bool:
+        return all(self.chunk_complete(i) for i in range(len(self.bounds)))
+
+    def add(self, frame) -> List[int]:
+        """Place one frame; returns chunk indices this frame completed."""
+        idx, off, payload = decode_chunk_frame(frame)
+        if not 0 <= idx < len(self.bounds):
+            raise ValueError(f"chunk index {idx} out of range")
+        start, end = self.bounds[idx]
+        if off < start or off + len(payload) > end:
+            raise ValueError(
+                f"chunk {idx}: frame [{off},{off + len(payload)}) outside "
+                f"its bounds [{start},{end})"
+            )
+        was_done = self.chunk_complete(idx)
+        self._merge(idx, off, off + len(payload))
+        self.buffer[off : off + len(payload)] = payload
+        self.received_bytes += len(payload)
+        if not was_done and self.chunk_complete(idx):
+            return [idx]
+        return []
 
 
 def write_frame(

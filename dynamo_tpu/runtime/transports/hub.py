@@ -354,11 +354,37 @@ class HubJournal:
 
     # -- restore -----------------------------------------------------------
 
+    def _old_segments(self) -> List[str]:
+        """Rotated-out WAL segments awaiting a snapshot, in chronological
+        (replay) order: ``wal.old.bin`` first, then numbered overflow
+        segments from compactions that failed before their snapshot landed
+        (each number was created while every lower one already existed)."""
+        import os
+        import re
+
+        out: List[str] = []
+        if os.path.exists(self.wal_old_path):
+            out.append(self.wal_old_path)
+        pat = re.compile(
+            re.escape(os.path.basename(self.wal_old_path)) + r"\.(\d+)$"
+        )
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            names = []
+        extras = []
+        for name in names:
+            m = pat.match(name)
+            if m:
+                extras.append((int(m.group(1)), os.path.join(self.dir, name)))
+        out.extend(p for _, p in sorted(extras))
+        return out
+
     def load_into(self, state: HubState) -> None:
         """Snapshot + WAL replay (journaling disabled while replaying)."""
         assert state.journal is None
         max_lease = 0
-        for src in (self.snap_path, self.wal_old_path, self.wal_path):
+        for src in (self.snap_path, *self._old_segments(), self.wal_path):
             for rec, payload in self._read_records(src):
                 op = rec.get("op")
                 if op == "lease":
@@ -417,8 +443,8 @@ class HubJournal:
             self._compacting = True
             self._pending = 0
             capture = self._capture(state)
-            self._rotate_wal()
-            task = loop.create_task(self._compact_async(capture))
+            segments = self._rotate_wal()
+            task = loop.create_task(self._compact_async(capture, segments))
             task.add_done_callback(lambda t: t.exception())
 
     def _capture(self, state: HubState) -> Dict[str, Any]:
@@ -438,39 +464,46 @@ class HubJournal:
             "objects": dict(state.objects),
         }
 
-    def _rotate_wal(self) -> None:
+    def _rotate_wal(self) -> List[str]:
+        """Swap in a fresh WAL; returns the rotated-out segments the
+        pending snapshot covers.  Always a rename, never a byte copy: when
+        a previous compaction failed before its snapshot landed (wal.old
+        still holds the only copy of that segment), the current WAL rotates
+        into the next NUMBERED segment instead of being merge-copied onto
+        wal.old on the event loop -- restore replays snapshot -> old
+        segments in order -> wal, so chronology is preserved for free."""
         import os
 
         if self._wal is not None:
             self._wal.close()
-        if os.path.exists(self.wal_old_path):
-            # a previous compaction failed before its snapshot landed:
-            # wal.old still holds the only copy of that segment.  Merge the
-            # current segment onto it instead of clobbering it -- replay
-            # order (snapshot -> wal.old -> wal) stays chronological.
-            with open(self.wal_old_path, "ab") as dst, open(
-                self.wal_path, "rb"
-            ) as src:
-                while True:
-                    chunk = src.read(1 << 20)
-                    if not chunk:
-                        break
-                    dst.write(chunk)
-            os.remove(self.wal_path)
-        else:
-            with contextlib.suppress(FileNotFoundError):
-                os.replace(self.wal_path, self.wal_old_path)
+        dst = self.wal_old_path
+        if os.path.exists(dst):
+            n = 1
+            while os.path.exists(f"{self.wal_old_path}.{n}"):
+                n += 1
+            dst = f"{self.wal_old_path}.{n}"
+        with contextlib.suppress(FileNotFoundError):
+            os.replace(self.wal_path, dst)
         self._wal = open(self.wal_path, "wb")
+        return self._old_segments()
 
-    async def _compact_async(self, capture: Dict[str, Any]) -> None:
+    async def _compact_async(
+        self, capture: Dict[str, Any], segments: List[str]
+    ) -> None:
         try:
-            await asyncio.to_thread(self._write_snapshot, capture)
+            await asyncio.to_thread(self._write_snapshot, capture, segments)
         except Exception:
             logger.exception("hub snapshot compaction failed")
         finally:
             self._compacting = False
 
-    def _write_snapshot(self, capture: Dict[str, Any]) -> None:
+    def _write_snapshot(
+        self, capture: Dict[str, Any], segments: List[str]
+    ) -> None:
+        """``segments`` MUST be the old-segment list captured at rotation
+        time: re-listing at deletion time (this runs in a worker thread)
+        could delete a segment a racing rotation created AFTER this
+        snapshot's capture -- records the snapshot does not cover."""
         import os
 
         tmp = self.snap_path + ".tmp"
@@ -490,16 +523,22 @@ class HubJournal:
             os.fsync(f.fileno())
         os.replace(tmp, self.snap_path)
         # the snapshot covers everything through the rotation point: the
-        # rotated-out segment is now redundant
-        with contextlib.suppress(FileNotFoundError):
-            os.remove(self.wal_old_path)
+        # rotated-out segments it was captured against are now redundant.
+        # Delete NEWEST-first: wal.old anchors the numbered chain, so a
+        # crash mid-cleanup must never leave a stale numbered segment
+        # behind an already-removed wal.old (a later rotation would reuse
+        # wal.old for newer records and restore would replay them BEFORE
+        # the stale segment, inverting chronology)
+        for path in reversed(segments):
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(path)
 
     def compact(self, state: HubState) -> None:
         """Synchronous compaction (tests / shutdown): capture, rotate,
         write, all inline."""
         capture = self._capture(state)
-        self._rotate_wal()
-        self._write_snapshot(capture)
+        segments = self._rotate_wal()
+        self._write_snapshot(capture, segments)
         self._pending = 0
 
     def close(self) -> None:

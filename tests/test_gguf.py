@@ -392,3 +392,68 @@ def test_gguf_q8_q4_dequant_roundtrip():
     raw, gt = _quant_q4_0(w)
     back = dequantize_ggml(raw, gt, (4, 64))
     assert np.abs(back - w).max() < np.abs(w).max() / 6  # 4-bit grid
+
+
+def test_eval_cli_gguf_only_dir(tmp_path, capsys):
+    """``dynamo-tpu eval`` on a GGUF-only model dir (no .safetensors) must
+    fall back to the GGUF loader exactly as JaxEngine.from_pretrained
+    does, instead of failing in load_safetensors_params."""
+    import json
+
+    import numpy as np
+
+    from dynamo_tpu.cli import build_parser, run_eval
+
+    H, I, L, NH, NKV, D, V = 32, 64, 2, 4, 2, 8, 8
+    rs = np.random.RandomState(0)
+    tensors = []
+
+    def add(name, shape):
+        arr = (rs.randn(*shape) * 0.05).astype(np.float32)
+        tensors.append((name, arr.shape, 0, arr.tobytes()))
+
+    add("token_embd.weight", (V, H))
+    add("output_norm.weight", (H,))
+    add("output.weight", (V, H))
+    for i in range(L):
+        add(f"blk.{i}.attn_q.weight", (NH * D, H))
+        add(f"blk.{i}.attn_k.weight", (NKV * D, H))
+        add(f"blk.{i}.attn_v.weight", (NKV * D, H))
+        add(f"blk.{i}.attn_output.weight", (H, NH * D))
+        add(f"blk.{i}.ffn_gate.weight", (I, H))
+        add(f"blk.{i}.ffn_up.weight", (I, H))
+        add(f"blk.{i}.ffn_down.weight", (H, I))
+        add(f"blk.{i}.attn_norm.weight", (H,))
+        add(f"blk.{i}.ffn_norm.weight", (H,))
+    meta = {
+        "general.architecture": "llama",
+        "general.alignment": 32,
+        "llama.embedding_length": H,
+        "llama.feed_forward_length": I,
+        "llama.block_count": L,
+        "llama.attention.head_count": NH,
+        "llama.attention.head_count_kv": NKV,
+        "llama.attention.key_length": D,
+        "llama.attention.layer_norm_rms_epsilon": 1e-5,
+        "llama.context_length": 128,
+        "llama.rope.freq_base": 10000.0,
+        "llama.vocab_size": V,
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": [
+            "<unk>", "<s>", "</s>", "▁hello", "▁world", "▁he", "llo", "▁",
+        ],
+        "tokenizer.ggml.scores": [0.0, 0.0, 0.0, -1.0, -1.5, -4.0, -4.0, -6.0],
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+        "tokenizer.ggml.unknown_token_id": 0,
+    }
+    d = tmp_path / "gguf-only"
+    d.mkdir()
+    _write_gguf_tensors(str(d / "model.gguf"), meta, tensors)
+    args = build_parser().parse_args(
+        ["eval", "--model-path", str(d), "--text",
+         "hello world hello world", "--window", "32"]
+    )
+    assert run_eval(args) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["perplexity"] > 0 and out["tokens_scored"] >= 2

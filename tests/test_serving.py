@@ -888,3 +888,32 @@ def test_http_logprobs_streaming_chunks(model_dir, run):
     for e in entries:
         assert e["logprob"] <= 0.0 and isinstance(e["bytes"], list)
         assert len(e["top_logprobs"]) == 1
+
+
+def test_completions_top_logprobs_duplicate_detok_keeps_best(model_dir):
+    """Two alternative token ids that detokenize to the same string must
+    not let the lower-probability one overwrite the higher (completions
+    top_logprobs is keyed by decoded string; entries arrive
+    probability-sorted)."""
+    tok = Tokenizer.from_model_dir(model_dir)
+    pre = OpenAIPreprocessor("m", tok)
+
+    class DupDetok:
+        """ids 7 and 9 decode to the same string (byte-level variants)."""
+
+        def decode(self, ids):
+            return {7: "x", 9: "x", 3: "y"}.get(ids[0], "?")
+
+    pre.tokenizer = DupDetok()
+    payload = pre._format_logprobs(
+        {
+            "token_ids": [7],
+            "logprobs": [-0.1],
+            "top_logprobs": [[[7, -0.1], [3, -1.0], [9, -2.5]]],
+        },
+        is_chat=False,
+        text_off=0,
+    )
+    tops = payload["top_logprobs"][0]
+    assert tops["x"] == -0.1  # the better alternative survives
+    assert tops["y"] == -1.0

@@ -827,3 +827,102 @@ def test_hub_async_compaction_and_failed_rotation_merge(run, tmp_path):
         assert st.kv["orphan/a"].value == b"precious"
 
     run(body())
+
+
+def test_chunk_frame_roundtrip_and_out_of_order_assembly():
+    """Chunked-KV wire format: frames round-trip, whole chunks assemble in
+    any arrival order, and malformed frames are rejected loudly."""
+    import numpy as np
+
+    from dynamo_tpu.runtime.transports.codec import (
+        ChunkAssembler,
+        decode_chunk_frame,
+        encode_chunk_frame,
+    )
+
+    payload = bytes(range(256)) * 4
+    frame = encode_chunk_frame(3, 128, payload)
+    idx, off, got = decode_chunk_frame(frame)
+    assert (idx, off, bytes(got)) == (3, 128, payload)
+
+    blob = np.random.RandomState(0).bytes(1000)
+    bounds = [(0, 300), (300, 600), (600, 1000)]
+    # chunk 2 split into two sub-frames; deliver everything out of order
+    frames = [
+        encode_chunk_frame(2, 800, blob[800:1000]),
+        encode_chunk_frame(0, 0, blob[0:300]),
+        encode_chunk_frame(2, 600, blob[600:800]),
+        encode_chunk_frame(1, 300, blob[300:600]),
+    ]
+    buf = bytearray(1000)
+    asm = ChunkAssembler(memoryview(buf), bounds)
+    completed = []
+    for f in frames:
+        completed.extend(asm.add(f))
+    assert completed == [0, 2, 1]  # whole-chunk completion, arrival order
+    assert asm.complete and bytes(buf) == blob
+
+    # truncated stream: a missing frame leaves the assembler incomplete
+    asm2 = ChunkAssembler(memoryview(bytearray(1000)), bounds)
+    for f in frames[:-1]:
+        asm2.add(f)
+    assert not asm2.complete
+    assert asm2.received_bytes == 700
+
+    # rejections: bad magic, index out of range, offset outside the chunk's
+    # bounds, overlapping bytes
+    asm3 = ChunkAssembler(memoryview(bytearray(1000)), bounds)
+    with pytest.raises(ValueError, match="magic"):
+        asm3.add(b"\x00" * 32)
+    with pytest.raises(ValueError, match="out of range"):
+        asm3.add(encode_chunk_frame(7, 0, b"x"))
+    with pytest.raises(ValueError, match="outside"):
+        asm3.add(encode_chunk_frame(0, 250, blob[250:350]))
+    asm3.add(encode_chunk_frame(0, 0, blob[0:200]))
+    with pytest.raises(ValueError, match="overlap"):
+        asm3.add(encode_chunk_frame(0, 100, blob[100:300]))
+
+
+def test_hub_repeated_failed_compactions_keep_every_segment(run, tmp_path):
+    """Two compactions in a row whose snapshots never land must leave BOTH
+    rotated-out segments on disk (numbered overflow), and restore must
+    replay them in chronological order -- no event-loop merge copy, no
+    clobber (satellite of the chunked-KV PR: _rotate_wal is rename-only)."""
+    import os
+
+    from dynamo_tpu.runtime.transports.hub import HubJournal, HubState
+
+    async def body():
+        d = str(tmp_path / "hub")
+        j = HubJournal(d, compact_every=1000)
+        j.open()
+        j._write_record(j._wal, {"op": "kv_put", "key": "a", "lease": 0}, b"1")
+        j._wal.flush()
+        segs1 = j._rotate_wal()  # wal -> wal.old (snapshot never lands)
+        j._write_record(j._wal, {"op": "kv_put", "key": "a", "lease": 0}, b"2")
+        j._write_record(j._wal, {"op": "kv_put", "key": "b", "lease": 0}, b"x")
+        j._wal.flush()
+        segs2 = j._rotate_wal()  # wal -> wal.old.1 (numbered overflow)
+        j._write_record(j._wal, {"op": "kv_put", "key": "a", "lease": 0}, b"3")
+        j._wal.flush()
+        j.close()
+        assert segs1 == [j.wal_old_path]
+        assert segs2 == [j.wal_old_path, j.wal_old_path + ".1"]
+        assert os.path.exists(j.wal_old_path + ".1")
+
+        st = HubState()
+        HubJournal(d).load_into(st)
+        # chronological replay: the newest write of "a" wins
+        assert st.kv["a"].value == b"3"
+        assert st.kv["b"].value == b"x"
+
+        # a snapshot over the captured segments removes exactly them
+        j2 = HubJournal(d)
+        j2._write_snapshot(j2._capture(st), segs2)
+        assert not os.path.exists(j2.wal_old_path)
+        assert not os.path.exists(j2.wal_old_path + ".1")
+        st2 = HubState()
+        HubJournal(d).load_into(st2)
+        assert st2.kv["a"].value == b"3" and st2.kv["b"].value == b"x"
+
+    run(body())
