@@ -1,0 +1,34 @@
+"""dynalint: repo-specific AST static analysis for async/JAX hot paths.
+
+The serving stack's hazard classes are mechanical -- a blocking call on an
+event loop, a silent ``except Exception`` around a KV transfer, a host
+sync on the tick loop -- so they are checked mechanically: six AST rules
+(DT001-DT006), inline ``# dynalint: disable=RULE`` suppressions, a
+checked-in baseline for grandfathered findings, and a CLI
+(``python -m dynamo_tpu.analysis``) that tier-1 runs as a zero-violation
+gate.  Stdlib-only by design.
+
+Public surface:
+
+* :func:`dynamo_tpu.analysis.hotpath.hot_path` -- mark a serving-critical
+  function for DT004/DT005 (imported by engine code; pure annotation).
+* :class:`Analyzer`, :class:`Baseline`, :data:`ALL_RULES` -- programmatic
+  use (the tier-1 gate test drives these directly).
+* :func:`dynamo_tpu.analysis.cli.run` -- the CLI.
+"""
+
+from .core import Analyzer, Baseline, Finding, ModuleInfo, Rule
+from .hotpath import HOT_PATH_MANIFEST, hot_path
+from .rules import ALL_RULES, get_rules
+
+__all__ = [
+    "ALL_RULES",
+    "Analyzer",
+    "Baseline",
+    "Finding",
+    "HOT_PATH_MANIFEST",
+    "ModuleInfo",
+    "Rule",
+    "get_rules",
+    "hot_path",
+]

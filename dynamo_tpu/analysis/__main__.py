@@ -1,0 +1,8 @@
+"""``python -m dynamo_tpu.analysis`` entry point."""
+
+import sys
+
+from .cli import run
+
+if __name__ == "__main__":
+    sys.exit(run())

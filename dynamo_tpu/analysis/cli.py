@@ -1,0 +1,164 @@
+"""dynalint CLI: ``python -m dynamo_tpu.analysis [paths...]``.
+
+Exit status is the CI contract: 0 when no non-baselined findings, 1 when
+any remain, 2 on usage / unreadable-source errors.  ``--format json``
+emits a stable machine-readable report (sorted findings, schema versioned)
+for future CI consumption.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .core import Analyzer, Baseline, Finding
+from .rules import ALL_RULES, get_rules
+
+JSON_SCHEMA_VERSION = 1
+
+
+def _default_target() -> str:
+    """With no paths: analyze the dynamo_tpu package this module lives in."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dynamo_tpu.analysis",
+        description="dynalint: AST hazard analysis for async/JAX hot paths "
+                    "(rules DT001-DT006)",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files or directories to analyze (default: the dynamo_tpu "
+             "package)",
+    )
+    p.add_argument(
+        "--root", default=None,
+        help="directory findings paths are reported relative to (default: "
+             "the common parent of the analyzed paths); must match between "
+             "runs for baseline fingerprints to be stable",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        dest="fmt", help="output format (default: text)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="JSON baseline of grandfathered findings to subtract",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current findings to --baseline (requires "
+             "--baseline) and exit 0",
+    )
+    p.add_argument(
+        "--select", default=None, metavar="DT001,DT003",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    p.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="suppress the summary line (findings still print)",
+    )
+    return p
+
+
+def _resolve_root(paths: Sequence[str], root: Optional[str]) -> str:
+    if root:
+        return os.path.abspath(root)
+    abspaths = [os.path.abspath(p) for p in paths]
+    common = os.path.commonpath(abspaths)
+    if os.path.isfile(common):
+        common = os.path.dirname(common)
+    # report paths as "dynamo_tpu/..." rather than bare module names when
+    # the target is the package directory itself
+    parent = os.path.dirname(common)
+    return parent if parent else common
+
+
+def run(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.name}  [{rule.severity}]")
+            print(f"       {rule.description}")
+        return 0
+
+    try:
+        rules = get_rules(args.select.split(",") if args.select else None)
+    except ValueError as e:
+        print(f"dynalint: {e}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or [_default_target()]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"dynalint: no such path: {missing}", file=sys.stderr)
+        return 2
+
+    analyzer = Analyzer(rules, root=_resolve_root(paths, args.root))
+    findings = analyzer.analyze_paths(paths)
+
+    if args.write_baseline:
+        if not args.baseline:
+            print(
+                "dynalint: --write-baseline requires --baseline FILE",
+                file=sys.stderr,
+            )
+            return 2
+        Baseline.from_findings(findings).save(args.baseline)
+        print(
+            f"dynalint: wrote {len(findings)} finding(s) to {args.baseline}"
+        )
+        return 0
+
+    baselined = 0
+    if args.baseline and os.path.exists(args.baseline):
+        baseline = Baseline.load(args.baseline)
+        kept = baseline.filter(findings)
+        baselined = len(findings) - len(kept)
+        findings = kept
+
+    if args.fmt == "json":
+        print(_render_json(findings, analyzer.errors, baselined))
+    else:
+        for f in findings:
+            print(f.render())
+        for err in analyzer.errors:
+            print(f"dynalint: parse error: {err}", file=sys.stderr)
+        if not args.quiet:
+            extra = f" ({baselined} baselined)" if baselined else ""
+            print(
+                f"dynalint: {len(findings)} finding(s){extra}, "
+                f"{len(analyzer.errors)} parse error(s)"
+            )
+    if analyzer.errors:
+        return 2
+    return 1 if findings else 0
+
+
+def _render_json(
+    findings: List[Finding], errors: List[str], baselined: int
+) -> str:
+    by_rule: dict = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    doc = {
+        "schema_version": JSON_SCHEMA_VERSION,
+        "findings": [f.to_dict() for f in findings],
+        "summary": {
+            "total": len(findings),
+            "baselined": baselined,
+            "by_rule": {k: by_rule[k] for k in sorted(by_rule)},
+            "parse_errors": errors,
+        },
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -1,0 +1,328 @@
+"""dynalint core: module loading, suppressions, baseline, rule engine.
+
+A *rule* inspects one parsed module at a time and yields findings; the
+:class:`Analyzer` walks a file set, applies inline suppressions and an
+optional checked-in baseline, and reports what is left.  Everything is
+stdlib-only (``ast`` + ``tokenize``): the linter must run in the tier-1
+test environment with no third-party dependencies.
+
+Suppressions
+------------
+``# dynalint: disable=DT001`` (comma-separate for several rules, ``*`` for
+all) suppresses findings anchored to that physical line.  A *standalone*
+comment line suppresses the next code line instead (skipping blank lines
+and further comments), so multi-line justifications can sit above the
+statement::
+
+    # dynalint: disable=DT004 -- the pipeline's one designed sync point
+    mats = jax.device_get(handles)
+
+Baseline
+--------
+Grandfathered findings live in a JSON baseline keyed by a *fingerprint*
+that survives unrelated edits: rule id + module-relative path + enclosing
+qualname + the normalized source line text.  Identical lines in the same
+function share a fingerprint, so the baseline stores a count per
+fingerprint; new occurrences beyond the grandfathered count still fail.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+BASELINE_VERSION = 1
+
+_DISABLE_TAG = "dynalint:"
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str  # "error" | "warning"
+    path: str  # analyzer-root-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+    qualname: str = ""  # enclosing function/class dotted path, "" = module
+    source_line: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity for baselining: independent of line numbers."""
+        basis = "\x1f".join(
+            (self.rule, self.path, self.qualname, self.source_line.strip())
+        )
+        return hashlib.sha1(basis.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "qualname": self.qualname,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        ctx = f" [{self.qualname}]" if self.qualname else ""
+        return f"{where}: {self.rule} {self.severity}: {self.message}{ctx}"
+
+
+# ---------------------------------------------------------------------------
+# Parsed module + suppression map
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file plus everything rules need to inspect it."""
+
+    abspath: str
+    relpath: str  # '/'-separated, relative to the analyzer root
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    # line number -> set of suppressed rule ids ("*" = all)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def is_suppressed(self, rule: str, lineno: int) -> bool:
+        rules = self.suppressions.get(lineno)
+        return bool(rules) and ("*" in rules or rule in rules)
+
+
+def _parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Collect ``# dynalint: disable=...`` comments via the token stream.
+
+    Trailing comments suppress their own line; standalone comment lines
+    suppress the next code line (justification-above style, blank lines
+    and further comment lines skipped).
+    """
+    out: Dict[int, Set[str]] = {}
+    lines = source.splitlines()
+
+    def next_code_line(line: int) -> int:
+        """First line after ``line`` that is not blank or comment-only."""
+        i = line  # 0-based index of the line AFTER the 1-based ``line``
+        while i < len(lines):
+            stripped = lines[i].strip()
+            if stripped and not stripped.startswith("#"):
+                return i + 1
+            i += 1
+        return line + 1
+
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            text = tok.string.lstrip("#").strip()
+            if not text.startswith(_DISABLE_TAG):
+                continue
+            text = text[len(_DISABLE_TAG):].strip()
+            if not text.startswith("disable="):
+                continue
+            spec = text[len("disable="):]
+            # allow a trailing justification: "DT004 -- why this is fine"
+            spec = spec.split("--", 1)[0].split("#", 1)[0]
+            rules = {r.strip() for r in spec.split(",") if r.strip()}
+            if not rules:
+                continue
+            line = tok.start[0]
+            standalone = tok.line[: tok.start[1]].strip() == ""
+            target = next_code_line(line) if standalone else line
+            out.setdefault(target, set()).update(rules)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def load_module(abspath: str, root: str) -> Optional[ModuleInfo]:
+    """Parse one file; returns None (caller reports) on unreadable source."""
+    with open(abspath, "rb") as f:
+        raw = f.read()
+    source = raw.decode("utf-8", errors="replace")
+    tree = ast.parse(source, filename=abspath)  # SyntaxError propagates
+    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+    return ModuleInfo(
+        abspath=abspath,
+        relpath=rel,
+        source=source,
+        tree=tree,
+        lines=source.splitlines(),
+        suppressions=_parse_suppressions(source),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    """One check.  Subclasses set the class attributes and implement
+    :meth:`check`, yielding findings (suppressions/baseline are applied by
+    the analyzer, not the rule)."""
+
+    id: str = "DT000"
+    name: str = "unnamed"
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self,
+        module: ModuleInfo,
+        node: ast.AST,
+        message: str,
+        qualname: str = "",
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.id,
+            severity=self.severity,
+            path=module.relpath,
+            line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            qualname=qualname,
+            source_line=module.source_line(line),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+class Baseline:
+    """Grandfathered findings: fingerprint -> allowed count."""
+
+    def __init__(self, counts: Optional[Dict[str, int]] = None) -> None:
+        self.counts: Dict[str, int] = dict(counts or {})
+        self.meta: Dict[str, Dict[str, object]] = {}
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding]) -> "Baseline":
+        bl = cls()
+        for f in findings:
+            fp = f.fingerprint
+            bl.counts[fp] = bl.counts.get(fp, 0) + 1
+            bl.meta.setdefault(
+                fp,
+                {"rule": f.rule, "path": f.path, "qualname": f.qualname,
+                 "line": f.source_line.strip()},
+            )
+        return bl
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != BASELINE_VERSION:
+            raise ValueError(
+                f"unsupported baseline version {data.get('version')!r}"
+            )
+        bl = cls()
+        for fp, entry in (data.get("findings") or {}).items():
+            bl.counts[fp] = int(entry.get("count", 1))
+            bl.meta[fp] = {
+                k: entry[k] for k in ("rule", "path", "qualname", "line")
+                if k in entry
+            }
+        return bl
+
+    def save(self, path: str) -> None:
+        findings = {}
+        for fp in sorted(self.counts):
+            entry: Dict[str, object] = dict(self.meta.get(fp, {}))
+            entry["count"] = self.counts[fp]
+            findings[fp] = entry
+        data = {"version": BASELINE_VERSION, "findings": findings}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def filter(self, findings: Sequence[Finding]) -> List[Finding]:
+        """Drop findings the baseline grandfathers (up to the recorded
+        count per fingerprint); everything beyond is returned as new."""
+        budget = dict(self.counts)
+        fresh: List[Finding] = []
+        for f in findings:
+            fp = f.fingerprint
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1
+            else:
+                fresh.append(f)
+        return fresh
+
+
+# ---------------------------------------------------------------------------
+# Analyzer
+# ---------------------------------------------------------------------------
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", ".venv")
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+        elif p.endswith(".py"):
+            yield p
+
+
+class Analyzer:
+    def __init__(self, rules: Sequence[Rule], root: Optional[str] = None):
+        self.rules = list(rules)
+        self.root = os.path.abspath(root) if root else os.getcwd()
+        self.errors: List[str] = []  # unparseable files
+
+    def analyze_paths(self, paths: Sequence[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in iter_python_files(paths):
+            findings.extend(self.analyze_file(path))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings
+
+    def analyze_file(self, path: str) -> List[Finding]:
+        try:
+            module = load_module(os.path.abspath(path), self.root)
+        except (OSError, SyntaxError, ValueError) as e:
+            self.errors.append(f"{path}: {e}")
+            return []
+        if module is None:
+            return []
+        out: List[Finding] = []
+        for rule in self.rules:
+            for finding in rule.check(module):
+                if not module.is_suppressed(finding.rule, finding.line):
+                    out.append(finding)
+        return out

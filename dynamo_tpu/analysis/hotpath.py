@@ -1,0 +1,77 @@
+"""Hot-path markers for dynalint (DT004/DT005).
+
+A *hot path* is a function on the per-token serving critical path: the
+engine tick loop, prefill/decode step assembly, sampling, and the
+paged-attention callers.  Inside these, an accidental host-device sync
+(``np.asarray`` on a device array, ``jax.device_get``,
+``.block_until_ready()``) serializes the software-pipelined device queue
+behind a full device->host round trip, and a ``jnp.asarray`` over a
+request-shaped Python list is a recompile hazard.  dynalint's DT004/DT005
+rules scan exactly the functions marked here.
+
+Two ways to mark a function:
+
+* decorate it with :func:`hot_path` -- preferred for code this package owns
+  (the decorator is a pure annotation: it tags and returns the SAME function
+  object, so ``jax.jit``, ``functools.partial`` introspection and pickling
+  are unaffected);
+* list it in :data:`HOT_PATH_MANIFEST` -- for modules where editing every
+  function is churn (e.g. the jitted step/kernel files whose whole surface
+  is hot).  Keys are module-path suffixes (``/``-separated), values are
+  ``fnmatch`` patterns over function qualnames.
+
+This module must stay import-light (no jax/numpy): engine modules import
+the decorator, and the analyzer imports the manifest.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+HOT_PATH_ATTR = "__dynalint_hot_path__"
+
+# module-path suffix -> qualname fnmatch patterns.  Every function matching
+# a pattern in a matching module is analyzed as a hot path.
+HOT_PATH_MANIFEST: Dict[str, List[str]] = {
+    # the whole jitted step-assembly surface is hot: everything here runs
+    # under jax.jit inside the tick loop's dispatch
+    "dynamo_tpu/engine/step.py": [
+        "decode_block",
+        "prefill_and_sample",
+        "prefill_mm_and_sample",
+        "prefill_suffix_and_sample",
+        "sample_step",
+        "sample_step_packed",
+        "embed_step",
+        "update_lanes",
+        "inject_token",
+        "inject_tokens",
+        "zero_count_rows",
+        "bump_counts",
+        "seed_count_rows",
+        "scatter_block_pages",
+        "slice_block_pages",
+    ],
+    # paged-attention kernels + the layer-page gather/scatter used by the
+    # chunked KV delivery scatter on the tick loop
+    "dynamo_tpu/ops/paged_attention.py": [
+        "paged_attention*",
+        "gather_layer_pages",
+        "scatter_layer_pages",
+    ],
+}
+
+
+def hot_path(fn: F) -> F:
+    """Mark ``fn`` as serving-critical for dynalint DT004/DT005.
+
+    Returns ``fn`` itself (tagged, not wrapped): safe above/below
+    ``jax.jit`` and any decorator that inspects the function object.
+    """
+    try:
+        setattr(fn, HOT_PATH_ATTR, True)
+    except (AttributeError, TypeError):  # builtins / slotted callables
+        pass
+    return fn

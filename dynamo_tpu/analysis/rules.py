@@ -1,0 +1,705 @@
+"""dynalint rules DT001-DT006: this repo's real async/JAX hazard classes.
+
+Each rule is deliberately narrow: it encodes a bug class this codebase has
+actually exhibited (blocking WAL I/O on the hub event loop, silent
+``except Exception`` swallows around KV transfers, host-device syncs on
+the tick loop), not a general style guide.  False-positive pressure is
+handled three ways, in order of preference: fix the code, add an inline
+``# dynalint: disable=RULE -- justification``, or baseline it.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, ModuleInfo, Rule
+from .hotpath import HOT_PATH_MANIFEST
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'time.sleep' for Attribute chains over Names; None when the base is
+    an arbitrary expression (call result, subscript, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FunctionInfo:
+    def __init__(self, node: ast.AST, qualname: str, cls: Optional[str]):
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls  # enclosing class name, if a method
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+def collect_functions(tree: ast.Module) -> List[FunctionInfo]:
+    out: List[FunctionInfo] = []
+
+    def walk(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                out.append(FunctionInfo(child, qn, cls))
+                walk(child, qn + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.", child.name)
+            else:
+                walk(child, prefix, cls)
+
+    walk(tree, "", None)
+    return out
+
+
+def own_body_walk(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's statements without descending into nested
+    function/lambda scopes (their bodies run elsewhere -- executors,
+    callbacks -- so async-context rules must not see them)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _body_contains_await(nodes: Sequence[ast.AST]) -> bool:
+    """Await anywhere in these statements, nested sync scopes excluded."""
+    stack: List[ast.AST] = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+# ---------------------------------------------------------------------------
+# DT001: blocking calls inside async def
+# ---------------------------------------------------------------------------
+
+_BLOCKING_CALLS: Dict[str, str] = {
+    "time.sleep": "use asyncio.sleep",
+    "open": "use asyncio.to_thread / run_in_executor",
+    "io.open": "use asyncio.to_thread / run_in_executor",
+    "os.fsync": "use asyncio.to_thread / run_in_executor",
+    "os.fdatasync": "use asyncio.to_thread / run_in_executor",
+    "subprocess.run": "use asyncio.create_subprocess_exec",
+    "subprocess.call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_output": "use asyncio.create_subprocess_exec",
+    "subprocess.getoutput": "use asyncio.create_subprocess_exec",
+    "socket.create_connection": "use asyncio.open_connection",
+}
+
+_FILE_METHODS = {
+    "read", "readline", "readlines", "write", "writelines", "flush", "seek",
+}
+_SOCKET_METHODS = {
+    "connect", "accept", "recv", "recvfrom", "send", "sendall", "sendto",
+    "makefile",
+}
+
+
+def _open_bound_names(fn: ast.AST) -> Set[str]:
+    """Names bound to sync file handles inside this function:
+    ``f = open(...)`` and ``with open(...) as f``."""
+    out: Set[str] = set()
+    for node in own_body_walk(fn):
+        if isinstance(node, ast.Assign):
+            if (
+                isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func) in ("open", "io.open")
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if (
+                    isinstance(item.context_expr, ast.Call)
+                    and dotted_name(item.context_expr.func)
+                    in ("open", "io.open")
+                    and isinstance(item.optional_vars, ast.Name)
+                ):
+                    out.add(item.optional_vars.id)
+    return out
+
+
+def _socket_bound_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in own_body_walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            d = dotted_name(node.value.func)
+            if d in ("socket.socket", "socket.create_connection"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _direct_blocking_ops(fn: ast.AST) -> List[Tuple[ast.Call, str]]:
+    """(call node, description) for every lexically-direct blocking call in
+    this function's own scope."""
+    out: List[Tuple[ast.Call, str]] = []
+    file_names = _open_bound_names(fn)
+    sock_names = _socket_bound_names(fn)
+    for node in own_body_walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        if d in _BLOCKING_CALLS:
+            out.append((node, f"blocking call '{d}()' ({_BLOCKING_CALLS[d]})"))
+            continue
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            base = node.func.value
+            base_name = base.id if isinstance(base, ast.Name) else None
+            if base_name in file_names and attr in _FILE_METHODS:
+                out.append(
+                    (node, f"sync file I/O '{base_name}.{attr}()' on a "
+                           "handle from open()")
+                )
+            elif base_name in sock_names and attr in _SOCKET_METHODS:
+                out.append(
+                    (node, f"blocking socket op '{base_name}.{attr}()'")
+                )
+            elif (
+                attr == "result"
+                and not node.args
+                and not node.keywords
+                and isinstance(base, (ast.Name, ast.Attribute))
+            ):
+                out.append(
+                    (node, f"'{dotted_name(node.func)}()' -- Future.result() "
+                           "blocks the loop; await the future instead")
+                )
+            elif isinstance(base, ast.Call) and dotted_name(base.func) in (
+                "open", "io.open",
+            ):
+                out.append(
+                    (node, f"sync file I/O 'open(...).{attr}()'")
+                )
+    return out
+
+
+class BlockingInAsync(Rule):
+    id = "DT001"
+    name = "blocking-call-in-async"
+    severity = "error"
+    description = (
+        "Blocking calls (time.sleep, sync open/read/write, subprocess, "
+        "socket ops, Future.result()) inside 'async def', directly or via a "
+        "sync helper defined in the same module, stall the event loop."
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        functions = collect_functions(module.tree)
+        # name -> FunctionInfos, for intra-module transitive resolution
+        by_name: Dict[str, List[FunctionInfo]] = {}
+        for fi in functions:
+            by_name.setdefault(fi.name, []).append(fi)
+
+        direct: Dict[int, List[Tuple[ast.Call, str]]] = {
+            id(fi.node): _direct_blocking_ops(fi.node) for fi in functions
+        }
+
+        def resolve(call: ast.Call, caller: FunctionInfo) -> Optional[FunctionInfo]:
+            d = dotted_name(call.func)
+            if d is None:
+                return None
+            if "." not in d:  # bare name: module-level function only
+                for cand in by_name.get(d, ()):
+                    if cand.cls is None:
+                        return cand
+                return None
+            base, _, meth = d.rpartition(".")
+            if base in ("self", "cls") and caller.cls is not None:
+                for cand in by_name.get(meth, ()):
+                    if cand.cls == caller.cls:
+                        return cand
+            return None
+
+        # transitive: does fn (or a same-module sync callee chain) block?
+        memo: Dict[int, Optional[str]] = {}
+
+        def blocks(fi: FunctionInfo, stack: Set[int]) -> Optional[str]:
+            key = id(fi.node)
+            if key in memo:
+                return memo[key]
+            if key in stack:
+                return None
+            stack.add(key)
+            verdict: Optional[str] = None
+            ops = direct[key]
+            if ops:
+                node, desc = ops[0]
+                verdict = f"{desc} at line {node.lineno}"
+            else:
+                for sub in own_body_walk(fi.node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    callee = resolve(sub, fi)
+                    if callee is None or callee.is_async:
+                        continue
+                    inner = blocks(callee, stack)
+                    if inner is not None:
+                        verdict = f"'{callee.name}()' -> {inner}"
+                        break
+            stack.discard(key)
+            memo[key] = verdict
+            return verdict
+
+        for fi in functions:
+            if not fi.is_async:
+                continue
+            for node, desc in direct[id(fi.node)]:
+                yield self.finding(
+                    module, node, f"{desc} in async function", fi.qualname
+                )
+            for sub in own_body_walk(fi.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                callee = resolve(sub, fi)
+                if callee is None or callee.is_async:
+                    continue
+                chain = blocks(callee, set())
+                if chain is not None:
+                    yield self.finding(
+                        module, sub,
+                        f"async function calls sync helper "
+                        f"'{callee.name}()' which blocks: {chain}",
+                        fi.qualname,
+                    )
+
+
+# ---------------------------------------------------------------------------
+# DT002: threading lock held across await
+# ---------------------------------------------------------------------------
+
+
+class ThreadingLockAcrossAwait(Rule):
+    id = "DT002"
+    name = "threading-lock-across-await"
+    severity = "error"
+    description = (
+        "A threading.Lock/RLock acquired in an async scope that awaits "
+        "while holding it can deadlock the loop (the release may need the "
+        "loop thread) and blocks every other coroutine meanwhile."
+    )
+
+    def _lock_names(self, tree: ast.Module) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                d = dotted_name(node.value.func)
+                if d in ("threading.Lock", "threading.RLock"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+                        elif isinstance(t, ast.Attribute):
+                            names.add(t.attr)
+        return names
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        locks = self._lock_names(module.tree)
+        if not locks:
+            return
+        for fi in collect_functions(module.tree):
+            if not fi.is_async:
+                continue
+            for node in own_body_walk(fi.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        ref = self._lock_ref(item.context_expr, locks)
+                        if ref and _body_contains_await(node.body):
+                            yield self.finding(
+                                module, node,
+                                f"threading lock '{ref}' held across "
+                                "'await' in async function (use "
+                                "asyncio.Lock or release before awaiting)",
+                                fi.qualname,
+                            )
+                elif isinstance(node, ast.Call):
+                    if (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "acquire"
+                    ):
+                        ref = self._lock_ref(node.func.value, locks)
+                        if ref:
+                            yield self.finding(
+                                module, node,
+                                f"blocking acquire() of threading lock "
+                                f"'{ref}' in async function",
+                                fi.qualname,
+                            )
+
+    @staticmethod
+    def _lock_ref(expr: ast.AST, locks: Set[str]) -> Optional[str]:
+        d = dotted_name(expr)
+        if d is None:
+            return None
+        last = d.rpartition(".")[2]
+        return d if last in locks else None
+
+
+# ---------------------------------------------------------------------------
+# DT003: silent except swallow
+# ---------------------------------------------------------------------------
+
+_LOG_METHOD_NAMES = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log", "print_exc",
+}
+_LOG_FUNC_NAMES = {"print", "log_once", "log_throttled", "warn_once"}
+_BROAD = {"Exception", "BaseException"}
+
+
+class SilentExceptSwallow(Rule):
+    id = "DT003"
+    name = "silent-except-swallow"
+    severity = "warning"
+    description = (
+        "'except Exception' / bare 'except' whose body neither logs, "
+        "re-raises, nor uses the caught exception silently destroys the "
+        "only evidence of a failure."
+    )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        functions = collect_functions(module.tree)
+        qual_by_node = {id(fi.node): fi.qualname for fi in functions}
+
+        def enclosing_qualname(handler: ast.excepthandler) -> str:
+            best = ""
+            for fi in functions:
+                n = fi.node
+                if (
+                    n.lineno <= handler.lineno
+                    and handler.lineno <= (n.end_lineno or n.lineno)
+                ):
+                    best = qual_by_node[id(n)]
+            return best
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not self._is_broad(handler.type):
+                    continue
+                if self._is_handled(handler):
+                    continue
+                what = (
+                    "bare 'except:'" if handler.type is None
+                    else "'except Exception'"
+                )
+                yield self.finding(
+                    module, handler,
+                    f"{what} swallows the error silently: log it "
+                    "(log_throttled for hot paths), re-raise, or use the "
+                    "bound exception",
+                    enclosing_qualname(handler),
+                )
+
+    @staticmethod
+    def _is_broad(t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True
+        if isinstance(t, ast.Name):
+            return t.id in _BROAD
+        if isinstance(t, ast.Tuple):
+            return any(
+                isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+            )
+        return False
+
+    @staticmethod
+    def _is_handled(handler: ast.excepthandler) -> bool:
+        bound = handler.name
+        for node in handler.body:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Raise):
+                    return True
+                if bound and isinstance(sub, ast.Name) and sub.id == bound:
+                    return True
+                if isinstance(sub, ast.Call):
+                    f = sub.func
+                    if (
+                        isinstance(f, ast.Attribute)
+                        and f.attr in _LOG_METHOD_NAMES
+                    ):
+                        return True
+                    if isinstance(f, ast.Name) and f.id in _LOG_FUNC_NAMES:
+                        return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Hot-path resolution shared by DT004/DT005
+# ---------------------------------------------------------------------------
+
+
+def _is_hot(module: ModuleInfo, fi: FunctionInfo) -> bool:
+    for dec in fi.node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        d = dotted_name(target)
+        if d is not None and d.rpartition(".")[2] == "hot_path":
+            return True
+    for suffix, patterns in HOT_PATH_MANIFEST.items():
+        if module.relpath.endswith(suffix):
+            for pat in patterns:
+                if fnmatch.fnmatchcase(fi.qualname, pat) or fnmatch.fnmatchcase(
+                    fi.name, pat
+                ):
+                    return True
+    return False
+
+
+def _hot_functions(module: ModuleInfo) -> List[FunctionInfo]:
+    """Hot-marked functions; nested defs inherit hotness (jit closures)."""
+    functions = collect_functions(module.tree)
+    hot = [fi for fi in functions if _is_hot(module, fi)]
+    hot_ids = {id(fi.node) for fi in hot}
+    out = list(hot)
+    for fi in functions:
+        if id(fi.node) in hot_ids:
+            continue
+        for h in hot:
+            hn = h.node
+            if (
+                hn.lineno < fi.node.lineno
+                and (fi.node.end_lineno or fi.node.lineno)
+                <= (hn.end_lineno or hn.lineno)
+            ):
+                out.append(fi)
+                break
+    return out
+
+
+_LIST_LITERALS = (ast.List, ast.Tuple, ast.ListComp, ast.GeneratorExp,
+                  ast.Constant, ast.Dict, ast.Set)
+
+
+# ---------------------------------------------------------------------------
+# DT004: host-device sync in hot paths
+# ---------------------------------------------------------------------------
+
+
+class HostSyncInHotPath(Rule):
+    id = "DT004"
+    name = "host-device-sync-in-hot-path"
+    severity = "warning"
+    description = (
+        "np.asarray / jax.device_get / .block_until_ready() in a function "
+        "marked @hot_path (or in the hot-path manifest) serializes the "
+        "pipelined device queue behind a device->host round trip."
+    )
+
+    _NP_CTORS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fi in _hot_functions(module):
+            for node in own_body_walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if d == "jax.device_get":
+                    yield self.finding(
+                        module, node,
+                        "jax.device_get in hot path forces a host sync",
+                        fi.qualname,
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                ):
+                    yield self.finding(
+                        module, node,
+                        ".block_until_ready() in hot path forces a host sync",
+                        fi.qualname,
+                    )
+                elif d in self._NP_CTORS and node.args:
+                    arg = node.args[0]
+                    # literals / comprehensions are host-side construction
+                    # (cheap, no device sync) -- DT005's concern, not ours
+                    if not isinstance(arg, _LIST_LITERALS):
+                        yield self.finding(
+                            module, node,
+                            f"{d}(...) on a non-literal in hot path may "
+                            "force a device->host transfer",
+                            fi.qualname,
+                        )
+
+
+# ---------------------------------------------------------------------------
+# DT005: jnp.asarray over request-shaped Python lists in hot paths
+# ---------------------------------------------------------------------------
+
+
+class RecompileHazardInHotPath(Rule):
+    id = "DT005"
+    name = "recompile-hazard-in-hot-path"
+    severity = "warning"
+    description = (
+        "jnp.asarray over a dynamically-sized Python list (list comp / "
+        "list() call) in a hot path bakes the list length into the traced "
+        "shape: every distinct request size triggers an XLA recompile. "
+        "Pad to a bucketed shape first."
+    )
+
+    _JNP_CTORS = {
+        "jnp.asarray", "jnp.array", "jax.numpy.asarray", "jax.numpy.array",
+    }
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fi in _hot_functions(module):
+            assigns: Dict[str, ast.AST] = {}
+            for node in own_body_walk(fi.node):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            assigns[t.id] = node.value
+            for node in own_body_walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if d not in self._JNP_CTORS or not node.args:
+                    continue
+                arg: ast.AST = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in assigns:
+                    arg = assigns[arg.id]
+                if isinstance(arg, ast.ListComp) or (
+                    isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Name)
+                    and arg.func.id == "list"
+                ):
+                    yield self.finding(
+                        module, node,
+                        f"{d}(...) over a dynamically-sized list in hot "
+                        "path: distinct lengths recompile the step",
+                        fi.qualname,
+                    )
+
+
+# ---------------------------------------------------------------------------
+# DT006: codec frame-kind exhaustiveness
+# ---------------------------------------------------------------------------
+
+
+class CodecFrameKindExhaustive(Rule):
+    id = "DT006"
+    name = "codec-frame-kind-exhaustive"
+    severity = "error"
+    description = (
+        "Every frame kind in runtime/transports/codec.py FRAME_KINDS must "
+        "have both an encoder (encode_<kind>*/write_<kind>*) and a decoder "
+        "(decode_<kind>*/read_<kind>*) function, so a new wire format "
+        "cannot ship half-implemented.  The kind must be the FIRST name "
+        "token after the verb: encode_chunk_frame implements 'chunk', not "
+        "'frame'."
+    )
+
+    CODEC_SUFFIX = "runtime/transports/codec.py"
+    _ENC = ("encode", "write")
+    _DEC = ("decode", "read")
+
+    @staticmethod
+    def _implements(func_name: str, verbs: Tuple[str, ...], kind: str) -> bool:
+        """True when ``func_name`` is ``<verb>_<kind>`` or
+        ``<verb>_<kind>_...`` -- an exact token match, so one kind's codec
+        cannot satisfy another kind whose name it merely contains."""
+        parts = func_name.split("_")
+        return len(parts) >= 2 and parts[0] in verbs and parts[1] == kind
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not module.relpath.endswith(self.CODEC_SUFFIX):
+            return
+        kinds_node: Optional[ast.Assign] = None
+        kinds: List[str] = []
+        func_names = [
+            n.name for n in module.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "FRAME_KINDS":
+                        kinds_node = node
+                        if isinstance(node.value, (ast.Tuple, ast.List)):
+                            kinds = [
+                                e.value for e in node.value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)
+                            ]
+        if kinds_node is None:
+            yield Finding(
+                rule=self.id, severity=self.severity, path=module.relpath,
+                line=1, col=1, qualname="",
+                message="codec module must declare a FRAME_KINDS registry "
+                        "(tuple of frame-kind names) for exhaustiveness "
+                        "checking",
+                source_line=module.source_line(1),
+            )
+            return
+        for kind in kinds:
+            has_enc = any(
+                self._implements(f, self._ENC, kind) for f in func_names
+            )
+            has_dec = any(
+                self._implements(f, self._DEC, kind) for f in func_names
+            )
+            if not has_enc:
+                yield self.finding(
+                    module, kinds_node,
+                    f"frame kind '{kind}' has no encoder "
+                    f"(encode_{kind}*/write_{kind}* function)",
+                )
+            if not has_dec:
+                yield self.finding(
+                    module, kinds_node,
+                    f"frame kind '{kind}' has no decoder "
+                    f"(decode_{kind}*/read_{kind}* function)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+ALL_RULES: List[Rule] = [
+    BlockingInAsync(),
+    ThreadingLockAcrossAwait(),
+    SilentExceptSwallow(),
+    HostSyncInHotPath(),
+    RecompileHazardInHotPath(),
+    CodecFrameKindExhaustive(),
+]
+
+
+def get_rules(select: Optional[Sequence[str]] = None) -> List[Rule]:
+    if not select:
+        return list(ALL_RULES)
+    wanted = {s.strip().upper() for s in select if s.strip()}
+    unknown = wanted - {r.id for r in ALL_RULES}
+    if unknown:
+        raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+    return [r for r in ALL_RULES if r.id in wanted]

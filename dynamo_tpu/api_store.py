@@ -223,6 +223,7 @@ class ApiStoreService:
                 elif k == key + "/status":
                     status = json.loads(v)
             except Exception:
+                logger.warning("skipping corrupt store record at %s", k)
                 continue
         if record is None:
             return _bad("not found", 404)
@@ -256,5 +257,6 @@ class ApiStoreService:
             try:
                 items.append(json.loads(v))
             except Exception:
+                logger.warning("skipping corrupt store record at %s", k)
                 continue
         return Response.json({"items": items, "total": len(items)})

@@ -20,6 +20,7 @@ stack (SSE codec, detokenizer, router, engine) is in the measured path.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import sys
 import time
@@ -305,10 +306,8 @@ async def _sse_request(
     finally:
         if writer is not None:
             writer.close()
-            try:
+            with contextlib.suppress(Exception):
                 await writer.wait_closed()
-            except Exception:
-                pass
 
 
 async def run_bench(

@@ -698,12 +698,18 @@ async def run_batch(args) -> None:
     name = _model_name(args)
     pipeline = link(OpenAIPreprocessor(name, tokenizer), Backend(tokenizer), engine)
 
-    prompts = []
-    with open(args.input_file, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if line:
-                prompts.append(_json.loads(line))
+    def _read_prompts() -> list:
+        out = []
+        with open(args.input_file, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    out.append(_json.loads(line))
+        return out
+
+    # file I/O off the loop: the engine may already be serving its tick
+    # loop on this thread
+    prompts = await asyncio.to_thread(_read_prompts)
 
     async def one(i, entry):
         text = entry.get("text") or entry.get("prompt") or ""
@@ -733,17 +739,23 @@ async def run_batch(args) -> None:
             out["error"] = error
         return out
 
-    try:
-        results = await asyncio.gather(
-            *(one(i, e) for i, e in enumerate(prompts))
+    def _write_results(results: list) -> None:
+        sink = (
+            open(args.output_file, "w", encoding="utf-8")
+            if args.output_file else sys.stdout
         )
-        sink = open(args.output_file, "w", encoding="utf-8") if args.output_file else sys.stdout
         try:
             for r in results:
                 sink.write(_json.dumps(r) + "\n")
         finally:
             if args.output_file:
                 sink.close()
+
+    try:
+        results = await asyncio.gather(
+            *(one(i, e) for i, e in enumerate(prompts))
+        )
+        await asyncio.to_thread(_write_results, results)
     finally:
         await engine.stop()
 
@@ -1187,7 +1199,8 @@ async def run_disagg_conf(args) -> int:
             try:
                 merged.update(_json.loads(value))
             except Exception:
-                pass  # malformed old value: overwrite it
+                # malformed old value: overwrite it, but say so
+                logger.warning("discarding malformed disagg conf at %s", _k)
         merged.update(conf)
         await rt.hub.kv_put(key, _json.dumps(merged).encode())
         print(f"disagg conf updated for namespace {args.namespace}: {merged}")

@@ -23,6 +23,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from ..analysis.hotpath import hot_path
+
 _NEG_INF = -1e30
 
 
@@ -38,6 +40,7 @@ def _env_flag(name: str):
 def _on_tpu() -> bool:
     try:
         return any("TPU" in d.device_kind for d in jax.devices())
+    # dynalint: disable=DT003 -- platform probe: "no backend" simply means not-TPU
     except Exception:
         return False
 
@@ -55,6 +58,7 @@ def _pallas_decode_enabled(page_size: int) -> bool:
     return page_size >= 8 and _on_tpu()
 
 
+@hot_path
 def decode_attention_dispatch(
     q: jax.Array,  # [B, Hq, D]
     kv_pages: jax.Array,  # [L, 2, num_pages, page_size, Hkv, D]
@@ -97,6 +101,7 @@ def _pallas_prefill_enabled(T: int, Hq: int, Hkv: int, D: int) -> bool:
     return _on_tpu()
 
 
+@hot_path
 def prefill_attention_dispatch(
     q: jax.Array,  # [B, T, Hq, D]
     k: jax.Array,  # [B, T, Hkv, D]
@@ -134,6 +139,7 @@ def _pallas_prefix_prefill_enabled(
     return _on_tpu()
 
 
+@hot_path
 def prefill_prefix_attention_dispatch(
     q: jax.Array,  # [B, T, Hq, D] suffix queries
     k: jax.Array,  # [B, T, Hkv, D] suffix keys (being prefilled)
@@ -197,6 +203,7 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     return jnp.repeat(x, n_rep, axis=-2)
 
 
+@hot_path
 def prefill_attention(
     q: jax.Array,  # [B, T, Hq, D]
     k: jax.Array,  # [B, T, Hkv, D]
@@ -229,6 +236,7 @@ def prefill_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+@hot_path
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D] one new query token per slot
     kv_pages: jax.Array,  # [2, num_pages, page_size, Hkv, D]
@@ -265,6 +273,7 @@ def paged_decode_attention(
     return jnp.einsum("bhk,bkhd->bhd", probs, v)
 
 
+@hot_path
 def prefill_prefix_attention(
     q: jax.Array,  # [B, T, Hq, D] suffix queries
     k: jax.Array,  # [B, T, Hkv, D] suffix keys (being prefilled)
@@ -329,6 +338,7 @@ def prefill_prefix_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
 
 
+@hot_path
 def write_prefill_kv(
     kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     k: jax.Array,  # [B, T, Hkv, D]
@@ -350,6 +360,7 @@ def write_prefill_kv(
     return kv_pages
 
 
+@hot_path
 def write_decode_kv(
     kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     k: jax.Array,  # [B, Hkv, D] one token

@@ -25,7 +25,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.hotpath import hot_path
 from ..runtime.engine import Annotated, Context, ResponseStream
+from ..runtime.utils import log_throttled
 from ..protocols.common import (
     FinishReason,
     ForwardPassMetrics,
@@ -53,6 +55,23 @@ from .step import (
 )
 
 logger = logging.getLogger("dynamo.engine")
+
+
+def _start_host_copy(arr) -> None:
+    """Kick off the async device->host DMA for ``arr`` so the later
+    device_get is a wait, not a transfer.  Purely an optimization: backends
+    without ``copy_to_host_async`` (CPU jax, some mocks) fall back to the
+    blocking fetch at commit, logged once so a silently-degraded pipeline
+    is still visible in production."""
+    try:
+        arr.copy_to_host_async()
+    except Exception:
+        log_throttled(
+            logger, "copy_to_host_async",
+            "copy_to_host_async unavailable; commits fall back to a "
+            "blocking device_get", level=logging.DEBUG, interval_s=60.0,
+            exc_info=True,
+        )
 
 
 def _enable_compilation_cache() -> None:
@@ -505,8 +524,10 @@ class JaxEngine:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                logger.debug("engine loop raised during stop", exc_info=True)
             self._task = None
         self._ex.shutdown(wait=False)
 
@@ -579,6 +600,7 @@ class JaxEngine:
                         )
                         return
                     stop_waiter.cancel()
+                    # dynalint: disable=DT001 -- 'get' is in 'done': result() is non-blocking
                     item = get.result()
                     if item is None:
                         return
@@ -1096,6 +1118,11 @@ class JaxEngine:
                 # fall back to singles: the failure may be group-induced
                 # (scratch pages for N prompts at once) and per-item errors
                 # must land on their own request
+                log_throttled(
+                    logger, "export-group-fallback",
+                    "grouped prefill export failed; retrying %d request(s) "
+                    "individually", len(group), exc_info=True,
+                )
                 for i in group:
                     try:
                         results[i] = self._prefill_export(reqs[i])
@@ -1203,6 +1230,11 @@ class JaxEngine:
                     reqs, group, results, layers_per_chunk
                 )
             except Exception:  # noqa: BLE001 - page pressure, as in batch
+                log_throttled(
+                    logger, "export-stream-fallback",
+                    "grouped streaming export failed; retrying %d "
+                    "request(s) individually", len(group), exc_info=True,
+                )
                 for i in group:
                     try:
                         results[i] = KVExportStream.from_blob(
@@ -1265,10 +1297,7 @@ class JaxEngine:
                     jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
                     ids_dev,
                 )
-                try:
-                    sl.copy_to_host_async()
-                except Exception:
-                    pass  # optional fast path; device_get still works
+                _start_host_copy(sl)
                 span_devs.append(sl)
             firsts = np.asarray(jax.device_get(sampled))  # [Bp, 2 + 2N]
             shared = _GroupSpanExport(span_devs)
@@ -1373,6 +1402,7 @@ class JaxEngine:
 
     # -- the tick loop ------------------------------------------------------
 
+    @hot_path
     async def _run(self) -> None:
         """The tick loop, software-pipelined over the device queue.
 
@@ -1948,6 +1978,7 @@ class JaxEngine:
             return self._dispatch_chunk(seq)
         return self._finish_prefill(seq, prompt_len, start)
 
+    @hot_path
     def _dispatch_chunk(self, seq: SeqState) -> Optional[InflightPrefill]:
         """Advance one page-aligned chunk of a chunked prefill (executor
         thread).  Intermediate chunks write KV and sample nothing; the final
@@ -2034,6 +2065,7 @@ class JaxEngine:
                      seq.request_id, prompt_len, bucket)
         return pf
 
+    @hot_path
     def _do_prefill_group(
         self, items: List[Tuple[SeqState, int]]
     ) -> List["InflightPrefillGroup"]:
@@ -2102,10 +2134,7 @@ class JaxEngine:
             )
             entries.append(pf)
         self._steps += 1
-        try:
-            sampled.copy_to_host_async()
-        except Exception:
-            pass  # optional fast path; the commit device_get still works
+        _start_host_copy(sampled)
         # ONE group handle: commit fetches the [Bp] array in one transfer
         # instead of one round trip per lane's [1] slice
         return [InflightPrefillGroup(sampled=sampled, entries=entries)]
@@ -2144,6 +2173,7 @@ class JaxEngine:
                 row[j] = t
         return row
 
+    @hot_path
     def _apply_dirty_rows(self) -> None:
         """Fold mirror changes for dirty lanes into the device-resident state
         with per-row scatters (executor thread).
@@ -2443,6 +2473,7 @@ class JaxEngine:
                 )
         return counts
 
+    @hot_path
     def _dispatch_block(self) -> Optional["InflightBlock"]:
         """Enqueue one decode block; does not wait for results."""
         K = self.cfg.decode_block_size
@@ -2520,10 +2551,7 @@ class JaxEngine:
         if use_penalties:
             d["counts"] = counts_out
         self._steps += 1
-        try:
-            sampled.copy_to_host_async()
-        except Exception:
-            pass  # optional fast path; device_get below still works
+        _start_host_copy(sampled)
         return InflightBlock(sampled=sampled, slots=list(self.sched.slots))
 
     # -- KV offload (G1 -> G2 -> G3; SURVEY.md 5.4) ------------------------
@@ -2543,10 +2571,7 @@ class JaxEngine:
             snap = slice_block_pages(
                 self.kv.pages, jnp.asarray(blk.pages, jnp.int32)
             )
-            try:
-                snap.copy_to_host_async()
-            except Exception:
-                pass
+            _start_host_copy(snap)
             meta = BlockMeta(
                 block_hash=blk.block_hash,
                 parent_sequence_hash=blk.parent_sequence_hash,
@@ -2594,6 +2619,7 @@ class JaxEngine:
             # register False: twin onboarded it concurrently; keep ownership
         seq.pending_onboard = []
 
+    @hot_path
     def _commit_all(self, entries: List[Any]) -> List[StepEvent]:
         """Materialize and commit pending prefills/blocks in dispatch order
         (one bundled device_get instead of one round trip per handle)."""
@@ -2613,6 +2639,8 @@ class JaxEngine:
                 for h in handles
             ]
         else:
+            # dynalint: disable=DT004 -- the pipeline's ONE designed sync point:
+            # block i's results materialize here while block i+1 computes
             mats = jax.device_get(handles)
         self._drain_offload()
         events: List[StepEvent] = []
@@ -2640,15 +2668,16 @@ class JaxEngine:
                 )
             )
 
+        # mats are host-resident np arrays (device_get / allgather output):
+        # no further np.asarray wrapping, which would read as a sync here
         for e, mat in zip(entries, mats):
             if isinstance(e, InflightPrefillGroup):
-                arr = np.asarray(mat)  # [Bp, 2 + 2N]
                 for i, pf in enumerate(e.entries):
-                    commit_prefill(pf, arr[i])
+                    commit_prefill(pf, mat[i])  # [Bp, 2 + 2N]
             elif isinstance(e, InflightPrefill):
-                commit_prefill(e, np.asarray(mat)[0])
+                commit_prefill(e, mat[0])
             else:
-                arr = np.asarray(mat)  # [B, K, 2 + 2N]
+                arr = mat  # [B, K, 2 + 2N]
                 N = (arr.shape[-1] - 2) // 2
                 toks, lps, tids, tlps = unpack_sampled_logprobs(arr, N)
                 events.extend(

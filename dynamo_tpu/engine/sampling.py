@@ -14,6 +14,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis.hotpath import hot_path
+
 _NEG_INF = -1e30
 
 
@@ -68,6 +70,7 @@ def _lane_gumbel(
     return jax.vmap(lambda k: jax.random.gumbel(k, (V,)))(keys)
 
 
+@hot_path
 def sample_tokens(
     logits: jax.Array,  # [B, V] float32
     rng: jax.Array,
@@ -123,6 +126,7 @@ def sample_tokens(
     sampled = jnp.argmax(masked + gumbel, axis=-1).astype(jnp.int32)
     return jnp.where(params.temperature <= 0.0, greedy, sampled)
 
+@hot_path
 def token_logprobs(
     logits: jax.Array,  # [B, V] float32
     sampled: jax.Array,  # [B] int32
@@ -148,6 +152,7 @@ def token_logprobs(
     return chosen, top_ids.astype(jnp.int32), top_lps
 
 
+@hot_path
 def pack_sampled_logprobs(
     sampled: jax.Array,  # [B] int32
     chosen_lp: jax.Array,  # [B] f32
@@ -193,6 +198,7 @@ def unpack_sampled_logprobs(packed, top_n: int):
 PROMPT_FLAG = 1 << 16
 
 
+@hot_path
 def apply_penalties(
     logits: jax.Array,  # [B, V] f32
     counts: jax.Array,  # [B, V] i32 packed histogram (see PROMPT_FLAG)

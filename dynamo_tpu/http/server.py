@@ -119,7 +119,7 @@ class HttpServer:
                 try:
                     w.close()
                 except Exception:
-                    pass
+                    logger.debug("closing live connection failed", exc_info=True)
             await self._server.wait_closed()
             self._server = None
 
@@ -175,7 +175,8 @@ class HttpServer:
             try:
                 await writer.wait_closed()
             except Exception:
-                pass
+                # peer vanished mid-teardown: routine, but keep a trace
+                logger.debug("connection teardown failed", exc_info=True)
 
     async def _read_request(
         self, reader: asyncio.StreamReader
@@ -253,6 +254,8 @@ class HttpServer:
                 try:
                     await aclose()
                 except Exception:
-                    pass
+                    # the stream generator's cleanup failed AFTER its last
+                    # chunk; the response is intact but leaks deserve a trace
+                    logger.debug("stream body aclose() failed", exc_info=True)
         writer.write(b"0\r\n\r\n")
         await writer.drain()

@@ -193,7 +193,7 @@ class MetricsService:
                 writer.write(head.encode() + payload)
                 await writer.drain()
             except Exception:
-                pass
+                logger.debug("metrics scrape reply failed", exc_info=True)
             finally:
                 with contextlib.suppress(Exception):
                     writer.close()

@@ -44,6 +44,7 @@ from ..protocols.common import PreprocessedRequest
 from ..runtime.component import Namespace, PushRouter
 from ..runtime.engine import Annotated, AsyncEngineContext, Context
 from ..runtime.transports.codec import ChunkAssembler, iter_chunk_frames
+from ..runtime.utils import log_throttled
 
 logger = logging.getLogger("dynamo.disagg")
 
@@ -255,7 +256,15 @@ class DisaggDecodeEngine:
             try:
                 self._depth = await self.queue.depth()
             except Exception:
-                # force local on hub trouble
+                # force local on hub trouble -- and say so: every request
+                # silently running local prefill is a capacity regression
+                # someone must be able to see (throttled: this fires per
+                # request window while the hub is down)
+                log_throttled(
+                    logger, "disagg-depth",
+                    "prefill queue depth unavailable (hub unreachable?); "
+                    "forcing local prefill", exc_info=True,
+                )
                 self._depth = self.router.cfg.max_prefill_queue_depth
             self._depth_at = now
         return self._depth

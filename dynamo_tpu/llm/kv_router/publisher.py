@@ -85,8 +85,10 @@ class KvEventPublisher:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                logger.debug("publisher loop raised during close", exc_info=True)
             self._task = None
 
 

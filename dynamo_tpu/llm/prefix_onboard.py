@@ -35,7 +35,7 @@ import numpy as np
 from ..offload import BlockMeta, KVStagingBuffer
 from ..runtime.component import Namespace, PushRouter
 from ..runtime.engine import Annotated, AsyncEngineContext, Context
-from ..runtime.transports.codec import ChunkAssembler, iter_chunk_frames
+from ..runtime.transports.codec import ChunkAssembler, encode_chunk_frame
 
 logger = logging.getLogger("dynamo.prefix_onboard")
 
@@ -86,11 +86,9 @@ def kv_export_handler(engine):
                 for idx, off in enumerate(
                     range(0, len(view), EXPORT_CHUNK_BYTES)
                 ):
-                    for frame in iter_chunk_frames(
-                        idx, off, view[off : off + EXPORT_CHUNK_BYTES],
-                        EXPORT_CHUNK_BYTES,
-                    ):
-                        yield frame
+                    yield encode_chunk_frame(
+                        idx, off, view[off : off + EXPORT_CHUNK_BYTES]
+                    )
 
         return gen()
 

@@ -134,8 +134,10 @@ class MockerEngine:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception:
+                logger.debug("mocker loop raised during stop", exc_info=True)
             self._task = None
 
     # -- AsyncEngine --------------------------------------------------------
@@ -178,6 +180,7 @@ class MockerEngine:
                         )
                         return
                     stop_waiter.cancel()
+                    # dynalint: disable=DT001 -- 'get' is in 'done': result() is non-blocking
                     item = get.result()
                     if item is None:
                         return

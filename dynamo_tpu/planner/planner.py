@@ -25,6 +25,7 @@ Scaling goes through a :class:`~.connector.Connector`.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import contextlib
 import logging
 import time
@@ -93,6 +94,15 @@ class Planner:
         self._prefill_grace = 0
         self._prev_queue_depth: Optional[int] = None
         self._task: Optional[asyncio.Task] = None
+        # single-thread writer for the JSONL adjustment log: _record runs
+        # on the event loop (called from the async adjust passes), so the
+        # append must not touch disk there; one worker preserves line order
+        self._log_io: Optional[concurrent.futures.ThreadPoolExecutor] = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="planner-log"
+            )
+            if self.cfg.adjustment_log_path else None
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -105,6 +115,9 @@ class Planner:
             with contextlib.suppress(asyncio.CancelledError, Exception):
                 await self._task
             self._task = None
+        if self._log_io is not None:
+            # drain queued log lines off-loop, then stop the writer
+            await asyncio.to_thread(self._log_io.shutdown, True)
 
     async def _loop(self) -> None:
         while True:
@@ -129,6 +142,19 @@ class Planner:
         await self._adjust_decode(metrics)
         await self._adjust_prefill(queue_depth)
         self._prev_queue_depth = queue_depth
+        # barrier: when the round completes, its decisions are on disk
+        # (threshold-tuning tools tail the file between rounds) -- the
+        # waiting happens here, off the per-decision path, not per line
+        await self._drain_log()
+
+    async def _drain_log(self) -> None:
+        if self._log_io is None:
+            return
+        try:
+            fut = self._log_io.submit(lambda: None)
+        except RuntimeError:  # stopped planner
+            return
+        await asyncio.wrap_future(fut)
 
     async def _adjust_decode(self, metrics: Dict[int, ForwardPassMetrics]) -> None:
         cfg = self.cfg
@@ -206,27 +232,34 @@ class Planner:
         )
         if action != "hold":
             logger.info("planner: %s %s (%s), count was %d", kind, action, reason, count)
-        if self.cfg.adjustment_log_path:
-            try:
-                import json
+        if self._log_io is not None:
+            import json
 
-                with open(self.cfg.adjustment_log_path, "a") as f:
-                    f.write(
-                        json.dumps(
-                            {
-                                "ts": time.time(),
-                                "kind": kind,
-                                "action": action,
-                                "reason": reason,
-                                "count_before": count,
-                                "no_op": self.cfg.no_op,
-                            }
-                        )
-                        + "\n"
-                    )
-            except OSError:
-                logger.warning(
-                    "planner adjustment log write failed", exc_info=True
-                )
+            line = json.dumps(
+                {
+                    "ts": time.time(),
+                    "kind": kind,
+                    "action": action,
+                    "reason": reason,
+                    "count_before": count,
+                    "no_op": self.cfg.no_op,
+                }
+            )
+            # append off the event loop (_record is called mid-adjustment);
+            # the single worker keeps decision order in the file
+            try:
+                self._log_io.submit(self._append_log_line, line)
+            except RuntimeError:
+                pass  # stopped planner (shutdown race): drop the line
         if len(self.adjustments) > 4096:
             del self.adjustments[:2048]
+
+    def _append_log_line(self, line: str) -> None:
+        """Log-writer thread only."""
+        try:
+            with open(self.cfg.adjustment_log_path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            logger.warning(
+                "planner adjustment log write failed", exc_info=True
+            )

@@ -168,6 +168,7 @@ class ResponseStream(Generic[U]):
             raise
         if nxt.done():
             try:
+                # dynalint: disable=DT001 -- guarded by nxt.done(): non-blocking
                 return nxt.result()
             except StopAsyncIteration:
                 ctx.set_complete()

@@ -19,6 +19,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 _LEN = struct.Struct(">II")
 
+# Wire-format registry: every kind listed here must have BOTH an encoder
+# (encode_*/write_* function) and a decoder (decode_*/read_* function) in
+# this module -- dynalint DT006 enforces the pairing, so a new frame kind
+# cannot ship half-implemented (an encoder the peer cannot parse, or a
+# decoder nothing emits).  Add the kind here FIRST when growing the wire
+# format; the lint failure then lists exactly what is missing.
+FRAME_KINDS = ("frame", "chunk")
+
 # 64 MiB hard cap per frame: a corrupt length prefix should fail fast, not OOM.
 MAX_FRAME = 64 * 1024 * 1024
 

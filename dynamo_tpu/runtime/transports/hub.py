@@ -36,6 +36,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
 
+from ..utils import log_throttled
 from .codec import read_frame, write_frame
 
 logger = logging.getLogger("dynamo.hub")
@@ -118,6 +119,10 @@ class HubState:
             collections.deque
         )
         self.objects: Dict[str, bytes] = {}
+        # expiry-loop wakeup: called whenever a new lease deadline appears
+        # (grant), so the owner's wait can re-aim at the earliest expiry
+        # instead of polling on a fixed interval
+        self.lease_wake: Optional[Callable[[], None]] = None
 
     # -- kv ---------------------------------------------------------------
 
@@ -173,7 +178,17 @@ class HubState:
         self.lease_ttl[lease_id] = ttl
         if self.journal is not None:
             self.journal({"op": "lease", "id": lease_id, "ttl": ttl}, b"")
+        if self.lease_wake is not None:
+            # a fresh grant can move the earliest deadline EARLIER; the
+            # expiry loop re-aims.  Keepalives only push deadlines later,
+            # so they never need a wake (the loop wakes at the stale
+            # deadline, finds nothing expired, recomputes)
+            self.lease_wake()
         return lease_id
+
+    def next_lease_expiry(self) -> Optional[float]:
+        """Earliest lease deadline (monotonic), None when no leases."""
+        return min(self.leases.values()) if self.leases else None
 
     def lease_keepalive(self, lease_id: int) -> bool:
         # deliberately NOT journaled (high frequency): a restore re-arms
@@ -297,11 +312,26 @@ class HubJournal:
 
     Writes flush on every record; fsync only with ``DYN_HUB_FSYNC=1``
     (power-loss durability costs ~ms per mutation, process-crash
-    durability is free)."""
+    durability is free).
+
+    Every byte that touches disk -- WAL open, appends, rotation, snapshot
+    write -- runs on ONE dedicated I/O worker thread (``_io``), never on
+    the hub's event loop: a slow disk must stall the journal, not every
+    connected worker's RPCs.  Submission order from the loop IS write
+    order (single worker, FIFO queue), so the snapshot/rotation
+    chronology the restore path depends on is preserved without locks.
+    In the default (no-fsync) mode the durability point moves from "when
+    the mutation returns" to "when the queued write lands" -- a few-ms
+    ack-before-flush window; power-loss durability was never promised
+    without fsync.  Under ``DYN_HUB_FSYNC=1`` the old contract stands:
+    ``append`` BLOCKS until the record is fsynced, so a mutation is never
+    acked before it is durable (that is the mode's entire point, and its
+    documented ~ms/mutation price)."""
 
     REC_HDR = 8  # two u32 LE: header length, payload length
 
     def __init__(self, data_dir: str, compact_every: int = 8192) -> None:
+        import concurrent.futures
         import os
         import struct
 
@@ -315,9 +345,13 @@ class HubJournal:
         self.wal_old_path = os.path.join(data_dir, "wal.old.bin")
         self.compact_every = compact_every
         self.fsync = os.environ.get("DYN_HUB_FSYNC") == "1"
-        self._wal = None
+        self._wal = None  # owned by the _io worker after open
         self._pending = 0
         self._compacting = False
+        self._io = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="hub-journal"
+        )
+        self._io_failed = False
 
     # -- record framing ----------------------------------------------------
 
@@ -416,36 +450,85 @@ class HubJournal:
         state._lease_seq = itertools.count(max(0x1000, max_lease + 1))
 
     # -- append + compaction -------------------------------------------------
+    #
+    # The caller-facing methods below (append, compact, close) are loop-safe:
+    # they only capture state and enqueue work; the file ops they imply all
+    # execute on the single _io worker in submission order.
 
     def open(self) -> None:
+        """Open the WAL for append.  Runs on the _io worker in production
+        (first queued append); callable directly when no appends are in
+        flight (tests driving the journal synchronously)."""
         self._wal = open(self.wal_path, "ab")
 
     def append(self, state: HubState, rec: Dict[str, Any], payload: bytes) -> None:
-        import os
+        """Queue one record for the I/O worker; never touches disk itself.
 
-        if self._wal is None:
-            self.open()
-        self._write_record(self._wal, rec, payload)
-        self._wal.flush()
+        Called from the hub's mutation path (event loop).  ``rec`` is
+        framed on the worker, so callers must hand over ownership (the hub
+        builds a fresh dict per mutation); ``payload`` is immutable bytes.
+        """
+        try:
+            fut = self._io.submit(self._do_append, rec, payload)
+        except RuntimeError:  # closed journal (shutdown race): drop loudly
+            log_throttled(
+                logger, "hub-journal-closed",
+                "hub journal closed; dropping a %s record", rec.get("op"),
+            )
+            return
         if self.fsync:
-            os.fsync(self._wal.fileno())
+            # DYN_HUB_FSYNC promises acked == durable: wait for the fsync
+            # (the mode's documented ~ms/mutation cost) instead of letting
+            # the RPC reply race the disk
+            fut.result()
         self._pending += 1
         if self._pending >= self.compact_every and not self._compacting:
-            # compaction must not stall the hub's event loop (the snapshot
-            # can carry every api-store artifact blob): capture + rotate
-            # synchronously (dict copies of immutable values -- cheap),
-            # write + fsync in a worker thread
-            try:
-                loop = asyncio.get_running_loop()
-            except RuntimeError:
-                self.compact(state)  # no loop (tests): synchronous
-                return
+            # capture on the caller (the loop): the dict copies of
+            # immutable values are cheap and MUST see the state exactly as
+            # of the last queued append.  Rotation + snapshot write queue
+            # behind the already-submitted appends, so the rotated-out
+            # segment holds precisely the records the capture covers.
             self._compacting = True
             self._pending = 0
             capture = self._capture(state)
-            segments = self._rotate_wal()
-            task = loop.create_task(self._compact_async(capture, segments))
-            task.add_done_callback(lambda t: t.exception())
+            self._io.submit(self._do_compact, capture)
+
+    def _do_append(self, rec: Dict[str, Any], payload: bytes) -> None:
+        """Worker thread: frame, write, flush (fsync if configured)."""
+        import os
+
+        try:
+            if self._wal is None:
+                self.open()
+            self._write_record(self._wal, rec, payload)
+            self._wal.flush()
+            if self.fsync:
+                os.fsync(self._wal.fileno())
+        except Exception:
+            # the hub keeps serving from memory; restart-durability of the
+            # records since the last good write is lost and must be loud
+            log_throttled(
+                logger, "hub-journal-write",
+                "hub journal write failed; recent mutations will not "
+                "survive a restart", level=logging.ERROR, exc_info=True,
+            )
+            # re-raise into the future: in fsync mode append() awaits it,
+            # so a failed write fails the mutation's RPC instead of acking
+            # a record that never reached disk (acked == durable)
+            raise
+
+    def _do_compact(self, capture: Dict[str, Any]) -> None:
+        """Worker thread: rotate then snapshot, error-isolated."""
+        try:
+            self._rotate_and_snapshot(capture)
+        except Exception:
+            logger.exception("hub snapshot compaction failed")
+        finally:
+            self._compacting = False
+
+    def _rotate_and_snapshot(self, capture: Dict[str, Any]) -> None:
+        segments = self._rotate_wal()
+        self._write_snapshot(capture, segments)
 
     def _capture(self, state: HubState) -> Dict[str, Any]:
         """Shallow-copy the state for a consistent snapshot (values are
@@ -487,16 +570,6 @@ class HubJournal:
         self._wal = open(self.wal_path, "wb")
         return self._old_segments()
 
-    async def _compact_async(
-        self, capture: Dict[str, Any], segments: List[str]
-    ) -> None:
-        try:
-            await asyncio.to_thread(self._write_snapshot, capture, segments)
-        except Exception:
-            logger.exception("hub snapshot compaction failed")
-        finally:
-            self._compacting = False
-
     def _write_snapshot(
         self, capture: Dict[str, Any], segments: List[str]
     ) -> None:
@@ -534,17 +607,23 @@ class HubJournal:
                 os.remove(path)
 
     def compact(self, state: HubState) -> None:
-        """Synchronous compaction (tests / shutdown): capture, rotate,
-        write, all inline."""
+        """Blocking compaction (tests / shutdown): capture now, then wait
+        for the worker to rotate + write behind any queued appends.
+        Exceptions propagate to the caller, unlike the background path."""
         capture = self._capture(state)
-        segments = self._rotate_wal()
-        self._write_snapshot(capture, segments)
         self._pending = 0
+        self._io.submit(self._rotate_and_snapshot, capture).result()
 
-    def close(self) -> None:
+    def _close_wal(self) -> None:
         if self._wal is not None:
             self._wal.close()
             self._wal = None
+
+    def close(self) -> None:
+        """Drain every queued write, close the WAL, stop the worker."""
+        with contextlib.suppress(RuntimeError):  # already closed
+            self._io.submit(self._close_wal)
+        self._io.shutdown(wait=True)
 
 
 # ---------------------------------------------------------------------------
@@ -618,12 +697,30 @@ class HubServer:
                     w.close()
             await self._server.wait_closed()
         if self.journal is not None:
-            self.journal.close()
+            # close() drains every queued write (and any in-flight
+            # snapshot): that wait belongs on a thread, not on the loop a
+            # colocated engine/HTTP frontend may still be serving from
+            await asyncio.to_thread(self.journal.close)
 
     async def _expiry_loop(self) -> None:
+        """Event-driven lease expiry: sleep until the EARLIEST lease
+        deadline (not a fixed 2 Hz poll -- an idle hub makes zero wakeups),
+        re-aimed whenever a grant introduces an earlier one.  Keepalives
+        only extend deadlines, so waking at a stale deadline just finds
+        nothing expired and recomputes."""
+        wake = asyncio.Event()
+        self.state.lease_wake = wake.set
         while True:
-            await asyncio.sleep(0.5)
             self.state.expire_leases()
+            # clear BEFORE reading the deadline: a grant landing between
+            # the read and the wait sets the event and wakes us right back
+            wake.clear()
+            nxt = self.state.next_lease_expiry()
+            timeout = (
+                None if nxt is None else max(nxt - time.monotonic(), 0.0)
+            )
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(wake.wait(), timeout)
 
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter

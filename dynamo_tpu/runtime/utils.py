@@ -13,6 +13,10 @@ Parity targets (SURVEY.md 2.1 Utils row):
   then blocks; releasing returns the object for reuse.
 - ``configure_logging`` -- reference lib/runtime logging config (DYN_LOG
   env filter), plus a JSONL mode for log aggregation pipelines.
+- ``log_throttled`` -- rate-limited logging for hot paths: a per-token or
+  per-request failure site logs at most once per interval per key (with a
+  suppressed-hit count), so a production fault is diagnosable without a
+  log flood feeding back into the latency it reports on.
 """
 
 from __future__ import annotations
@@ -147,6 +151,43 @@ class Pool(Generic[T]):
                 return False
 
         return _Handle()
+
+
+# key -> [last-emit monotonic time, hits suppressed since]
+_THROTTLE: dict = {}
+
+
+def log_throttled(
+    log: logging.Logger,
+    key: str,
+    msg: str,
+    *args: Any,
+    level: int = logging.WARNING,
+    interval_s: float = 5.0,
+    exc_info: bool = False,
+) -> None:
+    """Log at most once per ``interval_s`` seconds per ``key``.
+
+    Suppressed hits are counted and reported on the next emitted record,
+    so the log stays honest about failure volume without flooding.  GIL
+    atomicity is sufficient here: a racing duplicate emission or an
+    off-by-one suppressed count is harmless for diagnostics.
+    """
+    now = time.monotonic()
+    st = _THROTTLE.get(key)
+    if st is not None and now - st[0] < interval_s:
+        st[1] += 1
+        return
+    suppressed = st[1] if st is not None else 0
+    _THROTTLE[key] = [now, 0]
+    if suppressed:
+        msg = f"{msg} [{suppressed} similar suppressed]"
+    log.log(level, msg, *args, exc_info=exc_info)
+
+
+def reset_throttle() -> None:
+    """Tests only: forget throttle history."""
+    _THROTTLE.clear()
 
 
 class _JsonlFormatter(logging.Formatter):

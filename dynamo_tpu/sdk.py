@@ -154,7 +154,9 @@ class ServiceGraph:
                 try:
                     await client.close()
                 except Exception:
-                    pass
+                    logger.debug(
+                        "client close failed during shutdown", exc_info=True
+                    )
             stop = getattr(rs.instance, "stopped", None)
             if stop is not None:
                 try:
@@ -165,7 +167,9 @@ class ServiceGraph:
                 try:
                     await rs.engine.stop()
                 except Exception:
-                    pass
+                    logger.exception(
+                        "%s engine stop failed", rs.cls.__name__
+                    )
             await rs.runtime.shutdown()
         self.services.clear()
         if self._owned_hub is not None:

@@ -16,12 +16,15 @@ and the whole encode is one jit with static config.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger("dynamo.vision")
 
 Params = Dict[str, Any]
 
@@ -147,6 +150,9 @@ def decode_image_payload(
             try:
                 payload = base64.b64decode(payload)
             except Exception:
+                logger.debug(
+                    "image payload is not base64; treating as raw bytes"
+                )
                 payload = payload.encode()
         arr = None
         try:
