@@ -682,6 +682,92 @@ class CodecFrameKindExhaustive(Rule):
 
 
 # ---------------------------------------------------------------------------
+# DT007: metrics-registry hygiene
+# ---------------------------------------------------------------------------
+
+
+class MetricsRegistryHygiene(Rule):
+    id = "DT007"
+    name = "metrics-registry-hygiene"
+    severity = "error"
+    description = (
+        "prometheus_client metric families (Counter/Gauge/Histogram/"
+        "Summary/Info/Enum) must be minted through runtime/metrics.py "
+        "MetricsRegistry; inline construction elsewhere bypasses the "
+        "get-or-create cache (duplicate-registration errors when tests run "
+        "several engines per process) and the documented name catalog."
+    )
+
+    REGISTRY_SUFFIX = "runtime/metrics.py"
+    _METRIC_CLASSES = {
+        "Counter", "Gauge", "Histogram", "Summary", "Info", "Enum",
+    }
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if module.relpath.endswith(self.REGISTRY_SUFFIX):
+            return
+        # only names provably bound to prometheus_client count: a bare
+        # Counter(...) from collections must never trip this rule
+        aliases: Dict[str, str] = {}  # local name -> canonical class name
+        prom_modules: Set[str] = set()  # names referring to the module
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "prometheus_client" or mod.startswith(
+                    "prometheus_client."
+                ):
+                    for a in node.names:
+                        if a.name in self._METRIC_CLASSES:
+                            aliases[a.asname or a.name] = a.name
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "prometheus_client" or a.name.startswith(
+                        "prometheus_client."
+                    ):
+                        prom_modules.add(a.asname or a.name.split(".")[0])
+        if not aliases and not prom_modules:
+            return
+
+        functions = collect_functions(module.tree)
+
+        def enclosing_qualname(node: ast.AST) -> str:
+            best = ""
+            for fi in functions:
+                n = fi.node
+                if (
+                    n.lineno <= node.lineno
+                    and node.lineno <= (n.end_lineno or n.lineno)
+                ):
+                    best = fi.qualname
+            return best
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is None:
+                continue
+            if d in aliases:
+                cls = aliases[d]
+            elif "." in d:
+                base, _, last = d.rpartition(".")
+                if base in prom_modules and last in self._METRIC_CLASSES:
+                    cls = last
+                else:
+                    continue
+            else:
+                continue
+            yield self.finding(
+                module, node,
+                f"prometheus {cls}(...) constructed outside "
+                f"runtime/metrics.py: mint the family through "
+                f"MetricsRegistry.{cls.lower()}() so names stay in the "
+                "registry catalog",
+                enclosing_qualname(node),
+            )
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -692,6 +778,7 @@ ALL_RULES: List[Rule] = [
     HostSyncInHotPath(),
     RecompileHazardInHotPath(),
     CodecFrameKindExhaustive(),
+    MetricsRegistryHygiene(),
 ]
 
 

@@ -150,6 +150,19 @@ def build_parser() -> argparse.ArgumentParser:
     mt.add_argument("--host", default="0.0.0.0")
     mt.add_argument("--port", type=int, default=9091)
 
+    # trace: assemble one request's cross-component span timeline from the
+    # hub (every served component auto-exposes a _trace scrape endpoint)
+    tr = sub.add_parser("trace",
+                        help="assemble a request's cross-component trace")
+    tr.add_argument("--hub", required=True, help="hub address host:port")
+    tr.add_argument("--namespace", default="dynamo")
+    tr.add_argument("request_id", help="the request id (X-Request-Id header)")
+    tr.add_argument("--json", dest="json_out",
+                    help="write Chrome-trace JSON here (chrome://tracing / "
+                         "ui.perfetto.dev)")
+    tr.add_argument("--timeout", type=float, default=2.0,
+                    help="per-component scrape timeout seconds")
+
     # llmctl: cluster model administration (reference llmctl/src/main.rs)
     ctl = sub.add_parser("llmctl", help="list/remove models on a hub")
     ctl.add_argument("--hub", required=True, help="hub address host:port")
@@ -1172,6 +1185,99 @@ async def run_operator(args) -> int:
         await rt.shutdown()
 
 
+async def run_trace(args) -> int:
+    """Assemble one request's span timeline from every component on the hub.
+
+    Discovery comes from the hub's ``instances/`` keyspace; each component's
+    auto-served ``_trace`` endpoint returns its process's spans for the
+    request id, and the merged set prints as one offset-ordered timeline
+    (plus optional Chrome-trace JSON for chrome://tracing / Perfetto)."""
+    import json as _json
+
+    from .runtime import tracing
+    from .runtime.component import (
+        INSTANCE_ROOT_PATH,
+        DistributedRuntime,
+        Instance,
+    )
+
+    rt = await DistributedRuntime.detached(args.hub)
+    try:
+        prefix = f"{INSTANCE_ROOT_PATH}/{args.namespace}/"
+        components = set()
+        for _key, value in await rt.hub.kv_get_prefix(prefix):
+            try:
+                components.add(Instance.from_json(value).component)
+            except Exception:
+                logger.warning("skipping malformed instance record at %s", _key)
+        if not components:
+            print(f"no components registered under namespace {args.namespace}")
+            return 1
+        ns = rt.namespace(args.namespace)
+        # scrape components concurrently: one wedged component costs one
+        # timeout in total, not one per component
+        results = await asyncio.gather(
+            *(
+                ns.component(comp).scrape_trace(
+                    args.request_id, timeout_s=args.timeout
+                )
+                for comp in sorted(components)
+            ),
+            return_exceptions=True,
+        )
+        spans = []
+        for comp, res in zip(sorted(components), results):
+            if isinstance(res, Exception):
+                logger.warning("trace scrape failed for %s: %s", comp, res)
+            else:
+                spans.extend(res)
+        # colocated components share one process collector: the same span
+        # comes back from every component scrape in that process
+        seen_ids = set()
+        deduped = []
+        for s in spans:
+            key = s.get("span_id")
+            if key:
+                if key in seen_ids:
+                    continue
+                seen_ids.add(key)
+            deduped.append(s)
+        spans = deduped
+        if not spans:
+            print(
+                f"no spans for request {args.request_id} "
+                f"(is DYN_TRACE=1 set on the serving processes?)"
+            )
+            return 1
+        spans.sort(key=lambda s: s.get("start_s", 0.0))
+        t0 = spans[0].get("start_s", 0.0)
+        trace_ids = {s.get("trace_id") for s in spans if s.get("trace_id")}
+        print(
+            f"request {args.request_id}: {len(spans)} spans across "
+            f"{len({s.get('component') or 'process' for s in spans})} "
+            f"components (trace {', '.join(sorted(trace_ids)) or 'n/a'})"
+        )
+        print(f"{'offset_ms':>10}  {'dur_ms':>9}  {'component':<24} name")
+        for s in spans:
+            off = (s.get("start_s", 0.0) - t0) * 1e3
+            print(
+                f"{off:10.3f}  {s.get('duration_ms', 0.0):9.3f}  "
+                f"{(s.get('component') or '-'):<24} {s.get('name', '')}"
+            )
+        if args.json_out:
+            payload = _json.dumps(tracing.chrome_trace(spans), indent=2)
+            await asyncio.to_thread(_write_text, args.json_out, payload)
+            print(f"chrome trace written to {args.json_out}")
+        return 0
+    finally:
+        await rt.shutdown()
+
+
+def _write_text(path: str, payload: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(payload)
+
+
 async def run_disagg_conf(args) -> int:
     """Write the live disagg routing policy to the hub; every decode worker
     watching the key reloads it (llm/disagg.py start_config_watch)."""
@@ -1238,6 +1344,8 @@ def main(argv=None) -> int:
         return asyncio.run(run_bench(args))
     if args.cmd == "disagg-conf":
         return asyncio.run(run_disagg_conf(args))
+    if args.cmd == "trace":
+        return asyncio.run(run_trace(args))
     if args.cmd == "api-store":
         return asyncio.run(run_api_store(args))
     if args.cmd == "eval":

@@ -38,6 +38,7 @@ from ..block_manager import PagePool
 from ..tokens.sequence import TokenBlock
 from .config import ModelConfig
 from .kv_cache import PagedKVCache
+from .metrics import EngineMetrics
 from .model import Params, init_params
 from .sampling import SamplingParams
 from .scheduler import Scheduler, SchedulerConfig, SeqState, StepEvent
@@ -154,6 +155,8 @@ class InflightBlock:
     # (sampling.pack_sampled_logprobs layout; N inferred from the width)
     sampled: Any
     slots: List[Optional[SeqState]]
+    # dispatch timestamp: commit observes dispatch->materialize latency
+    dispatched_at: float = field(default_factory=time.perf_counter)
 
 
 @dataclass
@@ -166,6 +169,7 @@ class InflightPrefill:
     tok: Any  # jax.Array [1] token slice (inject re-apply path, device-only)
     seq: SeqState
     slot: int
+    dispatched_at: float = field(default_factory=time.perf_counter)
 
 
 # layer-group count the chunked KV export aims for when the caller doesn't
@@ -304,6 +308,7 @@ class InflightPrefillGroup:
 
     sampled: Any  # jax.Array [Bp]
     entries: List[InflightPrefill]
+    dispatched_at: float = field(default_factory=time.perf_counter)
 
 
 class JaxEngine:
@@ -316,6 +321,7 @@ class JaxEngine:
         cfg: Optional[EngineConfig] = None,
         kv_sharding: Optional[jax.sharding.Sharding] = None,
         mesh: Optional[jax.sharding.Mesh] = None,
+        metrics_registry=None,  # runtime.metrics.MetricsRegistry | None
     ) -> None:
         _enable_compilation_cache()
         self.model_cfg = model_cfg
@@ -383,6 +389,13 @@ class JaxEngine:
             ),
             self.kv.allocator,
         )
+        # registry-backed observability (runtime/metrics.py): the scheduler
+        # refreshes queue/occupancy gauges at admission, the engine observes
+        # step latency + KV residency at commit
+        self.obs = EngineMetrics(
+            metrics_registry, max_slots=self.cfg.max_batch_size
+        )
+        self.sched.metrics = self.obs
         # G2/G3 offload tiers: evictions snapshot (async) to host RAM with
         # disk overflow; admission onboards offloaded prefixes
         self.offload: Optional[Any] = None
@@ -1467,10 +1480,12 @@ class JaxEngine:
                     # pre-grow pages to cover the in-flight block plus this
                     # tick's block (the host mirror lags the device by up to
                     # one uncommitted block)
-                    self.sched.ensure_decode_capacity(
+                    preempted = self.sched.ensure_decode_capacity(
                         lookahead=2 * self.cfg.decode_block_size + 1,
                         chunk_pages=self.cfg.grow_chunk_pages,
                     )
+                    if preempted:
+                        self.obs.preemptions.inc(len(preempted))
                 self._revive_paused_lanes()
                 fresh: List[Any] = []
                 # advance chunked prefills: one chunk per seq per tick, so
@@ -1963,6 +1978,9 @@ class JaxEngine:
             seq.stats_counted = True
             self._prefix_lookups += prompt_len
             self._prefix_hits += seq.cached_prompt_tokens
+            self.obs.prefix_lookups.inc(prompt_len)
+            if seq.cached_prompt_tokens:
+                self.obs.prefix_hits.inc(seq.cached_prompt_tokens)
         chunk = self._chunk_tokens
         start = seq.cached_prompt_tokens
         if (
@@ -2088,6 +2106,9 @@ class JaxEngine:
                 seq.stats_counted = True
                 self._prefix_lookups += len(seq.prompt)
                 self._prefix_hits += seq.cached_prompt_tokens
+                self.obs.prefix_lookups.inc(len(seq.prompt))
+                if seq.cached_prompt_tokens:
+                    self.obs.prefix_hits.inc(seq.cached_prompt_tokens)
         Bp = self._pad_batch(len(items))
         caches = [seq.cached_prompt_tokens for seq, _ in items]
         if not any(caches):
@@ -2670,12 +2691,15 @@ class JaxEngine:
 
         # mats are host-resident np arrays (device_get / allgather output):
         # no further np.asarray wrapping, which would read as a sync here
+        now = time.perf_counter()
         for e, mat in zip(entries, mats):
             if isinstance(e, InflightPrefillGroup):
                 for i, pf in enumerate(e.entries):
                     commit_prefill(pf, mat[i])  # [Bp, 2 + 2N]
+                self.obs.observe_step("prefill", now - e.dispatched_at)
             elif isinstance(e, InflightPrefill):
                 commit_prefill(e, mat[0])
+                self.obs.observe_step("prefill", now - e.dispatched_at)
             else:
                 arr = mat  # [B, K, 2 + 2N]
                 N = (arr.shape[-1] - 2) // 2
@@ -2686,6 +2710,9 @@ class JaxEngine:
                         tids if N else None, tlps if N else None,
                     )
                 )
+                self.obs.observe_step("decode_block", now - e.dispatched_at)
+        alloc = self.kv.allocator
+        self.obs.observe_kv(alloc.used_pages, alloc.num_pages - 1)
         return events
 
     # -- event/output dispatch (loop thread) --------------------------------
@@ -2700,6 +2727,7 @@ class JaxEngine:
             queue = self._queues.get(ev.seq.request_id)
             if ev.tokens:
                 self._tokens_generated += len(ev.tokens)
+                self.obs.tokens.inc(len(ev.tokens))
             if ev.completed_blocks and pool is None:
                 self._publish_stored(ev.seq, ev.completed_blocks)
             if queue is None:

@@ -1,0 +1,11 @@
+"""Engine-side alias for the registry-backed observability objects.
+
+The implementation lives in :mod:`dynamo_tpu.runtime.metrics` so the
+mocker (which must stay JAX-free) can share the exact series the real
+engine exposes without importing the ``engine`` package; engine code
+imports it from here to keep layering readable.
+"""
+
+from ..runtime.metrics import EngineMetrics, MetricsRegistry, default_registry
+
+__all__ = ["EngineMetrics", "MetricsRegistry", "default_registry"]

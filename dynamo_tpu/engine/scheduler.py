@@ -174,6 +174,9 @@ class Scheduler:
         # G2/G3 offload lookup: fn(seq_hash) -> (blob, meta) | None, wired
         # by the engine when offload tiers are configured
         self.offload_lookup: Optional[Any] = None
+        # observability hook (engine/metrics.EngineMetrics): the scheduler
+        # stays sans-IO -- it only pokes gauges the engine wired in
+        self.metrics: Optional[Any] = None
         B = cfg.max_batch_size
         self.max_pages = cfg.max_seq_len // cfg.page_size
         self.waiting: Deque[SeqState] = collections.deque()
@@ -300,6 +303,8 @@ class Scheduler:
             # awaiting_kv lanes hold their pages and stay device-inactive
             # until the remote prefill delivers (engine.deliver_external)
         plan.run_decode = self.num_runnable > 0
+        if self.metrics is not None:
+            self.metrics.observe_sched(len(self.waiting), self.num_active)
         return plan
 
     def _match_prefix(self, seq: SeqState) -> List[int]:

@@ -5,7 +5,9 @@ metric family names (``{prefix}_http_service_requests_total``,
 ``_inflight_requests``, ``_request_duration_seconds``,
 ``_time_to_first_token_seconds``, ``_inter_token_latency_seconds``) so
 existing dashboards translate directly.  Each service owns a private
-registry (tests run many services per process).
+registry (tests run many services per process); families are minted
+through :class:`~dynamo_tpu.runtime.metrics.MetricsRegistry` (dynalint
+DT007 keeps inline prometheus_client construction out of the codebase).
 """
 
 from __future__ import annotations
@@ -13,14 +15,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from prometheus_client import (
-    CollectorRegistry,
-    Counter,
-    Gauge,
-    Histogram,
-    generate_latest,
-)
-from prometheus_client.exposition import CONTENT_TYPE_LATEST
+from ..runtime.metrics import MetricsRegistry
 
 _DURATION_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
@@ -34,54 +29,60 @@ _ITL_BUCKETS = (
 
 
 class ServiceMetrics:
-    def __init__(self, prefix: str = "dynamo") -> None:
-        self.registry = CollectorRegistry()
-        self.requests_total = Counter(
-            f"{prefix}_http_service_requests_total",
+    def __init__(
+        self,
+        prefix: str = "dynamo",
+        registry: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self._metrics = registry or MetricsRegistry()
+        self.registry = self._metrics.registry
+        self.requests_total = self._metrics.counter(
+            f"{prefix}_http_service_requests",
             "Total HTTP service requests",
             ["model", "endpoint", "status"],
-            registry=self.registry,
         )
-        self.inflight = Gauge(
+        self.inflight = self._metrics.gauge(
             f"{prefix}_http_service_inflight_requests",
             "Requests currently being processed",
             ["model", "endpoint"],
-            registry=self.registry,
         )
-        self.duration = Histogram(
+        self.duration = self._metrics.histogram(
             f"{prefix}_http_service_request_duration_seconds",
             "End-to-end request duration",
             ["model", "endpoint"],
             buckets=_DURATION_BUCKETS,
-            registry=self.registry,
         )
-        self.ttft = Histogram(
+        self.ttft = self._metrics.histogram(
             f"{prefix}_http_service_time_to_first_token_seconds",
             "Time to first generated token",
             ["model"],
             buckets=_TTFT_BUCKETS,
-            registry=self.registry,
         )
-        self.itl = Histogram(
+        self.itl = self._metrics.histogram(
             f"{prefix}_http_service_inter_token_latency_seconds",
             "Latency between consecutive tokens",
             ["model"],
             buckets=_ITL_BUCKETS,
-            registry=self.registry,
         )
 
     def guard(self, model: str, endpoint: str) -> "InflightGuard":
         return InflightGuard(self, model, endpoint)
 
     def render(self) -> tuple[bytes, str]:
-        return generate_latest(self.registry), CONTENT_TYPE_LATEST
+        return self._metrics.render()
 
 
 class InflightGuard:
     """Tracks one request: inflight gauge, duration, TTFT, ITL, final status.
 
     Reference: metrics.rs InflightGuard -- created at admission, marked
-    ok/error at completion; dropping without mark counts as error.
+    ok/error at completion; finishing without a mark counts as error.
+
+    Use as a context manager: ``__exit__`` always calls :meth:`finish`
+    (marking error when an exception escaped), so an abandoned stream --
+    the consumer's generator torn down by cancel/GeneratorExit -- can no
+    longer leak the inflight gauge.  ``finish`` is idempotent: belt-and-
+    suspenders call sites cannot double-decrement.
     """
 
     def __init__(self, metrics: ServiceMetrics, model: str, endpoint: str) -> None:
@@ -91,7 +92,17 @@ class InflightGuard:
         self.start = time.monotonic()
         self._last_token: Optional[float] = None
         self._status: Optional[str] = None
+        self._finished = False
         metrics.inflight.labels(model, endpoint).inc()
+
+    def __enter__(self) -> "InflightGuard":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is not None and self._status is None:
+            self._status = "error"
+        self.finish()
+        return False
 
     def token(self) -> None:
         now = time.monotonic()
@@ -108,6 +119,9 @@ class InflightGuard:
         self._status = "error"
 
     def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
         self.m.inflight.labels(self.model, self.endpoint).dec()
         self.m.duration.labels(self.model, self.endpoint).observe(
             time.monotonic() - self.start

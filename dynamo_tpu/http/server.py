@@ -54,6 +54,12 @@ class Response:
     status: int = 200
     headers: Dict[str, str] = field(default_factory=dict)
     body: Union[bytes, AsyncIterator[bytes]] = b""
+    # invoked exactly once when the connection is done with this response,
+    # even when a streaming body was NEVER started (header write failed
+    # because the client vanished): finalizing a never-started async
+    # generator does not run its body (PEP 525), so cleanup that lives in
+    # the generator needs this out-of-band hook
+    on_close: Optional[Callable[[], None]] = None
 
     @classmethod
     def json(cls, obj, status: int = 200) -> "Response":
@@ -90,6 +96,9 @@ class HttpServer:
         self.host = host
         self.port = port
         self.routes: Dict[Tuple[str, str], Handler] = {}
+        # (METHOD, path_prefix, handler): matched after exact routes, for
+        # path-parameter endpoints like /trace/{request_id}
+        self.prefix_routes: list = []
         self.fallback: Optional[Handler] = None
         self._server: Optional[asyncio.base_events.Server] = None
         # live connections; stop() force-closes them -- Python 3.12+
@@ -98,6 +107,11 @@ class HttpServer:
 
     def route(self, method: str, path: str, handler: Handler) -> None:
         self.routes[(method.upper(), path)] = handler
+
+    def route_prefix(self, method: str, prefix: str, handler: Handler) -> None:
+        """Route every path under ``prefix`` (the trailing path segment is
+        the handler's to parse from ``Request.path``)."""
+        self.prefix_routes.append((method.upper(), prefix, handler))
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -211,6 +225,11 @@ class HttpServer:
 
     async def _dispatch(self, req: Request) -> Response:
         handler = self.routes.get((req.method, req.path))
+        if handler is None:
+            for method, prefix, h in self.prefix_routes:
+                if method == req.method and req.path.startswith(prefix):
+                    handler = h
+                    break
         if handler is None and self.fallback is not None:
             handler = self.fallback
         if handler is None:
@@ -222,6 +241,18 @@ class HttpServer:
         return await handler(req)
 
     async def _write_response(
+        self, writer: asyncio.StreamWriter, resp: Response, keep_alive: bool
+    ) -> None:
+        try:
+            await self._write_response_inner(writer, resp, keep_alive)
+        finally:
+            if resp.on_close is not None:
+                try:
+                    resp.on_close()
+                except Exception:
+                    logger.debug("response on_close failed", exc_info=True)
+
+    async def _write_response_inner(
         self, writer: asyncio.StreamWriter, resp: Response, keep_alive: bool
     ) -> None:
         status_line = (

@@ -28,6 +28,8 @@ from ..protocols.openai import (
     sse_encode,
     sse_error,
 )
+from ..runtime import metrics as rtmetrics
+from ..runtime import tracing
 from ..runtime.engine import Annotated, AsyncEngine, Context, as_response_stream
 from .metrics import ServiceMetrics
 from .server import HttpServer, Request, Response
@@ -133,6 +135,7 @@ class HttpService:
         self.server.route("GET", "/health", self._health)
         self.server.route("GET", "/live", self._health)
         self.server.route("GET", "/metrics", self._metrics)
+        self.server.route_prefix("GET", "/trace/", self._trace)
 
     @property
     def address(self) -> tuple:
@@ -161,8 +164,36 @@ class HttpService:
         return Response.json({"object": "list", "data": self.manager.list_models()})
 
     async def _metrics(self, req: Request) -> Response:
+        # one scrape surface: the service's private HTTP-layer families plus
+        # the process-wide runtime registry (engine, scheduler, KV, disagg,
+        # router series) -- two exposition payloads concatenate cleanly as
+        # long as family names are disjoint, which the naming scheme
+        # guarantees ({prefix}_http_service_* vs dynamo_engine_*/_disagg_*)
         body, content_type = self.metrics.render()
-        return Response(200, {"Content-Type": content_type}, body)
+        runtime_body, _ = rtmetrics.render_default()
+        return Response(200, {"Content-Type": content_type}, body + runtime_body)
+
+    async def _trace(self, req: Request) -> Response:
+        """GET /trace/{request_id}: this process's spans for one request,
+        plus the Chrome-trace export (debug surface; the cross-process
+        timeline is the ``dynamo-tpu trace`` CLI's job)."""
+        rid = req.path[len("/trace/"):].strip("/")
+        if not rid:
+            return Response.json(
+                {"error": {"message": "usage: /trace/{request_id}"}}, 400
+            )
+        spans = [s.to_dict() for s in tracing.collector.get(rid)]
+        if not spans:
+            return Response.json(
+                {"error": {"message": f"no spans for request {rid!r}"}}, 404
+            )
+        return Response.json(
+            {
+                "request_id": rid,
+                "spans": spans,
+                "chrome_trace": tracing.chrome_trace(spans),
+            }
+        )
 
     def _count_rejected(self, body: Optional[dict], endpoint: str) -> None:
         """Count a rejected request, labelling with the model name only when
@@ -199,34 +230,39 @@ class HttpService:
         guard = self.metrics.guard(parsed.model, endpoint)
         request = Context.new(parsed)
         try:
-            stream = await as_response_stream(engine, request)
-            vectors, prompt_tokens = None, 0
-            async for item in stream:
-                if not isinstance(item, Annotated):
-                    item = Annotated.from_data(item)
-                if item.is_error():
-                    raise RuntimeError(item.error_message() or "engine error")
-                data = item.data or {}
-                if "embeddings" in data:
-                    vectors = data["embeddings"]
-                    prompt_tokens = int(data.get("prompt_tokens", 0))
-            if vectors is None:
-                raise RuntimeError("embedding engine returned no vectors")
-            guard.mark_ok()
-            return Response.json(
-                embedding_response(parsed.model, vectors, prompt_tokens)
-            )
+            with guard, tracing.span(
+                "http.request", request.id, component="http",
+                bind=True, endpoint=endpoint, model=parsed.model,
+            ):
+                stream = await as_response_stream(engine, request)
+                vectors, prompt_tokens = None, 0
+                async for item in stream:
+                    if not isinstance(item, Annotated):
+                        item = Annotated.from_data(item)
+                    if item.is_error():
+                        raise RuntimeError(
+                            item.error_message() or "engine error"
+                        )
+                    data = item.data or {}
+                    if "embeddings" in data:
+                        vectors = data["embeddings"]
+                        prompt_tokens = int(data.get("prompt_tokens", 0))
+                if vectors is None:
+                    raise RuntimeError("embedding engine returned no vectors")
+                guard.mark_ok()
+                resp = Response.json(
+                    embedding_response(parsed.model, vectors, prompt_tokens)
+                )
+                resp.headers.setdefault("X-Request-Id", request.id)
+                return resp
         except OpenAIError as e:
-            guard.mark_error()
+            # the guard's __exit__ already finished it with status=error
             return Response.json(e.to_body(), e.code)
         except Exception as e:
             logger.exception("embedding request failed")
-            guard.mark_error()
             return Response.json(
                 {"error": {"message": str(e), "type": "server_error"}}, 500
             )
-        finally:
-            guard.finish()
 
     async def _serve(self, req: Request, chat: bool) -> Response:
         endpoint = "chat_completions" if chat else "completions"
@@ -252,85 +288,141 @@ class HttpService:
 
         guard = self.metrics.guard(parsed.model, endpoint)
         request = Context.new(parsed)
+        # Root span of the request's trace, bound to the request id so the
+        # egress hop (and, through the propagated context, every remote
+        # component's spans) links under it.  Manually paired: it closes
+        # when the response body completes, covering the full stream.
+        rsp = tracing.span(
+            "http.request",
+            request.id,
+            component="http",
+            bind=True,
+            endpoint=endpoint,
+            model=parsed.model,
+        )
+        rsp.__enter__()
         try:
             stream = await as_response_stream(engine, request)
         except Exception as e:
             logger.exception("engine dispatch failed")
             guard.mark_error()
             guard.finish()
+            rsp.__exit__(type(e), e, e.__traceback__)
             return Response.json(
                 {"error": {"message": f"engine error: {e}", "type": "server_error"}},
                 503,
             )
 
         if parsed.stream:
-            return Response.sse(self._sse_body(stream, request, guard))
-        return await self._aggregate_body(stream, guard, chat)
+            started = [False]
+            resp = Response.sse(
+                self._sse_body(stream, request, guard, rsp, started)
+            )
+
+            def on_close() -> None:
+                # the server calls this once the connection is done with the
+                # response; a body generator that was never started (the
+                # client vanished before the first header byte) never runs
+                # its cleanup, so this is the only path that can kill the
+                # engine-side request and release the inflight gauge
+                if not started[0]:
+                    request.ctx.kill()
+                    guard.mark_error()
+                    guard.finish()
+                    rsp.set(abandoned=True)
+                    rsp.__exit__(None, None, None)
+
+            resp.on_close = on_close
+        else:
+            resp = await self._aggregate_body(stream, guard, chat, rsp)
+        # the trace handle: clients retrieve the span tree via
+        # GET /trace/{request_id} or the dynamo-tpu trace CLI
+        resp.headers.setdefault("X-Request-Id", request.id)
+        return resp
 
     async def _sse_body(
-        self, stream, request: Context, guard
+        self, stream, request: Context, guard, rsp=None, started=None
     ) -> AsyncIterator[bytes]:
+        if started is not None:
+            started[0] = True
         try:
-            async for item in stream:
-                if not isinstance(item, Annotated):
-                    item = Annotated.from_data(item)
-                if item.is_error():
-                    guard.mark_error()
-                    yield sse_error(item.error_message() or "engine error")
-                    return
-                if item.data is not None:
-                    if _bears_token(item.data):
-                        guard.token()
-                    yield sse_encode(item.data)
-                elif item.event is not None:
-                    # annotation envelope (formatted_prompt / token_ids ...):
-                    # surface as a named SSE event, reference openai.rs shape
-                    yield sse_annotation(item.event, item.comment)
-            guard.mark_ok()
-            yield SSE_DONE
+            with guard:
+                async for item in stream:
+                    if not isinstance(item, Annotated):
+                        item = Annotated.from_data(item)
+                    if item.is_error():
+                        guard.mark_error()
+                        if rsp is not None:
+                            rsp.set(error=True)
+                        yield sse_error(item.error_message() or "engine error")
+                        return
+                    if item.data is not None:
+                        if _bears_token(item.data):
+                            guard.token()
+                        yield sse_encode(item.data)
+                    elif item.event is not None:
+                        # annotation envelope (formatted_prompt / token_ids
+                        # ...): surface as a named SSE event, reference
+                        # openai.rs shape
+                        yield sse_annotation(item.event, item.comment)
+                guard.mark_ok()
+                yield SSE_DONE
         except (asyncio.CancelledError, GeneratorExit):
             # client went away mid-stream (handler cancelled, or the writer
             # failed and the generator was aclosed): kill the engine-side
             # request instead of decoding for a dead connection
             request.ctx.kill()
+            if rsp is not None:
+                rsp.set(abandoned=True)
             raise
         except Exception as e:
+            # the guard's __exit__ already finished it with status=error
             logger.exception("stream failed")
-            guard.mark_error()
+            if rsp is not None:
+                rsp.set(error=True)
             yield sse_error(str(e))
         finally:
-            guard.finish()
+            if rsp is not None:
+                rsp.__exit__(None, None, None)
 
-    async def _aggregate_body(self, stream, guard, chat: bool) -> Response:
+    async def _aggregate_body(self, stream, guard, chat: bool, rsp=None) -> Response:
         chunks = []
         try:
-            async for item in stream:
-                if not isinstance(item, Annotated):
-                    item = Annotated.from_data(item)
-                if item.is_error():
-                    guard.mark_error()
-                    guard.finish()
-                    return Response.json(
-                        {
-                            "error": {
-                                "message": item.error_message(),
-                                "type": "server_error",
-                            }
-                        },
-                        500,
-                    )
-                if item.data is not None:
-                    if _bears_token(item.data):
-                        guard.token()
-                    chunks.append(item.data)
-            guard.mark_ok()
-            agg = aggregate_chat(chunks) if chat else aggregate_completion(chunks)
-            return Response.json(agg)
+            with guard:
+                async for item in stream:
+                    if not isinstance(item, Annotated):
+                        item = Annotated.from_data(item)
+                    if item.is_error():
+                        guard.mark_error()
+                        if rsp is not None:
+                            rsp.set(error=True)
+                        return Response.json(
+                            {
+                                "error": {
+                                    "message": item.error_message(),
+                                    "type": "server_error",
+                                }
+                            },
+                            500,
+                        )
+                    if item.data is not None:
+                        if _bears_token(item.data):
+                            guard.token()
+                        chunks.append(item.data)
+                guard.mark_ok()
+                agg = (
+                    aggregate_chat(chunks) if chat
+                    else aggregate_completion(chunks)
+                )
+                return Response.json(agg)
         except Exception as e:
+            # the guard's __exit__ already finished it with status=error
             logger.exception("aggregation failed")
-            guard.mark_error()
+            if rsp is not None:
+                rsp.set(error=True)
             return Response.json(
                 {"error": {"message": str(e), "type": "server_error"}}, 500
             )
         finally:
-            guard.finish()
+            if rsp is not None:
+                rsp.__exit__(None, None, None)

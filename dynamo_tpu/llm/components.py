@@ -21,9 +21,7 @@ import json
 import logging
 from typing import Any, AsyncIterator, Optional
 
-from prometheus_client import CollectorRegistry, Gauge, generate_latest
-from prometheus_client.exposition import CONTENT_TYPE_LATEST
-
+from ..runtime.metrics import MetricsRegistry
 from ..runtime.component import Component, DistributedRuntime, Namespace
 from ..runtime.engine import Annotated, Context, EngineFn, ResponseStream
 from .kv_router.router import KV_HIT_RATE_SUBJECT, KvRouter
@@ -106,10 +104,11 @@ class MetricsService:
         self.aggregator = KvMetricsAggregator(
             self.ns.component(worker_component), interval_s=scrape_interval_s
         )
-        self.registry = CollectorRegistry()
+        self._metrics = MetricsRegistry()
+        self.registry = self._metrics.registry
 
-        def g(name: str, doc: str) -> Gauge:
-            return Gauge(name, doc, ["component"], registry=self.registry)
+        def g(name: str, doc: str):
+            return self._metrics.gauge(name, doc, ["component"])
 
         self.kv_active = g("llm_kv_blocks_active", "active KV blocks")
         self.kv_total = g("llm_kv_blocks_total", "total KV blocks")
@@ -175,7 +174,7 @@ class MetricsService:
             self.load_std.labels(label).set(var ** 0.5)
         if self._hit_events:
             self.hit_rate.labels(label).set(self._hit_sum / self._hit_events)
-        return generate_latest(self.registry), CONTENT_TYPE_LATEST
+        return self._metrics.render()
 
     async def serve_http(self, host: str = "127.0.0.1", port: int = 9091):
         """Serve ``GET /metrics`` (reference :9091); returns (host, port)."""

@@ -41,6 +41,8 @@ from typing import Any, AsyncIterator, Dict, Iterator, Optional
 import numpy as np
 
 from ..protocols.common import PreprocessedRequest
+from ..runtime import metrics as rtm
+from ..runtime import tracing
 from ..runtime.component import Namespace, PushRouter
 from ..runtime.engine import Annotated, AsyncEngineContext, Context
 from ..runtime.transports.codec import ChunkAssembler, iter_chunk_frames
@@ -97,6 +99,46 @@ class DisaggConfig:
     # stop shipping prefills when the queue is this deep (prefill pool is
     # saturated; local prefill beats queueing)
     max_prefill_queue_depth: int = 16
+
+
+class DisaggMetrics:
+    """Registry-backed disagg transfer-plane series (runtime/metrics.py);
+    the Prometheus face of ``PrefillWorker.delivery_stats`` plus the decode
+    side's placement counters.  Catalog: README "Observability"."""
+
+    def __init__(self, registry: Optional[rtm.MetricsRegistry] = None) -> None:
+        reg = registry or rtm.default_registry()
+        self.transfer_bytes = reg.counter(
+            "dynamo_disagg_transfer_bytes",
+            "KV bytes delivered prefill->decode",
+            ["path"],  # wire | device
+        )
+        self.transfer_latency = reg.histogram(
+            "dynamo_disagg_transfer_seconds",
+            "KV delivery (upload or device handoff) latency",
+            ["path"],
+            buckets=rtm.TRANSFER_LATENCY_BUCKETS,
+        )
+        self.export_latency = reg.histogram(
+            "dynamo_disagg_export_seconds",
+            "Prefill KV export latency before the first byte hits the wire",
+            buckets=rtm.TRANSFER_LATENCY_BUCKETS,
+        )
+        self.overlap_ratio = reg.histogram(
+            "dynamo_disagg_overlap_ratio",
+            "Fraction of export materialization overlapped with transfer "
+            "(0 = monolithic, -> 1 = fully pipelined)",
+            buckets=rtm.RATIO_BUCKETS,
+        )
+        self.prefills = reg.counter(
+            "dynamo_disagg_prefills",
+            "Prefill placement decisions on the decode worker",
+            ["target"],  # local | remote
+        )
+        self.queue_depth = reg.gauge(
+            "dynamo_disagg_prefill_queue_depth",
+            "Last observed shared prefill queue depth",
+        )
 
 
 class DisaggRouter:
@@ -177,6 +219,7 @@ class DisaggDecodeEngine:
         # observability: how many prefills went remote vs local
         self.remote_prefills = 0
         self.local_prefills = 0
+        self.obs = DisaggMetrics()
         self._depth_at = -1e9  # monotonic time of the last depth fetch
         self._depth = 0
         # same-process delivery fast path (see _LOCAL_DECODE)
@@ -282,12 +325,15 @@ class DisaggDecodeEngine:
             # short prefill can only run locally: skip the hub round trip
             # for the queue depth on the request hot path
             self.local_prefills += 1
+            self.obs.prefills.labels("local").inc()
             return await self.engine.generate(request)
         depth = await self._queue_depth()
+        self.obs.queue_depth.set(depth)
         if not self.router.prefill_remote(
             len(req.token_ids), prefix_hit_tokens, depth
         ):
             self.local_prefills += 1
+            self.obs.prefills.labels("local").inc()
             return await self.engine.generate(request)
 
         stream = await self.engine.generate_external(request)
@@ -295,17 +341,23 @@ class DisaggDecodeEngine:
             # admission failed (e.g. prompt > max_seq_len): the stream already
             # carries the error; don't waste a prefill worker on it
             self.local_prefills += 1
+            self.obs.prefills.labels("local").inc()
             return stream
         self.remote_prefills += 1
+        self.obs.prefills.labels("remote").inc()
         try:
-            await self.queue.enqueue(
-                {
-                    "request_id": request.id,
-                    "request": req.to_dict(),
-                    "decode_component": self.component_name,
-                    "decode_instance": self.instance_id,
-                }
-            )
+            msg = {
+                "request_id": request.id,
+                "request": req.to_dict(),
+                "decode_component": self.component_name,
+                "decode_instance": self.instance_id,
+            }
+            # thread the trace context through the hub-queue hop so the
+            # prefill worker's spans link under this request's tree
+            trace = tracing.wire_context(request.id)
+            if trace:
+                msg["trace"] = trace
+            await self.queue.enqueue(msg)
             self._depth += 1  # keep the cached snapshot roughly honest
         except Exception as e:
             # unpark the admitted lane now -- don't hold its slot + pages
@@ -517,6 +569,20 @@ class PrefillWorker:
         self.delivery_stats: "collections.deque" = collections.deque(
             maxlen=512
         )
+        self.obs = DisaggMetrics()
+
+    def _record_delivery(self, row: Dict[str, Any]) -> None:
+        """One delivery's stats -> the local deque AND the registry (the
+        Prometheus face of the same numbers the bench surface reads)."""
+        self.delivery_stats.append(row)
+        path = row["path"]
+        self.obs.transfer_bytes.labels(path).inc(row["bytes"])
+        self.obs.transfer_latency.labels(path).observe(
+            row["deliver_ms"] / 1e3
+        )
+        self.obs.export_latency.observe(row["export_ms"] / 1e3)
+        if "overlap_ratio" in row:
+            self.obs.overlap_ratio.observe(row["overlap_ratio"])
 
     def transfer_stats(self) -> Dict[str, Any]:
         """Percentile summary of the recorded deliveries (bench/metrics
@@ -655,11 +721,29 @@ class PrefillWorker:
         # distinct connections; to the same worker they multiplex
         await asyncio.gather(
             *[
-                self._deliver(msg, res, export_ms_per_item)
+                self._deliver_traced(msg, res, export_ms_per_item)
                 for msg, res in zip(batch, results)
             ],
             return_exceptions=True,
         )
+
+    async def _deliver_traced(
+        self, msg: Dict[str, Any], result: Any, export_ms: float
+    ) -> None:
+        """Delivery wrapped in a span linked (via the trace context the
+        decode worker put in the queue item) under the originating
+        request's tree -- the 'prefill worker' leg of the frontend ->
+        router -> prefill -> decode timeline."""
+        parent = None
+        if tracing.collector.enabled:
+            parent = tracing.TraceContext.from_wire(msg.get("trace"))
+        with tracing.span(
+            "prefill.deliver",
+            str(msg.get("request_id", "")),
+            parent=parent,
+            error=isinstance(result, Exception),
+        ):
+            await self._deliver(msg, result, export_ms)
 
     async def _deliver(
         self, msg: Dict[str, Any], result: Any, export_ms: float = 0.0
@@ -722,7 +806,7 @@ class PrefillWorker:
                 raise
             nbytes = blob.nbytes
             path = "wire"
-        self.delivery_stats.append(
+        self._record_delivery(
             {
                 "path": path,
                 "bytes": nbytes,
@@ -780,7 +864,7 @@ class PrefillWorker:
         last_at = stream.last_ready_at or first_at
         export_first = (first_at - started) * 1000.0
         export_total = (last_at - started) * 1000.0
-        self.delivery_stats.append(
+        self._record_delivery(
             {
                 "path": "wire",
                 "bytes": stream.nbytes,

@@ -18,6 +18,7 @@ import logging
 from typing import Callable, Optional
 
 from ...protocols.common import ForwardPassMetrics
+from ...runtime import metrics as rtm
 from ...runtime.component import Client, Component, PushRouter
 from ...runtime.engine import Context
 from .publisher import LOAD_METRICS_ENDPOINT
@@ -45,6 +46,13 @@ class KvMetricsAggregator:
         self._client: Optional[Client] = None
         self._router: Optional[PushRouter] = None
         self._task: Optional[asyncio.Task] = None
+        # per-worker KV load, exported from the router's vantage point (the
+        # planner and dashboards read the same snapshot routing runs on)
+        self._kv_load = rtm.default_registry().gauge(
+            "dynamo_kv_router_worker_kv_load",
+            "Per-worker KV cache usage as last scraped by the router",
+            ["worker"],
+        )
 
     async def start(self) -> None:
         ep = self.component.endpoint(LOAD_METRICS_ENDPOINT)
@@ -66,8 +74,10 @@ class KvMetricsAggregator:
         stream = await self._router.direct(Context.new({}), instance_id)
         async for item in stream:
             if item.data is not None:
-                self.endpoints.update(
-                    instance_id, ForwardPassMetrics.from_dict(item.data)
+                m = ForwardPassMetrics.from_dict(item.data)
+                self.endpoints.update(instance_id, m)
+                self._kv_load.labels(f"{instance_id:x}").set(
+                    m.gpu_cache_usage_perc
                 )
 
     async def scrape_once(self) -> ProcessedEndpoints:
@@ -76,6 +86,8 @@ class KvMetricsAggregator:
         for worker_id in list(self.endpoints.endpoints):
             if worker_id not in live:
                 self.endpoints.remove(worker_id)
+                with contextlib.suppress(KeyError):
+                    self._kv_load.remove(f"{worker_id:x}")
                 if self.on_remove is not None:
                     self.on_remove(worker_id)
         # scrape concurrently: one wedged worker costs scrape_timeout_s in

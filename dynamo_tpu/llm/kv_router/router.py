@@ -21,6 +21,7 @@ import logging
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 from ...protocols.common import PreprocessedRequest
+from ...runtime import metrics as rtm
 from ...runtime.component import (
     Component,
     InstanceNotFoundError,
@@ -178,6 +179,14 @@ class KvPushRouter:
     def __init__(self, inner: PushRouter, chooser: KvRouter) -> None:
         self.inner = inner
         self.chooser = chooser
+        # routing decisions by cause: kv (best-match direct), kv_donor
+        # (best-match plus a cross-worker onboarding donor), and the two
+        # fallbacks -- the series smarter-routing work tunes against
+        self._decisions = rtm.default_registry().counter(
+            "dynamo_kv_router_decisions",
+            "KV-router dispatch decisions by cause",
+            ["cause"],
+        )
 
     async def generate(self, request: Context[Any]) -> ResponseStream[Annotated]:
         data = request.data
@@ -203,6 +212,7 @@ class KvPushRouter:
             # no metrics yet / no workers known to the scheduler: degrade to
             # plain load balancing over the live instances rather than failing
             logger.debug("kv selection failed; falling back", exc_info=True)
+            self._decisions.labels("fallback_no_selection").inc()
             return await self.inner.generate(request)
         if donor is not None:
             # another worker holds a longer prefix: tell the chosen worker
@@ -214,7 +224,11 @@ class KvPushRouter:
                 "blocks": donor[1],
             }
         try:
-            return await self.inner.direct(stamp(overlap), instance_id)
+            stream = await self.inner.direct(stamp(overlap), instance_id)
+            self._decisions.labels(
+                "kv_donor" if donor is not None else "kv"
+            ).inc()
+            return stream
         except (InstanceNotFoundError, ConnectionRefusedError):
             # retryable dispatch failures are exactly those where the
             # request provably never left this process: a stale selection
@@ -227,4 +241,5 @@ class KvPushRouter:
             logger.debug(
                 "selected instance %x vanished; falling back", instance_id
             )
+            self._decisions.labels("fallback_dead_instance").inc()
             return await self.inner.generate(stamp(0))

@@ -19,9 +19,11 @@ import asyncio
 import itertools
 import random
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
+from ..runtime.metrics import EngineMetrics
 from ..protocols.common import (
     FinishReason,
     ForwardPassMetrics,
@@ -105,6 +107,9 @@ class MockerEngine:
         self._prefix_hits = 0
         self._prefix_lookups = 0
         self._tokens_generated = 0
+        # same registry-backed series the JaxEngine exposes, so chip-free
+        # stacks (mocker workers behind a frontend) light up /metrics too
+        self.obs = EngineMetrics(max_slots=self.cfg.max_batch_size)
 
     def _sink(self, ev: Dict[str, Any]) -> None:
         if self.kv_event_sink is not None:
@@ -302,6 +307,9 @@ class MockerEngine:
                 self._prefix_lookups += 1
                 if cost.cached_tokens > 0:
                     self._prefix_hits += 1
+                self.obs.prefix_lookups.inc(len(seq.blocks))
+                if cost.cached_tokens > 0:
+                    self.obs.prefix_hits.inc(cost.cached_tokens)
             ok = self.kv.use(hashes + [seq.partial_id])
             if not ok:
                 # should not happen (watermark guards admission)
@@ -314,6 +322,9 @@ class MockerEngine:
 
     async def _simulate_tick(self) -> None:
         cfg = self.cfg
+        t0 = time.perf_counter()
+        self.obs.observe_sched(len(self._waiting_list), len(self.running))
+        self.obs.observe_kv(self.kv.num_active_blocks, self.kv.max_capacity)
         # decode time models HBM-bound KV reads over all active tokens
         tick_s = cfg.decode_s_per_step * self.kv.num_active_blocks
         for rid in list(self.running.keys()):
@@ -332,6 +343,10 @@ class MockerEngine:
             self._generate_one(seq)
         if tick_s:
             await asyncio.sleep(tick_s / cfg.speedup_ratio)
+        if self.running:
+            self.obs.observe_step(
+                "decode_block", time.perf_counter() - t0
+            )
 
     def _generate_one(self, seq: _MockSeq) -> None:
         token = self._next_token(seq)
@@ -347,6 +362,7 @@ class MockerEngine:
         completed = seq.blocks.append(token)
         seq.num_generated += 1
         self._tokens_generated += 1
+        self.obs.tokens.inc()
         out_of_room = False
         if completed is not None:
             # secure the next partial first; only then promote the completed
@@ -384,6 +400,7 @@ class MockerEngine:
 
     def _preempt(self, seq: _MockSeq) -> None:
         logger.debug("mocker preempting %s", seq.request_id)
+        self.obs.preemptions.inc()
         self.running.pop(seq.request_id, None)
         self.kv.deref(seq.held)
         seq.held = []

@@ -41,6 +41,48 @@ DECODE = "decode"
 PREFILL = "prefill"
 
 
+def registry_metrics_source(
+    registry=None, worker_id: int = 0
+) -> Callable[[], Dict[int, ForwardPassMetrics]]:
+    """Metrics source reading the runtime metrics registry's engine gauges
+    (``dynamo_engine_*``, runtime/metrics.py) in place of ad-hoc plumbing:
+    a colocated deployment -- planner in the worker process, the common dev
+    topology -- points the planner at exactly the series ``/metrics``
+    exports, so scaling decisions and dashboards can never disagree about
+    what the load was.  Returns ``{}`` until an engine has published its
+    first sample (the planner treats that as "no fleet data yet")."""
+    from ..runtime import metrics as rtm
+
+    def source() -> Dict[int, ForwardPassMetrics]:
+        reg = registry or rtm.default_registry()
+        total = reg.sample("dynamo_engine_kv_pages_total")
+        if total is None:
+            return {}
+
+        def val(name: str) -> float:
+            return reg.sample(name) or 0.0
+
+        hits = val("dynamo_engine_prefix_hit_tokens")
+        lookups = val("dynamo_engine_prefix_lookup_tokens")
+        return {
+            worker_id: ForwardPassMetrics(
+                kv_active_blocks=int(val("dynamo_engine_kv_pages_used")),
+                kv_total_blocks=int(total),
+                num_requests_waiting=int(
+                    val("dynamo_engine_prefill_queue_depth")
+                ),
+                gpu_cache_usage_perc=val("dynamo_engine_kv_utilization"),
+                gpu_prefix_cache_hit_rate=hits / lookups if lookups else 0.0,
+                request_active_slots=int(
+                    val("dynamo_engine_batch_occupancy")
+                ),
+                request_total_slots=int(val("dynamo_engine_batch_slots")),
+            )
+        }
+
+    return source
+
+
 @dataclass
 class PlannerConfig:
     adjustment_interval_s: float = 10.0

@@ -46,6 +46,7 @@ from .engine import (
 )
 from . import tracing
 from .transports.client import HubClient, StaticHub, WatchHandle
+from .transports.codec import decode_trace_context
 from .transports.request_plane import DataPlaneClient, DataPlaneServer, RemoteError
 
 logger = logging.getLogger("dynamo.runtime")
@@ -212,6 +213,44 @@ class Component:
     def path(self) -> str:
         return f"{self.namespace}/{self.name}"
 
+    async def scrape_trace(
+        self, request_id: Optional[str] = None, timeout_s: float = 2.0
+    ) -> List[Dict[str, Any]]:
+        """Collect trace spans from every live instance of this component
+        (the trace analog of :meth:`scrape_stats`, consumed by the
+        ``dynamo-tpu trace`` CLI): each instance's ``_trace`` endpoint
+        returns its collector's spans for ``request_id`` (or its whole
+        ring); the merged span dicts assemble into one cross-process
+        timeline (``tracing.chrome_trace``)."""
+        ep = self.endpoint(TRACE_ENDPOINT)
+        client = await ep.client()
+        try:
+            with contextlib.suppress(TimeoutError):
+                await client.wait_for_instances(timeout_s)
+            spans: List[Dict[str, Any]] = []
+
+            async def one(instance_id: int):
+                router = PushRouter(client)
+                stream = await router.direct(
+                    Context.new({"request_id": request_id}), instance_id
+                )
+                async for item in stream:
+                    if isinstance(item, Annotated) and item.data is not None:
+                        return item.data
+                return None
+
+            ids = [i.instance_id for i in client.instances]
+            results = await asyncio.gather(
+                *(asyncio.wait_for(one(i), timeout_s) for i in ids),
+                return_exceptions=True,
+            )
+            for r in results:
+                if isinstance(r, dict):
+                    spans.extend(r.get("spans") or [])
+            return spans
+        finally:
+            await client.close()
+
     async def scrape_stats(self, timeout_s: float = 2.0) -> List[Dict[str, Any]]:
         """Request service stats from every live instance of this component
         (the ``$SRV.STATS`` scatter-gather, reference component.rs:284).
@@ -308,21 +347,39 @@ class Endpoint:
     ) -> Instance:
         """Serve ``engine`` on this endpoint."""
         rt = self.runtime
+        comp_path = f"{self.namespace}/{self.component}"
         stats = rt.endpoint_stats.setdefault(self.path, EndpointStats())
-        handler = _IngressHandler(engine, stats)
+        handler = _IngressHandler(
+            engine,
+            stats,
+            component=comp_path,
+            # the reserved scrape endpoints must not trace themselves: a
+            # dashboard polling _trace/_stats would churn the very span
+            # ring it is reading
+            traced=self.name not in (STATS_ENDPOINT, TRACE_ENDPOINT),
+        )
 
         def register(subject: str) -> None:
             rt.data_server.register(subject, handler)
             rt.local_engines[subject] = engine
 
         instance = await self._register(register)
-        # auto-serve the component's $SRV.STATS equivalent once
-        comp_path = f"{self.namespace}/{self.component}"
-        if self.name != STATS_ENDPOINT and comp_path not in rt._stats_served:
+        # process-level component tag for spans opened off the ingress task
+        # (engine executor threads); first-served component names the process
+        if not tracing.collector.component:
+            tracing.collector.component = comp_path
+        # auto-serve the component's $SRV.STATS equivalent + trace scrape once
+        if (
+            self.name not in (STATS_ENDPOINT, TRACE_ENDPOINT)
+            and comp_path not in rt._stats_served
+        ):
             rt._stats_served.add(comp_path)
             await Endpoint(
                 rt, self.namespace, self.component, STATS_ENDPOINT
             ).serve(EngineFn(partial(_stats_handler, rt, self.namespace)))
+            await Endpoint(
+                rt, self.namespace, self.component, TRACE_ENDPOINT
+            ).serve(EngineFn(_trace_handler))
         return instance
 
     async def serve_raw(self, handler) -> Instance:
@@ -344,6 +401,7 @@ class Endpoint:
 
 
 STATS_ENDPOINT = "_stats"  # reserved; the $SRV.STATS-equivalent endpoint
+TRACE_ENDPOINT = "_trace"  # reserved; per-component trace-span scrape
 
 
 @dataclass
@@ -383,6 +441,42 @@ async def _stats_handler(rt, namespace, request):
     return gen()
 
 
+async def _trace_handler(request):
+    """One-item stream with this process's spans for a request id (request
+    data ``{"request_id": ...}``; no id returns the whole ring) -- the
+    per-component scrape behind ``Component.scrape_trace`` and the
+    ``dynamo-tpu trace`` CLI."""
+    data = request.data if isinstance(request.data, dict) else None
+    rid = (data or {}).get("request_id")
+    if rid:
+        spans = [s.to_dict() for s in tracing.collector.get(rid)]
+    else:
+        spans = tracing.collector.dump()
+
+    async def gen():
+        yield Annotated.from_data(
+            {"component": tracing.collector.component, "spans": spans}
+        )
+
+    return gen()
+
+
+class _NullSpan:
+    """Stateless stand-in for untraced ingress paths (shared instance)."""
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
 class _IngressHandler:
     """Byte-level ingress: JSON payload -> Context -> engine -> JSON items.
 
@@ -391,9 +485,17 @@ class _IngressHandler:
     tracing stay end-to-end.
     """
 
-    def __init__(self, engine: AsyncEngine, stats: Optional[EndpointStats] = None) -> None:
+    def __init__(
+        self,
+        engine: AsyncEngine,
+        stats: Optional[EndpointStats] = None,
+        component: str = "",
+        traced: bool = True,
+    ) -> None:
         self.engine = engine
         self.stats = stats
+        self.component = component
+        self.traced = traced
 
     async def __call__(
         self, hdr: Dict[str, Any], payload: bytes, ctx: AsyncEngineContext
@@ -402,16 +504,39 @@ class _IngressHandler:
         request = Context(data=data, ctx=ctx, metadata=hdr.get("meta") or {})
         stats = self.stats
         t0 = time.monotonic()
+        # Ingress span: child of the caller's egress span (trace context
+        # decoded from the frame header), opened BEFORE the engine runs so
+        # everything the engine dispatches downstream -- nested egress hops,
+        # executor-thread engine spans (via the request-id binding) -- links
+        # under it.  Manually paired: it closes when the stream ends.
+        if self.traced:
+            parent = None
+            if tracing.collector.enabled:
+                parent = tracing.TraceContext.from_wire(
+                    decode_trace_context(hdr)
+                )
+            sp = tracing.span(
+                "ingress",
+                request.id,
+                parent=parent,
+                component=self.component or None,
+                bind=True,
+                subject=hdr.get("subject", ""),
+            )
+        else:
+            sp = _NULL_SPAN
+        sp.__enter__()
         if stats is not None:
             stats.requests += 1
             stats.in_flight += 1
         try:
             stream = await self.engine.generate(request)
-        except BaseException:
+        except BaseException as exc:
             if stats is not None:
                 stats.errors += 1
                 stats.in_flight -= 1
                 stats.processing_ms_total += (time.monotonic() - t0) * 1e3
+            sp.__exit__(type(exc), exc, exc.__traceback__)
             raise
 
         async def gen() -> AsyncIterator[bytes]:
@@ -419,26 +544,26 @@ class _IngressHandler:
             # yield Annotated (signals/errors) or raw payloads (wrapped here).
             failed = False
             n_items = 0
-            with tracing.span("ingress", request.id) as sp:
-                try:
-                    async for item in stream:
-                        if not isinstance(item, Annotated):
-                            item = Annotated.from_data(item)
-                        if item.is_error():
-                            failed = True
-                        n_items += 1
-                        yield json.dumps(item.to_dict()).encode()
-                except BaseException:
-                    failed = True
-                    raise
-                finally:
-                    sp.set(items=n_items, error=failed)
-                    if stats is not None:
-                        stats.in_flight -= 1
-                        stats.errors += 1 if failed else 0
-                        stats.processing_ms_total += (
-                            time.monotonic() - t0
-                        ) * 1e3
+            try:
+                async for item in stream:
+                    if not isinstance(item, Annotated):
+                        item = Annotated.from_data(item)
+                    if item.is_error():
+                        failed = True
+                    n_items += 1
+                    yield json.dumps(item.to_dict()).encode()
+            except BaseException:
+                failed = True
+                raise
+            finally:
+                sp.set(items=n_items, error=failed)
+                sp.__exit__(None, None, None)
+                if stats is not None:
+                    stats.in_flight -= 1
+                    stats.errors += 1 if failed else 0
+                    stats.processing_ms_total += (
+                        time.monotonic() - t0
+                    ) * 1e3
 
         return gen()
 
@@ -579,6 +704,7 @@ class PushRouter:
         rt = self.client.endpoint.runtime
         return await rt.data_client.request_upload(
             inst.host, inst.port, inst.subject, request_id, meta, chunks, ctx,
+            trace=tracing.wire_context(request_id),
         )
 
     async def direct_raw(
@@ -596,6 +722,7 @@ class PushRouter:
         rt = self.client.endpoint.runtime
         return await rt.data_client.request(
             inst.host, inst.port, inst.subject, request_id, meta, payload, ctx,
+            trace=tracing.wire_context(request_id),
         )
 
     async def random(self, request: Context[Any]) -> ResponseStream[Annotated]:
@@ -629,15 +756,27 @@ class PushRouter:
             return ResponseStream(request.ctx, local_gen())
 
         payload = json.dumps(request.data).encode()
-        byte_stream = await rt.data_client.request(
-            inst.host,
-            inst.port,
-            inst.subject,
+        # Egress span: covers send + prologue; its context rides the frame
+        # header so the remote ingress span links under it.  Disabled
+        # tracing: span.__enter__ is one attribute check, esp.context is
+        # None, and the frame carries no trace field.
+        with tracing.span(
+            "egress",
             request.id,
-            request.metadata,
-            payload,
-            request.ctx,
-        )
+            target=self.client.endpoint.path,
+            instance=f"{inst.instance_id:x}",
+        ) as esp:
+            c = esp.context
+            byte_stream = await rt.data_client.request(
+                inst.host,
+                inst.port,
+                inst.subject,
+                request.id,
+                request.metadata,
+                payload,
+                request.ctx,
+                trace=c.to_wire() if c is not None else None,
+            )
 
         async def gen() -> AsyncIterator[Annotated]:
             async for raw in byte_stream:

@@ -15,6 +15,7 @@ Line format (one JSON object per line, append-only)::
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import json
 import time
 from typing import Any, AsyncIterator, Dict, List, Optional
@@ -23,16 +24,42 @@ from .engine import Annotated, AsyncEngine, Context, ResponseStream
 
 
 class RecordingEngine:
-    """AsyncEngine wrapper: pass items through, append them to a JSONL file."""
+    """AsyncEngine wrapper: pass items through, append them to a JSONL file.
+
+    File I/O rides a dedicated single-writer thread (the same pattern the
+    hub WAL uses): ``_write`` is called from inside an async generator on
+    the event loop, so the actual ``write()+flush()`` must never run there
+    (dynalint DT001).  One worker preserves line order; :meth:`close`
+    drains queued lines, then closes the handle."""
 
     def __init__(self, inner: AsyncEngine, path: str) -> None:
         self.inner = inner
         self.path = path
-        self._fh = open(path, "a", encoding="utf-8")
+        self._io = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="recorder-io"
+        )
+        # open on the writer thread too: every touch of the handle happens
+        # on one thread, and the constructor stays loop-safe
+        self._fh = None
+        self._io.submit(self._open).result()
+
+    def _open(self) -> None:
+        """Writer thread only."""
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def _append(self, line: str) -> None:
+        """Writer thread only."""
+        self._fh.write(line + "\n")
+        self._fh.flush()
 
     def _write(self, entry: Dict[str, Any]) -> None:
-        self._fh.write(json.dumps(entry) + "\n")
-        self._fh.flush()
+        # serialize on the caller (cheap, keeps entry snapshots consistent);
+        # hand the disk touch to the writer thread without waiting
+        line = json.dumps(entry)
+        try:
+            self._io.submit(self._append, line)
+        except RuntimeError:
+            pass  # closed recorder (shutdown race): drop the line
 
     async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
         t0 = time.monotonic()
@@ -72,7 +99,13 @@ class RecordingEngine:
         return ResponseStream(request.ctx, gen())
 
     def close(self) -> None:
-        self._fh.close()
+        """Drain queued lines and close the file (blocking; call off-loop or
+        via ``asyncio.to_thread`` from async code)."""
+        try:
+            self._io.submit(self._fh.close)
+        except RuntimeError:
+            return  # already closed
+        self._io.shutdown(wait=True)
 
 
 def load_recording(path: str) -> List[Dict[str, Any]]:

@@ -21,7 +21,8 @@ import logging
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
-from .codec import read_frame, write_frame
+from .. import tracing
+from .codec import encode_trace_context, read_frame, write_frame
 from .hub import HubState, WatchEvent
 
 logger = logging.getLogger("dynamo.hub.client")
@@ -338,6 +339,12 @@ class HubClient:
     async def _call(
         self, hdr: Dict[str, Any], payload: bytes = b""
     ) -> Tuple[Dict[str, Any], bytes]:
+        # hub RPCs issued while a request span is open (disagg queue pushes,
+        # discovery lookups on the request path) carry the trace context, so
+        # control-plane time attributes to the right trace; disabled tracing
+        # is one attribute check and leaves the frame untouched
+        if tracing.collector.enabled:
+            encode_trace_context(hdr, tracing.wire_context())
         if self._conn_lost:
             raise ConnectionError("hub connection lost")
         if not self._connected.is_set() and self.reconnect_window > 0:

@@ -25,7 +25,7 @@ _LEN = struct.Struct(">II")
 # cannot ship half-implemented (an encoder the peer cannot parse, or a
 # decoder nothing emits).  Add the kind here FIRST when growing the wire
 # format; the lint failure then lists exactly what is missing.
-FRAME_KINDS = ("frame", "chunk")
+FRAME_KINDS = ("frame", "chunk", "trace")
 
 # 64 MiB hard cap per frame: a corrupt length prefix should fail fast, not OOM.
 MAX_FRAME = 64 * 1024 * 1024
@@ -68,6 +68,38 @@ async def read_frame(
     except (asyncio.IncompleteReadError, ConnectionResetError) as exc:
         raise TruncatedFrame("EOF inside frame body") from exc
     return json.loads(hdr_bytes), payload
+
+
+# ---------------------------------------------------------------------------
+# Trace-context header field (distributed tracing, runtime/tracing.py)
+#
+# The trace context -- which trace a request belongs to and which span is
+# the parent of whatever the receiver opens -- rides every plane's JSON
+# frame header under one reserved key.  It is optional: tracing disabled
+# means the key is absent and frames are byte-identical to the untraced
+# wire format (tests assert this).
+# ---------------------------------------------------------------------------
+
+TRACE_HDR_KEY = "trace"
+
+
+def encode_trace_context(
+    header: Dict[str, Any], wire_ctx: Optional[Dict[str, str]]
+) -> Dict[str, Any]:
+    """Stamp a trace context (``tracing.wire_context()`` output) into a
+    frame header in place; a None context leaves the header untouched, so
+    call sites need no tracing-enabled branch of their own."""
+    if wire_ctx:
+        header[TRACE_HDR_KEY] = wire_ctx
+    return header
+
+
+def decode_trace_context(header: Dict[str, Any]) -> Optional[Dict[str, str]]:
+    """Inverse of :func:`encode_trace_context`: the raw wire dict
+    (``{"tid": ..., "sid": ...}``) or None.  Validation/typing lives in
+    ``tracing.TraceContext.from_wire`` -- the codec only carries bytes."""
+    ctx = header.get(TRACE_HDR_KEY)
+    return ctx if isinstance(ctx, dict) else None
 
 
 # ---------------------------------------------------------------------------

@@ -42,7 +42,7 @@ import logging
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
 from ..engine import AsyncEngineContext, ensure_response_stream
-from .codec import read_frame, write_frame
+from .codec import encode_trace_context, read_frame, write_frame
 
 logger = logging.getLogger("dynamo.dataplane")
 
@@ -397,14 +397,20 @@ class _Connection:
         meta: Dict[str, Any],
         payload: bytes,
         ctx: AsyncEngineContext,
+        trace: Optional[Dict[str, str]] = None,
     ) -> AsyncIterator[bytes]:
-        """Issue a request; await the prologue; yield response payloads."""
+        """Issue a request; await the prologue; yield response payloads.
+        ``trace`` is an optional trace-context wire dict carried in the req
+        frame header (absent = untraced, byte-identical wire format)."""
         sid = next(self._sid)
         q: asyncio.Queue = asyncio.Queue(maxsize=512)
         self._streams[sid] = q
         await self.send(
-            {"t": "req", "sid": sid, "subject": subject, "id": request_id,
-             "meta": meta},
+            encode_trace_context(
+                {"t": "req", "sid": sid, "subject": subject,
+                 "id": request_id, "meta": meta},
+                trace,
+            ),
             payload,
         )
 
@@ -466,6 +472,7 @@ class _Connection:
         meta: Dict[str, Any],
         chunks: Any,
         ctx: AsyncEngineContext,
+        trace: Optional[Dict[str, str]] = None,
     ) -> AsyncIterator[bytes]:
         """Issue an upload-stream request: send every chunk, then read the
         response stream.  ``chunks`` is an iterable or async iterable of
@@ -482,8 +489,11 @@ class _Connection:
         req_sent = False
         try:
             await self.send(
-                {"t": "req", "sid": sid, "subject": subject,
-                 "id": request_id, "meta": meta, "up": True}
+                encode_trace_context(
+                    {"t": "req", "sid": sid, "subject": subject,
+                     "id": request_id, "meta": meta, "up": True},
+                    trace,
+                )
             )
             req_sent = True
             if hasattr(chunks, "__aiter__"):
@@ -569,9 +579,12 @@ class DataPlaneClient:
         meta: Dict[str, Any],
         payload: bytes,
         ctx: AsyncEngineContext,
+        trace: Optional[Dict[str, str]] = None,
     ) -> AsyncIterator[bytes]:
         conn = await self._get(host, port)
-        return await conn.request(subject, request_id, meta, payload, ctx)
+        return await conn.request(
+            subject, request_id, meta, payload, ctx, trace=trace
+        )
 
     async def request_upload(
         self,
@@ -582,9 +595,12 @@ class DataPlaneClient:
         meta: Dict[str, Any],
         chunks: Any,
         ctx: AsyncEngineContext,
+        trace: Optional[Dict[str, str]] = None,
     ) -> AsyncIterator[bytes]:
         conn = await self._get(host, port)
-        return await conn.request_upload(subject, request_id, meta, chunks, ctx)
+        return await conn.request_upload(
+            subject, request_id, meta, chunks, ctx, trace=trace
+        )
 
     async def close(self) -> None:
         for conn in self._conns.values():

@@ -461,6 +461,88 @@ def test_dt006_ignores_other_modules(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DT007: metrics-registry hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_dt007_inline_prometheus_construction(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        from prometheus_client import Counter, Gauge
+
+        reqs = Counter("reqs_total", "requests", ["route"])
+
+        def make():
+            return Gauge("depth", "queue depth")
+        """,
+        rules=["DT007"],
+    )
+    assert rule_ids(findings) == ["DT007", "DT007"]
+    assert "runtime/metrics.py" in findings[0].message
+    assert findings[1].qualname == "make"
+
+
+def test_dt007_module_attribute_call(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import prometheus_client as pc
+
+        h = pc.Histogram("lat_seconds", "latency")
+        """,
+        rules=["DT007"],
+    )
+    assert rule_ids(findings) == ["DT007"]
+    assert "Histogram" in findings[0].message
+
+
+def test_dt007_collections_counter_is_clean(tmp_path):
+    """A Counter that is not prometheus_client's must never trip the rule."""
+    findings = lint_source(
+        tmp_path,
+        """
+        from collections import Counter
+
+        def tally(xs):
+            return Counter(xs)
+        """,
+        rules=["DT007"],
+    )
+    assert findings == []
+
+
+def test_dt007_registry_module_is_exempt(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        from prometheus_client import Counter
+
+        def counter(name, doc):
+            return Counter(name, doc)
+        """,
+        rules=["DT007"],
+        name="runtime/metrics.py",
+    )
+    assert findings == []
+
+
+def test_dt007_registry_facade_usage_is_clean(tmp_path):
+    """Minting through the MetricsRegistry facade is the sanctioned path."""
+    findings = lint_source(
+        tmp_path,
+        """
+        from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        hits = reg.counter("hits", "cache hits")
+        """,
+        rules=["DT007"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------
 
@@ -691,8 +773,8 @@ def test_repo_baseline_is_empty():
 
 
 def test_codec_frame_kinds_registry_present():
-    """DT006's anchor: the registry exists and covers the two wire formats
-    the transfer plane speaks today."""
+    """DT006's anchor: the registry exists and covers the wire formats the
+    transfer plane speaks today (frames, KV chunks, trace contexts)."""
     from dynamo_tpu.runtime.transports import codec
 
-    assert set(codec.FRAME_KINDS) == {"frame", "chunk"}
+    assert set(codec.FRAME_KINDS) == {"frame", "chunk", "trace"}

@@ -186,6 +186,74 @@ def test_tracing_disabled_is_noop():
     assert tracing.collector.get("req-2") == []
 
 
+def test_trace_cli_assembles_timeline(run, tmp_path, capsys):
+    """`dynamo-tpu trace <rid>`: discovers components from the hub,
+    scrapes their _trace endpoints, prints an offset-ordered timeline, and
+    writes Chrome-trace JSON."""
+    from dynamo_tpu.cli import run_trace
+    from dynamo_tpu.runtime import tracing
+    from tests.test_tracing import _two_component_stack, req
+
+    from dynamo_tpu.runtime.component import (
+        Context,
+        DistributedRuntime,
+        PushRouter,
+    )
+    from dynamo_tpu.runtime.transports.hub import HubServer
+
+    prev_component = tracing.collector.component
+    tracing.collector.clear()
+    tracing.collector.enable()
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        _rt_a, _rt_b, shutdown = await _two_component_stack(addr, "clit")
+        caller = await DistributedRuntime.detached(addr)
+        try:
+            client = await (
+                caller.namespace("clit").component("relay")
+                .endpoint("generate").client()
+            )
+            await client.wait_for_instances()
+            request = Context.new(req([1, 2, 3, 4]))
+            stream = await PushRouter(client).generate(request)
+            async for _ in stream:
+                pass
+            await client.close()
+
+            class Args:
+                hub = addr
+                namespace = "clit"
+                request_id = request.id
+                json_out = str(tmp_path / "trace.json")
+                timeout = 2.0
+
+            rc = await run_trace(Args())
+            return rc
+        finally:
+            await caller.shutdown()
+            await shutdown()
+            await hub.stop()
+
+    try:
+        rc = run(body())
+    finally:
+        tracing.collector.disable()
+        tracing.collector.clear()
+        tracing.collector.component = prev_component
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "spans across" in out and "ingress" in out
+    doc = json.loads((tmp_path / "trace.json").read_text())
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(events) >= 4
+    # the CLI deduplicates spans that colocated components both returned
+    span_ids = [e["args"]["span_id"] for e in events]
+    assert len(span_ids) == len(set(span_ids))
+
+
 def test_batch_mode_runs_prompt_file(run, tmp_path, model_dir, capsys):
     """in=batch: a JSONL prompt file runs through the full pipeline and
     produces one in-order JSON result per line."""

@@ -196,7 +196,14 @@ def test_oversized_remote_prompt_is_not_enqueued(run):
 
 def test_disagg_end_to_end_matches_aggregated(run):
     """Full stack: decode worker + prefill worker over a hub.  Long prompts
-    ship to the prefill pool; output must equal aggregated serving."""
+    ship to the prefill pool; output must equal aggregated serving.  Runs
+    with tracing ON so the queue-hop trace propagation (decode ingress ->
+    prefill.deliver span) is exercised on the real stack."""
+    from dynamo_tpu.runtime import tracing
+
+    prev_component = tracing.collector.component
+    tracing.collector.clear()
+    tracing.collector.enable()
 
     async def body():
         long_prompt = [7, 3, 7, 3, 5, 5, 9, 1, 2, 8, 4, 6]
@@ -264,6 +271,15 @@ def test_disagg_end_to_end_matches_aggregated(run):
             assert got_long == expect_long
             assert disagg.remote_prefills == 1  # 12 tokens > 8 -> remote
             assert pw.prefills_done == 1
+            # queue-hop trace propagation: the prefill worker's delivery
+            # span links (same trace, non-root) under the request's tree
+            spans = {s.name: s for s in tracing.collector.get(long_rid)}
+            assert "prefill.deliver" in spans, sorted(spans)
+            assert "ingress" in spans
+            assert (
+                spans["prefill.deliver"].trace_id == spans["ingress"].trace_id
+            )
+            assert spans["prefill.deliver"].parent_span_id
             got_short, _ = await ask(short_prompt)
             assert got_short == expect_short
             assert disagg.local_prefills == 1  # 3 tokens stayed local
@@ -283,7 +299,12 @@ def test_disagg_end_to_end_matches_aggregated(run):
                 await rt.shutdown()
             await hub.stop()
 
-    run(body())
+    try:
+        run(body())
+    finally:
+        tracing.collector.disable()
+        tracing.collector.clear()
+        tracing.collector.component = prev_component
 
 
 def test_local_device_delivery_matches_aggregated(run):

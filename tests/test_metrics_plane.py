@@ -1,0 +1,283 @@
+"""Runtime metrics registry, engine/disagg series, InflightGuard context
+manager, the merged /metrics surface, and the planner's registry source."""
+
+from __future__ import annotations
+
+import pytest
+
+from dynamo_tpu.http.metrics import ServiceMetrics
+from dynamo_tpu.runtime.metrics import EngineMetrics, MetricsRegistry
+
+
+# -- MetricsRegistry ---------------------------------------------------------
+
+
+def test_registry_get_or_create_and_sample():
+    reg = MetricsRegistry()
+    c1 = reg.counter("t_things", "things", ["kind"])
+    c2 = reg.counter("t_things", "things", ["kind"])
+    assert c1 is c2  # same family, no duplicate-registration error
+    c1.labels("a").inc(3)
+    assert reg.sample("t_things", {"kind": "a"}) == 3.0
+    assert reg.sample("t_things", {"kind": "missing"}) is None
+    g = reg.gauge("t_level", "level")
+    g.set(0.5)
+    assert reg.sample("t_level") == 0.5
+    h = reg.histogram("t_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.25)
+    h.observe(0.75)
+    assert reg.sample("t_lat_seconds") == pytest.approx(1.0)  # _sum
+    body, ctype = reg.render()
+    assert b"t_things_total" in body and b"t_level" in body
+    assert "text/plain" in ctype
+
+
+def test_engine_metrics_families_and_updates():
+    reg = MetricsRegistry()
+    em = EngineMetrics(reg, max_slots=8)
+    em.observe_sched(waiting=3, active=2)
+    em.observe_kv(used=10, total=100)
+    em.observe_step("decode_block", 0.002)
+    em.tokens.inc(16)
+    assert reg.sample("dynamo_engine_batch_slots") == 8
+    assert reg.sample("dynamo_engine_prefill_queue_depth") == 3
+    assert reg.sample("dynamo_engine_batch_occupancy") == 2
+    assert reg.sample("dynamo_engine_kv_utilization") == pytest.approx(0.1)
+    assert reg.sample("dynamo_engine_tokens_generated") == 16
+    assert (
+        reg.sample(
+            "dynamo_engine_step_latency_seconds", {"kind": "decode_block"}
+        )
+        == pytest.approx(0.002)
+    )
+
+
+def test_planner_registry_metrics_source():
+    from dynamo_tpu.planner.planner import registry_metrics_source
+
+    reg = MetricsRegistry()
+    source = registry_metrics_source(reg)
+    assert source() == {}  # no engine has published yet
+    em = EngineMetrics(reg, max_slots=4)
+    em.observe_sched(waiting=5, active=3)
+    em.observe_kv(used=80, total=100)
+    em.prefix_lookups.inc(100)
+    em.prefix_hits.inc(25)
+    m = source()[0]
+    assert m.kv_total_blocks == 100 and m.kv_active_blocks == 80
+    assert m.gpu_cache_usage_perc == pytest.approx(0.8)
+    assert m.num_requests_waiting == 5
+    assert m.request_active_slots == 3 and m.request_total_slots == 4
+    assert m.gpu_prefix_cache_hit_rate == pytest.approx(0.25)
+
+
+def test_disagg_metrics_families():
+    from dynamo_tpu.llm.disagg import DisaggMetrics
+
+    reg = MetricsRegistry()
+    dm = DisaggMetrics(reg)
+    dm.transfer_bytes.labels("wire").inc(1024)
+    dm.transfer_latency.labels("wire").observe(0.05)
+    dm.export_latency.observe(0.02)
+    dm.overlap_ratio.observe(0.6)
+    dm.prefills.labels("remote").inc()
+    assert reg.sample("dynamo_disagg_transfer_bytes", {"path": "wire"}) == 1024
+    assert reg.sample("dynamo_disagg_prefills", {"target": "remote"}) == 1
+    text = reg.render()[0].decode()
+    for family in (
+        "dynamo_disagg_transfer_bytes_total",
+        "dynamo_disagg_transfer_seconds",
+        "dynamo_disagg_export_seconds",
+        "dynamo_disagg_overlap_ratio",
+        "dynamo_disagg_prefills_total",
+    ):
+        assert family in text  # documented names, README Observability
+
+
+# -- InflightGuard context manager ------------------------------------------
+
+
+def _counts(metrics, model="m", endpoint="e"):
+    reg = metrics._metrics
+    return {
+        status: reg.sample(
+            "dynamo_http_service_requests",
+            {"model": model, "endpoint": endpoint, "status": status},
+        )
+        or 0.0
+        for status in ("success", "error")
+    }
+
+
+def test_guard_exception_marks_error_and_releases_inflight():
+    m = ServiceMetrics()
+    with pytest.raises(RuntimeError):
+        with m.guard("m", "e"):
+            raise RuntimeError("boom")
+    assert _counts(m) == {"success": 0.0, "error": 1.0}
+    assert m._metrics.sample(
+        "dynamo_http_service_inflight_requests", {"model": "m", "endpoint": "e"}
+    ) == 0.0
+
+
+def test_guard_generator_teardown_cannot_leak_inflight(run):
+    """An abandoned SSE stream (consumer stops iterating; GeneratorExit
+    tears the body down) must still decrement the inflight gauge."""
+    m = ServiceMetrics()
+
+    async def body():
+        async def stream_body(guard):
+            with guard:
+                for _ in range(100):
+                    yield b"data\n"
+
+        gen = stream_body(m.guard("m", "e"))
+        assert (await gen.__anext__()) == b"data\n"
+        await gen.aclose()  # client went away mid-stream
+
+    run(body())
+    assert m._metrics.sample(
+        "dynamo_http_service_inflight_requests", {"model": "m", "endpoint": "e"}
+    ) == 0.0
+    assert _counts(m)["error"] == 1.0  # finished without mark_ok
+
+
+def test_guard_finish_is_idempotent():
+    m = ServiceMetrics()
+    g = m.guard("m", "e")
+    g.mark_ok()
+    with g:
+        pass
+    g.finish()
+    g.finish()
+    assert _counts(m) == {"success": 1.0, "error": 0.0}
+    assert m._metrics.sample(
+        "dynamo_http_service_inflight_requests", {"model": "m", "endpoint": "e"}
+    ) == 0.0
+
+
+def test_never_started_sse_body_runs_on_close(run):
+    """A streaming response whose body generator is NEVER started (the
+    client vanished before the header write) must still run Response.on_close
+    -- PEP 525: finalizing a never-started async generator skips its body,
+    so cleanup cannot live only inside it."""
+    from dynamo_tpu.http.server import HttpServer, Response
+
+    ran = []
+    body_ran = []
+
+    async def body_gen():
+        body_ran.append(True)
+        yield b"never"
+
+    class FailingWriter:
+        def write(self, data):
+            pass
+
+        async def drain(self):
+            raise ConnectionResetError("client went away")
+
+    async def main():
+        server = HttpServer()
+        resp = Response.sse(body_gen())
+        resp.on_close = lambda: ran.append(True)
+        with pytest.raises(ConnectionResetError):
+            await server._write_response(FailingWriter(), resp, True)
+
+    run(main())
+    assert ran == [True]
+    assert body_ran == []  # the generator body really never ran
+
+
+def test_abandoned_sse_request_releases_guard_and_kills_engine(
+    model_dir, run
+):
+    """Service-level wiring: the SSE Response's on_close (never-started
+    case) kills the engine-side request, releases the inflight gauge, and
+    counts the request as an error."""
+    from dynamo_tpu.http.server import Request
+    from tests.test_serving import _build_service
+
+    async def main():
+        svc, engine = _build_service(model_dir)
+        try:
+            body = {
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+                "stream": True,
+            }
+            import json
+
+            req = Request(
+                method="POST", path="/v1/chat/completions",
+                headers={}, body=json.dumps(body).encode(),
+            )
+            resp = await svc._serve(req, chat=True)
+            assert resp.on_close is not None
+            resp.on_close()  # connection died before the body ever started
+            inflight = svc.metrics._metrics.sample(
+                "dynamo_http_service_inflight_requests",
+                {"model": "mock-model", "endpoint": "chat_completions"},
+            )
+            errors = svc.metrics._metrics.sample(
+                "dynamo_http_service_requests",
+                {"model": "mock-model", "endpoint": "chat_completions",
+                 "status": "error"},
+            )
+            aclose = getattr(resp.body, "aclose", None)
+            if aclose is not None:
+                await aclose()
+            return inflight, errors
+        finally:
+            await engine.stop()
+            await svc.stop()
+
+    inflight, errors = run(main())
+    assert inflight == 0.0
+    assert errors == 1.0
+
+
+# -- merged /metrics surface -------------------------------------------------
+
+
+def test_http_metrics_exposes_engine_series(model_dir, run):
+    """After one request through the mocker-backed OpenAI service, /metrics
+    serves BOTH the HTTP-layer families and the engine's registry series
+    (documented names, README Observability)."""
+    from tests.test_serving import _build_service, http_request
+
+    async def main():
+        svc, engine = _build_service(model_dir)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _, _ = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 8,
+                },
+            )
+            assert status == 200
+            m_status, _, payload = await http_request(
+                host, port, "GET", "/metrics", raw_response=True
+            )
+            return m_status, payload.decode()
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    status, text = run(main())
+    assert status == 200
+    # HTTP layer
+    assert "dynamo_http_service_requests_total" in text
+    assert "dynamo_http_service_inflight_requests" in text
+    # engine plane (mocker publishes the same series the JAX engine does)
+    assert "dynamo_engine_step_latency_seconds" in text
+    assert "dynamo_engine_batch_occupancy" in text
+    assert "dynamo_engine_kv_utilization" in text
+    assert "dynamo_engine_tokens_generated_total" in text
+    # disagg families register lazily with their first worker; the engine
+    # series above are the single-process serving floor

@@ -1,0 +1,434 @@
+"""Distributed tracing: cross-hop span linkage, collector index, Chrome
+export, the per-component ``_trace`` scrape, the HTTP ``/trace/{rid}``
+endpoint, and the disabled-tracing wire guarantee."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.component import (
+    Context,
+    DistributedRuntime,
+    PushRouter,
+)
+from dynamo_tpu.runtime.engine import ResponseStream
+from dynamo_tpu.runtime.transports.hub import HubServer
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import PreprocessedRequest, StopConditions
+
+from tests.test_serving import http_request
+
+
+@pytest.fixture
+def traced():
+    """Enable the module-global collector for one test, restoring after."""
+    prev_component = tracing.collector.component
+    tracing.collector.clear()
+    tracing.collector.enable()
+    yield tracing.collector
+    tracing.collector.disable()
+    tracing.collector.clear()
+    tracing.collector.component = prev_component
+
+
+def req(tokens, max_tokens=4):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+    ).to_dict()
+
+
+class RelayEngine:
+    """Engine that forwards every request to another component's endpoint
+    (the router/frontend hop of a two-component pipeline)."""
+
+    def __init__(self, router: PushRouter) -> None:
+        self.router = router
+
+    async def generate(self, request):
+        stream = await self.router.generate(request)
+
+        async def gen():
+            async for item in stream:
+                yield item
+
+        return ResponseStream(request.ctx, gen())
+
+
+async def _two_component_stack(addr, ns_name="trc"):
+    """backend (mocker) and relay (dispatches to backend) on separate
+    runtimes, so every hop takes the remote wire path."""
+    rt_b = await DistributedRuntime.detached(addr)
+    engine = MockerEngine(MockerConfig(block_size=4))
+    await (
+        rt_b.namespace(ns_name).component("backend").endpoint("generate")
+        .serve(engine)
+    )
+
+    rt_a = await DistributedRuntime.detached(addr)
+    bclient = await (
+        rt_a.namespace(ns_name).component("backend").endpoint("generate")
+        .client()
+    )
+    await bclient.wait_for_instances()
+    relay = RelayEngine(PushRouter(bclient))
+    await (
+        rt_a.namespace(ns_name).component("relay").endpoint("generate")
+        .serve(relay)
+    )
+
+    async def shutdown():
+        await bclient.close()
+        await engine.stop()
+        await rt_a.shutdown()
+        await rt_b.shutdown()
+
+    return rt_a, rt_b, shutdown
+
+
+def _by_name(spans, name):
+    return [s for s in spans if s.name == name]
+
+
+def test_trace_links_across_two_components(run, traced):
+    """One request through caller -> relay -> backend produces ONE linked
+    span tree: shared trace_id, parent/child edges across the wire hops,
+    and a valid Chrome-trace export."""
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        _rt_a, _rt_b, shutdown = await _two_component_stack(addr)
+        caller = await DistributedRuntime.detached(addr)
+        try:
+            rclient = await (
+                caller.namespace("trc").component("relay").endpoint("generate")
+                .client()
+            )
+            await rclient.wait_for_instances()
+            request = Context.new(req([1, 2, 3, 4]))
+            stream = await PushRouter(rclient).generate(request)
+            items = [x async for x in stream]
+            assert items and not items[0].is_error()
+            await rclient.close()
+            return request.id
+        finally:
+            await caller.shutdown()
+            await shutdown()
+            await hub.stop()
+
+    rid = run(body())
+    spans = tracing.collector.get(rid)
+    assert len(spans) >= 4, [s.name for s in spans]
+
+    # one trace across every hop
+    trace_ids = {s.trace_id for s in spans}
+    assert len(trace_ids) == 1 and "" not in trace_ids
+
+    ingress = {s.component: s for s in _by_name(spans, "ingress")}
+    assert set(ingress) == {"trc/relay", "trc/backend"}
+    egress = {s.attrs.get("target"): s for s in _by_name(spans, "egress")}
+    assert set(egress) == {"trc/relay/generate", "trc/backend/generate"}
+
+    # parent/child linkage: caller egress -> relay ingress -> relay egress
+    # -> backend ingress
+    assert ingress["trc/relay"].parent_span_id == (
+        egress["trc/relay/generate"].span_id
+    )
+    assert egress["trc/backend/generate"].parent_span_id == (
+        ingress["trc/relay"].span_id
+    )
+    assert ingress["trc/backend"].parent_span_id == (
+        egress["trc/backend/generate"].span_id
+    )
+
+    # Chrome-trace export: loadable JSON, complete events, process metadata
+    export = tracing.collector.export(rid)
+    doc = json.loads(json.dumps(export))
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(events) == len(spans)
+    for e in events:
+        assert e["ts"] > 0 and e["dur"] >= 0
+        assert e["args"]["trace_id"] in trace_ids
+    meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    names = {e["args"]["name"] for e in meta}
+    assert {"trc/relay", "trc/backend"} <= names
+
+
+def test_scrape_trace_merges_components(run, traced):
+    """Component.scrape_trace returns span dicts for the request from each
+    component's _trace endpoint (the CLI's assembly primitive)."""
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        _rt_a, _rt_b, shutdown = await _two_component_stack(addr)
+        caller = await DistributedRuntime.detached(addr)
+        try:
+            ns = caller.namespace("trc")
+            rclient = await ns.component("relay").endpoint("generate").client()
+            await rclient.wait_for_instances()
+            request = Context.new(req([7, 8, 9, 10]))
+            stream = await PushRouter(rclient).generate(request)
+            async for _ in stream:
+                pass
+            await rclient.close()
+            scraped = await ns.component("backend").scrape_trace(request.id)
+            return request.id, scraped
+        finally:
+            await caller.shutdown()
+            await shutdown()
+            await hub.stop()
+
+    rid, scraped = run(body())
+    assert scraped, "scrape returned no spans"
+    assert {s["request_id"] for s in scraped} == {rid}
+    comps = {s.get("component") for s in scraped if s.get("name") == "ingress"}
+    assert {"trc/relay", "trc/backend"} <= comps
+    # scraped dicts assemble into a valid chrome trace
+    doc = tracing.chrome_trace(scraped)
+    assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+
+
+def test_disabled_tracing_adds_no_header_and_no_spans(run):
+    """With tracing off, request frames carry no trace field and nothing is
+    collected -- the disabled cost is one attribute check."""
+    assert not tracing.collector.enabled
+    tracing.collector.clear()
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        rt_b = await DistributedRuntime.detached(addr)
+        engine = MockerEngine(MockerConfig(block_size=4))
+        inst = await (
+            rt_b.namespace("off").component("backend").endpoint("generate")
+            .serve(engine)
+        )
+        seen_headers = []
+        orig = rt_b.data_server._handlers[inst.subject]
+
+        async def spy(hdr, payload, ctx):
+            seen_headers.append(dict(hdr))
+            return await orig(hdr, payload, ctx)
+
+        rt_b.data_server.register(inst.subject, spy)
+        caller = await DistributedRuntime.detached(addr)
+        try:
+            client = await (
+                caller.namespace("off").component("backend")
+                .endpoint("generate").client()
+            )
+            await client.wait_for_instances()
+            request = Context.new(req([5, 6, 7, 8]))
+            stream = await PushRouter(client).generate(request)
+            async for _ in stream:
+                pass
+            await client.close()
+            return request.id, seen_headers
+        finally:
+            await caller.shutdown()
+            await engine.stop()
+            await rt_b.shutdown()
+            await hub.stop()
+
+    rid, headers = run(body())
+    assert headers, "spy never saw the request frame"
+    assert all("trace" not in h for h in headers)
+    assert tracing.collector.get(rid) == []
+
+
+def test_enabled_tracing_stamps_header(run, traced):
+    """The same wire path WITH tracing on carries the trace context."""
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        rt_b = await DistributedRuntime.detached(addr)
+        engine = MockerEngine(MockerConfig(block_size=4))
+        inst = await (
+            rt_b.namespace("on").component("backend").endpoint("generate")
+            .serve(engine)
+        )
+        seen_headers = []
+        orig = rt_b.data_server._handlers[inst.subject]
+
+        async def spy(hdr, payload, ctx):
+            seen_headers.append(dict(hdr))
+            return await orig(hdr, payload, ctx)
+
+        rt_b.data_server.register(inst.subject, spy)
+        caller = await DistributedRuntime.detached(addr)
+        try:
+            client = await (
+                caller.namespace("on").component("backend")
+                .endpoint("generate").client()
+            )
+            await client.wait_for_instances()
+            request = Context.new(req([5, 6, 7, 8]))
+            stream = await PushRouter(client).generate(request)
+            async for _ in stream:
+                pass
+            await client.close()
+            return request.id, seen_headers
+        finally:
+            await caller.shutdown()
+            await engine.stop()
+            await rt_b.shutdown()
+            await hub.stop()
+
+    rid, headers = run(body())
+    stamped = [h for h in headers if "trace" in h]
+    assert stamped, "no request frame carried a trace context"
+    spans = tracing.collector.get(rid)
+    tid = stamped[0]["trace"]["tid"]
+    assert any(s.trace_id == tid for s in spans)
+
+
+# -- HTTP end-to-end: frontend -> relay -> backend + /trace endpoint --------
+
+
+def test_http_e2e_trace_endpoint(model_dir, run, traced):
+    """Acceptance path: a chat request through the OpenAI frontend and two
+    hub components yields ONE linked trace (shared trace_id, >= 4 spans),
+    retrievable via GET /trace/{request_id} with a valid Chrome export; the
+    response's X-Request-Id header is the lookup key."""
+    from dynamo_tpu.http import HttpService
+    from dynamo_tpu.llm import Backend, OpenAIPreprocessor, Tokenizer
+    from dynamo_tpu.runtime.pipeline import link
+
+    async def body():
+        hub = HubServer()
+        host, port = await hub.start()
+        addr = f"{host}:{port}"
+        _rt_a, _rt_b, shutdown = await _two_component_stack(addr, "web")
+
+        rt_f = await DistributedRuntime.detached(addr)
+        rclient = await (
+            rt_f.namespace("web").component("relay").endpoint("generate")
+            .client()
+        )
+        await rclient.wait_for_instances()
+        tok = Tokenizer.from_model_dir(model_dir)
+        pipeline = link(
+            OpenAIPreprocessor("m", tok), Backend(tok), PushRouter(rclient)
+        )
+        svc = HttpService()
+        svc.manager.add_chat_model("m", pipeline)
+        await svc.start()
+        try:
+            h, p = svc.address
+            status, headers, _payload = await http_request(
+                h, p, "POST", "/v1/chat/completions",
+                {
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 4,
+                },
+            )
+            assert status == 200
+            rid = headers.get("x-request-id")
+            assert rid, f"no X-Request-Id in {headers}"
+            t_status, _, t_body = await http_request(
+                h, p, "GET", f"/trace/{rid}"
+            )
+            nf_status, _, _ = await http_request(
+                h, p, "GET", "/trace/no-such-request"
+            )
+            return rid, t_status, t_body, nf_status
+        finally:
+            await svc.stop()
+            await rclient.close()
+            await rt_f.shutdown()
+            await shutdown()
+            await hub.stop()
+
+    rid, t_status, t_body, nf_status = run(body())
+    assert t_status == 200 and nf_status == 404
+    assert t_body["request_id"] == rid
+    spans = t_body["spans"]
+    assert len(spans) >= 4, [s["name"] for s in spans]
+    trace_ids = {s["trace_id"] for s in spans if s.get("trace_id")}
+    assert len(trace_ids) == 1
+    names = {s["name"] for s in spans}
+    assert {"http.request", "egress", "ingress"} <= names
+    comps = {s.get("component") for s in spans if s["name"] == "ingress"}
+    assert {"web/relay", "web/backend"} <= comps
+    # every non-root span's parent exists in the set (a *linked* tree)
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        if s.get("parent_span_id"):
+            assert s["parent_span_id"] in ids
+    events = t_body["chrome_trace"]["traceEvents"]
+    assert sum(1 for e in events if e.get("ph") == "X") == len(spans)
+
+
+# -- collector mechanics -----------------------------------------------------
+
+
+def test_collector_index_tracks_ring_eviction():
+    c = tracing.TraceCollector(capacity=4)
+    c.enable()
+
+    def record(rid, name):
+        import time
+
+        t = time.monotonic()
+        c.record(tracing.Span(name=name, request_id=rid, start_s=t, end_s=t))
+
+    for i in range(3):
+        record("a", f"a{i}")
+    for i in range(3):
+        record("b", f"b{i}")
+    # capacity 4: a0 and a1 rotated out, the index followed
+    assert [s.name for s in c.get("a")] == ["a2"]
+    assert [s.name for s in c.get("b")] == ["b0", "b1", "b2"]
+    for i in range(4):
+        record("c", f"c{i}")
+    assert c.get("a") == [] and c.get("b") == []
+    assert [s.name for s in c.get("c")] == ["c0", "c1", "c2", "c3"]
+    assert len(c.dump()) == 4
+
+
+def test_span_parent_resolution_and_binding():
+    c = tracing.collector
+    c.clear()
+    c.enable()
+    try:
+        with tracing.span("root", "req-x", bind=True) as root:
+            root_ctx = root.context
+            with tracing.span("child", "req-x") as child:
+                child_ctx = child.context
+                assert child_ctx.trace_id == root_ctx.trace_id
+        # binding survives for off-task spans (engine executor threads)
+        assert c.binding("req-x") == root_ctx
+        with tracing.span("late", "req-x"):
+            pass
+        spans = {s.name: s for s in c.get("req-x")}
+        assert spans["child"].parent_span_id == root_ctx.span_id
+        assert spans["late"].parent_span_id == root_ctx.span_id
+        assert spans["late"].trace_id == root_ctx.trace_id
+        # wire_context resolves from the binding when no span is open
+        wc = tracing.wire_context("req-x")
+        assert wc == {"tid": root_ctx.trace_id, "sid": root_ctx.span_id}
+    finally:
+        c.disable()
+        c.clear()
+
+
+def test_disabled_span_is_noop():
+    tracing.collector.clear()
+    assert not tracing.collector.enabled
+    with tracing.span("x", "req-noop") as sp:
+        assert sp.context is None
+        sp.set(ignored=True)
+    assert tracing.collector.get("req-noop") == []
+    assert tracing.wire_context("req-noop") is None
