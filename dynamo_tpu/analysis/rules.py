@@ -768,6 +768,79 @@ class MetricsRegistryHygiene(Rule):
 
 
 # ---------------------------------------------------------------------------
+# DT008: fire-and-forget tasks
+# ---------------------------------------------------------------------------
+
+
+class FireAndForgetTask(Rule):
+    id = "DT008"
+    name = "fire-and-forget-task"
+    severity = "warning"
+    description = (
+        "asyncio.create_task()/ensure_future() whose handle is neither "
+        "stored nor given a done-callback: the event loop holds only a "
+        "weak reference (the task can be garbage-collected mid-await) and "
+        "an exception inside it is silently swallowed until interpreter "
+        "shutdown.  Store the handle (and discard on done), or chain "
+        ".add_done_callback(...)."
+    )
+
+    _SPAWNERS = {"create_task", "ensure_future"}
+
+    def _is_spawn(self, call: ast.AST) -> bool:
+        if not isinstance(call, ast.Call):
+            return False
+        d = dotted_name(call.func)
+        if d is None:
+            return False
+        base, _, last = d.rpartition(".")
+        if last not in self._SPAWNERS:
+            return False
+        if not base:
+            return True  # bare name: from asyncio import create_task
+        # only asyncio itself and event-loop handles spawn unreferenced
+        # tasks; TaskGroup.create_task (the group holds the reference and
+        # surfaces crashes) and unrelated .create_task methods are clean
+        root = base.rpartition(".")[2]
+        return root == "asyncio" or root.endswith("loop")
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        functions = collect_functions(module.tree)
+
+        def enclosing_qualname(node: ast.AST) -> str:
+            best = ""
+            for fi in functions:
+                n = fi.node
+                if (
+                    n.lineno <= node.lineno
+                    and node.lineno <= (n.end_lineno or n.lineno)
+                ):
+                    best = fi.qualname
+            return best
+
+        for node in ast.walk(module.tree):
+            # the discarded-result shape is precisely an expression
+            # statement whose value IS the spawn call; assignments,
+            # arguments (tasks.add(create_task(...))) and chained
+            # .add_done_callback(...) all keep or register the handle
+            if not isinstance(node, ast.Expr):
+                continue
+            call = node.value
+            if isinstance(call, ast.Await):
+                continue  # awaited inline: not fire-and-forget
+            if not self._is_spawn(call):
+                continue
+            fn = dotted_name(call.func)
+            yield self.finding(
+                module, call,
+                f"'{fn}(...)' result discarded: store the task handle "
+                "(with a done-callback discard) or chain "
+                ".add_done_callback() so crashes inside it surface",
+                enclosing_qualname(call),
+            )
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -779,6 +852,7 @@ ALL_RULES: List[Rule] = [
     RecompileHazardInHotPath(),
     CodecFrameKindExhaustive(),
     MetricsRegistryHygiene(),
+    FireAndForgetTask(),
 ]
 
 

@@ -22,6 +22,7 @@ import argparse
 import asyncio
 import contextlib
 import logging
+import os
 import signal
 import sys
 from typing import Optional, Tuple
@@ -621,7 +622,7 @@ async def run_worker(args) -> None:
     elif args.disagg != "prefill":
         print(f"worker serving on {args.endpoint} (hub {addr}; no model card)")
     try:
-        await _wait_forever(stop)
+        await _wait_forever(stop, drain_runtime=runtime)
     finally:
         if prefill_worker is not None:
             await prefill_worker.stop()
@@ -773,12 +774,38 @@ async def run_batch(args) -> None:
         await engine.stop()
 
 
-async def _wait_forever(stop: Optional[asyncio.Event] = None) -> None:
+async def _wait_forever(
+    stop: Optional[asyncio.Event] = None, drain_runtime=None
+) -> None:
+    """Park until a signal (or ``stop``).  With ``drain_runtime`` set,
+    SIGTERM triggers a graceful drain first -- deregister from discovery,
+    finish in-flight requests (``DYN_DRAIN_TIMEOUT_S``, default 30) --
+    before stopping, so supervisor scale-down / k8s rollout never drops
+    requests a drain could have finished.  SIGINT stays immediate."""
     stop = stop or asyncio.Event()
     loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        with contextlib.suppress(NotImplementedError):
-            loop.add_signal_handler(sig, stop.set)
+    drain_tasks: set = set()
+
+    async def _drain_then_stop() -> None:
+        try:
+            await drain_runtime.drain(
+                float(os.environ.get("DYN_DRAIN_TIMEOUT_S", "30"))
+            )
+        finally:
+            stop.set()
+
+    def _on_term() -> None:
+        if drain_runtime is None or drain_runtime.draining:
+            stop.set()
+            return
+        task = asyncio.ensure_future(_drain_then_stop())
+        drain_tasks.add(task)
+        task.add_done_callback(drain_tasks.discard)
+
+    with contextlib.suppress(NotImplementedError):
+        loop.add_signal_handler(signal.SIGINT, stop.set)
+    with contextlib.suppress(NotImplementedError):
+        loop.add_signal_handler(signal.SIGTERM, _on_term)
     await stop.wait()
 
 

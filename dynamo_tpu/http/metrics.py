@@ -12,6 +12,7 @@ DT007 keeps inline prometheus_client construction out of the codebase).
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Optional
 
@@ -64,6 +65,11 @@ class ServiceMetrics:
             ["model"],
             buckets=_ITL_BUCKETS,
         )
+        self.sheds = self._metrics.counter(
+            f"{prefix}_http_service_sheds",
+            "Requests rejected 503 by admission control (inflight bound)",
+            ["endpoint"],
+        )
 
     def guard(self, model: str, endpoint: str) -> "InflightGuard":
         return InflightGuard(self, model, endpoint)
@@ -93,6 +99,10 @@ class InflightGuard:
         self._last_token: Optional[float] = None
         self._status: Optional[str] = None
         self._finished = False
+        # invoked exactly once from finish(): the admission controller's
+        # release (and the deadline watchdog's cancel) piggyback on the one
+        # completion point every request path already hits
+        self.on_finish: Optional[callable] = None
         metrics.inflight.labels(model, endpoint).inc()
 
     def __enter__(self) -> "InflightGuard":
@@ -129,3 +139,10 @@ class InflightGuard:
         self.m.requests_total.labels(
             self.model, self.endpoint, self._status or "error"
         ).inc()
+        if self.on_finish is not None:
+            try:
+                self.on_finish()
+            except Exception:
+                logging.getLogger("dynamo.http.metrics").exception(
+                    "guard on_finish callback failed"
+                )

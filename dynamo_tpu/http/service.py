@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import AsyncIterator, Dict, Optional
 
 from ..protocols.openai import (
@@ -30,7 +31,14 @@ from ..protocols.openai import (
 )
 from ..runtime import metrics as rtmetrics
 from ..runtime import tracing
-from ..runtime.engine import Annotated, AsyncEngine, Context, as_response_stream
+from ..runtime.engine import (
+    DEADLINE_EXCEEDED_MSG,
+    Annotated,
+    AsyncEngine,
+    Context,
+    DeadlineExceededError,
+    as_response_stream,
+)
 from .metrics import ServiceMetrics
 from .server import HttpServer, Request, Response
 
@@ -59,6 +67,38 @@ def sse_annotation(name: str, comment) -> bytes:
 class ModelNotFound(OpenAIError):
     def __init__(self, model: str) -> None:
         super().__init__(f"model '{model}' not found", code=404)
+
+
+class AdmissionControl:
+    """Frontend load shedding: bound concurrently-admitted requests.
+
+    Past ``max_inflight`` (0 = unbounded; env ``DYN_HTTP_MAX_INFLIGHT``)
+    new requests are rejected with 503 + ``Retry-After`` (env
+    ``DYN_HTTP_RETRY_AFTER_S``) *before* any parsing or engine work --
+    overload sheds at the cheapest possible point instead of growing an
+    unbounded queue whose every entry will miss its SLO anyway."""
+
+    def __init__(
+        self,
+        max_inflight: Optional[int] = None,
+        retry_after_s: Optional[float] = None,
+    ) -> None:
+        if max_inflight is None:
+            max_inflight = int(os.environ.get("DYN_HTTP_MAX_INFLIGHT", "0"))
+        if retry_after_s is None:
+            retry_after_s = float(os.environ.get("DYN_HTTP_RETRY_AFTER_S", "1"))
+        self.max_inflight = max_inflight
+        self.retry_after_s = retry_after_s
+        self.inflight = 0
+
+    def try_acquire(self) -> bool:
+        if 0 < self.max_inflight <= self.inflight:
+            return False
+        self.inflight += 1
+        return True
+
+    def release(self) -> None:
+        self.inflight = max(0, self.inflight - 1)
 
 
 class ModelManager:
@@ -123,9 +163,16 @@ class HttpService:
         port: int = 0,
         metrics_prefix: str = "dynamo",
         template=None,  # Optional[RequestTemplate]: body defaults
+        max_inflight: Optional[int] = None,  # admission bound (None = env)
+        default_deadline_s: Optional[float] = None,  # None = env / no deadline
     ) -> None:
         self.manager = manager or ModelManager()
         self.template = template
+        self.admission = AdmissionControl(max_inflight)
+        if default_deadline_s is None:
+            env_dl = float(os.environ.get("DYN_DEADLINE_S", "0"))
+            default_deadline_s = env_dl if env_dl > 0 else None
+        self.default_deadline_s = default_deadline_s
         self.metrics = ServiceMetrics(prefix=metrics_prefix)
         self.server = HttpServer(host, port)
         self.server.route("POST", "/v1/chat/completions", self._chat)
@@ -195,6 +242,35 @@ class HttpService:
             }
         )
 
+    def _shed(self, endpoint: str) -> Response:
+        """Admission-control rejection: 503 + Retry-After, counted."""
+        self.metrics.sheds.labels(endpoint).inc()
+        resp = Response.json(
+            {
+                "error": {
+                    "message": "server overloaded, retry later",
+                    "type": "overloaded_error",
+                }
+            },
+            503,
+        )
+        resp.headers["Retry-After"] = (
+            f"{self.admission.retry_after_s:g}"
+        )
+        return resp
+
+    def _request_deadline(self, req: Request) -> Optional[float]:
+        """Per-request deadline budget in seconds: the
+        ``X-Request-Deadline-S`` header, else the service default
+        (``DYN_DEADLINE_S``), else None (no deadline)."""
+        raw = req.headers.get("x-request-deadline-s")
+        if raw:
+            try:
+                return float(raw)
+            except ValueError:
+                logger.warning("ignoring bad X-Request-Deadline-S %r", raw)
+        return self.default_deadline_s
+
     def _count_rejected(self, body: Optional[dict], endpoint: str) -> None:
         """Count a rejected request, labelling with the model name only when
         it is actually served: client-supplied junk names must not mint
@@ -215,6 +291,8 @@ class HttpService:
         """/v1/embeddings: single aggregated response, no streaming
         (reference openai.rs:212)."""
         endpoint = "embeddings"
+        if not self.admission.try_acquire():
+            return self._shed(endpoint)
         try:
             body = req.json()
             if not isinstance(body, dict):
@@ -224,10 +302,15 @@ class HttpService:
             parsed = EmbeddingRequest.from_dict(body)
             engine = self.manager.embedding_engine(parsed.model)
         except OpenAIError as e:
+            self.admission.release()
             self._count_rejected(body if isinstance(body, dict) else None, endpoint)
             return Response.json(e.to_body(), e.code)
+        except BaseException:
+            self.admission.release()
+            raise
 
         guard = self.metrics.guard(parsed.model, endpoint)
+        guard.on_finish = self.admission.release
         request = Context.new(parsed)
         try:
             with guard, tracing.span(
@@ -266,6 +349,9 @@ class HttpService:
 
     async def _serve(self, req: Request, chat: bool) -> Response:
         endpoint = "chat_completions" if chat else "completions"
+        # shed BEFORE parsing: overload rejection must stay O(1)
+        if not self.admission.try_acquire():
+            return self._shed(endpoint)
         try:
             body = req.json()
             if not isinstance(body, dict):
@@ -283,11 +369,32 @@ class HttpService:
                 else self.manager.completion_engine(parsed.model)
             )
         except OpenAIError as e:
+            self.admission.release()
             self._count_rejected(body if isinstance(body, dict) else None, endpoint)
             return Response.json(e.to_body(), e.code)
+        except BaseException:
+            self.admission.release()
+            raise
 
         guard = self.metrics.guard(parsed.model, endpoint)
         request = Context.new(parsed)
+        # Deadline budget: armed here at the edge, it rides the codec
+        # headers hop by hop; the local watchdog kills the request context
+        # at expiry so even an engine that never checks terminates.
+        deadline_s = self._request_deadline(req)
+        watchdog = None
+        if deadline_s is not None:
+            request.ctx.set_deadline(deadline_s)
+            watchdog = asyncio.get_running_loop().call_later(
+                max(deadline_s, 0.0), request.ctx.kill
+            )
+
+        def on_finish() -> None:
+            self.admission.release()
+            if watchdog is not None:
+                watchdog.cancel()
+
+        guard.on_finish = on_finish
         # Root span of the request's trace, bound to the request id so the
         # egress hop (and, through the propagated context, every remote
         # component's spans) links under it.  Manually paired: it closes
@@ -303,6 +410,20 @@ class HttpService:
         rsp.__enter__()
         try:
             stream = await as_response_stream(engine, request)
+        except DeadlineExceededError as e:
+            guard.mark_error()
+            guard.finish()
+            rsp.set(deadline_expired=True)
+            rsp.__exit__(type(e), e, e.__traceback__)
+            return Response.json(
+                {
+                    "error": {
+                        "message": DEADLINE_EXCEEDED_MSG,
+                        "type": "timeout_error",
+                    }
+                },
+                504,
+            )
         except Exception as e:
             logger.exception("engine dispatch failed")
             guard.mark_error()
@@ -334,7 +455,7 @@ class HttpService:
 
             resp.on_close = on_close
         else:
-            resp = await self._aggregate_body(stream, guard, chat, rsp)
+            resp = await self._aggregate_body(stream, request, guard, chat, rsp)
         # the trace handle: clients retrieve the span tree via
         # GET /trace/{request_id} or the dynamo-tpu trace CLI
         resp.headers.setdefault("X-Request-Id", request.id)
@@ -365,6 +486,14 @@ class HttpService:
                         # ...): surface as a named SSE event, reference
                         # openai.rs shape
                         yield sse_annotation(item.event, item.comment)
+                if request.ctx.deadline_expired():
+                    # the watchdog killed the request: the stream ended
+                    # because the budget ran out, not because it finished
+                    guard.mark_error()
+                    if rsp is not None:
+                        rsp.set(deadline_expired=True)
+                    yield sse_error(DEADLINE_EXCEEDED_MSG)
+                    return
                 guard.mark_ok()
                 yield SSE_DONE
         except (asyncio.CancelledError, GeneratorExit):
@@ -385,14 +514,34 @@ class HttpService:
             if rsp is not None:
                 rsp.__exit__(None, None, None)
 
-    async def _aggregate_body(self, stream, guard, chat: bool, rsp=None) -> Response:
+    async def _aggregate_body(
+        self, stream, request: Context, guard, chat: bool, rsp=None
+    ) -> Response:
         chunks = []
+
+        def timeout_response() -> Response:
+            guard.mark_error()
+            if rsp is not None:
+                rsp.set(deadline_expired=True)
+            return Response.json(
+                {
+                    "error": {
+                        "message": DEADLINE_EXCEEDED_MSG,
+                        "type": "timeout_error",
+                    }
+                },
+                504,
+            )
+
         try:
             with guard:
                 async for item in stream:
                     if not isinstance(item, Annotated):
                         item = Annotated.from_data(item)
                     if item.is_error():
+                        msg = item.error_message() or ""
+                        if msg.startswith(DEADLINE_EXCEEDED_MSG):
+                            return timeout_response()
                         guard.mark_error()
                         if rsp is not None:
                             rsp.set(error=True)
@@ -409,12 +558,17 @@ class HttpService:
                         if _bears_token(item.data):
                             guard.token()
                         chunks.append(item.data)
+                if request.ctx.deadline_expired():
+                    # watchdog-killed: the stream ended on budget expiry
+                    return timeout_response()
                 guard.mark_ok()
                 agg = (
                     aggregate_chat(chunks) if chat
                     else aggregate_completion(chunks)
                 )
                 return Response.json(agg)
+        except DeadlineExceededError:
+            return timeout_response()
         except Exception as e:
             # the guard's __exit__ already finished it with status=error
             logger.exception("aggregation failed")

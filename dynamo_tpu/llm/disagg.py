@@ -33,6 +33,7 @@ import collections
 import contextlib
 import json
 import logging
+import os
 import time
 import weakref
 from dataclasses import dataclass
@@ -41,6 +42,7 @@ from typing import Any, AsyncIterator, Dict, Iterator, Optional
 import numpy as np
 
 from ..protocols.common import PreprocessedRequest
+from ..runtime import faults
 from ..runtime import metrics as rtm
 from ..runtime import tracing
 from ..runtime.component import Namespace, PushRouter
@@ -139,6 +141,107 @@ class DisaggMetrics:
             "dynamo_disagg_prefill_queue_depth",
             "Last observed shared prefill queue depth",
         )
+        self.breaker_state = reg.gauge(
+            "dynamo_disagg_breaker_state",
+            "Remote-prefill circuit breaker state "
+            "(0 closed, 1 open, 2 half-open)",
+        )
+        self.breaker_events = reg.counter(
+            "dynamo_disagg_breaker_events",
+            "Remote-prefill circuit breaker events",
+            ["event"],  # open | close | half_open | fallback
+        )
+
+
+class CircuitBreaker:
+    """Closed/open/half-open breaker on the remote-prefill path.
+
+    Remote prefill is an *optimization*: when the hub queue is failing
+    (enqueue errors) or saturating (enqueue latency past the breach
+    threshold), shipping more work there hurts every request.  After
+    ``failure_threshold`` consecutive breaches the breaker opens: requests
+    run local aggregated prefill with zero hub traffic for ``open_s``.
+    Then one half-open probe is let through; success closes the breaker,
+    failure re-opens it.
+
+    Env knobs: ``DYN_BREAKER_FAILURES``, ``DYN_BREAKER_OPEN_S``,
+    ``DYN_BREAKER_MAX_ENQUEUE_S``."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+    _STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+    def __init__(
+        self,
+        failure_threshold: Optional[int] = None,
+        open_s: Optional[float] = None,
+        max_enqueue_latency_s: Optional[float] = None,
+        obs: Optional[DisaggMetrics] = None,
+    ) -> None:
+        if failure_threshold is None:
+            failure_threshold = int(os.environ.get("DYN_BREAKER_FAILURES", "3"))
+        if open_s is None:
+            open_s = float(os.environ.get("DYN_BREAKER_OPEN_S", "5"))
+        if max_enqueue_latency_s is None:
+            max_enqueue_latency_s = float(
+                os.environ.get("DYN_BREAKER_MAX_ENQUEUE_S", "1")
+            )
+        self.failure_threshold = failure_threshold
+        self.open_s = open_s
+        self.max_enqueue_latency_s = max_enqueue_latency_s
+        self.state = self.CLOSED
+        self.obs = obs
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    def _transition(self, state: str) -> None:
+        if state == self.state:
+            return
+        logger.warning(
+            "remote-prefill circuit breaker: %s -> %s", self.state, state
+        )
+        self.state = state
+        if self.obs is not None:
+            self.obs.breaker_state.set(self._STATE_CODE[state])
+            self.obs.breaker_events.labels(state).inc()
+
+    def allow(self) -> bool:
+        """May a request take the remote path right now?"""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN:
+            if time.monotonic() - self._opened_at < self.open_s:
+                return False
+            self._transition(self.HALF_OPEN)
+        # half-open: exactly one probe in flight at a time
+        if self._probe_inflight:
+            return False
+        self._probe_inflight = True
+        return True
+
+    def release_probe(self) -> None:
+        """The caller took the probe slot but never attempted the remote
+        path (admission failed, engine raised): free the slot with NO
+        verdict -- only a real enqueue outcome may move the state."""
+        self._probe_inflight = False
+
+    def record_success(self) -> None:
+        self._probe_inflight = False
+        self._consecutive_failures = 0
+        if self.state != self.CLOSED:
+            self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        self._probe_inflight = False
+        self._consecutive_failures += 1
+        if (
+            self.state == self.HALF_OPEN
+            or self._consecutive_failures >= self.failure_threshold
+        ):
+            self._opened_at = time.monotonic()
+            self._transition(self.OPEN)
 
 
 class DisaggRouter:
@@ -173,6 +276,20 @@ class PrefillQueue:
 
     async def depth(self) -> int:
         return await self.hub.queue_depth(self.name)
+
+
+def _queue_deadline_expired(msg: Dict[str, Any]) -> bool:
+    """Did this queue item's deadline budget die while it waited?  The
+    item carries (remaining_s, wall-clock enqueue time); coarse cross-host
+    wall skew is acceptable for multi-second budgets."""
+    dl = msg.get("deadline")
+    if not isinstance(dl, dict):
+        return False
+    try:
+        elapsed = time.time() - float(dl.get("wall", 0.0))
+        return elapsed >= float(dl.get("remaining_s", 0.0))
+    except (TypeError, ValueError):
+        return False
 
 
 def _blob_chunks(blob: np.ndarray) -> Iterator[bytes]:
@@ -220,6 +337,9 @@ class DisaggDecodeEngine:
         self.remote_prefills = 0
         self.local_prefills = 0
         self.obs = DisaggMetrics()
+        # graceful degradation: enqueue failures / latency breaches open
+        # the breaker and prefills run locally instead of hard-failing
+        self.breaker = CircuitBreaker(obs=self.obs)
         self._depth_at = -1e9  # monotonic time of the last depth fetch
         self._depth = 0
         # same-process delivery fast path (see _LOCAL_DECODE)
@@ -335,37 +455,89 @@ class DisaggDecodeEngine:
             self.local_prefills += 1
             self.obs.prefills.labels("local").inc()
             return await self.engine.generate(request)
+        if not self.breaker.allow():
+            # breaker open: the remote path is known-bad right now -- run
+            # the prefill locally with zero hub traffic instead of failing
+            self.local_prefills += 1
+            self.obs.prefills.labels("local").inc()
+            self.obs.breaker_events.labels("fallback").inc()
+            return await self.engine.generate(request)
 
-        stream = await self.engine.generate_external(request)
+        try:
+            stream = await self.engine.generate_external(request)
+        except BaseException:
+            # no remote attempt happened: free a half-open probe slot
+            # verdict-free so the breaker can still probe later
+            self.breaker.release_probe()
+            raise
         if not self.engine.awaiting_external(request.id):
             # admission failed (e.g. prompt > max_seq_len): the stream already
-            # carries the error; don't waste a prefill worker on it
+            # carries the error; don't waste a prefill worker on it.  This is
+            # NOT a hub-probe outcome -- release the slot without a verdict.
+            self.breaker.release_probe()
             self.local_prefills += 1
             self.obs.prefills.labels("local").inc()
             return stream
-        self.remote_prefills += 1
-        self.obs.prefills.labels("remote").inc()
-        try:
-            msg = {
-                "request_id": request.id,
-                "request": req.to_dict(),
-                "decode_component": self.component_name,
-                "decode_instance": self.instance_id,
+        msg = {
+            "request_id": request.id,
+            "request": req.to_dict(),
+            "decode_component": self.component_name,
+            "decode_instance": self.instance_id,
+        }
+        # thread the trace context through the hub-queue hop so the
+        # prefill worker's spans link under this request's tree
+        trace = tracing.wire_context(request.id)
+        if trace:
+            msg["trace"] = trace
+        # the deadline budget rides the queue item too: a job whose budget
+        # died on the queue is dropped by the prefill worker, and the
+        # decode-side lane fails fast (pages freed) via its error notice
+        rem = request.ctx.deadline_remaining()
+        if rem is not None:
+            msg["deadline"] = {
+                "remaining_s": round(rem, 4), "wall": time.time(),
             }
-            # thread the trace context through the hub-queue hop so the
-            # prefill worker's spans link under this request's tree
-            trace = tracing.wire_context(request.id)
-            if trace:
-                msg["trace"] = trace
+        t0 = time.monotonic()
+        try:
+            if faults.injector.enabled and faults.injector.should_fire(
+                "disagg.enqueue_fail", request.id
+            ):
+                raise faults.InjectedFault("injected enqueue failure")
             await self.queue.enqueue(msg)
-            self._depth += 1  # keep the cached snapshot roughly honest
-        except Exception as e:
-            # unpark the admitted lane now -- don't hold its slot + pages
-            # hostage to the delivery timeout for a job that never shipped
+        except Exception as e:  # noqa: BLE001 - degrade, don't hard-fail
+            # graceful degradation: unpark the admitted lane (slot + pages
+            # released), count the breach, and serve the request with LOCAL
+            # aggregated prefill -- an unreachable hub must cost capacity,
+            # not correctness
+            self.breaker.record_failure()
             self.engine.fail_external(
                 request.id, f"failed to enqueue remote prefill: {e}"
             )
+            aclose = getattr(stream, "aclose", None)
+            if aclose is not None:
+                with contextlib.suppress(Exception):
+                    await aclose()
+            log_throttled(
+                logger, "disagg-enqueue",
+                "remote prefill enqueue failed (%s); falling back to local "
+                "prefill", e,
+            )
+            self.local_prefills += 1
+            self.obs.prefills.labels("local").inc()
+            self.obs.breaker_events.labels("fallback").inc()
+            return await self.engine.generate(request)
+        except BaseException:
+            # cancellation mid-enqueue: not a verdict on the hub -- free
+            # the probe slot so the breaker can still probe later
+            self.breaker.release_probe()
             raise
+        if time.monotonic() - t0 > self.breaker.max_enqueue_latency_s:
+            self.breaker.record_failure()  # queue-latency breach
+        else:
+            self.breaker.record_success()
+        self.remote_prefills += 1
+        self.obs.prefills.labels("remote").inc()
+        self._depth += 1  # keep the cached snapshot roughly honest
         return stream
 
     async def _kv_deliver(
@@ -680,6 +852,13 @@ class PrefillWorker:
                 # probe both dereference it, and one malformed item must not
                 # abort the batch
                 _ = (msg["decode_component"], int(msg["decode_instance"]))
+                if _queue_deadline_expired(msg):
+                    # budget died on the queue: skip the prefill, tell the
+                    # decode side now so its parked lane frees slot + pages
+                    parsed.append(
+                        TimeoutError("deadline exceeded before remote prefill")
+                    )
+                    continue
                 parsed.append(PreprocessedRequest.from_dict(msg["request"]))
             except Exception as e:  # noqa: BLE001
                 logger.exception("malformed prefill queue item")
@@ -800,6 +979,8 @@ class PrefillWorker:
                 # worker still ships over the wire
                 blob = np.asarray(blob)
             try:
+                if faults.injector.enabled:
+                    await faults.injector.maybe_delay("disagg.slow_export", rid)
                 await self._upload(msg, meta, _blob_chunks(blob))
             except Exception:
                 logger.exception("KV delivery failed for request %s", rid)
@@ -846,15 +1027,26 @@ class PrefillWorker:
         }
 
         async def frames() -> AsyncIterator[bytes]:
+            truncated = False
             async for idx, _lo, _hi, part in stream.chunks():
+                if truncated:
+                    continue  # drain the export without sending (fault)
                 raw = part.tobytes()  # C-order bytes of the layer slab
                 for frame in iter_chunk_frames(
                     idx, bounds[idx][0], raw, KV_CHUNK_BYTES
                 ):
                     yield frame
+                if faults.injector.enabled and faults.injector.should_fire(
+                    "disagg.chunk_truncate", rid
+                ):
+                    # simulated mid-transfer loss: the receiver's assembler
+                    # must detect the truncation and fail the lane fast
+                    truncated = True
 
         t0 = time.perf_counter()
         try:
+            if faults.injector.enabled:
+                await faults.injector.maybe_delay("disagg.slow_export", rid)
             await self._upload(msg, meta, frames())
         except Exception:
             logger.exception("KV delivery failed for request %s", rid)

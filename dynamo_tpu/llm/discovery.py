@@ -19,7 +19,7 @@ import logging
 from typing import Callable, Dict, Optional, Set
 
 from ..http.service import ModelManager
-from ..runtime.component import PushRouter, RouterMode
+from ..runtime.component import FailoverPolicy, PushRouter, RouterMode
 from ..runtime.pipeline import link
 from .backend import Backend
 from .model_card import MODEL_ROOT, ModelDeploymentCard, ModelEntry
@@ -142,7 +142,13 @@ class ModelWatcher:
             )
             client = await endpoint.client()
             self._clients[slug] = [client]
-            router = PushRouter(client, mode=self.router_mode)
+            # the frontend's workers are fungible replicas: request-level
+            # failover is safe (a worker lost before its first response
+            # item redispatches to a survivor) and on by default
+            router = PushRouter(
+                client, mode=self.router_mode,
+                failover=FailoverPolicy.from_env(),
+            )
             if self.engine_factory is not None:
                 engine = self.engine_factory(entry, card, client, router)
                 if hasattr(engine, "__await__"):

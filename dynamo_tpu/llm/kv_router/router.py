@@ -28,6 +28,7 @@ from ...runtime.component import (
     Namespace,
     PushRouter,
 )
+from ...runtime.transports.request_plane import WorkerLostError
 from ...runtime.engine import Annotated, Context, ResponseStream
 from ...tokens.hashing import hash_blocks
 from .indexer import KvIndexer, KvIndexerSharded, OverlapScores
@@ -229,15 +230,17 @@ class KvPushRouter:
                 "kv_donor" if donor is not None else "kv"
             ).inc()
             return stream
-        except (InstanceNotFoundError, ConnectionRefusedError):
+        except (InstanceNotFoundError, ConnectionRefusedError, WorkerLostError):
             # retryable dispatch failures are exactly those where the
-            # request provably never left this process: a stale selection
-            # (instance gone from the live set) or a refused connect (the
-            # worker died before the lease expired).  Anything later must
-            # propagate -- re-dispatching after the worker may have started
-            # executing would run the request twice.  Clear the overlap
-            # estimate: it described the dead worker's cache, not whoever
-            # the fallback picks.
+            # request provably never started: a stale selection (instance
+            # gone from the live set), a refused connect (the worker died
+            # before the lease expired), or a prologue-stage loss (the
+            # worker drained its subject / the connection dropped before
+            # the handler acked).  Anything later must propagate --
+            # re-dispatching after the worker may have started executing
+            # would run the request twice.  Clear the overlap estimate: it
+            # described the dead worker's cache, not whoever the fallback
+            # picks.
             logger.debug(
                 "selected instance %x vanished; falling back", instance_id
             )

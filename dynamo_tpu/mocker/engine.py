@@ -201,6 +201,12 @@ class MockerEngine:
                     yield item
             finally:
                 self._queues.pop(request.id, None)
+                # torn down without a finish (killed ctx -> ResponseStream
+                # acloses the generator; abandoned consumer): cancel the
+                # sequence so its KV blocks free now, not at max_tokens
+                self._cancelled.add(request.id)
+                if self._wake is not None:
+                    self._wake.set()
 
         return ResponseStream(ctx, stream())
 

@@ -28,18 +28,21 @@ import contextlib
 import json
 import logging
 import os
+import random
 import socket
 import time
 from dataclasses import dataclass, field
 from enum import Enum
 from functools import partial
-from typing import Any, AsyncIterator, Dict, List, Optional
+from typing import Any, AsyncIterator, Dict, List, Optional, Set
 
 from .engine import (
+    DEADLINE_EXCEEDED_MSG,
     Annotated,
     AsyncEngine,
     AsyncEngineContext,
     Context,
+    DeadlineExceededError,
     EngineFn,
     ResponseStream,
     ensure_response_stream,
@@ -47,7 +50,12 @@ from .engine import (
 from . import tracing
 from .transports.client import HubClient, StaticHub, WatchHandle
 from .transports.codec import decode_trace_context
-from .transports.request_plane import DataPlaneClient, DataPlaneServer, RemoteError
+from .transports.request_plane import (
+    DataPlaneClient,
+    DataPlaneServer,
+    RemoteError,
+    WorkerLostError,
+)
 
 logger = logging.getLogger("dynamo.runtime")
 
@@ -114,6 +122,9 @@ class DistributedRuntime:
         self.endpoint_stats: Dict[str, "EndpointStats"] = {}
         self._stats_served: set = set()
         self._shutdown = asyncio.Event()
+        # every instance this process registered (drain deregisters them)
+        self.served: List[Instance] = []
+        self.draining = False
 
     # -- constructors ------------------------------------------------------
 
@@ -174,6 +185,61 @@ class DistributedRuntime:
 
     def request_shutdown(self) -> None:
         self._shutdown.set()
+
+    def inflight_requests(self) -> int:
+        """Requests currently being served by this process's endpoints."""
+        return sum(s.in_flight for s in self.endpoint_stats.values())
+
+    async def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful worker drain: deregister every served instance from
+        discovery (watching routers drop it from selection), stop accepting
+        new dispatches (a stale client's request gets a retryable
+        no-handler error, which its failover sends elsewhere), then wait
+        for in-flight requests to finish.  Returns True when the drain
+        completed cleanly within ``timeout_s``.
+
+        SIGTERM (supervisor scale-down, kubernetes preStop) is the
+        intended trigger: drain, then exit -- no request is dropped by a
+        planned shutdown."""
+        if self.draining:
+            return True
+        self.draining = True
+        logger.info(
+            "draining: deregistering %d instances, %d requests in flight",
+            len(self.served), self.inflight_requests(),
+        )
+        for inst in self.served:
+            with contextlib.suppress(Exception):
+                await self.hub.kv_delete(inst.etcd_key)
+            self.data_server.unregister(inst.subject)
+            self.local_engines.pop(inst.subject, None)
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while self.inflight_requests() > 0:
+            if asyncio.get_running_loop().time() >= deadline:
+                logger.warning(
+                    "drain timed out with %d requests still in flight",
+                    self.inflight_requests(),
+                )
+                self._count_drain(clean=False)
+                return False
+            await asyncio.sleep(0.02)
+        self._count_drain(clean=True)
+        logger.info("drain complete")
+        return True
+
+    @staticmethod
+    def _count_drain(clean: bool) -> None:
+        from . import metrics as rtm
+
+        rtm.default_registry().counter(
+            "dynamo_worker_drains",
+            "Graceful worker drains by outcome",
+            ["outcome"],
+        ).labels("clean" if clean else "timeout").inc()
+
+    async def drain_and_shutdown(self, timeout_s: float = 30.0) -> None:
+        await self.drain(timeout_s)
+        self.request_shutdown()
 
     def namespace(self, name: str) -> "Namespace":
         return Namespace(self, name)
@@ -335,6 +401,7 @@ class Endpoint:
             await rt.hub.kv_put(
                 instance.etcd_key, instance.to_json(), lease=rt.primary_lease
             )
+        rt.served.append(instance)
         logger.info("serving %s as instance %x at %s:%d",
                     self.path, instance_id, host, port)
         return instance
@@ -557,6 +624,8 @@ class _IngressHandler:
                 raise
             finally:
                 sp.set(items=n_items, error=failed)
+                if ctx.deadline_expired():
+                    sp.set(deadline_expired=True)
                 sp.__exit__(None, None, None)
                 if stats is not None:
                     stats.in_flight -= 1
@@ -639,10 +708,56 @@ class InstanceNotFoundError(RuntimeError):
     selection -- the worker died between the choice and the dispatch)."""
 
 
+class NoInstancesError(RuntimeError):
+    """No (non-excluded) live instance to dispatch to."""
+
+
 class RouterMode(str, Enum):
     ROUND_ROBIN = "round_robin"
     RANDOM = "random"
     DIRECT = "direct"
+
+
+@dataclass
+class FailoverPolicy:
+    """Bounded request-level failover: a worker lost before it delivered
+    any response item is retried on a *different* instance (the failed one
+    is excluded from selection) after a full-jitter backoff.  A worker
+    lost after output reached the caller is never retried -- redispatching
+    could duplicate delivered tokens -- so mid-stream death degrades to an
+    immediate error frame instead.
+
+    Env defaults: ``DYN_FAILOVER_ATTEMPTS`` (redispatch budget),
+    ``DYN_FAILOVER_BACKOFF_S`` (backoff base)."""
+
+    max_redispatches: int = 2
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 1.0
+
+    @classmethod
+    def from_env(cls) -> "FailoverPolicy":
+        return cls(
+            max_redispatches=int(os.environ.get("DYN_FAILOVER_ATTEMPTS", "2")),
+            backoff_base_s=float(
+                os.environ.get("DYN_FAILOVER_BACKOFF_S", "0.05")
+            ),
+        )
+
+    def backoff_s(self, redispatch_index: int) -> float:
+        """Full jitter over an exponentially-growing window: concurrent
+        failovers off one dead worker spread out instead of stampeding the
+        survivors in lockstep."""
+        window = min(
+            self.backoff_cap_s, self.backoff_base_s * (2 ** redispatch_index)
+        )
+        return random.uniform(0.0, window)
+
+
+# Transport-shaped dispatch failures: the request provably delivered
+# nothing, so redispatch to another instance cannot duplicate output.
+# (WorkerLostError covers conn loss + drained subjects; OSError covers
+# refused/failed dials; InstanceNotFoundError covers stale selections.)
+_RETRYABLE = (WorkerLostError, InstanceNotFoundError, OSError)
 
 
 class PushRouter:
@@ -650,25 +765,33 @@ class PushRouter:
 
     ``generate`` picks an instance (round-robin / random), ``direct`` targets
     a specific instance id (the KV router uses this after best-match).
-    Yields :class:`Annotated` items.
+    Yields :class:`Annotated` items.  With a :class:`FailoverPolicy`
+    attached, ``generate`` additionally survives worker death before the
+    first response item by redispatching to a surviving instance.
     """
 
     def __init__(
-        self, client: Client, mode: RouterMode = RouterMode.ROUND_ROBIN
+        self,
+        client: Client,
+        mode: RouterMode = RouterMode.ROUND_ROBIN,
+        failover: Optional[FailoverPolicy] = None,
     ) -> None:
         self.client = client
         self.mode = mode
+        self.failover = failover
         self._rr = 0
 
-    def _pick(self) -> Instance:
+    def _pick(self, exclude: Optional[Set[int]] = None) -> Instance:
         instances = self.client.instances
+        if exclude:
+            instances = [
+                i for i in instances if i.instance_id not in exclude
+            ]
         if not instances:
-            raise RuntimeError(
+            raise NoInstancesError(
                 f"no instances available for {self.client.endpoint.path}"
             )
         if self.mode == RouterMode.RANDOM:
-            import random
-
             return random.choice(instances)
         inst = instances[self._rr % len(instances)]
         self._rr += 1
@@ -677,7 +800,89 @@ class PushRouter:
     async def generate(
         self, request: Context[Any]
     ) -> ResponseStream[Annotated]:
+        if self.failover is not None:
+            return ResponseStream(request.ctx, self._failover_gen(request))
         return await self._dispatch(self._pick(), request)
+
+    @staticmethod
+    def _count_redispatch(stage: str) -> None:
+        from . import metrics as rtm
+
+        rtm.default_registry().counter(
+            "dynamo_router_redispatches",
+            "Failover redispatches by stage "
+            "(dispatch = connect/prologue failed, "
+            "before_first_token = stream died with nothing delivered)",
+            ["stage"],
+        ).labels(stage).inc()
+
+    async def _failover_gen(
+        self, request: Context[Any]
+    ) -> AsyncIterator[Annotated]:
+        """The failover dispatch loop.  Worker loss *before* any response
+        item: exclude the instance, back off with full jitter, redispatch.
+        Worker loss *after* output was delivered: immediate error frame
+        (never a hang, never a duplicate).  Budget exhausted: error frame
+        naming the last failure."""
+        policy = self.failover
+        assert policy is not None
+        excluded: Set[int] = set()
+        last_exc: Optional[BaseException] = None
+        attempts = policy.max_redispatches + 1
+        for attempt in range(attempts):
+            if attempt:
+                await asyncio.sleep(policy.backoff_s(attempt - 1))
+            if request.ctx.is_stopped():
+                return
+            try:
+                inst = self._pick(exclude=excluded)
+            except NoInstancesError as e:
+                # everyone is dead or excluded; the backoff window also
+                # gives the instance watch time to deliver replacements
+                last_exc = e
+                continue
+            try:
+                stream = await self._dispatch(inst, request)
+            except DeadlineExceededError as e:
+                yield Annotated.from_error(str(e) or DEADLINE_EXCEEDED_MSG)
+                return
+            except _RETRYABLE as e:
+                excluded.add(inst.instance_id)
+                last_exc = e
+                self._count_redispatch("dispatch")
+                logger.warning(
+                    "dispatch to %x failed (%s); redispatching",
+                    inst.instance_id, e,
+                )
+                continue
+            delivered = False
+            try:
+                async for item in stream:
+                    delivered = True
+                    yield item
+                return
+            except DeadlineExceededError as e:
+                yield Annotated.from_error(str(e) or DEADLINE_EXCEEDED_MSG)
+                return
+            except _RETRYABLE as e:
+                if delivered:
+                    # output already reached the caller: a redispatch could
+                    # duplicate it -- fail fast with an error frame instead
+                    yield Annotated.from_error(
+                        f"worker {inst.instance_id:x} lost mid-stream: {e}"
+                    )
+                    return
+                excluded.add(inst.instance_id)
+                last_exc = e
+                self._count_redispatch("before_first_token")
+                logger.warning(
+                    "worker %x lost before first token (%s); redispatching",
+                    inst.instance_id, e,
+                )
+                continue
+        yield Annotated.from_error(
+            f"dispatch failed after {attempts} attempts: {last_exc}"
+        )
 
     def _find_instance(self, instance_id: int) -> Instance:
         for inst in self.client.instances:
@@ -737,6 +942,11 @@ class PushRouter:
         self, inst: Instance, request: Context[Any]
     ) -> ResponseStream[Annotated]:
         rt = self.client.endpoint.runtime
+        # Deadline check at the hop: an expired budget never dispatches --
+        # the caller gets its fast 504 without spending a worker on it.
+        dl = request.ctx.deadline_remaining()
+        if dl is not None and dl <= 0:
+            raise DeadlineExceededError()
         # In-process fast path: skip serialization when the instance lives in
         # this very process (static mode pipelines).  Items are wrapped into
         # the same Annotated envelope the remote path produces, so the stream
@@ -776,6 +986,9 @@ class PushRouter:
                 payload,
                 request.ctx,
                 trace=c.to_wire() if c is not None else None,
+                # remaining budget rides the frame header next to the trace
+                # context; the hop's transit time decrements it naturally
+                deadline=dl,
             )
 
         async def gen() -> AsyncIterator[Annotated]:

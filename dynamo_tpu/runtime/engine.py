@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import time
 import uuid
 from dataclasses import dataclass, field
 from typing import (
@@ -40,15 +41,38 @@ T = TypeVar("T")
 U = TypeVar("U")
 
 
+# The canonical deadline-expiry message: error frames carry it, and the
+# HTTP frontend classifies error frames bearing it as 504.  One constant,
+# shared by every producer and the classifier, so they cannot drift.
+DEADLINE_EXCEEDED_MSG = "deadline exceeded"
+
+
+class DeadlineExceededError(RuntimeError):
+    """A request's deadline budget expired before it completed.  Maps to
+    HTTP 504 at the frontend; transports answer it with a fast error frame
+    instead of computing for a caller that stopped waiting."""
+
+    def __init__(self, message: str = DEADLINE_EXCEEDED_MSG) -> None:
+        super().__init__(message)
+
+
 class AsyncEngineContext:
     """Per-request control surface: id, stop/kill signals, completion.
 
     ``stop_generating`` asks the producer to finish gracefully (emit what it
     has, then end the stream).  ``kill`` demands immediate termination (no
     further items).  Reference: engine.rs:47-85.
+
+    An optional *deadline budget* (seconds remaining) rides along: it is
+    re-anchored on the local monotonic clock at every hop (the wire carries
+    relative seconds, ``codec.encode_deadline_context``), checked before
+    work is admitted, and enforced mid-stream by transport watchdogs that
+    ``kill`` the context at expiry.
     """
 
-    __slots__ = ("_id", "_stopped", "_killed", "_complete", "_children")
+    __slots__ = (
+        "_id", "_stopped", "_killed", "_complete", "_children", "_deadline",
+    )
 
     def __init__(self, request_id: Optional[str] = None) -> None:
         self._id = request_id or uuid.uuid4().hex
@@ -56,6 +80,7 @@ class AsyncEngineContext:
         self._killed = asyncio.Event()
         self._complete = asyncio.Event()
         self._children: list["AsyncEngineContext"] = []
+        self._deadline: Optional[float] = None  # absolute time.monotonic()
 
     @property
     def id(self) -> str:
@@ -98,6 +123,23 @@ class AsyncEngineContext:
             child.kill()
         elif self.is_stopped():
             child.stop_generating()
+
+    # -- deadline budget ---------------------------------------------------
+
+    def set_deadline(self, remaining_s: float) -> None:
+        """Arm (or re-anchor, on a hop) the deadline budget: ``remaining_s``
+        seconds from now on this host's monotonic clock."""
+        self._deadline = time.monotonic() + remaining_s
+
+    def deadline_remaining(self) -> Optional[float]:
+        """Seconds left in the budget (may be negative), or None when no
+        deadline is armed -- the value the next hop's header carries."""
+        if self._deadline is None:
+            return None
+        return self._deadline - time.monotonic()
+
+    def deadline_expired(self) -> bool:
+        return self._deadline is not None and time.monotonic() >= self._deadline
 
 
 @dataclass

@@ -18,10 +18,11 @@ import asyncio
 import contextlib
 import itertools
 import logging
+import random
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
-from .. import tracing
+from .. import faults, tracing
 from .codec import encode_trace_context, read_frame, write_frame
 from .hub import HubState, WatchEvent
 
@@ -123,6 +124,15 @@ class HubClient:
         self._reconnecting = False
         self._keepalives: Dict[int, asyncio.Task] = {}
         self._send_lock = asyncio.Lock()
+        # strong refs for on_connection_lost callback coroutines (a bare
+        # ensure_future can be GC'd mid-await, silently dropping the
+        # notification -- dynalint DT008's hazard class)
+        self._bg_tasks: set = set()
+
+    def _spawn_bg(self, coro: Any) -> None:
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     async def connect(self) -> "HubClient":
         self._reader, self._writer = await asyncio.open_connection(
@@ -161,6 +171,13 @@ class HubClient:
                 if frame is None:
                     break
                 hdr, payload = frame
+                if faults.injector.enabled:
+                    # chaos plane: drop or delay incoming hub frames (watch
+                    # events, sub messages, RPC responses) to exercise the
+                    # reconnect / stale-view recovery paths deterministically
+                    if faults.injector.should_fire("hub.frame_drop"):
+                        continue
+                    await faults.injector.maybe_delay("hub.frame_delay")
                 if "watch" in hdr:
                     ev = WatchEvent(hdr["type"], hdr["key"], payload)
                     q = self._watches.get(hdr["watch"])
@@ -233,7 +250,7 @@ class HubClient:
             with contextlib.suppress(Exception):
                 res = cb()
                 if asyncio.iscoroutine(res):
-                    asyncio.ensure_future(res)
+                    self._spawn_bg(res)
 
     async def _reconnect_loop(self) -> None:
         """Retry the connection with backoff; on success, re-establish
@@ -254,7 +271,10 @@ class HubClient:
                     if asyncio.get_running_loop().time() + delay > deadline:
                         self._fail_connection()
                         return
-                    await asyncio.sleep(delay)
+                    # full jitter (sleep U[0, delay]): a restarted hub sees
+                    # its N clients' reconnects spread across the window
+                    # instead of a thundering herd of synchronized dials
+                    await asyncio.sleep(random.uniform(0.0, delay))
                     delay = min(delay * 2, 2.0)
                     continue
                 self._pump = asyncio.create_task(self._pump_loop())
@@ -267,7 +287,7 @@ class HubClient:
                     if asyncio.get_running_loop().time() + delay > deadline:
                         self._fail_connection()
                         return
-                    await asyncio.sleep(delay)
+                    await asyncio.sleep(random.uniform(0.0, delay))
                     continue
                 self._connected.set()
                 logger.info(
@@ -421,7 +441,7 @@ class HubClient:
             try:
                 res = cb()
                 if asyncio.iscoroutine(res):
-                    asyncio.ensure_future(res)
+                    self._spawn_bg(res)
             except Exception:
                 logger.exception("on_connection_lost callback failed")
 

@@ -25,7 +25,7 @@ _LEN = struct.Struct(">II")
 # cannot ship half-implemented (an encoder the peer cannot parse, or a
 # decoder nothing emits).  Add the kind here FIRST when growing the wire
 # format; the lint failure then lists exactly what is missing.
-FRAME_KINDS = ("frame", "chunk", "trace")
+FRAME_KINDS = ("frame", "chunk", "trace", "deadline")
 
 # 64 MiB hard cap per frame: a corrupt length prefix should fail fast, not OOM.
 MAX_FRAME = 64 * 1024 * 1024
@@ -100,6 +100,39 @@ def decode_trace_context(header: Dict[str, Any]) -> Optional[Dict[str, str]]:
     ``tracing.TraceContext.from_wire`` -- the codec only carries bytes."""
     ctx = header.get(TRACE_HDR_KEY)
     return ctx if isinstance(ctx, dict) else None
+
+
+# ---------------------------------------------------------------------------
+# Deadline-budget header field (request recovery, runtime/engine.py)
+#
+# A request's remaining deadline budget rides every hop's JSON frame header
+# next to the trace context, as *relative seconds remaining* -- wall clocks
+# across hosts need not agree; each receiver re-anchors the budget on its
+# own monotonic clock (``AsyncEngineContext.set_deadline``).  Time spent on
+# the hop decrements the budget naturally.  Optional: requests without a
+# deadline leave the header untouched (byte-identical wire format).
+# ---------------------------------------------------------------------------
+
+DEADLINE_HDR_KEY = "dl"
+
+
+def encode_deadline_context(
+    header: Dict[str, Any], remaining_s: Optional[float]
+) -> Dict[str, Any]:
+    """Stamp the remaining deadline budget (seconds) into a frame header in
+    place; None leaves the header untouched, so call sites need no
+    deadline-armed branch of their own."""
+    if remaining_s is not None:
+        header[DEADLINE_HDR_KEY] = round(float(remaining_s), 4)
+    return header
+
+
+def decode_deadline_context(header: Dict[str, Any]) -> Optional[float]:
+    """Inverse of :func:`encode_deadline_context`: the remaining budget in
+    seconds, or None.  Non-numeric junk decodes to None (a malformed
+    header must not crash the read loop)."""
+    v = header.get(DEADLINE_HDR_KEY)
+    return float(v) if isinstance(v, (int, float)) else None
 
 
 # ---------------------------------------------------------------------------

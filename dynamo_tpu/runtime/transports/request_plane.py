@@ -39,16 +39,44 @@ import asyncio
 import contextlib
 import itertools
 import logging
+import os
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional, Tuple
 
-from ..engine import AsyncEngineContext, ensure_response_stream
-from .codec import encode_trace_context, read_frame, write_frame
+from .. import faults
+from ..engine import (
+    DEADLINE_EXCEEDED_MSG,
+    AsyncEngineContext,
+    DeadlineExceededError,
+    ensure_response_stream,
+)
+from .codec import (
+    decode_deadline_context,
+    encode_deadline_context,
+    encode_trace_context,
+    read_frame,
+    write_frame,
+)
 
 logger = logging.getLogger("dynamo.dataplane")
 
 # How long a stalled consumer may block its (bounded) stream queue before the
-# stream is considered abandoned and dropped.
-ABANDONED_STREAM_TIMEOUT = 60.0
+# stream is considered abandoned and dropped (env: DYN_ABANDONED_STREAM_S).
+ABANDONED_STREAM_TIMEOUT = float(os.environ.get("DYN_ABANDONED_STREAM_S", "60"))
+
+_DEADLINE_MSG = DEADLINE_EXCEEDED_MSG
+
+
+def _count_abandoned(side: str) -> None:
+    """abandoned_streams counter (lazy: transports must import without
+    dragging prometheus in)."""
+    from .. import metrics as rtm
+
+    rtm.default_registry().counter(
+        "dynamo_abandoned_streams",
+        "Streams dropped by the request plane after a consumer stalled "
+        "past the abandoned-stream timeout",
+        ["side"],  # response (client pump) | upload (server chunk queue)
+    ).labels(side).inc()
 
 # A raw byte-level handler: receives (header, payload, ctx) and returns an
 # async iterator of payload byte strings.  Serde lives one layer up (ingress).
@@ -76,6 +104,14 @@ class StreamEnd(Exception):
 
 class RemoteError(Exception):
     """Error raised by the remote handler, propagated through the stream."""
+
+
+class WorkerLostError(RemoteError):
+    """The stream died for transport-shaped reasons -- connection lost, or
+    the worker no longer serves the subject (drain/restart).  Distinct from
+    a handler error so failover can tell "the worker vanished" (retryable
+    on another instance when nothing was delivered yet) from "the request
+    itself failed" (never retryable)."""
 
 
 class DataPlaneServer:
@@ -156,9 +192,22 @@ class DataPlaneServer:
             if raw is None and handler is None:
                 live.pop(sid, None)
                 uploads.pop(sid, None)
+                # "retry" marks a transport-shaped failure: the worker is
+                # not serving this subject (drained / restarting), so the
+                # caller's failover may safely try another instance
                 await send(
-                    {"t": "err", "sid": sid,
+                    {"t": "err", "sid": sid, "retry": True,
                      "msg": f"no handler for subject {subject!r}"}
+                )
+                return
+            if ctx.deadline_expired():
+                # fast 504: the budget died in flight or on the queue --
+                # answer immediately, never touch the engine
+                live.pop(sid, None)
+                uploads.pop(sid, None)
+                await send(
+                    {"t": "err", "sid": sid, "deadline": True,
+                     "msg": _DEADLINE_MSG}
                 )
                 return
             try:
@@ -190,20 +239,67 @@ class DataPlaneServer:
                 uploads.pop(sid, None)
                 return
             await send({"t": "ack", "sid": sid})
+            if faults.injector.enabled and faults.injector.should_fire(
+                "engine.crash_before_first_token", subject
+            ):
+                # simulated worker death at the transport level, after the
+                # engine accepted but before any item: the connection drops
+                # with nothing delivered -- the failover-retryable window.
+                # Kill the context so the engine side cleans up (pages
+                # freed), as a real process death's connection loss would.
+                ctx.kill()
+                writer.close()
+                return
+            # Deadline watchdog: expiry kills the context, which wins the
+            # ResponseStream race below even when the engine is blocked
+            # mid-item; the stream then closes with a deadline error frame
+            # (fast 504 at the frontend) and the kill propagates into the
+            # engine's cancellation path, freeing the request's KV pages.
+            wd = None
+            rem = ctx.deadline_remaining()
+            if rem is not None:
+                wd = asyncio.get_running_loop().call_later(
+                    max(rem, 0.0), ctx.kill
+                )
+            _F = faults.injector
+            n_sent = 0
             try:
                 # ResponseStream races the handler against kill, so a killed
                 # request terminates even when the engine is blocked mid-item.
                 async for item in ensure_response_stream(ctx, stream):
                     if ctx.is_killed():
                         break
+                    if _F.enabled and _F.should_fire(
+                        "req.stream_abort", subject
+                    ):
+                        await send(
+                            {"t": "err", "sid": sid,
+                             "msg": "injected stream abort"}
+                        )
+                        return
                     await send({"t": "data", "sid": sid}, item)
-                await send({"t": "end", "sid": sid})
+                    n_sent += 1
+                    if n_sent == 1 and _F.enabled and _F.should_fire(
+                        "engine.crash_after_first_token", subject
+                    ):
+                        ctx.kill()
+                        writer.close()  # simulated worker death mid-stream
+                        return
+                if ctx.is_killed() and ctx.deadline_expired():
+                    await send(
+                        {"t": "err", "sid": sid, "deadline": True,
+                         "msg": _DEADLINE_MSG}
+                    )
+                else:
+                    await send({"t": "end", "sid": sid})
             except asyncio.CancelledError:
                 raise
             except Exception as exc:  # noqa: BLE001 - mid-stream error
                 logger.exception("handler stream failed for %s", hdr.get("subject"))
                 await send({"t": "err", "sid": sid, "msg": str(exc)})
             finally:
+                if wd is not None:
+                    wd.cancel()
                 ctx.set_complete()
                 live.pop(sid, None)
                 uq_dead = uploads.pop(sid, None)
@@ -232,6 +328,12 @@ class DataPlaneServer:
                     # cancel frame already sitting in the TCP buffer can't
                     # race past the stream it targets.
                     ctx = AsyncEngineContext(hdr.get("id"))
+                    rem = decode_deadline_context(hdr)
+                    if rem is not None:
+                        # re-anchor the caller's remaining budget on this
+                        # host's monotonic clock (the hop's transit time has
+                        # already decremented it)
+                        ctx.set_deadline(rem)
                     live[sid] = ctx
                     uq = None
                     if hdr.get("up"):
@@ -267,6 +369,7 @@ class DataPlaneServer:
                                 "%.0fs); dropping", usid,
                                 ABANDONED_STREAM_TIMEOUT,
                             )
+                            _count_abandoned("upload")
                             uploads.pop(usid, None)
                             uctx = live.get(usid)
                             if uctx is not None:
@@ -306,6 +409,18 @@ class DataPlaneServer:
             self._conn_writers.discard(writer)
             with contextlib.suppress(Exception):
                 writer.close()
+
+
+def _remote_error(hdr: Dict[str, Any]) -> Exception:
+    """Typed exception for an err frame: deadline expiries and transport-
+    shaped losses (conn drop, drained subject) get their own classes so the
+    frontend can map them to 504 / failover without string matching."""
+    msg = hdr.get("msg", "remote error")
+    if hdr.get("deadline"):
+        return DeadlineExceededError(msg)
+    if hdr.get("lost") or hdr.get("retry"):
+        return WorkerLostError(msg)
+    return RemoteError(msg)
 
 
 class _Connection:
@@ -355,6 +470,7 @@ class _Connection:
                             "stream %s abandoned (queue full %.0fs); dropping",
                             sid, ABANDONED_STREAM_TIMEOUT,
                         )
+                        _count_abandoned("response")
                         self._streams.pop(sid, None)
                         with contextlib.suppress(ConnectionError):
                             await self.send(
@@ -371,7 +487,10 @@ class _Connection:
                     with contextlib.suppress(asyncio.QueueEmpty):
                         q.get_nowait()
                 with contextlib.suppress(asyncio.QueueFull):
-                    q.put_nowait(({"t": "err", "msg": "connection lost"}, b""))
+                    q.put_nowait(
+                        ({"t": "err", "lost": True, "msg": "connection lost"},
+                         b"")
+                    )
 
     async def send(self, hdr: Dict[str, Any], payload: bytes = b"") -> None:
         assert self._writer is not None
@@ -398,18 +517,24 @@ class _Connection:
         payload: bytes,
         ctx: AsyncEngineContext,
         trace: Optional[Dict[str, str]] = None,
+        deadline: Optional[float] = None,
     ) -> AsyncIterator[bytes]:
         """Issue a request; await the prologue; yield response payloads.
         ``trace`` is an optional trace-context wire dict carried in the req
-        frame header (absent = untraced, byte-identical wire format)."""
+        frame header (absent = untraced, byte-identical wire format);
+        ``deadline`` is the remaining budget in seconds, stamped next to
+        it."""
         sid = next(self._sid)
         q: asyncio.Queue = asyncio.Queue(maxsize=512)
         self._streams[sid] = q
         await self.send(
-            encode_trace_context(
-                {"t": "req", "sid": sid, "subject": subject,
-                 "id": request_id, "meta": meta},
-                trace,
+            encode_deadline_context(
+                encode_trace_context(
+                    {"t": "req", "sid": sid, "subject": subject,
+                     "id": request_id, "meta": meta},
+                    trace,
+                ),
+                deadline,
             ),
             payload,
         )
@@ -418,7 +543,7 @@ class _Connection:
         hdr, _ = await q.get()
         if hdr.get("t") == "err":
             self._streams.pop(sid, None)
-            raise RemoteError(hdr.get("msg", "remote error"))
+            raise _remote_error(hdr)
         assert hdr.get("t") == "ack", f"bad prologue {hdr}"
 
         async def gen() -> AsyncIterator[bytes]:
@@ -438,7 +563,7 @@ class _Connection:
                         return
                     elif t == "err":
                         ended = True
-                        raise RemoteError(hdr.get("msg", "remote error"))
+                        raise _remote_error(hdr)
             finally:
                 watcher.cancel()
                 # The consumer may stop iterating (kill / early aclose) before
@@ -519,7 +644,7 @@ class _Connection:
         hdr, _ = await q.get()
         if hdr.get("t") == "err":
             self._streams.pop(sid, None)
-            raise RemoteError(hdr.get("msg", "remote error"))
+            raise _remote_error(hdr)
         assert hdr.get("t") == "ack", f"bad prologue {hdr}"
 
         async def gen() -> AsyncIterator[bytes]:
@@ -539,7 +664,7 @@ class _Connection:
                         return
                     elif t == "err":
                         ended = True
-                        raise RemoteError(hdr.get("msg", "remote error"))
+                        raise _remote_error(hdr)
             finally:
                 watcher.cancel()
                 if not ended and ctx.is_stopped() and not cancel_sent[0]:
@@ -580,10 +705,12 @@ class DataPlaneClient:
         payload: bytes,
         ctx: AsyncEngineContext,
         trace: Optional[Dict[str, str]] = None,
+        deadline: Optional[float] = None,
     ) -> AsyncIterator[bytes]:
         conn = await self._get(host, port)
         return await conn.request(
-            subject, request_id, meta, payload, ctx, trace=trace
+            subject, request_id, meta, payload, ctx, trace=trace,
+            deadline=deadline,
         )
 
     async def request_upload(

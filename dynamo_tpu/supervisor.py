@@ -60,9 +60,15 @@ class Watcher:
     replicas: int
     env: Optional[Dict[str, str]] = None
     cwd: Optional[str] = None
+    # SIGTERM first: workers install a drain handler (deregister from
+    # discovery, finish in-flight, exit) and get stop_grace_s to use it
+    # before SIGKILL -- scale-down and shutdown never drop requests that a
+    # drain could have finished
     stop_signal: int = signal.SIGTERM
     stop_grace_s: float = 5.0
     restarts: int = 0  # observability: total restart count
+    graceful_stops: int = 0  # exited within grace after stop_signal
+    forced_kills: int = 0  # needed SIGKILL after the grace expired
     _procs: List[_Replica] = field(default_factory=list)
 
 
@@ -80,11 +86,12 @@ class Supervisor:
         replicas: int = 1,
         env: Optional[Dict[str, str]] = None,
         cwd: Optional[str] = None,
+        stop_grace_s: float = 5.0,
     ) -> Watcher:
         if name in self.watchers:
             raise ValueError(f"watcher {name!r} already exists")
         w = Watcher(name=name, cmd=list(cmd), replicas=replicas,
-                    env=env, cwd=cwd)
+                    env=env, cwd=cwd, stop_grace_s=stop_grace_s)
         self.watchers[name] = w
         return w
 
@@ -141,7 +148,13 @@ class Supervisor:
             proc.send_signal(w.stop_signal)
         try:
             await asyncio.wait_for(proc.wait(), w.stop_grace_s)
+            w.graceful_stops += 1
         except asyncio.TimeoutError:
+            logger.warning(
+                "watcher %s: replica ignored signal %d for %.1fs; killing",
+                w.name, w.stop_signal, w.stop_grace_s,
+            )
+            w.forced_kills += 1
             with contextlib.suppress(ProcessLookupError):
                 proc.kill()
             await proc.wait()

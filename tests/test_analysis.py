@@ -543,6 +543,84 @@ def test_dt007_registry_facade_usage_is_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DT008: fire-and-forget tasks
+# ---------------------------------------------------------------------------
+
+
+def test_dt008_discarded_task_handle(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import asyncio
+
+        async def bad(coro, other):
+            asyncio.create_task(coro)
+            asyncio.ensure_future(other)
+        """,
+        rules=["DT008"],
+    )
+    assert rule_ids(findings) == ["DT008", "DT008"]
+    assert all(f.qualname == "bad" for f in findings)
+
+
+def test_dt008_clean_twins(tmp_path):
+    """Stored handles, done-callback chains, container registration, and
+    inline awaits all keep (or surface) the task -- no findings."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import asyncio
+
+        tasks = set()
+
+        async def good(coro, a, b, c):
+            t = asyncio.create_task(coro)
+            tasks.add(asyncio.create_task(a))
+            asyncio.create_task(b).add_done_callback(tasks.discard)
+            await asyncio.ensure_future(c)
+            return t
+        """,
+        rules=["DT008"],
+    )
+    assert findings == []
+
+
+def test_dt008_taskgroup_is_clean(tmp_path):
+    """TaskGroup.create_task holds the reference and surfaces crashes at
+    __aexit__ -- discarding its result is the canonical pattern."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import asyncio
+
+        async def good(coro, other):
+            async with asyncio.TaskGroup() as tg:
+                tg.create_task(coro)
+            loop = asyncio.get_running_loop()
+            loop.create_task(other)  # this one IS the hazard
+        """,
+        rules=["DT008"],
+    )
+    assert rule_ids(findings) == ["DT008"]
+    assert "loop.create_task" in findings[0].message
+
+
+def test_dt008_suppression(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import asyncio
+
+        async def main(coro):
+            # short-lived helper; crash surfaced by the join below
+            asyncio.create_task(coro)  # dynalint: disable=DT008
+        """,
+        rules=["DT008"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------
 
@@ -774,7 +852,8 @@ def test_repo_baseline_is_empty():
 
 def test_codec_frame_kinds_registry_present():
     """DT006's anchor: the registry exists and covers the wire formats the
-    transfer plane speaks today (frames, KV chunks, trace contexts)."""
+    transfer plane speaks today (frames, KV chunks, trace contexts,
+    deadline budgets)."""
     from dynamo_tpu.runtime.transports import codec
 
-    assert set(codec.FRAME_KINDS) == {"frame", "chunk", "trace"}
+    assert set(codec.FRAME_KINDS) == {"frame", "chunk", "trace", "deadline"}

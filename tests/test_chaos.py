@@ -1,0 +1,768 @@
+"""Seeded chaos suite: deterministic fault injection driving the recovery
+machinery end-to-end -- failover before first token, fast error frames on
+mid-stream death, deadline budgets (504 + zero leaked KV pages), the
+remote-prefill circuit breaker, admission-control shedding, and a
+randomized soak (slow).
+
+Everything is mocker-backed and single-process, but every dispatch takes
+the real wire path (HubServer + per-worker DataPlaneServers over real
+sockets), so the faults exercise the same transports production uses.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.http import HttpService, ModelManager
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime import metrics as rtm
+from dynamo_tpu.runtime.component import (
+    DistributedRuntime,
+    FailoverPolicy,
+    PushRouter,
+)
+from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.runtime.transports.codec import (
+    decode_deadline_context,
+    encode_deadline_context,
+)
+from dynamo_tpu.runtime.transports.hub import HubServer
+
+from tests.test_serving import http_request
+
+
+@pytest.fixture
+def injector():
+    """The process injector, disarmed on the way out."""
+    faults.injector.disable()
+    yield faults.injector
+    faults.injector.disable()
+
+
+@pytest.fixture
+def registry():
+    """Fresh default metrics registry per test."""
+    prev = rtm.set_default(rtm.MetricsRegistry())
+    yield rtm.default_registry()
+    rtm.set_default(prev)
+
+
+def req(tokens, max_tokens=8) -> dict:
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0),
+    ).to_dict()
+
+
+async def expected_tokens(tokens, max_tokens=8):
+    """The deterministic mocker output for this prompt, computed on a
+    private engine -- what any worker must produce."""
+    eng = MockerEngine(MockerConfig(block_size=4))
+    try:
+        stream = await eng.generate(Context.new(req(tokens, max_tokens)))
+        out = []
+        async for item in stream:
+            out.extend((item.data or {}).get("token_ids") or [])
+        return out
+    finally:
+        await eng.stop()
+
+
+class Cluster:
+    """N mocker workers + a frontend client, all over real sockets."""
+
+    def __init__(self):
+        self.hub = None
+        self.workers = []
+        self.engines = []
+        self.frontend = None
+        self.client = None
+
+    async def start(self, n_workers=2, mocker_cfg=None, ns="chaos"):
+        self.hub = HubServer()
+        host, port = await self.hub.start()
+        addr = f"{host}:{port}"
+        for _ in range(n_workers):
+            rt = await DistributedRuntime.detached(addr)
+            eng = MockerEngine(mocker_cfg or MockerConfig(block_size=4))
+            await (
+                rt.namespace(ns).component("backend").endpoint("generate")
+                .serve(eng)
+            )
+            self.workers.append(rt)
+            self.engines.append(eng)
+        self.frontend = await DistributedRuntime.detached(addr)
+        self.client = await (
+            self.frontend.namespace(ns).component("backend")
+            .endpoint("generate").client()
+        )
+        deadline = time.monotonic() + 5
+        while len(self.client.instances) < n_workers:
+            assert time.monotonic() < deadline, "workers never registered"
+            await asyncio.sleep(0.02)
+        return self
+
+    async def stop(self):
+        if self.client is not None:
+            await self.client.close()
+        if self.frontend is not None:
+            await self.frontend.shutdown()
+        for eng in self.engines:
+            await eng.stop()
+        for rt in self.workers:
+            await rt.shutdown()
+        if self.hub is not None:
+            await self.hub.stop()
+
+
+async def collect(stream):
+    """(tokens, errors) from an Annotated stream."""
+    tokens, errors = [], []
+    async for item in stream:
+        if not isinstance(item, Annotated):
+            item = Annotated.from_data(item)
+        if item.is_error():
+            errors.append(item.error_message())
+        else:
+            tokens.extend((item.data or {}).get("token_ids") or [])
+    return tokens, errors
+
+
+# -- fault-injection plane ---------------------------------------------------
+
+
+def test_fault_schedule_is_deterministic(injector):
+    """Acceptance: the same DYN_FAULTS seed reproduces the identical fault
+    schedule, draw for draw."""
+    spec = "seed=7;hub.frame_drop=0.5;req.stream_abort=0.3:max=5"
+
+    def drive():
+        injector.configure(spec)
+        for i in range(200):
+            injector.should_fire("hub.frame_drop")
+            injector.should_fire("req.stream_abort", f"key{i}")
+        return injector.schedule()
+
+    first, second = drive(), drive()
+    assert first, "nothing fired at p=0.5 over 200 draws?!"
+    assert first == second
+    # a different seed produces a different schedule
+    injector.configure(spec.replace("seed=7", "seed=8"))
+    for i in range(200):
+        injector.should_fire("hub.frame_drop")
+        injector.should_fire("req.stream_abort", f"key{i}")
+    assert injector.schedule() != first
+
+
+def test_fault_spec_validation(injector):
+    with pytest.raises(faults.FaultSpecError):
+        injector.configure("no.such.site=1")
+    with pytest.raises(faults.FaultSpecError):
+        injector.configure("hub.frame_drop=notafloat")
+    with pytest.raises(faults.FaultSpecError):
+        injector.configure("seed=x")
+    injector.configure("hub.frame_drop=0.5:max=2:after=1:delay=0.1")
+    assert injector.enabled
+    assert injector.delay_s("hub.frame_drop") == 0.1
+
+
+def test_match_filter_does_not_advance_stream(injector):
+    """Evaluations filtered out by match= must not draw: unrelated traffic
+    cannot shift the schedule for the traffic that matters."""
+    injector.configure("seed=3;req.stream_abort=0.5:match=want")
+    for i in range(100):
+        injector.should_fire("req.stream_abort", f"want{i}")
+    clean = [(f["site"], f["draw"]) for f in injector.schedule()]
+
+    injector.configure("seed=3;req.stream_abort=0.5:match=want")
+    for i in range(100):
+        injector.should_fire("req.stream_abort", "noise")  # filtered
+        injector.should_fire("req.stream_abort", f"want{i}")
+    noisy = [(f["site"], f["draw"]) for f in injector.schedule()]
+    assert clean == noisy
+
+
+def test_disabled_injector_fires_nothing(injector):
+    assert not injector.enabled
+    assert not injector.should_fire("hub.frame_drop")
+
+
+def test_max_and_after_caps(injector):
+    injector.configure("seed=1;hub.frame_drop=1:max=2:after=3")
+    fires = [injector.should_fire("hub.frame_drop") for _ in range(10)]
+    assert fires == [False] * 3 + [True, True] + [False] * 5
+
+
+# -- deadline plumbing units -------------------------------------------------
+
+
+def test_deadline_codec_roundtrip():
+    hdr = encode_deadline_context({"t": "req"}, 1.5)
+    assert decode_deadline_context(hdr) == 1.5
+    assert decode_deadline_context({"t": "req"}) is None
+    assert decode_deadline_context({"dl": "junk"}) is None
+    # None leaves the header untouched (byte-identical wire format)
+    assert encode_deadline_context({"t": "req"}, None) == {"t": "req"}
+
+
+def test_ctx_deadline_budget():
+    ctx = Context.new(None).ctx
+    assert ctx.deadline_remaining() is None
+    assert not ctx.deadline_expired()
+    ctx.set_deadline(10.0)
+    rem = ctx.deadline_remaining()
+    assert rem is not None and 9.0 < rem <= 10.0
+    ctx.set_deadline(-0.1)
+    assert ctx.deadline_expired()
+
+
+def test_failover_backoff_bounds():
+    p = FailoverPolicy(backoff_base_s=0.05, backoff_cap_s=0.4)
+    for i in range(8):
+        for _ in range(20):
+            b = p.backoff_s(i)
+            assert 0.0 <= b <= min(0.4, 0.05 * 2**i)
+
+
+# -- request-level failover (acceptance) -------------------------------------
+
+
+def test_failover_before_first_token(run, injector, registry):
+    """Kill-worker-before-first-token fault: the request completes via
+    failover on another worker with the correct output, and
+    redispatches_total increments."""
+
+    async def body():
+        cluster = await Cluster().start(n_workers=2)
+        try:
+            injector.configure(
+                "seed=11;engine.crash_before_first_token=1:max=1:match=.generate-"
+            )
+            router = PushRouter(
+                cluster.client,
+                failover=FailoverPolicy(
+                    max_redispatches=2, backoff_base_s=0.01
+                ),
+            )
+            prompt = [1, 2, 3, 4, 5]
+            want = await expected_tokens(prompt, max_tokens=6)
+            stream = await router.generate(
+                Context.new(req(prompt, max_tokens=6))
+            )
+            tokens, errors = await collect(stream)
+            assert errors == []
+            assert tokens == want and tokens
+            # exactly one injected crash, exactly one redispatch
+            assert injector.fire_count("engine.crash_before_first_token") == 1
+            sched = injector.schedule()
+            assert [(f["site"], f["draw"]) for f in sched] == [
+                ("engine.crash_before_first_token", 0)
+            ]
+            assert (
+                registry.sample(
+                    "dynamo_router_redispatches",
+                    {"stage": "before_first_token"},
+                )
+                == 1
+            )
+            assert (
+                registry.sample(
+                    "dynamo_faults_injected",
+                    {"site": "engine.crash_before_first_token"},
+                )
+                == 1
+            )
+        finally:
+            await cluster.stop()
+
+    run(body())
+
+
+def test_mid_stream_crash_yields_fast_error_frame(run, injector, registry):
+    """Kill-mid-stream: the client receives an error frame quickly (not a
+    ride on the abandoned-stream timeout), and delivered output is never
+    retried on another worker."""
+
+    async def body():
+        cluster = await Cluster().start(n_workers=2)
+        try:
+            injector.configure(
+                "seed=5;engine.crash_after_first_token=1:max=1:match=.generate-"
+            )
+            router = PushRouter(
+                cluster.client,
+                failover=FailoverPolicy(
+                    max_redispatches=2, backoff_base_s=0.01
+                ),
+            )
+            t0 = time.monotonic()
+            stream = await router.generate(
+                Context.new(req([9, 8, 7], max_tokens=32))
+            )
+            tokens, errors = await collect(stream)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 2.0, f"error took {elapsed:.1f}s to surface"
+            assert len(errors) == 1 and "lost mid-stream" in errors[0]
+            assert tokens, "the first token must have been delivered"
+            # no redispatch after delivered output
+            assert (
+                registry.sample(
+                    "dynamo_router_redispatches",
+                    {"stage": "before_first_token"},
+                )
+                is None
+            )
+        finally:
+            await cluster.stop()
+
+    run(body())
+
+
+def test_stream_abort_fault_surfaces_as_error(run, injector, registry):
+    async def body():
+        cluster = await Cluster().start(n_workers=1)
+        try:
+            injector.configure(
+                "seed=2;req.stream_abort=1:max=1:match=.generate-"
+            )
+            router = PushRouter(cluster.client)
+            stream = await router.generate(
+                Context.new(req([4, 4, 4], max_tokens=16))
+            )
+            with pytest.raises(Exception, match="injected stream abort"):
+                await collect(stream)
+        finally:
+            await cluster.stop()
+
+    run(body())
+
+
+def test_failover_budget_exhaustion_is_an_error_frame(run, injector, registry):
+    """Every worker dying still terminates the request with a clear error,
+    never a hang."""
+
+    async def body():
+        cluster = await Cluster().start(n_workers=2)
+        try:
+            injector.configure(
+                "seed=4;engine.crash_before_first_token=1:match=.generate-"
+            )  # no max: every dispatch dies
+            router = PushRouter(
+                cluster.client,
+                failover=FailoverPolicy(
+                    max_redispatches=2, backoff_base_s=0.01
+                ),
+            )
+            stream = await router.generate(
+                Context.new(req([6, 6], max_tokens=4))
+            )
+            tokens, errors = await asyncio.wait_for(collect(stream), 10)
+            assert tokens == []
+            assert len(errors) == 1 and "after 3 attempts" in errors[0]
+        finally:
+            await cluster.stop()
+
+    run(body())
+
+
+# -- deadline budgets end-to-end (acceptance) --------------------------------
+
+
+def test_expired_deadline_504_and_no_leaked_pages(run, injector, model_dir):
+    """A request whose deadline expires mid-generation returns HTTP 504 and
+    leaves zero leaked KV pages on the worker."""
+    prev = rtm.set_default(rtm.MetricsRegistry())
+    try:
+        from dynamo_tpu.llm import Backend, OpenAIPreprocessor, Tokenizer
+        from dynamo_tpu.runtime.pipeline import link
+
+        async def body():
+            # slow decode so a 0.3s budget dies mid-stream
+            cluster = await Cluster().start(
+                n_workers=1,
+                mocker_cfg=MockerConfig(
+                    block_size=4, decode_s_per_step=0.05
+                ),
+            )
+            svc = None
+            try:
+                tok = Tokenizer.from_model_dir(model_dir)
+                router = PushRouter(
+                    cluster.client, failover=FailoverPolicy.from_env()
+                )
+                engine = link(
+                    OpenAIPreprocessor("m", tok), Backend(tok), router
+                )
+                manager = ModelManager()
+                manager.add_chat_model("m", engine)
+                svc = HttpService(manager, default_deadline_s=0.3)
+                await svc.start()
+                host, port = svc.address
+                t0 = time.monotonic()
+                status, _headers, payload = await http_request(
+                    host, port, "POST", "/v1/chat/completions",
+                    {
+                        "model": "m",
+                        "messages": [{"role": "user", "content": "hello"}],
+                        "max_tokens": 400,
+                    },
+                )
+                elapsed = time.monotonic() - t0
+                assert status == 504, payload
+                assert payload["error"]["type"] == "timeout_error"
+                assert elapsed < 5.0, "504 must be fast, not a hang"
+                # zero leaked KV pages once the cancellation propagates
+                eng = cluster.engines[0]
+                deadline = time.monotonic() + 3
+                while eng.kv.num_active_blocks and time.monotonic() < deadline:
+                    await asyncio.sleep(0.05)
+                assert eng.kv.num_active_blocks == 0
+                assert not eng.running
+            finally:
+                if svc is not None:
+                    await svc.stop()
+                await cluster.stop()
+
+        run(body())
+    finally:
+        rtm.set_default(prev)
+
+
+def test_preexpired_deadline_is_rejected_before_dispatch(run, injector, registry):
+    """A budget that is already spent never reaches a worker."""
+
+    async def body():
+        cluster = await Cluster().start(n_workers=1)
+        try:
+            router = PushRouter(
+                cluster.client,
+                failover=FailoverPolicy(max_redispatches=1,
+                                        backoff_base_s=0.01),
+            )
+            request = Context.new(req([5, 5, 5], max_tokens=4))
+            request.ctx.set_deadline(-0.01)
+            stream = await router.generate(request)
+            tokens, errors = await collect(stream)
+            assert tokens == []
+            assert len(errors) == 1 and "deadline exceeded" in errors[0]
+            assert cluster.engines[0].tokens_generated == 0
+        finally:
+            await cluster.stop()
+
+    run(body())
+
+
+# -- circuit breaker / disagg graceful degradation ---------------------------
+
+
+class StubDisaggEngine:
+    """Minimal engine surface DisaggDecodeEngine drives."""
+
+    def __init__(self):
+        self.local_generates = 0
+        self.failed = {}
+        self._awaiting = set()
+
+    async def generate(self, request):
+        self.local_generates += 1
+
+        async def gen():
+            yield Annotated.from_data({"token_ids": [1], "finish_reason": "stop"})
+
+        return gen()
+
+    async def generate_external(self, request):
+        self._awaiting.add(request.id)
+
+        async def gen():
+            yield Annotated.from_data({"token_ids": [2], "finish_reason": "stop"})
+
+        return gen()
+
+    def awaiting_external(self, rid):
+        return rid in self._awaiting
+
+    def fail_external(self, rid, msg):
+        self.failed[rid] = msg
+        self._awaiting.discard(rid)
+        return True
+
+
+def test_breaker_opens_and_requests_degrade_to_local(run, injector, registry):
+    """Enqueue failures trip the breaker: requests are served via local
+    prefill (graceful degradation, not hard failure), and while open the
+    queue is not touched at all."""
+
+    async def body():
+        from dynamo_tpu.llm.disagg import DisaggConfig, DisaggDecodeEngine
+
+        rt = await DistributedRuntime.static()
+        stub = StubDisaggEngine()
+        disagg = DisaggDecodeEngine(
+            stub, rt.namespace("cb"), "decode", instance_id=1,
+            cfg=DisaggConfig(max_local_prefill_length=4),
+        )
+        injector.configure("seed=1;disagg.enqueue_fail=1:max=3")
+        prompt = list(range(64))  # long prefill: remote-eligible
+
+        async def one(i):
+            stream = await disagg.generate(
+                Context.new(req(prompt, max_tokens=2), request_id=f"r{i}")
+            )
+            return await collect(stream)
+
+        # 3 enqueue failures: each degrades to local and counts a breach
+        for i in range(3):
+            tokens, errors = await one(i)
+            assert errors == [] and tokens == [1]  # local fallback path
+        assert disagg.breaker.state == disagg.breaker.OPEN
+        assert len(stub.failed) == 3  # each parked lane was unparked
+        assert stub.local_generates == 3
+        # while open: straight to local, no queue interaction
+        tokens, _ = await one(3)
+        assert tokens == [1]
+        assert stub.local_generates == 4
+        assert await disagg.queue.depth() == 0
+        # half-open probe after the window: enqueue now succeeds -> closed
+        disagg.breaker.open_s = 0.01
+        await asyncio.sleep(0.03)
+        tokens, _ = await one(4)
+        assert tokens == [2]  # remote path (external stream)
+        assert disagg.breaker.state == disagg.breaker.CLOSED
+        assert await disagg.queue.depth() == 1
+        assert disagg.remote_prefills == 1
+        # 3 enqueue-failure fallbacks + 1 open-state fallback
+        assert registry.sample(
+            "dynamo_disagg_breaker_events", {"event": "fallback"}
+        ) == 4.0
+        await rt.shutdown()
+
+    run(body())
+
+
+def test_breaker_state_machine(registry):
+    from dynamo_tpu.llm.disagg import CircuitBreaker
+
+    b = CircuitBreaker(failure_threshold=2, open_s=0.05,
+                       max_enqueue_latency_s=1.0)
+    assert b.allow() and b.state == b.CLOSED
+    b.record_failure()
+    assert b.state == b.CLOSED  # one failure is not a pattern
+    b.record_failure()
+    assert b.state == b.OPEN
+    assert not b.allow()
+    time.sleep(0.06)
+    assert b.allow()  # half-open probe
+    assert b.state == b.HALF_OPEN
+    assert not b.allow()  # only one probe at a time
+    b.record_failure()
+    assert b.state == b.OPEN  # failed probe re-opens
+    time.sleep(0.06)
+    assert b.allow()
+    b.record_success()
+    assert b.state == b.CLOSED
+    # a probe released without a verdict (admission failed / engine raised
+    # before any hub attempt) must not move the state, reset the failure
+    # count, or leak the half-open slot
+    b.record_failure()
+    assert b._consecutive_failures == 1
+    b.allow()
+    b.release_probe()
+    assert b.state == b.CLOSED and b._consecutive_failures == 1
+    b.record_failure()
+    assert b.state == b.OPEN  # threshold 2 reached despite the release
+    time.sleep(0.06)
+    assert b.allow()  # half-open probe taken
+    b.release_probe()
+    assert b.state == b.HALF_OPEN
+    assert b.allow()  # slot is free for the next real probe
+
+
+def test_queue_item_deadline_expiry():
+    from dynamo_tpu.llm.disagg import _queue_deadline_expired
+
+    assert not _queue_deadline_expired({})
+    assert not _queue_deadline_expired(
+        {"deadline": {"remaining_s": 30.0, "wall": time.time()}}
+    )
+    assert _queue_deadline_expired(
+        {"deadline": {"remaining_s": 0.5, "wall": time.time() - 2.0}}
+    )
+    assert not _queue_deadline_expired({"deadline": {"remaining_s": "x"}})
+
+
+# -- admission control (shedding) --------------------------------------------
+
+
+def test_admission_control_sheds_past_inflight_bound(run, registry):
+    async def body():
+        from dynamo_tpu.runtime.engine import EngineFn
+
+        release = asyncio.Event()
+
+        async def slow_engine(request):
+            async def gen():
+                await release.wait()
+                yield Annotated.from_data(
+                    {"id": "c", "model": "m",
+                     "choices": [{"index": 0, "delta": {"content": "hi"},
+                                  "finish_reason": "stop"}]}
+                )
+
+            return gen()
+
+        manager = ModelManager()
+        manager.add_chat_model("m", EngineFn(slow_engine))
+        svc = HttpService(manager, max_inflight=1)
+        await svc.start()
+        try:
+            host, port = svc.address
+            body_json = {
+                "model": "m",
+                "messages": [{"role": "user", "content": "x"}],
+            }
+            first = asyncio.ensure_future(
+                http_request(host, port, "POST", "/v1/chat/completions",
+                             body_json)
+            )
+            await asyncio.sleep(0.2)  # first request is now in flight
+            status2, headers2, payload2 = await http_request(
+                host, port, "POST", "/v1/chat/completions", body_json
+            )
+            assert status2 == 503
+            assert headers2.get("retry-after") == "1"
+            assert payload2["error"]["type"] == "overloaded_error"
+            release.set()
+            status1, _h, _p = await first
+            assert status1 == 200
+            # the slot freed: a third request is admitted again
+            status3, _h, _p = await http_request(
+                host, port, "POST", "/v1/chat/completions", body_json
+            )
+            assert status3 == 200
+            assert svc.metrics.registry is not None
+            sheds = svc.metrics._metrics.sample(
+                "dynamo_http_service_sheds", {"endpoint": "chat_completions"}
+            )
+            assert sheds == 1.0
+            assert svc.admission.inflight == 0
+        finally:
+            await svc.stop()
+
+    run(body())
+
+
+# -- worker drain ------------------------------------------------------------
+
+
+def test_drain_deregisters_and_finishes_inflight(run, injector, registry):
+    """Drain: instance leaves discovery (router stops picking it), in-flight
+    requests finish, and a stale dispatch gets a retryable error that
+    failover sends to a survivor."""
+
+    async def body():
+        cluster = await Cluster().start(
+            n_workers=2,
+            mocker_cfg=MockerConfig(block_size=4, decode_s_per_step=0.01),
+        )
+        try:
+            router = PushRouter(
+                cluster.client,
+                failover=FailoverPolicy(max_redispatches=2,
+                                        backoff_base_s=0.01),
+            )
+            # a long request pinned to worker 0 (round robin starts there)
+            stream = await router.generate(
+                Context.new(req([3, 1, 4, 1, 5], max_tokens=40))
+            )
+            consume = asyncio.ensure_future(collect(stream))
+            await asyncio.sleep(0.1)  # it is now in flight on some worker
+            target = cluster.workers[0]
+            drained_clean = await target.drain(timeout_s=10.0)
+            assert drained_clean
+            assert target.inflight_requests() == 0
+            tokens, errors = await asyncio.wait_for(consume, 10)
+            # the in-flight request finished normally -- drain never drops
+            assert errors == []
+            assert tokens
+            # discovery no longer lists the drained instance
+            deadline = time.monotonic() + 3
+            while len(cluster.client.instances) > 1:
+                assert time.monotonic() < deadline
+                await asyncio.sleep(0.02)
+            # new requests land on the survivor
+            want = await expected_tokens([2, 7, 1], max_tokens=4)
+            stream = await router.generate(
+                Context.new(req([2, 7, 1], max_tokens=4))
+            )
+            tokens, errors = await collect(stream)
+            assert errors == [] and tokens == want
+            assert registry.sample(
+                "dynamo_worker_drains", {"outcome": "clean"}
+            ) == 1.0
+        finally:
+            await cluster.stop()
+
+    run(body())
+
+
+# -- randomized chaos soak (slow) --------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_soak_every_request_terminates(run, injector, registry):
+    """Randomized multi-fault soak: under crash/abort/delay faults, every
+    request must terminate promptly with either the correct output or an
+    explicit error frame -- never a hang, never wrong tokens."""
+
+    async def body():
+        outcomes = {"ok": 0, "error": 0}
+        for seed in (1, 2, 3):
+            cluster = await Cluster().start(n_workers=3)
+            try:
+                injector.configure(
+                    f"seed={seed};"
+                    "engine.crash_before_first_token=0.25:match=.generate-;"
+                    "engine.crash_after_first_token=0.1:match=.generate-;"
+                    "req.stream_abort=0.1:match=.generate-;"
+                    "hub.frame_delay=0.2:delay=0.005"
+                )
+                router = PushRouter(
+                    cluster.client,
+                    failover=FailoverPolicy(max_redispatches=3,
+                                            backoff_base_s=0.01),
+                )
+                for i in range(25):
+                    prompt = [seed, i, i + 1]
+                    want = await expected_tokens(prompt, max_tokens=5)
+                    stream = await router.generate(
+                        Context.new(req(prompt, max_tokens=5))
+                    )
+                    try:
+                        tokens, errors = await asyncio.wait_for(
+                            collect(stream), 15
+                        )
+                    except Exception as e:  # noqa: BLE001 - abort path
+                        outcomes["error"] += 1
+                        assert "abort" in str(e) or "lost" in str(e), e
+                        continue
+                    if errors:
+                        outcomes["error"] += 1
+                    else:
+                        assert tokens == want
+                        outcomes["ok"] += 1
+            finally:
+                injector.disable()
+                await cluster.stop()
+        # the faults fired, and recovery still served most traffic
+        assert outcomes["ok"] > 0 and outcomes["ok"] + outcomes["error"] == 75
+
+    run(body())

@@ -84,6 +84,80 @@ def test_flapping_replica_is_parked(run):
     run(body())
 
 
+def test_flap_backoff_bounds_restart_rate(run):
+    """Crash-restart backoff: consecutive fast exits must space restarts
+    exponentially, so a crashing command cannot spin the supervisor hot.
+    With base 0.05s the first five respawns cost >= 0.05+0.1+0.2+0.4+0.8s
+    of backoff alone -- a bounded observation window must therefore see
+    only a handful of restarts (an unbacked-off loop would do hundreds)."""
+
+    async def body():
+        import dynamo_tpu.supervisor as sv
+
+        sup = Supervisor()
+        sup.add_watcher("crash", [sys.executable, "-c", "raise SystemExit(9)"],
+                        replicas=1)
+        old_base, old_flaps = sv.BACKOFF_BASE_S, sv.MAX_FLAPS
+        sv.BACKOFF_BASE_S = 0.05
+        sv.MAX_FLAPS = 100  # keep it restarting for the whole window
+        try:
+            await sup.start()
+            w = sup.watchers["crash"]
+            await asyncio.sleep(1.0)
+            # backoff budget spent by restart n grows as 0.05*(2^n - 1):
+            # 1s of wall time admits at most ~5 restarts plus slack
+            assert 1 <= w.restarts <= 8
+            assert w._procs[0].flaps >= 1
+        finally:
+            sv.BACKOFF_BASE_S = old_base
+            sv.MAX_FLAPS = old_flaps
+            await sup.stop()
+
+    run(body())
+
+
+def test_scale_down_drains_via_sigterm(run, tmp_path):
+    """Scale-down must give replicas their stop signal + grace to drain
+    (the worker side hooks SIGTERM to deregister/finish in-flight): a
+    replica that exits cleanly on SIGTERM is a graceful stop, never a
+    SIGKILL."""
+
+    async def body():
+        marker = tmp_path / "drained"
+        ready = tmp_path / "ready"
+        script = (
+            "import os, signal, sys, time\n"
+            "def term(sig, frame):\n"
+            f"    open({str(marker)!r}, 'w').write('drained')\n"
+            "    sys.exit(0)\n"
+            "signal.signal(signal.SIGTERM, term)\n"
+            f"open({str(ready)!r}, 'a').write(str(os.getpid()) + '\\n')\n"
+            "time.sleep(60)\n"
+        )
+        sup = Supervisor()
+        sup.add_watcher("w", [sys.executable, "-c", script], replicas=2,
+                        stop_grace_s=5.0)
+        await sup.start()
+        try:
+            w = sup.watchers["w"]
+            # wait until both replicas confirmed their handler is installed
+            for _ in range(200):
+                if ready.exists() and len(ready.read_text().split()) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(ready.read_text().split()) == 2
+
+            await sup.scale("w", 1)
+            assert sup.replica_count("w") == 1
+            assert marker.exists() and marker.read_text() == "drained"
+            assert w.graceful_stops == 1
+            assert w.forced_kills == 0
+        finally:
+            await sup.stop()
+
+    run(body())
+
+
 def test_parked_replica_rearms_on_scale(run, tmp_path):
     """The logged remedy must work: after fixing the command, scale()
     drops parked slots and spawns fresh replicas."""
