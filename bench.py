@@ -26,6 +26,8 @@ def build_engine(
     quantize=None,
     max_seq_len: int = 1024,
     grow_chunk_pages: int = 4,
+    host_offload_blocks: int = 0,
+    swap_preemption: bool = True,
 ):
     """decode_block is the throughput/latency dial: 64 steps per host round
     trip is +20% decode tok/s on the tunneled bench chip (measured 1491 vs
@@ -56,6 +58,8 @@ def build_engine(
         decode_block_size=decode_block,
         quantize=quantize,
         grow_chunk_pages=grow_chunk_pages,
+        host_offload_blocks=host_offload_blocks,
+        swap_preemption=swap_preemption,
         seed=0,
     )
     return JaxEngine.random_init(model_cfg, cfg)
@@ -302,6 +306,87 @@ async def run_decode_sweep(rs) -> dict:
     return out
 
 
+async def run_mem_pressure(rs) -> dict:
+    """Memory-pressure scenario: an undersized page pool forces constant
+    capacity preemption, measured twice -- once with swap-based preemption
+    (KV offloaded and restored through the chunked scatter path) and once
+    with classic recompute (full re-prefill of the folded prompt).
+
+    The headline pair is the *resume rate*: KV tokens recovered per second
+    the preempted lane spent not-runnable.  Swap pays a D2H+H2D move
+    (``kv_onboard_gbps``); recompute pays a full prefill of the same
+    tokens -- the gap is the scenario's whole point.  ``*_run_tok_s`` are
+    the end-to-end throughputs of the identical workload under each mode,
+    and a final warm re-run reports the tiered prefix-hit counters (the
+    churn's evictions land in G2 and serve the repeat prompts)."""
+    out = {}
+    bs, isl, osl = 8, 128, 256
+    run_tok_s = {}
+    for mode in ("swap", "recompute"):
+        # each lane wants (128+256)/16 = 24 pages; 8 lanes want 192 against
+        # 144 usable -> every request gets preempted at least once
+        engine = build_engine(
+            max_batch_size=bs,
+            num_pages=145,
+            decode_block=16,
+            max_seq_len=512,
+            host_offload_blocks=(256 if mode == "swap" else 0),
+            swap_preemption=(mode == "swap"),
+        )
+        try:
+            mk = lambda: [
+                rs.randint(1, 30000, (isl,)).tolist() for _ in range(bs)
+            ]
+            # warm pass at full osl so the preemption/resume paths compile
+            # outside the measured window
+            await run_batch(engine, mk(), max_tokens=osl)
+            measured = mk()
+            t0 = time.monotonic()
+            total = await run_batch(engine, measured, max_tokens=osl)
+            elapsed = time.monotonic() - t0
+            run_tok_s[mode] = total / elapsed
+            sched = engine.sched
+            tok_bytes = engine.kv.bytes_per_page / engine.kv.page_size
+            if mode == "swap":
+                assert sched.preempt_swap > 0, "swap preemption not exercised"
+                stats = engine.offload_engine.stats()
+                swap_det = stats["onboard_detail"].get("swap") or {}
+                sec = swap_det.get("seconds") or 0.0
+                toks = (swap_det.get("bytes") or 0) / tok_bytes
+                out["preempt_resume_tok_s"] = (
+                    round(toks / sec, 1) if sec > 0 else None
+                )
+                out["kv_onboard_gbps"] = stats.get("onboard_gbps")
+                out["preempt_swap_count"] = sched.preempt_swap
+                # warm re-run: the churn's evictions are parked in G2, so
+                # the measured prompts' prefixes now onboard from the host
+                # tier instead of re-prefilling
+                engine.offload_engine.drain()
+                await run_batch(engine, measured[:2], max_tokens=8)
+                out["kv_tier_prefix_hits"] = sum(
+                    engine.offload_engine.tier_hits.values()
+                )
+            else:
+                assert sched.preempt_recompute > 0, (
+                    "recompute preemption not exercised"
+                )
+                sec = engine.resume_prefill_seconds
+                out["preempt_resume_tok_s_recompute"] = (
+                    round(engine.resume_prefill_tokens / sec, 1)
+                    if sec > 0
+                    else None
+                )
+        finally:
+            await engine.stop()
+    out["preempt_run_tok_s_swap"] = round(run_tok_s["swap"], 2)
+    out["preempt_run_tok_s_recompute"] = round(run_tok_s["recompute"], 2)
+    a, b = out.get("preempt_resume_tok_s"), out.get(
+        "preempt_resume_tok_s_recompute"
+    )
+    out["preempt_swap_speedup"] = round(a / b, 2) if a and b else None
+    return out
+
+
 async def best_of(n: int, run):
     """Best of ``n`` timed passes of ``run()`` (fresh-args coroutine
     factory): the tunneled chip's round-trip latency drifts with ambient
@@ -411,6 +496,7 @@ async def main():
     del engine
 
     sweep = await run_decode_sweep(rs)
+    mem_pressure = await run_mem_pressure(rs)
     disagg_tok_s, _dev_stats = await run_disagg(rs, allow_local=True)
     disagg_wire_tok_s, wire_stats = await run_disagg(rs, allow_local=False)
 
@@ -444,6 +530,7 @@ async def main():
                 "est_hbm_util_v5e": round(util, 4),
                 "param_bytes": pbytes,
                 **sweep,
+                **mem_pressure,
                 **serving,
             }
         )

@@ -61,6 +61,18 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
         "gather_layer_pages",
         "scatter_layer_pages",
     ],
+    # offload-plane hot paths: the admission-time tier lookup runs on the
+    # event loop and the host-ring put sits behind every eviction -- a
+    # host sync or recompile hazard in these stalls admission or the
+    # offload thread's drain rate (DT009 separately forbids sync
+    # device<->host transfers module-wide outside COPY_HELPERS)
+    "dynamo_tpu/offload.py": [
+        "HostTier.put",
+        "HostTier.get_ram",
+        "KVOffloadEngine.lookup",
+        "KVOffloadEngine.submit_evict",
+        "KVOffloadEngine.swap_out",
+    ],
 }
 
 

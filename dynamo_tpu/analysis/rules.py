@@ -841,6 +841,95 @@ class FireAndForgetTask(Rule):
 
 
 # ---------------------------------------------------------------------------
+# DT009: synchronous device<->host transfers in offload-engine modules
+# ---------------------------------------------------------------------------
+
+
+class OffloadSyncTransfer(Rule):
+    id = "DT009"
+    name = "offload-sync-transfer"
+    severity = "error"
+    description = (
+        "Synchronous device<->host transfers (jax.device_get / "
+        "jax.device_put / np.asarray-family on array args / "
+        ".block_until_ready()) inside an offload-engine module "
+        "(*/offload.py) are forbidden outside the designated copy helpers "
+        "named in the module's COPY_HELPERS tuple: tier puts/gets run on "
+        "threads the admission path may wait on, so one accidental "
+        "blocking transfer turns the offload plane back into a tick-loop "
+        "stall.  Materialize through the designated helper (which runs "
+        "only on the offload thread) instead."
+    )
+
+    OFFLOAD_SUFFIX = "/offload.py"
+    _SYNC_FNS = {"jax.device_get", "jax.device_put"}
+    _CTORS = {
+        "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+        "jnp.asarray", "jnp.array", "jax.numpy.asarray", "jax.numpy.array",
+    }
+
+    @staticmethod
+    def _copy_helpers(module: ModuleInfo) -> Set[str]:
+        """Function names listed in the module-level ``COPY_HELPERS``
+        assignment (tuple/list/set of string literals)."""
+        out: Set[str] = set()
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "COPY_HELPERS":
+                    if isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+                        out.update(
+                            e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        )
+        return out
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not (
+            module.relpath.endswith(self.OFFLOAD_SUFFIX)
+            or module.relpath == "offload.py"
+        ):
+            return
+        helpers = self._copy_helpers(module)
+        for fi in collect_functions(module.tree):
+            if fi.name in helpers:
+                continue
+            for node in own_body_walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                if d in self._SYNC_FNS:
+                    yield self.finding(
+                        module, node,
+                        f"{d}(...) outside the designated COPY_HELPERS "
+                        "blocks an offload path on a device transfer",
+                        fi.qualname,
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                ):
+                    yield self.finding(
+                        module, node,
+                        ".block_until_ready() outside the designated "
+                        "COPY_HELPERS blocks an offload path on the device",
+                        fi.qualname,
+                    )
+                elif d in self._CTORS and node.args:
+                    arg = node.args[0]
+                    if not isinstance(arg, _LIST_LITERALS):
+                        yield self.finding(
+                            module, node,
+                            f"{d}(...) on a non-literal outside the "
+                            "designated COPY_HELPERS may materialize a "
+                            "device array synchronously",
+                            fi.qualname,
+                        )
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -853,6 +942,7 @@ ALL_RULES: List[Rule] = [
     CodecFrameKindExhaustive(),
     MetricsRegistryHygiene(),
     FireAndForgetTask(),
+    OffloadSyncTransfer(),
 ]
 
 

@@ -288,9 +288,14 @@ async def _sse_request(
             if usage and usage.get("completion_tokens") is not None:
                 usage_tokens = int(usage["completion_tokens"])
             for c in chunk.get("choices") or []:
+                # TTFT stamps on the first *token arrival* (any choices
+                # chunk), not the first non-empty text: incremental detok
+                # can render early tokens as "" (byte-partial BPE pieces),
+                # which used to leave most requests with no TTFT sample at
+                # all and collapse ttft_p99 onto a one-request p50
+                if ttft is None:
+                    ttft = time.monotonic() - t0
                 if c.get("text"):
-                    if ttft is None:
-                        ttft = time.monotonic() - t0
                     n_chunks += 1
         n_tokens = usage_tokens if usage_tokens is not None else n_chunks
         if error:

@@ -22,8 +22,12 @@ Eviction publishes a ``removed`` KV event through ``event_sink`` so the
 router's index never over-states residency; registration publishes
 ``stored``.  (The engine wires ``event_sink`` to its KvEventPublisher.)
 
-G2 (host RAM) / G3 (disk) offload tiers compose on top of this module: an
-evicted block's pages can be copied out before the free-list reclaim.
+G2 (host RAM) / G3 (disk) offload tiers compose on top of this module: the
+``on_evict`` hook fires with the block *before* its pages return to the
+free list (still under the pool lock, so no other thread can reuse the
+pages until the hook's device read is dispatched); the engine wires it to
+``offload.KVOffloadEngine`` so the snapshot's blocking materialize happens
+on the dedicated offload thread, never here.
 """
 
 from __future__ import annotations
@@ -79,6 +83,9 @@ class PagePool:
         )
         self.prefix_hits = 0
         self.prefix_lookups = 0
+        # reuse-priority evictions performed (each one is an offload
+        # opportunity: the tier-occupancy story starts here)
+        self.evictions = 0
         # alloc/free/registry mutations are locked: the scheduler runs on
         # the tick-loop thread while JaxEngine._prefill_export (disagg
         # prefill-worker path) allocates scratch pages on the engine
@@ -127,6 +134,7 @@ class PagePool:
     def _evict_one(self) -> None:
         seq_hash, _ = self._inactive.popitem(last=False)
         blk = self._registered.pop(seq_hash)
+        self.evictions += 1
         if self.on_evict is not None:
             try:
                 self.on_evict(blk)

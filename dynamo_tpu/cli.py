@@ -55,11 +55,17 @@ def _add_engine_flags(p) -> None:
                    help="chunked prefill: split long prompts into chunks "
                         "of this many tokens, interleaved with decode")
     p.add_argument("--host-offload-blocks", type=int, default=0,
-                   help="G2 host-RAM KV offload capacity (blocks); 0 = off")
+                   help="G2 host-RAM KV offload capacity (blocks); 0 = off "
+                        "(env DYN_KV_OFFLOAD arms/overrides the whole plane)")
     p.add_argument("--disk-offload-blocks", type=int, default=0,
                    help="G3 disk KV offload capacity (blocks); 0 = off")
     p.add_argument("--disk-offload-dir",
                    help="directory for G3 disk offload files")
+    p.add_argument("--no-swap-preemption", dest="swap_preemption",
+                   action="store_false", default=True,
+                   help="disable swap-based preemption (offload the "
+                        "victim's KV and restore it on resume); preempted "
+                        "sequences always recompute instead")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree (shards over local devices)")
     p.add_argument("--dp", type=int, default=1,
@@ -355,6 +361,7 @@ async def _make_engine(args):
         host_offload_blocks=args.host_offload_blocks,
         disk_offload_blocks=args.disk_offload_blocks,
         disk_offload_dir=args.disk_offload_dir,
+        swap_preemption=args.swap_preemption,
         quantize=args.quantize,
     )
     logger.info("loading %s ...", args.model_path)

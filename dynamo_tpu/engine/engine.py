@@ -53,6 +53,8 @@ from .step import (
     prefill_buckets,
     prefill_suffix_and_sample,
     scatter_block_pages,
+    scatter_layer_pages,
+    slice_block_pages,
 )
 
 logger = logging.getLogger("dynamo.engine")
@@ -125,10 +127,18 @@ class EngineConfig:
     # KV offload tiers (SURVEY.md 5.4 / reference offload.rs): evicted G1
     # blocks demote to host RAM (G2, this many blocks) and overflow to disk
     # (G3); admission onboards offloaded prefixes back into fresh pages.
-    # 0 disables.
+    # 0 disables.  The DYN_KV_OFFLOAD env knob (offload.env_offload_spec
+    # grammar) arms/overrides these at engine construction, so a deployment
+    # can turn the whole plane on without touching config; with both unset
+    # the plane is a no-op and no offload thread is ever started.
     host_offload_blocks: int = 0
     disk_offload_blocks: int = 0
     disk_offload_dir: Optional[str] = None
+    # swap-based preemption (FlowKV, arXiv:2504.03775): a capacity-preempted
+    # lane's KV is offloaded and restored through the chunked scatter path
+    # instead of re-prefilled.  Effective only when the offload plane is
+    # armed; recompute remains the fallback when swap budget runs out.
+    swap_preemption: bool = True
     # extra pages allocated per growth event so the page table (and its
     # device copy) changes every few blocks instead of every block
     grow_chunk_pages: int = 4
@@ -396,27 +406,49 @@ class JaxEngine:
             metrics_registry, max_slots=self.cfg.max_batch_size
         )
         self.sched.metrics = self.obs
-        # G2/G3 offload tiers: evictions snapshot (async) to host RAM with
-        # disk overflow; admission onboards offloaded prefixes
+        # G2/G3 offload plane (offload.KVOffloadEngine): evictions snapshot
+        # (async) onto the dedicated offload thread with disk overflow;
+        # admission onboards offloaded prefixes through the chunked scatter
+        # path; preemption swaps instead of recomputing.  Armed by config
+        # or by DYN_KV_OFFLOAD (env wins); a no-op -- no thread -- otherwise.
         self.offload: Optional[Any] = None
-        self._offload_pending: List[Tuple[int, Any, Any]] = []
-        if pool is not None and (
-            self.cfg.host_offload_blocks > 0 or self.cfg.disk_offload_blocks > 0
-        ):
-            from ..offload import DiskTier, HostTier
+        self.offload_engine: Optional[Any] = None
+        self._swapped: Dict[str, SeqState] = {}
+        from ..offload import env_offload_spec
 
-            disk = None
-            if self.cfg.disk_offload_blocks > 0:
-                if not self.cfg.disk_offload_dir:
-                    raise ValueError(
-                        "disk_offload_blocks > 0 requires disk_offload_dir"
-                    )
-                disk = DiskTier(
-                    self.cfg.disk_offload_dir, self.cfg.disk_offload_blocks
+        host_blocks = self.cfg.host_offload_blocks
+        disk_blocks = self.cfg.disk_offload_blocks
+        disk_dir = self.cfg.disk_offload_dir
+        swap_on = self.cfg.swap_preemption
+        env_spec = env_offload_spec()
+        if env_spec is not None:
+            # env wins outright: the spec defines the whole plane, so an
+            # explicit host=0 / disk=0 disarms a config-armed tier (only
+            # the disk dir falls back to config -- it is a path, not a
+            # capacity)
+            host_blocks = env_spec["host"]
+            disk_blocks = env_spec["disk"]
+            disk_dir = env_spec["dir"] or disk_dir
+            swap_on = env_spec["swap"] and self.cfg.swap_preemption
+        if pool is not None and (host_blocks > 0 or disk_blocks > 0):
+            from ..offload import KVOffloadEngine
+
+            if disk_blocks > 0 and not disk_dir:
+                raise ValueError(
+                    "disk_offload_blocks > 0 requires disk_offload_dir"
                 )
-            self.offload = HostTier(self.cfg.host_offload_blocks, parent=disk)
+            self.offload_engine = KVOffloadEngine(
+                host_blocks,
+                disk_blocks,
+                disk_dir,
+                swap_enabled=swap_on,
+                registry=metrics_registry,
+            )
+            self.offload = self.offload_engine.host
             pool.on_evict = self._on_pool_evict
-            self.sched.offload_lookup = self.offload.get
+            self.sched.offload_lookup = self._offload_lookup
+            if swap_on:
+                self.sched.swap_out = self._swap_out
         # chunked prefill restarts at page-aligned offsets: normalize the
         # configured chunk up to a whole page so an intermediate chunk can
         # never overrun the remaining prompt (trigger and dispatch both use
@@ -465,6 +497,11 @@ class JaxEngine:
         self._prefix_lookups = 0
         self._steps = 0
         self._tokens_generated = 0
+        # recompute-resume accounting (bench preempt_resume_tok_s): KV
+        # tokens re-prefilled after a recompute preemption and the
+        # dispatch->commit seconds the lane spent not runnable for them
+        self.resume_prefill_tokens = 0
+        self.resume_prefill_seconds = 0.0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -527,7 +564,20 @@ class JaxEngine:
         self._running = True
         self._loop = asyncio.get_running_loop()
         self._wake = asyncio.Event()
+        if self.offload_engine is not None:
+            # a ready swap blob must wake a sleeping tick loop (all lanes
+            # parked = nothing runnable = the loop is waiting on _wake)
+            self.offload_engine.wake_cb = self._wake_from_thread
         self._task = asyncio.create_task(self._run(), name="jax-engine-loop")
+
+    def _wake_from_thread(self) -> None:
+        loop, wake = self._loop, self._wake
+        if loop is None or wake is None:
+            return
+        try:
+            loop.call_soon_threadsafe(wake.set)
+        except RuntimeError:
+            pass  # loop already closed during shutdown
 
     async def stop(self) -> None:
         self._running = False
@@ -543,6 +593,8 @@ class JaxEngine:
                 logger.debug("engine loop raised during stop", exc_info=True)
             self._task = None
         self._ex.shutdown(wait=False)
+        if self.offload_engine is not None:
+            self.offload_engine.close()
 
     # -- AsyncEngine --------------------------------------------------------
 
@@ -590,6 +642,18 @@ class JaxEngine:
                 yield Annotated.from_error(message)
 
             return ResponseStream(ctx, err_stream())
+        if self.offload_engine is not None and seq.blocks is not None:
+            # queue-side prefetch: promote the prompt's offloaded prefix
+            # chain (G3 disk reads included) into host RAM while the
+            # request waits for a slot, so the admission-time tier lookup
+            # is a RAM hit and the onboard scatter dispatches with the
+            # admitting tick
+            max_blocks = max(0, (len(seq.prompt) - 1) // self.sched.block_size)
+            hashes = seq.blocks.sequence_hashes()[:max_blocks]
+            pool = self.sched.pool
+            self.offload_engine.prefetch(
+                [h for h in hashes if pool is None or not pool.is_registered(h)]
+            )
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request.id] = queue
         assert self._wake is not None
@@ -960,16 +1024,12 @@ class JaxEngine:
         """Executor thread: scatter staged layer-group chunks into the
         lane's pages (the incremental half of a chunked delivery; the
         first-token commit waits for the barrier)."""
-        from .step import scatter_layer_pages
+        from .kv_cache import pad_page_axis
 
-        n_pages, bucket, ids = self._lane_scatter_ids(seq)
+        _n_pages, bucket, ids = self._lane_scatter_ids(seq)
         ids_dev = jnp.asarray(ids)
         for lo, hi, arr in parts:
-            padded = np.asarray(arr)
-            if bucket > n_pages:
-                pad = [(0, 0)] * padded.ndim
-                pad[2] = (0, bucket - n_pages)
-                padded = np.pad(padded, pad)
+            padded = pad_page_axis(np.asarray(arr), bucket)
             self.kv.pages = scatter_layer_pages(
                 self.kv.pages,
                 jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
@@ -992,18 +1052,10 @@ class JaxEngine:
         # delivery.  Destination ids are page-bucketed by the shared
         # helper (blob shape was validated against the prompt's page count
         # in _process_deliveries).
-        n_pages, bucket, ids = self._lane_scatter_ids(seq)
-        padded = blob
-        if bucket > n_pages:
-            pad = [(0, 0)] * blob.ndim
-            pad[2] = (0, bucket - n_pages)
-            # a device-resident blob (same-process delivery) pads on device;
-            # np.pad would silently pull it to host and re-upload
-            padded = (
-                jnp.pad(blob, pad)
-                if isinstance(blob, jax.Array)
-                else np.pad(blob, pad)
-            )
+        from .kv_cache import pad_page_axis
+
+        _n_pages, bucket, ids = self._lane_scatter_ids(seq)
+        padded = pad_page_axis(blob, bucket)
         self.kv.pages = scatter_block_pages(
             self.kv.pages, jnp.asarray(ids), jnp.asarray(padded)
         )
@@ -1382,10 +1434,12 @@ class JaxEngine:
             finally:
                 for blk in acquired:
                     pool.release(blk.sequence_hash)
-        # continue the chain into the offload tiers
-        if self.offload is not None:
+        # continue the chain into the offload tiers; the (possibly disk)
+        # reads route through the offload thread -- this runs on the engine
+        # executor, which may wait, but never does file I/O itself
+        if self.offload_engine is not None:
             for h in seq_hashes[len(out) :]:
-                hit = self.offload.get(h)
+                hit = self.offload_engine.get_blocking(h)
                 if hit is None:
                     break
                 blob, meta = hit
@@ -1399,6 +1453,7 @@ class JaxEngine:
         hit_rate = (
             self._prefix_hits / self._prefix_lookups if self._prefix_lookups else 0.0
         )
+        oe = self.offload_engine
         return ForwardPassMetrics(
             kv_active_blocks=alloc.used_pages,
             kv_total_blocks=alloc.num_pages - 1,
@@ -1407,6 +1462,13 @@ class JaxEngine:
             gpu_prefix_cache_hit_rate=hit_rate,
             request_active_slots=self.sched.num_active,
             request_total_slots=self.cfg.max_batch_size,
+            # offload-plane warmth for KV-router placement: a worker whose
+            # host tier holds blocks (and keeps hitting) beats a cold one
+            host_tier_blocks=len(oe.host) if oe is not None else 0,
+            disk_tier_blocks=(
+                len(oe.disk) if oe is not None and oe.disk is not None else 0
+            ),
+            tier_hit_rate=oe.tier_hit_rate if oe is not None else 0.0,
         )
 
     @property
@@ -1458,15 +1520,20 @@ class JaxEngine:
                             first, lp_row,
                         )
                         self._dispatch([ev])
+                for seq, rec in self._process_swaps():
+                    # swap-in restore: scatter the parked KV back into the
+                    # lane's pages (chunked, executor thread) and clear the
+                    # barrier -- no token is emitted, the lane just resumes
+                    await loop.run_in_executor(
+                        self._ex, self._apply_swap_in, seq, rec
+                    )
                 if (
                     not self.sched.has_runnable_work
                     and not pending
                     and not self._chunking
                 ):
-                    if self._offload_pending:
-                        await loop.run_in_executor(self._ex, self._drain_offload)
                     self._wake.clear()
-                    if self._external:
+                    if self._external or self._swapped:
                         # bounded wait so parked-lane timeouts still fire
                         try:
                             await asyncio.wait_for(self._wake.wait(), 1.0)
@@ -1486,6 +1553,16 @@ class JaxEngine:
                     )
                     if preempted:
                         self.obs.preemptions.inc(len(preempted))
+                        if self.offload_engine is not None:
+                            for s in preempted:
+                                kind = (
+                                    "swap"
+                                    if s.request_id in self._swapped
+                                    else "recompute"
+                                )
+                                self.offload_engine.metrics.preemptions.labels(
+                                    kind
+                                ).inc()
                 self._revive_paused_lanes()
                 fresh: List[Any] = []
                 # advance chunked prefills: one chunk per seq per tick, so
@@ -1620,6 +1697,8 @@ class JaxEngine:
         self._deliveries.pop(seq.request_id, None)
         self._chunked.pop(seq.request_id, None)
         self._external_deadline.pop(seq.request_id, None)
+        if self._swapped.pop(seq.request_id, None) is not None:
+            self.offload_engine.drop_swap(seq.request_id)
         queue = self._queues.get(seq.request_id)
         if queue is not None:
             queue.put_nowait(Annotated.from_error(message))
@@ -1647,6 +1726,8 @@ class JaxEngine:
             self._deliveries.pop(rid, None)
             self._chunked.pop(rid, None)
             self._external_deadline.pop(rid, None)
+            if self._swapped.pop(rid, None) is not None:
+                self.offload_engine.drop_swap(rid)
             seq = by_id.get(rid)
             if seq is not None:
                 # with the PagePool, cancel releases refs -- registered blocks
@@ -2575,15 +2656,15 @@ class JaxEngine:
         _start_host_copy(sampled)
         return InflightBlock(sampled=sampled, slots=list(self.sched.slots))
 
-    # -- KV offload (G1 -> G2 -> G3; SURVEY.md 5.4) ------------------------
+    # -- KV offload (G1 -> G2 -> G3 + swap; SURVEY.md 5.4) -----------------
 
     def _on_pool_evict(self, blk) -> None:
         """PagePool eviction hook: dispatch an async device slice of the
         block's pages before the free list reclaims them.  Device program
-        order places the read before any reuse; the host copy materializes
-        with the next commit sync (``_drain_offload``) -- no extra round
-        trip on the hot loop."""
-        if self.offload is None:
+        order places the read before any reuse; the blocking materialize
+        and the tier store run on the offload engine's dedicated thread --
+        neither the tick loop nor the engine executor ever waits on them."""
+        if self.offload_engine is None:
             return
         from ..offload import BlockMeta
         from .step import slice_block_pages
@@ -2598,35 +2679,67 @@ class JaxEngine:
                 parent_sequence_hash=blk.parent_sequence_hash,
                 position=blk.position,
             )
-            self._offload_pending.append((blk.sequence_hash, snap, meta))
+            self.offload_engine.submit_evict(blk.sequence_hash, snap, meta)
         except Exception:
             # best-effort: a lost offload is a cache miss later, not an error
             logger.debug("offload snapshot failed", exc_info=True)
 
-    def _drain_offload(self) -> None:
-        """Materialize pending eviction snapshots into the host tier
-        (executor thread; runs alongside the commit device_get)."""
-        if not self._offload_pending:
-            return
-        pending, self._offload_pending = self._offload_pending, []
-        for seq_hash, snap, meta in pending:
-            try:
-                self.offload.put(seq_hash, np.asarray(snap), meta)
-            except Exception:
-                logger.debug("offload store failed", exc_info=True)
+    def _offload_lookup(self, seq_hash: int):
+        """Scheduler-facing tier lookup (``_match_prefix`` G1 -> G2 -> G3
+        fall-through): RAM hits return immediately; disk-only hits kick an
+        async promote and miss this admission (the queue-side prefetch in
+        :meth:`generate` makes that case rare)."""
+        hit = self.offload_engine.lookup(seq_hash)
+        if hit is None:
+            return None
+        blob, meta, _tier = hit
+        return blob, meta
 
     def _apply_onboards(self, seq: SeqState) -> None:
         """Scatter offload-tier hits into their pages and register them
-        (executor thread, before the prefill dispatch that reads them)."""
-        from .step import scatter_block_pages
+        (executor thread, before the prefill dispatch that reads them).
+
+        All of the admission's onboarded blocks ride ONE page-bucketed,
+        layer-group-chunked scatter sequence -- the same
+        ``scatter_layer_pages`` path the chunked external KV delivery uses
+        -- so per-block dispatch overhead is paid once per admission and
+        compile-cache entries stay O(page buckets x layer groups)."""
+        from ..runtime import faults
+        from .kv_cache import layer_chunk_spans, pad_page_axis
 
         sched = self.sched
-        for seq_hash, pages, blob, meta in seq.pending_onboard:
-            self.kv.pages = scatter_block_pages(
+        if not seq.pending_onboard:
+            return
+        if faults.injector.enabled and faults.injector.should_fire(
+            "onboard.truncate", seq.request_id
+        ):
+            self._abandon_onboards(seq)
+            return
+        pending, seq.pending_onboard = seq.pending_onboard, []
+        ids = np.concatenate(
+            [np.asarray(pages, np.int32) for _h, pages, _b, _m in pending]
+        )
+        blob = np.concatenate(
+            [np.asarray(b) for _h, _p, b, _m in pending], axis=2
+        )
+        bucket = pick_page_bucket(len(ids), self.sched.max_pages)
+        ids_p = np.zeros((bucket,), np.int32)  # pad -> trash page 0
+        ids_p[: len(ids)] = ids
+        ids_dev = jnp.asarray(ids_p)
+        padded = pad_page_axis(blob, bucket)
+        L = int(blob.shape[0])
+        t0 = time.perf_counter()
+        for lo, hi in layer_chunk_spans(L, None, DEFAULT_EXPORT_CHUNKS):
+            self.kv.pages = scatter_layer_pages(
                 self.kv.pages,
-                jnp.asarray(pages, jnp.int32),
-                jnp.asarray(blob),
+                jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
+                ids_dev,
+                jnp.asarray(padded[lo:hi]),
             )
+        self.offload_engine.record_onboard(
+            "prefix", blob.nbytes, time.perf_counter() - t0
+        )
+        for seq_hash, pages, _blob, meta in pending:
             if sched.pool.register(
                 seq_hash,
                 pages,
@@ -2638,7 +2751,193 @@ class JaxEngine:
                 for p in pages:
                     seq.owned_pages.remove(p)
             # register False: twin onboarded it concurrently; keep ownership
+
+    def _abandon_onboards(self, seq: SeqState) -> None:
+        """Onboard aborted (chaos/IO): fall back to recomputing the
+        would-have-been-onboarded prefix.  The blocks' pages are already
+        allocated at the right page-table positions, so they simply stay
+        plain-owned and the (now longer) suffix prefill writes the prompt
+        KV into them -- no pages move, no pages leak, nothing registers."""
+        sched = self.sched
         seq.pending_onboard = []
+        seq.cached_prompt_tokens = len(seq.held_blocks) * sched.block_size
+        # re-derive which prompt blocks register after prefill: the
+        # abandoned span is prefilled now, so it registers with the rest
+        sched._queue_prompt_registrations(seq)
+        if self.offload_engine is not None:
+            self.offload_engine.onboard_fallbacks += 1
+            self.offload_engine.metrics.onboard_fallbacks.labels(
+                "truncate"
+            ).inc()
+
+    # -- swap-based preemption (offload the victim, restore on resume) ------
+
+    def _swap_out(self, seq: SeqState) -> bool:
+        """Scheduler ``swap_out`` hook (tick-loop thread, victim still
+        slotted): snapshot the lane's committed KV and park the sequence.
+        Declines -- recompute fallback -- whenever the lane's device state
+        is not fully host-visible (mid-prefill, parked, uncommitted first
+        token) or the swap budget is exhausted."""
+        if self.offload_engine is None:
+            return False
+        if seq.awaiting_kv or seq.prefilling or seq.finish is not None:
+            return False
+        if seq.num_generated < 1 or seq.slot < 0:
+            # nothing committed yet: the mirrors may hold a placeholder
+            # token (pending inject) or no KV at all -- only a re-prefill
+            # reproduces the stream
+            return False
+        if seq.blocks is None:
+            # multimodal lanes opt out of block tracking, so the preemption
+            # fold cannot reconstruct their token history; they keep the
+            # classic recompute path
+            return False
+        if seq.slot in self._pending_injects:
+            return False  # a device-only sampled token would be lost
+        cache_len = int(self.sched.seq_lens[seq.slot])
+        ps = self.cfg.page_size
+        n_pages = -(-cache_len // ps)
+        if cache_len <= 0 or n_pages > len(seq.pages):
+            return False
+        n_blocks = -(-n_pages // self.sched.pages_per_block)
+        try:
+            ids = jnp.asarray(np.asarray(seq.pages[:n_pages], np.int32))
+            snap = slice_block_pages(self.kv.pages, ids)
+            _start_host_copy(snap)
+        except Exception:
+            logger.debug("swap snapshot dispatch failed", exc_info=True)
+            return False
+        if not self.offload_engine.swap_out(
+            seq.request_id, snap, cache_len, n_blocks
+        ):
+            return False
+        self._swapped[seq.request_id] = seq
+        return True
+
+    def _process_swaps(self) -> List[Tuple[SeqState, Any]]:
+        """Tick-loop side of swap-in: hand back (seq, record) pairs whose
+        restore is due (lane admitted + blob materialized).  Failed or
+        chaos-truncated records fall back to recompute -- the lane (and
+        its pages, if any) release cleanly and the request re-prefills."""
+        if not self._swapped:
+            return []
+        from ..offload import SWAP_FAILED, SWAP_READY
+        from ..runtime import faults
+
+        out: List[Tuple[SeqState, Any]] = []
+        for rid, seq in list(self._swapped.items()):
+            if seq.finish is not None or not seq.awaiting_kv:
+                # finished/cancelled, or a second preemption already
+                # reverted the lane to the recompute path: drop the record
+                self._swapped.pop(rid, None)
+                self.offload_engine.drop_swap(rid)
+                continue
+            rec = self.offload_engine.poll_swap(rid)
+            if rec is None or (rec.state == SWAP_FAILED and rec.dev is None):
+                # no restorable copy anywhere: unpark onto recompute
+                self._swap_recompute(seq, "copy_fail")
+                continue
+            if (rec.dev is None and rec.state != SWAP_READY) or seq.slot < 0:
+                continue  # blob still materializing / lane not admitted
+            if faults.injector.enabled and faults.injector.should_fire(
+                "onboard.truncate", f"swap/{rid}"
+            ):
+                self._swap_recompute(seq, "truncate")
+                continue
+            self._swapped.pop(rid, None)
+            out.append((seq, rec))
+        return out
+
+    def _swap_recompute(self, seq: SeqState, cause: str) -> None:
+        """Swap restore impossible: unpark the sequence onto the recompute
+        path.  Slot + pages (if admitted) release; the request re-prefills
+        its folded prompt exactly as classic preemption would -- identical
+        output, no leaked pages, one counted fallback."""
+        rid = seq.request_id
+        self._swapped.pop(rid, None)
+        self.offload_engine.drop_swap(rid)
+        self.offload_engine.swap_fallbacks += 1
+        self.offload_engine.metrics.swap_fallbacks.labels(cause).inc()
+        seq.awaiting_kv = False
+        if seq.slot >= 0:
+            self.sched._release_slot(seq)
+            seq.slot = -1
+            self.sched.waiting.appendleft(seq)
+        # still waiting: plan() now treats it as a plain cold admission
+
+    def _apply_swap_in(self, seq: SeqState, rec) -> None:
+        """Executor thread: restore a parked lane's KV through the chunked
+        scatter path and clear the resume barrier.
+
+        Geometry: the snapshot covers ``cache_len`` committed positions =
+        ``len(prompt) - 1`` after the preemption fold; admission already
+        wrote ``tokens[b] = prompt[-1]``, so once ``seq_lens`` rewinds to
+        ``cache_len`` the next decode block recomputes position P-1's KV
+        and samples exactly the token the re-prefill would have -- swap on
+        and off are token-identical.  The final ``block_until_ready`` is a
+        deliberate sync: the lane cannot run before its KV lands, and the
+        wait happens on the executor (never the event loop), yielding the
+        true H2D throughput for the ``kv_onboard_gbps`` accounting."""
+        from .kv_cache import layer_chunk_spans, pad_page_axis
+
+        rid = seq.request_id
+        sched = self.sched
+        try:
+            # fast path: the retained device snapshot restores with a
+            # device-to-device scatter -- no host link round trip (on a
+            # tunneled chip that link is orders of magnitude slower than
+            # HBM); the host blob serves long parks whose device copy was
+            # dropped for staging budget.  Read dev ONCE: the offload
+            # thread may null it (budget trim) between a check and a
+            # second read.
+            dev = rec.dev
+            blob = dev if dev is not None else rec.blob
+            if blob is None:
+                # dev was trimmed after _process_swaps saw it and the host
+                # blob is not ready yet: retry next tick
+                self._swapped[rid] = seq
+                return
+            cache_len = rec.cache_len
+            ps = self.cfg.page_size
+            n_pages = -(-cache_len // ps)
+            if (
+                seq.slot < 0
+                or sched.slots[seq.slot] is not seq
+                or n_pages > len(seq.pages)
+                or tuple(blob.shape[2:3]) != (n_pages,)
+            ):
+                self._swapped[rid] = seq  # re-examine next tick
+                return
+            bucket = pick_page_bucket(n_pages, sched.max_pages)
+            ids = np.zeros((bucket,), np.int32)
+            ids[:n_pages] = seq.pages[:n_pages]
+            ids_dev = jnp.asarray(ids)
+            padded = pad_page_axis(blob, bucket)
+            L = int(blob.shape[0])
+            t0 = time.perf_counter()
+            for lo, hi in layer_chunk_spans(L, None, DEFAULT_EXPORT_CHUNKS):
+                self.kv.pages = scatter_layer_pages(
+                    self.kv.pages,
+                    jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
+                    ids_dev,
+                    jnp.asarray(padded[lo:hi]),
+                )
+            self.kv.pages.block_until_ready()
+            self.offload_engine.record_onboard(
+                "swap", blob.nbytes, time.perf_counter() - t0
+            )
+        except Exception:
+            logger.exception("swap-in restore failed for %s; recomputing", rid)
+            self._swap_recompute(seq, "copy_fail")
+            return
+        self.offload_engine.drop_swap(rid)
+        # barrier cleared: rewind the cache length to the restored KV and
+        # wake the lane (admission wrote seq_lens = len(prompt); the last
+        # prompt token's KV is rewritten by the lane's next decode step)
+        sched.seq_lens[seq.slot] = cache_len
+        sched.tokens[seq.slot] = seq.prompt[-1]
+        seq.awaiting_kv = False
+        sched.dirty_slots.add(seq.slot)
 
     @hot_path
     def _commit_all(self, entries: List[Any]) -> List[StepEvent]:
@@ -2663,7 +2962,6 @@ class JaxEngine:
             # dynalint: disable=DT004 -- the pipeline's ONE designed sync point:
             # block i's results materialize here while block i+1 computes
             mats = jax.device_get(handles)
-        self._drain_offload()
         events: List[StepEvent] = []
 
         def commit_prefill(pf: InflightPrefill, row: np.ndarray) -> None:
@@ -2683,6 +2981,13 @@ class JaxEngine:
             top = (
                 [[int(i), float(l)] for i, l in zip(tids, tlps)] if N else None
             )
+            if seq.prior_generated > 0:
+                # this prefill resumed a recompute-preempted lane: the
+                # folded prompt's uncached span is pure resume work
+                self.resume_prefill_tokens += (
+                    len(seq.prompt) - seq.cached_prompt_tokens
+                )
+                self.resume_prefill_seconds += max(now - pf.dispatched_at, 0.0)
             events.append(
                 self.sched.commit_prefill_token(
                     seq, int(tok), float(lp), top

@@ -140,6 +140,26 @@ def layer_chunk_spans(
     ]
 
 
+def pad_page_axis(blob, bucket: int):
+    """Pad a KV blob ``[..., P, page, Hkv, D]`` (pages on axis 2) with
+    zeros up to ``bucket`` pages -- the shared shape-normalization for
+    every bucketed page scatter (external KV delivery, chunked delivery,
+    tier onboard, swap-in restore).  Pad entries target trash page 0 with
+    zero content, so one executable per page bucket serves every blob
+    size.  Device-resident blobs pad on device (``np.pad`` would silently
+    pull them to host and re-upload)."""
+    n = blob.shape[2]
+    if bucket <= n:
+        return blob
+    pad = [(0, 0)] * blob.ndim
+    pad[2] = (0, bucket - n)
+    if isinstance(blob, jax.Array):
+        return jnp.pad(blob, pad)
+    import numpy as np
+
+    return np.pad(blob, pad)
+
+
 def choose_num_pages(
     cfg: ModelConfig,
     page_size: int,

@@ -174,6 +174,14 @@ class Scheduler:
         # G2/G3 offload lookup: fn(seq_hash) -> (blob, meta) | None, wired
         # by the engine when offload tiers are configured
         self.offload_lookup: Optional[Any] = None
+        # swap-based preemption hook: fn(seq) -> bool, wired by the engine
+        # when the offload plane is armed.  Called with the victim still
+        # slotted (pages intact) so the engine can dispatch the device
+        # snapshot before the slot release frees them; True parks the
+        # sequence for a KV restore instead of a re-prefill.
+        self.swap_out: Optional[Any] = None
+        self.preempt_swap = 0
+        self.preempt_recompute = 0
         # observability hook (engine/metrics.EngineMetrics): the scheduler
         # stays sans-IO -- it only pokes gauges the engine wired in
         self.metrics: Optional[Any] = None
@@ -448,13 +456,38 @@ class Scheduler:
         return max(active, key=lambda s: s.arrival_s)
 
     def _preempt(self, seq: SeqState) -> None:
+        # swap-based preemption: snapshot the lane's KV (engine hook, must
+        # run while the pages are still allocated so the device read is
+        # ordered before any reuse) and park the sequence for a restore;
+        # recompute -- fold + re-prefill -- remains the fallback whenever
+        # the hook declines (tiers full, lane mid-prefill, chaos)
+        swapped = False
+        if self.swap_out is not None and seq.finish is None:
+            try:
+                swapped = bool(self.swap_out(seq))
+            except Exception:
+                import logging
+
+                logging.getLogger("dynamo.offload").exception(
+                    "swap-out hook failed for %s; recomputing", seq.request_id
+                )
         self._release_slot(seq)
-        # restart from scratch: fold generated tokens into the prompt so the
-        # re-prefill reproduces the full sequence deterministically
+        # fold generated tokens into the prompt so the resume -- whether a
+        # KV restore or a re-prefill -- reproduces the full sequence
+        # deterministically (stop/penalty accounting shares this bookkeeping)
         seq.prompt = seq.prompt + self._generated_tokens(seq)
         seq.prior_generated += seq.num_generated
         seq.num_generated = 0
         seq.slot = -1
+        if swapped:
+            # parked exactly like a disagg external lane: holds pages at
+            # admission, stays device-inactive until the engine's swap-in
+            # delivery clears the barrier (an external lane keeps its own
+            # pre-existing awaiting_kv)
+            seq.awaiting_kv = True
+            self.preempt_swap += 1
+        else:
+            self.preempt_recompute += 1
         self.waiting.appendleft(seq)
 
     def _generated_tokens(self, seq: SeqState) -> List[int]:

@@ -27,11 +27,18 @@ from .indexer import OverlapScores
 
 @dataclass
 class KvRouterConfig:
-    """Cost-function weights (reference kv_router.rs:59-100)."""
+    """Cost-function weights (reference kv_router.rs:59-100).
+
+    ``tier_hit_weight`` extends the reference function with the offload
+    plane's warmth signal: a worker whose G2/G3 tiers keep serving prefix
+    hits onboards a repeat prefix from host RAM (no re-prefill), so it
+    beats an otherwise-equal cold worker.  Deliberately smaller than the
+    G1 overlap weight -- an HBM-resident prefix still wins outright."""
 
     overlap_score_weight: float = 2.0
     gpu_cache_usage_weight: float = 1.0
     waiting_requests_weight: float = 1.0
+    tier_hit_weight: float = 0.25
 
 
 @dataclass
@@ -93,10 +100,17 @@ class DefaultWorkerSelector:
             normalized_waiting = (
                 m.num_requests_waiting / max_waiting if max_waiting > 0 else 0.0
             )
+            # offload-tier warmth: only workers actually holding parked
+            # blocks get the bonus, scaled by how often their tiers hit
+            tier_warmth = (
+                m.tier_hit_rate if getattr(m, "host_tier_blocks", 0) > 0
+                or getattr(m, "disk_tier_blocks", 0) > 0 else 0.0
+            )
             logit = (
                 cfg.overlap_score_weight * score
                 - cfg.gpu_cache_usage_weight * m.gpu_cache_usage_perc
                 - cfg.waiting_requests_weight * normalized_waiting
+                + cfg.tier_hit_weight * tier_warmth
             )
             if logit > best_logit:
                 best_logit = logit

@@ -20,8 +20,10 @@ cluster-wide cache capacity.  Mechanism here:
     registers them exactly as if they had been evicted locally.  No new
     scheduler states; the tested onboard path is the only onboard path.
 
-The import staging uses the host tier (G2), so onboarding requires the
-engine to run with ``host_offload_blocks > 0``.
+The import staging uses the host tier (G2) via the engine's
+``KVOffloadEngine`` (every put rides its dedicated offload thread), so
+onboarding requires the offload plane to be armed -- either
+``host_offload_blocks > 0`` or ``DYN_KV_OFFLOAD``.
 """
 
 from __future__ import annotations
@@ -160,7 +162,7 @@ class PrefixOnboardEngine:
         _, seq_hashes = hash_blocks(token_ids, block_size)
         seq_hashes = seq_hashes[:n]
         pool = self.engine.kv.allocator
-        offload = self.engine.offload
+        offload = self.engine.offload_engine
         # only fetch what neither HBM nor the local tiers already hold; the
         # donor chain must stay contiguous, so cut at the first local hit
         # gap is fine -- we request the full chain and the donor returns its
@@ -192,7 +194,9 @@ class PrefixOnboardEngine:
 
         def _store() -> None:
             nonlocal fetched, pending_meta, staging, asm
-            offload.put(
+            # the host-ring copy (and any disk demotion it cascades into)
+            # runs on the offload engine's thread, never this event loop
+            offload.submit_put(
                 int(pending_meta["seq_hash"]),
                 staging.array,
                 BlockMeta.from_dict(pending_meta["meta"]),
@@ -219,7 +223,7 @@ class PrefixOnboardEngine:
                 blob = np.frombuffer(
                     frame, jnp.dtype(pending_meta["dtype"])
                 ).reshape(pending_meta["shape"])
-                offload.put(
+                offload.submit_put(
                     int(pending_meta["seq_hash"]),
                     blob,
                     BlockMeta.from_dict(pending_meta["meta"]),
@@ -239,6 +243,12 @@ class PrefixOnboardEngine:
             )
         self.onboarded_blocks += fetched
         if fetched:
+            # barrier: the submitted puts must be resident before the
+            # engine's admission-time tier lookup runs (off-loop wait; the
+            # offload thread's queue is at most this request's blocks deep)
+            import asyncio
+
+            await asyncio.to_thread(offload.drain)
             logger.info(
                 "onboarded %d prefix blocks from donor %x",
                 fetched, int(donor["instance"]),

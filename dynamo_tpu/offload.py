@@ -1,18 +1,29 @@
-"""KV offload tiers: G2 (host RAM) and G3 (disk) behind the G1 page pool.
+"""Multi-tier KV offload plane: G2 (host RAM) and G3 (disk) behind the G1
+page pool, coordinated by :class:`KVOffloadEngine`.
 
 Reference parity: lib/llm/src/block_manager offload (offload.rs:76-80 --
-eviction cascades G1 -> G2 -> G3, lookups promote back up).  The TPU build
-keeps the same cascade but moves data on XLA's terms (see
+eviction cascades G1 -> G2 -> G3, lookups promote back up) plus the
+offload/onboard engines that move blocks between tiers asynchronously.
+The TPU build keeps the same cascade but moves data on XLA's terms (see
 engine/engine.py): an evicted block's pages are *sliced on device* before
 the free-list reclaims them (device program order guarantees the slice
 reads pre-reuse contents), the transfer rides ``copy_to_host_async``, and
-the host copy lands in the ``HostTier`` when the engine next synchronizes
-for a commit -- zero added round trips on the hot loop.
+the blocking materialize + every tier put/get runs on the offload
+engine's dedicated thread -- never the event loop, never the engine
+executor that drives device ticks.
 
 A block is stored as ``(blob, meta)``: blob is the raw page content
 ``[L, 2, pages_per_block, page, Hkv, D]``, meta carries the router-facing
 identity (block_hash, parent_sequence_hash, position) so an onboarded
 block re-registers and re-publishes exactly as it first did.
+
+Beyond block offload, the engine parks whole preempted sequences here:
+swap-based preemption snapshots the victim lane's KV into a request-keyed
+swap record and restores it through the chunked scatter path on resume,
+instead of burning prefill FLOPs recomputing KV that already existed
+(FlowKV, arXiv:2504.03775).  ``DYN_KV_OFFLOAD`` arms the whole plane from
+the environment; unset and unconfigured, no engine is built and no
+offload thread ever starts.
 """
 
 from __future__ import annotations
@@ -21,12 +32,30 @@ import collections
 import logging
 import os
 import threading
-from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 logger = logging.getLogger("dynamo.offload")
+
+# The designated sync-transfer helpers (dynalint DT009): every synchronous
+# device<->host materialization in this module must happen inside one of
+# these functions, so an accidental blocking transfer on a tier hot path
+# is a lint error, not a latent stall.
+COPY_HELPERS = ("to_host",)
+
+
+def to_host(arr: Any) -> np.ndarray:
+    """THE designated device->host materialize point for the offload plane.
+
+    Runs only on the offload engine's thread: by the time it is called the
+    async DMA (``copy_to_host_async``, started at dispatch) has usually
+    landed, so this is a wait, not a transfer -- and if it is a transfer,
+    it blocks a thread nobody's tick latency depends on."""
+    return np.asarray(arr)
 
 
 @dataclass
@@ -103,7 +132,14 @@ class KVStagingBuffer:
 
 
 class DiskTier:
-    """G3: one ``.npz`` file per block under ``root``, LRU-capped."""
+    """G3: one ``.npz`` file per block under ``root``, LRU-capped.
+
+    ``put``/``get`` do blocking file I/O and therefore must only be
+    called from the :class:`KVOffloadEngine`'s dedicated thread (the same
+    single-writer-thread pattern as the hub WAL) -- the event loop and
+    the engine's device executor never touch this class directly.  The
+    residency index (``__contains__``) is in-RAM and safe from any
+    thread."""
 
     def __init__(self, root: str, capacity_blocks: int) -> None:
         self.root = root
@@ -120,43 +156,62 @@ class DiskTier:
     def __len__(self) -> int:
         return len(self._lru)
 
+    def __contains__(self, seq_hash: int) -> bool:
+        with self._lock:
+            return seq_hash in self._lru
+
     def put(self, seq_hash: int, blob: np.ndarray, meta: BlockMeta) -> None:
+        """Offload-thread only.  File I/O runs OUTSIDE the lock (write to
+        a temp file, rename into place): the lock guards only the in-RAM
+        index, so ``__contains__`` probes from the admission path never
+        wait behind a multi-MB compressed write."""
         if self.capacity <= 0:
             return
+        path = self._path(seq_hash)
+        tmp = path + ".tmp.npz"  # .npz suffix so np.savez appends nothing
+        try:
+            np.savez(tmp, blob=blob, **meta.to_dict())
+            os.replace(tmp, path)
+        except OSError:
+            logger.exception("disk tier write failed for %x", seq_hash)
+            with_suppress_remove(tmp)
+            return
+        victims: List[int] = []
         with self._lock:
-            try:
-                np.savez(
-                    self._path(seq_hash), blob=blob, **meta.to_dict()
-                )
-            except OSError:
-                logger.exception("disk tier write failed for %x", seq_hash)
-                return
             self._lru[seq_hash] = None
             self._lru.move_to_end(seq_hash)
             while len(self._lru) > self.capacity:
                 victim, _ = self._lru.popitem(last=False)
-                with_suppress_remove(self._path(victim))
+                victims.append(victim)
+        for victim in victims:
+            with_suppress_remove(self._path(victim))
 
     def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta]]:
+        """Offload-thread only (single reader; puts rename atomically, so
+        a file listed in the index is always complete).  The lock again
+        covers only the index."""
         with self._lock:
             if seq_hash not in self._lru:
                 self.misses += 1
                 return None
-            try:
-                with np.load(self._path(seq_hash)) as z:
-                    blob = z["blob"]
-                    meta = BlockMeta(
-                        int(z["block_hash"]),
-                        int(z["parent_sequence_hash"]),
-                        int(z["position"]),
-                    )
-            except OSError:
+        try:
+            with np.load(self._path(seq_hash)) as z:
+                blob = z["blob"]
+                meta = BlockMeta(
+                    int(z["block_hash"]),
+                    int(z["parent_sequence_hash"]),
+                    int(z["position"]),
+                )
+        except OSError:
+            with self._lock:
                 self._lru.pop(seq_hash, None)
                 self.misses += 1
-                return None
-            self._lru.move_to_end(seq_hash)
+            return None
+        with self._lock:
+            if seq_hash in self._lru:
+                self._lru.move_to_end(seq_hash)
             self.hits += 1
-            return blob, meta
+        return blob, meta
 
 
 def with_suppress_remove(path: str) -> None:
@@ -167,45 +222,141 @@ def with_suppress_remove(path: str) -> None:
 
 
 class HostTier:
-    """G2: in-RAM LRU of block blobs; overflow demotes to the G3 parent."""
+    """G2: preallocated host-RAM ring of block blobs; overflow demotes to
+    the G3 parent.
+
+    The ring is ONE contiguous ndarray of ``capacity_blocks`` slots,
+    allocated lazily from the first block's geometry (the pinned-buffer
+    analog on a platform without a user pin API: a single stable
+    allocation the allocator never fragments or re-touches).  ``put``
+    copies into a free slot with ``np.copyto`` -- zero allocations on the
+    eviction path -- and ``get`` copies out, so a returned blob stays
+    valid after its slot is recycled.  Blocks whose geometry does not
+    match the ring (foreign-engine donors) fall back to a per-entry side
+    table, counted against the same LRU capacity."""
 
     def __init__(
         self, capacity_blocks: int, parent: Optional[DiskTier] = None
     ) -> None:
         self.capacity = capacity_blocks
         self.parent = parent
-        self._store: "collections.OrderedDict[int, Tuple[np.ndarray, BlockMeta]]" = (
+        # LRU order over every resident hash; value = ring slot or None
+        # (None = side-table entry)
+        self._slots: "collections.OrderedDict[int, Optional[int]]" = (
             collections.OrderedDict()
         )
+        self._misc: Dict[int, Tuple[np.ndarray, BlockMeta]] = {}
+        self._meta: Dict[int, BlockMeta] = {}
+        self._ring: Optional[np.ndarray] = None
+        self._ring_failed = False
+        self._free_slots: List[int] = []
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
 
     def __len__(self) -> int:
-        return len(self._store)
+        return len(self._slots)
+
+    @property
+    def ring_nbytes(self) -> int:
+        return self._ring.nbytes if self._ring is not None else 0
+
+    def _ensure_ring(self, blob: np.ndarray) -> None:
+        if self._ring is not None or self._ring_failed or self.capacity <= 0:
+            return
+        try:
+            self._ring = np.empty(
+                (self.capacity,) + tuple(blob.shape), blob.dtype
+            )
+        except MemoryError:
+            # remember the failure: retrying a multi-GB allocation on
+            # every eviction would hammer the allocator on the one thread
+            # all offload work queues behind
+            logger.exception(
+                "host tier ring allocation failed (%d blocks); falling "
+                "back to per-entry storage", self.capacity,
+            )
+            self._ring = None
+            self._ring_failed = True
+            return
+        self._free_slots = list(range(self.capacity - 1, -1, -1))
 
     def put(self, seq_hash: int, blob: np.ndarray, meta: BlockMeta) -> None:
         if self.capacity <= 0:
             if self.parent is not None:
                 self.parent.put(seq_hash, blob, meta)
             return
+        demote: List[Tuple[int, np.ndarray, BlockMeta]] = []
         with self._lock:
-            self._store[seq_hash] = (blob, meta)
-            self._store.move_to_end(seq_hash)
-            demote = []
-            while len(self._store) > self.capacity:
-                demote.append(self._store.popitem(last=False))
-        for victim, (vb, vm) in demote:
+            self._evict_locked(seq_hash)  # overwrite: recycle the old slot
+            self._ensure_ring(blob)
+            slot: Optional[int] = None
+            if (
+                self._ring is not None
+                and tuple(blob.shape) == self._ring.shape[1:]
+                and blob.dtype == self._ring.dtype
+            ):
+                if not self._free_slots:
+                    self._demote_lru_locked(demote)
+                if self._free_slots:
+                    slot = self._free_slots.pop()
+                    np.copyto(self._ring[slot], blob)
+            if slot is None:
+                # geometry mismatch (or ring unavailable): side table
+                self._misc[seq_hash] = (blob.copy(), meta)
+            self._slots[seq_hash] = slot
+            self._slots.move_to_end(seq_hash)
+            self._meta[seq_hash] = meta
+            while len(self._slots) > self.capacity:
+                self._demote_lru_locked(demote)
+        for victim, vb, vm in demote:
             if self.parent is not None:
                 self.parent.put(victim, vb, vm)
 
-    def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta]]:
+    def _demote_lru_locked(
+        self, demote: List[Tuple[int, np.ndarray, BlockMeta]]
+    ) -> None:
+        if not self._slots:
+            return
+        victim, slot = self._slots.popitem(last=False)
+        meta = self._meta.pop(victim)
+        if slot is None:
+            vb, meta = self._misc.pop(victim)
+        else:
+            vb = self._ring[slot].copy()
+            self._free_slots.append(slot)
+        demote.append((victim, vb, meta))
+
+    def _evict_locked(self, seq_hash: int) -> None:
+        slot = self._slots.pop(seq_hash, "absent")
+        if slot == "absent":
+            return
+        self._meta.pop(seq_hash, None)
+        if slot is None:
+            self._misc.pop(seq_hash, None)
+        else:
+            self._free_slots.append(slot)
+
+    def get_ram(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta]]:
+        """RAM-resident hit only: never consults the disk parent, so it is
+        safe to call from latency-sensitive threads (the admission path)."""
         with self._lock:
-            hit = self._store.get(seq_hash)
-            if hit is not None:
-                self._store.move_to_end(seq_hash)
-                self.hits += 1
-                return hit
+            if seq_hash not in self._slots:
+                return None
+            slot = self._slots[seq_hash]
+            self._slots.move_to_end(seq_hash)
+            self.hits += 1
+            if slot is None:
+                blob, meta = self._misc[seq_hash]
+                return blob.copy(), meta
+            return self._ring[slot].copy(), self._meta[seq_hash]
+
+    def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta]]:
+        """Tiered get: RAM first, then the disk parent (promoting the hit
+        back into G2).  May do file I/O -- offload-thread only."""
+        hit = self.get_ram(seq_hash)
+        if hit is not None:
+            return hit
         if self.parent is not None:
             promoted = self.parent.get(seq_hash)
             if promoted is not None:
@@ -217,20 +368,452 @@ class HostTier:
 
     def contains(self, seq_hash: int) -> bool:
         with self._lock:
-            if seq_hash in self._store:
+            if seq_hash in self._slots:
                 return True
-        return self.parent is not None and seq_hash in self.parent._lru
+        return self.parent is not None and seq_hash in self.parent
 
     def stats(self) -> Dict[str, Any]:
         out = {
             "g2_blocks": len(self),
             "g2_hits": self.hits,
             "g2_misses": self.misses,
+            "g2_ring_bytes": self.ring_nbytes,
         }
         if self.parent is not None:
             out.update(
                 g3_blocks=len(self.parent),
                 g3_hits=self.parent.hits,
                 g3_misses=self.parent.misses,
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the offload engine: dedicated thread + swap records + env arming
+# ---------------------------------------------------------------------------
+
+
+SWAP_PENDING = "pending"
+SWAP_READY = "ready"
+SWAP_FAILED = "failed"
+
+
+@dataclass
+class SwapRecord:
+    """One preempted sequence's parked KV, staged across two homes:
+
+    ``dev`` is the gathered device-side snapshot -- retained (budgeted)
+    so a short park restores with a device-to-device scatter and never
+    round-trips the host link (FlowKV's low-latency staged transfer; on a
+    tunneled chip the host link can be 100x slower than HBM).  ``blob``
+    is the host materialization the offload thread produces -- the spill
+    that survives once the device copy is dropped for budget.  A record
+    is restorable the moment either exists."""
+
+    cache_len: int
+    n_blocks: int  # block-equivalents charged against the swap budget
+    state: str = SWAP_PENDING
+    dev: Any = None  # device-resident staging copy (fast-path restore)
+    blob: Optional[np.ndarray] = None
+    nbytes: int = 0
+    started_at: float = field(default_factory=time.perf_counter)
+
+
+def env_offload_spec(environ: Optional[Dict[str, str]] = None) -> Optional[Dict[str, Any]]:
+    """Parse ``DYN_KV_OFFLOAD`` into offload-plane settings, or None when
+    unset (the plane stays a no-op: no tiers, no thread, no swap).
+
+    Grammar: ``1``/``on`` arms the host tier with defaults, or a
+    comma-separated ``k=v`` list::
+
+        DYN_KV_OFFLOAD=host=256,disk=1024,dir=/var/kv,swap=1
+
+    with ``host``/``disk`` in blocks, ``dir`` the G3 root, and ``swap``
+    enabling/disabling swap-based preemption (default on)."""
+    env = environ if environ is not None else os.environ
+    spec = env.get("DYN_KV_OFFLOAD", "").strip()
+    if not spec or spec.lower() in ("0", "off", "false", "no"):
+        return None
+    out: Dict[str, Any] = {"host": 256, "disk": 0, "dir": None, "swap": True}
+    if spec.lower() in ("1", "on", "true", "yes"):
+        return out
+    for clause in filter(None, (c.strip() for c in spec.split(","))):
+        k, sep, v = clause.partition("=")
+        k = k.strip().lower()
+        if not sep:
+            raise ValueError(f"malformed DYN_KV_OFFLOAD clause {clause!r}")
+        try:
+            if k == "host":
+                out["host"] = int(v)
+            elif k == "disk":
+                out["disk"] = int(v)
+            elif k == "dir":
+                out["dir"] = v
+            elif k == "swap":
+                out["swap"] = v.strip().lower() not in ("0", "off", "false", "no")
+            else:
+                raise ValueError(f"unknown DYN_KV_OFFLOAD key {k!r}")
+        except ValueError as e:
+            raise ValueError(f"bad DYN_KV_OFFLOAD value {clause!r}") from e
+    return out
+
+
+class KVOffloadEngine:
+    """The G2/G3 coordinator: owns the tiers, the dedicated offload
+    thread, the swap records, and the plane's metrics.
+
+    Every blocking step -- the device->host materialize of an eviction
+    snapshot, disk writes, disk reads, host-ring copies -- runs on ONE
+    private thread (``kv-offload``), the same isolation pattern as the
+    hub WAL's writer thread: the asyncio event loop and the engine's
+    device executor only ever enqueue work here or probe RAM-resident
+    indexes.  Capacity and occupancy are deterministic: the host ring is
+    one preallocated buffer, swap records are budgeted in
+    block-equivalents against ``swap_blocks``."""
+
+    def __init__(
+        self,
+        host_blocks: int,
+        disk_blocks: int = 0,
+        disk_dir: Optional[str] = None,
+        *,
+        swap_enabled: bool = True,
+        swap_blocks: Optional[int] = None,
+        registry: Any = None,
+    ) -> None:
+        disk = None
+        if disk_blocks > 0:
+            if not disk_dir:
+                raise ValueError("disk_blocks > 0 requires disk_dir")
+            disk = DiskTier(disk_dir, disk_blocks)
+        self.disk = disk
+        self.host = HostTier(host_blocks, parent=disk)
+        self.swap_enabled = swap_enabled
+        self.swap_blocks = (
+            swap_blocks if swap_blocks is not None else max(host_blocks, 8)
+        )
+        # device-side staging budget (block-equivalents of retained device
+        # snapshots, HBM *outside* the page pool -- the same scratch class
+        # as the disagg export gathers); 0 = host-blob restores only.
+        # Half the swap budget: short parks ride the device fast path,
+        # but once parked KV piles up the overflow spills to host blobs
+        # instead of holding HBM scratch for the whole park.
+        self.swap_device_blocks = max(self.swap_blocks // 2, 1)
+        self._swaps: Dict[str, SwapRecord] = {}
+        self._swap_used = 0
+        self._swap_dev_used = 0
+        self._promoting: set = set()
+        self._lock = threading.Lock()
+        self._ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kv-offload"
+        )
+        # lazy import keeps this module importable without prometheus
+        from .runtime.metrics import OffloadMetrics
+
+        self.metrics = OffloadMetrics(registry)
+        # called (from the offload thread) when a swap blob becomes ready,
+        # so a sleeping tick loop wakes to apply it
+        self.wake_cb: Optional[Any] = None
+        # plain-int mirrors for bench/tests (no registry scrape needed)
+        self.offload_bytes = 0
+        self.offload_seconds = 0.0
+        self.onboard_bytes = 0
+        self.onboard_seconds = 0.0
+        # per-tier [bytes, seconds] so bench can separate swap restores
+        # from prefix onboards when deriving recovery rates
+        self.onboard_detail: Dict[str, List[float]] = {}
+        self.tier_hits: Dict[str, int] = {"host": 0, "disk": 0, "swap": 0}
+        self.tier_lookups = 0
+        # disk->host promotions (prefetch or lookup-triggered); kept OUT
+        # of tier_hits so tier_hit_rate only counts lookups actually
+        # served -- a warmed-but-unused worker must not read as warm
+        self.disk_promotes = 0
+        self.copy_fails = 0
+        self.swap_outs = 0
+        self.swap_ins = 0
+        self.swap_fallbacks = 0
+        self.onboard_fallbacks = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        self._ex.shutdown(wait=True)
+
+    def drain(self) -> None:
+        """Barrier: returns once every queued offload/prefetch/swap task
+        has run (tests and shutdown; never called on a hot path)."""
+        self._ex.submit(lambda: None).result()
+
+    def _wake(self) -> None:
+        cb = self.wake_cb
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                logger.debug("offload wake callback failed", exc_info=True)
+
+    # -- eviction path (G1 -> G2 -> G3) --------------------------------------
+
+    def submit_evict(self, seq_hash: int, snap: Any, meta: BlockMeta) -> None:
+        """Queue an eviction snapshot for materialize + tier store.  The
+        caller has already dispatched the device slice and started the
+        async host copy; nothing here blocks."""
+        self._ex.submit(self._store_evict, seq_hash, snap, meta)
+
+    def _store_evict(self, seq_hash: int, snap: Any, meta: BlockMeta) -> None:
+        from .runtime import faults
+
+        try:
+            if faults.injector.enabled and faults.injector.should_fire(
+                "offload.copy_fail", f"evict/{seq_hash:x}"
+            ):
+                self.copy_fails += 1
+                self.metrics.copy_fails.inc()
+                return  # lost offload = a cache miss later, never an error
+            t0 = time.perf_counter()
+            blob = to_host(snap)
+            self.host.put(seq_hash, blob, meta)
+            dt = time.perf_counter() - t0
+            self.offload_bytes += blob.nbytes
+            self.offload_seconds += dt
+            self.metrics.record_offload("host", blob.nbytes, dt)
+            self._observe_occupancy()
+        except Exception:
+            logger.debug("offload store failed for %x", seq_hash, exc_info=True)
+
+    def submit_put(self, seq_hash: int, blob: np.ndarray, meta: BlockMeta) -> None:
+        """Store an externally-sourced block (prefix-onboard donor fetch)
+        without touching the calling thread: the put -- and any disk
+        demotion it cascades into -- runs on the offload thread."""
+        self._ex.submit(self._store_put, seq_hash, blob, meta)
+
+    def _store_put(self, seq_hash: int, blob: np.ndarray, meta: BlockMeta) -> None:
+        try:
+            self.host.put(seq_hash, blob, meta)
+            self._observe_occupancy()
+        except Exception:
+            logger.debug("tier put failed for %x", seq_hash, exc_info=True)
+
+    # -- lookup path (tiered prefix reuse) -----------------------------------
+
+    def lookup(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta, str]]:
+        """Admission-time probe: returns ``(blob, meta, tier)`` for a
+        RAM-resident hit.  A disk-only hit schedules an asynchronous
+        promote (so a later admission -- or the retry after prefetch --
+        hits in RAM) and returns None: this path runs on the event loop
+        and must never wait on file I/O."""
+        self.tier_lookups += 1
+        hit = self.host.get_ram(seq_hash)
+        if hit is not None:
+            self.tier_hits["host"] += 1
+            self.metrics.tier_hits.labels("host").inc()
+            return hit[0], hit[1], "host"
+        if self.disk is not None and seq_hash in self.disk:
+            with self._lock:
+                schedule = seq_hash not in self._promoting
+                if schedule:
+                    self._promoting.add(seq_hash)
+            if schedule:
+                self._ex.submit(self._promote, seq_hash)
+        return None
+
+    def _promote(self, seq_hash: int) -> None:
+        try:
+            hit = self.host.get(seq_hash)  # promotes disk -> ring
+            if hit is not None:
+                self.disk_promotes += 1
+                self.metrics.tier_promotes.labels("disk").inc()
+                self._observe_occupancy()
+        except Exception:
+            logger.debug("disk promote failed for %x", seq_hash, exc_info=True)
+        finally:
+            with self._lock:
+                self._promoting.discard(seq_hash)
+            self._wake()
+
+    def prefetch(self, seq_hashes: List[int]) -> None:
+        """Queue-side prefetch: while the request waits for admission,
+        promote its offloaded prefix chain into the host ring so the
+        admission-time ``lookup`` is a RAM hit and the onboard's H2D
+        scatter can be dispatched with the admitting tick (overlapping
+        the copy with that tick's compute) instead of stalling on a disk
+        read.  Stops at the first tier miss -- prefix chains are only
+        usable contiguously."""
+        if not seq_hashes:
+            return
+        self._ex.submit(self._prefetch, list(seq_hashes))
+
+    def _prefetch(self, seq_hashes: List[int]) -> None:
+        for h in seq_hashes:
+            try:
+                if self.host.get_ram(h) is not None:
+                    continue
+                promoted = self.host.get(h)
+                if promoted is None:
+                    break
+                # a promote is NOT a hit: only lookups actually served
+                # count toward tier_hit_rate (the router warmth signal)
+                self.disk_promotes += 1
+                self.metrics.tier_promotes.labels("disk").inc()
+            except Exception:
+                logger.debug("prefetch failed at %x", h, exc_info=True)
+                break
+        self._observe_occupancy()
+
+    def contains(self, seq_hash: int) -> bool:
+        return self.host.contains(seq_hash)
+
+    def get_blocking(self, seq_hash: int) -> Optional[Tuple[np.ndarray, Any]]:
+        """Tiered get from a worker thread (block export / donor paths):
+        routes the possibly-disk read through the offload thread and
+        waits for it.  Never call on the event loop."""
+        return self._ex.submit(self.host.get, seq_hash).result()
+
+    # -- swap records (preempted-sequence KV) --------------------------------
+
+    def swap_out(
+        self, request_id: str, snap: Any, cache_len: int, n_blocks: int
+    ) -> bool:
+        """Reserve budget and park a preemption snapshot.  The device copy
+        is retained (within ``swap_device_blocks``) so a short park can
+        restore without ever crossing the host link; the host materialize
+        is queued as the spill.  Returns False (caller falls back to
+        recompute) when swap is disabled, the budget is exhausted, or the
+        ``offload.copy_fail`` chaos site fires -- tiers-full is a
+        fallback, never an error."""
+        from .runtime import faults
+
+        if not self.swap_enabled:
+            return False
+        if faults.injector.enabled and faults.injector.should_fire(
+            "offload.copy_fail", f"swap/{request_id}"
+        ):
+            self.copy_fails += 1
+            self.metrics.copy_fails.inc()
+            self.swap_fallbacks += 1
+            self.metrics.swap_fallbacks.labels("copy_fail").inc()
+            return False
+        keep_dev = self.swap_device_blocks > 0
+        with self._lock:
+            if request_id in self._swaps:
+                return False  # defensive: one parked record per request
+            if self._swap_used + n_blocks > self.swap_blocks:
+                self.swap_fallbacks += 1
+                self.metrics.swap_fallbacks.labels("budget").inc()
+                return False
+            self._swap_used += n_blocks
+            if keep_dev:
+                self._swap_dev_used += n_blocks
+            self._swaps[request_id] = SwapRecord(
+                cache_len=cache_len,
+                n_blocks=n_blocks,
+                dev=snap if keep_dev else None,
+            )
+        self.swap_outs += 1
+        self.metrics.swap_events.labels("out").inc()
+        self._ex.submit(self._store_swap, request_id, snap)
+        return True
+
+    def _store_swap(self, request_id: str, snap: Any) -> None:
+        rec = self._swaps.get(request_id)
+        if rec is None:
+            return  # dropped (cancel / already restored from the device copy)
+        try:
+            t0 = time.perf_counter()
+            rec.blob = to_host(snap)
+            rec.nbytes = rec.blob.nbytes
+            dt = time.perf_counter() - t0
+            rec.state = SWAP_READY
+            self.offload_bytes += rec.nbytes
+            self.offload_seconds += dt
+            self.metrics.record_offload("swap", rec.nbytes, dt)
+            # host spill landed: drop the device copy if the staging
+            # budget is oversubscribed (long parks ride the host blob)
+            with self._lock:
+                if (
+                    rec.dev is not None
+                    and self._swap_dev_used > self.swap_device_blocks
+                ):
+                    rec.dev = None
+                    self._swap_dev_used -= rec.n_blocks
+        except Exception:
+            logger.debug("swap store failed for %s", request_id, exc_info=True)
+            rec.state = SWAP_FAILED
+        finally:
+            self._observe_occupancy()
+            self._wake()
+
+    def poll_swap(self, request_id: str) -> Optional[SwapRecord]:
+        return self._swaps.get(request_id)
+
+    def drop_swap(self, request_id: str) -> None:
+        with self._lock:
+            rec = self._swaps.pop(request_id, None)
+            if rec is not None:
+                self._swap_used -= rec.n_blocks
+                if rec.dev is not None:
+                    rec.dev = None
+                    self._swap_dev_used -= rec.n_blocks
+        if rec is not None:
+            self._observe_occupancy()
+
+    def record_onboard(self, tier: str, nbytes: int, seconds: float) -> None:
+        """Called by the engine after an onboard scatter lands on device;
+        feeds the ``kv_onboard_gbps`` accounting."""
+        self.onboard_bytes += nbytes
+        self.onboard_seconds += seconds
+        d = self.onboard_detail.setdefault(tier, [0.0, 0.0])
+        d[0] += nbytes
+        d[1] += seconds
+        if tier == "swap":
+            self.swap_ins += 1
+            self.metrics.swap_events.labels("in").inc()
+        self.metrics.record_onboard(tier, nbytes, seconds)
+
+    # -- observability -------------------------------------------------------
+
+    def _observe_occupancy(self) -> None:
+        self.metrics.tier_blocks.labels("host").set(len(self.host))
+        if self.disk is not None:
+            self.metrics.tier_blocks.labels("disk").set(len(self.disk))
+        self.metrics.tier_blocks.labels("swap").set(self._swap_used)
+
+    @property
+    def tier_hit_rate(self) -> float:
+        """Fraction of tier lookups served from G2/G3 -- the router-facing
+        warmth signal (a worker whose tiers keep hitting is a better home
+        for repeat prefixes than a cold one)."""
+        if not self.tier_lookups:
+            return 0.0
+        return min(
+            (self.tier_hits["host"] + self.tier_hits["disk"])
+            / self.tier_lookups,
+            1.0,
+        )
+
+    def stats(self) -> Dict[str, Any]:
+        out = dict(self.host.stats())
+        out.update(
+            offload_bytes=self.offload_bytes,
+            offload_seconds=round(self.offload_seconds, 6),
+            onboard_bytes=self.onboard_bytes,
+            onboard_seconds=round(self.onboard_seconds, 6),
+            onboard_detail={
+                t: {"bytes": int(b), "seconds": round(s, 6)}
+                for t, (b, s) in self.onboard_detail.items()
+            },
+            tier_hits=dict(self.tier_hits),
+            tier_lookups=self.tier_lookups,
+            disk_promotes=self.disk_promotes,
+            swap_outs=self.swap_outs,
+            swap_ins=self.swap_ins,
+            swap_fallbacks=self.swap_fallbacks,
+            onboard_fallbacks=self.onboard_fallbacks,
+            swap_used_blocks=self._swap_used,
+            copy_fails=self.copy_fails,
+        )
+        if self.onboard_seconds > 0:
+            out["onboard_gbps"] = round(
+                self.onboard_bytes / self.onboard_seconds / 1e9, 3
             )
         return out

@@ -131,6 +131,12 @@ class ForwardPassMetrics:
     gpu_prefix_cache_hit_rate: float = 0.0
     request_active_slots: int = 0
     request_total_slots: int = 0
+    # multi-tier KV offload plane (KVBM G2/G3): blocks parked per tier and
+    # the fraction of tier lookups that hit -- the router's warmth signal
+    # for preferring workers whose host tier holds reusable prefixes
+    host_tier_blocks: int = 0
+    disk_tier_blocks: int = 0
+    tier_hit_rate: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         return self.__dict__.copy()

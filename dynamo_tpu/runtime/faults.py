@@ -46,6 +46,13 @@ Site catalog (README "Failure model & fault injection"):
                                     circuit breaker)
     disagg.chunk_truncate           KV upload stops after the first chunk
     disagg.slow_export              injected latency before the KV upload
+    offload.copy_fail               an offload-tier materialize is dropped
+                                    (eviction snapshot lost = later cache
+                                    miss; swap snapshot lost = resume falls
+                                    back to recompute)
+    onboard.truncate                a tier onboard aborts before the device
+                                    scatter (prefix onboards recompute the
+                                    prefix; swap-ins recompute the sequence)
 """
 
 from __future__ import annotations
@@ -66,6 +73,8 @@ SITES = frozenset(
         "disagg.enqueue_fail",
         "disagg.chunk_truncate",
         "disagg.slow_export",
+        "offload.copy_fail",
+        "onboard.truncate",
     }
 )
 

@@ -210,6 +210,93 @@ class EngineMetrics:
         self.kv_util.set(used / total if total else 0.0)
 
 
+class OffloadMetrics:
+    """Registry-backed multi-tier KV offload plane series (G2 host / G3
+    disk / swap records): transfer volume + latency per tier, occupancy,
+    tiered prefix hits, preemption kinds, and the chaos-visible failure
+    counters.  Minted here (DT007) and updated only from the offload
+    thread or the engine's existing commit points -- never per token.
+    Catalog: README "Multi-tier KV cache (KVBM)".
+    """
+
+    def __init__(self, registry: Optional["MetricsRegistry"] = None) -> None:
+        reg = registry or default_registry()
+        self.registry = reg
+        self.offload_bytes = reg.counter(
+            "dynamo_kv_offload_bytes",
+            "KV bytes demoted out of HBM (eviction snapshots, swap-outs)",
+            ["tier"],  # host | swap
+        )
+        self.offload_latency = reg.histogram(
+            "dynamo_kv_offload_seconds",
+            "Device->host materialize + tier store latency per blob",
+            ["tier"],
+            buckets=TRANSFER_LATENCY_BUCKETS,
+        )
+        self.onboard_bytes = reg.counter(
+            "dynamo_kv_onboard_bytes",
+            "KV bytes restored into HBM pages (prefix onboards, swap-ins)",
+            ["tier"],  # prefix | swap
+        )
+        self.onboard_latency = reg.histogram(
+            "dynamo_kv_onboard_seconds",
+            "Host->device scatter latency per onboarded blob",
+            ["tier"],
+            buckets=TRANSFER_LATENCY_BUCKETS,
+        )
+        self.tier_blocks = reg.gauge(
+            "dynamo_kv_tier_blocks",
+            "Blocks resident per offload tier (swap = budget blocks in use)",
+            ["tier"],  # host | disk | swap
+        )
+        self.tier_hits = reg.counter(
+            "dynamo_kv_tier_prefix_hits",
+            "Prefix-block lookups served from an offload tier",
+            ["tier"],  # host | disk
+        )
+        self.tier_promotes = reg.counter(
+            "dynamo_kv_tier_promotes",
+            "Blocks promoted up a tier ahead of use (disk->host ring via "
+            "prefetch or lookup-triggered promote); deliberately not a "
+            "hit -- warmth counts only lookups actually served",
+            ["tier"],  # disk
+        )
+        self.preemptions = reg.counter(
+            "dynamo_kv_preemptions",
+            "Capacity preemptions by recovery kind",
+            ["kind"],  # swap | recompute
+        )
+        self.swap_events = reg.counter(
+            "dynamo_kv_swap_events",
+            "Swap-plane transitions (out = parked, in = restored)",
+            ["event"],  # out | in
+        )
+        self.swap_fallbacks = reg.counter(
+            "dynamo_kv_swap_fallbacks",
+            "Swap attempts that fell back to recompute, by cause",
+            ["cause"],  # budget | copy_fail | truncate
+        )
+        self.onboard_fallbacks = reg.counter(
+            "dynamo_kv_onboard_fallbacks",
+            "Prefix onboards abandoned (the admission recomputed the "
+            "prefix in place), by cause",
+            ["cause"],  # truncate
+        )
+        self.copy_fails = reg.counter(
+            "dynamo_kv_offload_copy_failures",
+            "Offload materializations dropped (I/O errors or injected "
+            "offload.copy_fail faults)",
+        )
+
+    def record_offload(self, tier: str, nbytes: int, seconds: float) -> None:
+        self.offload_bytes.labels(tier).inc(nbytes)
+        self.offload_latency.labels(tier).observe(max(seconds, 0.0))
+
+    def record_onboard(self, tier: str, nbytes: int, seconds: float) -> None:
+        self.onboard_bytes.labels(tier).inc(nbytes)
+        self.onboard_latency.labels(tier).observe(max(seconds, 0.0))
+
+
 _default = MetricsRegistry()
 _default_lock = threading.Lock()
 

@@ -621,6 +621,74 @@ def test_dt008_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DT009: sync device<->host transfers in offload-engine modules
+# ---------------------------------------------------------------------------
+
+
+def test_dt009_sync_transfers_outside_helpers(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        COPY_HELPERS = ("to_host",)
+
+        def to_host(arr):
+            return np.asarray(arr)
+
+        def lookup(store, snap, dev):
+            a = jax.device_get(snap)
+            b = np.asarray(snap)
+            jax.device_put(b)
+            dev.block_until_ready()
+            return a
+        """,
+        rules=["DT009"],
+        name="fixture_pkg/offload.py",
+    )
+    assert rule_ids(findings) == ["DT009"] * 4
+
+
+def test_dt009_copy_helper_is_exempt(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import numpy as np
+
+        COPY_HELPERS = ("to_host",)
+
+        def to_host(arr):
+            return np.asarray(arr)
+
+        def store(tier, h, snap):
+            tier.put(h, to_host(snap))
+
+        def probe(shape):
+            return np.asarray([1, 2, 3])  # literal: host-side construction
+        """,
+        rules=["DT009"],
+        name="fixture_pkg/offload.py",
+    )
+    assert findings == []
+
+
+def test_dt009_ignores_other_modules(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        def anywhere(handles):
+            return jax.device_get(handles)
+        """,
+        rules=["DT009"],
+        name="fixture_pkg/engine.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------
 

@@ -571,8 +571,13 @@ def test_chunked_prefill_cancel_mid_chunking_frees_pages(run):
             ctx.ctx.stop_generating()
             async for _ in stream:
                 pass
-            # give the loop a tick to release
-            await asyncio.sleep(0.05)
+            # the release happens on the tick after the cancel drains; on
+            # a loaded single-core box (mid-compile) that tick can take
+            # well over a fixed 50ms -- poll instead of guessing
+            for _ in range(100):
+                if engine.sched.num_active == 0:
+                    break
+                await asyncio.sleep(0.05)
             assert engine.sched.num_active == 0
         finally:
             await engine.stop()

@@ -128,6 +128,40 @@ def test_selector_no_endpoints():
         sel.select_worker(ProcessedEndpoints(), OverlapScores(), 8, 16)
 
 
+def test_selector_prefers_tier_warm_worker():
+    """Offload-plane warmth breaks the tie: a worker whose host tier keeps
+    serving prefix hits beats an otherwise-identical cold worker, but an
+    HBM-resident overlap still outweighs tier warmth."""
+    sel = DefaultWorkerSelector(KvRouterConfig())
+    workers = ProcessedEndpoints(
+        endpoints={
+            1: _metrics(gpu_cache_usage_perc=0.2),
+            2: _metrics(
+                gpu_cache_usage_perc=0.2,
+                host_tier_blocks=16,
+                tier_hit_rate=0.8,
+            ),
+        }
+    )
+    wid, _ = sel.select_worker(workers, OverlapScores(), 64, 16)
+    assert wid == 2
+    # warmth without resident blocks is stale signal: no bonus
+    workers.endpoints[2].host_tier_blocks = 0
+    logits = {
+        w: sel.select_worker(
+            ProcessedEndpoints(endpoints={w: m}), OverlapScores(), 64, 16
+        )[1]
+        for w, m in workers.endpoints.items()
+    }
+    assert logits[1] == logits[2]
+    # G1 overlap on the cold worker beats the warm tier
+    workers.endpoints[2].host_tier_blocks = 16
+    wid2, _ = sel.select_worker(
+        workers, OverlapScores(scores={1: 4}), 64, 16
+    )
+    assert wid2 == 1
+
+
 def test_scheduler_predictive_update():
     from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
 

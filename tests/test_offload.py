@@ -1,18 +1,38 @@
-"""KV offload tiers (G2 host / G3 disk) and their engine integration.
+"""KV offload tiers (G2 host / G3 disk), swap-based preemption, and their
+engine integration.
 
 Reference capability: block_manager offload.rs:76-80 -- eviction cascades
-G1 -> G2 -> G3; admission lookups promote blocks back up.
+G1 -> G2 -> G3; admission lookups promote blocks back up; preemption
+swaps the victim's KV out and restores it through the chunked scatter
+path instead of recomputing.
 """
 
 import asyncio
+import threading
 
 import numpy as np
 import pytest
 
-from dynamo_tpu.offload import BlockMeta, DiskTier, HostTier
+from dynamo_tpu.offload import (
+    BlockMeta,
+    DiskTier,
+    HostTier,
+    KVOffloadEngine,
+    env_offload_spec,
+)
+from dynamo_tpu.runtime import faults
 
 from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.tokens.sequence import TokenBlockSequence
 from tests.test_jax_engine import collect, req
+
+
+@pytest.fixture
+def injector():
+    """The process injector, disarmed on the way out."""
+    faults.injector.disable()
+    yield faults.injector
+    faults.injector.disable()
 
 
 def _blob(seed, shape=(2, 2, 1, 4, 2, 8)):
@@ -95,6 +115,8 @@ def test_engine_offload_roundtrip(run):
                     engine, req([(p + i) % 30 for p in prompt_b], max_tokens=4)
                 )
             assert a_resident() == 0, "A's blocks must have been evicted"
+            # barrier: eviction snapshots materialize on the offload thread
+            engine.offload_engine.drain()
             assert len(engine.offload) > 0, "evictions must have offloaded"
 
             hits_before = engine._prefix_hits
@@ -123,6 +145,7 @@ def test_engine_offload_disk_spill_roundtrip(run, tmp_path):
             first_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
             assert engine.offload.parent is not None
             for i in range(16):
+                engine.offload_engine.drain()
                 if len(engine.offload.parent) > 0:
                     break
                 await collect(
@@ -130,6 +153,14 @@ def test_engine_offload_disk_spill_roundtrip(run, tmp_path):
                     req([(9 + i + j) % 30 for j in range(12)], max_tokens=4),
                 )
             assert len(engine.offload.parent) > 0, "G3 must hold spills"
+            # a disk-resident prefix onboards via the queue-side prefetch
+            # (promote to the host ring) + the chunked scatter; make the
+            # promote deterministic for the assertion below
+            a_hashes = TokenBlockSequence(
+                prompt_a, block_size=engine.sched.block_size
+            ).sequence_hashes()
+            engine.offload_engine.prefetch(a_hashes)
+            engine.offload_engine.drain()
             second_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
             assert second_a == first_a
         finally:
@@ -139,6 +170,9 @@ def test_engine_offload_disk_spill_roundtrip(run, tmp_path):
 
 
 def test_offload_disabled_by_default(run):
+    """With DYN_KV_OFFLOAD unset and no config blocks, the plane is a
+    no-op: no tiers, no offload thread, no swap hook."""
+
     async def body():
         engine = JaxEngine.random_init(
             ModelConfig.tiny(),
@@ -147,8 +181,368 @@ def test_offload_disabled_by_default(run):
         )
         try:
             assert engine.offload is None
+            assert engine.offload_engine is None
+            assert engine.sched.swap_out is None
+            await collect(engine, req([1, 2, 3], max_tokens=2))
+            assert not [
+                t for t in threading.enumerate()
+                if t.name.startswith("kv-offload")
+            ], "no offload thread may start when the plane is unarmed"
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_env_offload_spec_grammar():
+    assert env_offload_spec({}) is None
+    assert env_offload_spec({"DYN_KV_OFFLOAD": "off"}) is None
+    assert env_offload_spec({"DYN_KV_OFFLOAD": "1"}) == {
+        "host": 256, "disk": 0, "dir": None, "swap": True,
+    }
+    spec = env_offload_spec(
+        {"DYN_KV_OFFLOAD": "host=64,disk=128,dir=/tmp/kv,swap=0"}
+    )
+    assert spec == {"host": 64, "disk": 128, "dir": "/tmp/kv", "swap": False}
+    with pytest.raises(ValueError):
+        env_offload_spec({"DYN_KV_OFFLOAD": "host=abc"})
+    with pytest.raises(ValueError):
+        env_offload_spec({"DYN_KV_OFFLOAD": "bogus=1"})
+
+
+def test_env_var_arms_engine(run, monkeypatch):
+    """DYN_KV_OFFLOAD turns the plane on without any config blocks."""
+    monkeypatch.setenv("DYN_KV_OFFLOAD", "host=8")
+
+    async def body():
+        engine = JaxEngine.random_init(
+            ModelConfig.tiny(),
+            EngineConfig(max_batch_size=2, max_seq_len=32, page_size=4,
+                         num_pages=16),
+        )
+        try:
+            assert engine.offload_engine is not None
+            assert engine.offload_engine.host.capacity == 8
+            assert engine.sched.swap_out is not None
             await collect(engine, req([1, 2, 3], max_tokens=2))
         finally:
             await engine.stop()
 
     run(body())
+
+
+# -- swap-based preemption ---------------------------------------------------
+
+
+def _pressure_engine(swap: bool, num_pages: int = 13, **kw):
+    """A pool two growing sequences cannot share: admission fits both, but
+    decode growth runs dry and the younger lane gets preempted."""
+    defaults = dict(
+        max_batch_size=2,
+        max_seq_len=64,
+        page_size=4,
+        num_pages=num_pages,
+        host_offload_blocks=32,
+        swap_preemption=swap,
+    )
+    defaults.update(kw)
+    return JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**defaults))
+
+
+async def _run_pressure_pair(engine, prompt_a, prompt_b, max_tokens=24):
+    """Run two concurrent requests through a tight pool; returns their
+    outputs in request order."""
+    (ta, _), (tb, _) = await asyncio.gather(
+        collect(engine, req(prompt_a, max_tokens=max_tokens)),
+        collect(engine, req(prompt_b, max_tokens=max_tokens)),
+    )
+    return ta, tb
+
+
+def test_swap_preemption_token_identical(run):
+    """The acceptance invariant: swap-based preemption produces exactly
+    the tokens recompute preemption does (and both match an uncontended
+    pool), while actually exercising the swap path."""
+
+    prompt_a = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompt_b = [2, 7, 1, 8, 2, 8, 1, 8]
+
+    async def one(swap: bool, num_pages: int):
+        engine = _pressure_engine(swap, num_pages=num_pages)
+        try:
+            out = await _run_pressure_pair(engine, prompt_a, prompt_b)
+            return out, engine.sched.preempt_swap, engine.sched.preempt_recompute
+        finally:
+            await engine.stop()
+
+    async def body():
+        roomy, _, _ = await one(swap=True, num_pages=41)
+        swap_out, n_swap, _ = await one(swap=True, num_pages=13)
+        reco_out, _, n_reco = await one(swap=False, num_pages=13)
+        assert n_swap >= 1, "swap preemption must have been exercised"
+        assert n_reco >= 1, "recompute preemption must have been exercised"
+        assert swap_out == reco_out == roomy
+
+    run(body())
+
+
+def test_swap_budget_exhausted_falls_back_to_recompute(run):
+    """A zero swap budget declines every swap-out; preemption still works
+    (recompute), output unchanged, nothing leaks."""
+
+    async def body():
+        engine = _pressure_engine(True, num_pages=13)
+        engine.offload_engine.swap_blocks = 0  # exhaust the budget
+        try:
+            ta, tb = await _run_pressure_pair(
+                engine, [3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8, 2, 8, 1, 8]
+            )
+            assert ta and tb
+            assert engine.sched.preempt_swap == 0
+            assert engine.sched.preempt_recompute >= 1
+            assert engine.offload_engine.swap_fallbacks >= 1
+            assert engine.kv.allocator.used_pages == 0  # no leaked pages
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_swap_copy_fail_chaos_recomputes_cleanly(run, injector):
+    """offload.copy_fail on the swap snapshot: the swap-out declines and
+    the victim takes the recompute path -- identical output, no leaked
+    pages, counters advance."""
+
+    prompt_a = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompt_b = [2, 7, 1, 8, 2, 8, 1, 8]
+
+    async def body():
+        baseline_engine = _pressure_engine(True, num_pages=41)
+        try:
+            baseline = await _run_pressure_pair(
+                baseline_engine, prompt_a, prompt_b
+            )
+        finally:
+            await baseline_engine.stop()
+
+        injector.configure("seed=3;offload.copy_fail=1:match=swap/")
+        engine = _pressure_engine(True, num_pages=13)
+        try:
+            out = await _run_pressure_pair(engine, prompt_a, prompt_b)
+            assert out == baseline
+            assert injector.fire_count("offload.copy_fail") >= 1
+            assert engine.sched.preempt_swap == 0  # every swap-out declined
+            assert engine.sched.preempt_recompute >= 1
+            assert engine.offload_engine.swap_fallbacks >= 1
+            assert engine.kv.allocator.used_pages == 0
+            assert engine.offload_engine._swap_used == 0  # budget released
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_swap_host_blob_path_token_identical(run):
+    """With the device staging budget off, restores ride the host blob
+    (the long-park spill) -- still token-identical, still counted."""
+
+    prompt_a = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompt_b = [2, 7, 1, 8, 2, 8, 1, 8]
+
+    async def body():
+        roomy = _pressure_engine(True, num_pages=41)
+        try:
+            baseline = await _run_pressure_pair(roomy, prompt_a, prompt_b)
+        finally:
+            await roomy.stop()
+        engine = _pressure_engine(True, num_pages=13)
+        engine.offload_engine.swap_device_blocks = 0  # host restores only
+        try:
+            out = await _run_pressure_pair(engine, prompt_a, prompt_b)
+            assert out == baseline
+            assert engine.sched.preempt_swap >= 1
+            assert engine.offload_engine.swap_ins >= 1
+            det = engine.offload_engine.onboard_detail.get("swap")
+            assert det is not None and det[0] > 0  # host-blob bytes moved
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_swap_onboard_truncate_chaos_recomputes_cleanly(run, injector):
+    """onboard.truncate on the swap restore: the ready blob is discarded
+    and the lane recomputes -- identical output, no leaked pages."""
+
+    prompt_a = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompt_b = [2, 7, 1, 8, 2, 8, 1, 8]
+
+    async def body():
+        baseline_engine = _pressure_engine(True, num_pages=41)
+        try:
+            baseline = await _run_pressure_pair(
+                baseline_engine, prompt_a, prompt_b
+            )
+        finally:
+            await baseline_engine.stop()
+
+        injector.configure("seed=3;onboard.truncate=1:match=swap/")
+        engine = _pressure_engine(True, num_pages=13)
+        try:
+            out = await _run_pressure_pair(engine, prompt_a, prompt_b)
+            assert out == baseline
+            assert injector.fire_count("onboard.truncate") >= 1
+            assert engine.offload_engine.swap_fallbacks >= 1
+            assert engine.kv.allocator.used_pages == 0
+            assert engine.offload_engine._swap_used == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- eviction/onboard chaos + races -----------------------------------------
+
+
+def test_evict_copy_fail_chaos_is_a_cache_miss(run, injector):
+    """offload.copy_fail on eviction snapshots: blocks never land in G2,
+    re-runs recompute instead of onboarding -- same output, counter moves."""
+
+    async def body():
+        injector.configure("seed=1;offload.copy_fail=1:match=evict/")
+        prompt_a = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+        engine = _offload_engine()
+        try:
+            first_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            for i in range(12):
+                await collect(
+                    engine,
+                    req([(7 + p + i) % 30 for p in prompt_a], max_tokens=4),
+                )
+            engine.offload_engine.drain()
+            assert injector.fire_count("offload.copy_fail") >= 1
+            assert len(engine.offload) == 0, "failed copies must not land"
+            second_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            assert second_a == first_a  # recompute reproduces the stream
+            assert engine.kv.allocator.used_pages == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_prefix_onboard_truncate_chaos_recomputes(run, injector):
+    """onboard.truncate on a tiered prefix onboard: the admission keeps
+    its pages, prefills the whole prompt, and produces identical output
+    with zero leaked pages."""
+
+    async def body():
+        prompt_a = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+        engine = _offload_engine()
+        try:
+            first_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            pool = engine.sched.pool
+            a_hashes = TokenBlockSequence(
+                prompt_a, block_size=engine.sched.block_size
+            ).sequence_hashes()
+            for i in range(12):
+                if not any(pool.is_registered(h) for h in a_hashes):
+                    break
+                await collect(
+                    engine,
+                    req([(7 + p + i) % 30 for p in prompt_a], max_tokens=4),
+                )
+            engine.offload_engine.drain()
+            assert len(engine.offload) > 0
+            injector.configure("seed=1;onboard.truncate=1")
+            second_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            assert second_a == first_a
+            assert injector.fire_count("onboard.truncate") >= 1
+            assert engine.kv.allocator.used_pages == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_eviction_during_offload_race_preserves_content(run):
+    """The freed pages are reused by new prefills immediately after the
+    eviction dispatch; the offloaded snapshot must still hold the
+    pre-reuse contents (device program order), proven by the onboarded
+    re-run reproducing the original stream."""
+
+    async def body():
+        prompt_a = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+        engine = _offload_engine()
+        try:
+            first_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            pool = engine.sched.pool
+            a_hashes = TokenBlockSequence(
+                prompt_a, block_size=engine.sched.block_size
+            ).sequence_hashes()
+            # churn back-to-back so every eviction's pages are re-prefilled
+            # while its snapshot may still be materializing
+            for i in range(12):
+                if not any(pool.is_registered(h) for h in a_hashes):
+                    break
+                await asyncio.gather(
+                    collect(
+                        engine,
+                        req([(7 + p + i) % 30 for p in prompt_a], max_tokens=4),
+                    ),
+                    collect(
+                        engine,
+                        req([(13 + p + i) % 30 for p in prompt_a], max_tokens=4),
+                    ),
+                )
+            engine.offload_engine.drain()
+            hits_before = engine.offload_engine.tier_hits["host"]
+            second_a, _ = await collect(engine, req(prompt_a, max_tokens=4))
+            assert second_a == first_a
+            assert engine.offload_engine.tier_hits["host"] > hits_before
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_host_ring_is_single_allocation():
+    """The G2 store is one preallocated buffer: puts recycle slots, no
+    per-put growth."""
+    t = HostTier(4)
+    for i in range(16):
+        t.put(i, _blob(i), BlockMeta(position=i))
+    assert len(t) == 4
+    ring = t._ring
+    assert ring is not None and ring.shape[0] == 4
+    for i in range(16, 32):
+        t.put(i, _blob(i), BlockMeta(position=i))
+    assert t._ring is ring  # never reallocated
+    blob, meta = t.get(31)
+    assert np.array_equal(blob, _blob(31)) and meta.position == 31
+    # returned blobs are decoupled from slot recycling
+    for i in range(32, 40):
+        t.put(i, _blob(i), BlockMeta())
+    assert np.array_equal(blob, _blob(31))
+
+
+def test_kv_offload_engine_lookup_is_ram_only(tmp_path):
+    """lookup() never blocks on disk: a G3-only block misses, the async
+    promote runs on the offload thread, and the retry hits in RAM."""
+    eng = KVOffloadEngine(2, 8, str(tmp_path / "g3"))
+    try:
+        eng.disk.put(99, _blob(99), BlockMeta(position=7))
+        assert eng.lookup(99) is None  # disk-only: schedules the promote
+        eng.drain()
+        hit = eng.lookup(99)
+        assert hit is not None
+        blob, meta, tier = hit
+        assert tier == "host" and meta.position == 7
+        assert np.array_equal(blob, _blob(99))
+        # the promote is counted as a promote, the served lookup as the
+        # hit -- a promoted-but-unserved block must not inflate warmth
+        assert eng.disk_promotes == 1 and eng.tier_hits["host"] == 1
+        assert eng.tier_hits["disk"] == 0
+        assert 0.0 < eng.tier_hit_rate <= 1.0
+    finally:
+        eng.close()
