@@ -39,6 +39,8 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
     # under jax.jit inside the tick loop's dispatch
     "dynamo_tpu/engine/step.py": [
         "decode_block",
+        "verify_and_sample",
+        "score_prompt_step",
         "prefill_and_sample",
         "prefill_mm_and_sample",
         "prefill_suffix_and_sample",
@@ -72,6 +74,15 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
         "KVOffloadEngine.lookup",
         "KVOffloadEngine.submit_evict",
         "KVOffloadEngine.swap_out",
+    ],
+    # speculative-decoding hot paths: drafting runs on the engine executor
+    # once per verify dispatch and sits on the per-step critical path for
+    # every speculating lane -- a host sync or recompile hazard there
+    # stalls the whole verify cadence (engine._dispatch_verify and the
+    # verify/score steps are separately marked with @hot_path)
+    "dynamo_tpu/spec/drafter.py": [
+        "NGramDrafter.propose",
+        "longest_accepted",
     ],
 }
 

@@ -361,6 +361,45 @@ def write_prefill_kv(
 
 
 @hot_path
+def write_spec_kv(
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    k: jax.Array,  # [B, S, Hkv, D] verify-column keys
+    v: jax.Array,
+    page_table: jax.Array,  # [B, P]
+    base: jax.Array,  # [B] cache length; column j lands at base + j
+    n_tokens: jax.Array,  # [B] valid columns per lane (0 = lane not verifying)
+    layer: jax.Array,  # scalar i32
+) -> jax.Array:
+    """Scatter a speculative verify dispatch's K/V: column ``j`` of lane
+    ``b`` lands at position ``base[b] + j``.  Columns past ``n_tokens``
+    (rejected-draft padding, non-speculating lanes) and positions past the
+    lane's page allocation route to trash page 0 -- the multi-token
+    sibling of :func:`write_decode_kv`'s dead-lane handling.  Rejected
+    columns' writes within a lane's pages are *garbage by design*: they
+    sit beyond the committed cache length, are never attended (the read
+    window is ``seq_lens``-bounded), and the next verify/decode step
+    overwrites them in sequence order before the length passes them."""
+    B, S, Hkv, D = k.shape
+    page_size = kv_pages.shape[3]
+    P = page_table.shape[1]
+    positions = base[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # [B, S]
+    valid = jnp.arange(S)[None, :] < n_tokens[:, None]  # [B, S]
+    page_idx = positions // page_size
+    slot = jnp.where(valid, positions % page_size, 0)
+    ids = jnp.take_along_axis(page_table, jnp.clip(page_idx, 0, P - 1), axis=1)
+    ids = jnp.where(valid & (page_idx < P), ids, 0)
+    flat_ids = ids.reshape(B * S)
+    flat_slot = slot.reshape(B * S)
+    kv_pages = kv_pages.at[layer, 0, flat_ids, flat_slot].set(
+        k.reshape(B * S, Hkv, D)
+    )
+    kv_pages = kv_pages.at[layer, 1, flat_ids, flat_slot].set(
+        v.reshape(B * S, Hkv, D)
+    )
+    return kv_pages
+
+
+@hot_path
 def write_decode_kv(
     kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     k: jax.Array,  # [B, Hkv, D] one token

@@ -55,6 +55,7 @@ from .step import (
     scatter_block_pages,
     scatter_layer_pages,
     slice_block_pages,
+    verify_and_sample,
 )
 
 logger = logging.getLogger("dynamo.engine")
@@ -179,6 +180,22 @@ class InflightPrefill:
     tok: Any  # jax.Array [1] token slice (inject re-apply path, device-only)
     seq: SeqState
     slot: int
+    # echo+logprobs: packed [1, T, 2 + 2N] prompt-scoring handle (step.
+    # score_prompt_step), materialized alongside the sampled row at commit
+    prompt_lp: Any = None
+    dispatched_at: float = field(default_factory=time.perf_counter)
+
+
+@dataclass
+class InflightVerify:
+    """A dispatched-but-uncommitted speculative verify: one forward pass
+    scored every speculating lane's draft columns; the host accept walk
+    runs at commit.  ``lanes`` snapshots (seq, slot, draft) at dispatch --
+    a lane preempted/cancelled since discards its whole column, exactly
+    like a stale decode block."""
+
+    sampled: Any  # packed [B, S, 2 + 2N]
+    lanes: List[Tuple[SeqState, int, List[int]]]
     dispatched_at: float = field(default_factory=time.perf_counter)
 
 
@@ -502,6 +519,16 @@ class JaxEngine:
         # dispatch->commit seconds the lane spent not runnable for them
         self.resume_prefill_tokens = 0
         self.resume_prefill_seconds = 0.0
+        # speculative decoding (spec/): per-request drafters propose draft
+        # tokens from host token history; the batched verify step scores
+        # them in one forward pass.  Engine-lifetime counters back the
+        # bench acceptance numbers; the registry family is dynamo_spec_*.
+        from ..runtime.metrics import SpecMetrics
+
+        self.spec_metrics = SpecMetrics(metrics_registry)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_verify_steps = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -631,6 +658,7 @@ class JaxEngine:
                     "sampling penalties are unavailable at max_seq_len "
                     f">= 32768 (engine max_seq_len {self.cfg.max_seq_len})"
                 )
+            self._arm_speculation(seq)  # unknown drafter -> error stream
             self.sched.enqueue(seq)
         except ValueError as e:
             # surface as an error item, matching the remote prologue-error path
@@ -686,6 +714,39 @@ class JaxEngine:
                 self._queues.pop(request.id, None)
 
         return ResponseStream(ctx, stream())
+
+    def _arm_speculation(self, seq: SeqState) -> None:
+        """Attach a live SpecState to a request that asked for speculation.
+
+        Eligibility: the lane needs a host-visible token history
+        (``seq.blocks``; multimodal lanes opt out of block tracking) and no
+        sampling penalties -- penalty histograms evolve token-by-token, so
+        a multi-token verify cannot reproduce the sequential distribution;
+        those requests silently keep the plain decode path (output is the
+        contract, speculation is an optimization).  Unknown drafter kinds
+        raise ValueError, surfacing as a request error like any other
+        invalid option."""
+        opts = seq.speculation
+        if opts is None or not opts.enabled or opts.num_draft_tokens < 1:
+            return
+        if seq.blocks is None:
+            return  # no token history to draft from (multimodal lane)
+        if self._seq_penalized(seq):
+            log_throttled(
+                logger, "spec-penalized",
+                "speculation disabled for a request with sampling "
+                "penalties (multi-token verify cannot replay sequential "
+                "penalty histograms)", level=logging.DEBUG,
+            )
+            return
+        from ..spec import MAX_DRAFT_TOKENS, SpecState, make_drafter
+
+        seq.spec = SpecState(
+            drafter=make_drafter(opts.drafter),  # raises on unknown kind
+            num_draft_tokens=min(int(opts.num_draft_tokens), MAX_DRAFT_TOKENS),
+            kind=opts.drafter,
+        )
+        self.spec_metrics.requests.inc()
 
     async def embed(self, token_batches: List[List[int]]) -> List[List[float]]:
         """Pooled embeddings for pre-tokenized inputs (/v1/embeddings).
@@ -1546,9 +1607,23 @@ class JaxEngine:
                 if self.sched.num_active > 0:
                     # pre-grow pages to cover the in-flight block plus this
                     # tick's block (the host mirror lags the device by up to
-                    # one uncommitted block)
+                    # one uncommitted block); with speculating lanes slotted
+                    # the floor also covers a verify dispatch's full draft
+                    # span (spec-free serving keeps its exact old watermark
+                    # -- the floor must not raise preemption pressure for
+                    # workloads that never speculate)
+                    lookahead = 2 * self.cfg.decode_block_size + 1
+                    if any(
+                        s is not None and s.spec is not None
+                        for s in self.sched.slots
+                    ):
+                        from ..spec import MAX_DRAFT_TOKENS
+
+                        lookahead = max(
+                            lookahead, 2 * (MAX_DRAFT_TOKENS + 1) + 1
+                        )
                     preempted = self.sched.ensure_decode_capacity(
-                        lookahead=2 * self.cfg.decode_block_size + 1,
+                        lookahead=lookahead,
                         chunk_pages=self.cfg.grow_chunk_pages,
                     )
                     if preempted:
@@ -1619,7 +1694,7 @@ class JaxEngine:
                         self._ex, self._do_prefill_group, items
                     )
                     fresh.extend(pfs)
-                if self.sched.num_runnable > 0:
+                if self.sched.num_decode_runnable > 0:
                     blk = await loop.run_in_executor(self._ex, self._dispatch_block)
                     if blk is not None:
                         fresh.append(blk)
@@ -1629,6 +1704,21 @@ class JaxEngine:
                     )
                     self._dispatch(events)
                 pending = fresh
+                # speculative verify dispatches AFTER the commit above: a
+                # lane's next draft extends its post-commit history, so
+                # each spec lane runs one draft->verify->commit cycle per
+                # tick (the dispatch still overlaps this tick's in-flight
+                # decode block on device).  The slot scan gates the
+                # executor hop so spec-free serving pays nothing here.
+                if any(
+                    s is not None and s.spec is not None
+                    for s in self.sched.slots
+                ):
+                    vb = await loop.run_in_executor(
+                        self._ex, self._dispatch_verify
+                    )
+                    if vb is not None:
+                        pending.append(vb)
                 if not fresh and not pending:
                     self._handle_stalled_admission()
                     # nothing dispatched and nothing in flight (e.g. waiting
@@ -2145,6 +2235,12 @@ class JaxEngine:
         self._sync_device_state()
         tok = sampled[:, 0]  # device slice from the packed [1, C] row
         pf = InflightPrefill(sampled=sampled, tok=tok, seq=seq, slot=seq.slot)
+        if (
+            seq.prompt_logprobs is not None
+            and not seq.prompt_lp_sent
+            and seq.prior_generated == 0  # resumes fold output into prompt
+        ):
+            pf.prompt_lp = self._dispatch_prompt_score(seq)
         self._pending_injects[seq.slot] = pf
         self._dev["tokens"] = inject_token(self._dev["tokens"], seq.slot, tok)
         if self._dev.get("counts") is not None:
@@ -2224,6 +2320,12 @@ class JaxEngine:
                 seq=seq,
                 slot=seq.slot,
             )
+            if (
+                seq.prompt_logprobs is not None
+                and not seq.prompt_lp_sent
+                and seq.prior_generated == 0
+            ):
+                pf.prompt_lp = self._dispatch_prompt_score(seq)
             self._pending_injects[seq.slot] = pf
             if tracing.collector.enabled:
                 with tracing.span(
@@ -2330,6 +2432,7 @@ class JaxEngine:
                 and limits[b] > int(sched.seq_lens[b])
                 and not seq.awaiting_kv
                 and not seq.prefilling
+                and seq.spec is None  # spec lanes advance via verify
             )
             rows["stop"][i] = self._lane_stop_row(seq)
             rows["pages"][i] = sched.page_table[b]
@@ -2481,11 +2584,13 @@ class JaxEngine:
                 continue
             # a lane with no write headroom must not run: it would scatter
             # its next KV write to the trash page and emit a garbage token.
-            # Lanes awaiting a remote prefill's KV stay parked until delivery.
+            # Lanes awaiting a remote prefill's KV stay parked until
+            # delivery; speculating lanes advance via verify dispatches.
             active[b] = (
                 limit[b] > int(sched.seq_lens[b])
                 and not seq.awaiting_kv
                 and not seq.prefilling
+                and seq.spec is None
             )
             # stop tokens the device may swallow itself (shared helper so
             # the full-rebuild and dirty-row paths cannot diverge)
@@ -2575,6 +2680,20 @@ class JaxEngine:
                 )
         return counts
 
+    def _live_page_bucket(self) -> int:
+        """Power-of-two page-table width covering the longest slotted
+        lane's allocation (floor 8 bounds the executable count) -- the ONE
+        bucketing rule shared by the decode-block and verify dispatches,
+        so the two paths can never compile against different table
+        widths."""
+        live_pages = [
+            len(s.pages) for s in self.sched.slots if s is not None and s.pages
+        ]
+        return pick_page_bucket(
+            min(max(8, max(live_pages, default=1)), self.sched.max_pages),
+            self.sched.max_pages,
+        )
+
     @hot_path
     def _dispatch_block(self) -> Optional["InflightBlock"]:
         """Enqueue one decode block; does not wait for results."""
@@ -2589,13 +2708,7 @@ class JaxEngine:
         # attention can never read past a lane's allocation).  Dead lanes'
         # rows are zeroed, so clamped gathers land on trash page 0.  Each
         # bucket is its own cached executable; the floor bounds the count.
-        live_pages = [
-            len(s.pages) for s in self.sched.slots if s is not None and s.pages
-        ]
-        Pb = pick_page_bucket(
-            min(max(8, max(live_pages, default=1)), self.sched.max_pages),
-            self.sched.max_pages,
-        )
+        Pb = self._live_page_bucket()
         use_filters = any(
             s is not None and self._sampling_needs_filters(s.sampling)
             for s in self.sched.slots
@@ -2655,6 +2768,158 @@ class JaxEngine:
         self._steps += 1
         _start_host_copy(sampled)
         return InflightBlock(sampled=sampled, slots=list(self.sched.slots))
+
+    # -- speculative decoding (spec/: draft on host, verify in one pass) ----
+
+    @hot_path
+    def _dispatch_verify(self) -> Optional["InflightVerify"]:
+        """Enqueue one batched multi-token verify for the speculating lanes
+        (executor thread).
+
+        Per eligible lane: the drafter proposes up to ``num_draft_tokens``
+        continuations of the committed token history (clamped to the
+        lane's write headroom so a draft can never outrun its pages or
+        token budget), and the scheduler packs them as extra columns next
+        to the lane's last committed token.  One ``verify_and_sample``
+        forward scores every column; the host accept walk runs at commit.
+        A lane with no proposal still rides along with zero draft columns
+        -- its verify degenerates to a plain decode step, so speculation
+        never stalls progress.
+
+        Eligibility gates keep the host mirrors authoritative: no verify
+        while the lane's first token is device-only (pending inject), while
+        parked (awaiting_kv / prefilling), or while a previous verify is in
+        flight (the next draft must extend the post-commit history).
+        """
+        from ..runtime import faults
+        from ..spec import MAX_DRAFT_TOKENS
+
+        sched = self.sched
+        limits = self._compute_limits()
+        lanes: List[Tuple[SeqState, int, List[int]]] = []
+        max_d = 0
+        t_draft0 = time.perf_counter()
+        for b, seq in enumerate(sched.slots):
+            if seq is None or seq.spec is None or seq.finish is not None:
+                continue
+            st = seq.spec
+            if (
+                st.inflight
+                or seq.awaiting_kv
+                or seq.prefilling
+                or b in self._pending_injects
+                or seq.num_generated + seq.prior_generated < 1
+            ):
+                continue
+            base = int(sched.seq_lens[b])
+            headroom = int(limits[b]) - base
+            if headroom < 1:
+                continue  # no writable position; growth or preemption next
+            n = min(st.num_draft_tokens, headroom - 1, MAX_DRAFT_TOKENS)
+            draft: List[int] = []
+            if n > 0 and seq.blocks is not None:
+                draft = list(st.drafter.propose(seq.blocks.tokens, n))[:n]
+                if (
+                    draft
+                    and faults.injector.enabled
+                    and faults.injector.should_fire(
+                        "spec.draft_corrupt", seq.request_id
+                    )
+                ):
+                    # deterministic corruption: shift every proposed token
+                    # off its value -- the accept walk must reject the
+                    # whole column (a bad draft can only cost compute)
+                    V = self.model_cfg.vocab_size
+                    draft = [(t + 1) % V for t in draft]
+            lanes.append((seq, b, draft))
+            if len(draft) > max_d:
+                max_d = len(draft)
+        if not lanes:
+            return None
+        B = self.cfg.max_batch_size
+        # pad the draft axis to a power of two so compile-cache entries
+        # stay at {1, 1+1, 1+2, 1+4, 1+8} columns
+        Dp = 0 if max_d == 0 else 1 << (max_d - 1).bit_length()
+        S = 1 + Dp
+        tokens = np.zeros((B, S), np.int32)
+        base_arr = np.zeros((B,), np.int32)
+        n_tok = np.zeros((B,), np.int32)
+        seqs: List[Optional[SeqState]] = [None] * B
+        for seq, b, draft in lanes:
+            tokens[b, 0] = sched.tokens[b]
+            if draft:
+                tokens[b, 1 : 1 + len(draft)] = draft
+            base_arr[b] = sched.seq_lens[b]
+            n_tok[b] = 1 + len(draft)
+            seqs[b] = seq
+            seq.spec.inflight = True
+        Pb = self._live_page_bucket()
+        use_filters = any(
+            self._sampling_needs_filters(s.sampling) for s, _b, _d in lanes
+        )
+        draft_s = time.perf_counter() - t_draft0
+        # numpy copy of the page-table mirror for the same aliasing reason
+        # as _push_device_state: the scheduler mutates it on later ticks
+        sampled, self.kv.pages = verify_and_sample(
+            self.params,
+            self.model_cfg,
+            self.kv.pages,
+            self._put_batch(tokens),
+            self._put_batch(base_arr),
+            self._put_batch(n_tok),
+            self._put_batch(sched.page_table[:, :Pb].copy()),
+            self._next_rng(),
+            self._sampling_arrays(seqs),
+            self._lp_top(seqs),
+            use_filters,
+        )
+        self._steps += 1
+        self.spec_metrics.draft_latency.observe(max(draft_s, 0.0))
+        _start_host_copy(sampled)
+        return InflightVerify(sampled=sampled, lanes=lanes)
+
+    def _dispatch_prompt_score(self, seq: SeqState) -> Any:
+        """Echo+logprobs: dispatch the prompt-scoring forward (no KV
+        writes, step.score_prompt_step) alongside the lane's prefill; the
+        packed rows materialize with the prefill commit.  One extra
+        forward, paid only by requests that asked for prompt logprobs."""
+        from .step import score_prompt_step
+
+        prompt = seq.prompt
+        bucket = pick_bucket(self.buckets, len(prompt))
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, : len(prompt)] = prompt
+        lens = np.zeros((1,), np.int32)
+        lens[0] = len(prompt)
+        out = score_prompt_step(
+            self.params,
+            self.model_cfg,
+            self.kv.pages,
+            self._put_batch(toks),
+            self._put_batch(lens),
+            8 if seq.prompt_logprobs else 0,
+        )
+        _start_host_copy(out)
+        return out
+
+    def _prompt_lp_entries(self, seq: SeqState, packed: np.ndarray) -> List[Any]:
+        """Packed scoring rows [T, 2 + 2N] -> per-prompt-position entries
+        ``[token_id, logprob|None, top|None]`` (position 0 carries None:
+        nothing precedes it, the OpenAI prompt-logprobs shape)."""
+        from .sampling import unpack_sampled_logprobs
+
+        N = (packed.shape[-1] - 2) // 2
+        _t, lps, tids, tlps = unpack_sampled_logprobs(packed, N)
+        prompt = seq.prompt
+        out: List[Any] = [[int(prompt[0]), None, None]]
+        for j in range(1, len(prompt)):
+            top = (
+                [[int(i), float(l)] for i, l in zip(tids[j - 1], tlps[j - 1])]
+                if N
+                else None
+            )
+            out.append([int(prompt[j]), float(lps[j - 1]), top])
+        return out
 
     # -- KV offload (G1 -> G2 -> G3 + swap; SURVEY.md 5.4) -----------------
 
@@ -2941,11 +3206,24 @@ class JaxEngine:
 
     @hot_path
     def _commit_all(self, entries: List[Any]) -> List[StepEvent]:
-        """Materialize and commit pending prefills/blocks in dispatch order
-        (one bundled device_get instead of one round trip per handle)."""
+        """Materialize and commit pending prefills/blocks/verifies in
+        dispatch order (one bundled device_get instead of one round trip
+        per handle)."""
         from .sampling import unpack_sampled_logprobs
 
         handles = [e.sampled for e in entries]
+        # echo+logprobs scoring rows ride the same bundled transfer
+        lp_refs: List[Tuple[Any, int]] = []
+        for e in entries:
+            pfs = (
+                e.entries
+                if isinstance(e, InflightPrefillGroup)
+                else [e] if isinstance(e, InflightPrefill) else []
+            )
+            for pf in pfs:
+                if pf.prompt_lp is not None:
+                    lp_refs.append((pf, len(handles)))
+                    handles.append(pf.prompt_lp)
         if jax.process_count() > 1:
             # multi-host mesh (v5e pod): a batch-sharded result's shards
             # live partly on other processes, so a plain device_get raises
@@ -2962,6 +3240,7 @@ class JaxEngine:
             # dynalint: disable=DT004 -- the pipeline's ONE designed sync point:
             # block i's results materialize here while block i+1 computes
             mats = jax.device_get(handles)
+        lp_mats = {id(pf): mats[i] for pf, i in lp_refs}
         events: List[StepEvent] = []
 
         def commit_prefill(pf: InflightPrefill, row: np.ndarray) -> None:
@@ -2988,10 +3267,78 @@ class JaxEngine:
                     len(seq.prompt) - seq.cached_prompt_tokens
                 )
                 self.resume_prefill_seconds += max(now - pf.dispatched_at, 0.0)
-            events.append(
-                self.sched.commit_prefill_token(
-                    seq, int(tok), float(lp), top
+            ev = self.sched.commit_prefill_token(seq, int(tok), float(lp), top)
+            plp = lp_mats.get(id(pf))
+            if plp is not None and not seq.prompt_lp_sent:
+                ev.prompt_logprobs = self._prompt_lp_entries(seq, plp[0])
+                seq.prompt_lp_sent = True
+            events.append(ev)
+
+        def commit_verify(e: InflightVerify, arr: np.ndarray) -> None:
+            # arr: packed [B, S, 2 + 2N] target samples at every column
+            from ..spec import longest_accepted
+
+            N = (arr.shape[-1] - 2) // 2
+            toks, lps, tids, tlps = unpack_sampled_logprobs(arr, N)
+            for seq, slot, draft in e.lanes:
+                st = seq.spec
+                if st is not None:
+                    st.inflight = False
+                if (
+                    seq.finish is not None
+                    or seq.slot != slot
+                    or self.sched.slots[slot] is not seq
+                    or seq.awaiting_kv
+                ):
+                    # preempted/cancelled mid-verify: the whole column is
+                    # discarded (the existing speculative-rollback path --
+                    # resume re-derives these tokens deterministically)
+                    continue
+                col = toks[slot]
+                m = longest_accepted(draft, col)
+                # committed tokens are the TARGET samples: the verified
+                # draft prefix plus the bonus token at the first mismatch;
+                # trailing columns are marked dead for the host replay
+                column = np.full((col.shape[0],), -1, np.int32)
+                column[: m + 1] = col[: m + 1]
+                ev = self.sched._commit_lane_column(
+                    seq, column, lps[slot],
+                    tids[slot] if N else None,
+                    tlps[slot] if N else None,
                 )
+                if st is not None:
+                    # accepted counts only verified drafts that actually
+                    # COMMITTED: the stop-rule replay can finish the lane
+                    # mid-column, and acceptance must not exceed emitted
+                    # tokens (a verified-but-swallowed stop token is
+                    # conservatively uncounted)
+                    accepted = min(m, len(ev.tokens))
+                    st.drafted += len(draft)
+                    st.accepted += accepted
+                    st.verify_steps += 1
+                    self.spec_drafted += len(draft)
+                    self.spec_accepted += accepted
+                    if draft:
+                        self.spec_metrics.drafted.labels(st.kind).inc(
+                            len(draft)
+                        )
+                        if accepted:
+                            self.spec_metrics.accepted.labels(st.kind).inc(
+                                accepted
+                            )
+                if ev.finished is not None:
+                    seq.finish = ev.finished
+                    self.sched._release_slot(seq)
+                if ev.tokens or ev.finished is not None:
+                    events.append(ev)
+            self.spec_verify_steps += 1
+            self.spec_metrics.verify_steps.inc()
+            if self.spec_drafted:
+                self.spec_metrics.accept_rate.set(
+                    self.spec_accepted / self.spec_drafted
+                )
+            self.spec_metrics.verify_latency.observe(
+                max(now - e.dispatched_at, 0.0)
             )
 
         # mats are host-resident np arrays (device_get / allgather output):
@@ -3005,6 +3352,9 @@ class JaxEngine:
             elif isinstance(e, InflightPrefill):
                 commit_prefill(e, mat[0])
                 self.obs.observe_step("prefill", now - e.dispatched_at)
+            elif isinstance(e, InflightVerify):
+                commit_verify(e, mat)
+                self.obs.observe_step("verify", now - e.dispatched_at)
             else:
                 arr = mat  # [B, K, 2 + 2N]
                 N = (arr.shape[-1] - 2) // 2
@@ -3046,9 +3396,38 @@ class JaxEngine:
                     out.logprobs = list(ev.logprobs)
                     if want > 0 and ev.top_logprobs is not None:
                         out.top_logprobs = [t[:want] for t in ev.top_logprobs]
+                if ev.prompt_logprobs is not None:
+                    out.prompt_logprobs = ev.prompt_logprobs
                 queue.put_nowait(Annotated.from_data(out.to_dict()))
             if ev.finished is not None:
                 out = LLMEngineOutput.finished(ev.finished)
+                if not ev.tokens and ev.prompt_logprobs is not None:
+                    # first token finished the request outright (swallowed
+                    # stop): the prompt logprobs must still ship
+                    out.prompt_logprobs = ev.prompt_logprobs
+                st = ev.seq.spec
+                if st is not None:
+                    # per-choice acceptance observability: the finish item
+                    # carries the stats (usage extension downstream), the
+                    # request span carries spec_accept_rate
+                    out.spec = {
+                        "drafted_tokens": st.drafted,
+                        "accepted_tokens": st.accepted,
+                        "acceptance_rate": round(st.accept_rate, 6),
+                        "drafter": st.kind,
+                    }
+                    from ..runtime import tracing
+
+                    if tracing.collector.enabled:
+                        with tracing.span(
+                            "engine.spec", ev.seq.request_id
+                        ) as sp:
+                            sp.set(
+                                spec_accept_rate=round(st.accept_rate, 6),
+                                spec_drafted=st.drafted,
+                                spec_accepted=st.accepted,
+                                spec_verify_steps=st.verify_steps,
+                            )
                 queue.put_nowait(Annotated.from_data(out.to_dict()))
                 queue.put_nowait(None)
                 if pool is None:

@@ -6,6 +6,13 @@ engine exposes without importing the ``engine`` package; engine code
 imports it from here to keep layering readable.
 """
 
-from ..runtime.metrics import EngineMetrics, MetricsRegistry, default_registry
+from ..runtime.metrics import (
+    EngineMetrics,
+    MetricsRegistry,
+    SpecMetrics,
+    default_registry,
+)
 
-__all__ = ["EngineMetrics", "MetricsRegistry", "default_registry"]
+__all__ = [
+    "EngineMetrics", "MetricsRegistry", "SpecMetrics", "default_registry",
+]

@@ -91,6 +91,16 @@ class SeqState:
     # the lane stays decode-inactive while prefilling is True
     prefilled_tokens: int = 0
     prefilling: bool = False
+    # speculative decoding: the request's knobs (SpeculationOptions | None)
+    # and, once the engine arms the lane, its live spec.SpecState.  A lane
+    # with spec armed is DEVICE-inactive for the decode scan -- it advances
+    # through the engine's batched verify dispatches instead, driven from
+    # the host mirrors.
+    speculation: Optional[Any] = None
+    spec: Optional[Any] = None
+    # echo+logprobs: top-N prompt logprobs to compute at first prefill
+    prompt_logprobs: Optional[int] = None
+    prompt_lp_sent: bool = False
 
     @property
     def seq_len(self) -> int:
@@ -118,6 +128,8 @@ class SeqState:
                 else TokenBlockSequence(req.token_ids, block_size=block_size)
             ),
             mm_embeds=mm,
+            speculation=req.speculation,
+            prompt_logprobs=req.prompt_logprobs,
         )
 
 
@@ -150,6 +162,9 @@ class StepEvent:
     # [[token_id, logprob], ...] (None when the dispatch ran without tops)
     logprobs: List[float] = field(default_factory=list)
     top_logprobs: Optional[List[List[List[float]]]] = None
+    # echo+logprobs: per-prompt-position [token_id, logprob|None, top|None]
+    # entries, attached by the engine to the request's first event
+    prompt_logprobs: Optional[List[Any]] = None
 
     @property
     def token(self) -> Optional[int]:
@@ -223,6 +238,21 @@ class Scheduler:
             1
             for s in self.slots
             if s is not None and not s.awaiting_kv and not s.prefilling
+        )
+
+    @property
+    def num_decode_runnable(self) -> int:
+        """Runnable lanes the decode SCAN should step: speculating lanes
+        are excluded -- they advance via the engine's verify dispatches
+        (host-mirror driven), and a decode block over only-spec lanes
+        would burn a dispatch on dead rows."""
+        return sum(
+            1
+            for s in self.slots
+            if s is not None
+            and not s.awaiting_kv
+            and not s.prefilling
+            and s.spec is None
         )
 
     @property

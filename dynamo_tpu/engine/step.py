@@ -225,6 +225,127 @@ def decode_block(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "top_n", "use_filters"),
+    donate_argnames=("kv_pages",),
+)
+def verify_and_sample(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,
+    tokens: jax.Array,  # [B, S]: last committed token | draft columns (padded)
+    base: jax.Array,  # [B] cache length; column j sits at position base + j
+    n_tokens: jax.Array,  # [B] valid columns (1 + draft len; 0 = inactive)
+    page_table: jax.Array,  # [B, P] (bucketed)
+    rng: jax.Array,
+    sampling: SamplingParams,
+    top_n: int = 0,
+    use_filters: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched multi-token verify: score every speculating lane's draft
+    columns in ONE forward pass and sample the target token at every
+    position.
+
+    Column j carries (for j=0) the lane's last committed token and (j>0)
+    draft token j; its KV lands at position ``base + j`` and its logits
+    sample the token for position ``base + j + 1`` -- the exact
+    position-keying of the decode scan (``decode_block``: a step at cache
+    length q samples with ``positions = q + 1``), so greedy and seeded
+    lanes produce bit-identical tokens to plain decode.  The host accept
+    walk (engine ``_commit_all``) keeps the longest prefix where draft j
+    equals the sampled target j-1, plus the bonus token at the first
+    mismatch; the rest of the column is speculative garbage the next
+    step's writes overwrite.
+
+    Attention reuses the prefix-suffix dispatch: the resident cache
+    (positions < base, token-granular mask, no page alignment needed) is
+    the prefix; the S fresh columns attend causally among themselves.
+
+    Returns (packed [B, S, 2 + 2*top_n], kv_pages) -- one int32 transfer
+    carrying token | logprob | top-N per column (pack_sampled_logprobs
+    layout shared with every other sampling site).
+    """
+    B, S = tokens.shape
+    positions = base[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+
+    def attn_fn(q, k, v, kv, layer):
+        out = att.prefill_prefix_attention_dispatch(
+            q, k, v, kv, layer, page_table, base, n_tokens,
+            cfg.sliding_window or 0,
+        )
+        new_kv = att.write_spec_kv(kv, k, v, page_table, base, n_tokens, layer)
+        return out, new_kv
+
+    hidden, kv_pages = transformer(
+        params, cfg, tokens, positions, kv_pages, attn_fn
+    )
+    logits = lm_logits(params, cfg, hidden)  # [B, S, V]
+    subs = jax.random.split(rng, S)
+    cols = []
+    for j in range(S):  # S <= 1 + MAX_DRAFT_TOKENS: unrolled, tiny
+        lj = logits[:, j]
+        sampled = sample_tokens(
+            lj, subs[j], sampling, use_filters, positions=base + 1 + j
+        )
+        lp, top_ids, top_lps = token_logprobs(lj, sampled, top_n)
+        cols.append(pack_sampled_logprobs(sampled, lp, top_ids, top_lps))
+    return jnp.stack(cols, axis=1), kv_pages
+
+
+@partial(jax.jit, static_argnames=("cfg", "top_n"))
+def score_prompt_step(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,  # read-only: trunk signature, never written
+    tokens: jax.Array,  # [B, T] bucket-padded prompt
+    seq_lens: jax.Array,  # [B] true prompt length (0 = pad lane)
+    top_n: int = 0,
+) -> jax.Array:
+    """Per-position next-token logprobs over a prompt (echo+logprobs).
+
+    The scoring half of the verify path without the KV writes: run the
+    trunk causally, take logits at every position, and report the logprob
+    of the token that actually FOLLOWS it (entry j scores prompt token
+    j+1; the last entry is meaningless and dropped by the host).  Shares
+    :func:`~..sampling.token_logprobs`/``pack_sampled_logprobs`` with the
+    verify and decode sites, so all three report the same raw-model
+    distribution.  The logits projection runs in position chunks so the
+    transient buffer is [B, <=512, V] instead of [B, T, V] -- a
+    max_seq_len prompt over a large vocab must not be able to OOM the
+    device (and thereby fail the whole batch) from one echo+logprobs
+    request.
+
+    Returns packed [B, T, 2 + 2*top_n] int32.
+    """
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def attn_fn(q, k, v, kv, layer):
+        out = att.prefill_attention_dispatch(
+            q, k, v, seq_lens, cfg.sliding_window or 0
+        )
+        return out, kv
+
+    hidden, _ = transformer(params, cfg, tokens, positions, kv_pages, attn_fn)
+    targets = jnp.roll(tokens, -1, axis=1)  # target[j] = tokens[j + 1]
+    chunk = min(T, 512)  # ragged tail chunk handled via logits.shape
+    parts = []
+    for lo in range(0, T, chunk):
+        logits = lm_logits(params, cfg, hidden[:, lo : lo + chunk])
+        span = logits.shape[1]
+        tgt = targets[:, lo : lo + chunk].reshape(B * span)
+        lp, top_ids, top_lps = token_logprobs(
+            logits.reshape(B * span, -1), tgt, top_n
+        )
+        parts.append(
+            pack_sampled_logprobs(tgt, lp, top_ids, top_lps).reshape(
+                B, span, -1
+            )
+        )
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
 @jax.jit
 def sample_step(
     logits: jax.Array, rng: jax.Array, params: SamplingParams

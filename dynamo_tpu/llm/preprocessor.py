@@ -19,6 +19,7 @@ import jinja2.sandbox
 from ..protocols.common import (
     PreprocessedRequest,
     SamplingOptions,
+    SpeculationOptions,
     StopConditions,
 )
 from ..protocols.openai import (
@@ -123,6 +124,17 @@ class OpenAIPreprocessor(Operator):
             ),
             eos_token_ids=self.tokenizer.eos_token_ids,
         )
+        spec = getattr(req, "speculation", None)
+        if spec:
+            out.speculation = SpeculationOptions(
+                enabled=spec.get("enabled", True),
+                num_draft_tokens=spec.get("num_draft_tokens", 4),
+                drafter=spec.get("drafter", "ngram"),
+            )
+        if getattr(req, "echo", False) and s.logprobs is not None:
+            # legacy OpenAI echo+logprobs: the engine's verify-scoring path
+            # serves per-position PROMPT logprobs alongside the completion
+            out.prompt_logprobs = s.logprobs
         out.annotations = list(getattr(req, "annotations", []) or [])
         out._formatted_prompt = prompt  # for the formatted_prompt annotation
         return out
@@ -179,14 +191,7 @@ class OpenAIPreprocessor(Operator):
             offsets.append(off)
             off += len(t)
         def top_map(i: int) -> Dict[str, float]:
-            # entries arrive probability-sorted; two token ids can decode to
-            # the same string, and the later (lower-probability) alternative
-            # must not overwrite the earlier one
-            out: Dict[str, float] = {}
-            for s, l in top_entries(i) or []:
-                if s not in out:
-                    out[s] = l
-            return out
+            return self._first_wins_map(top_entries(i) or [])
 
         return {
             "tokens": tok_str,
@@ -196,6 +201,61 @@ class OpenAIPreprocessor(Operator):
                 if tops is not None
                 else None
             ),
+            "text_offset": offsets,
+        }
+
+    @staticmethod
+    def _first_wins_map(
+        pairs, limit: Optional[int] = None
+    ) -> Dict[str, float]:
+        """Probability-sorted ``(token_string, logprob)`` pairs -> the
+        OpenAI top_logprobs map.  Two token ids can decode to the same
+        string, and the later (lower-probability) alternative must not
+        overwrite the earlier one -- the ONE dedup rule shared by the
+        completion and prompt logprob blocks."""
+        out: Dict[str, float] = {}
+        for s, l in pairs:
+            if limit is not None and len(out) >= limit:
+                break
+            if s not in out:
+                out[s] = float(l)
+        return out
+
+    def _format_prompt_logprobs(
+        self, entries: List[Any], want: int
+    ) -> Dict[str, Any]:
+        """Engine prompt-logprob entries -> the completions logprobs block
+        covering the echoed prompt.  Entries are ``[token_id, logprob|None,
+        top|None]`` per prompt position (position 0 carries None, the
+        OpenAI prompt-logprobs shape); offsets start at 0 because the echo
+        text leads the response."""
+        tokens: List[str] = []
+        lps: List[Any] = []
+        tops: List[Any] = []
+        offsets: List[int] = []
+        off = 0
+        for tid, lp, top in entries:
+            s = self.tokenizer.decode([int(tid)])
+            tokens.append(s)
+            lps.append(lp)
+            if top is None or want <= 0:
+                tops.append(None)
+            else:
+                tops.append(
+                    self._first_wins_map(
+                        (
+                            (self.tokenizer.decode([int(alt_id)]), alt_lp)
+                            for alt_id, alt_lp in top
+                        ),
+                        limit=want,
+                    )
+                )
+            offsets.append(off)
+            off += len(s)
+        return {
+            "tokens": tokens,
+            "token_logprobs": lps,
+            "top_logprobs": tops if want > 0 else None,
             "text_offset": offsets,
         }
 
@@ -229,6 +289,8 @@ class OpenAIPreprocessor(Operator):
             completion_tokens = 0
             finish: Optional[str] = None
             text_off = 0  # running offset into the emitted completion text
+            spec_stats = None  # engine-reported acceptance (finish item)
+            pending_echo: Optional[str] = None
             if not is_chat and getattr(req, "echo", False):
                 # OpenAI completions echo: the prompt text leads the
                 # completion (its length counts into text_offset)
@@ -237,7 +299,13 @@ class OpenAIPreprocessor(Operator):
                     if isinstance(req.prompt, str)
                     else self.tokenizer.decode(list(req.prompt))
                 )
-                if prompt_text:
+                if prompt_text and pre.prompt_logprobs is not None:
+                    # echo+logprobs: hold the echo chunk until the engine's
+                    # first item delivers the prompt logprobs that belong
+                    # on it (engines without the scoring path degrade to a
+                    # plain echo)
+                    pending_echo = prompt_text
+                elif prompt_text:
                     yield Annotated.from_data(
                         completion_chunk(
                             rid, model, created, text=prompt_text
@@ -253,6 +321,25 @@ class OpenAIPreprocessor(Operator):
                 data = item.data
                 if data is None:
                     continue
+                if data.get("spec") is not None:
+                    spec_stats = data["spec"]
+                if pending_echo is not None:
+                    plp = data.get("prompt_logprobs")
+                    lp_block = (
+                        self._format_prompt_logprobs(
+                            plp, pre.prompt_logprobs or 0
+                        )
+                        if plp
+                        else None
+                    )
+                    yield Annotated.from_data(
+                        completion_chunk(
+                            rid, model, created, text=pending_echo,
+                            logprobs=lp_block,
+                        )
+                    )
+                    text_off = len(pending_echo)
+                    pending_echo = None
                 completion_tokens += len(data.get("token_ids") or [])
                 text = data.get("text")
                 fr = data.get("finish_reason")
@@ -287,6 +374,11 @@ class OpenAIPreprocessor(Operator):
                                 logprobs=lp,
                             )
                         )
+            if pending_echo is not None:
+                # the engine produced no data items at all; still echo
+                yield Annotated.from_data(
+                    completion_chunk(rid, model, created, text=pending_echo)
+                )
             final = (
                 chat_chunk(rid, model, created, finish_reason=finish or "stop")
                 if is_chat
@@ -295,6 +387,11 @@ class OpenAIPreprocessor(Operator):
                 )
             )
             final["usage"] = usage_block(len(pre.token_ids), completion_tokens)
+            if spec_stats is not None:
+                # per-choice acceptance observability: the usage extension
+                # mirrors the engine's spec stats (tracing carries the same
+                # numbers as the request span's spec_accept_rate attr)
+                final["usage"]["speculation"] = spec_stats
             yield Annotated.from_data(final)
 
         return gen()

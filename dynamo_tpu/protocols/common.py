@@ -73,6 +73,34 @@ class SamplingOptions:
 
 
 @dataclass
+class SpeculationOptions:
+    """Per-request speculative-decoding knobs (spec/ subsystem).
+
+    ``enabled`` arms draft-and-verify for the request; ``num_draft_tokens``
+    is the per-verify draft length (engine-clamped to
+    ``spec.MAX_DRAFT_TOKENS``); ``drafter`` names a registered drafter
+    kind (``ngram``/``prompt_lookup`` today -- see spec/drafter.py).
+    Output is always the target model's: greedy and seeded lanes are
+    bit-identical with speculation on or off.
+    """
+
+    enabled: bool = False
+    num_draft_tokens: int = 4
+    drafter: str = "ngram"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(
+        cls, d: Optional[Dict[str, Any]]
+    ) -> "Optional[SpeculationOptions]":
+        if d is None:
+            return None
+        return cls(**{k: d[k] for k in cls().__dict__ if k in d})
+
+
+@dataclass
 class PreprocessedRequest:
     """Token-level request handed to the engine.
 
@@ -92,6 +120,11 @@ class PreprocessedRequest:
     # FIRST len(mm_embeds) prompt positions; the corresponding token_ids are
     # placeholders the embed lookup ignores.  [T_img][hidden] floats.
     mm_embeds: Optional[List[List[float]]] = None
+    # speculative decoding knobs (None = off)
+    speculation: Optional[SpeculationOptions] = None
+    # prompt logprobs (completions echo+logprobs): None = off, 0 = chosen
+    # only, N > 0 = with top-N alternatives per prompt position
+    prompt_logprobs: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -103,6 +136,10 @@ class PreprocessedRequest:
             "mdc_sum": self.mdc_sum,
             "estimated_prefix_hit_num_blocks": self.estimated_prefix_hit_num_blocks,
             "mm_embeds": self.mm_embeds,
+            "speculation": (
+                self.speculation.to_dict() if self.speculation else None
+            ),
+            "prompt_logprobs": self.prompt_logprobs,
         }
 
     @classmethod
@@ -116,6 +153,8 @@ class PreprocessedRequest:
             mdc_sum=d.get("mdc_sum"),
             estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
             mm_embeds=d.get("mm_embeds"),
+            speculation=SpeculationOptions.from_dict(d.get("speculation")),
+            prompt_logprobs=d.get("prompt_logprobs"),
         )
 
 
@@ -167,6 +206,13 @@ class LLMEngineOutput:
     finish_reason: Optional[FinishReason] = None
     # completed KV blocks for this step (router/event feedback)
     completed_blocks: Optional[List[Dict[str, int]]] = None
+    # prompt logprobs (first output item of an echo+logprobs completion):
+    # one [token_id, logprob|None, top|None] entry per prompt position
+    # (position 0 has no logprob, matching OpenAI prompt-logprobs shape)
+    prompt_logprobs: Optional[List[Any]] = None
+    # per-request speculation stats, attached to the finish item:
+    # {drafted_tokens, accepted_tokens, acceptance_rate, drafter}
+    spec: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"token_ids": list(self.token_ids)}
@@ -184,6 +230,10 @@ class LLMEngineOutput:
             out["finish_reason"] = self.finish_reason.value
         if self.completed_blocks is not None:
             out["completed_blocks"] = self.completed_blocks
+        if self.prompt_logprobs is not None:
+            out["prompt_logprobs"] = self.prompt_logprobs
+        if self.spec is not None:
+            out["spec"] = self.spec
         return out
 
     @classmethod
@@ -198,6 +248,8 @@ class LLMEngineOutput:
             top_logprobs=d.get("top_logprobs"),
             finish_reason=FinishReason(fr) if fr else None,
             completed_blocks=d.get("completed_blocks"),
+            prompt_logprobs=d.get("prompt_logprobs"),
+            spec=d.get("spec"),
         )
 
     @classmethod

@@ -114,6 +114,34 @@ class SamplingFields:
         return out
 
 
+def _parse_speculation(d: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Per-request speculative-decoding knobs -> normalized dict (None =
+    off).  Accepted at the top level or under ``nvext`` (matching the other
+    extension fields): ``{"speculation": {"enabled": true,
+    "num_draft_tokens": 4, "drafter": "ngram"}}``.  A bare ``{}`` block
+    means "on with defaults".  Drafter-kind existence is validated by the
+    engine (the registry is pluggable); the protocol checks shape only."""
+    spec = d.get("speculation", (d.get("nvext") or {}).get("speculation"))
+    if spec is None or spec is False:  # false = explicitly off, like absent
+        return None
+    if spec is True:
+        spec = {}
+    if not isinstance(spec, dict):
+        raise OpenAIError("'speculation' must be an object or a boolean")
+    enabled = spec.get("enabled", True)
+    if not isinstance(enabled, bool):
+        raise OpenAIError("'speculation.enabled' must be a boolean")
+    n = spec.get("num_draft_tokens", 4)
+    if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+        raise OpenAIError(
+            "'speculation.num_draft_tokens' must be a positive integer"
+        )
+    drafter = spec.get("drafter", "ngram")
+    if not isinstance(drafter, str) or not drafter:
+        raise OpenAIError("'speculation.drafter' must be a non-empty string")
+    return {"enabled": enabled, "num_draft_tokens": n, "drafter": drafter}
+
+
 def _parse_logprobs(d: Dict[str, Any], chat: bool) -> Optional[int]:
     """OpenAI logprobs fields -> normalized top-N (None = off).
 
@@ -146,6 +174,8 @@ class ChatCompletionRequest:
     sampling: SamplingFields
     stream: bool = False
     annotations: List[str] = field(default_factory=list)
+    # normalized per-request speculative-decoding knobs (None = off)
+    speculation: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ChatCompletionRequest":
@@ -167,6 +197,7 @@ class ChatCompletionRequest:
             sampling=SamplingFields.from_dict(d, chat=True),
             stream=bool(d.get("stream", False)),
             annotations=list(nvext.get("annotations") or []),
+            speculation=_parse_speculation(d),
         )
 
 
@@ -179,6 +210,8 @@ class CompletionRequest:
     sampling: SamplingFields
     stream: bool = False
     echo: bool = False
+    # normalized per-request speculative-decoding knobs (None = off)
+    speculation: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "CompletionRequest":
@@ -194,21 +227,17 @@ class CompletionRequest:
             raise OpenAIError("'prompt' must not be empty")
         if d.get("n") not in (None, 1):
             raise OpenAIError("only n=1 is supported")
-        out = cls(
+        # echo+logprobs (legacy OpenAI prompt logprobs) is served: the
+        # preprocessor threads prompt_logprobs to the engine, whose
+        # verify-scoring path computes logprobs at every prompt position
+        return cls(
             model=model,
             prompt=prompt,
             sampling=SamplingFields.from_dict(d),
             stream=bool(d.get("stream", False)),
             echo=bool(d.get("echo", False)),
+            speculation=_parse_speculation(d),
         )
-        if out.echo and out.sampling.logprobs is not None:
-            # echo+logprobs asks for PROMPT logprobs (legacy OpenAI); the
-            # engine computes completion logprobs only -- fail loudly
-            # instead of returning silently misaligned arrays
-            raise OpenAIError(
-                "'echo' with 'logprobs' (prompt logprobs) is not supported"
-            )
-        return out
 
 
 @dataclass

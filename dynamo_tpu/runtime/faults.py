@@ -53,6 +53,10 @@ Site catalog (README "Failure model & fault injection"):
     onboard.truncate                a tier onboard aborts before the device
                                     scatter (prefix onboards recompute the
                                     prefix; swap-ins recompute the sequence)
+    spec.draft_corrupt              a speculative drafter's proposal is
+                                    corrupted before dispatch; the verify
+                                    accept walk must reject it (output
+                                    unchanged, only acceptance rate drops)
 """
 
 from __future__ import annotations
@@ -75,6 +79,7 @@ SITES = frozenset(
         "disagg.slow_export",
         "offload.copy_fail",
         "onboard.truncate",
+        "spec.draft_corrupt",
     }
 )
 

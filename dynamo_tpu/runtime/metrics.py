@@ -297,6 +297,53 @@ class OffloadMetrics:
         self.onboard_latency.labels(tier).observe(max(seconds, 0.0))
 
 
+class SpecMetrics:
+    """Registry-backed speculative-decoding series (``dynamo_spec_*``).
+
+    Updated only at the engine's existing commit points (per verify
+    dispatch, never per token).  ``accept_rate`` is the engine-lifetime
+    running ratio -- per-request rates ride the OpenAI usage extension and
+    the request span's ``spec_accept_rate`` attr instead.  Catalog: README
+    "Speculative decoding".
+    """
+
+    def __init__(self, registry: Optional["MetricsRegistry"] = None) -> None:
+        reg = registry or default_registry()
+        self.registry = reg
+        self.drafted = reg.counter(
+            "dynamo_spec_drafted_tokens",
+            "Draft tokens proposed and dispatched for verification",
+            ["drafter"],
+        )
+        self.accepted = reg.counter(
+            "dynamo_spec_accepted_tokens",
+            "Draft tokens accepted by the verify step",
+            ["drafter"],
+        )
+        self.verify_steps = reg.counter(
+            "dynamo_spec_verify_steps",
+            "Batched multi-token verify dispatches",
+        )
+        self.requests = reg.counter(
+            "dynamo_spec_requests",
+            "Requests that ran with speculation armed",
+        )
+        self.accept_rate = reg.gauge(
+            "dynamo_spec_accept_rate",
+            "Engine-lifetime draft acceptance rate (accepted/drafted)",
+        )
+        self.draft_latency = reg.histogram(
+            "dynamo_spec_draft_seconds",
+            "Host-side drafting time per verify dispatch (all lanes)",
+            buckets=STEP_LATENCY_BUCKETS,
+        )
+        self.verify_latency = reg.histogram(
+            "dynamo_spec_verify_seconds",
+            "Verify dispatch->commit latency",
+            buckets=STEP_LATENCY_BUCKETS,
+        )
+
+
 _default = MetricsRegistry()
 _default_lock = threading.Lock()
 

@@ -162,6 +162,23 @@ def test_fault_schedule_is_deterministic(injector):
     assert injector.schedule() != first
 
 
+def test_spec_draft_corrupt_site_deterministic(injector):
+    """spec.draft_corrupt is a first-class chaos site: DYN_FAULTS grammar
+    arms it, identical specs reproduce the identical corruption schedule,
+    and max= caps it.  The end-to-end invariant -- a corrupted draft costs
+    only a rejected column, never wrong output -- is proven against the
+    live engine in test_spec.py."""
+    spec = "seed=11;spec.draft_corrupt=0.5:max=3"
+    injector.configure(spec)
+    first = [injector.should_fire("spec.draft_corrupt", "r1") for _ in range(20)]
+    sched1 = injector.schedule()
+    injector.configure(spec)
+    second = [injector.should_fire("spec.draft_corrupt", "r1") for _ in range(20)]
+    assert first == second
+    assert sched1 == injector.schedule()
+    assert sum(first) == 3  # max honored
+
+
 def test_fault_spec_validation(injector):
     with pytest.raises(faults.FaultSpecError):
         injector.configure("no.such.site=1")

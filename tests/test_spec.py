@@ -1,0 +1,733 @@
+"""Speculative decoding subsystem (dynamo_tpu/spec): drafters, the batched
+verify step, token identity vs plain decode, preemption composition, prompt
+logprobs (echo+logprobs), and per-request acceptance observability."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    SpeculationOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.spec import (
+    MAX_DRAFT_TOKENS,
+    NGramDrafter,
+    longest_accepted,
+    make_drafter,
+    register_drafter,
+)
+
+
+@pytest.fixture
+def injector():
+    """The process injector, disarmed on the way out."""
+    faults.injector.disable()
+    yield faults.injector
+    faults.injector.disable()
+
+
+def make_engine(**cfg_kw) -> JaxEngine:
+    defaults = dict(max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64)
+    defaults.update(cfg_kw)
+    return JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**defaults))
+
+
+def req(
+    tokens, max_tokens=8, spec=None, sampling=None, prompt_logprobs=None, **kw
+) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, **kw),
+        sampling_options=sampling or SamplingOptions(temperature=0.0),
+        speculation=spec,
+        prompt_logprobs=prompt_logprobs,
+    )
+
+
+def spec_opts(n=4, drafter="ngram"):
+    return SpeculationOptions(enabled=True, num_draft_tokens=n, drafter=drafter)
+
+
+async def collect(engine, request):
+    """Returns (tokens, finish_reason, spec_stats, prompt_logprobs)."""
+    stream = await engine.generate(Context.new(request))
+    tokens, finish, stats, plp = [], None, None, None
+    async for item in stream:
+        ann = item if isinstance(item, Annotated) else Annotated.from_dict(item)
+        assert not ann.is_error(), ann.error_message()
+        data = ann.data
+        tokens.extend(data.get("token_ids") or [])
+        if data.get("spec") is not None:
+            stats = data["spec"]
+        if data.get("prompt_logprobs") is not None:
+            plp = data["prompt_logprobs"]
+        if data.get("finish_reason"):
+            finish = data["finish_reason"]
+    return tokens, finish, stats, plp
+
+
+class OracleDrafter:
+    """Test drafter that replays a known-correct continuation -- drives the
+    accept path deterministically (100% acceptance)."""
+
+    def __init__(self, full):
+        self.full = list(full)
+
+    def propose(self, history, n):
+        k = len(history)
+        return self.full[k : k + n]
+
+
+class WrongDrafter:
+    """Always proposes garbage; every column must be rejected."""
+
+    def propose(self, history, n):
+        return [7] * n if n > 0 else []
+
+
+# -- drafter units -----------------------------------------------------------
+
+
+def test_ngram_drafter_proposes_continuation():
+    d = NGramDrafter(max_ngram=3, min_ngram=2)
+    # tail [4, 5] matched earlier at positions 1-2; continuation 6, 7
+    hist = [1, 4, 5, 6, 7, 9, 4, 5]
+    assert d.propose(hist, 2) == [6, 7]
+    # longest match wins: tail [4, 5, 6] over [5, 6]
+    hist = [4, 5, 6, 8, 2, 4, 5, 6]
+    assert d.propose(hist, 1) == [8]
+
+
+def test_ngram_drafter_prefers_most_recent_match():
+    d = NGramDrafter(max_ngram=2, min_ngram=2)
+    hist = [1, 2, 3, 1, 2, 4, 1, 2]
+    assert d.propose(hist, 1) == [4]  # the later occurrence wins
+
+
+def test_ngram_drafter_no_match_is_empty():
+    d = NGramDrafter()
+    assert d.propose([1, 2, 3, 4, 5], 4) == []
+    assert d.propose([1, 2], 4) == []  # too short
+    assert d.propose([1, 2, 3, 1, 2], 0) == []  # nothing requested
+
+
+def test_longest_accepted_walk():
+    assert longest_accepted([], [9, 9]) == 0
+    assert longest_accepted([5, 6], [5, 6, 7]) == 2
+    assert longest_accepted([5, 8], [5, 6, 7]) == 1
+    assert longest_accepted([4], [5]) == 0
+
+
+def test_make_drafter_registry():
+    assert isinstance(make_drafter("ngram"), NGramDrafter)
+    assert isinstance(make_drafter("prompt_lookup"), NGramDrafter)
+    with pytest.raises(ValueError):
+        make_drafter("no-such-drafter")
+
+
+# -- protocol parsing --------------------------------------------------------
+
+
+def test_openai_speculation_knobs_parse():
+    from dynamo_tpu.protocols.openai import (
+        ChatCompletionRequest,
+        CompletionRequest,
+        OpenAIError,
+    )
+
+    c = CompletionRequest.from_dict(
+        {"model": "m", "prompt": "hi",
+         "speculation": {"num_draft_tokens": 6, "drafter": "ngram"}}
+    )
+    assert c.speculation == {
+        "enabled": True, "num_draft_tokens": 6, "drafter": "ngram"
+    }
+    # nvext placement + bare-true shorthand
+    c2 = CompletionRequest.from_dict(
+        {"model": "m", "prompt": "hi", "nvext": {"speculation": {}}}
+    )
+    assert c2.speculation["enabled"] is True
+    ch = ChatCompletionRequest.from_dict(
+        {"model": "m", "messages": [{"role": "user", "content": "x"}],
+         "speculation": {"enabled": False}}
+    )
+    assert ch.speculation["enabled"] is False
+    # boolean shorthand is symmetric: false = off, like absent
+    c3 = CompletionRequest.from_dict(
+        {"model": "m", "prompt": "hi", "speculation": False}
+    )
+    assert c3.speculation is None
+    with pytest.raises(OpenAIError):
+        CompletionRequest.from_dict(
+            {"model": "m", "prompt": "hi",
+             "speculation": {"num_draft_tokens": 0}}
+        )
+    with pytest.raises(OpenAIError):
+        CompletionRequest.from_dict(
+            {"model": "m", "prompt": "hi", "speculation": {"drafter": 3}}
+        )
+
+
+def test_speculation_options_wire_roundtrip():
+    r = req([1, 2, 3], spec=spec_opts(n=5))
+    back = PreprocessedRequest.from_dict(r.to_dict())
+    assert back.speculation is not None
+    assert back.speculation.num_draft_tokens == 5
+    assert back.speculation.drafter == "ngram"
+    assert PreprocessedRequest.from_dict(req([1]).to_dict()).speculation is None
+
+
+def test_unknown_drafter_fails_request(run):
+    async def body():
+        engine = make_engine()
+        try:
+            stream = await engine.generate(
+                Context.new(req([1, 2, 3], spec=spec_opts(drafter="nope")))
+            )
+            items = [item async for item in stream]
+            assert any(
+                isinstance(i, Annotated) and i.is_error() for i in items
+            )
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- token identity ----------------------------------------------------------
+
+
+def test_spec_greedy_token_identity(run):
+    """The acceptance-criteria invariant: n-gram speculation on == off for
+    greedy decode, across decode-block boundaries (max_tokens spans
+    multiple K=16 blocks on the plain path)."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            base, f1, _, _ = await collect(engine, req(prompt, max_tokens=24))
+            spec, f2, stats, _ = await collect(
+                engine, req(prompt, max_tokens=24, spec=spec_opts())
+            )
+            assert spec == base
+            assert f1 == f2 == "length"
+            assert stats is not None and stats["drafter"] == "ngram"
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_mixed_batch_matches_solo(run):
+    """Spec and non-spec lanes decode concurrently in one batch; each must
+    match its solo non-speculative output (lane isolation + identity)."""
+
+    async def body():
+        prompts = [[1, 2, 3], [9, 8, 7, 6], [5, 5, 5, 5, 5], [2, 4]]
+        engine = make_engine()
+        try:
+            solo = [
+                (await collect(engine, req(p, max_tokens=6)))[0]
+                for p in prompts
+            ]
+            results = await asyncio.gather(
+                *[
+                    collect(
+                        engine,
+                        req(p, max_tokens=6,
+                            spec=spec_opts() if i % 2 == 0 else None),
+                    )
+                    for i, p in enumerate(prompts)
+                ]
+            )
+            assert [r[0] for r in results] == solo
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_seeded_sampling_identity(run):
+    """Seeded lanes key their noise by (seed, position), so speculative
+    output is bit-identical to plain decode even at temperature."""
+
+    async def body():
+        samp = SamplingOptions(temperature=0.9, top_p=0.95, seed=1234)
+        engine = make_engine()
+        try:
+            prompt = [7, 8, 9]
+            base, _, _, _ = await collect(
+                engine, req(prompt, max_tokens=16, sampling=samp)
+            )
+            # oracle drafting forces accepted columns, so the identity is
+            # exercised THROUGH the accept path, not vacuously at 0%
+            register_drafter(
+                "seeded-oracle", lambda: OracleDrafter(prompt + base)
+            )
+            spec, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=16, sampling=samp,
+                    spec=spec_opts(drafter="seeded-oracle")),
+            )
+            assert spec == base
+            assert stats["accepted_tokens"] > 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_oracle_accepts_multi_token(run):
+    """A perfect drafter reaches 100% acceptance and the verify path
+    commits multiple tokens per dispatch (fewer engine steps than
+    tokens)."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=12))
+            register_drafter(
+                "oracle", lambda: OracleDrafter(prompt + base)
+            )
+            v0 = engine.spec_verify_steps
+            out, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=12, spec=spec_opts(drafter="oracle")),
+            )
+            steps = engine.spec_verify_steps - v0
+            assert out == base
+            assert stats["accepted_tokens"] == stats["drafted_tokens"] > 0
+            assert stats["acceptance_rate"] == 1.0
+            # 12 tokens in far fewer verify dispatches than tokens
+            assert steps < len(out)
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_rejecting_drafter_keeps_output(run):
+    """An always-wrong drafter costs only rejected columns: output is
+    unchanged and acceptance is zero (the safety half of draft-and-verify)."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [3, 1, 4, 1, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=10))
+            register_drafter("wrong", WrongDrafter)
+            out, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=10, spec=spec_opts(drafter="wrong")),
+            )
+            assert out == base
+            assert stats["drafted_tokens"] > 0
+            assert stats["accepted_tokens"] == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_draft_clamped_to_cap(run):
+    """num_draft_tokens above MAX_DRAFT_TOKENS clamps instead of growing
+    the compile-cache surface."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [1, 2, 3]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=6))
+            out, _, _, _ = await collect(
+                engine, req(prompt, max_tokens=6, spec=spec_opts(n=99))
+            )
+            assert out == base
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_penalized_request_falls_back(run):
+    """Sampling penalties disable speculation (sequential histograms);
+    the request still completes with penalty semantics intact."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            samp = SamplingOptions(temperature=0.0, frequency_penalty=0.5)
+            base, _, _, _ = await collect(
+                engine, req([1, 2, 3], max_tokens=8, sampling=samp)
+            )
+            out, _, stats, _ = await collect(
+                engine,
+                req([1, 2, 3], max_tokens=8, sampling=samp, spec=spec_opts()),
+            )
+            assert out == base
+            assert stats is None  # speculation never armed
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- preemption composition (PR 5 swap plane) --------------------------------
+
+
+def _pressure_engine(num_pages: int, **kw):
+    defaults = dict(
+        max_batch_size=2,
+        max_seq_len=64,
+        page_size=4,
+        num_pages=num_pages,
+        host_offload_blocks=32,
+        swap_preemption=True,
+    )
+    defaults.update(kw)
+    return JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**defaults))
+
+
+def test_spec_survives_swap_preemption(run):
+    """Speculating lanes compose with swap preemption: a preempted lane's
+    in-flight verify column is discarded, the KV restore rewinds it, and
+    the resumed stream is token-identical to an uncontended run."""
+
+    prompt_a = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompt_b = [2, 7, 1, 8, 2, 8, 1, 8]
+
+    async def one(num_pages):
+        engine = _pressure_engine(num_pages)
+        try:
+            (ta, _, _, _), (tb, _, _, _) = await asyncio.gather(
+                collect(engine, req(prompt_a, max_tokens=24, spec=spec_opts())),
+                collect(engine, req(prompt_b, max_tokens=24, spec=spec_opts())),
+            )
+            return (ta, tb), engine.sched.preempt_swap, \
+                engine.sched.preempt_recompute
+        finally:
+            await engine.stop()
+
+    async def body():
+        roomy, _, _ = await one(num_pages=41)
+        tight, n_swap, n_reco = await one(num_pages=13)
+        assert n_swap + n_reco >= 1, "preemption must have been exercised"
+        assert tight == roomy
+        # and both match the plain non-speculative decode
+        engine = _pressure_engine(41)
+        try:
+            plain_a, _, _, _ = await collect(
+                engine, req(prompt_a, max_tokens=24)
+            )
+        finally:
+            await engine.stop()
+        assert roomy[0] == plain_a
+
+    run(body())
+
+
+# -- chaos: spec.draft_corrupt ----------------------------------------------
+
+
+def test_spec_draft_corrupt_chaos_output_unchanged(run, injector):
+    """The chaos invariant: a corrupted draft can only cost a rejected
+    column, never wrong output.  Deterministic via DYN_FAULTS grammar."""
+
+    async def body():
+        prompt = [1, 2, 3, 4, 5]
+        engine = make_engine()
+        try:
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=12))
+            register_drafter(
+                "chaos-oracle", lambda: OracleDrafter(prompt + base)
+            )
+            # uncorrupted oracle: full acceptance
+            clean, _, clean_stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=12,
+                    spec=spec_opts(drafter="chaos-oracle")),
+            )
+            assert clean == base and clean_stats["acceptance_rate"] == 1.0
+            injector.configure("seed=7;spec.draft_corrupt=1")
+            out, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=12,
+                    spec=spec_opts(drafter="chaos-oracle")),
+            )
+            assert injector.fire_count("spec.draft_corrupt") > 0
+            assert out == base  # corruption cost acceptance, not output
+            assert stats["accepted_tokens"] == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- prompt logprobs (echo+logprobs) ----------------------------------------
+
+
+def test_prompt_logprobs_engine(run):
+    """The verify-scoring path serves per-position prompt logprobs: one
+    entry per prompt token, position 0 carries None, the rest are finite
+    log-probabilities with top-N alternatives."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [5, 6, 7, 8]
+            toks, _, _, plp = await collect(
+                engine,
+                req(prompt, max_tokens=4,
+                    sampling=SamplingOptions(temperature=0.0, logprobs=2),
+                    prompt_logprobs=2),
+            )
+            assert len(toks) == 4
+            assert plp is not None and len(plp) == len(prompt)
+            assert plp[0][0] == 5 and plp[0][1] is None
+            for tid, lp, top in plp[1:]:
+                assert lp <= 0.0
+                assert top and len(top) == 8  # engine width; client clamps
+                # alternatives are probability-sorted
+                assert top[0][1] >= top[-1][1]
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_prompt_logprobs_with_prefix_cache_hit(run):
+    """A cached-prefix admission still scores the WHOLE prompt (the
+    scoring forward is independent of the suffix-prefill restart)."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [2] * 12  # 3 full blocks at page_size 4
+            await collect(engine, req(prompt, max_tokens=2))
+            # second admission reuses the registered prefix blocks
+            _, _, _, plp = await collect(
+                engine, req(prompt, max_tokens=2, prompt_logprobs=0)
+            )
+            assert plp is not None and len(plp) == len(prompt)
+            assert plp[0][1] is None
+            assert all(e[1] is not None for e in plp[1:])
+            assert all(e[2] is None for e in plp)  # top_n 0: no alternatives
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_echo_logprobs_completion_pipeline(model_dir, run):
+    """Full preprocessor pipeline: echo+logprobs returns the echoed prompt
+    chunk carrying the prompt-logprobs block (tokens/token_logprobs/
+    top_logprobs/text_offset), then the completion's own logprobs -- the
+    last ROADMAP-named scenario-breadth 400, now served."""
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import Tokenizer
+    from dynamo_tpu.protocols.openai import (
+        CompletionRequest,
+        aggregate_completion,
+    )
+    from dynamo_tpu.runtime.pipeline import link
+
+    async def body():
+        tok = Tokenizer.from_model_dir(model_dir)
+        engine = JaxEngine.random_init(
+            ModelConfig.tiny(vocab_size=512),
+            EngineConfig(max_batch_size=2, max_seq_len=64, page_size=4,
+                         num_pages=64),
+        )
+        pipeline = link(OpenAIPreprocessor("m", tok), Backend(tok), engine)
+        try:
+            parsed = CompletionRequest.from_dict(
+                {"model": "m", "prompt": "hello world", "max_tokens": 3,
+                 "temperature": 0, "echo": True, "logprobs": 2}
+            )
+            stream = await pipeline.generate(Context.new(parsed))
+            chunks = []
+            async for item in stream:
+                if isinstance(item, Annotated) and item.data is not None:
+                    chunks.append(item.data)
+            return aggregate_completion(chunks), len(tok.encode("hello world"))
+        finally:
+            await engine.stop()
+
+    body_out, n_prompt = run(body())
+    choice = body_out["choices"][0]
+    assert choice["text"].startswith("hello world")
+    lp = choice["logprobs"]
+    # prompt entries + 3 completion entries, aligned arrays
+    assert len(lp["tokens"]) == n_prompt + 3
+    assert lp["token_logprobs"][0] is None
+    assert all(v <= 0.0 for v in lp["token_logprobs"][1:])
+    assert lp["text_offset"][0] == 0
+    assert lp["text_offset"] == sorted(lp["text_offset"])
+    # prompt alternatives are string->logprob maps clamped to the request N
+    assert lp["top_logprobs"][0] is None
+    assert all(
+        t is None or len(t) <= 2 for t in lp["top_logprobs"]
+    )
+    assert "speculation" not in body_out.get("usage", {})
+
+
+def test_spec_usage_block_in_completion(model_dir, run):
+    """Per-choice acceptance stats surface in the OpenAI usage extension."""
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.tokenizer import Tokenizer
+    from dynamo_tpu.protocols.openai import (
+        CompletionRequest,
+        aggregate_completion,
+    )
+    from dynamo_tpu.runtime.pipeline import link
+
+    async def body():
+        tok = Tokenizer.from_model_dir(model_dir)
+        engine = JaxEngine.random_init(
+            ModelConfig.tiny(vocab_size=512),
+            EngineConfig(max_batch_size=2, max_seq_len=64, page_size=4,
+                         num_pages=64),
+        )
+        pipeline = link(OpenAIPreprocessor("m", tok), Backend(tok), engine)
+        try:
+            parsed = CompletionRequest.from_dict(
+                {"model": "m", "prompt": "hello world hello world",
+                 "max_tokens": 6, "temperature": 0,
+                 "speculation": {"num_draft_tokens": 4}}
+            )
+            stream = await pipeline.generate(Context.new(parsed))
+            chunks = []
+            async for item in stream:
+                if isinstance(item, Annotated) and item.data is not None:
+                    chunks.append(item.data)
+            return aggregate_completion(chunks)
+        finally:
+            await engine.stop()
+
+    out = run(body())
+    spec = out["usage"]["speculation"]
+    assert spec["drafter"] == "ngram"
+    assert spec["drafted_tokens"] >= 0
+    assert 0.0 <= spec["acceptance_rate"] <= 1.0
+    assert spec["accepted_tokens"] <= spec["drafted_tokens"]
+
+
+def test_spec_metrics_and_tracing(run):
+    """dynamo_spec_* metrics advance and the request span carries
+    spec_accept_rate."""
+    from dynamo_tpu.runtime import tracing
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    import jax
+
+    from dynamo_tpu.engine.model import init_params
+
+    async def body():
+        reg = MetricsRegistry()
+        engine = JaxEngine(
+            ModelConfig.tiny(),
+            init_params(ModelConfig.tiny(), jax.random.PRNGKey(0)),
+            EngineConfig(max_batch_size=4, max_seq_len=64, page_size=4,
+                         num_pages=64),
+            metrics_registry=reg,
+        )
+        tracing.collector.clear()
+        tracing.collector.enable()
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=8))
+            register_drafter(
+                "metrics-oracle", lambda: OracleDrafter(prompt + base)
+            )
+            rid = "spec-metrics-req"
+            stream = await engine.generate(
+                Context.new(
+                    req(prompt, max_tokens=8,
+                        spec=spec_opts(drafter="metrics-oracle")),
+                    rid,
+                )
+            )
+            async for _ in stream:
+                pass
+            assert reg.sample(
+                "dynamo_spec_drafted_tokens", {"drafter": "metrics-oracle"}
+            ) > 0
+            assert reg.sample(
+                "dynamo_spec_accepted_tokens", {"drafter": "metrics-oracle"}
+            ) > 0
+            assert reg.sample("dynamo_spec_verify_steps") > 0
+            assert reg.sample("dynamo_spec_accept_rate") > 0
+            spans = tracing.collector.get(rid)
+            spec_spans = [s for s in spans if s.name == "engine.spec"]
+            assert spec_spans, [s.name for s in spans]
+            assert spec_spans[0].attrs["spec_accept_rate"] > 0
+        finally:
+            tracing.collector.disable()
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_eos_mid_column(run):
+    """An EOS sampled inside an accepted column finishes the lane through
+    the same host stop-rule replay plain decode uses; the rest of the
+    column is discarded and no pages leak."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [1, 2, 3]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=8))
+            eos_tok = base[3]
+
+            def mk_req(spec=None):
+                r = req(prompt, max_tokens=8, spec=spec)
+                r.eos_token_ids = [eos_tok]
+                return r
+
+            b_eos, f1, _, _ = await collect(engine, mk_req())
+            register_drafter(
+                "eos-oracle", lambda: OracleDrafter(prompt + base)
+            )
+            s_eos, f2, _, _ = await collect(
+                engine, mk_req(spec=spec_opts(drafter="eos-oracle"))
+            )
+            assert b_eos == s_eos
+            assert f1 == f2 == "eos"
+            assert engine.kv.allocator.used_pages == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_composes_with_chunked_prefill(run):
+    """A speculating lane whose prompt prefills in chunks stays parked
+    until the final chunk commits, then verifies -- identical output."""
+
+    async def body():
+        engine = make_engine(prefill_chunk_tokens=8)
+        try:
+            prompt = list(range(1, 21))
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=10))
+            out, _, _, _ = await collect(
+                engine, req(prompt, max_tokens=10, spec=spec_opts())
+            )
+            assert out == base
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_max_draft_tokens_cap():
+    assert 1 <= MAX_DRAFT_TOKENS <= 8
