@@ -28,6 +28,8 @@ def build_engine(
     grow_chunk_pages: int = 4,
     host_offload_blocks: int = 0,
     swap_preemption: bool = True,
+    mixed_batching: bool = True,
+    mixed_token_budget: int = 512,
 ):
     """decode_block is the throughput/latency dial: 64 steps per host round
     trip is +20% decode tok/s on the tunneled bench chip (measured 1491 vs
@@ -60,6 +62,8 @@ def build_engine(
         grow_chunk_pages=grow_chunk_pages,
         host_offload_blocks=host_offload_blocks,
         swap_preemption=swap_preemption,
+        mixed_batching=mixed_batching,
+        mixed_token_budget=mixed_token_budget,
         seed=0,
     )
     return JaxEngine.random_init(model_cfg, cfg)
@@ -469,6 +473,198 @@ async def run_spec(rs) -> dict:
     return out
 
 
+async def run_prefill_under_decode_load(rs, build=build_engine) -> dict:
+    """Mixed-batching scenario (ISSUE 7): a steady bs8 decode batch with a
+    prefill arrival stream riding on top.
+
+    Three measured passes: (a) pure decode, no arrivals -- the ITL floor;
+    (b) decode + arrivals with mixed batching ON (arrivals pack into the
+    decode tick as ragged chunks of the unified dispatch); (c) the same
+    with mixed batching OFF (arrivals run as dedicated prefill dispatches
+    that stall the decode batch).  A fourth leg measures the dedicated
+    prefill path alone so prefill throughput under decode load has its
+    denominator.  Reported: ``pfload_itl_p99_ms_*`` (per-token arrival-gap
+    p99 over the decode lanes, per mode), ``pfload_prefill_tok_s`` vs
+    ``pfload_prefill_dedicated_tok_s``, and ``mixed_dispatch_ratio`` =
+    dispatches_s / decode_steps_s in the mixed window (~1 when every tick
+    is one unified dispatch; BENCH_r05's separate-dispatch engine sat at
+    ~1/32)."""
+    import numpy as np
+
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    bs, osl = 8, 48
+    pf_len, n_pf = 256, 6  # n_pf: dedicated-leg request count
+
+    def _req(tokens, max_tokens, ignore_eos=True):
+        return PreprocessedRequest(
+            token_ids=tokens,
+            stop_conditions=StopConditions(
+                max_tokens=max_tokens, ignore_eos=ignore_eos
+            ),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+
+    async def decode_lane(engine, prompt):
+        # (arrival time, tokens in the commit event): the legs deliver
+        # tokens in different event sizes (decode_block=4 commits 4 at a
+        # time, the unified dispatch 1), so per-token ITL must amortize
+        # each event gap over its tokens -- duplicating one stamp per
+        # token would dilute the blocked legs' p99 with zero gaps
+        stream = await engine.generate(Context.new(_req(prompt, osl)))
+        events = []
+        async for item in stream:
+            data = item.data or {}
+            n = len(data.get("token_ids") or [])
+            if n:
+                events.append((time.monotonic(), n))
+        return events
+
+    async def prefill_one(engine, prompt):
+        stream = await engine.generate(Context.new(_req(prompt, 1)))
+        async for _item in stream:
+            pass
+
+    async def run_mode(mixed, arrivals):
+        # slots beyond the decode batch so arrivals admit immediately
+        engine = build(
+            max_batch_size=16, num_pages=1024, decode_block=4,
+            mixed_batching=mixed,
+        )
+        try:
+            # warm/compile the decode path and the arrival shapes at load
+            # concurrency (4-wide bursts group-batch into a different
+            # executable than a lone prefill)
+            await asyncio.gather(
+                *[
+                    decode_lane(engine, rs.randint(1, 30000, (48,)).tolist())
+                    for _ in range(bs)
+                ],
+                *[
+                    prefill_one(
+                        engine, rs.randint(1, 30000, (pf_len,)).tolist()
+                    )
+                    for _ in range(4)
+                ],
+            )
+            d_prompts = [
+                rs.randint(1, 30000, (48,)).tolist() for _ in range(bs)
+            ]
+            steps0 = engine._steps
+            t0 = time.monotonic()
+            lanes = [
+                asyncio.ensure_future(decode_lane(engine, p))
+                for p in d_prompts
+            ]
+            # dispatch count at decode-window close: the post-window drain
+            # of in-flight arrivals must not pollute the ratio's numerator
+            steps_at_close = None
+
+            async def arrival_stream():
+                # saturating prefill pressure for the whole decode window
+                # (four in flight), so the mixed engine packs chunks into
+                # every tick and the ratio measures the steady state
+                nonlocal steps_at_close
+                done_tokens = 0
+                pt0 = time.monotonic()
+
+                async def one():
+                    nonlocal done_tokens
+                    await prefill_one(
+                        engine, rs.randint(1, 30000, (pf_len,)).tolist()
+                    )
+                    done_tokens += pf_len
+
+                inflight = {asyncio.ensure_future(one()) for _ in range(4)}
+                while not all(l.done() for l in lanes):
+                    fin, inflight = await asyncio.wait(
+                        inflight, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    for f in fin:
+                        f.result()
+                    while len(inflight) < 4:
+                        inflight.add(asyncio.ensure_future(one()))
+                window = time.monotonic() - pt0
+                steps_at_close = engine._steps
+                tokens_at_close = done_tokens
+                if inflight:
+                    await asyncio.gather(*inflight)
+                return tokens_at_close / window
+
+            pf_tok_s = await arrival_stream() if arrivals else None
+            lane_events = await asyncio.gather(*lanes)
+            elapsed = time.monotonic() - t0
+            dispatches = (
+                steps_at_close if steps_at_close is not None
+                else engine._steps
+            ) - steps0
+            gaps = [
+                (tb - ta) * 1000.0 / nb
+                for ev in lane_events
+                for (ta, _na), (tb, nb) in zip(ev, ev[1:])
+                for _ in range(nb)
+            ]
+            itl_p99 = float(np.percentile(gaps, 99)) if gaps else 0.0
+            n_tokens = sum(n for ev in lane_events for _t, n in ev)
+            decode_steps_s = n_tokens / bs / elapsed
+            return itl_p99, pf_tok_s, dispatches / elapsed / decode_steps_s
+        finally:
+            await engine.stop()
+
+    itl_idle, _, _ = await run_mode(mixed=True, arrivals=False)
+    itl_on, pf_on_tok_s, ratio = await run_mode(mixed=True, arrivals=True)
+    itl_off, pf_off_tok_s, _ = await run_mode(mixed=False, arrivals=True)
+
+    # dedicated-prefill denominator: the arrival stream alone, no decode,
+    # at the SAME concurrency (4 in flight) as the load legs -- the classic
+    # engine batches concurrent same-shape prefills into group dispatches,
+    # so a sequential leg would understate the path and mask regressions
+    engine = build(max_batch_size=16, num_pages=1024, decode_block=4,
+                   mixed_batching=False)
+    try:
+        # warm the burst shape AND the lone shape: a 4-wide burst
+        # compiles the grouped prefill executable, a straggler admitted
+        # on its own tick the single-prompt one
+        await asyncio.gather(
+            *[
+                prefill_one(engine, rs.randint(1, 30000, (pf_len,)).tolist())
+                for _ in range(4)
+            ]
+        )
+        await prefill_one(engine, rs.randint(1, 30000, (pf_len,)).tolist())
+        t0 = time.monotonic()
+        done = 0
+        while done < n_pf:
+            burst = min(4, n_pf - done)
+            await asyncio.gather(
+                *[
+                    prefill_one(
+                        engine, rs.randint(1, 30000, (pf_len,)).tolist()
+                    )
+                    for _ in range(burst)
+                ]
+            )
+            done += burst
+        pf_dedicated_tok_s = done * pf_len / (time.monotonic() - t0)
+    finally:
+        await engine.stop()
+
+    return {
+        "pfload_itl_p99_ms_idle": round(itl_idle, 2),
+        "pfload_itl_p99_ms_mixed_on": round(itl_on, 2),
+        "pfload_itl_p99_ms_mixed_off": round(itl_off, 2),
+        "pfload_prefill_tok_s": round(pf_on_tok_s, 1),
+        "pfload_prefill_off_tok_s": round(pf_off_tok_s, 1),
+        "pfload_prefill_dedicated_tok_s": round(pf_dedicated_tok_s, 1),
+        "mixed_dispatch_ratio": round(ratio, 3),
+    }
+
+
 async def best_of(n: int, run):
     """Best of ``n`` timed passes of ``run()`` (fresh-args coroutine
     factory): the tunneled chip's round-trip latency drifts with ambient
@@ -580,6 +776,7 @@ async def main():
     sweep = await run_decode_sweep(rs)
     mem_pressure = await run_mem_pressure(rs)
     spec = await run_spec(rs)
+    pf_load = await run_prefill_under_decode_load(rs)
     disagg_tok_s, _dev_stats = await run_disagg(rs, allow_local=True)
     disagg_wire_tok_s, wire_stats = await run_disagg(rs, allow_local=False)
 
@@ -615,6 +812,7 @@ async def main():
                 **sweep,
                 **mem_pressure,
                 **spec,
+                **pf_load,
                 **serving,
             }
         )

@@ -3,7 +3,7 @@
 The serving stack's hazard classes are mechanical -- a blocking call on an
 event loop, a silent ``except Exception`` around a KV transfer, a host
 sync on the tick loop -- so they are checked mechanically: six AST rules
-(DT001-DT006), inline ``# dynalint: disable=RULE`` suppressions, a
+(DT001-DT010), inline ``# dynalint: disable=RULE`` suppressions, a
 checked-in baseline for grandfathered findings, and a CLI
 (``python -m dynamo_tpu.analysis``) that tier-1 runs as a zero-violation
 gate.  Stdlib-only by design.

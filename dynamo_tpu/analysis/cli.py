@@ -29,7 +29,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dynamo_tpu.analysis",
         description="dynalint: AST hazard analysis for async/JAX hot paths "
-                    "(rules DT001-DT006)",
+                    "(rules DT001-DT010)",
     )
     p.add_argument(
         "paths", nargs="*",

@@ -39,8 +39,10 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
     # under jax.jit inside the tick loop's dispatch
     "dynamo_tpu/engine/step.py": [
         "decode_block",
+        "unified_step",
         "verify_and_sample",
         "score_prompt_step",
+        "prefill_step",
         "prefill_and_sample",
         "prefill_mm_and_sample",
         "prefill_suffix_and_sample",
@@ -59,9 +61,20 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
     # paged-attention kernels + the layer-page gather/scatter used by the
     # chunked KV delivery scatter on the tick loop
     "dynamo_tpu/ops/paged_attention.py": [
-        "paged_attention*",
+        "paged_decode_attention*",
         "gather_layer_pages",
         "scatter_layer_pages",
+    ],
+    # flash prefill kernels (full-prompt and prefix-suffix)
+    "dynamo_tpu/ops/flash_prefill.py": [
+        "flash_prefill_attention",
+        "flash_prefix_prefill_attention",
+    ],
+    # the unified mixed prefill+decode ragged kernel: the ONE attention
+    # call of step.unified_step, dispatched every tick under mixed
+    # batching (the *_xla reference is the same entry point's CPU path)
+    "dynamo_tpu/ops/ragged_attention.py": [
+        "ragged_paged_attention*",
     ],
     # offload-plane hot paths: the admission-time tier lookup runs on the
     # event loop and the host-ring put sits behind every eviction -- a

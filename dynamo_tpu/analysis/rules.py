@@ -1,4 +1,4 @@
-"""dynalint rules DT001-DT006: this repo's real async/JAX hazard classes.
+"""dynalint rules DT001-DT010: this repo's real async/JAX hazard classes.
 
 Each rule is deliberately narrow: it encodes a bug class this codebase has
 actually exhibited (blocking WAL I/O on the hub event loop, silent
@@ -930,6 +930,73 @@ class OffloadSyncTransfer(Rule):
 
 
 # ---------------------------------------------------------------------------
+# DT010: jitted step entry points missing from the hot-path manifest
+# ---------------------------------------------------------------------------
+
+
+class HotPathManifestDrift(Rule):
+    id = "DT010"
+    name = "hot-path-manifest-drift"
+    severity = "error"
+    description = (
+        "A jitted entry point in a step/kernel module (engine/step.py, "
+        "ops/*.py) is covered by neither an @hot_path decorator nor a "
+        "HOT_PATH_MANIFEST pattern.  DT004/DT005 scan exactly the marked "
+        "surface, so an unlisted jax.jit entry point silently loses "
+        "host-sync and recompile-hazard coverage -- manifest drift: the "
+        "kernel was added, the manifest was not.  (This class of drift is "
+        "real: the manifest carried a paged_attention* pattern that "
+        "matched nothing after a rename, dropping coverage of "
+        "paged_decode_attention_v2.)  Add the function to "
+        "HOT_PATH_MANIFEST or decorate it with @hot_path."
+    )
+
+    _JIT_NAMES = {"jax.jit", "jit"}
+    _PARTIALS = {"partial", "functools.partial"}
+
+    @classmethod
+    def _applies(cls, relpath: str) -> bool:
+        if relpath.endswith("engine/step.py"):
+            return True
+        head, _, fname = relpath.rpartition("/")
+        return fname.endswith(".py") and (
+            head == "ops" or head.endswith("/ops")
+        )
+
+    @classmethod
+    def _is_jitted(cls, fi: FunctionInfo) -> bool:
+        for dec in fi.node.decorator_list:
+            if dotted_name(dec) in cls._JIT_NAMES:
+                return True
+            if isinstance(dec, ast.Call):
+                d = dotted_name(dec.func)
+                if d in cls._JIT_NAMES:
+                    return True
+                if d in cls._PARTIALS and dec.args:
+                    if dotted_name(dec.args[0]) in cls._JIT_NAMES:
+                        return True
+        return False
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not self._applies(module.relpath):
+            return
+        for fi in collect_functions(module.tree):
+            if fi.qualname != fi.name:
+                continue  # entry points are module top-level
+            if not self._is_jitted(fi):
+                continue
+            if _is_hot(module, fi):
+                continue
+            yield self.finding(
+                module, fi.node,
+                f"jitted entry point {fi.name!r} is in neither "
+                "HOT_PATH_MANIFEST nor @hot_path-decorated: DT004/DT005 "
+                "will not scan it (manifest drift)",
+                fi.qualname,
+            )
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -943,6 +1010,7 @@ ALL_RULES: List[Rule] = [
     MetricsRegistryHygiene(),
     FireAndForgetTask(),
     OffloadSyncTransfer(),
+    HotPathManifestDrift(),
 ]
 
 

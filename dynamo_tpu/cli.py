@@ -54,6 +54,16 @@ def _add_engine_flags(p) -> None:
     p.add_argument("--prefill-chunk-tokens", type=int, default=None,
                    help="chunked prefill: split long prompts into chunks "
                         "of this many tokens, interleaved with decode")
+    p.add_argument("--no-mixed-batching", dest="mixed_batching",
+                   action="store_false", default=True,
+                   help="disable unified mixed prefill+decode dispatches "
+                        "(ragged paged attention); prefill and decode "
+                        "revert to separate launches per tick")
+    p.add_argument("--mixed-token-budget", type=int, default=None,
+                   help="fresh tokens per unified mixed-batch dispatch "
+                        "(decode lanes cost one each, the rest packs "
+                        "prefill chunks; env DYN_MIXED_TOKEN_BUDGET "
+                        "overrides)")
     p.add_argument("--host-offload-blocks", type=int, default=0,
                    help="G2 host-RAM KV offload capacity (blocks); 0 = off "
                         "(env DYN_KV_OFFLOAD arms/overrides the whole plane)")
@@ -358,12 +368,15 @@ async def _make_engine(args):
         block_size=args.block_size,
         decode_block_size=args.decode_block_size,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
+        mixed_batching=args.mixed_batching,
         host_offload_blocks=args.host_offload_blocks,
         disk_offload_blocks=args.disk_offload_blocks,
         disk_offload_dir=args.disk_offload_dir,
         swap_preemption=args.swap_preemption,
         quantize=args.quantize,
     )
+    if args.mixed_token_budget is not None:
+        cfg.mixed_token_budget = args.mixed_token_budget
     logger.info("loading %s ...", args.model_path)
     from .parallel.multihost import MultiNodeConfig, initialize_multihost
 

@@ -83,6 +83,58 @@ def decode_attention_dispatch(
     return paged_decode_attention(q, layer_kv, page_table, kv_lens, window)
 
 
+def _pallas_ragged_enabled(page_size: int, Hq: int, Hkv: int, D: int) -> bool:
+    """Trace-time choice of the ragged mixed-batch attention backend.
+
+    ``DYN_PALLAS_RAGGED=1/0`` forces it; default is auto -- on when the
+    backend is a TPU, the page size meets the kernel's sublane tiling
+    (>= 8), and the GQA group divides cleanly.  The XLA composition
+    (ops.ragged_attention.ragged_paged_attention_xla) stays as the
+    universal fallback and the tier-1 (CPU) code path."""
+    forced = _env_flag("DYN_PALLAS_RAGGED")
+    if forced is not None:
+        return forced
+    if page_size < 8 or Hq % Hkv or D % 8:
+        return False
+    return _on_tpu()
+
+
+@hot_path
+def ragged_attention_dispatch(
+    q: jax.Array,  # [B, S, Hq, D] ragged queries (lane b row i at base[b]+i)
+    k: jax.Array,  # [B, S, Hkv, D] fresh keys for the same columns
+    v: jax.Array,  # [B, S, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    layer: jax.Array,  # scalar i32
+    page_table: jax.Array,  # [B, P] (bucketed)
+    base: jax.Array,  # [B] committed cache length per lane
+    q_lens: jax.Array,  # [B] valid query rows (0 = inactive lane)
+    window: int = 0,
+) -> jax.Array:
+    """Ragged mixed prefill+decode attention over the paged pool: Pallas
+    page-streaming kernel on TPU, XLA gather + einsum elsewhere.  Resolved
+    at trace time (static), so each compiled executable embeds exactly one
+    backend -- the pattern every other dispatch gate here follows.  This
+    is the ONE attention call of ``step.unified_step``: a decode lane is a
+    1-row query, a chunked-prefill lane its chunk's rows, all causal at
+    token granularity against the resident prefix plus the dispatch's own
+    fresh columns."""
+    Hq, D = q.shape[2], q.shape[3]
+    Hkv = k.shape[2]
+    if _pallas_ragged_enabled(kv_pages.shape[3], Hq, Hkv, D):
+        from ..ops.ragged_attention import ragged_paged_attention
+
+        return ragged_paged_attention(
+            q, k, v, kv_pages, page_table, base, q_lens, layer, window,
+            group=4,
+        )
+    from ..ops.ragged_attention import ragged_paged_attention_xla
+
+    return ragged_paged_attention_xla(
+        q, k, v, kv_pages, page_table, base, q_lens, layer, window
+    )
+
+
 def _pallas_prefill_enabled(T: int, Hq: int, Hkv: int, D: int) -> bool:
     """Trace-time choice of the prefill-attention backend.
 

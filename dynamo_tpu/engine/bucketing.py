@@ -1,0 +1,59 @@
+"""Shape bucketing: the ONE home of every pow2/pad rule the engine uses.
+
+Every jitted dispatch absorbs request-shaped variability into a small,
+bounded set of static shapes so the XLA compile cache stays O(log) in the
+workload, never O(requests): prefill lengths bucket to powers of two,
+prefix/page-table widths bucket to powers of two, prefill group batches pad
+to powers of two, speculative draft columns pad to powers of two, and the
+mixed-batch ragged query axis buckets to powers of two.  These rules used
+to live scattered across ``step.py`` (length/page buckets), ``engine.py``
+(group-batch and draft-column pads) -- drift between them would mint
+surprise executables mid-serving, so they all route through here now.
+``step.py`` re-exports the length/page helpers for compatibility.
+
+Import-light on purpose (pure Python, no jax/numpy): the analyzer and the
+scheduler both import it.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def pow2_bucket(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor).
+
+    The universal pad rule: group batches (``engine._pad_batch``), draft
+    columns (spec verify), soft-prompt rows, penalty-history buffers, and
+    the mixed-batch ragged query axis all bucket through this, so each
+    site compiles O(log(max)) executables.
+    """
+    n = max(int(n), int(floor))
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def prefill_buckets(page_size: int, max_len: int) -> List[int]:
+    """Power-of-two length buckets, all multiples of page_size."""
+    max_len = -(-max_len // page_size) * page_size  # round up to a page multiple
+    buckets = []
+    b = page_size
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+def pick_bucket(buckets: List[int], n: int) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds max bucket {buckets[-1]}")
+
+
+def pick_page_bucket(n_pages: int, max_pages: int) -> int:
+    """Static width for page-table gathers: smallest power of two
+    >= n_pages (capped at max_pages), so compile-cache entries stay few."""
+    if n_pages > max_pages:
+        raise ValueError(f"{n_pages} prefix pages exceed max {max_pages}")
+    return min(pow2_bucket(n_pages), max_pages)

@@ -49,12 +49,14 @@ from .step import (
     update_lanes,
     pick_bucket,
     pick_page_bucket,
+    pow2_bucket,
     prefill_and_sample,
     prefill_buckets,
     prefill_suffix_and_sample,
     scatter_block_pages,
     scatter_layer_pages,
     slice_block_pages,
+    unified_step,
     verify_and_sample,
 )
 
@@ -120,8 +122,23 @@ class EngineConfig:
     # running requests interleave instead of stalling behind one long
     # prompt (the reference gets this from vLLM's chunked prefill; here
     # the suffix-prefill machinery restarts at any page-aligned offset).
-    # None = whole prompt in one dispatch.
+    # None = whole prompt in one dispatch.  Under mixed batching this also
+    # caps one lane's chunk inside a unified dispatch.
     prefill_chunk_tokens: Optional[int] = None
+    # mixed prefill+decode batching (Ragged Paged Attention, ROADMAP item
+    # 2): admitted prompts pack into the decode tick as ragged chunks
+    # served by ONE unified dispatch (step.unified_step), so prefill never
+    # stalls the decode batch behind a separate launch and TTFT/ITL stop
+    # trading off.  Output is bit-identical to the separate paths for
+    # greedy/seeded lanes.  ``--no-mixed-batching`` restores the classic
+    # separate-dispatch behavior exactly; penalized and multimodal
+    # requests always take the classic paths (the unified step carries no
+    # penalty histograms / soft-prompt injection).
+    mixed_batching: bool = True
+    # total fresh tokens per unified dispatch (decode lanes cost one each,
+    # the remainder packs prefill chunks); DYN_MIXED_TOKEN_BUDGET
+    # overrides at engine construction
+    mixed_token_budget: int = 512
     # sequence-hash prefix-cache reuse (block_manager.PagePool); requires
     # block_size to divide evenly into pages
     enable_prefix_caching: bool = True
@@ -183,6 +200,26 @@ class InflightPrefill:
     # echo+logprobs: packed [1, T, 2 + 2N] prompt-scoring handle (step.
     # score_prompt_step), materialized alongside the sampled row at commit
     prompt_lp: Any = None
+    dispatched_at: float = field(default_factory=time.perf_counter)
+
+
+@dataclass
+class InflightUnified:
+    """A dispatched-but-uncommitted unified mixed-batch step: one ragged
+    dispatch served every decode lane (one row each, device-resident
+    state) plus the tick's packed prefill chunks.  ``finals`` carries an
+    :class:`InflightPrefill` record per lane whose prompt completed this
+    dispatch (their sampled first token is already folded into the device
+    decode state by the step itself; the records back the pending-inject
+    re-apply path and the echo+logprobs ride-along).  Decode columns
+    commit through the block replay (K=1), final prefill columns through
+    the same path -- the raw matrix is the single source for both."""
+
+    sampled: Any  # packed [B, 2 + 2N]
+    slots: List[Optional[SeqState]]
+    finals: List[InflightPrefill]
+    n_decode: int = 0
+    n_prefill_tokens: int = 0
     dispatched_at: float = field(default_factory=time.perf_counter)
 
 
@@ -476,6 +513,22 @@ class JaxEngine:
             self._chunk_tokens = max(
                 ps_, -(-self.cfg.prefill_chunk_tokens // ps_) * ps_
             )
+        # mixed prefill+decode batching (unified ragged dispatch): the
+        # token budget caps one dispatch's fresh rows; DYN_MIXED_TOKEN_BUDGET
+        # overrides config so a deployment can retune without a restart flag
+        import os as _os
+
+        self._mixed = bool(self.cfg.mixed_batching)
+        budget = self.cfg.mixed_token_budget
+        env_budget = _os.environ.get("DYN_MIXED_TOKEN_BUDGET")
+        if env_budget:
+            try:
+                budget = int(env_budget)
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed DYN_MIXED_TOKEN_BUDGET=%r", env_budget
+                )
+        self._mixed_budget = max(int(budget), 1)
         self.buckets = prefill_buckets(self.cfg.page_size, self.cfg.max_seq_len)
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._queues: Dict[str, asyncio.Queue] = {}
@@ -1640,6 +1693,15 @@ class JaxEngine:
                                 ).inc()
                 self._revive_paused_lanes()
                 fresh: List[Any] = []
+                # mixed batching: admitted prompts pack into the decode
+                # tick as ragged chunks of ONE unified dispatch.  Penalized
+                # lanes force the classic tick (the unified step carries no
+                # penalty histograms); pending mixed prefills then drain
+                # through the classic chunk machinery (mixed chunk
+                # boundaries stay page-aligned for exactly this handoff).
+                mixed_ok = self._mixed_tick_ok()
+                if not mixed_ok and self.sched.mix_pending:
+                    self._drain_mixed_to_classic()
                 # advance chunked prefills: one chunk per seq per tick, so
                 # decode blocks interleave below instead of stalling behind
                 # one long prompt
@@ -1663,11 +1725,37 @@ class JaxEngine:
                 # batch plain prefills by compiled shape: a burst of N
                 # admissions costs one weight-streaming pass per shape
                 # group instead of N (chunked-prefill candidates go one at
-                # a time through _do_prefill)
+                # a time through _do_prefill; under mixed batching every
+                # text prompt routes to the unified plane instead)
                 groups: Dict[Tuple[int, int], List[Tuple[SeqState, int]]] = {}
+                # park every chunk-bound lane BEFORE any dispatch: the
+                # first sync of an admission burst can be a full device
+                # rebuild (from inside the first lane's prefill), and a
+                # lane not yet marked prefilling would be rebuilt ACTIVE
+                # with placeholder state -- the next decode block would
+                # then step it over a half-written cache and commit
+                # garbage as its output (a multi-lane chunked-admission
+                # corruption this ordering closes; test_mixed_batching
+                # asserts the chunked batch == solo)
+                for seq, prompt_len in plan.prefills:
+                    if (
+                        self._chunk_tokens is not None
+                        and prompt_len - seq.cached_prompt_tokens
+                        > self._chunk_tokens
+                        and seq.mm_embeds is None
+                    ):
+                        seq.prefilling = True
+                        seq.prefilled_tokens = seq.cached_prompt_tokens
                 for seq, prompt_len in plan.prefills:
                     if seq.slot < 0 or self.sched.slots[seq.slot] is not seq:
                         continue  # preempted by this tick's capacity pass
+                    if mixed_ok and seq.mm_embeds is None:
+                        # soft-prompt lanes keep the classic dispatch (the
+                        # unified step has no mm injection)
+                        self.sched.queue_mixed_prefill(
+                            seq, seq.cached_prompt_tokens
+                        )
+                        continue
                     cached = seq.cached_prompt_tokens
                     if (
                         self._chunk_tokens is not None
@@ -1694,7 +1782,25 @@ class JaxEngine:
                         self._ex, self._do_prefill_group, items
                     )
                     fresh.extend(pfs)
-                if self.sched.num_decode_runnable > 0:
+                chunks = (
+                    self.sched.form_mixed_chunks(
+                        self._mixed_budget, self._chunk_tokens
+                    )
+                    if mixed_ok
+                    else []
+                )
+                if chunks:
+                    # ONE dispatch serves the whole batch: every decode
+                    # lane rides alongside the packed prefill chunks
+                    ub = await loop.run_in_executor(
+                        self._ex, self._dispatch_unified, chunks
+                    )
+                    if ub is not None:
+                        fresh.append(ub)
+                elif (
+                    self.sched.num_decode_runnable > 0
+                    and self._has_steppable_lane(pending)
+                ):
                     blk = await loop.run_in_executor(self._ex, self._dispatch_block)
                     if blk is not None:
                         fresh.append(blk)
@@ -1733,6 +1839,7 @@ class JaxEngine:
                 pending = []
                 self._pending_injects.clear()
                 self._chunking = []
+                self.sched.mix_pending = []
                 self._fail_all(f"engine error: {e}")
                 self._dev = None  # full rebuild once work resumes
                 self.sched.dirty_slots.clear()
@@ -1754,6 +1861,64 @@ class JaxEngine:
                 and limits[b] > self._limit_host[b]
             ):
                 sched.dirty_slots.add(b)
+
+    def _mixed_tick_ok(self) -> bool:
+        """Whether this tick may run the unified mixed-batch dispatch.
+
+        Penalized lanes require the decode scan's device-resident penalty
+        histograms (and prompt-penalized first-token logits), which the
+        unified step deliberately does not carry -- one penalized lane in
+        the batch reverts the whole tick to the classic paths, exactly the
+        eligibility shape speculation uses (output is the contract, the
+        packing is an optimization)."""
+        if not self._mixed:
+            return False
+        return not any(
+            s is not None and self._seq_penalized(s) for s in self.sched.slots
+        )
+
+    def _drain_mixed_to_classic(self) -> None:
+        """Hand pending mixed prefills to the classic chunk machinery (a
+        penalized lane turned the tick classic).  Safe because non-final
+        mixed chunks always end page-aligned, which is the classic suffix
+        path's restart requirement."""
+        for seq in self.sched.mix_pending:
+            if (
+                seq.finish is None
+                and seq.slot >= 0
+                and self.sched.slots[seq.slot] is seq
+                and seq.prefilling
+                and seq not in self._chunking
+            ):
+                self._chunking.append(seq)
+        self.sched.mix_pending = []
+
+    def _has_steppable_lane(self, pending: List[Any]) -> bool:
+        """Whether any decode-runnable lane can still absorb a token once
+        the in-flight work lands -- the guard that skips the decode
+        dispatch on ticks that could only launch dead rows (e.g. the tail
+        tick after every lane's token budget went in-flight: the old loop
+        paid one wasted all-dead block per batch completion there)."""
+        inflight = 0
+        for e in pending:
+            if isinstance(e, InflightBlock):
+                inflight += self.cfg.decode_block_size
+            elif isinstance(e, InflightUnified):
+                inflight += 1
+        sched = self.sched
+        limits = self._compute_limits()
+        for b, s in enumerate(sched.slots):
+            if (
+                s is None
+                or s.finish is not None
+                or s.awaiting_kv
+                or s.prefilling
+                or s.spec is not None
+            ):
+                continue
+            if int(limits[b]) > int(sched.seq_lens[b]) + inflight:
+                return True
+        return False
 
     def _handle_stalled_admission(self) -> None:
         """Nothing running, nothing admitted: requests whose prompts can never
@@ -1914,7 +2079,7 @@ class JaxEngine:
     def _pad_batch(n: int) -> int:
         """Pad a prefill group to a power-of-two batch so group size does
         not multiply compile-cache entries (dead rows write trash page 0)."""
-        return 1 << max(n - 1, 0).bit_length()
+        return pow2_bucket(n)
 
     def _dispatch_full_prefill_batch(
         self, items: List[Tuple[SeqState, List[int], List[int]]], Bp: int
@@ -1984,7 +2149,7 @@ class JaxEngine:
             0 if s is None or s.mm_embeds is None else len(s.mm_embeds)
             for s in seqs
         ]
-        M = 1 << max(max(mm_lens) - 1, 0).bit_length()  # >= 1, power of two
+        M = pow2_bucket(max(mm_lens))  # >= 1, power of two
         mm = np.zeros((Bp, M, H), np.float32)
         mml = np.zeros((Bp,), np.int32)
         for i, s in enumerate(seqs):
@@ -2176,8 +2341,11 @@ class JaxEngine:
         prompt_len = len(seq.prompt)
         start = seq.prefilled_tokens
         chunk = self._chunk_tokens
-        assert chunk is not None
-        if prompt_len - start <= chunk:
+        # chunk is None when a lane reaches here via _drain_mixed_to_classic
+        # with chunking unconfigured: the rest of the prompt is one final
+        # suffix dispatch (mixed chunk boundaries are page-aligned, which
+        # is all the suffix restart requires)
+        if chunk is None or prompt_len - start <= chunk:
             seq.prefilling = False
             pf = self._finish_prefill(seq, prompt_len, start)
             self.sched.dirty_slots.add(seq.slot)
@@ -2211,6 +2379,7 @@ class JaxEngine:
         )
         seq.prefilled_tokens = start + suffix_len
         self._steps += 1
+        self.obs.observe_dispatch("chunk")
         logger.debug(
             "prefill chunk id=%s %d..%d/%d", seq.request_id, start,
             seq.prefilled_tokens, prompt_len,
@@ -2251,6 +2420,7 @@ class JaxEngine:
                 jnp.asarray([seq.slot], jnp.int32), tok,
             )
         self._steps += 1
+        self.obs.observe_dispatch("prefill")
         if tracing.collector.enabled:
             with tracing.span(
                 "engine.prefill_dispatch", seq.request_id
@@ -2338,6 +2508,7 @@ class JaxEngine:
             )
             entries.append(pf)
         self._steps += 1
+        self.obs.observe_dispatch("prefill")
         _start_host_copy(sampled)
         # ONE group handle: commit fetches the [Bp] array in one transfer
         # instead of one round trip per lane's [1] slice
@@ -2505,7 +2676,7 @@ class JaxEngine:
                 toks, amts = self._penalty_history(seq)
                 if not toks:
                     continue
-                pad = 1 << max(len(toks) - 1, 0).bit_length()
+                pad = pow2_bucket(len(toks))
                 buf = np.zeros((pad,), np.int32)
                 amounts = np.zeros((pad,), np.int32)
                 buf[: len(toks)] = toks
@@ -2766,8 +2937,170 @@ class JaxEngine:
         if use_penalties:
             d["counts"] = counts_out
         self._steps += 1
+        self.obs.observe_dispatch("decode_block")
         _start_host_copy(sampled)
         return InflightBlock(sampled=sampled, slots=list(self.sched.slots))
+
+    @hot_path
+    def _dispatch_unified(
+        self, chunks: List[Any]
+    ) -> Optional["InflightUnified"]:
+        """Enqueue one unified ragged mixed-batch step (executor thread).
+
+        Every decode lane contributes one query row read from the
+        device-resident state (so unified steps pipeline exactly like
+        decode blocks: dispatch i+1 goes out before step i's tokens
+        materialize), and each :class:`~.scheduler.MixedChunk` contributes
+        its prompt rows.  Final chunks sample the lane's first token on
+        device and fold it into the decode state -- the unified analog of
+        ``inject_token`` -- with an :class:`InflightPrefill` record minted
+        for the pending-inject re-apply path and the echo+logprobs
+        ride-along.  Host chunk bookkeeping advances at dispatch, exactly
+        like ``_dispatch_chunk``, so next tick's formation never re-packs
+        dispatched tokens.
+        """
+        from ..runtime import tracing
+
+        sched = self.sched
+        for ch in chunks:
+            seq = ch.seq
+            if seq.pending_onboard:
+                end = ch.start + ch.length
+                self._apply_onboards(seq)
+                if seq.cached_prompt_tokens < ch.start:
+                    # onboard truncated (chaos/IO): the would-have-been-
+                    # onboarded span must be recomputed, so widen this
+                    # chunk back to the surviving cached prefix -- the
+                    # classic path gets this ordering for free because it
+                    # reads the start AFTER _apply_onboards
+                    ch.start = seq.cached_prompt_tokens
+                    ch.length = end - ch.start
+                    ch.seq.prefilled_tokens = ch.start
+            if not seq.stats_counted:
+                seq.stats_counted = True
+                self._prefix_lookups += len(seq.prompt)
+                self._prefix_hits += seq.cached_prompt_tokens
+                self.obs.prefix_lookups.inc(len(seq.prompt))
+                if seq.cached_prompt_tokens:
+                    self.obs.prefix_hits.inc(seq.cached_prompt_tokens)
+        B = self.cfg.max_batch_size
+        # ragged query axis buckets to a power of two (the draft-column /
+        # group-batch pad rule), so arrival patterns cannot mint surprise
+        # executables mid-serving
+        S = pow2_bucket(max((ch.length for ch in chunks), default=1))
+        p_tokens = np.zeros((B, S), np.int32)
+        p_start = np.zeros((B,), np.int32)
+        p_lens = np.zeros((B,), np.int32)
+        p_sample = np.zeros((B,), bool)
+        p_act = np.zeros((B,), bool)
+        n_pf_tokens = 0
+        final_chunks: List[Any] = []
+        for ch in chunks:
+            b = ch.seq.slot
+            p_tokens[b, : ch.length] = ch.seq.prompt[
+                ch.start : ch.start + ch.length
+            ]
+            p_start[b] = ch.start
+            p_lens[b] = ch.length
+            p_sample[b] = ch.final
+            # speculating lanes sample their first token here but stay
+            # device-inactive: they advance via verify dispatches, and a
+            # device-activated spec lane would be decoded TWICE
+            p_act[b] = ch.final and ch.seq.spec is None
+            n_pf_tokens += ch.length
+            # dispatch-ordered host bookkeeping (the _dispatch_chunk rule)
+            ch.seq.prefilled_tokens = ch.start + ch.length
+            if ch.final:
+                ch.seq.prefilling = False
+                final_chunks.append(ch)
+        self._sync_device_state()
+        d = self._dev
+        Pb = self._live_page_bucket()
+        n_decode = sum(
+            1
+            for b, s in enumerate(sched.slots)
+            if s is not None
+            and p_lens[b] == 0
+            and s.finish is None
+            and not s.awaiting_kv
+            and not s.prefilling
+            and s.spec is None
+        )
+        use_filters = any(
+            s is not None and self._sampling_needs_filters(s.sampling)
+            for s in sched.slots
+        )
+        top_n = self._lp_top(sched.slots)
+        (
+            packed,
+            d["tokens"],
+            d["seq_lens"],
+            d["active"],
+            self.kv.pages,
+            self._rng,
+        ) = unified_step(
+            self.params,
+            self.model_cfg,
+            self.kv.pages,
+            d["tokens"],
+            d["seq_lens"],
+            d["limit_lens"],
+            d["active"],
+            d["stop_ids"],
+            d["page_table"][:, :Pb],
+            self._put_batch(p_tokens),
+            self._put_batch(p_start),
+            self._put_batch(p_lens),
+            self._put_batch(p_sample),
+            self._put_batch(p_act),
+            self._rng,
+            d["sampling"],
+            top_n,
+            use_filters,
+        )
+        finals: List[InflightPrefill] = []
+        for ch in final_chunks:
+            seq = ch.seq
+            b = seq.slot
+            pf = InflightPrefill(
+                sampled=packed[b : b + 1],
+                tok=packed[b : b + 1, 0],
+                seq=seq,
+                slot=b,
+            )
+            if (
+                seq.prompt_logprobs is not None
+                and not seq.prompt_lp_sent
+                and seq.prior_generated == 0
+            ):
+                pf.prompt_lp = self._dispatch_prompt_score(seq)
+            self._pending_injects[b] = pf
+            finals.append(pf)
+            if tracing.collector.enabled:
+                with tracing.span(
+                    "engine.prefill_dispatch", seq.request_id
+                ) as sp:
+                    sp.set(
+                        prompt_len=len(seq.prompt),
+                        cached=seq.cached_prompt_tokens,
+                        mixed=True,
+                    )
+        self._steps += 1
+        self.obs.observe_dispatch("unified")
+        self.obs.observe_mixed(n_decode, n_pf_tokens)
+        _start_host_copy(packed)
+        logger.debug(
+            "unified dispatch: %d decode lanes + %d prefill tokens "
+            "(%d chunks, %d final) S=%d",
+            n_decode, n_pf_tokens, len(chunks), len(finals), S,
+        )
+        return InflightUnified(
+            sampled=packed,
+            slots=list(sched.slots),
+            finals=finals,
+            n_decode=n_decode,
+            n_prefill_tokens=n_pf_tokens,
+        )
 
     # -- speculative decoding (spec/: draft on host, verify in one pass) ----
 
@@ -2839,7 +3172,7 @@ class JaxEngine:
         B = self.cfg.max_batch_size
         # pad the draft axis to a power of two so compile-cache entries
         # stay at {1, 1+1, 1+2, 1+4, 1+8} columns
-        Dp = 0 if max_d == 0 else 1 << (max_d - 1).bit_length()
+        Dp = 0 if max_d == 0 else pow2_bucket(max_d)
         S = 1 + Dp
         tokens = np.zeros((B, S), np.int32)
         base_arr = np.zeros((B,), np.int32)
@@ -2874,6 +3207,7 @@ class JaxEngine:
             use_filters,
         )
         self._steps += 1
+        self.obs.observe_dispatch("verify")
         self.spec_metrics.draft_latency.observe(max(draft_s, 0.0))
         _start_host_copy(sampled)
         return InflightVerify(sampled=sampled, lanes=lanes)
@@ -2899,6 +3233,7 @@ class JaxEngine:
             self._put_batch(lens),
             8 if seq.prompt_logprobs else 0,
         )
+        self.obs.observe_dispatch("prompt_score")
         _start_host_copy(out)
         return out
 
@@ -3218,6 +3553,8 @@ class JaxEngine:
             pfs = (
                 e.entries
                 if isinstance(e, InflightPrefillGroup)
+                else e.finals
+                if isinstance(e, InflightUnified)
                 else [e] if isinstance(e, InflightPrefill) else []
             )
             for pf in pfs:
@@ -3352,6 +3689,47 @@ class JaxEngine:
             elif isinstance(e, InflightPrefill):
                 commit_prefill(e, mat[0])
                 self.obs.observe_step("prefill", now - e.dispatched_at)
+            elif isinstance(e, InflightUnified):
+                # mat: packed [B, 2 + 2N] -- decode columns AND final
+                # prefill columns commit through the same K=1 block
+                # replay, so the stop rules cannot diverge between the
+                # lanes of one dispatch
+                N = (mat.shape[-1] - 2) // 2
+                toks, lps, tids, tlps = unpack_sampled_logprobs(mat, N)
+                final_slots = {pf.slot: pf for pf in e.finals}
+                for pf in e.finals:
+                    if self._pending_injects.get(pf.slot) is pf:
+                        del self._pending_injects[pf.slot]
+                unified_events = self.sched.commit_block(
+                    toks[:, None], e.slots, lps[:, None],
+                    tids[:, None] if N else None,
+                    tlps[:, None] if N else None,
+                )
+                for ev in unified_events:
+                    # slot-keyed (commit events only fire for lanes still
+                    # resident, so ev.seq.slot is its dispatch-time lane);
+                    # the identity guard covers slot reuse after preempt
+                    pf = final_slots.get(ev.seq.slot)
+                    if pf is None or pf.seq is not ev.seq:
+                        continue
+                    seq = pf.seq
+                    if seq.prior_generated > 0:
+                        # this dispatch completed a recompute-preempted
+                        # lane's re-prefill: pure resume work
+                        self.resume_prefill_tokens += (
+                            len(seq.prompt) - seq.cached_prompt_tokens
+                        )
+                        self.resume_prefill_seconds += max(
+                            now - e.dispatched_at, 0.0
+                        )
+                    plp = lp_mats.get(id(pf))
+                    if plp is not None and not seq.prompt_lp_sent:
+                        ev.prompt_logprobs = self._prompt_lp_entries(
+                            seq, plp[0]
+                        )
+                        seq.prompt_lp_sent = True
+                events.extend(unified_events)
+                self.obs.observe_step("unified", now - e.dispatched_at)
             elif isinstance(e, InflightVerify):
                 commit_verify(e, mat)
                 self.obs.observe_step("verify", now - e.dispatched_at)

@@ -139,8 +139,18 @@ class TickPlan:
 
     # prompts to prefill: (seq, bucket_len) -- each is one prefill dispatch
     prefills: List[Tuple[SeqState, int]] = field(default_factory=list)
-    # whether a decode step over the active batch should run
-    run_decode: bool = False
+
+
+@dataclass
+class MixedChunk:
+    """One lane's contribution of prompt tokens to a unified mixed-batch
+    dispatch: ``final`` means the chunk completes the prompt, so the
+    dispatch samples the lane's first token."""
+
+    seq: SeqState
+    start: int  # first prompt position this chunk covers
+    length: int  # tokens in the chunk
+    final: bool
 
 
 @dataclass
@@ -204,6 +214,10 @@ class Scheduler:
         self.max_pages = cfg.max_seq_len // cfg.page_size
         self.waiting: Deque[SeqState] = collections.deque()
         self.slots: List[Optional[SeqState]] = [None] * B
+        # slotted lanes whose prompt KV the mixed-batch plane still owes
+        # (unified ragged dispatches pack their chunks; see
+        # form_mixed_chunks)
+        self.mix_pending: List[SeqState] = []
         # numpy mirrors of the device batch arrays
         self.tokens = np.zeros((B,), np.int32)
         self.seq_lens = np.zeros((B,), np.int32)
@@ -340,10 +354,100 @@ class Scheduler:
                 plan.prefills.append((seq, len(seq.prompt)))
             # awaiting_kv lanes hold their pages and stay device-inactive
             # until the remote prefill delivers (engine.deliver_external)
-        plan.run_decode = self.num_runnable > 0
+        # decode dispatch gating lives in the engine tick loop, keyed on
+        # num_decode_runnable AFTER this tick's lane parking: a tick whose
+        # slots hold only parked / mid-prefill / speculating lanes must
+        # not pay a device dispatch for dead rows
         if self.metrics is not None:
             self.metrics.observe_sched(len(self.waiting), self.num_active)
         return plan
+
+    # -- mixed-batch formation (unified ragged dispatch) ---------------------
+
+    def queue_mixed_prefill(self, seq: SeqState, start: int) -> None:
+        """Hand an admitted (slotted) prompt to the mixed-batch plane: the
+        lane parks ``prefilling`` (decode-inactive) and its prompt tokens
+        are packed into unified dispatches chunk by chunk, FIFO across
+        lanes, under the per-dispatch token budget."""
+        seq.prefilling = True
+        seq.prefilled_tokens = start
+        # a re-admitted (preemption-recomputed) lane may still have a stale
+        # entry from its previous life; one entry per seq keeps one chunk
+        # per lane per dispatch
+        if seq not in self.mix_pending:
+            self.mix_pending.append(seq)
+
+    def form_mixed_chunks(
+        self, budget: int, chunk_cap: Optional[int] = None
+    ) -> List[MixedChunk]:
+        """Pack pending prefill work into this tick's unified dispatch.
+
+        ``budget`` is the dispatch's total fresh-token budget
+        (``DYN_MIXED_TOKEN_BUDGET``): every decode-runnable lane costs one
+        token, the remainder goes to prefill chunks in arrival order.  At
+        least one prompt token always packs when prefill work is pending,
+        so a decode batch as wide as the budget can never starve
+        admission.  ``chunk_cap`` bounds one lane's chunk (the
+        ``prefill_chunk_tokens`` knob); chunk lengths are otherwise ragged
+        -- the dispatch pads the query axis to a pow2 bucket, so the
+        executable-shape set stays O(log(budget)) no matter the arrival
+        pattern (tested in test_mixed_batching).
+
+        Non-final chunk boundaries are rounded DOWN to a page multiple:
+        a drained lane (``_drain_mixed_to_classic``) resumes through the
+        classic suffix machinery, whose prefix page table covers whole
+        pages only -- a mid-page boundary would leave the partial page's
+        keys unreachable on restart.  Starts stay aligned by induction
+        (admission starts at the page-aligned prefix-cache boundary).
+        When alignment rounds the head lane's chunk to zero, one full
+        page packs anyway (slight budget overshoot beats starvation).
+        """
+        ps = self.cfg.page_size
+        left = max(budget - self.num_decode_runnable, 1)
+        chunks: List[MixedChunk] = []
+        still: List[SeqState] = []
+        seen: set = set()
+        for seq in self.mix_pending:
+            if (
+                seq.finish is not None
+                or seq.slot < 0
+                or self.slots[seq.slot] is not seq
+                or not seq.prefilling
+                or id(seq) in seen
+            ):
+                continue  # cancelled / preempted mid-prefill / dup: drop
+            seen.add(id(seq))
+            remaining = len(seq.prompt) - seq.prefilled_tokens
+            if remaining <= 0:  # defensive; final chunk clears prefilling
+                seq.prefilling = False
+                self.dirty_slots.add(seq.slot)
+                continue
+            take = min(remaining, left) if left > 0 else 0
+            if chunk_cap is not None:
+                take = min(take, chunk_cap)
+            if take < remaining:
+                # non-final: keep the boundary page-aligned for the
+                # classic-path handoff (start is aligned by induction)
+                take = (seq.prefilled_tokens + take) // ps * ps \
+                    - seq.prefilled_tokens
+                if take <= 0 and not chunks:
+                    take = min(ps, remaining)
+            if take > 0:
+                chunks.append(
+                    MixedChunk(
+                        seq=seq,
+                        start=seq.prefilled_tokens,
+                        length=take,
+                        final=(take == remaining),
+                    )
+                )
+                left -= take
+                if take < remaining:
+                    still.append(seq)
+            else:
+                still.append(seq)
+        self.mix_pending = still
+        return chunks
 
     def _match_prefix(self, seq: SeqState) -> List[int]:
         """Acquire the longest resident prefix of the prompt's blocks; returns
@@ -650,6 +754,13 @@ class Scheduler:
         for b in range(B):
             seq = slots_at_entry[b]
             if seq is None or seq.finish is not None or seq.slot != b:
+                continue
+            if seq.prefilling or seq.awaiting_kv:
+                # a parked lane's column is placeholder garbage by
+                # construction (the lane is device-inactive, rows are -1);
+                # a lane re-parked since the dispatch (preempt + re-admit
+                # into the same slot) must not have stale columns
+                # attributed to its new life
                 continue
             ev = self._commit_lane_column(
                 seq, sampled[b],

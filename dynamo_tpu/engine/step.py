@@ -293,6 +293,116 @@ def verify_and_sample(
     return jnp.stack(cols, axis=1), kv_pages
 
 
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "top_n", "use_filters"),
+    donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
+)
+def unified_step(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,
+    tokens: jax.Array,  # [B] device-resident last committed token per lane
+    seq_lens: jax.Array,  # [B] cache length (next decode write position)
+    limit_lens: jax.Array,  # [B] cache length at which a lane must stop
+    active: jax.Array,  # [B] bool: decode lanes the scan would step
+    stop_ids: jax.Array,  # [B, E] device-checked stop tokens (-1 = pad)
+    page_table: jax.Array,  # [B, P] (bucketed)
+    p_tokens: jax.Array,  # [B, S] prefill chunk tokens (0 on decode lanes)
+    p_start: jax.Array,  # [B] chunk start position (prefilled so far)
+    p_lens: jax.Array,  # [B] chunk length; 0 = decode (or idle) lane
+    p_sample: jax.Array,  # [B] bool: final chunk -> sample first token
+    p_activate: jax.Array,  # [B] bool: final chunk also joins the decode
+    # batch (False for speculating lanes, which stay device-inactive and
+    # advance via verify dispatches)
+    rng: jax.Array,
+    sampling: SamplingParams,
+    top_n: int = 0,
+    use_filters: bool = True,
+) -> Tuple[jax.Array, ...]:
+    """ONE ragged mixed prefill+decode dispatch over the whole batch.
+
+    The continuous-batching step (ROADMAP item 2, *Ragged Paged Attention*):
+    decode lanes contribute one query row (their last committed token, read
+    from the device-resident ``tokens`` vector so steps pipeline without a
+    host round trip), chunked-prefill lanes contribute their chunk's rows --
+    all in one ``[B, S]`` ragged block served by a single attention dispatch
+    per layer, so an admitted prompt never stalls the decode batch behind a
+    separate prefill launch.
+
+    Per-lane geometry: row ``j`` of lane ``b`` sits at absolute position
+    ``base[b] + j`` where ``base`` is ``p_start`` for prefill lanes and
+    ``seq_lens`` for decode lanes; KV scatters through ``write_spec_kv``
+    (token-granular, invalid rows to trash page 0) and attention through
+    ``ragged_attention_dispatch`` (resident prefix ``< base`` + causal
+    fresh block).  Sampling keys positions exactly like the paths it
+    replaces -- ``base + q_len`` is ``seq_lens + 1`` for a decode lane
+    (the decode-scan identity) and the prompt length for a final prefill
+    chunk (the prefill-sample identity) -- so greedy and seeded lanes are
+    bit-identical to the separate-dispatch paths.
+
+    Decode lanes replay ``decode_block``'s one-step update on device
+    (stop-token swallow, limit deactivation) so the next pipelined unified
+    dispatch sees consistent state; final-chunk prefill lanes fold their
+    sampled first token into the decode state the way ``inject_token``
+    would.  Intermediate chunks write KV only.  The host replay at commit
+    stays authoritative for all stop rules.
+
+    Returns ``(packed [B, 2 + 2*top_n], tokens, seq_lens, active,
+    kv_pages, rng)``: packed rows carry (raw token | logprob | tops); the
+    token is ``-1`` for lanes that sampled nothing (idle, mid-chunk).
+    """
+    B, S = p_tokens.shape
+    is_pf = p_lens > 0
+    q_lens = jnp.where(is_pf, p_lens, active.astype(jnp.int32))
+    base = jnp.where(is_pf, p_start, seq_lens).astype(jnp.int32)
+    # decode lanes: row 0 carries the device-resident last token
+    col0 = jnp.where(is_pf, p_tokens[:, 0], tokens)
+    toks2d = p_tokens.at[:, 0].set(col0)
+    positions = base[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+
+    def attn_fn(q, k, v, kv, layer):
+        out = att.ragged_attention_dispatch(
+            q, k, v, kv, layer, page_table, base, q_lens,
+            cfg.sliding_window or 0,
+        )
+        new_kv = att.write_spec_kv(kv, k, v, page_table, base, q_lens, layer)
+        return out, new_kv
+
+    hidden, kv_pages = transformer(
+        params, cfg, toks2d, positions, kv_pages, attn_fn
+    )
+    last = jnp.clip(q_lens - 1, 0, S - 1)
+    hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
+    logits = lm_logits(params, cfg, hidden_last)  # [B, V]
+    rng, sub = jax.random.split(rng)
+    sampled = sample_tokens(
+        logits, sub, sampling, use_filters, positions=base + q_lens
+    )
+    lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
+    # device bookkeeping, mirroring decode_block's live_step for decode
+    # lanes and the inject path for final-chunk lanes (host replay at
+    # commit re-derives the authoritative stop reason from ``packed``).
+    # A final chunk hands the lane to decode with the SAME state the
+    # classic path's admission mirror + inject would produce: cache length
+    # = prompt length (the sampled token's KV lands at exactly that
+    # position on the next decode step), last token = the sample.
+    final_pf = is_pf & p_sample
+    live = active | final_pf
+    hit_stop = jnp.any(sampled[:, None] == stop_ids, axis=1)
+    emit = live & ~hit_stop
+    new_seq = jnp.where(
+        final_pf,
+        p_start + p_lens,
+        seq_lens + (emit & ~is_pf).astype(jnp.int32),
+    )
+    new_active = emit & (new_seq < limit_lens) & (~final_pf | p_activate)
+    new_tokens = jnp.where(emit, sampled, tokens)
+    out = jnp.where(live, sampled, -1)
+    packed = pack_sampled_logprobs(out, lp, top_ids, top_lps)
+    return packed, new_tokens, new_seq, new_active, kv_pages, rng
+
+
 @partial(jax.jit, static_argnames=("cfg", "top_n"))
 def score_prompt_step(
     params: Params,
@@ -687,32 +797,11 @@ from ..ops.paged_attention import (  # noqa: E402,F401
     scatter_layer_pages,
 )
 
-
-def prefill_buckets(page_size: int, max_len: int) -> list:
-    """Power-of-two length buckets, all multiples of page_size."""
-    max_len = -(-max_len // page_size) * page_size  # round up to a page multiple
-    buckets = []
-    b = page_size
-    while b < max_len:
-        buckets.append(b)
-        b *= 2
-    buckets.append(max_len)
-    return buckets
-
-
-def pick_bucket(buckets: list, n: int) -> int:
-    for b in buckets:
-        if n <= b:
-            return b
-    raise ValueError(f"prompt length {n} exceeds max bucket {buckets[-1]}")
-
-
-def pick_page_bucket(n_pages: int, max_pages: int) -> int:
-    """Static width for the prefix page gather: smallest power of two
-    >= n_pages (capped at max_pages), so compile-cache entries stay few."""
-    if n_pages > max_pages:
-        raise ValueError(f"{n_pages} prefix pages exceed max {max_pages}")
-    b = 1
-    while b < n_pages:
-        b *= 2
-    return min(b, max_pages)
+# Shape bucketing lives in engine/bucketing.py (the ONE home of every
+# pow2/pad rule); re-exported here for the existing import sites.
+from .bucketing import (  # noqa: E402,F401
+    pick_bucket,
+    pick_page_bucket,
+    pow2_bucket,
+    prefill_buckets,
+)

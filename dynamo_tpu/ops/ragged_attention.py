@@ -1,0 +1,294 @@
+"""Pallas ragged paged-attention kernel (TPU): one dispatch for a mixed
+prefill+decode batch.
+
+The serving gap this closes (ROADMAP item 2, *Ragged Paged Attention* in
+PAPERS.md): prefill and decode used to run as separate XLA dispatches that
+alternate on the chip, so every admitted prompt stalled the decode batch
+and TTFT traded off against ITL.  This kernel takes **ragged per-sequence
+query lengths** over the existing paged KV layout -- a decode lane
+contributes one query row, a chunked-prefill lane contributes its chunk --
+and serves the whole batch in one launch.
+
+Geometry: lane ``b``'s query row ``i`` sits at absolute position
+``base[b] + i`` (``base`` = committed cache length, exactly the
+``write_spec_kv`` convention); rows at ``i >= q_lens[b]`` are ragged
+padding whose output is garbage the host never reads (their KV writes
+route to trash page 0, the engine-wide invalid-row convention).  Keys come
+from two places:
+
+* the **resident prefix** -- positions ``< base[b]`` streamed from the
+  paged pool HBM->VMEM page-group by page-group (grid ``(B, P/G + 1)``,
+  the decode-v2 group-fetch pattern: the page table rides as scalar
+  prefetch and each grid step fetches ``G`` pages as independently
+  pipelined block operands);
+* the **fresh block** -- this dispatch's own K/V columns, attended
+  causally among themselves at token granularity (``kpos <= qpos``) in
+  the final grid step.
+
+Softmax is the standard flash-style online max/sum rescale in f32 VMEM
+scratch, shared across both phases, so KV is read from HBM exactly once
+and nothing is written back but the ``[B, S, Hq, D]`` output.
+
+``interpret=True`` runs the same kernel through the Pallas interpreter
+(CPU-testable); :func:`ragged_paged_attention_xla` is the pure-XLA
+reference implementation -- tier-1 (``JAX_PLATFORMS=cpu``) exercises the
+XLA composition via ``engine.attention.ragged_attention_dispatch``, which
+resolves the backend at trace time like every other dispatch gate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _ragged_kernel(
+    # scalar prefetch
+    layer_ref,  # [1] layer index (SMEM)
+    pt_ref,  # [B, P] page table (SMEM)
+    base_ref,  # [B] committed cache length = first fresh position (SMEM)
+    len_ref,  # [B] fresh query rows per lane (SMEM)
+    *refs,  # G kv blocks [1, 2, 1, page, Hkv, D], q, fresh k, fresh v,
+    # then o_ref and m/l/acc scratch
+    G: int,
+    window: int = 0,
+):
+    """Grid (B, P/G + 1): steps ``p < P/G`` stream the lane's resident
+    prefix page groups, the final step folds in the dispatch's own fresh
+    K/V block with per-token causal masking.  One online-softmax
+    accumulator serves both phases, so the rescale math cannot diverge
+    between the prefix and fresh halves."""
+    kv_refs = refs[:G]
+    q_ref, fk_ref, fv_ref, o_ref, m_scr, l_scr, acc_scr = refs[G:]
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    npg = pl.num_programs(1) - 1  # page-group steps before the fresh step
+    page = kv_refs[0].shape[3]
+    Hkv = kv_refs[0].shape[4]
+    D = kv_refs[0].shape[5]
+    S = q_ref.shape[1]
+    Hq = q_ref.shape[2]
+    n_rep = Hq // Hkv
+    scale = 1.0 / (D ** 0.5)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    base = base_ref[b]
+    q_len = len_ref[b]
+
+    # [S, Hq, D] -> [Hkv, n_rep, S, D]: GQA batch layout shared by both
+    # phases (scratch rows flatten the same (Hkv, n_rep, S) order)
+    def q4():
+        return q_ref[0].transpose(1, 0, 2).reshape(Hkv, n_rep, S, D)
+
+    def accumulate(s, v):  # s [Hkv, n_rep, S, K], v [Hkv, K, D]
+        s2 = s.reshape(Hq * S, s.shape[-1])
+        m_prev = m_scr[:]
+        m_cur = jnp.max(s2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(s2 - m_new)
+        pv = jax.lax.dot_general(
+            probs.reshape(Hkv, n_rep * S, s.shape[-1]).astype(v.dtype), v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [Hkv, n_rep*S, D]
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + pv.reshape(Hq * S, D)
+
+    grp_base = p * G * page
+    live = (p < npg) & (grp_base < base)
+    if window > 0:
+        # keys below every query's window can skip (earliest query sits
+        # at position ``base``)
+        live = live & (grp_base + G * page > base - window)
+
+    @pl.when(live)
+    def _prefix():
+        k = jnp.concatenate(
+            [r[0, 0, 0].transpose(1, 0, 2) for r in kv_refs], axis=1
+        )  # [Hkv, G*page, D]
+        v = jnp.concatenate(
+            [r[0, 1, 0].transpose(1, 0, 2) for r in kv_refs], axis=1
+        )
+        s = jax.lax.dot_general(
+            q4(), k,
+            dimension_numbers=(((3,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Hkv, n_rep, S, G*page]
+        kpos = grp_base + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=3
+        )
+        keep = kpos < base
+        if window > 0:
+            qpos = base + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, dimension=2
+            )
+            keep = keep & (kpos > qpos - window)
+        accumulate(jnp.where(keep, s, _NEG_INF), v)
+
+    @pl.when(p == npg)
+    def _fresh():
+        fk = fk_ref[0].transpose(1, 0, 2)  # [Hkv, S, D]
+        fv = fv_ref[0].transpose(1, 0, 2)
+        s = jax.lax.dot_general(
+            q4(), fk,
+            dimension_numbers=(((3,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Hkv, n_rep, S, S]
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=2)
+        kj = jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=3)
+        keep = (kj <= qi) & (kj < q_len)
+        if window > 0:
+            keep = keep & (qi - kj < window)
+        accumulate(jnp.where(keep, s, _NEG_INF), fv)
+        l = l_scr[:]
+        safe = jnp.where(l > 0.0, l, 1.0)
+        out = (acc_scr[:] / safe).reshape(Hkv, n_rep, S, D)
+        o_ref[0] = out.reshape(Hq, S, D).transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("window", "group", "interpret")
+)
+def ragged_paged_attention(
+    q: jax.Array,  # [B, S, Hq, D] ragged queries (row i at base + i)
+    k: jax.Array,  # [B, S, Hkv, D] fresh keys for the same columns
+    v: jax.Array,  # [B, S, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    page_table: jax.Array,  # [B, P] int32 page ids
+    base: jax.Array,  # [B] committed cache length per lane
+    q_lens: jax.Array,  # [B] valid query rows (0 = inactive lane)
+    layer: jax.Array | int = 0,
+    window: int = 0,
+    group: int = 4,  # pages per grid step
+    interpret: bool = False,
+) -> jax.Array:
+    """Ragged mixed-batch attention over the paged KV pool (see module
+    docstring).  When the table width doesn't divide by ``group``, the
+    group degrades to the largest divisor (callers pass power-of-two
+    widths >= 8, so the full group applies)."""
+    B, S, Hq, D = q.shape
+    L, _, num_pages, page, Hkv, _ = kv_pages.shape
+    P = page_table.shape[1]
+    G = min(group, P)
+    while P % G:
+        G -= 1
+    npg = P // G
+
+    pt = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
+    lyr = jnp.clip(jnp.asarray(layer, jnp.int32), 0, L - 1).reshape(1)
+
+    def kv_map(g):
+        def m(b, p, layer_ref, pt_ref, base_ref, len_ref):
+            # the fresh step (p == npg) re-targets the last group: the
+            # fetch is dead weight there but keeps the operand spec static
+            pp = jnp.minimum(p, npg - 1)
+            return (layer_ref[0], 0, pt_ref[b, pp * G + g], 0, 0, 0)
+
+        return m
+
+    def row_map(b, p, *_):
+        return (b, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, npg + 1),
+        in_specs=[
+            pl.BlockSpec((1, 2, 1, page, Hkv, D), kv_map(g)) for g in range(G)
+        ]
+        + [
+            pl.BlockSpec((1, S, Hq, D), row_map),
+            pl.BlockSpec((1, S, Hkv, D), row_map),
+            pl.BlockSpec((1, S, Hkv, D), row_map),
+        ],
+        out_specs=pl.BlockSpec((1, S, Hq, D), row_map),
+        scratch_shapes=[
+            pltpu.VMEM((Hq * S, 1), jnp.float32),
+            pltpu.VMEM((Hq * S, 1), jnp.float32),
+            pltpu.VMEM((Hq * S, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_ragged_kernel, G=G, window=window),
+        out_shape=jax.ShapeDtypeStruct((B, S, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(
+        lyr, pt, base.astype(jnp.int32), q_lens.astype(jnp.int32),
+        *([kv_pages] * G), q, k, v,
+    )
+
+
+def ragged_paged_attention_xla(
+    q: jax.Array,  # [B, S, Hq, D]
+    k: jax.Array,  # [B, S, Hkv, D] fresh keys
+    v: jax.Array,  # [B, S, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    page_table: jax.Array,  # [B, P]
+    base: jax.Array,  # [B]
+    q_lens: jax.Array,  # [B]
+    layer: jax.Array | int = 0,
+    window: int = 0,
+) -> jax.Array:
+    """Pure-XLA reference of the ragged kernel: gather the full table's
+    pages as the prefix key block (masked at token granularity by
+    ``kpos < base``), concatenate the fresh columns, one masked softmax.
+    Same math as ``engine.attention.prefill_prefix_attention`` run with
+    the whole page table as the prefix -- the kernel's parity oracle and
+    the CPU tier-1 code path."""
+    B, S, Hq, D = q.shape
+    L = kv_pages.shape[0]
+    page_size = kv_pages.shape[3]
+    P = page_table.shape[1]
+    Hkv = k.shape[2]
+    n_rep = Hq // Hkv
+
+    lyr = jnp.clip(jnp.asarray(layer, jnp.int32), 0, L - 1)
+    layer_kv = jax.lax.dynamic_index_in_dim(kv_pages, lyr, 0, keepdims=False)
+    kp = layer_kv[0][page_table].reshape(B, P * page_size, Hkv, D)
+    vp = layer_kv[1][page_table].reshape(B, P * page_size, Hkv, D)
+
+    def rep(x):
+        return x if n_rep == 1 else jnp.repeat(x, n_rep, axis=-2)
+
+    keys = rep(jnp.concatenate([kp, k], axis=1))
+    vals = rep(jnp.concatenate([vp, v], axis=1))
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys) * scale
+
+    local = jnp.arange(S)
+    kpos = jnp.arange(P * page_size)
+    prefix_valid = kpos[None, :] < base[:, None]  # [B, Kp]
+    fresh_valid = local[None, :] < q_lens[:, None]  # [B, S]
+    causal = local[None, :] <= local[:, None]  # [Sq, Sk]
+    if window > 0:
+        q_abs = base[:, None] + local[None, :]  # [B, Sq]
+        prefix_win = kpos[None, None, :] > q_abs[:, :, None] - window
+        mask_prefix = jnp.broadcast_to(
+            (prefix_valid[:, None, :] & prefix_win)[:, None],
+            (B, 1, S, P * page_size),
+        )
+        causal = causal & (local[:, None] - local[None, :] < window)
+    else:
+        mask_prefix = jnp.broadcast_to(
+            prefix_valid[:, None, None, :], (B, 1, S, P * page_size)
+        )
+    mask_fresh = jnp.broadcast_to(
+        causal[None, None, :, :] & fresh_valid[:, None, None, :], (B, 1, S, S)
+    )
+    mask = jnp.concatenate([mask_prefix, mask_fresh], axis=-1)
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vals)

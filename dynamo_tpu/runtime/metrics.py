@@ -192,6 +192,28 @@ class EngineMetrics:
             "dynamo_engine_preemptions",
             "Sequences preempted for KV-page capacity",
         )
+        # dispatch accounting: every device launch the tick loop pays, by
+        # kind (prefill / decode_block / unified / verify / chunk /
+        # prompt_score).
+        # dispatches/s vs decode steps/s is the mixed-batching health ratio
+        # the bench tracks every round (ROADMAP item 2).
+        self.dispatches = reg.counter(
+            "dynamo_engine_dispatches_total",
+            "Device dispatches issued by the engine tick loop",
+            ["kind"],
+        )
+        # mixed-batch occupancy: how full each unified ragged dispatch ran
+        # (decode lanes riding alongside how many packed prefill tokens)
+        self.mixed_decode_lanes = reg.histogram(
+            "dynamo_engine_mixed_batch_decode_lanes",
+            "Decode lanes per unified mixed-batch dispatch",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128),
+        )
+        self.mixed_prefill_tokens = reg.histogram(
+            "dynamo_engine_mixed_batch_prefill_tokens",
+            "Prefill tokens packed into a unified mixed-batch dispatch",
+            buckets=(0, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+        )
         if max_slots:
             self.slots.set(max_slots)
 
@@ -203,6 +225,13 @@ class EngineMetrics:
 
     def observe_step(self, kind: str, seconds: float) -> None:
         self.step_latency.labels(kind).observe(max(seconds, 0.0))
+
+    def observe_dispatch(self, kind: str) -> None:
+        self.dispatches.labels(kind).inc()
+
+    def observe_mixed(self, decode_lanes: int, prefill_tokens: int) -> None:
+        self.mixed_decode_lanes.observe(decode_lanes)
+        self.mixed_prefill_tokens.observe(prefill_tokens)
 
     def observe_kv(self, used: int, total: int) -> None:
         self.kv_used.set(used)

@@ -1,0 +1,505 @@
+"""Mixed prefill+decode batching (unified ragged dispatch): output identity
+vs the separate-dispatch paths, idle-tick dispatch elision, shape-bucket
+bounds, and the dispatch-accounting metrics.
+
+The contract under test (ISSUE 7 acceptance): with ``mixed_batching`` on,
+admitted prompts pack into the decode tick as ragged chunks of ONE unified
+dispatch -- and every token streamed to every client is bit-identical to
+what ``--no-mixed-batching`` (the classic separate prefill/decode
+dispatches) produces, for greedy and seeded lanes, across chunked prefill,
+mid-batch admission, EOS, preemption, and spec-decode composition.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.engine.bucketing import pow2_bucket
+from dynamo_tpu.engine.kv_cache import PageAllocator
+from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, SeqState
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    SpeculationOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.runtime.metrics import MetricsRegistry, set_default
+
+
+@pytest.fixture()
+def fresh_registry():
+    prev = set_default(MetricsRegistry())
+    yield
+    set_default(prev)
+
+
+def make_engine(**cfg_kw) -> JaxEngine:
+    defaults = dict(max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64)
+    defaults.update(cfg_kw)
+    return JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**defaults))
+
+
+def req(tokens, max_tokens=8, sampling=None, spec=None, **kw):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens, **kw),
+        sampling_options=sampling or SamplingOptions(temperature=0.0),
+        speculation=spec,
+    )
+
+
+async def collect(engine, request):
+    stream = await engine.generate(Context.new(request))
+    tokens, finish = [], None
+    async for item in stream:
+        ann = item if isinstance(item, Annotated) else Annotated.from_dict(item)
+        assert not ann.is_error(), ann.error_message()
+        data = ann.data
+        tokens.extend(data.get("token_ids") or [])
+        if data.get("finish_reason"):
+            finish = data["finish_reason"]
+    return tokens, finish
+
+
+async def run_batch(prompts, max_tokens=6, sampling=None, **cfg_kw):
+    engine = make_engine(**cfg_kw)
+    try:
+        return await asyncio.gather(
+            *[
+                collect(engine, req(p, max_tokens=max_tokens, sampling=sampling))
+                for p in prompts
+            ]
+        )
+    finally:
+        await engine.stop()
+
+
+# -- output identity vs the separate-dispatch paths --------------------------
+
+
+def test_mixed_matches_separate_batch(run):
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [5, 5, 5, 5, 5, 5, 5], [2, 4]]
+
+    async def body():
+        on = await run_batch(prompts, mixed_batching=True)
+        off = await run_batch(prompts, mixed_batching=False)
+        assert on == off
+        assert all(len(t) == 6 for t, _ in on)
+
+    run(body())
+
+
+def test_mixed_chunked_prefill_identity(run):
+    """Long prompts split across unified dispatches (token budget + chunk
+    cap force multi-chunk prefill) produce the same stream as the classic
+    chunked path."""
+    prompts = [list(range(1, 33)), [7] * 29, [3, 1, 4, 1, 5, 9, 2, 6] * 3]
+
+    async def body():
+        on = await run_batch(
+            prompts, mixed_batching=True, prefill_chunk_tokens=8,
+            mixed_token_budget=12, max_seq_len=128, num_pages=128,
+        )
+        off = await run_batch(
+            prompts, mixed_batching=False, prefill_chunk_tokens=8,
+            max_seq_len=128, num_pages=128,
+        )
+        # and against the unchunked classic path (one prefill dispatch)
+        plain = await run_batch(
+            prompts, mixed_batching=False, max_seq_len=128, num_pages=128
+        )
+        assert on == off == plain
+
+    run(body())
+
+
+def test_mixed_mid_batch_admission_identity(run):
+    """A prompt admitted while the batch is mid-decode packs into a live
+    tick's unified dispatch; the decode lanes and the newcomer both match
+    the separate-dispatch run."""
+
+    async def staggered(mixed):
+        engine = make_engine(mixed_batching=mixed)
+        try:
+            t_a = asyncio.ensure_future(
+                collect(engine, req([1, 2, 3, 4], max_tokens=12))
+            )
+            # wait until A is actually decoding before admitting B
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                if engine.sched.num_active >= 1:
+                    break
+            await asyncio.sleep(0.05)
+            t_b = asyncio.ensure_future(
+                collect(engine, req([9, 9, 8, 8, 7, 7], max_tokens=8))
+            )
+            return await t_a, await t_b
+        finally:
+            await engine.stop()
+
+    async def body():
+        on = await staggered(True)
+        off = await staggered(False)
+        assert on == off
+
+    run(body())
+
+
+def test_mixed_seeded_sampling_identity(run):
+    """Seeded lanes key their noise by (seed, position) -- a pure function
+    -- so mixed vs separate dispatch composition cannot change their
+    stream."""
+    samp = SamplingOptions(temperature=0.9, top_p=0.95, seed=4242)
+    prompts = [[1, 2, 3, 4, 5], [8, 6, 7, 5, 3, 0, 9]]
+
+    async def body():
+        on = await run_batch(prompts, max_tokens=10, sampling=samp,
+                             mixed_batching=True)
+        off = await run_batch(prompts, max_tokens=10, sampling=samp,
+                              mixed_batching=False)
+        assert on == off
+        assert all(len(t) == 10 for t, _ in on)
+
+    run(body())
+
+
+def test_mixed_eos_identity(run):
+    async def discover(mixed):
+        engine = make_engine(mixed_batching=mixed)
+        try:
+            toks, _ = await collect(engine, req([1, 2, 3], max_tokens=3))
+            r = req([1, 2, 3], max_tokens=10)
+            r.eos_token_ids = [toks[1]]
+            return await collect(engine, r)
+        finally:
+            await engine.stop()
+
+    async def body():
+        on = await discover(True)
+        off = await discover(False)
+        assert on == off
+        assert on[1] == "eos"
+
+    run(body())
+
+
+def test_mixed_preemption_identity(run):
+    """Preemption under page pressure (swap or recompute re-prefill, which
+    itself rides the unified plane) keeps the stream identical to an
+    uncontended run and to the separate-dispatch path."""
+
+    prompt_a = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompt_b = [2, 7, 1, 8, 2, 8, 1, 8]
+
+    async def one(num_pages, mixed):
+        engine = make_engine(
+            max_batch_size=2, num_pages=num_pages, mixed_batching=mixed,
+            host_offload_blocks=32, swap_preemption=True,
+        )
+        try:
+            res = await asyncio.gather(
+                collect(engine, req(prompt_a, max_tokens=24)),
+                collect(engine, req(prompt_b, max_tokens=24)),
+            )
+            return res, engine.sched.preempt_swap + engine.sched.preempt_recompute
+        finally:
+            await engine.stop()
+
+    async def body():
+        roomy, _ = await one(41, True)
+        tight, n_pre = await one(13, True)
+        assert n_pre >= 1, "preemption must have been exercised"
+        off, _ = await one(13, False)
+        assert tight == roomy == off
+
+    run(body())
+
+
+def test_mixed_spec_compose_identity(run):
+    """Speculating lanes (device-inactive for the decode scan, advancing
+    via verify dispatches post-commit) compose with unified mixed ticks:
+    a spec lane plus a freshly admitted prompt produce the same streams
+    as the classic paths."""
+    prompt = [5, 6, 5, 6, 5, 6, 5, 6]
+    spec = SpeculationOptions(enabled=True, num_draft_tokens=4, drafter="ngram")
+
+    async def one(mixed):
+        engine = make_engine(mixed_batching=mixed)
+        try:
+            t_a = asyncio.ensure_future(
+                collect(engine, req(prompt, max_tokens=16, spec=spec))
+            )
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                if engine.sched.num_active >= 1:
+                    break
+            await asyncio.sleep(0.05)
+            t_b = asyncio.ensure_future(
+                collect(engine, req([4, 2, 4, 2, 4], max_tokens=8))
+            )
+            return await t_a, await t_b
+        finally:
+            await engine.stop()
+
+    async def body():
+        on = await one(True)
+        off = await one(False)
+        assert on == off
+
+    run(body())
+
+
+def test_penalized_lane_reverts_tick_to_classic(run):
+    """Penalized requests need the decode scan's device-resident penalty
+    histograms, so their presence turns ticks classic -- output matches
+    the mixed-off run exactly."""
+    samp = SamplingOptions(temperature=0.8, seed=7, frequency_penalty=0.5)
+    prompts = [[1, 2, 3, 4], [9, 8, 7, 6, 5]]
+
+    async def body():
+        on = await run_batch(prompts, max_tokens=8, sampling=samp,
+                             mixed_batching=True)
+        off = await run_batch(prompts, max_tokens=8, sampling=samp,
+                              mixed_batching=False)
+        assert on == off
+
+    run(body())
+
+
+def test_penalized_arrival_mid_mixed_prefill_drains_to_classic(run):
+    """A penalized request admitted WHILE a mixed prefill is mid-flight
+    turns the tick classic: the in-flight lane drains to the chunk
+    machinery and must finish correctly -- with the default config
+    (prefill_chunk_tokens unset), where the drained lane completes in
+    one classic suffix dispatch, and with page-unaligned progress, which
+    form_mixed_chunks must have rounded to a page boundary."""
+    long_prompt = list(range(1, 41))
+    pen = SamplingOptions(temperature=0.8, seed=7, frequency_penalty=0.5)
+
+    async def one(mixed):
+        # budget 8 => the 40-token prompt spans ~5 unified dispatches,
+        # leaving a wide window to land the penalized admission mid-flight
+        engine = make_engine(
+            mixed_batching=mixed, mixed_token_budget=8,
+            max_seq_len=128, num_pages=128,
+        )
+        try:
+            t_a = asyncio.ensure_future(
+                collect(engine, req(long_prompt, max_tokens=6))
+            )
+            if mixed:
+                for _ in range(400):
+                    await asyncio.sleep(0.005)
+                    if any(
+                        s is not None and s.prefilling
+                        for s in engine.sched.slots
+                    ):
+                        break
+            else:
+                await asyncio.sleep(0.05)
+            t_b = asyncio.ensure_future(
+                collect(engine, req([9, 8, 7, 6], max_tokens=6, sampling=pen))
+            )
+            return await t_a, await t_b
+        finally:
+            await engine.stop()
+
+    async def body():
+        on = await one(True)
+        off = await one(False)
+        assert on == off
+
+    run(body())
+
+
+def test_form_mixed_chunks_page_aligned_boundaries():
+    """Non-final chunk boundaries land on page multiples (the classic
+    handoff's restart requirement), and alignment can't starve the head
+    lane (a sub-page budget still packs one full page)."""
+    ps = 4
+    for budget in (1, 2, 5, 6, 7, 9, 10, 13, 17):
+        sched = _mk_sched(max_batch_size=4, max_seq_len=256, page_size=ps)
+        sched.allocator = PageAllocator(256)
+        seqs = []
+        for i, n in enumerate((37, 23)):
+            seq = SeqState.from_request(
+                f"r{i}", req([1] * n, max_tokens=4), ps
+            )
+            sched.enqueue(seq)
+            sched.plan()
+            assert seq.slot >= 0
+            sched.queue_mixed_prefill(seq, 0)
+            seqs.append(seq)
+        progressed = False
+        for _tick in range(200):
+            if not sched.mix_pending:
+                break
+            chunks = sched.form_mixed_chunks(budget, None)
+            assert chunks, "head-lane floor must guarantee progress"
+            for ch in chunks:
+                assert ch.start == ch.seq.prefilled_tokens
+                if not ch.final:
+                    assert (ch.start + ch.length) % ps == 0
+                ch.seq.prefilled_tokens = ch.start + ch.length
+                if ch.final:
+                    ch.seq.prefilling = False
+                progressed = True
+        assert progressed and not sched.mix_pending
+        assert all(s.prefilled_tokens == len(s.prompt) for s in seqs)
+
+
+# -- the unified path actually runs (identity must not pass vacuously) -------
+
+
+def test_unified_dispatch_used_and_counted(run, fresh_registry):
+    async def body():
+        engine = make_engine()
+        try:
+            await asyncio.gather(
+                *[
+                    collect(engine, req(p, max_tokens=6))
+                    for p in [[1, 2, 3, 4, 5], [9, 8, 7], [2, 4]]
+                ]
+            )
+            reg = engine.obs.registry
+            unified = reg.sample(
+                "dynamo_engine_dispatches", {"kind": "unified"}
+            )
+            assert unified and unified >= 1
+            # occupancy histograms observed once per unified dispatch
+            assert (
+                reg.sample("dynamo_engine_mixed_batch_prefill_tokens_count")
+                or reg.sample("dynamo_engine_mixed_batch_prefill_tokens")
+                is not None
+            )
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_no_mixed_batching_never_dispatches_unified(run, fresh_registry):
+    async def body():
+        engine = make_engine(mixed_batching=False)
+        try:
+            await asyncio.gather(
+                *[
+                    collect(engine, req(p, max_tokens=6))
+                    for p in [[1, 2, 3, 4, 5], [9, 8, 7]]
+                ]
+            )
+            reg = engine.obs.registry
+            assert reg.sample(
+                "dynamo_engine_dispatches", {"kind": "unified"}
+            ) in (None, 0.0)
+            assert reg.sample(
+                "dynamo_engine_dispatches", {"kind": "prefill"}
+            ) >= 1
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- idle-tick dispatch elision (satellite regression) -----------------------
+
+
+def _mk_sched(**kw):
+    defaults = dict(max_batch_size=2, max_seq_len=32, page_size=4)
+    defaults.update(kw)
+    return Scheduler(SchedulerConfig(**defaults), PageAllocator(16))
+
+
+def test_decode_gate_sees_only_parked_lanes_as_idle():
+    """A tick whose slots hold only parked (awaiting_kv / mid-prefill)
+    lanes must not pay a decode dispatch -- the engine gate keys on
+    ``num_decode_runnable``, which must treat parked lanes as dead rows."""
+    sched = _mk_sched()
+    seq = SeqState.from_request("a", req([1, 2, 3], max_tokens=4), 4)
+    sched.enqueue(seq)
+    sched.plan()  # admits to a slot
+    assert seq.slot >= 0
+    seq.prefilling = True
+    assert sched.num_decode_runnable == 0
+    seq.prefilling = False
+    seq.awaiting_kv = True
+    assert sched.num_decode_runnable == 0
+    seq.awaiting_kv = False
+    assert sched.num_decode_runnable == 1
+
+
+def test_tail_tick_pays_no_dead_block(run, fresh_registry):
+    """Once a lane's whole token budget is in flight, the next tick must
+    not dispatch a decode block that can only step dead rows (the old
+    loop paid one wasted block per batch completion)."""
+
+    async def body():
+        engine = make_engine(decode_block_size=16, mixed_batching=False)
+        try:
+            await collect(engine, req([1, 2, 3], max_tokens=4))
+            reg = engine.obs.registry
+            blocks = reg.sample(
+                "dynamo_engine_dispatches", {"kind": "decode_block"}
+            )
+            # 4 tokens fit one 16-step block: exactly one block dispatch,
+            # no dead tail block
+            assert blocks == 1.0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- shape buckets stay bounded ----------------------------------------------
+
+
+def test_mixed_query_bucket_set_bounded():
+    """Random arrival patterns through mixed-chunk formation may only mint
+    O(log budget) distinct ragged query-axis buckets."""
+    rs = np.random.RandomState(0)
+    budget = 64
+    shapes = set()
+    for _ in range(200):
+        sched = _mk_sched(max_batch_size=4, max_seq_len=256)
+        sched.allocator = PageAllocator(256)
+        n = rs.randint(1, 5)
+        for i in range(n):
+            seq = SeqState.from_request(
+                f"r{i}", req([1] * rs.randint(1, 120), max_tokens=4), 4
+            )
+            sched.enqueue(seq)
+            sched.plan()
+            if seq.slot >= 0:
+                sched.queue_mixed_prefill(seq, 0)
+        while sched.mix_pending:
+            chunks = sched.form_mixed_chunks(budget, None)
+            if not chunks:
+                break
+            shapes.add(pow2_bucket(max(ch.length for ch in chunks)))
+            for ch in chunks:
+                # dispatch-ordered bookkeeping (what _dispatch_unified does)
+                ch.seq.prefilled_tokens = ch.start + ch.length
+                if ch.final:
+                    ch.seq.prefilling = False
+    assert shapes  # formation actually ran
+    assert all(s & (s - 1) == 0 for s in shapes)  # powers of two
+    assert len(shapes) <= int(np.log2(budget)) + 2
+
+
+def test_bucket_helpers_are_shared():
+    """step.py re-exports the bucketing utilities -- one home for every
+    pow2/pad rule (satellite: dedupe)."""
+    from dynamo_tpu.engine import bucketing, step
+
+    assert step.pick_bucket is bucketing.pick_bucket
+    assert step.prefill_buckets is bucketing.prefill_buckets
+    assert step.pick_page_bucket is bucketing.pick_page_bucket
+    assert step.pow2_bucket is bucketing.pow2_bucket
+    assert bucketing.pow2_bucket(0) == 1
+    assert bucketing.pow2_bucket(1) == 1
+    assert bucketing.pow2_bucket(5) == 8
+    assert bucketing.pow2_bucket(3, floor=4) == 4
+    assert bucketing.pick_page_bucket(5, 16) == 8

@@ -356,3 +356,123 @@ def test_prefix_prefill_dispatch_uses_xla_on_cpu():
     )
     ref = att.prefill_prefix_attention(q, k, v, kv_pages, 1, pt, offset, slen)
     assert float(jnp.max(jnp.abs(ref - got))) == 0.0
+
+
+# -- ragged paged-attention kernel (mixed prefill+decode) --------------------
+
+from dynamo_tpu.ops.ragged_attention import (
+    ragged_paged_attention,
+    ragged_paged_attention_xla,
+)
+
+
+def _mk_ragged_case(B, S, Pp, page, Hq, Hkv, D, bases, qlens, seed=0,
+                    L=2, dtype=jnp.float32):
+    """Ragged mixed-batch inputs over a paged pool: lane ``b`` holds a
+    resident prefix of ``bases[b]`` tokens in its page table and
+    contributes ``qlens[b]`` fresh query rows (1 = decode lane, >1 =
+    chunked-prefill lane, 0 = inactive)."""
+    rs = np.random.RandomState(seed)
+    num_pages = 1 + B * Pp
+    kv_pages = jnp.asarray(rs.randn(L, 2, num_pages, page, Hkv, D), dtype)
+    kv_pages = kv_pages.at[:, :, 0].set(0.0)  # trash page
+    pt = np.zeros((B, Pp), np.int32)
+    for b in range(B):
+        used = -(-bases[b] // page) if bases[b] else 0
+        pt[b, :used] = 1 + b * Pp + np.arange(used)
+    q = jnp.asarray(rs.randn(B, S, Hq, D), dtype)
+    k = jnp.asarray(rs.randn(B, S, Hkv, D), dtype)
+    v = jnp.asarray(rs.randn(B, S, Hkv, D), dtype)
+    return (
+        q, k, v, kv_pages, jnp.asarray(pt),
+        jnp.asarray(bases, jnp.int32), jnp.asarray(qlens, jnp.int32),
+    )
+
+
+@pytest.mark.parametrize(
+    "B,S,Pp,page,Hq,Hkv,D,bases,qlens,group",
+    [
+        # pure decode batch (every lane one row)
+        (3, 1, 4, 8, 4, 4, 16, [9, 32, 17], [1, 1, 1], 2),
+        # mixed: decode lane + chunked-prefill lanes + a dead lane
+        (4, 8, 4, 8, 8, 2, 32, [16, 0, 11, 24], [1, 8, 5, 0], 2),
+        # prefill continuation from a non-page-aligned base
+        (2, 16, 8, 4, 4, 2, 16, [7, 0], [16, 13], 4),
+        # group doesn't divide the table: degrades to a divisor
+        (2, 4, 6, 8, 4, 4, 16, [48, 3], [4, 1], 4),
+    ],
+)
+def test_ragged_kernel_matches_xla(B, S, Pp, page, Hq, Hkv, D, bases,
+                                   qlens, group):
+    q, k, v, kv_pages, pt, base, qn = _mk_ragged_case(
+        B, S, Pp, page, Hq, Hkv, D, bases, qlens
+    )
+    ref = ragged_paged_attention_xla(q, k, v, kv_pages, pt, base, qn, 1)
+    got = ragged_paged_attention(
+        q, k, v, kv_pages, pt, base, qn, 1, group=group, interpret=True
+    )
+    m = _valid_mask(S, qlens)
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * m
+    assert float(diff.max()) < 1e-5
+
+
+@pytest.mark.parametrize("window", [4, 12])
+def test_ragged_kernel_sliding_window(window):
+    B, S, Pp, page, Hq, Hkv, D = 2, 8, 4, 8, 4, 2, 16
+    bases, qlens = [24, 0], [8, 6]
+    q, k, v, kv_pages, pt, base, qn = _mk_ragged_case(
+        B, S, Pp, page, Hq, Hkv, D, bases, qlens, seed=3
+    )
+    ref = ragged_paged_attention_xla(
+        q, k, v, kv_pages, pt, base, qn, 1, window
+    )
+    got = ragged_paged_attention(
+        q, k, v, kv_pages, pt, base, qn, 1, window, group=2, interpret=True
+    )
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * _valid_mask(S, qlens)
+    assert float(diff.max()) < 1e-5
+
+
+def test_ragged_xla_matches_prefix_prefill():
+    """The ragged XLA reference must agree with the existing prefix-suffix
+    attention (its independent oracle) when every lane is a prefill
+    continuation."""
+    B, S, Pp, page, Hq, Hkv, D = 2, 8, 4, 8, 4, 2, 16
+    bases, qlens = [16, 8], [8, 5]
+    q, k, v, kv_pages, pt, base, qn = _mk_ragged_case(
+        B, S, Pp, page, Hq, Hkv, D, bases, qlens, seed=7
+    )
+    ref = att.prefill_prefix_attention(q, k, v, kv_pages, 1, pt, base, qn)
+    got = ragged_paged_attention_xla(q, k, v, kv_pages, pt, base, qn, 1)
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * _valid_mask(S, qlens)
+    assert float(diff.max()) < 1e-5
+
+
+def test_ragged_kernel_bf16():
+    B, S, Pp, page, Hq, Hkv, D = 2, 8, 4, 8, 4, 2, 32
+    bases, qlens = [16, 9], [8, 1]
+    q, k, v, kv_pages, pt, base, qn = _mk_ragged_case(
+        B, S, Pp, page, Hq, Hkv, D, bases, qlens, seed=5, dtype=jnp.bfloat16
+    )
+    ref = ragged_paged_attention_xla(
+        q, k, v, kv_pages, pt, base, qn, 1
+    ).astype(jnp.float32)
+    got = ragged_paged_attention(
+        q, k, v, kv_pages, pt, base, qn, 1, group=2, interpret=True
+    ).astype(jnp.float32)
+    diff = np.abs(np.asarray(ref) - np.asarray(got)) * _valid_mask(S, qlens)
+    assert float(diff.max()) < 0.06
+
+
+def test_ragged_dispatch_uses_xla_on_cpu():
+    """On the CPU test platform the ragged dispatch must pick the XLA path
+    (the kernel is TPU-only outside interpret mode)."""
+    B, S, Pp, page, Hq, Hkv, D = 2, 4, 4, 8, 4, 2, 16
+    q, k, v, kv_pages, pt, base, qn = _mk_ragged_case(
+        B, S, Pp, page, Hq, Hkv, D, [8, 0], [1, 4]
+    )
+    got = att.ragged_attention_dispatch(
+        q, k, v, kv_pages, 1, pt, base, qn
+    )
+    ref = ragged_paged_attention_xla(q, k, v, kv_pages, pt, base, qn, 1)
+    assert float(jnp.max(jnp.abs(ref - got))) == 0.0
