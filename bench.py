@@ -281,16 +281,28 @@ async def run_decode_sweep(rs) -> dict:
             )
         # marginal decode at bs64: diff mt=192 vs mt=64 runs (fresh prompts
         # each pass so every pass pays the same cold prefill, which the
-        # difference cancels)
+        # difference cancels).  Drift-robust measurement (VERDICT r5 #2):
+        # the compared legs interleave A/B/A/B inside ONE window -- each
+        # pair's legs see the same ambient tunnel load, so the pairwise
+        # difference cancels drift that best-of-2-per-leg accumulated
+        # (r05 recorded 7,047 against a quiet-chip ~22k for exactly that
+        # reason).  The best pairwise marginal is the recorded value: one
+        # quiet pair suffices, matching the proven int8 A/B methodology.
         bs = 64
         mk = lambda: [rs.randint(1, 30000, (128,)).tolist() for _ in range(bs)]
         await run_batch(engine, mk(), max_tokens=192)  # compile long shapes
-        els = {}
-        for mt in (64, 192):
-            _, els[mt] = await best_of(2, lambda m=mt: run_batch(engine, mk(), max_tokens=m))
+        pairs = []
+        for _ in range(2):
+            pair = []
+            for mt in (64, 192):
+                t0 = time.monotonic()
+                await run_batch(engine, mk(), max_tokens=mt)
+                pair.append(time.monotonic() - t0)
+            pairs.append(tuple(pair))
         d_tok = bs * (192 - 64)
-        d_el = els[192] - els[64]
-        if d_el > 0:
+        deltas = [b - a for a, b in pairs if b - a > 0]
+        if deltas:
+            d_el = min(deltas)  # the quietest interleaved pair
             marginal = d_tok / d_el
             pbytes = param_bytes(engine.params)
             steps_s = (192 - 64) / d_el
@@ -302,7 +314,7 @@ async def run_decode_sweep(rs) -> dict:
                 (pbytes + kv_per_step) * steps_s / 819e9, 4
             )
         else:
-            # tunnel drift inverted the two legs: a difference metric from
+            # tunnel drift inverted every pair: a difference metric from
             # them would be garbage; record the invalidity explicitly
             out["decode_marginal_tok_s_bs64"] = None
     finally:
@@ -665,6 +677,143 @@ async def run_prefill_under_decode_load(rs, build=build_engine) -> dict:
     }
 
 
+def _tp_scaling_model():
+    """CI-sized llama-shaped config whose 8 kv heads shard at every
+    measured tp degree -- small enough that the tp=1 leg is seconds on a
+    CPU device, wide enough that the matmuls dominate python overhead."""
+    from dynamo_tpu.engine import ModelConfig
+
+    return ModelConfig(
+        vocab_size=2048,
+        hidden_size=256,
+        intermediate_size=512,
+        num_layers=4,
+        num_heads=8,
+        num_kv_heads=8,
+        head_dim=32,
+        rope_theta=10000.0,
+        max_position=256,
+        dtype="float32",
+    )
+
+
+async def _tp_scaling_impl(degrees=(1, 2, 4, 8)) -> dict:
+    """tok/s/chip of the SERVED engine path at each tensor-parallel
+    degree: one engine per tp, same workload, same seed.  Runs wherever
+    the current process already sees enough devices (virtual CPU mesh in
+    the subprocess leg, real chips on a pod)."""
+    import os
+
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig, JaxEngine
+
+    # ambient DYN_TP/DYN_DP would win over every leg's EngineConfig.tp
+    # (env-over-config is the serving contract) and silently re-degree
+    # the whole sweep -- the measurement owns its parallelism.  Saved and
+    # restored: in the native (>= 8 device) path this runs inside the
+    # main bench process, and scenarios after the sweep must see the
+    # operator's environment unchanged.
+    saved = {k: os.environ.pop(k, None) for k in ("DYN_TP", "DYN_DP")}
+    model = _tp_scaling_model()
+    rs = np.random.RandomState(0)
+    bs, isl, osl = 8, 32, 32
+    out = {}
+    try:
+        for tp in degrees:
+            engine = JaxEngine.random_init(
+                model,
+                EngineConfig(
+                    max_batch_size=bs, max_seq_len=128, page_size=16,
+                    num_pages=64, decode_block_size=16, tp=tp, seed=0,
+                ),
+            )
+            try:
+                mk = lambda: [
+                    rs.randint(1, 2000, (isl,)).tolist() for _ in range(bs)
+                ]
+                await run_batch(engine, mk(), max_tokens=osl)  # compile/warm
+                t0 = time.monotonic()
+                total = await run_batch(engine, mk(), max_tokens=osl)
+                elapsed = time.monotonic() - t0
+                out[f"tp{tp}_tok_s_per_chip"] = round(
+                    total / elapsed / tp, 2
+                )
+                if tp > 1:
+                    spec = engine.kv.pages.sharding.spec
+                    assert "tp" in [ax for ax in spec if ax], (
+                        f"tp={tp} KV pool not sharded: {spec}"
+                    )
+            finally:
+                await engine.stop()
+    finally:
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+    return out
+
+
+async def run_tp_scaling() -> dict:
+    """Tensor-parallel scaling scenario (ROADMAP item 1): tok/s/chip of
+    the served engine at tp in {1, 2, 4, 8}, published next to the bs8
+    single-chip line.
+
+    With >= 8 local devices (a pod slice) the measurement runs in
+    process on real chips.  On the single-chip bench host it re-execs
+    under an 8-device virtual CPU platform (the dryrun pattern: the
+    platform must be forced before JAX loads) -- there the absolute
+    numbers track host cores, not TPU silicon, so the published value is
+    the *scaling shape* (per-chip efficiency retained as tp grows) while
+    the absolute tok/s line stays the single-chip TPU number above it."""
+    import os
+    import subprocess
+    import sys
+
+    import jax
+
+    try:
+        n_dev = len(jax.devices())
+    except Exception:
+        n_dev = 0
+    if n_dev >= 8:
+        # degrade, never abort (same contract as the child path below): a
+        # failed sweep leg must not discard every scenario the bench
+        # already measured
+        try:
+            out = await _tp_scaling_impl()
+        except Exception as e:  # noqa: BLE001
+            return {"tp_scaling_error": f"{type(e).__name__}: {e}"[:500]}
+        out["tp_scaling_devices"] = "native"
+        return out
+    from __graft_entry__ import virtual_cpu_child_env
+
+    env = virtual_cpu_child_env(dict(os.environ), 8)
+    # the child sweeps its own tp degrees; ambient DYN_TP/DYN_DP would
+    # override every leg's EngineConfig
+    env.pop("DYN_TP", None)
+    env.pop("DYN_DP", None)
+    # degrade, never abort: a child overrun or garbled stdout must not
+    # discard every scenario the bench already measured
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--tp-scaling-child"],
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=1500,
+        )
+        if proc.returncode != 0:
+            return {"tp_scaling_error": proc.stderr[-500:]}
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        return {"tp_scaling_error": "child timed out after 1500s"}
+    except (ValueError, IndexError) as e:  # empty/garbled child stdout
+        return {"tp_scaling_error": f"unparseable child output: {e}"}
+    out["tp_scaling_devices"] = "virtual-cpu"
+    return out
+
+
 async def best_of(n: int, run):
     """Best of ``n`` timed passes of ``run()`` (fresh-args coroutine
     factory): the tunneled chip's round-trip latency drifts with ambient
@@ -774,6 +923,7 @@ async def main():
     del engine
 
     sweep = await run_decode_sweep(rs)
+    tp_scaling = await run_tp_scaling()
     mem_pressure = await run_mem_pressure(rs)
     spec = await run_spec(rs)
     pf_load = await run_prefill_under_decode_load(rs)
@@ -810,6 +960,7 @@ async def main():
                 "est_hbm_util_v5e": round(util, 4),
                 "param_bytes": pbytes,
                 **sweep,
+                **tp_scaling,
                 **mem_pressure,
                 **spec,
                 **pf_load,
@@ -820,4 +971,11 @@ async def main():
 
 
 if __name__ == "__main__":
+    import sys
+
+    if "--tp-scaling-child" in sys.argv:
+        # child of run_tp_scaling: env already forces the 8-device virtual
+        # CPU platform; print ONE JSON line the parent parses
+        print(json.dumps(asyncio.run(_tp_scaling_impl())))
+        sys.exit(0)
     asyncio.run(main())

@@ -36,11 +36,21 @@ HOT_PATH_ATTR = "__dynalint_hot_path__"
 # a pattern in a matching module is analyzed as a hot path.
 HOT_PATH_MANIFEST: Dict[str, List[str]] = {
     # the whole jitted step-assembly surface is hot: everything here runs
-    # under jax.jit inside the tick loop's dispatch
+    # under jax.jit inside the tick loop's dispatch.  The ``_``-prefixed
+    # names are the raw implementations behind the module-level jit
+    # wrappers (``decode_block = partial(jax.jit, ...)(_decode_block)``)
+    # -- the serving-mesh path re-jits exactly these with explicit in/out
+    # shardings (parallel/sharding.make_sharded_steps), so their BODIES
+    # are the hot surface DT004/DT005 must scan
     "dynamo_tpu/engine/step.py": [
+        "decode_step",
+        "_decode_once",
         "decode_block",
+        "_decode_block",
         "unified_step",
+        "_unified_step",
         "verify_and_sample",
+        "_verify_and_sample",
         "score_prompt_step",
         "prefill_step",
         "prefill_and_sample",
@@ -50,20 +60,45 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
         "sample_step_packed",
         "embed_step",
         "update_lanes",
+        "_update_lanes",
         "inject_token",
+        "_inject_token",
         "inject_tokens",
+        "_inject_tokens",
         "zero_count_rows",
+        "_zero_count_rows",
         "bump_counts",
+        "_bump_counts",
         "seed_count_rows",
+        "_seed_count_rows",
         "scatter_block_pages",
+        "_scatter_block_pages",
         "slice_block_pages",
+        "_slice_block_pages",
+    ],
+    # multichip serving entry points: the sharded re-jit factory (its jit
+    # wrappers pin in/out shardings over the raw step bodies above --
+    # DT011 separately enforces the declarations) and the sp/pp prefill
+    # routes the sharded engine dispatches long prompts through
+    "dynamo_tpu/parallel/sharding.py": [
+        "make_sharded_steps",
+    ],
+    "dynamo_tpu/parallel/pipeline_parallel.py": [
+        "pp_prefill_step",
+    ],
+    "dynamo_tpu/parallel/ring_attention.py": [
+        "ring_attention_chunk",
+        "ring_prefill_step",
+        "make_ring_attention",
     ],
     # paged-attention kernels + the layer-page gather/scatter used by the
     # chunked KV delivery scatter on the tick loop
     "dynamo_tpu/ops/paged_attention.py": [
         "paged_decode_attention*",
         "gather_layer_pages",
+        "_gather_layer_pages",
         "scatter_layer_pages",
+        "_scatter_layer_pages",
     ],
     # flash prefill kernels (full-prompt and prefix-suffix)
     "dynamo_tpu/ops/flash_prefill.py": [

@@ -1,4 +1,4 @@
-"""dynalint rules DT001-DT010: this repo's real async/JAX hazard classes.
+"""dynalint rules DT001-DT011: this repo's real async/JAX hazard classes.
 
 Each rule is deliberately narrow: it encodes a bug class this codebase has
 actually exhibited (blocking WAL I/O on the hub event loop, silent
@@ -458,20 +458,25 @@ class SilentExceptSwallow(Rule):
 # ---------------------------------------------------------------------------
 
 
+def _manifest_match(relpath: str, *names: str) -> bool:
+    """Whether any of ``names`` matches a HOT_PATH_MANIFEST pattern for a
+    module at ``relpath`` -- the ONE manifest matcher (decorator-based
+    hotness is separate; see _is_hot)."""
+    for suffix, patterns in HOT_PATH_MANIFEST.items():
+        if relpath.endswith(suffix):
+            for pat in patterns:
+                if any(fnmatch.fnmatchcase(n, pat) for n in names):
+                    return True
+    return False
+
+
 def _is_hot(module: ModuleInfo, fi: FunctionInfo) -> bool:
     for dec in fi.node.decorator_list:
         target = dec.func if isinstance(dec, ast.Call) else dec
         d = dotted_name(target)
         if d is not None and d.rpartition(".")[2] == "hot_path":
             return True
-    for suffix, patterns in HOT_PATH_MANIFEST.items():
-        if module.relpath.endswith(suffix):
-            for pat in patterns:
-                if fnmatch.fnmatchcase(fi.qualname, pat) or fnmatch.fnmatchcase(
-                    fi.name, pat
-                ):
-                    return True
-    return False
+    return _manifest_match(module.relpath, fi.qualname, fi.name)
 
 
 def _hot_functions(module: ModuleInfo) -> List[FunctionInfo]:
@@ -939,16 +944,19 @@ class HotPathManifestDrift(Rule):
     name = "hot-path-manifest-drift"
     severity = "error"
     description = (
-        "A jitted entry point in a step/kernel module (engine/step.py, "
-        "ops/*.py) is covered by neither an @hot_path decorator nor a "
-        "HOT_PATH_MANIFEST pattern.  DT004/DT005 scan exactly the marked "
-        "surface, so an unlisted jax.jit entry point silently loses "
-        "host-sync and recompile-hazard coverage -- manifest drift: the "
-        "kernel was added, the manifest was not.  (This class of drift is "
-        "real: the manifest carried a paged_attention* pattern that "
-        "matched nothing after a rename, dropping coverage of "
-        "paged_decode_attention_v2.)  Add the function to "
-        "HOT_PATH_MANIFEST or decorate it with @hot_path."
+        "A jitted entry point in a step/kernel/parallel module "
+        "(engine/step.py, ops/*.py, parallel/*.py) is covered by neither "
+        "an @hot_path decorator nor a HOT_PATH_MANIFEST pattern.  "
+        "DT004/DT005 scan exactly the marked surface, so an unlisted "
+        "jax.jit entry point silently loses host-sync and "
+        "recompile-hazard coverage -- manifest drift: the kernel was "
+        "added, the manifest was not.  (This class of drift is real: the "
+        "manifest carried a paged_attention* pattern that matched nothing "
+        "after a rename, dropping coverage of paged_decode_attention_v2; "
+        "and the sharded-serving refactor's assignment-form wrappers -- "
+        "``step = partial(jax.jit, ...)(_impl)`` -- dropped the raw "
+        "bodies until the assignment form below was added.)  Add the "
+        "function to HOT_PATH_MANIFEST or decorate it with @hot_path."
     )
 
     _JIT_NAMES = {"jax.jit", "jit"}
@@ -960,7 +968,9 @@ class HotPathManifestDrift(Rule):
             return True
         head, _, fname = relpath.rpartition("/")
         return fname.endswith(".py") and (
-            head == "ops" or head.endswith("/ops")
+            head in ("ops", "parallel")
+            or head.endswith("/ops")
+            or head.endswith("/parallel")
         )
 
     @classmethod
@@ -977,10 +987,32 @@ class HotPathManifestDrift(Rule):
                         return True
         return False
 
+    @classmethod
+    def _jit_wrapped_impl(cls, call: ast.AST) -> Optional[str]:
+        """The wrapped function's dotted name for assignment-form jits:
+        ``jax.jit(impl, ...)`` or ``partial(jax.jit, ...)(impl)``; None
+        for anything else."""
+        if not isinstance(call, ast.Call) or not call.args:
+            return None
+        if dotted_name(call.func) in cls._JIT_NAMES:
+            return dotted_name(call.args[0])
+        inner = call.func
+        if (
+            isinstance(inner, ast.Call)
+            and dotted_name(inner.func) in cls._PARTIALS
+            and inner.args
+            and dotted_name(inner.args[0]) in cls._JIT_NAMES
+        ):
+            return dotted_name(call.args[0])
+        return None
+
     def check(self, module: ModuleInfo) -> Iterator[Finding]:
         if not self._applies(module.relpath):
             return
-        for fi in collect_functions(module.tree):
+        functions = {
+            fi.qualname: fi for fi in collect_functions(module.tree)
+        }
+        for fi in functions.values():
             if fi.qualname != fi.name:
                 continue  # entry points are module top-level
             if not self._is_jitted(fi):
@@ -994,6 +1026,89 @@ class HotPathManifestDrift(Rule):
                 "will not scan it (manifest drift)",
                 fi.qualname,
             )
+        # assignment-form wrappers: ``step = partial(jax.jit, ...)(impl)``
+        # (the raw-impl split the sharded serving path re-jits).  Covered
+        # when the assigned name OR the raw impl is manifest/hot-marked.
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            impl = self._jit_wrapped_impl(node.value)
+            if impl is None:
+                continue
+            if _manifest_match(module.relpath, target.id):
+                continue
+            impl_fi = functions.get(impl.rpartition(".")[2])
+            if impl_fi is not None and _is_hot(module, impl_fi):
+                continue
+            yield self.finding(
+                module, node,
+                f"jit-wrapped entry point {target.id!r} (raw impl "
+                f"{impl!r}) is in neither HOT_PATH_MANIFEST nor "
+                "@hot_path-decorated: DT004/DT005 will not scan its body "
+                "(manifest drift)",
+                target.id,
+            )
+
+
+# ---------------------------------------------------------------------------
+# DT011: multichip jit entry points must declare in/out shardings
+# ---------------------------------------------------------------------------
+
+
+class MultichipShardingsDeclared(Rule):
+    id = "DT011"
+    name = "multichip-shardings-undeclared"
+    severity = "error"
+    description = (
+        "A call-form ``jax.jit(fn, ...)`` in a parallel/ module (the "
+        "sharded-serving re-jit surface, e.g. make_sharded_steps) omits "
+        "``in_shardings`` or ``out_shardings``.  These re-jits exist "
+        "precisely to pin placements: with the declarations missing, "
+        "GSPMD falls back to propagation-from-operands, and one "
+        "host-built operand (a fresh batch array, a scratch buffer) can "
+        "silently flip the whole recurrent state -- including the paged "
+        "KV pool -- to fully replicated.  A replicated KV pool is not an "
+        "error anywhere: decode still produces correct tokens while "
+        "every chip stores every page and pays an all-gather per step.  "
+        "Declare both kwargs (an explicit ``None`` means 'deliberately "
+        "unconstrained' and satisfies the rule); decorator-form jits in "
+        "parallel/ that shard internally via shard_map are out of scope."
+    )
+
+    _JIT_NAMES = {"jax.jit", "jit"}
+
+    @classmethod
+    def _applies(cls, relpath: str) -> bool:
+        head, _, fname = relpath.rpartition("/")
+        return fname.endswith(".py") and (
+            head == "parallel" or head.endswith("/parallel")
+        )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not self._applies(module.relpath):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in self._JIT_NAMES:
+                continue
+            if not node.args:
+                continue  # partial(jax.jit, ...): jit is the arg, not callee
+            kw = {k.arg for k in node.keywords if k.arg}
+            missing = sorted({"in_shardings", "out_shardings"} - kw)
+            if missing:
+                target = dotted_name(node.args[0]) or "<expr>"
+                yield self.finding(
+                    module, node,
+                    f"jax.jit({target}, ...) in a parallel/ module omits "
+                    f"{' and '.join(missing)}: placement falls back to "
+                    "operand propagation and the KV pool can be silently "
+                    "replicated across the mesh",
+                    target,
+                )
 
 
 # ---------------------------------------------------------------------------
@@ -1011,6 +1126,7 @@ ALL_RULES: List[Rule] = [
     FireAndForgetTask(),
     OffloadSyncTransfer(),
     HotPathManifestDrift(),
+    MultichipShardingsDeclared(),
 ]
 
 

@@ -388,6 +388,17 @@ async def _make_engine(args):
     if args.leader_addr is not None:
         mn.leader_addr = args.leader_addr
     initialize_multihost(mn)  # must precede the first jax backend touch
+    # DYN_TP / DYN_DP env overrides (the engine-startup knob, mirrors
+    # DYN_KV_OFFLOAD): a set variable wins over the flag, so a deployment
+    # can re-degree a worker without editing its launch line.  sp/pp/ep
+    # stay flag-only -- they select step routes, not just shardings.
+    from .parallel.mesh import env_parallel_spec
+
+    env = env_parallel_spec()
+    if env["tp"] is not None:
+        args.tp = env["tp"]
+    if env["dp"] is not None:
+        args.dp = env["dp"]
     mesh_cfg = None
     if max(args.tp, args.dp, args.sp, args.pp, args.ep) > 1:
         from .parallel.mesh import MeshConfig
@@ -413,8 +424,20 @@ async def _make_engine(args):
                 f"--max-batch-size {args.max_batch_size} must be divisible "
                 f"by --dp {args.dp} (batch lanes shard over dp)"
             )
+        model_cfg = None
+        if args.tp > 1:
+            # fail before any weight loads: a tp that cannot shard the kv
+            # heads would silently replicate the KV pool and pay a
+            # cross-chip gather per decode step
+            model_cfg = ModelConfig.from_pretrained(args.model_path)
+            try:
+                model_cfg.validate_tp(args.tp)
+            except ValueError as e:
+                raise SystemExit(str(e))
         mesh = build_mesh(mesh_cfg, devices[: mesh_cfg.num_devices])
-        return JaxEngine.from_pretrained(args.model_path, cfg, mesh=mesh)
+        return JaxEngine.from_pretrained(
+            args.model_path, cfg, mesh=mesh, model_cfg=model_cfg
+        )
     return JaxEngine.from_pretrained(args.model_path, cfg)
 
 

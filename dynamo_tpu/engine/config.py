@@ -56,6 +56,28 @@ class ModelConfig:
     def is_moe(self) -> bool:
         return self.num_experts > 0
 
+    def validate_tp(self, tp: int) -> None:
+        """Fail fast when a tensor-parallel degree cannot shard this
+        architecture's attention heads.  ``num_heads % tp`` must be 0 for
+        the column-parallel qkv split; kv heads that do not divide fall
+        back to replicated KV (``_compatible_spec``) -- legal, but the
+        decode hot path then pays a cross-chip gather per step, so it is
+        an error here rather than a silent 10x regression.  Serving a GQA
+        model at tp > num_kv_heads requires head-replication machinery
+        this engine does not carry."""
+        if tp <= 1:
+            return
+        if self.num_heads % tp:
+            raise ValueError(
+                f"tp={tp} does not divide num_heads={self.num_heads}"
+            )
+        if self.num_kv_heads % tp:
+            raise ValueError(
+                f"tp={tp} does not divide num_kv_heads={self.num_kv_heads}: "
+                "the paged KV pool would replicate across the tp group and "
+                "every decode step would pay a cross-chip gather"
+            )
+
     @classmethod
     def tiny(cls, **overrides: Any) -> "ModelConfig":
         """A CI-sized config: runs in milliseconds on CPU, same code paths."""

@@ -43,16 +43,20 @@ from .model import Params, init_params
 from .sampling import SamplingParams
 from .scheduler import Scheduler, SchedulerConfig, SeqState, StepEvent
 from .step import (
+    bump_counts,
     decode_block,
     inject_token,
     inject_tokens,
+    seed_count_rows,
     update_lanes,
+    zero_count_rows,
     pick_bucket,
     pick_page_bucket,
     pow2_bucket,
     prefill_and_sample,
     prefill_buckets,
     prefill_suffix_and_sample,
+    gather_layer_pages,
     scatter_block_pages,
     scatter_layer_pages,
     slice_block_pages,
@@ -165,6 +169,16 @@ class EngineConfig:
     # disaggregation: a lane parked for a remote prefill's KV fails after
     # this long (lost queue item / crashed prefill worker backstop)
     external_kv_timeout_s: float = 60.0
+    # engine-startup parallelism (ROADMAP item 1): tp shards attention
+    # heads / MLP hidden and the paged KV pool (kv heads over tp -- zero
+    # cross-chip traffic on the decode hot path), dp shards the batch
+    # lanes.  The engine builds the dp x tp mesh itself at construction
+    # (parallel/mesh.serving_mesh) and re-jits the serving steps with
+    # explicit in/out shardings; DYN_TP / DYN_DP override at startup so a
+    # deployment can turn TP on without touching config.  An explicit
+    # ``mesh=`` argument (cli multinode path) wins over both.
+    tp: int = 1
+    dp: int = 1
     seed: int = 0
     dtype: Optional[str] = None
     # weight-only quantization: "int8" stores matmul weights as int8 with
@@ -256,7 +270,12 @@ class _GroupSpanExport:
         self._tasks: List[Optional[asyncio.Task]] = [None] * len(span_devs)
 
     def _materialize(self, idx: int) -> np.ndarray:
-        arr = np.asarray(jax.device_get(self._devs[idx]))
+        # per-shard assembly: a tp-sharded pool's span comes to host one
+        # kv-head slice per chip and reassembles here (the wire format is
+        # always full-width); unsharded spans take the plain device_get
+        from ..parallel.sharding import assemble_shards
+
+        arr = assemble_shards(self._devs[idx])
         self._host[idx] = arr
         self._devs[idx] = None  # release the device copy
         return arr
@@ -288,6 +307,9 @@ class KVExportStream:
     dtype: str
     row: np.ndarray  # packed [2 + 2N] (token | logprob | tops)
     spans: List[Tuple[int, int]]  # per-chunk [layer_lo, layer_hi)
+    # source-pool shard geometry (kv_shard_geometry); chunks are always
+    # full-width -- per-shard head slices reassemble at materialize
+    shards: Optional[Dict[str, int]] = None
     started_at: float = 0.0
     first_ready_at: Optional[float] = None
     last_ready_at: Optional[float] = None
@@ -375,6 +397,28 @@ class InflightPrefillGroup:
     dispatched_at: float = field(default_factory=time.perf_counter)
 
 
+from types import SimpleNamespace
+
+# one-chip dispatch table: the module-level jitted steps as-is.  The mesh
+# path swaps in parallel.sharding.make_sharded_steps, which re-jits the
+# same raw implementations with explicit in/out shardings.
+_MODULE_STEPS = SimpleNamespace(
+    decode_block=decode_block,
+    unified_step=unified_step,
+    verify_and_sample=verify_and_sample,
+    update_lanes=update_lanes,
+    inject_token=inject_token,
+    inject_tokens=inject_tokens,
+    zero_count_rows=zero_count_rows,
+    bump_counts=bump_counts,
+    seed_count_rows=seed_count_rows,
+    scatter_block_pages=scatter_block_pages,
+    slice_block_pages=slice_block_pages,
+    gather_layer_pages=gather_layer_pages,
+    scatter_layer_pages=scatter_layer_pages,
+)
+
+
 class JaxEngine:
     """Continuous-batching JAX engine over a paged KV cache."""
 
@@ -397,6 +441,18 @@ class JaxEngine:
         # at load), and long full prefills route through ring (sp) or
         # pipeline (pp) step functions.  Reference capability: engines.rs:43
         # MultiNodeConfig + dynamo-run flags.rs:82-100.
+        #
+        # With no explicit mesh, the engine builds its own dp x tp serving
+        # mesh from EngineConfig.tp/dp (DYN_TP / DYN_DP env overrides) and
+        # shards the params it was handed -- TP is an engine-startup knob,
+        # not a caller obligation (ROADMAP item 1).
+        if mesh is None:
+            mesh = self.resolve_mesh(self.cfg, model_cfg)
+            if mesh is not None:
+                from ..parallel.sharding import shard_params
+
+                params = shard_params(params, model_cfg, mesh)
+                self.params = params
         self.mesh = mesh
         self._dp = int(mesh.shape.get("dp", 1)) if mesh is not None else 1
         self._sp = int(mesh.shape.get("sp", 1)) if mesh is not None else 1
@@ -444,12 +500,30 @@ class JaxEngine:
             sharding=kv_sharding,
             allocator=pool,
         )
+        # serving-step dispatch table: module-level jits on one chip; on a
+        # dp/tp (/ep) mesh, re-jitted with explicit in/out shardings
+        # (params/KV over tp, decode state over dp) so GSPMD inserts the
+        # collectives and the KV pool can never be silently replicated.
+        # sp/pp meshes keep the propagation-based module jits: their
+        # shard_map prefill routes hand back arrays laid out over sp/pp
+        # (e.g. KV over the pp layer groups), which pinned decode
+        # shardings would reject at the very next dispatch.
+        if mesh is not None and self._sp <= 1 and self._pp <= 1:
+            from ..parallel.sharding import make_sharded_steps
+
+            self._fns = make_sharded_steps(
+                mesh, model_cfg, self.params, self.kv.pages,
+                self.cfg.max_batch_size,
+            )
+        else:
+            self._fns = _MODULE_STEPS
         self.sched = Scheduler(
             SchedulerConfig(
                 max_batch_size=self.cfg.max_batch_size,
                 max_seq_len=self.cfg.max_seq_len,
                 page_size=self.cfg.page_size,
                 block_size=self.cfg.block_size,
+                dp_groups=self._dp,
             ),
             self.kv.allocator,
         )
@@ -518,7 +592,14 @@ class JaxEngine:
         # overrides config so a deployment can retune without a restart flag
         import os as _os
 
-        self._mixed = bool(self.cfg.mixed_batching)
+        # sp/pp meshes pin mixed batching OFF: those axes exist to
+        # accelerate FULL prefills (ring attention / microbatched
+        # pipeline), and the unified mixed dispatch would swallow every
+        # prefill into a path that uses neither -- classic dispatch is
+        # what routes long prompts through _dispatch_parallel_prefill
+        self._mixed = bool(self.cfg.mixed_batching) and (
+            self._sp <= 1 and self._pp <= 1
+        )
         budget = self.cfg.mixed_token_budget
         env_budget = _os.environ.get("DYN_MIXED_TOKEN_BUDGET")
         if env_budget:
@@ -585,6 +666,36 @@ class JaxEngine:
 
     # -- lifecycle ----------------------------------------------------------
 
+    @staticmethod
+    def resolve_mesh(
+        cfg: Optional["EngineConfig"], model_cfg: ModelConfig
+    ) -> Optional[jax.sharding.Mesh]:
+        """The engine-startup dp x tp mesh from config + env, or None for
+        single-chip serving.  ``DYN_TP`` / ``DYN_DP`` win outright over
+        EngineConfig.tp/dp (a set ``DYN_TP=1`` disarms a config-armed tp);
+        the tp degree is validated against the model's head geometry
+        before any device is touched."""
+        from ..parallel.mesh import env_parallel_spec, serving_mesh
+
+        cfg = cfg or EngineConfig()
+        env = env_parallel_spec()
+        tp = env["tp"] if env["tp"] is not None else cfg.tp
+        dp = env["dp"] if env["dp"] is not None else cfg.dp
+        if max(tp, dp) <= 1:
+            return None
+        model_cfg.validate_tp(tp)
+        if dp > 1 and cfg.max_batch_size % dp:
+            # same fail-fast contract as validate_tp: an indivisible dp
+            # would drop the 'dp' axis from every decode-state spec
+            # (_compatible_spec) and disable balanced admission -- all dp
+            # chips then compute the full replicated batch while the
+            # operator believes the deployment is data-parallel
+            raise ValueError(
+                f"dp={dp} does not divide max_batch_size="
+                f"{cfg.max_batch_size}: batch lanes shard over dp"
+            )
+        return serving_mesh(tp=tp, dp=dp)
+
     @classmethod
     def random_init(
         cls,
@@ -593,6 +704,8 @@ class JaxEngine:
         seed: int = 0,
         mesh: Optional[jax.sharding.Mesh] = None,
     ) -> "JaxEngine":
+        if mesh is None:
+            mesh = cls.resolve_mesh(cfg, model_cfg)
         params = init_params(model_cfg, jax.random.PRNGKey(seed))
         if mesh is not None:
             from ..parallel.sharding import shard_params
@@ -606,12 +719,21 @@ class JaxEngine:
         model_path: str,
         cfg: Optional[EngineConfig] = None,
         mesh: Optional[jax.sharding.Mesh] = None,
+        model_cfg: Optional[ModelConfig] = None,
     ) -> "JaxEngine":
         import os
 
         from .weights import load_safetensors_params
 
-        model_cfg = ModelConfig.from_pretrained(model_path)
+        # callers that already parsed the config (cli validate_tp) pass it
+        # through instead of paying a second disk read+parse
+        if model_cfg is None:
+            model_cfg = ModelConfig.from_pretrained(model_path)
+        if mesh is None:
+            # engine-startup TP: shardings reach the streaming weight
+            # loader, so a 70B-class checkpoint loads straight into its
+            # per-chip slices instead of materializing whole tensors
+            mesh = cls.resolve_mesh(cfg, model_cfg)
         shardings = None
         if mesh is not None:
             from ..parallel.sharding import param_shardings
@@ -984,6 +1106,17 @@ class JaxEngine:
             self._wake.set()
         return True
 
+    @staticmethod
+    def _assemble_kv(arr) -> np.ndarray:
+        """Materialize a KV slice on host: per-shard head-slice gathers
+        reassembled for sharded pools (parallel.sharding.assemble_shards),
+        plain device_get otherwise.  Every export path routes through here
+        so the wire/offload blob format stays full-width regardless of the
+        serving mesh."""
+        from ..parallel.sharding import assemble_shards
+
+        return assemble_shards(arr)
+
     def _expected_blob_shape(self, seq: SeqState) -> Tuple[int, ...]:
         kp = self.kv.pages.shape  # [L, 2, num_pages, page, Hkv, D]
         n_pages = -(-len(seq.prompt) // self.cfg.page_size)
@@ -1144,7 +1277,7 @@ class JaxEngine:
         ids_dev = jnp.asarray(ids)
         for lo, hi, arr in parts:
             padded = pad_page_axis(np.asarray(arr), bucket)
-            self.kv.pages = scatter_layer_pages(
+            self.kv.pages = self._fns.scatter_layer_pages(
                 self.kv.pages,
                 jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
                 ids_dev,
@@ -1170,7 +1303,7 @@ class JaxEngine:
 
         _n_pages, bucket, ids = self._lane_scatter_ids(seq)
         padded = pad_page_axis(blob, bucket)
-        self.kv.pages = scatter_block_pages(
+        self.kv.pages = self._fns.scatter_block_pages(
             self.kv.pages, jnp.asarray(ids), jnp.asarray(padded)
         )
         return self._apply_external_commit(seq, first_token, lp_row)
@@ -1224,7 +1357,7 @@ class JaxEngine:
             seq = SeqState.from_request("export", req, self.sched.block_size)
             sampled = self._dispatch_full_prefill(seq, prompt, pages)
             ids = np.asarray(pages, np.int32)
-            blob = np.asarray(jax.device_get(self.kv.pages[:, :, ids]))
+            blob = self._assemble_kv(self.kv.pages[:, :, ids])
             # the full packed row (token | logprob | tops): delivery carries
             # it so a logprobs request's first token keeps its logprob
             row = np.asarray(jax.device_get(sampled))[0]
@@ -1264,10 +1397,15 @@ class JaxEngine:
             return results
 
         def materialize() -> List[Any]:
-            # ONE bundled device_get for every blob (a per-item get would
-            # pay one device round trip each on a high-RTT link)
             idx = [i for i, r in enumerate(results) if isinstance(r, tuple)]
-            blobs = jax.device_get([results[i][0] for i in idx])
+            if self.kv.shard_geometry is not None:
+                # sharded pool: each blob assembles from its per-shard
+                # head slices (one D2H per shard, no device all-gather)
+                blobs = [self._assemble_kv(results[i][0]) for i in idx]
+            else:
+                # ONE bundled device_get for every blob (a per-item get
+                # would pay one device round trip each on a high-RTT link)
+                blobs = jax.device_get([results[i][0] for i in idx])
             out: List[Any] = list(results)
             for i, blob in zip(idx, blobs):
                 out[i] = (np.asarray(blob), results[i][1])
@@ -1348,10 +1486,8 @@ class JaxEngine:
                 # below is safe), and only the first tokens come to host
                 blob_all = self.kv.pages[:, :, jnp.asarray(all_ids)]
             else:
-                # one transfer for the whole group's pages
-                blob_all = np.asarray(
-                    jax.device_get(self.kv.pages[:, :, all_ids])
-                )
+                # one transfer per shard for the whole group's pages
+                blob_all = self._assemble_kv(self.kv.pages[:, :, all_ids])
             firsts = np.asarray(jax.device_get(sampled))  # [Bp, 2 + 2N]
             off = 0
             for row, (i, pages) in enumerate(zip(group, allocated)):
@@ -1416,9 +1552,11 @@ class JaxEngine:
                 )
                 for i in group:
                     try:
-                        results[i] = KVExportStream.from_blob(
+                        res = KVExportStream.from_blob(
                             *self._prefill_export(reqs[i])
                         )
+                        res.shards = self.kv.shard_geometry
+                        results[i] = res
                     except Exception as exc:  # noqa: BLE001
                         results[i] = exc
         return results
@@ -1436,7 +1574,6 @@ class JaxEngine:
         program order) and nothing blocks on the bulk transfer here --
         only the tiny sampled rows come to host."""
         from .kv_cache import layer_chunk_spans
-        from .step import gather_layer_pages
 
         ps = self.cfg.page_size
         allocated: List[List[int]] = []
@@ -1471,7 +1608,7 @@ class JaxEngine:
             ids_dev = jnp.asarray(all_ids)
             span_devs: List[Any] = []
             for lo, hi in spans:
-                sl = gather_layer_pages(
+                sl = self._fns.gather_layer_pages(
                     self.kv.pages,
                     jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
                     ids_dev,
@@ -1489,6 +1626,7 @@ class JaxEngine:
                     dtype=str(self.kv.pages.dtype),
                     row=firsts[row],
                     spans=spans,
+                    shards=self.kv.shard_geometry,
                     _group=shared,
                     _page_off=off,
                 )
@@ -1527,9 +1665,7 @@ class JaxEngine:
                     all_ids = np.concatenate(
                         [np.asarray(b.pages, np.int32) for b in acquired]
                     )
-                    blob_all = np.asarray(
-                        jax.device_get(self.kv.pages[:, :, all_ids])
-                    )
+                    blob_all = self._assemble_kv(self.kv.pages[:, :, all_ids])
                     off = 0
                     for blk in acquired:
                         k = len(blk.pages)
@@ -2411,11 +2547,11 @@ class JaxEngine:
         ):
             pf.prompt_lp = self._dispatch_prompt_score(seq)
         self._pending_injects[seq.slot] = pf
-        self._dev["tokens"] = inject_token(self._dev["tokens"], seq.slot, tok)
+        self._dev["tokens"] = self._fns.inject_token(
+            self._dev["tokens"], seq.slot, tok
+        )
         if self._dev.get("counts") is not None:
-            from .step import bump_counts
-
-            self._dev["counts"] = bump_counts(
+            self._dev["counts"] = self._fns.bump_counts(
                 self._dev["counts"],
                 jnp.asarray([seq.slot], jnp.int32), tok,
             )
@@ -2473,13 +2609,11 @@ class JaxEngine:
         slots = np.full((Bp,), self.cfg.max_batch_size, np.int32)
         for i, (seq, _pl) in enumerate(items):
             slots[i] = seq.slot
-        self._dev["tokens"] = inject_tokens(
+        self._dev["tokens"] = self._fns.inject_tokens(
             self._dev["tokens"], jnp.asarray(slots), sampled[:Bp, 0]
         )
         if self._dev.get("counts") is not None:
-            from .step import bump_counts
-
-            self._dev["counts"] = bump_counts(
+            self._dev["counts"] = self._fns.bump_counts(
                 self._dev["counts"], jnp.asarray(slots), sampled[:Bp, 0]
             )
         entries: List[InflightPrefill] = []
@@ -2635,7 +2769,7 @@ class JaxEngine:
             freq,
             pres,
             rep,
-        ) = update_lanes(
+        ) = self._fns.update_lanes(
             d["tokens"],
             d["seq_lens"],
             d["limit_lens"],
@@ -2662,13 +2796,11 @@ class JaxEngine:
         # tokens of a still-uncommitted in-flight block are skipped, a
         # bounded one-block skew on a rare path)
         if d.get("counts") is not None and dirty:
-            from .step import seed_count_rows, zero_count_rows
-
             # the fixed-G padded slot array from above: a dirty-set-sized
             # array would compile one executable per distinct burst size
             # (pad slots are out of range; mode='drop' skips them), matching
             # update_lanes
-            d["counts"] = zero_count_rows(d["counts"], jnp.asarray(slots))
+            d["counts"] = self._fns.zero_count_rows(d["counts"], jnp.asarray(slots))
             for b in dirty:
                 seq = sched.slots[b]
                 if seq is None or not self._seq_penalized(seq):
@@ -2681,7 +2813,7 @@ class JaxEngine:
                 amounts = np.zeros((pad,), np.int32)
                 buf[: len(toks)] = toks
                 amounts[: len(toks)] = amts
-                d["counts"] = seed_count_rows(
+                d["counts"] = self._fns.seed_count_rows(
                     d["counts"], jnp.int32(b), jnp.asarray(buf),
                     jnp.asarray(amounts),
                 )
@@ -2698,9 +2830,9 @@ class JaxEngine:
                     del self._pending_injects[b]
         if len(injects) == 1:
             b, samp = injects[0]
-            d["tokens"] = inject_token(d["tokens"], jnp.int32(b), samp)
+            d["tokens"] = self._fns.inject_token(d["tokens"], jnp.int32(b), samp)
         elif injects:
-            d["tokens"] = inject_tokens(
+            d["tokens"] = self._fns.inject_tokens(
                 d["tokens"],
                 jnp.asarray(np.asarray([b for b, _ in injects], np.int32)),
                 jnp.concatenate([s for _, s in injects]),
@@ -2709,9 +2841,7 @@ class JaxEngine:
             # the re-applied first tokens follow the same rule as their
             # original injection: they are output, so they count (the lane
             # was just zeroed+reseeded above, so exactly once)
-            from .step import bump_counts
-
-            d["counts"] = bump_counts(
+            d["counts"] = self._fns.bump_counts(
                 d["counts"],
                 jnp.asarray(np.asarray([b for b, _ in injects], np.int32)),
                 jnp.concatenate([s for _, s in injects]),
@@ -2789,7 +2919,7 @@ class JaxEngine:
         # still device-only; re-apply those injections
         for slot, pf in list(self._pending_injects.items()):
             if sched.slots[slot] is pf.seq and pf.seq.finish is None:
-                self._dev["tokens"] = inject_token(
+                self._dev["tokens"] = self._fns.inject_token(
                     self._dev["tokens"], slot, pf.tok
                 )
             else:
@@ -2897,9 +3027,8 @@ class JaxEngine:
                 if self.sched.slots[slot] is pf.seq
             ]
             if pend:
-                from .step import bump_counts
 
-                d["counts"] = bump_counts(
+                d["counts"] = self._fns.bump_counts(
                     d["counts"],
                     jnp.asarray(
                         np.asarray([p[0] for p in pend], np.int32)
@@ -2916,7 +3045,7 @@ class JaxEngine:
             self.kv.pages,
             self._rng,
             counts_out,
-        ) = decode_block(
+        ) = self._fns.decode_block(
             self.params,
             self.model_cfg,
             self.kv.pages,
@@ -3038,7 +3167,7 @@ class JaxEngine:
             d["active"],
             self.kv.pages,
             self._rng,
-        ) = unified_step(
+        ) = self._fns.unified_step(
             self.params,
             self.model_cfg,
             self.kv.pages,
@@ -3193,7 +3322,7 @@ class JaxEngine:
         draft_s = time.perf_counter() - t_draft0
         # numpy copy of the page-table mirror for the same aliasing reason
         # as _push_device_state: the scheduler mutates it on later ticks
-        sampled, self.kv.pages = verify_and_sample(
+        sampled, self.kv.pages = self._fns.verify_and_sample(
             self.params,
             self.model_cfg,
             self.kv.pages,
@@ -3267,10 +3396,9 @@ class JaxEngine:
         if self.offload_engine is None:
             return
         from ..offload import BlockMeta
-        from .step import slice_block_pages
 
         try:
-            snap = slice_block_pages(
+            snap = self._fns.slice_block_pages(
                 self.kv.pages, jnp.asarray(blk.pages, jnp.int32)
             )
             _start_host_copy(snap)
@@ -3278,6 +3406,7 @@ class JaxEngine:
                 block_hash=blk.block_hash,
                 parent_sequence_hash=blk.parent_sequence_hash,
                 position=blk.position,
+                shards=self.kv.shard_geometry,
             )
             self.offload_engine.submit_evict(blk.sequence_hash, snap, meta)
         except Exception:
@@ -3330,7 +3459,7 @@ class JaxEngine:
         L = int(blob.shape[0])
         t0 = time.perf_counter()
         for lo, hi in layer_chunk_spans(L, None, DEFAULT_EXPORT_CHUNKS):
-            self.kv.pages = scatter_layer_pages(
+            self.kv.pages = self._fns.scatter_layer_pages(
                 self.kv.pages,
                 jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
                 ids_dev,
@@ -3402,13 +3531,14 @@ class JaxEngine:
         n_blocks = -(-n_pages // self.sched.pages_per_block)
         try:
             ids = jnp.asarray(np.asarray(seq.pages[:n_pages], np.int32))
-            snap = slice_block_pages(self.kv.pages, ids)
+            snap = self._fns.slice_block_pages(self.kv.pages, ids)
             _start_host_copy(snap)
         except Exception:
             logger.debug("swap snapshot dispatch failed", exc_info=True)
             return False
         if not self.offload_engine.swap_out(
-            seq.request_id, snap, cache_len, n_blocks
+            seq.request_id, snap, cache_len, n_blocks,
+            shards=self.kv.shard_geometry,
         ):
             return False
         self._swapped[seq.request_id] = seq
@@ -3497,6 +3627,13 @@ class JaxEngine:
                 # blob is not ready yet: retry next tick
                 self._swapped[rid] = seq
                 return
+            if rec.shards != self.kv.shard_geometry:
+                # snapshot from a differently-sharded pool (engine restart
+                # with a new tp degree mid-park): the full-width blob is
+                # still scatterable, but the device-side fast path aliases
+                # the OLD layout -- recompute is the only safe restore
+                self._swap_recompute(seq, "shard_geometry")
+                return
             cache_len = rec.cache_len
             ps = self.cfg.page_size
             n_pages = -(-cache_len // ps)
@@ -3516,7 +3653,7 @@ class JaxEngine:
             L = int(blob.shape[0])
             t0 = time.perf_counter()
             for lo, hi in layer_chunk_spans(L, None, DEFAULT_EXPORT_CHUNKS):
-                self.kv.pages = scatter_layer_pages(
+                self.kv.pages = self._fns.scatter_layer_pages(
                     self.kv.pages,
                     jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
                     ids_dev,

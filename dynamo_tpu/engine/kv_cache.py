@@ -113,6 +113,16 @@ class PagedKVCache:
         total = self.num_pages - 1
         return self.allocator.used_pages / total if total else 0.0
 
+    @property
+    def shard_geometry(self):
+        """``{"axis": i, "parts": n}`` when the pool is sharded (tp: kv
+        heads on axis 4), else None.  Every KV blob leaving the device
+        (disagg export, offload tiers, swap snapshots) records this so
+        restore sites can assert pool compatibility."""
+        from ..parallel.sharding import kv_shard_geometry
+
+        return kv_shard_geometry(self.pages)
+
 
 def layer_chunk_spans(
     num_layers: int,

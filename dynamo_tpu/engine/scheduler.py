@@ -46,6 +46,13 @@ class SchedulerConfig:
     # KV block size for router-visible block identity (token hashing); usually
     # equals page_size but decoupled (reference recommends 128 for routing).
     block_size: Optional[int] = None
+    # data-parallel groups of the serving mesh: slot b belongs to dp group
+    # b // (max_batch_size / dp_groups), because the engine's decode-state
+    # arrays shard batch-major over ``dp``.  Admission balances lanes
+    # across groups (see _free_slot) so one dp shard never carries the
+    # whole batch while its peers idle -- per-chip throughput under
+    # partial load depends on it.  1 = no mesh, first-free admission.
+    dp_groups: int = 1
 
 
 @dataclass
@@ -511,10 +518,31 @@ class Scheduler:
         seq.pending_register = list(seq.blocks.blocks[n_reused:n_prompt_blocks])
 
     def _free_slot(self) -> Optional[int]:
-        for i, s in enumerate(self.slots):
-            if s is None:
-                return i
-        return None
+        dp = self.cfg.dp_groups
+        B = self.cfg.max_batch_size
+        if dp <= 1 or B % dp:
+            for i, s in enumerate(self.slots):
+                if s is None:
+                    return i
+            return None
+        # dp-balanced admission: pick the first free slot of the
+        # least-occupied dp group (ties -> lowest group, preserving the
+        # deterministic first-free order within a group).  The decode batch
+        # shards batch-major over dp, so an unbalanced fill would leave
+        # whole chips stepping empty lanes while one group saturates.
+        per = B // dp
+        best: Optional[int] = None
+        best_load = per + 1
+        for g in range(dp):
+            lanes = self.slots[g * per : (g + 1) * per]
+            load = sum(1 for s in lanes if s is not None)
+            if load >= per or load >= best_load:
+                continue
+            best = g * per + next(
+                i for i, s in enumerate(lanes) if s is None
+            )
+            best_load = load
+        return best
 
     def _write_slot_arrays(self, seq: SeqState) -> None:
         b = seq.slot

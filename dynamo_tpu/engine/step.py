@@ -116,13 +116,7 @@ decode_step = partial(jax.jit, static_argnames=("cfg",), donate_argnames=("kv_pa
 )
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "num_steps", "use_filters", "top_n",
-                     "use_penalties"),
-    donate_argnames=("kv_pages", "counts"),
-)
-def decode_block(
+def _decode_block(
     params: Params,
     cfg: ModelConfig,
     kv_pages: jax.Array,
@@ -225,12 +219,17 @@ def decode_block(
     )
 
 
-@partial(
+# the serving entry point: the raw implementation re-jits with explicit
+# in/out shardings for multichip meshes (parallel.sharding.make_sharded_steps)
+decode_block = partial(
     jax.jit,
-    static_argnames=("cfg", "top_n", "use_filters"),
-    donate_argnames=("kv_pages",),
-)
-def verify_and_sample(
+    static_argnames=("cfg", "num_steps", "use_filters", "top_n",
+                     "use_penalties"),
+    donate_argnames=("kv_pages", "counts"),
+)(_decode_block)
+
+
+def _verify_and_sample(
     params: Params,
     cfg: ModelConfig,
     kv_pages: jax.Array,
@@ -293,12 +292,14 @@ def verify_and_sample(
     return jnp.stack(cols, axis=1), kv_pages
 
 
-@partial(
+verify_and_sample = partial(
     jax.jit,
     static_argnames=("cfg", "top_n", "use_filters"),
-    donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
-)
-def unified_step(
+    donate_argnames=("kv_pages",),
+)(_verify_and_sample)
+
+
+def _unified_step(
     params: Params,
     cfg: ModelConfig,
     kv_pages: jax.Array,
@@ -401,6 +402,13 @@ def unified_step(
     out = jnp.where(live, sampled, -1)
     packed = pack_sampled_logprobs(out, lp, top_ids, top_lps)
     return packed, new_tokens, new_seq, new_active, kv_pages, rng
+
+
+unified_step = partial(
+    jax.jit,
+    static_argnames=("cfg", "top_n", "use_filters"),
+    donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
+)(_unified_step)
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_n"))
@@ -661,15 +669,16 @@ def embed_step(
     return pooled / jnp.maximum(norm, 1e-9)
 
 
-@partial(jax.jit, donate_argnames=("tokens",))
-def inject_token(tokens: jax.Array, slot: jax.Array, token: jax.Array) -> jax.Array:
+def _inject_token(tokens: jax.Array, slot: jax.Array, token: jax.Array) -> jax.Array:
     """Scatter a freshly-prefilled lane's first token into the device-resident
     decode token vector (dynamic slot index -> one cached executable)."""
     return tokens.at[slot].set(token[0])
 
 
-@partial(jax.jit, donate_argnames=("tokens",))
-def inject_tokens(
+inject_token = partial(jax.jit, donate_argnames=("tokens",))(_inject_token)
+
+
+def _inject_tokens(
     tokens: jax.Array,  # [B]
     slots: jax.Array,  # [G] lane indices; out-of-range rows are pad (dropped)
     toks: jax.Array,  # [G]
@@ -681,15 +690,18 @@ def inject_tokens(
     return tokens.at[slots].set(toks, mode="drop")
 
 
-@partial(
-    jax.jit,
-    donate_argnames=(
-        "tokens", "seq_lens", "limit_lens", "active", "stop_ids",
-        "page_table", "temp", "top_p", "top_k", "seed", "freq", "pres",
-        "rep",
-    ),
+inject_tokens = partial(jax.jit, donate_argnames=("tokens",))(_inject_tokens)
+
+# donated decode-state arrays of the lane-scatter path: the one donation
+# list shared by the module jit below and the sharded re-jit
+UPDATE_LANES_DONATED = (
+    "tokens", "seq_lens", "limit_lens", "active", "stop_ids",
+    "page_table", "temp", "top_p", "top_k", "seed", "freq", "pres",
+    "rep",
 )
-def update_lanes(
+
+
+def _update_lanes(
     tokens: jax.Array,  # [B]
     seq_lens: jax.Array,  # [B]
     limit_lens: jax.Array,  # [B]
@@ -738,15 +750,23 @@ def update_lanes(
     )
 
 
-@partial(jax.jit, donate_argnames=("counts",))
-def zero_count_rows(counts: jax.Array, slots: jax.Array) -> jax.Array:
+update_lanes = partial(jax.jit, donate_argnames=UPDATE_LANES_DONATED)(
+    _update_lanes
+)
+
+
+def _zero_count_rows(counts: jax.Array, slots: jax.Array) -> jax.Array:
     """Zero the generated-token histograms of re-assigned lanes (penalty
     state; out-of-range pad slots drop)."""
     return counts.at[slots].set(0, mode="drop")
 
 
-@partial(jax.jit, donate_argnames=("counts",))
-def bump_counts(
+zero_count_rows = partial(jax.jit, donate_argnames=("counts",))(
+    _zero_count_rows
+)
+
+
+def _bump_counts(
     counts: jax.Array,  # [B, V]
     slots: jax.Array,  # [G] lane indices (out-of-range pads drop)
     toks: jax.Array,  # [G] token ids (device values fine)
@@ -756,8 +776,10 @@ def bump_counts(
     return counts.at[slots, toks].add(1, mode="drop")
 
 
-@partial(jax.jit, donate_argnames=("counts",))
-def seed_count_rows(
+bump_counts = partial(jax.jit, donate_argnames=("counts",))(_bump_counts)
+
+
+def _seed_count_rows(
     counts: jax.Array,  # [B, V]
     slot: jax.Array,  # scalar i32
     toks: jax.Array,  # [Tpad] history tokens (pow2-padded)
@@ -769,8 +791,12 @@ def seed_count_rows(
     return counts.at[slot, toks].add(amounts, mode="drop")
 
 
-@partial(jax.jit, donate_argnames=("kv_pages",))
-def scatter_block_pages(
+seed_count_rows = partial(jax.jit, donate_argnames=("counts",))(
+    _seed_count_rows
+)
+
+
+def _scatter_block_pages(
     kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     ids: jax.Array,  # [pages_per_block] page ids
     blob: jax.Array,  # [L, 2, pages_per_block, page, Hkv, D]
@@ -780,12 +806,19 @@ def scatter_block_pages(
     return kv_pages.at[:, :, ids].set(blob.astype(kv_pages.dtype))
 
 
-@jax.jit
-def slice_block_pages(kv_pages: jax.Array, ids: jax.Array) -> jax.Array:
+scatter_block_pages = partial(jax.jit, donate_argnames=("kv_pages",))(
+    _scatter_block_pages
+)
+
+
+def _slice_block_pages(kv_pages: jax.Array, ids: jax.Array) -> jax.Array:
     """Read a block's pages (pre-eviction snapshot for G1 -> G2 demotion).
     Dispatched before the free-list reuses the pages, so device program
     order guarantees it reads the pre-reuse contents."""
     return kv_pages[:, :, ids]
+
+
+slice_block_pages = jax.jit(_slice_block_pages)
 
 
 # Layer-range variants of slice/scatter_block_pages -- the chunked KV

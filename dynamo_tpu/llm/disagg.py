@@ -555,6 +555,19 @@ class DisaggDecodeEngine:
 
         meta = hdr.get("meta") or {}
         rid = meta["request_id"]
+        if "kv_shards" in meta:
+            # the blob is full-width regardless of the sender's mesh (per-
+            # shard slices reassemble at export), so a geometry difference
+            # is legal -- surfaced for operators diagnosing cross-mesh
+            # prefill/decode pools (e.g. tp=8 prefill feeding tp=4 decode)
+            local = getattr(
+                getattr(self.engine, "kv", None), "shard_geometry", None
+            )
+            if meta["kv_shards"] != local:
+                logger.debug(
+                    "cross-mesh KV delivery for %s: prefill shards %s, "
+                    "decode shards %s", rid, meta["kv_shards"], local,
+                )
         ok = False
         if meta.get("error"):
             # prefill worker reporting failure: fail the parked lane now
@@ -830,6 +843,13 @@ class PrefillWorker:
                 # the loop hot re-raising the same error
                 await asyncio.sleep(0.5)
 
+    def _kv_shard_geometry(self):
+        """The prefill engine's KV shard geometry (None for unsharded /
+        non-JaxEngine backends) -- stamped into delivery meta so a decode
+        worker can see which mesh produced the blob."""
+        kv = getattr(self.engine, "kv", None)
+        return getattr(kv, "shard_geometry", None)
+
     def _local_engine(self, msg: Dict[str, Any]):
         if not self.allow_local:
             return None
@@ -974,6 +994,9 @@ class PrefillWorker:
                 "first_token": first,
                 "lp_row": lp_row,
             }
+            shards = self._kv_shard_geometry()
+            if shards is not None:
+                meta["kv_shards"] = shards
             if not isinstance(blob, np.ndarray):
                 # mixed batch: a device export targeting a remote decode
                 # worker still ships over the wire
@@ -1025,6 +1048,10 @@ class PrefillWorker:
                 "total_bytes": stream.nbytes,
             },
         }
+        if stream.shards is not None:
+            # exporting-pool shard geometry (tp: kv heads sharded); blobs
+            # are full-width -- provenance for the decode-side check
+            meta["kv_shards"] = stream.shards
 
         async def frames() -> AsyncIterator[bytes]:
             truncated = False

@@ -63,20 +63,31 @@ class BlockMeta:
     block_hash: int = 0
     parent_sequence_hash: int = 0
     position: int = 0
+    # shard geometry of the pool the blob was exported from ({"axis": i,
+    # "parts": n}, parallel.sharding.kv_shard_geometry) -- None for an
+    # unsharded pool.  Tier blobs are always full-width (per-shard slices
+    # reassemble on export), so this is provenance for restore-site
+    # validation, not a layout switch.
+    shards: Optional[Dict[str, int]] = None
 
-    def to_dict(self) -> Dict[str, int]:
-        return {
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
             "block_hash": self.block_hash,
             "parent_sequence_hash": self.parent_sequence_hash,
             "position": self.position,
         }
+        if self.shards is not None:
+            out["shards"] = dict(self.shards)
+        return out
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "BlockMeta":
+        shards = d.get("shards")
         return cls(
             int(d.get("block_hash", 0)),
             int(d.get("parent_sequence_hash", 0)),
             int(d.get("position", 0)),
+            dict(shards) if shards else None,
         )
 
 
@@ -412,6 +423,9 @@ class SwapRecord:
 
     cache_len: int
     n_blocks: int  # block-equivalents charged against the swap budget
+    # shard geometry of the source pool at snapshot time (provenance for
+    # the restore-side compatibility check; blobs are full-width)
+    shards: Optional[Dict[str, int]] = None
     state: str = SWAP_PENDING
     dev: Any = None  # device-resident staging copy (fast-path restore)
     blob: Optional[np.ndarray] = None
@@ -672,7 +686,8 @@ class KVOffloadEngine:
     # -- swap records (preempted-sequence KV) --------------------------------
 
     def swap_out(
-        self, request_id: str, snap: Any, cache_len: int, n_blocks: int
+        self, request_id: str, snap: Any, cache_len: int, n_blocks: int,
+        shards: Optional[Dict[str, int]] = None,
     ) -> bool:
         """Reserve budget and park a preemption snapshot.  The device copy
         is retained (within ``swap_device_blocks``) so a short park can
@@ -707,6 +722,7 @@ class KVOffloadEngine:
             self._swaps[request_id] = SwapRecord(
                 cache_len=cache_len,
                 n_blocks=n_blocks,
+                shards=dict(shards) if shards else None,
                 dev=snap if keep_dev else None,
             )
         self.swap_outs += 1

@@ -209,8 +209,7 @@ def paged_decode_attention(
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def gather_layer_pages(
+def _gather_layer_pages(
     kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     layer_ids: jax.Array,  # [Lg] layer indices of the chunk
     page_ids: jax.Array,  # [P] page ids to export
@@ -225,8 +224,10 @@ def gather_layer_pages(
     return kv_pages[li, ki, pi]
 
 
-@functools.partial(jax.jit, donate_argnames=("kv_pages",))
-def scatter_layer_pages(
+gather_layer_pages = jax.jit(_gather_layer_pages)
+
+
+def _scatter_layer_pages(
     kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
     layer_ids: jax.Array,  # [Lg] layer indices of the chunk
     page_ids: jax.Array,  # [P] destination page ids (pad entries -> page 0)
@@ -239,3 +240,8 @@ def scatter_layer_pages(
     ki = jnp.arange(2)[None, :, None]
     pi = page_ids[None, None, :]
     return kv_pages.at[li, ki, pi].set(blob.astype(kv_pages.dtype))
+
+
+scatter_layer_pages = functools.partial(
+    jax.jit, donate_argnames=("kv_pages",)
+)(_scatter_layer_pages)

@@ -58,3 +58,64 @@ def build_mesh(
 
 def single_device_mesh() -> Mesh:
     return build_mesh(MeshConfig())
+
+
+def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` moved out of experimental AND renamed its
+    replication-check kwarg (``check_rep`` -> ``check_vma``) across the
+    jax versions this repo must serve on (TPU driver vs CI container).
+    Resolve whichever this runtime carries and disable the check under
+    its local name (the per-shard bodies here return intentionally
+    stage-local values that the checker would reject)."""
+    import inspect
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    kwargs = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    params = inspect.signature(fn).parameters
+    if "check_vma" in params:
+        kwargs["check_vma"] = False
+    elif "check_rep" in params:
+        kwargs["check_rep"] = False
+    return fn(f, **kwargs)
+
+
+def serving_mesh(
+    tp: int = 1, dp: int = 1, devices: Optional[Sequence[jax.Device]] = None
+) -> Optional[Mesh]:
+    """The engine-startup mesh: dp x tp over the local devices, or None
+    when both degrees are 1 (single-chip serving pays zero mesh
+    machinery).  Raises when the process cannot see enough devices --
+    a silently-shrunk mesh would serve with replicated params and report
+    multi-chip throughput it is not getting."""
+    tp, dp = max(int(tp), 1), max(int(dp), 1)
+    if tp == 1 and dp == 1:
+        return None
+    return build_mesh(MeshConfig(dp=dp, tp=tp), devices)
+
+
+def env_parallel_spec() -> dict:
+    """``DYN_TP`` / ``DYN_DP`` -> {"tp": n | None, "dp": n | None}: the
+    deployment-side override for engine-startup tensor/data parallelism
+    (mirrors the DYN_KV_OFFLOAD pattern -- arm the plane without touching
+    config).  None means the variable is unset and config decides; a set
+    value wins outright, so ``DYN_TP=1`` disarms a config-armed tp.  An
+    unparsable value raises: a typo silently falling back to config would
+    serve single-chip while the operator believes TP is armed -- the
+    worst kind of disarm, since the output is identical either way."""
+    import os
+
+    out = {}
+    for key, name in (("tp", "DYN_TP"), ("dp", "DYN_DP")):
+        raw = os.environ.get(name)
+        if raw is None or raw.strip() == "":
+            out[key] = None
+            continue
+        try:
+            out[key] = max(int(raw), 1)
+        except ValueError:
+            raise ValueError(
+                f"{name}={raw!r} is not an integer parallel degree"
+            ) from None
+    return out

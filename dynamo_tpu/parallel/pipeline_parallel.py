@@ -38,6 +38,7 @@ from ..engine.model import (
     rope_cos_sin,
     scan_layers,
 )
+from .mesh import shard_map_compat
 
 
 @partial(
@@ -114,12 +115,11 @@ def pp_prefill_step(
         # only the last stage wrote non-zeros; psum replicates the result
         return jax.lax.psum(out, axis_name), kv
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         stage,
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(), P(), P(), P(), P()),
         out_specs=(P(), P(axis_name)),
-        check_vma=False,
     )
     hidden_mb, kv_pages = fn(
         params["layers"], kv_pages, x_mb, cos_mb, sin_mb, pt_mb, lens_mb

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..engine import attention as att
 from ..engine.config import ModelConfig
 from ..engine.model import Params, lm_logits, transformer
+from .mesh import shard_map_compat
 
 _NEG_INF = -1e30
 
@@ -105,7 +106,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp", window: int = 0):
     axis_size = mesh.shape[axis_name]
     spec = P(None, axis_name, None, None)
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         partial(
             ring_attention_chunk, axis_name=axis_name, axis_size=axis_size,
             window=window,
@@ -113,7 +114,6 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp", window: int = 0):
         mesh=mesh,
         in_specs=(spec, spec, spec, P(None)),
         out_specs=spec,
-        check_vma=False,
     )
 
     def ring_attn(q, k, v, seq_lens):

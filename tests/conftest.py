@@ -39,6 +39,14 @@ from dynamo_tpu.tokens.hashing import ensure_native_built  # noqa: E402
 ensure_native_built()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: cold-compile storms / soaks excluded from tier-1 "
+        "(run with -m slow)",
+    )
+
+
 @pytest.fixture
 def run():
     """Run an async test body on a fresh event loop."""

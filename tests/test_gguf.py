@@ -344,10 +344,14 @@ def test_gguf_config_from_metadata(gguf_checkpoint):
     assert not cfg.tie_word_embeddings
 
 
+@pytest.mark.slow
 def test_gguf_weights_match_torch_forward(gguf_checkpoint):
     """Dequantized GGUF weights through the engine trunk vs the torch
     forward: Q8_0/Q4_0 round trips bound the error, the un-permutation of
-    q/k must be exact or rope scrambles the logits entirely."""
+    q/k must be exact or rope scrambles the logits entirely.
+
+    Slow lane: imports torch and cold-compiles the f32 scoring graph for
+    a parity check that guards a loader, not the serving path."""
     import numpy as np
     import torch as _torch
 

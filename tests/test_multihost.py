@@ -103,8 +103,21 @@ def test_two_process_world_runs_sharded_computation(tmp_path):
     finally:
         for p in procs:
             p.kill()
+    if any(_CPU_NO_MULTIPROCESS in out for out in outs):
+        pytest.skip(
+            "this jax's CPU backend has no multiprocess collectives "
+            "(newer jax ships a gloo-backed cross-host CPU path)"
+        )
     for rank, out in enumerate(outs):
         assert f"rank {rank} OK total=496.0" in out, f"rank {rank}:\n{out}"
+
+
+# jax < 0.5-era CPU backends refuse cross-process computations outright;
+# the 2-process tests probe for this runtime capability rather than pin a
+# version (the TPU driver environment has it, some CI containers do not)
+_CPU_NO_MULTIPROCESS = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
 
 
 _SERVE_WORKER = """
@@ -159,11 +172,15 @@ print("rank %d SERVE OK %s" % (cfg_mn.node_rank, outs), flush=True)
 """
 
 
+@pytest.mark.slow
 def test_two_process_served_engine_matches_single(tmp_path):
     """The v5e-pod serving path: two jax.distributed processes build a
     dp=2 x tp=2 mesh spanning both, and the ENGINE's generate() surface
     serves identical greedy requests collectively -- output must match a
-    single-process unsharded engine with the same seed (VERDICT r4 #7)."""
+    single-process unsharded engine with the same seed (VERDICT r4 #7).
+
+    Slow lane: the two cold processes re-compile every serving executable
+    on one CI core (the 900 s timeout exists for exactly that storm)."""
     import asyncio
     import json
 
@@ -243,5 +260,10 @@ def test_two_process_served_engine_matches_single(tmp_path):
     finally:
         for p in procs:
             p.kill()
+    if any(_CPU_NO_MULTIPROCESS in out for out in outs):
+        pytest.skip(
+            "this jax's CPU backend has no multiprocess collectives "
+            "(newer jax ships a gloo-backed cross-host CPU path)"
+        )
     for rank, out in enumerate(outs):
         assert f"rank {rank} SERVE OK" in out, f"rank {rank}:\n{out}"

@@ -104,8 +104,12 @@ def _hf_greedy_text(model, tokenizer, prompt: str, n: int) -> str:
     return tokenizer.decode(out[0][len(ids):].tolist())
 
 
+@pytest.mark.slow
 def test_served_greedy_transcript_matches_transformers(checkpoint, run):
-    """HTTP -> engine -> detok on a real checkpoint == transformers.generate."""
+    """HTTP -> engine -> detok on a real checkpoint == transformers.generate.
+
+    Slow lane: a full HTTP service over a fresh from_pretrained engine
+    cold-compiles the whole serving executable set."""
     path, model = checkpoint
     tok = Tokenizer.from_model_dir(path)
     prompts = ["the quick brown", "perplexity measures how"]
@@ -141,11 +145,15 @@ def test_served_greedy_transcript_matches_transformers(checkpoint, run):
     assert got == expected
 
 
+@pytest.mark.slow
 def test_served_int8_real_checkpoint(checkpoint, run):
     """The int8 path serves the real checkpoint end to end over HTTP
     (transcript-level quality is pinned by the perplexity-delta test --
     a tiny model's near-uniform logits make exact int8 transcripts
-    brittle by construction)."""
+    brittle by construction).
+
+    Slow lane: second full cold-compile of the served int8 executable
+    set (see test_served_greedy_transcript_matches_transformers)."""
     path, _model = checkpoint
     tok = Tokenizer.from_model_dir(path)
 

@@ -761,7 +761,13 @@ def test_http_logprobs_full_stack(model_dir, run):
     assert len(lp["token_logprobs"]) == 5
     assert all(v <= 0.0 for v in lp["token_logprobs"])
     assert len(lp["top_logprobs"]) == 5
-    assert all(len(t) == 2 for t in lp["top_logprobs"])
+    # top-2 alternatives per position, EXCEPT that duplicate detok strings
+    # collapse first-wins (documented completions behavior: two token ids
+    # detokenizing identically share one text key) -- with random tiny
+    # weights a collision can land on any position, so the bound is <= 2
+    # with at least one collision-free position keeping the width honest
+    assert all(1 <= len(t) <= 2 for t in lp["top_logprobs"])
+    assert any(len(t) == 2 for t in lp["top_logprobs"])
     assert lp["text_offset"][0] == 0
     assert lp["text_offset"] == sorted(lp["text_offset"])
     # greedy: the chosen token's logprob equals its top-alternative entry

@@ -109,12 +109,21 @@ def test_soak_churn_cancel_and_worker_death(run):
         # steady state after churn: fresh requests all succeed and spread
         # across the two live workers
         before_b, before_c = eng_b.served, eng_c.served
+        failed_at_kill = done["failed"]
         await asyncio.gather(*[one(1000 + j) for j in range(20)])
         assert eng_b.served > before_b and eng_c.served > before_c
 
         total = sum(done.values())
         assert total == 170
-        assert done["full"] + done["cancelled"] >= 150  # failures only near the kill
+        # "failures only near the kill", asserted sharply: ZERO failures
+        # once the router view recovered (the 20 steady-state requests
+        # above), and the kill-window count bounded by worker A's
+        # round-robin share of the waves in flight before its death is
+        # noticed (~13/wave; how many waves that spans tracks host load,
+        # so the ceiling is the A-share of ALL six waves, not a guess at
+        # detection latency)
+        assert done["failed"] == failed_at_kill, done
+        assert done["failed"] <= 75, done
         assert done["full"] > 0 and done["cancelled"] > 0
 
         await caller.shutdown()

@@ -1,0 +1,436 @@
+"""Engine-startup tensor parallelism (ROADMAP item 1): the TP serving path
+end to end on a virtual-CPU mesh.
+
+The engine builds its own dp x tp mesh from ``EngineConfig.tp/dp`` (or
+``DYN_TP``/``DYN_DP``), shards params and the paged KV pool, and re-jits
+the serving steps with explicit in/out shardings
+(``parallel.sharding.make_sharded_steps``).  These tests pin the tentpole
+contract: a TP worker's served output is BIT-identical to tp=1 for greedy
+and seeded lanes, every param path carries a sharding rule, blobs leaving
+the device reassemble full-width from per-shard head slices, and admission
+balances lanes across dp groups.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.engine.kv_cache import PageAllocator
+from dynamo_tpu.engine.model import init_params
+from dynamo_tpu.engine.scheduler import Scheduler, SchedulerConfig, SeqState
+from dynamo_tpu.parallel.mesh import MeshConfig, build_mesh
+from dynamo_tpu.parallel.sharding import (
+    _compatible_spec,
+    _flatten_with_paths,
+    assemble_shards,
+    batch_pspecs,
+    kv_pspec,
+    kv_shard_geometry,
+    param_pspecs,
+    shard_kv,
+    shard_params,
+)
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tests.test_jax_engine import collect, req
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs >= 4 (virtual) devices"
+)
+
+PROMPTS = [
+    [1, 2, 3, 4, 5],
+    [9, 8, 7],
+    [3, 3, 3, 3, 3, 3, 3, 3],
+    [5, 1],
+]
+
+
+def _engine(tp=1, dp=1, model=None, **cfg_kw):
+    defaults = dict(
+        max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64,
+        tp=tp, dp=dp, seed=0,
+    )
+    defaults.update(cfg_kw)
+    return JaxEngine.random_init(
+        model or ModelConfig.tiny(), EngineConfig(**defaults)
+    )
+
+
+def _seeded_req(tokens, seed, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(
+            temperature=1.0, top_p=0.9, seed=seed
+        ),
+    )
+
+
+def _assert_tp_engaged(engine, tp):
+    """The KV pool must actually shard over tp -- a divisibility fallback
+    would replicate it and the identity assert below would pass while
+    measuring nothing."""
+    spec = engine.kv.pages.sharding.spec
+    assert "tp" in [ax for ax in spec if ax], spec
+    assert engine.kv.shard_geometry == {"axis": 4, "parts": tp}
+
+
+# ---------------------------------------------------------------------------
+# tentpole: served output bit-identical tp=1 vs tp>1, greedy and seeded
+# ---------------------------------------------------------------------------
+
+
+def test_tp_engine_bit_identical_greedy(run):
+    """EngineConfig.tp alone (no explicit mesh: the engine-startup path)
+    serves a concurrent greedy batch bit-identically to tp=1."""
+
+    async def body():
+        plain = _engine()
+        try:
+            assert plain.mesh is None  # tp=1 pays zero mesh machinery
+            expect = [
+                (await collect(plain, req(p, max_tokens=6)))[0]
+                for p in PROMPTS
+            ]
+        finally:
+            await plain.stop()
+
+        for tp in (2, 4):
+            model = (
+                None if tp == 2 else ModelConfig.tiny(num_kv_heads=4)
+            )
+            if tp == 4:
+                plain4 = _engine(model=ModelConfig.tiny(num_kv_heads=4))
+                try:
+                    expect4 = [
+                        (await collect(plain4, req(p, max_tokens=6)))[0]
+                        for p in PROMPTS
+                    ]
+                finally:
+                    await plain4.stop()
+            sharded = _engine(tp=tp, model=model)
+            try:
+                _assert_tp_engaged(sharded, tp)
+                got = await asyncio.gather(
+                    *[collect(sharded, req(p, max_tokens=6)) for p in PROMPTS]
+                )
+                assert [g[0] for g in got] == (
+                    expect if tp == 2 else expect4
+                )
+            finally:
+                await sharded.stop()
+
+    run(body())
+
+
+def test_tp_engine_bit_identical_seeded(run):
+    """Seeded (temperature>0) lanes are bit-identical too: the per-lane
+    counter-based sampling keys are placement-independent, so the sharded
+    sampler must draw exactly the plain engine's tokens."""
+
+    async def body():
+        plain = _engine()
+        try:
+            expect = [
+                (await collect(plain, _seeded_req(p, seed=11 + i)))[0]
+                for i, p in enumerate(PROMPTS)
+            ]
+        finally:
+            await plain.stop()
+
+        sharded = _engine(tp=2)
+        try:
+            _assert_tp_engaged(sharded, 2)
+            got = await asyncio.gather(
+                *[
+                    collect(sharded, _seeded_req(p, seed=11 + i))
+                    for i, p in enumerate(PROMPTS)
+                ]
+            )
+            assert [g[0] for g in got] == expect
+        finally:
+            await sharded.stop()
+
+    run(body())
+
+
+def test_dp_tp_engine_bit_identical(run):
+    """dp x tp together (dp=2, tp=2): batch lanes shard over dp, heads and
+    KV over tp; output still bit-identical."""
+
+    async def body():
+        plain = _engine()
+        try:
+            expect = [
+                (await collect(plain, req(p, max_tokens=6)))[0]
+                for p in PROMPTS
+            ]
+        finally:
+            await plain.stop()
+
+        sharded = _engine(tp=2, dp=2)
+        try:
+            _assert_tp_engaged(sharded, 2)
+            got = await asyncio.gather(
+                *[collect(sharded, req(p, max_tokens=6)) for p in PROMPTS]
+            )
+            assert [g[0] for g in got] == expect
+        finally:
+            await sharded.stop()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# startup knobs: env arming, head-geometry validation
+# ---------------------------------------------------------------------------
+
+
+def test_dyn_tp_env_wins_over_config(monkeypatch):
+    cfg = ModelConfig.tiny()
+    # env arms TP with a default config
+    monkeypatch.setenv("DYN_TP", "2")
+    mesh = JaxEngine.resolve_mesh(EngineConfig(), cfg)
+    assert mesh is not None and mesh.shape["tp"] == 2
+    # a set DYN_TP=1 disarms a config-armed tp
+    monkeypatch.setenv("DYN_TP", "1")
+    assert JaxEngine.resolve_mesh(EngineConfig(tp=2), cfg) is None
+    # unset: config decides
+    monkeypatch.delenv("DYN_TP")
+    mesh = JaxEngine.resolve_mesh(EngineConfig(tp=2), cfg)
+    assert mesh is not None and mesh.shape["tp"] == 2
+    assert JaxEngine.resolve_mesh(EngineConfig(), cfg) is None
+    # garbage fails LOUDLY: a typo silently disarming TP would serve
+    # single-chip while the operator believes it is sharded
+    monkeypatch.setenv("DYN_TP", "lots")
+    with pytest.raises(ValueError, match="DYN_TP"):
+        JaxEngine.resolve_mesh(EngineConfig(), cfg)
+
+
+def test_validate_tp_rejects_undividable_heads():
+    cfg = ModelConfig.tiny()  # 4 q heads, 2 kv heads
+    cfg.validate_tp(1)
+    cfg.validate_tp(2)
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        cfg.validate_tp(4)  # divides q heads, not kv heads
+    with pytest.raises(ValueError, match="num_heads"):
+        cfg.validate_tp(3)
+    # resolve_mesh applies the same gate before touching devices
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        JaxEngine.resolve_mesh(EngineConfig(tp=4), cfg)
+    # dp gets the same fail-fast contract: an indivisible batch would
+    # silently replicate every decode-state array across the dp chips
+    with pytest.raises(ValueError, match="max_batch_size"):
+        JaxEngine.resolve_mesh(
+            EngineConfig(dp=3, max_batch_size=8), cfg
+        )
+    mesh = JaxEngine.resolve_mesh(EngineConfig(dp=2, max_batch_size=8), cfg)
+    assert mesh is not None and mesh.shape["dp"] == 2
+
+
+# ---------------------------------------------------------------------------
+# sharding rules (satellite): spec coverage, fallback, round-trips
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "model",
+    [
+        ModelConfig.tiny(),
+        ModelConfig.tiny(tie_word_embeddings=False),
+        ModelConfig.tiny(attention_bias=True, qk_norm=True),
+        ModelConfig.tiny(
+            num_experts=4, num_experts_per_tok=2, moe_capacity_factor=4.0
+        ),
+    ],
+    ids=["dense", "untied", "bias+qknorm", "moe"],
+)
+def test_every_param_path_has_a_spec(model):
+    """param_pspecs covers EVERY leaf init_params produces -- a new param
+    falling through to the replicated default is exactly how a fat matrix
+    silently stops sharding."""
+    params = init_params(model, jax.random.PRNGKey(0))
+    specs = param_pspecs(model)
+    missing = [
+        path for path in _flatten_with_paths(params) if path not in specs
+    ]
+    assert not missing, f"param paths without a sharding rule: {missing}"
+
+
+def test_compatible_spec_divisibility_fallback():
+    # dp=2 x tp=2: stays inside this module's 4-device minimum
+    mesh = build_mesh(MeshConfig(dp=2, tp=2), jax.devices()[:4])
+    # divisible: kept
+    assert _compatible_spec(P(None, "tp"), (3, 8), mesh) == P(None, "tp")
+    # not divisible by tp=2: that axis falls back to replicated
+    assert _compatible_spec(P(None, "tp"), (3, 7), mesh) == P(None, None)
+    # per-axis independence: dp kept while tp drops
+    assert _compatible_spec(P("dp", "tp"), (4, 3), mesh) == P("dp", None)
+    # axis absent from the mesh counts as size 1 (always compatible)
+    assert _compatible_spec(P("ep"), (5,), mesh) == P("ep")
+
+
+def test_shard_params_kv_batch_roundtrip():
+    """shard_params/shard_kv place arrays on their declared (fallback-
+    filtered) shardings without changing a byte; batch arrays round-trip
+    through batch_pspecs the same way."""
+    cfg = ModelConfig.tiny()
+    mesh = build_mesh(MeshConfig(dp=2, tp=2), jax.devices()[:4])
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    flat_before = {
+        k: np.asarray(v) for k, v in _flatten_with_paths(params).items()
+    }
+    sharded = shard_params(params, cfg, mesh)
+    flat_after = _flatten_with_paths(sharded)
+    assert flat_after.keys() == flat_before.keys()
+    specs = param_pspecs(cfg)
+    for path, leaf in flat_after.items():
+        expect = _compatible_spec(specs[path], leaf.shape, mesh)
+        assert leaf.sharding == NamedSharding(mesh, expect), path
+        np.testing.assert_array_equal(np.asarray(leaf), flat_before[path])
+    # wq ([L, H, heads*D]) genuinely shards over tp (not a fallback)
+    assert "tp" in [
+        ax for ax in flat_after["layers/wq"].sharding.spec if ax
+    ]
+
+    kv = jax.numpy.zeros(
+        (cfg.num_layers, 2, 8, 4, cfg.num_kv_heads, cfg.head_dim),
+        jax.numpy.float32,
+    )
+    kv_sharded = shard_kv(kv, cfg, mesh)
+    assert kv_sharded.sharding == NamedSharding(mesh, kv_pspec(cfg))
+    assert kv_shard_geometry(kv_sharded) == {"axis": 4, "parts": 2}
+    assert kv_shard_geometry(kv) is None  # unplaced: no geometry
+
+    for name, arr in {
+        "tokens": np.zeros((4,), np.int32),
+        "seq_lens": np.zeros((4,), np.int32),
+        "page_table": np.zeros((4, 8), np.int32),
+        "prompt_tokens": np.zeros((4, 16), np.int32),
+    }.items():
+        spec = _compatible_spec(batch_pspecs()[name], arr.shape, mesh)
+        placed = jax.device_put(arr, NamedSharding(mesh, spec))
+        assert placed.sharding.spec == spec
+        np.testing.assert_array_equal(np.asarray(placed), arr)
+
+
+# ---------------------------------------------------------------------------
+# per-shard export (satellite): sharded assembly == unsharded bytes
+# ---------------------------------------------------------------------------
+
+
+def test_per_shard_assembly_matches_unsharded_bytes(run):
+    """assemble_shards on the TP engine's live KV pool (the disagg-export
+    materialize path) is byte-identical to the plain full-array
+    device_get -- per-shard head slices reassemble into exactly the
+    full-width blob the wire/offload formats carry."""
+
+    async def body():
+        engine = _engine(tp=2)
+        try:
+            _assert_tp_engaged(engine, 2)
+            await collect(engine, req([2, 7, 1, 8, 2, 8], max_tokens=4))
+            pages = engine.kv.pages
+            per_shard = assemble_shards(pages)
+            full = np.asarray(jax.device_get(pages))
+            assert per_shard.dtype == full.dtype
+            np.testing.assert_array_equal(per_shard, full)
+            # replicated/unsharded arrays take the plain path unchanged
+            rep = jax.numpy.arange(8.0)
+            np.testing.assert_array_equal(
+                assemble_shards(rep), np.arange(8.0)
+            )
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_disagg_export_roundtrip_from_tp_prefiller(run):
+    """A TP prefill worker's exported blob (full-width, stamped with the
+    source shard geometry) onboards into an UNSHARDED decode engine and
+    decodes exactly like a local prefill there -- the cross-mesh wire
+    contract of the per-shard export."""
+    from dynamo_tpu.runtime.engine import Context
+
+    async def body():
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        agg = _engine()
+        try:
+            expect, _ = await collect(agg, req(prompt, max_tokens=6))
+        finally:
+            await agg.stop()
+
+        decode = _engine()
+        prefiller = _engine(tp=2)
+        try:
+            _assert_tp_engaged(prefiller, 2)
+            r = req(prompt, max_tokens=6)
+            streams = await prefiller.prefill_export_batch_stream(
+                [PreprocessedRequest.from_dict(r.to_dict())]
+            )
+            stream = streams[0]
+            assert not isinstance(stream, Exception), stream
+            assert stream.shards == {"axis": 4, "parts": 2}
+            blob = await stream.assemble()
+            # full-width regardless of the source mesh
+            assert blob.shape[0] == decode.model_cfg.num_layers
+            first = int(np.asarray(stream.row).reshape(-1)[0])
+            ctx = Context.new(r)
+            out = await decode.generate_external(ctx)
+            assert decode.deliver_external(ctx.id, blob, first)
+            tokens = []
+            async for item in out:
+                assert not item.is_error(), item.error_message()
+                tokens.extend((item.data or {}).get("token_ids") or [])
+            assert tokens == expect
+        finally:
+            await decode.stop()
+            await prefiller.stop()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# dp-balanced admission
+# ---------------------------------------------------------------------------
+
+
+def test_dp_balanced_slot_admission():
+    """With dp_groups=2 over 4 lanes, consecutive admissions alternate dp
+    groups (slot 0 then 2 then 1 then 3) so one shard never carries the
+    whole batch while its peer idles."""
+    sched = Scheduler(
+        SchedulerConfig(max_batch_size=4, max_seq_len=32, page_size=4,
+                        dp_groups=2),
+        PageAllocator(32),
+    )
+    slots = []
+    for i in range(4):
+        seq = SeqState.from_request(f"r{i}", req([1, 2, 3]), 4)
+        sched.enqueue(seq)
+        sched.plan()
+        slots.append(seq.slot)
+    assert slots == [0, 2, 1, 3]
+
+    # dp_groups=1: plain first-free order
+    sched = Scheduler(
+        SchedulerConfig(max_batch_size=4, max_seq_len=32, page_size=4),
+        PageAllocator(32),
+    )
+    slots = []
+    for i in range(2):
+        seq = SeqState.from_request(f"s{i}", req([1, 2, 3]), 4)
+        sched.enqueue(seq)
+        sched.plan()
+        slots.append(seq.slot)
+    assert slots == [0, 1]
