@@ -49,6 +49,9 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
         "_decode_block",
         "unified_step",
         "_unified_step",
+        "packed_unified_step",
+        "_packed_unified_step",
+        "_mixed_sample_epilogue",
         "verify_and_sample",
         "_verify_and_sample",
         "score_prompt_step",
@@ -105,11 +108,15 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
         "flash_prefill_attention",
         "flash_prefix_prefill_attention",
     ],
-    # the unified mixed prefill+decode ragged kernel: the ONE attention
-    # call of step.unified_step, dispatched every tick under mixed
-    # batching (the *_xla reference is the same entry point's CPU path)
+    # the unified mixed prefill+decode ragged kernels -- rectangle and
+    # fully-packed layouts: the ONE attention call of
+    # step.unified_step / step.packed_unified_step, dispatched every
+    # tick under mixed batching (the *_xla references are the same
+    # entry points' CPU paths)
     "dynamo_tpu/ops/ragged_attention.py": [
         "ragged_paged_attention*",
+        "packed_ragged_attention*",
+        "_packed_kernel",
     ],
     # offload-plane hot paths: the admission-time tier lookup runs on the
     # event loop and the host-ring put sits behind every eviction -- a

@@ -64,6 +64,23 @@ def _add_engine_flags(p) -> None:
                         "(decode lanes cost one each, the rest packs "
                         "prefill chunks; env DYN_MIXED_TOKEN_BUDGET "
                         "overrides)")
+    p.add_argument("--no-packed-ragged", dest="packed_ragged",
+                   action="store_false", default=True,
+                   help="disable the fully-packed ragged layout for "
+                        "unified dispatches (revert to the lane rectangle "
+                        "padded to the max chunk; env DYN_PACKED_RAGGED "
+                        "overrides)")
+    p.add_argument("--kv-admit-budget", default=None, metavar="SPEC",
+                   help="KV-budget admission: 'on' or "
+                        "'util=0.9,headroom=256,reserve=16,floor_s=2,"
+                        "skips=4' -- admit against predicted KV pages "
+                        "with a skip-ahead fairness floor instead of "
+                        "slot count (env DYN_KV_ADMIT_BUDGET overrides)")
+    p.add_argument("--kv-prefetch-window", type=int, default=None,
+                   help="queue-side prefetch window: offloaded prefix "
+                        "chains of the first N queued requests stage "
+                        "toward host RAM while they wait; 0 disables "
+                        "(env DYN_KV_PREFETCH overrides)")
     p.add_argument("--host-offload-blocks", type=int, default=0,
                    help="G2 host-RAM KV offload capacity (blocks); 0 = off "
                         "(env DYN_KV_OFFLOAD arms/overrides the whole plane)")
@@ -373,10 +390,14 @@ async def _make_engine(args):
         disk_offload_blocks=args.disk_offload_blocks,
         disk_offload_dir=args.disk_offload_dir,
         swap_preemption=args.swap_preemption,
+        packed_ragged=args.packed_ragged,
+        kv_admit_budget=args.kv_admit_budget,
         quantize=args.quantize,
     )
     if args.mixed_token_budget is not None:
         cfg.mixed_token_budget = args.mixed_token_budget
+    if args.kv_prefetch_window is not None:
+        cfg.kv_prefetch_window = args.kv_prefetch_window
     logger.info("loading %s ...", args.model_path)
     from .parallel.multihost import MultiNodeConfig, initialize_multihost
 

@@ -135,6 +135,46 @@ def ragged_attention_dispatch(
     )
 
 
+@hot_path
+def packed_ragged_attention_dispatch(
+    q: jax.Array,  # [Np, Hq, D] packed queries (lane's row i at base+i)
+    k: jax.Array,  # [Np, Hkv, D] packed fresh keys
+    v: jax.Array,  # [Np, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    layer: jax.Array,  # scalar i32
+    page_table: jax.Array,  # [B, P] (bucketed)
+    base: jax.Array,  # [B] committed cache length per lane
+    seg_off: jax.Array,  # [B] lane's segment offset into the packed axis
+    q_lens: jax.Array,  # [B] fresh rows per lane (0 = no segment)
+    lane: jax.Array,  # [Np] lane per packed token (B = padding)
+    rel: jax.Array,  # [Np] row index within the lane's segment
+    s_max: int,  # static per-lane window capacity
+    window: int = 0,
+) -> jax.Array:
+    """Fully-packed ragged mixed-batch attention: the flat-token-axis
+    layout of ``step.packed_unified_step`` (ISSUE 10).  Pallas
+    packed-operand kernel on TPU, XLA unpack-rectangle-repack reference
+    elsewhere -- resolved at trace time like every other dispatch gate,
+    and gated by the same ``DYN_PALLAS_RAGGED`` knob as the rectangle
+    kernel (the two are the same algorithm over different operand
+    layouts)."""
+    Hq, D = q.shape[1], q.shape[2]
+    Hkv = k.shape[1]
+    if _pallas_ragged_enabled(kv_pages.shape[3], Hq, Hkv, D):
+        from ..ops.ragged_attention import packed_ragged_attention
+
+        return packed_ragged_attention(
+            q, k, v, kv_pages, page_table, base, seg_off, q_lens, s_max,
+            layer, window, group=4,
+        )
+    from ..ops.ragged_attention import packed_ragged_attention_xla
+
+    return packed_ragged_attention_xla(
+        q, k, v, kv_pages, page_table, base, seg_off, q_lens, lane, rel,
+        s_max, layer, window,
+    )
+
+
 def _pallas_prefill_enabled(T: int, Hq: int, Hkv: int, D: int) -> bool:
     """Trace-time choice of the prefill-attention backend.
 
@@ -448,6 +488,36 @@ def write_spec_kv(
     kv_pages = kv_pages.at[layer, 1, flat_ids, flat_slot].set(
         v.reshape(B * S, Hkv, D)
     )
+    return kv_pages
+
+
+@hot_path
+def write_packed_kv(
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    k: jax.Array,  # [Np, Hkv, D] packed fresh keys
+    v: jax.Array,  # [Np, Hkv, D]
+    page_table: jax.Array,  # [B, P]
+    lane: jax.Array,  # [Np] lane per packed token (B = padding)
+    pos: jax.Array,  # [Np] absolute position per token
+    valid: jax.Array,  # [Np] bool (False = pad / dead row -> trash page 0)
+    layer: jax.Array,  # scalar i32
+) -> jax.Array:
+    """Scatter a packed unified dispatch's K/V: packed token ``n`` of
+    lane ``lane[n]`` lands at position ``pos[n]`` through that lane's
+    page table.  The flat-axis sibling of :func:`write_spec_kv` --
+    invalid rows (packed-axis padding, device-dead decode lanes) and
+    positions past the lane's allocation route to trash page 0."""
+    Np = k.shape[0]
+    page_size = kv_pages.shape[3]
+    B, P = page_table.shape
+    lane_c = jnp.clip(lane.astype(jnp.int32), 0, B - 1)
+    page_idx = pos // page_size
+    ok = valid & (page_idx < P) & (lane.astype(jnp.int32) < B)
+    slot = jnp.where(ok, pos % page_size, 0)
+    ids = page_table[lane_c, jnp.clip(page_idx, 0, P - 1)]
+    ids = jnp.where(ok, ids, 0)
+    kv_pages = kv_pages.at[layer, 0, ids, slot].set(k)
+    kv_pages = kv_pages.at[layer, 1, ids, slot].set(v)
     return kv_pages
 
 

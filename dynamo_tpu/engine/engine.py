@@ -41,7 +41,13 @@ from .kv_cache import PagedKVCache
 from .metrics import EngineMetrics
 from .model import Params, init_params
 from .sampling import SamplingParams
-from .scheduler import Scheduler, SchedulerConfig, SeqState, StepEvent
+from .scheduler import (
+    Scheduler,
+    SchedulerConfig,
+    SeqState,
+    StepEvent,
+    parse_kv_admit_spec,
+)
 from .step import (
     bump_counts,
     decode_block,
@@ -60,6 +66,7 @@ from .step import (
     scatter_block_pages,
     scatter_layer_pages,
     slice_block_pages,
+    packed_unified_step,
     unified_step,
     verify_and_sample,
 )
@@ -143,6 +150,27 @@ class EngineConfig:
     # the remainder packs prefill chunks); DYN_MIXED_TOKEN_BUDGET
     # overrides at engine construction
     mixed_token_budget: int = 512
+    # fully-packed ragged layout (ISSUE 10): unified dispatches run a
+    # flat packed token axis (pow2 of the dispatch's REAL fresh tokens)
+    # instead of the lane rectangle that pads every lane to the max
+    # chunk -- the trunk stops paying for padding exactly where long
+    # prefill chunks make it worst.  Token-identical to the rectangle
+    # and classic paths; ``DYN_PACKED_RAGGED=0/1`` overrides at engine
+    # construction.  Only consulted when mixed batching is on.
+    packed_ragged: bool = True
+    # KV-budget admission (ROADMAP item 5 / scheduler.KVAdmitConfig):
+    # admit against predicted KV pages -- prompt + max_tokens headroom --
+    # with a skip-ahead + aging fairness floor, instead of slot count.
+    # Spec string per scheduler.parse_kv_admit_spec ("on" or
+    # "util=0.9,headroom=256,reserve=16,floor_s=2,skips=4"); None = the
+    # legacy slot-count admission.  DYN_KV_ADMIT_BUDGET env wins.
+    kv_admit_budget: Optional[str] = None
+    # queue-side prefetch window: the offloaded prefix chains of the
+    # first N queued requests promote toward host RAM (with completion
+    # tracking + ring pins) while they wait, so onboarding overlaps
+    # queue wait instead of TTFT.  0 disables prefetch entirely;
+    # DYN_KV_PREFETCH overrides at engine construction.
+    kv_prefetch_window: int = 32
     # sequence-hash prefix-cache reuse (block_manager.PagePool); requires
     # block_size to divide evenly into pages
     enable_prefix_caching: bool = True
@@ -405,6 +433,7 @@ from types import SimpleNamespace
 _MODULE_STEPS = SimpleNamespace(
     decode_block=decode_block,
     unified_step=unified_step,
+    packed_unified_step=packed_unified_step,
     verify_and_sample=verify_and_sample,
     update_lanes=update_lanes,
     inject_token=inject_token,
@@ -517,6 +546,23 @@ class JaxEngine:
             )
         else:
             self._fns = _MODULE_STEPS
+        # KV-budget admission (scheduler.KVAdmitConfig): config arms it,
+        # DYN_KV_ADMIT_BUDGET wins outright (an explicit "off" disarms a
+        # config-armed budget -- the DYN_KV_OFFLOAD contract)
+        import os as _os
+
+        admit_spec: Any = self.cfg.kv_admit_budget
+        env_admit = _os.environ.get("DYN_KV_ADMIT_BUDGET")
+        if env_admit is not None and env_admit.strip():
+            try:
+                admit_spec = parse_kv_admit_spec(env_admit)
+            except ValueError:
+                # malformed env must not kill the server (the contract
+                # every sibling serving env knob follows): warn, keep
+                # the config-armed spec
+                logger.warning(
+                    "ignoring malformed DYN_KV_ADMIT_BUDGET=%r", env_admit
+                )
         self.sched = Scheduler(
             SchedulerConfig(
                 max_batch_size=self.cfg.max_batch_size,
@@ -524,6 +570,7 @@ class JaxEngine:
                 page_size=self.cfg.page_size,
                 block_size=self.cfg.block_size,
                 dp_groups=self._dp,
+                kv_admit=parse_kv_admit_spec(admit_spec),
             ),
             self.kv.allocator,
         )
@@ -590,8 +637,6 @@ class JaxEngine:
         # mixed prefill+decode batching (unified ragged dispatch): the
         # token budget caps one dispatch's fresh rows; DYN_MIXED_TOKEN_BUDGET
         # overrides config so a deployment can retune without a restart flag
-        import os as _os
-
         # sp/pp meshes pin mixed batching OFF: those axes exist to
         # accelerate FULL prefills (ring attention / microbatched
         # pipeline), and the unified mixed dispatch would swallow every
@@ -610,6 +655,35 @@ class JaxEngine:
                     "ignoring malformed DYN_MIXED_TOKEN_BUDGET=%r", env_budget
                 )
         self._mixed_budget = max(int(budget), 1)
+        # fully-packed ragged layout: DYN_PACKED_RAGGED=0/1 overrides the
+        # config (same contract as every other serving env knob)
+        self._packed = bool(self.cfg.packed_ragged)
+        env_packed = _os.environ.get("DYN_PACKED_RAGGED")
+        if env_packed is not None and env_packed.strip():
+            self._packed = env_packed.strip().lower() not in (
+                "0", "off", "false", "no"
+            )
+        # per-dispatch fresh-token accounting (padded-token fractions the
+        # long-context bench reports): real rows vs rows dispatched vs
+        # rows the rectangle layout would have dispatched
+        self.mixed_used_tokens = 0
+        self.mixed_dispatched_tokens = 0
+        self.mixed_rect_tokens = 0
+        # queue-side prefetch: window resolved here, walks issued by the
+        # tick loop from queue position (_drive_prefetch), finished or
+        # cancelled per request
+        self._prefetch_window = max(int(self.cfg.kv_prefetch_window), 0)
+        env_pf = _os.environ.get("DYN_KV_PREFETCH")
+        if env_pf is not None and env_pf.strip():
+            v = env_pf.strip().lower()
+            if v in ("off", "false", "no"):
+                self._prefetch_window = 0
+            else:
+                try:
+                    self._prefetch_window = max(int(v), 0)
+                except ValueError:
+                    logger.warning("ignoring malformed DYN_KV_PREFETCH=%r", v)
+        self._prefetch_issued: set = set()
         self.buckets = prefill_buckets(self.cfg.page_size, self.cfg.max_seq_len)
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._queues: Dict[str, asyncio.Queue] = {}
@@ -845,18 +919,10 @@ class JaxEngine:
                 yield Annotated.from_error(message)
 
             return ResponseStream(ctx, err_stream())
-        if self.offload_engine is not None and seq.blocks is not None:
-            # queue-side prefetch: promote the prompt's offloaded prefix
-            # chain (G3 disk reads included) into host RAM while the
-            # request waits for a slot, so the admission-time tier lookup
-            # is a RAM hit and the onboard scatter dispatches with the
-            # admitting tick
-            max_blocks = max(0, (len(seq.prompt) - 1) // self.sched.block_size)
-            hashes = seq.blocks.sequence_hashes()[:max_blocks]
-            pool = self.sched.pool
-            self.offload_engine.prefetch(
-                [h for h in hashes if pool is None or not pool.is_registered(h)]
-            )
+        # queue-side prefetch is driven by the tick loop from queue
+        # position (_drive_prefetch): the first _prefetch_window waiting
+        # requests get tracked walks, so a deep queue cannot thrash the
+        # host ring staging chains hours from admission
         queue: asyncio.Queue = asyncio.Queue()
         self._queues[request.id] = queue
         assert self._wake is not None
@@ -1792,6 +1858,7 @@ class JaxEngine:
                     else:
                         await self._wake.wait()
                     continue
+                self._drive_prefetch()
                 plan = self.sched.plan()
                 if self.sched.num_active > 0:
                     # pre-grow pages to cover the in-flight block plus this
@@ -2088,6 +2155,7 @@ class JaxEngine:
         self._deliveries.pop(seq.request_id, None)
         self._chunked.pop(seq.request_id, None)
         self._external_deadline.pop(seq.request_id, None)
+        self._cancel_prefetch(seq.request_id)
         if self._swapped.pop(seq.request_id, None) is not None:
             self.offload_engine.drop_swap(seq.request_id)
         queue = self._queues.get(seq.request_id)
@@ -2117,6 +2185,7 @@ class JaxEngine:
             self._deliveries.pop(rid, None)
             self._chunked.pop(rid, None)
             self._external_deadline.pop(rid, None)
+            self._cancel_prefetch(rid)
             if self._swapped.pop(rid, None) is not None:
                 self.offload_engine.drop_swap(rid)
             seq = by_id.get(rid)
@@ -2442,6 +2511,7 @@ class JaxEngine:
         With chunked prefill configured and a long-enough remainder, only
         the first chunk dispatches here (no sample); the tick loop advances
         the rest via ``_dispatch_chunk`` (returns None in that case)."""
+        self._note_prefetch_admission(seq)
         if seq.pending_onboard:
             self._apply_onboards(seq)
         # prefix-cache stats are token-weighted and counted once per request
@@ -2561,7 +2631,10 @@ class JaxEngine:
             with tracing.span(
                 "engine.prefill_dispatch", seq.request_id
             ) as sp:
-                sp.set(prompt_len=prompt_len, bucket=bucket, cached=cached)
+                sp.set(
+                    prompt_len=prompt_len, bucket=bucket, cached=cached,
+                    kv_prefetch_hits=seq.prefetch_hits,
+                )
         logger.debug("prefill dispatched id=%s len=%d bucket=%d",
                      seq.request_id, prompt_len, bucket)
         return pf
@@ -2583,6 +2656,7 @@ class JaxEngine:
         from ..runtime import tracing
 
         for seq, _pl in items:
+            self._note_prefetch_admission(seq)
             if seq.pending_onboard:
                 self._apply_onboards(seq)
             if not seq.stats_counted:
@@ -2635,7 +2709,10 @@ class JaxEngine:
                 with tracing.span(
                     "engine.prefill_dispatch", seq.request_id
                 ) as sp:
-                    sp.set(prompt_len=pl, cached=caches[i], group=len(items))
+                    sp.set(
+                        prompt_len=pl, cached=caches[i], group=len(items),
+                        kv_prefetch_hits=seq.prefetch_hits,
+                    )
             logger.debug(
                 "prefill dispatched id=%s len=%d cached=%d (group of %d)",
                 seq.request_id, pl, caches[i], len(items),
@@ -3093,6 +3170,7 @@ class JaxEngine:
         sched = self.sched
         for ch in chunks:
             seq = ch.seq
+            self._note_prefetch_admission(seq)
             if seq.pending_onboard:
                 end = ch.start + ch.length
                 self._apply_onboards(seq)
@@ -3117,18 +3195,16 @@ class JaxEngine:
         # group-batch pad rule), so arrival patterns cannot mint surprise
         # executables mid-serving
         S = pow2_bucket(max((ch.length for ch in chunks), default=1))
-        p_tokens = np.zeros((B, S), np.int32)
         p_start = np.zeros((B,), np.int32)
         p_lens = np.zeros((B,), np.int32)
         p_sample = np.zeros((B,), bool)
         p_act = np.zeros((B,), bool)
         n_pf_tokens = 0
         final_chunks: List[Any] = []
+        chunk_by_slot: Dict[int, Any] = {}
         for ch in chunks:
             b = ch.seq.slot
-            p_tokens[b, : ch.length] = ch.seq.prompt[
-                ch.start : ch.start + ch.length
-            ]
+            chunk_by_slot[b] = ch
             p_start[b] = ch.start
             p_lens[b] = ch.length
             p_sample[b] = ch.final
@@ -3145,48 +3221,141 @@ class JaxEngine:
         self._sync_device_state()
         d = self._dev
         Pb = self._live_page_bucket()
-        n_decode = sum(
-            1
-            for b, s in enumerate(sched.slots)
-            if s is not None
-            and p_lens[b] == 0
-            and s.finish is None
-            and not s.awaiting_kv
-            and not s.prefilling
-            and s.spec is None
-        )
+        # decode-capable lanes: contribute one fresh row each (packed) /
+        # one live column (rectangle); the count feeds the occupancy
+        # histograms either way
+        dec_cap = np.zeros((B,), bool)
+        for b, s in enumerate(sched.slots):
+            dec_cap[b] = (
+                s is not None
+                and p_lens[b] == 0
+                and s.finish is None
+                and not s.awaiting_kv
+                and not s.prefilling
+                and s.spec is None
+            )
+        n_decode = int(dec_cap.sum())
         use_filters = any(
             s is not None and self._sampling_needs_filters(s.sampling)
             for s in sched.slots
         )
         top_n = self._lp_top(sched.slots)
-        (
-            packed,
-            d["tokens"],
-            d["seq_lens"],
-            d["active"],
-            self.kv.pages,
-            self._rng,
-        ) = self._fns.unified_step(
-            self.params,
-            self.model_cfg,
-            self.kv.pages,
-            d["tokens"],
-            d["seq_lens"],
-            d["limit_lens"],
-            d["active"],
-            d["stop_ids"],
-            d["page_table"][:, :Pb],
-            self._put_batch(p_tokens),
-            self._put_batch(p_start),
-            self._put_batch(p_lens),
-            self._put_batch(p_sample),
-            self._put_batch(p_act),
-            self._rng,
-            d["sampling"],
-            top_n,
-            use_filters,
-        )
+        if self._packed:
+            # fully-packed layout (ISSUE 10): ONE flat token axis sized
+            # pow2(real fresh tokens) instead of the [B, S] rectangle --
+            # the trunk stops paying for every lane's padding to the max
+            # chunk.  Segments pack contiguously in slot order; the
+            # packed-axis pad also guarantees every live lane's static
+            # s_max window fits (the Pallas kernel's slice rule).
+            q_host = np.where(dec_cap, 1, p_lens).astype(np.int32)
+            total = int(q_host.sum())
+            s_max = pow2_bucket(int(q_host.max()) if total else 1)
+            seg_off = np.zeros((B,), np.int32)
+            off = 0
+            max_end = 1
+            for b in range(B):
+                ql = int(q_host[b])
+                if ql == 0:
+                    continue
+                seg_off[b] = off
+                max_end = max(max_end, off + s_max)
+                off += ql
+            Np = pow2_bucket(max(total, max_end, 1))
+            t_tokens = np.zeros((Np,), np.int32)
+            t_lane = np.full((Np,), B, np.int32)
+            t_rel = np.zeros((Np,), np.int32)
+            t_dec = np.zeros((Np,), bool)
+            for b in range(B):
+                ql = int(q_host[b])
+                if ql == 0:
+                    continue
+                o = int(seg_off[b])
+                t_lane[o : o + ql] = b
+                t_rel[o : o + ql] = np.arange(ql, dtype=np.int32)
+                ch = chunk_by_slot.get(b)
+                if ch is not None:
+                    t_tokens[o : o + ql] = ch.seq.prompt[
+                        ch.start : ch.start + ql
+                    ]
+                else:
+                    t_dec[o] = True
+            disp_tokens = Np
+            (
+                packed,
+                d["tokens"],
+                d["seq_lens"],
+                d["active"],
+                self.kv.pages,
+                self._rng,
+            ) = self._fns.packed_unified_step(
+                self.params,
+                self.model_cfg,
+                self.kv.pages,
+                d["tokens"],
+                d["seq_lens"],
+                d["limit_lens"],
+                d["active"],
+                d["stop_ids"],
+                d["page_table"][:, :Pb],
+                jnp.asarray(t_tokens),
+                jnp.asarray(t_lane),
+                jnp.asarray(t_rel),
+                jnp.asarray(t_dec),
+                self._put_batch(p_start),
+                self._put_batch(p_lens),
+                self._put_batch(p_sample),
+                self._put_batch(p_act),
+                self._put_batch(dec_cap),
+                self._put_batch(seg_off),
+                self._rng,
+                d["sampling"],
+                s_max,
+                top_n,
+                use_filters,
+            )
+        else:
+            p_tokens = np.zeros((B, S), np.int32)
+            for ch in chunks:
+                p_tokens[ch.seq.slot, : ch.length] = ch.seq.prompt[
+                    ch.start : ch.start + ch.length
+                ]
+            disp_tokens = B * S
+            (
+                packed,
+                d["tokens"],
+                d["seq_lens"],
+                d["active"],
+                self.kv.pages,
+                self._rng,
+            ) = self._fns.unified_step(
+                self.params,
+                self.model_cfg,
+                self.kv.pages,
+                d["tokens"],
+                d["seq_lens"],
+                d["limit_lens"],
+                d["active"],
+                d["stop_ids"],
+                d["page_table"][:, :Pb],
+                self._put_batch(p_tokens),
+                self._put_batch(p_start),
+                self._put_batch(p_lens),
+                self._put_batch(p_sample),
+                self._put_batch(p_act),
+                self._rng,
+                d["sampling"],
+                top_n,
+                use_filters,
+            )
+        # padded-token accounting, BOTH layouts derived from this one
+        # dispatch: `used` real rows, `dispatched` what actually ran,
+        # `rectangle` what the [B, S] layout would have run -- the bench
+        # reports 1 - used/dispatched vs 1 - used/rectangle
+        used_tokens = n_pf_tokens + n_decode
+        self.mixed_used_tokens += used_tokens
+        self.mixed_dispatched_tokens += disp_tokens
+        self.mixed_rect_tokens += B * S
+        self.obs.observe_mixed_tokens(used_tokens, disp_tokens, B * S)
         finals: List[InflightPrefill] = []
         for ch in final_chunks:
             seq = ch.seq
@@ -3213,6 +3382,7 @@ class JaxEngine:
                         prompt_len=len(seq.prompt),
                         cached=seq.cached_prompt_tokens,
                         mixed=True,
+                        kv_prefetch_hits=seq.prefetch_hits,
                     )
         self._steps += 1
         self.obs.observe_dispatch("unified")
@@ -3412,6 +3582,72 @@ class JaxEngine:
         except Exception:
             # best-effort: a lost offload is a cache miss later, not an error
             logger.debug("offload snapshot failed", exc_info=True)
+
+    def _drive_prefetch(self) -> None:
+        """Issue tracked prefetch walks for the queue's admission window
+        (loop thread, once per tick -- ISSUE 10).
+
+        The walk promotes each request's offloaded prefix chain
+        disk->host and pins it in the ring, so by the time the request
+        reaches a slot, ``_match_prefix``'s tier lookup is a RAM hit and
+        the onboard scatter dispatches with the admitting tick: the
+        disk->host->HBM walk overlaps queue wait instead of TTFT.  Only
+        the first ``_prefetch_window`` waiting requests are walked --
+        queue position IS the prefetch priority."""
+        oe = self.offload_engine
+        if oe is None or self._prefetch_window == 0 or not self.sched.waiting:
+            return
+        pool = self.sched.pool
+        count = 0
+        for seq in self.sched.waiting:
+            if count >= self._prefetch_window:
+                break
+            count += 1
+            rid = seq.request_id
+            if (
+                rid in self._prefetch_issued
+                or seq.blocks is None
+                # external / swap-parked lanes admit with fresh pages
+                # only and never consume onboards -- a pinned walk for
+                # them is pure ring pressure
+                or seq.awaiting_kv
+            ):
+                continue
+            # rid stays marked even when nothing is offloaded: rescanning
+            # a fully-G1-resident 128k chain every tick would burn the
+            # loop thread on no-op registry probes (a block evicted after
+            # this scan is handled by the admission-time tier lookup)
+            self._prefetch_issued.add(rid)
+            max_blocks = max(
+                0, (len(seq.prompt) - 1) // self.sched.block_size
+            )
+            hashes = [
+                h
+                for h in seq.blocks.sequence_hashes()[:max_blocks]
+                if pool is None or not pool.is_registered(h)
+            ]
+            if hashes:
+                oe.prefetch(hashes, request_id=rid)
+
+    def _note_prefetch_admission(self, seq: SeqState) -> None:
+        """Admission reached the request: settle its tracked prefetch --
+        count staged blocks the admission consumes (``pending_onboard``
+        tier hits), release the ring pins, record the overlap ratio.
+        Must run BEFORE ``_apply_onboards`` drains the pending list."""
+        oe = self.offload_engine
+        if oe is None or seq.request_id not in self._prefetch_issued:
+            return
+        self._prefetch_issued.discard(seq.request_id)
+        consumed = [h for h, _p, _b, _m in seq.pending_onboard]
+        seq.prefetch_hits = oe.finish_prefetch(seq.request_id, consumed)
+
+    def _cancel_prefetch(self, rid: str) -> None:
+        """A request left the queue without admitting (cancel / error):
+        free its host-staged prefetch state (the ISSUE 10 leak fix)."""
+        if rid in self._prefetch_issued:
+            self._prefetch_issued.discard(rid)
+            if self.offload_engine is not None:
+                self.offload_engine.cancel_prefetch(rid)
 
     def _offload_lookup(self, seq_hash: int):
         """Scheduler-facing tier lookup (``_match_prefix`` G1 -> G2 -> G3
@@ -3915,6 +4151,11 @@ class JaxEngine:
                     out.prompt_logprobs = ev.prompt_logprobs
                 queue.put_nowait(Annotated.from_data(out.to_dict()))
             if ev.finished is not None:
+                # backstop for paths that never cross a prefill-dispatch
+                # site (disagg external lanes): any prefetch state still
+                # tracked at finish is released here (pins freed, bytes
+                # counted wasted)
+                self._cancel_prefetch(ev.seq.request_id)
                 out = LLMEngineOutput.finished(ev.finished)
                 if not ev.tokens and ev.prompt_logprobs is not None:
                     # first token finished the request outright (swallowed

@@ -34,6 +34,84 @@ from .kv_cache import OutOfPages, PageAllocator
 
 
 @dataclass
+class KVAdmitConfig:
+    """KV-budget admission model (ROADMAP item 5 / FlowKV): admit against
+    a *predicted KV-page* commitment instead of slot count, so one 128k
+    prompt neither grabs a slot it cannot feed nor blocks the queue while
+    short traffic could still fit.
+
+    The predictor charges each request its peak pages -- sequence length
+    plus decode headroom (``remaining_budget``, optionally capped by
+    ``headroom_tokens``) -- against ``util * pool - reserve_pages``.  A
+    head that does not fit is *skipped over* (short traffic keeps
+    admitting, up to ``max_skips`` per pass) until it has aged past
+    ``floor_s`` seconds; from then on no request passes it, so freed
+    pages accumulate for the head instead of feeding newcomers -- the
+    fairness floor in both directions.  Admission order changes; token
+    streams never do.
+
+    Armed via ``SchedulerConfig.kv_admit`` (engine:
+    ``EngineConfig.kv_admit_budget`` / ``DYN_KV_ADMIT_BUDGET``)."""
+
+    # fraction of the (trash-page-excluded) pool the predictor may commit
+    util: float = 0.9
+    # cap on the predicted decode headroom per request, tokens; None =
+    # the request's full remaining token budget (max_tokens-capped)
+    headroom_tokens: Optional[int] = None
+    # pages withheld from the predictor (swap-restore / onboard slack)
+    reserve_pages: int = 0
+    # fairness floor: once the queue head has waited this long, nothing
+    # skips past it
+    floor_s: float = 2.0
+    # max requests admitted past a blocked head per planning pass
+    max_skips: int = 4
+
+
+def parse_kv_admit_spec(spec: Any) -> Optional[KVAdmitConfig]:
+    """Parse a ``DYN_KV_ADMIT_BUDGET`` value into a :class:`KVAdmitConfig`
+    (None = slot-count admission).
+
+    Grammar: ``0``/``off`` disarms, ``1``/``on`` arms the defaults, or a
+    comma-separated ``k=v`` list::
+
+        DYN_KV_ADMIT_BUDGET=util=0.9,headroom=256,reserve=16,floor_s=2,skips=4
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, KVAdmitConfig):
+        return spec
+    if isinstance(spec, bool):
+        return KVAdmitConfig() if spec else None
+    s = str(spec).strip()
+    if not s or s.lower() in ("0", "off", "false", "no"):
+        return None
+    out = KVAdmitConfig()
+    if s.lower() in ("1", "on", "true", "yes"):
+        return out
+    for clause in filter(None, (c.strip() for c in s.split(","))):
+        k, sep, v = clause.partition("=")
+        k = k.strip().lower()
+        if not sep:
+            raise ValueError(f"malformed DYN_KV_ADMIT_BUDGET clause {clause!r}")
+        if k not in ("util", "headroom", "reserve", "floor_s", "skips"):
+            raise ValueError(f"unknown DYN_KV_ADMIT_BUDGET key {k!r}")
+        try:
+            if k == "util":
+                out.util = float(v)
+            elif k == "headroom":
+                out.headroom_tokens = int(v)
+            elif k == "reserve":
+                out.reserve_pages = int(v)
+            elif k == "floor_s":
+                out.floor_s = float(v)
+            elif k == "skips":
+                out.max_skips = int(v)
+        except ValueError as e:
+            raise ValueError(f"bad DYN_KV_ADMIT_BUDGET value {clause!r}") from e
+    return out
+
+
+@dataclass
 class SchedulerConfig:
     max_batch_size: int = 8
     max_seq_len: int = 2048
@@ -53,6 +131,10 @@ class SchedulerConfig:
     # whole batch while its peers idle -- per-chip throughput under
     # partial load depends on it.  1 = no mesh, first-free admission.
     dp_groups: int = 1
+    # KV-budget admission (None = legacy slot-count admission); see
+    # KVAdmitConfig.  Changes which tick a request admits on, never its
+    # tokens.
+    kv_admit: Optional[KVAdmitConfig] = None
 
 
 @dataclass
@@ -108,6 +190,11 @@ class SeqState:
     # echo+logprobs: top-N prompt logprobs to compute at first prefill
     prompt_logprobs: Optional[int] = None
     prompt_lp_sent: bool = False
+    # queue-side prefetch accounting: offloaded prefix blocks found
+    # host-staged at admission because the prefetch walk promoted them
+    # during queue wait (engine._note_prefetch_admission; span attr +
+    # dynamo_kv_prefetch_hits)
+    prefetch_hits: int = 0
 
     @property
     def seq_len(self) -> int:
@@ -214,6 +301,11 @@ class Scheduler:
         self.swap_out: Optional[Any] = None
         self.preempt_swap = 0
         self.preempt_recompute = 0
+        # KV-budget admission (None = slot-count): counters back the
+        # long-context bench and the starvation tests
+        self.kv_admit = cfg.kv_admit
+        self.admit_skips = 0  # admissions that passed a blocked head
+        self.admit_blocked = 0  # passes whose head did not fit the budget
         # observability hook (engine/metrics.EngineMetrics): the scheduler
         # stays sans-IO -- it only pokes gauges the engine wired in
         self.metrics: Optional[Any] = None
@@ -321,46 +413,26 @@ class Scheduler:
 
     def plan(self) -> TickPlan:
         """Admit waiting requests into free slots (page permitting), then
-        decide whether a decode step runs."""
+        decide whether a decode step runs.
+
+        With ``kv_admit`` unset the queue admits strictly FIFO against
+        slot count + the physical page floor.  With it set, admission
+        runs the KV-budget model (:class:`KVAdmitConfig`): predicted
+        peak pages gate each candidate, and a head that does not fit is
+        skipped over -- bounded by the fairness floor -- so short
+        traffic and one long prompt make progress together."""
         plan = TickPlan()
         cap = self.cfg.max_prefill_per_tick
-        while self.waiting and (cap is None or len(plan.prefills) < cap):
-            slot = self._free_slot()
-            if slot is None:
-                break
-            seq = self.waiting[0]
-            # remote-prefilled prompts arrive as one full-prompt KV blob; a
-            # shared reused prefix would be overwritten by the scatter, so
-            # external admissions take fresh pages only (reuse is the local
-            # prefill path's optimization)
-            cached_pages = [] if seq.awaiting_kv else self._match_prefix(seq)
-            if seq.awaiting_kv:
-                seq.cached_prompt_tokens = 0
-            n_pages = -(-len(seq.prompt) // self.cfg.page_size)
-            # admission needs room for the prompt *and* the first decode
-            # write, with one page of headroom per active seq for growth;
-            # reused prefix pages are already resident and cost nothing
-            need = self.min_total_pages(seq) - len(cached_pages)
-            if self.allocator.free_pages < need + self.num_active:
-                self._unmatch_prefix(seq)
-                break
-            self.waiting.popleft()
-            fresh = self.allocator.alloc(n_pages - len(cached_pages))
-            # onboard pages were allocated inside _match_prefix and stay
-            # plain-owned until the engine registers them post-scatter
-            onboard = [
-                p for _h, pgs, _b, _m in seq.pending_onboard for p in pgs
-            ]
-            seq.owned_pages = onboard + fresh
-            seq.pages = cached_pages + fresh
-            seq.slot = slot
-            self.slots[slot] = seq
-            self._write_slot_arrays(seq)
-            self._queue_prompt_registrations(seq)
-            if not seq.awaiting_kv:
-                plan.prefills.append((seq, len(seq.prompt)))
-            # awaiting_kv lanes hold their pages and stay device-inactive
-            # until the remote prefill delivers (engine.deliver_external)
+        if self.kv_admit is not None:
+            self._plan_budget(plan, cap)
+        else:
+            while self.waiting and (cap is None or len(plan.prefills) < cap):
+                slot = self._free_slot()
+                if slot is None:
+                    break
+                if not self._try_admit(self.waiting[0], plan, slot):
+                    break
+                self.waiting.popleft()
         # decode dispatch gating lives in the engine tick loop, keyed on
         # num_decode_runnable AFTER this tick's lane parking: a tick whose
         # slots hold only parked / mid-prefill / speculating lanes must
@@ -368,6 +440,106 @@ class Scheduler:
         if self.metrics is not None:
             self.metrics.observe_sched(len(self.waiting), self.num_active)
         return plan
+
+    def _try_admit(self, seq: SeqState, plan: TickPlan, slot: int) -> bool:
+        """Admit one request into ``slot`` if the physical page floor
+        allows; returns False (state untouched) otherwise.  The one
+        admission body both planners share."""
+        # remote-prefilled prompts arrive as one full-prompt KV blob; a
+        # shared reused prefix would be overwritten by the scatter, so
+        # external admissions take fresh pages only (reuse is the local
+        # prefill path's optimization)
+        cached_pages = [] if seq.awaiting_kv else self._match_prefix(seq)
+        if seq.awaiting_kv:
+            seq.cached_prompt_tokens = 0
+        n_pages = -(-len(seq.prompt) // self.cfg.page_size)
+        # admission needs room for the prompt *and* the first decode
+        # write, with one page of headroom per active seq for growth;
+        # reused prefix pages are already resident and cost nothing
+        need = self.min_total_pages(seq) - len(cached_pages)
+        if self.allocator.free_pages < need + self.num_active:
+            self._unmatch_prefix(seq)
+            return False
+        fresh = self.allocator.alloc(n_pages - len(cached_pages))
+        # onboard pages were allocated inside _match_prefix and stay
+        # plain-owned until the engine registers them post-scatter
+        onboard = [
+            p for _h, pgs, _b, _m in seq.pending_onboard for p in pgs
+        ]
+        seq.owned_pages = onboard + fresh
+        seq.pages = cached_pages + fresh
+        seq.slot = slot
+        self.slots[slot] = seq
+        self._write_slot_arrays(seq)
+        self._queue_prompt_registrations(seq)
+        if not seq.awaiting_kv:
+            plan.prefills.append((seq, len(seq.prompt)))
+        # awaiting_kv lanes hold their pages and stay device-inactive
+        # until the remote prefill delivers (engine.deliver_external)
+        return True
+
+    def predicted_pages(self, seq: SeqState) -> int:
+        """Predicted peak KV pages for a request under the budget model:
+        current sequence length (the prompt, for a queued request) plus
+        decode headroom -- the remaining token budget, optionally capped
+        by ``headroom_tokens``.  Never below what the sequence already
+        holds, never above the per-lane page ceiling."""
+        adm = self.kv_admit
+        head = self.remaining_budget(seq)
+        if adm is not None and adm.headroom_tokens is not None:
+            head = min(head, adm.headroom_tokens)
+        n = min(seq.seq_len + head, self.cfg.max_seq_len)
+        pages = -(-n // self.cfg.page_size)
+        return max(min(pages, self.max_pages), len(seq.pages))
+
+    def _plan_budget(self, plan: TickPlan, cap: Optional[int]) -> None:
+        """KV-budget admission pass (see :class:`KVAdmitConfig`)."""
+        adm = self.kv_admit
+        now = time.monotonic()
+        usable = self.allocator.num_pages - 1  # trash page excluded
+        budget = max(int(usable * adm.util) - adm.reserve_pages, 1)
+        committed = sum(
+            self.predicted_pages(s) for s in self.slots if s is not None
+        )
+
+        # fairness floor: an aged head stops all skip-ahead, so pages
+        # freed by completions accumulate for it instead of feeding
+        # newcomers behind it.  Evaluated against the CURRENT head at
+        # each gating point -- an aged head that admits mid-pass must
+        # not leave its stale flag gating the requests behind it.
+        def head_aged() -> bool:
+            return (
+                bool(self.waiting)
+                and now - self.waiting[0].arrival_s > adm.floor_s
+            )
+
+        skips = 0
+        i = 0
+        while i < len(self.waiting) and (
+            cap is None or len(plan.prefills) < cap
+        ):
+            slot = self._free_slot()
+            if slot is None:
+                break
+            seq = self.waiting[i]
+            need = self.predicted_pages(seq)
+            # an empty batch always admits its head: a request whose
+            # prediction exceeds the whole budget must still run alone
+            # (the engine fails truly-impossible prompts separately)
+            fits = committed + need <= budget or (
+                self.num_active == 0 and i == 0
+            )
+            if fits and self._try_admit(seq, plan, slot):
+                del self.waiting[i]
+                committed += need
+                continue
+            if i == 0:
+                self.admit_blocked += 1
+            if head_aged() or skips >= adm.max_skips:
+                break
+            skips += 1
+            self.admit_skips += 1
+            i += 1
 
     # -- mixed-batch formation (unified ragged dispatch) ---------------------
 

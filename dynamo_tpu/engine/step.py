@@ -376,18 +376,50 @@ def _unified_step(
     last = jnp.clip(q_lens - 1, 0, S - 1)
     hidden_last = jnp.take_along_axis(hidden, last[:, None, None], axis=1)[:, 0]
     logits = lm_logits(params, cfg, hidden_last)  # [B, V]
+    packed, new_tokens, new_seq, new_active, rng = _mixed_sample_epilogue(
+        logits, base, q_lens, is_pf, p_start, p_lens, p_sample, p_activate,
+        tokens, seq_lens, limit_lens, active, stop_ids, rng, sampling,
+        top_n, use_filters,
+    )
+    return packed, new_tokens, new_seq, new_active, kv_pages, rng
+
+
+def _mixed_sample_epilogue(
+    logits: jax.Array,  # [B, V] last-row logits per lane
+    base: jax.Array,  # [B]
+    q_lens: jax.Array,  # [B]
+    is_pf: jax.Array,  # [B] bool
+    p_start: jax.Array,  # [B]
+    p_lens: jax.Array,  # [B]
+    p_sample: jax.Array,  # [B] bool
+    p_activate: jax.Array,  # [B] bool
+    tokens: jax.Array,  # [B]
+    seq_lens: jax.Array,  # [B]
+    limit_lens: jax.Array,  # [B]
+    active: jax.Array,  # [B] bool
+    stop_ids: jax.Array,  # [B, E]
+    rng: jax.Array,
+    sampling: SamplingParams,
+    top_n: int,
+    use_filters: bool,
+) -> Tuple[jax.Array, ...]:
+    """Sampling + device bookkeeping shared by the rectangle and packed
+    unified steps (the two layouts differ only in how the trunk reaches
+    per-lane last-row logits; everything from sampling down is one code
+    path so they cannot drift).
+
+    Mirrors ``decode_block``'s live_step for decode lanes and the inject
+    path for final-chunk lanes (host replay at commit re-derives the
+    authoritative stop reason from ``packed``).  A final chunk hands the
+    lane to decode with the SAME state the classic path's admission
+    mirror + inject would produce: cache length = prompt length (the
+    sampled token's KV lands at exactly that position on the next decode
+    step), last token = the sample."""
     rng, sub = jax.random.split(rng)
     sampled = sample_tokens(
         logits, sub, sampling, use_filters, positions=base + q_lens
     )
     lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
-    # device bookkeeping, mirroring decode_block's live_step for decode
-    # lanes and the inject path for final-chunk lanes (host replay at
-    # commit re-derives the authoritative stop reason from ``packed``).
-    # A final chunk hands the lane to decode with the SAME state the
-    # classic path's admission mirror + inject would produce: cache length
-    # = prompt length (the sampled token's KV lands at exactly that
-    # position on the next decode step), last token = the sample.
     final_pf = is_pf & p_sample
     live = active | final_pf
     hit_stop = jnp.any(sampled[:, None] == stop_ids, axis=1)
@@ -401,7 +433,7 @@ def _unified_step(
     new_tokens = jnp.where(emit, sampled, tokens)
     out = jnp.where(live, sampled, -1)
     packed = pack_sampled_logprobs(out, lp, top_ids, top_lps)
-    return packed, new_tokens, new_seq, new_active, kv_pages, rng
+    return packed, new_tokens, new_seq, new_active, rng
 
 
 unified_step = partial(
@@ -409,6 +441,98 @@ unified_step = partial(
     static_argnames=("cfg", "top_n", "use_filters"),
     donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
 )(_unified_step)
+
+
+def _packed_unified_step(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,
+    tokens: jax.Array,  # [B] device-resident last committed token per lane
+    seq_lens: jax.Array,  # [B] cache length (next decode write position)
+    limit_lens: jax.Array,  # [B] cache length at which a lane must stop
+    active: jax.Array,  # [B] bool: decode lanes the scan would step
+    stop_ids: jax.Array,  # [B, E] device-checked stop tokens (-1 = pad)
+    page_table: jax.Array,  # [B, P] (bucketed)
+    t_tokens: jax.Array,  # [Np] packed fresh tokens (prefill chunk rows)
+    t_lane: jax.Array,  # [Np] lane per packed token (B = padding)
+    t_rel: jax.Array,  # [Np] row index within the lane's segment
+    t_dec: jax.Array,  # [Np] bool: row carries a decode lane's query (its
+    # token is read from the device-resident ``tokens`` vector, so packed
+    # steps pipeline exactly like rectangle ones)
+    p_start: jax.Array,  # [B] chunk start position (0 on decode lanes)
+    p_lens: jax.Array,  # [B] chunk length; 0 = decode (or idle) lane
+    p_sample: jax.Array,  # [B] bool: final chunk -> sample first token
+    p_activate: jax.Array,  # [B] bool: final chunk also joins decode
+    dec_cap: jax.Array,  # [B] bool: host packed a decode row for the lane
+    seg_off: jax.Array,  # [B] lane's segment offset into the packed axis
+    rng: jax.Array,
+    sampling: SamplingParams,
+    s_max: int,  # static per-lane window capacity (pow2 of max segment)
+    top_n: int = 0,
+    use_filters: bool = True,
+) -> Tuple[jax.Array, ...]:
+    """Fully-packed unified mixed step (ISSUE 10): the rectangle step's
+    semantics over a flat ``[Np]`` token axis.
+
+    Where :func:`_unified_step` pads every lane's query axis to the
+    dispatch's max chunk (a ``[B, S]`` trunk for ``used << B*S`` real
+    tokens once one long prefill chunk rides along), this step runs the
+    trunk over exactly the packed rows -- ``Np = pow2(total fresh
+    tokens)`` -- and resolves each row's lane through ``t_lane`` /
+    ``seg_off``.  Segments pack contiguously in slot order; a decode
+    lane contributes one row whose token is read from the
+    device-resident ``tokens`` vector on device (``t_dec``), so host
+    assembly never waits on an uncommitted step.  A decode lane that
+    self-deactivated on device masks its row to the trash page exactly
+    like the rectangle layout masks its column.  Sampling, stop
+    handling, and the decode-state fold are byte-for-byte the shared
+    :func:`_mixed_sample_epilogue`, keyed by the identical positions --
+    greedy and seeded lanes are token-identical to the rectangle and
+    classic paths.
+
+    Returns ``(packed [B, 2 + 2*top_n], tokens, seq_lens, active,
+    kv_pages, rng)`` -- the exact :func:`_unified_step` contract, so the
+    engine's commit path is layout-blind."""
+    B = tokens.shape[0]
+    Np = t_tokens.shape[0]
+    is_pf = p_lens > 0
+    q_lens = jnp.where(is_pf, p_lens, (dec_cap & active).astype(jnp.int32))
+    base = jnp.where(is_pf, p_start, seq_lens).astype(jnp.int32)
+    lane_c = jnp.clip(t_lane, 0, B - 1)
+    tok_flat = jnp.where(t_dec, tokens[lane_c], t_tokens)
+    pos = base[lane_c] + t_rel
+    valid = (t_lane < B) & (t_rel < q_lens[lane_c])
+    positions = jnp.where(valid, pos, 0)
+
+    def attn_fn(q, k, v, kv, layer):
+        out = att.packed_ragged_attention_dispatch(
+            q[0], k[0], v[0], kv, layer, page_table, base, seg_off,
+            q_lens, t_lane, t_rel, s_max, cfg.sliding_window or 0,
+        )
+        new_kv = att.write_packed_kv(
+            kv, k[0], v[0], page_table, t_lane, pos, valid, layer
+        )
+        return out[None], new_kv
+
+    hidden, kv_pages = transformer(
+        params, cfg, tok_flat[None], positions[None], kv_pages, attn_fn
+    )
+    last = jnp.clip(seg_off + q_lens - 1, 0, Np - 1)
+    hidden_last = hidden[0, last]  # [B, H]
+    logits = lm_logits(params, cfg, hidden_last)  # [B, V]
+    packed, new_tokens, new_seq, new_active, rng = _mixed_sample_epilogue(
+        logits, base, q_lens, is_pf, p_start, p_lens, p_sample, p_activate,
+        tokens, seq_lens, limit_lens, active, stop_ids, rng, sampling,
+        top_n, use_filters,
+    )
+    return packed, new_tokens, new_seq, new_active, kv_pages, rng
+
+
+packed_unified_step = partial(
+    jax.jit,
+    static_argnames=("cfg", "s_max", "top_n", "use_filters"),
+    donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
+)(_packed_unified_step)
 
 
 @partial(jax.jit, static_argnames=("cfg", "top_n"))

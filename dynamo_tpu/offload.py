@@ -261,6 +261,12 @@ class HostTier:
         self._ring: Optional[np.ndarray] = None
         self._ring_failed = False
         self._free_slots: List[int] = []
+        # prefetch pins: hash -> refcount.  A pinned block is skipped by
+        # LRU demotion, so a chain promoted for a queued request cannot
+        # be churned back to disk before its admission consumes it.  Pins
+        # come only from bounded prefetch windows and are released at
+        # admission or cancel (the leak the ISSUE 10 satellite closes).
+        self._pins: Dict[int, int] = {}
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
@@ -319,17 +325,25 @@ class HostTier:
             self._slots.move_to_end(seq_hash)
             self._meta[seq_hash] = meta
             while len(self._slots) > self.capacity:
-                self._demote_lru_locked(demote)
+                if not self._demote_lru_locked(demote):
+                    break  # everything resident is pinned; overshoot
         for victim, vb, vm in demote:
             if self.parent is not None:
                 self.parent.put(victim, vb, vm)
 
     def _demote_lru_locked(
         self, demote: List[Tuple[int, np.ndarray, BlockMeta]]
-    ) -> None:
-        if not self._slots:
-            return
-        victim, slot = self._slots.popitem(last=False)
+    ) -> bool:
+        """Demote the least-recent UNPINNED resident; returns False when
+        every resident is pinned (caller stops demoting -- the ring may
+        transiently exceed capacity rather than evict a block a queued
+        request is about to consume)."""
+        victim = next(
+            (h for h in self._slots if not self._pins.get(h)), None
+        )
+        if victim is None:
+            return False
+        slot = self._slots.pop(victim)
         meta = self._meta.pop(victim)
         if slot is None:
             vb, meta = self._misc.pop(victim)
@@ -337,6 +351,39 @@ class HostTier:
             vb = self._ring[slot].copy()
             self._free_slots.append(slot)
         demote.append((victim, vb, meta))
+        return True
+
+    def pin(self, seq_hash: int) -> bool:
+        """Pin a RAM-resident block against demotion (prefetch holds);
+        returns False when the hash is not resident."""
+        with self._lock:
+            if seq_hash not in self._slots:
+                return False
+            self._pins[seq_hash] = self._pins.get(seq_hash, 0) + 1
+            return True
+
+    def unpin(self, seq_hash: int) -> None:
+        with self._lock:
+            n = self._pins.get(seq_hash, 0) - 1
+            if n > 0:
+                self._pins[seq_hash] = n
+            else:
+                self._pins.pop(seq_hash, None)
+
+    @property
+    def pinned_blocks(self) -> int:
+        with self._lock:
+            return len(self._pins)
+
+    @property
+    def block_nbytes(self) -> int:
+        """Bytes of one resident block blob (0 until the first put)."""
+        if self._ring is not None:
+            return int(self._ring[0].nbytes)
+        with self._lock:
+            for blob, _meta in self._misc.values():
+                return int(blob.nbytes)
+        return 0
 
     def _evict_locked(self, seq_hash: int) -> None:
         slot = self._slots.pop(seq_hash, "absent")
@@ -407,6 +454,28 @@ class HostTier:
 SWAP_PENDING = "pending"
 SWAP_READY = "ready"
 SWAP_FAILED = "failed"
+
+
+@dataclass
+class PrefetchState:
+    """One queued request's prefetch walk (queue-side prefix promotion
+    with completion tracking, ISSUE 10).
+
+    ``done`` collects the hashes the walk found (or made) RAM-resident
+    -- each is pinned in the host ring until the request admits or
+    cancels.  ``completed_at`` stamps the walk's end; together with
+    ``issued_at`` and the admission stamp it yields the *overlap ratio*:
+    the fraction of the disk->host walk that ran during queue wait
+    instead of on the TTFT critical path (1.0 = fully hidden)."""
+
+    hashes: List[int]
+    issued_at: float = field(default_factory=time.perf_counter)
+    done: set = field(default_factory=set)
+    completed_at: Optional[float] = None
+    # stamped by finish_prefetch when admission lands before the walk
+    # finishes; the walk's tail then computes the partial overlap
+    admitted_at: Optional[float] = None
+    consumed: Optional[set] = None
 
 
 @dataclass
@@ -547,6 +616,15 @@ class KVOffloadEngine:
         self.swap_ins = 0
         self.swap_fallbacks = 0
         self.onboard_fallbacks = 0
+        # queue-side prefetch tracking (ISSUE 10): request-keyed walk
+        # states (pins + stamps) and the aggregate counters behind
+        # dynamo_kv_prefetch_* / the bench overlap ratio
+        self._prefetch_states: Dict[str, PrefetchState] = {}
+        self.prefetch_issued = 0  # blocks requested by tracked walks
+        self.prefetch_hits = 0  # staged blocks consumed at admission
+        self.prefetch_wasted_bytes = 0  # staged but never consumed
+        self.prefetch_overlap_sum = 0.0
+        self.prefetch_overlap_n = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -645,34 +723,153 @@ class KVOffloadEngine:
                 self._promoting.discard(seq_hash)
             self._wake()
 
-    def prefetch(self, seq_hashes: List[int]) -> None:
+    def prefetch(
+        self, seq_hashes: List[int], request_id: Optional[str] = None
+    ) -> None:
         """Queue-side prefetch: while the request waits for admission,
         promote its offloaded prefix chain into the host ring so the
         admission-time ``lookup`` is a RAM hit and the onboard's H2D
         scatter can be dispatched with the admitting tick (overlapping
         the copy with that tick's compute) instead of stalling on a disk
         read.  Stops at the first tier miss -- prefix chains are only
-        usable contiguously."""
+        usable contiguously.
+
+        With a ``request_id`` the walk is *tracked*: every block it
+        stages is pinned against ring demotion until the request admits
+        (:meth:`finish_prefetch`) or cancels (:meth:`cancel_prefetch`),
+        and the issue/complete/admit stamps feed the
+        ``dynamo_kv_prefetch_*`` series and the bench overlap ratio."""
         if not seq_hashes:
             return
-        self._ex.submit(self._prefetch, list(seq_hashes))
+        state = None
+        if request_id is not None:
+            state = PrefetchState(hashes=list(seq_hashes))
+            with self._lock:
+                old = self._prefetch_states.pop(request_id, None)
+                self._prefetch_states[request_id] = state
+                self.prefetch_issued += len(seq_hashes)
+            if old is not None:
+                self._release_prefetch(old, wasted=True)
+            self.metrics.prefetch_issued.inc(len(seq_hashes))
+        self._ex.submit(self._prefetch, list(seq_hashes), request_id, state)
 
-    def _prefetch(self, seq_hashes: List[int]) -> None:
+    def _prefetch(
+        self,
+        seq_hashes: List[int],
+        request_id: Optional[str] = None,
+        state: Optional[PrefetchState] = None,
+    ) -> None:
         for h in seq_hashes:
             try:
-                if self.host.get_ram(h) is not None:
-                    continue
-                promoted = self.host.get(h)
-                if promoted is None:
-                    break
-                # a promote is NOT a hit: only lookups actually served
-                # count toward tier_hit_rate (the router warmth signal)
-                self.disk_promotes += 1
-                self.metrics.tier_promotes.labels("disk").inc()
+                resident = self.host.get_ram(h) is not None
+                if not resident:
+                    if self.host.get(h) is None:
+                        break
+                    # a promote is NOT a hit: only lookups actually
+                    # served count toward tier_hit_rate (the router
+                    # warmth signal)
+                    self.disk_promotes += 1
+                    self.metrics.tier_promotes.labels("disk").inc()
+                if state is not None:
+                    # pin-and-record under the engine lock so a
+                    # concurrent cancel (which pops the state under the
+                    # same lock and unpins ``done``) cannot miss a pin
+                    with self._lock:
+                        if self._prefetch_states.get(
+                            request_id
+                        ) is state and self.host.pin(h):
+                            state.done.add(h)
             except Exception:
                 logger.debug("prefetch failed at %x", h, exc_info=True)
                 break
+        if state is not None:
+            settle = False
+            with self._lock:
+                state.completed_at = time.perf_counter()
+                if (
+                    self._prefetch_states.get(request_id) is state
+                    and state.admitted_at is not None
+                ):
+                    # admission landed mid-walk: settle the partial
+                    # overlap now that the walk's end is known
+                    self._prefetch_states.pop(request_id, None)
+                    settle = True
+            if settle:
+                self._settle_prefetch(state)
         self._observe_occupancy()
+
+    def finish_prefetch(
+        self, request_id: str, consumed_hashes: List[int]
+    ) -> int:
+        """Admission landed: release the request's prefetch pins, count
+        hits (staged blocks the admission actually onboarded) vs wasted
+        bytes, and record the overlap ratio.  Returns the hit count (the
+        admission-path span attr).  Safe to call for untracked ids."""
+        with self._lock:
+            state = self._prefetch_states.get(request_id)
+            if state is None:
+                return 0
+            state.admitted_at = time.perf_counter()
+            state.consumed = set(consumed_hashes)
+            if state.completed_at is None:
+                # walk still running: it settles the state at its end
+                # (pins it takes after this point release there too)
+                return len(state.done & state.consumed)
+            self._prefetch_states.pop(request_id, None)
+        return self._settle_prefetch(state)
+
+    def cancel_prefetch(self, request_id: str) -> None:
+        """A queued request left before admission (cancel / error): free
+        its host-staged prefetch state -- unpin every staged block and
+        charge the bytes as wasted.  Without this, pins from abandoned
+        requests accumulate and the ring degenerates to unevictable.  A
+        still-running walk stops pinning the moment the state is popped
+        (it re-checks registration under the lock before every pin)."""
+        with self._lock:
+            state = self._prefetch_states.pop(request_id, None)
+        if state is None:
+            return
+        self._release_prefetch(state, wasted=True)
+
+    def _settle_prefetch(self, state: PrefetchState) -> int:
+        """Settle one tracked walk's accounting and release its pins.
+        Called from the offload thread (walk end) or the engine executor
+        (admission) -- never while holding ``self._lock``; the plain-int
+        aggregates update under it so concurrent settles cannot lose
+        increments."""
+        consumed = state.consumed or set()
+        hits = len(state.done & consumed)
+        wasted = len(state.done - consumed) * self.host.block_nbytes
+        walk = (state.completed_at or state.issued_at) - state.issued_at
+        ratio = None
+        if walk > 0 and state.admitted_at is not None:
+            ratio = min(
+                max((state.admitted_at - state.issued_at) / walk, 0.0), 1.0
+            )
+        with self._lock:
+            self.prefetch_hits += hits
+            self.prefetch_wasted_bytes += wasted
+            if ratio is not None:
+                self.prefetch_overlap_sum += ratio
+                self.prefetch_overlap_n += 1
+        if hits:
+            self.metrics.prefetch_hits.inc(hits)
+        if wasted:
+            self.metrics.prefetch_wasted.inc(wasted)
+        if ratio is not None:
+            self.metrics.prefetch_overlap.observe(ratio)
+        for h in state.done:
+            self.host.unpin(h)
+        return hits
+
+    def _release_prefetch(self, state: PrefetchState, wasted: bool) -> None:
+        if wasted and state.done:
+            nbytes = len(state.done) * self.host.block_nbytes
+            with self._lock:
+                self.prefetch_wasted_bytes += nbytes
+            self.metrics.prefetch_wasted.inc(nbytes)
+        for h in state.done:
+            self.host.unpin(h)
 
     def contains(self, seq_hash: int) -> bool:
         return self.host.contains(seq_hash)
@@ -827,7 +1024,15 @@ class KVOffloadEngine:
             onboard_fallbacks=self.onboard_fallbacks,
             swap_used_blocks=self._swap_used,
             copy_fails=self.copy_fails,
+            prefetch_issued=self.prefetch_issued,
+            prefetch_hits=self.prefetch_hits,
+            prefetch_wasted_bytes=self.prefetch_wasted_bytes,
+            prefetch_pinned_blocks=self.host.pinned_blocks,
         )
+        if self.prefetch_overlap_n:
+            out["prefetch_overlap_ratio"] = round(
+                self.prefetch_overlap_sum / self.prefetch_overlap_n, 4
+            )
         if self.onboard_seconds > 0:
             out["onboard_gbps"] = round(
                 self.onboard_bytes / self.onboard_seconds / 1e9, 3

@@ -34,6 +34,13 @@ and nothing is written back but the ``[B, S, Hq, D]`` output.
 reference implementation -- tier-1 (``JAX_PLATFORMS=cpu``) exercises the
 XLA composition via ``engine.attention.ragged_attention_dispatch``, which
 resolves the backend at trace time like every other dispatch gate.
+
+Two operand layouts share the math: the original **rectangle**
+(``[B, S]`` queries, every lane padded to the dispatch's max chunk) and
+the **fully-packed** flat token axis (ISSUE 10,
+:func:`packed_ragged_attention` / :func:`packed_ragged_attention_xla`
+below) whose trunk-side win is the whole point -- see the section
+comment ahead of the packed kernel.
 """
 
 from __future__ import annotations
@@ -292,3 +299,266 @@ def ragged_paged_attention_xla(
     scores = jnp.where(mask, scores, _NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+
+
+# ---------------------------------------------------------------------------
+# fully-packed ragged layout (ISSUE 10): flat token axis + per-lane offsets
+# ---------------------------------------------------------------------------
+#
+# The rectangle above pads EVERY lane's query axis to the dispatch's max
+# chunk, so one long prefill chunk makes the whole batch pay its width --
+# with B=8 lanes, a 512-token chunk next to 7 decode lanes runs a
+# [8, 512] trunk (4096 rows) for 519 real tokens.  The packed layout
+# carries the dispatch's fresh tokens on ONE flat axis of length
+# pow2_bucket(total) with per-lane segment offsets: the trunk (embed /
+# QKV / MLP / logits -- the bulk of prefill FLOPs) runs exactly the
+# packed rows, and attention resolves each token's lane through the
+# offset tables.  Segments are packed contiguously in slot order, one
+# segment per lane, decode lanes contributing a single row.
+
+
+def _packed_kernel(
+    # scalar prefetch
+    layer_ref,  # [1] layer index (SMEM)
+    pt_ref,  # [B, P] page table (SMEM)
+    base_ref,  # [B] committed cache length = first fresh position (SMEM)
+    off_ref,  # [B] lane's segment offset into the packed axis (SMEM)
+    len_ref,  # [B] fresh rows per lane (SMEM)
+    *refs,  # G kv blocks, packed q, packed fresh k/v, o_ref, m/l/acc scratch
+    G: int,
+    s_max: int,
+    window: int = 0,
+):
+    """Grid ``(B, P/G + 1)``, the page-streaming structure of
+    :func:`_ragged_kernel`, over PACKED operands: the whole packed
+    ``[Np, H, D]`` q / fresh-k / fresh-v arrays ride as single VMEM
+    blocks (revisited every step, so they transfer once), and lane ``b``
+    reads its ``s_max``-row window at ``off_ref[b]`` with a dynamic
+    slice.  The caller guarantees ``off + s_max <= Np`` for every live
+    lane (packed-axis padding rule in the step assembly), so the slice
+    never clamps and rows stay aligned.
+
+    Output aliasing: lane ``b``'s final step writes its full
+    ``s_max``-row window, whose tail (rows past ``q_len``) overlaps the
+    NEXT lanes' segments -- safe because the grid walks lanes in
+    ascending order, so a later lane's write overwrites any garbage a
+    predecessor spilled into its rows.  Idle lanes (``q_len == 0``) skip
+    both compute and the write (their offset is 0 and would clobber the
+    first live lane)."""
+    kv_refs = refs[:G]
+    q_ref, fk_ref, fv_ref, o_ref, m_scr, l_scr, acc_scr = refs[G:]
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    npg = pl.num_programs(1) - 1
+    page = kv_refs[0].shape[3]
+    Hkv = kv_refs[0].shape[4]
+    D = kv_refs[0].shape[5]
+    Hq = q_ref.shape[1]
+    n_rep = Hq // Hkv
+    scale = 1.0 / (D ** 0.5)
+
+    base = base_ref[b]
+    off = off_ref[b]
+    q_len = len_ref[b]
+    live_lane = q_len > 0
+
+    @pl.when((p == 0) & ((b == 0) | live_lane))
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when((b == 0) & (p == 0))
+    def _zero_out():
+        # pad rows of the packed output are never overwritten by a lane's
+        # window; zero once so the host-bound array holds no uninitialized
+        # memory
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    def q4():
+        # lane window [s_max, Hq, D] -> [Hkv, n_rep, s_max, D]
+        qw = q_ref[pl.ds(off, s_max)]
+        return qw.transpose(1, 0, 2).reshape(Hkv, n_rep, s_max, D)
+
+    def accumulate(s, v):  # s [Hkv, n_rep, s_max, K], v [Hkv, K, D]
+        s2 = s.reshape(Hq * s_max, s.shape[-1])
+        m_prev = m_scr[:]
+        m_cur = jnp.max(s2, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(s2 - m_new)
+        pv = jax.lax.dot_general(
+            probs.reshape(Hkv, n_rep * s_max, s.shape[-1]).astype(v.dtype), v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + pv.reshape(Hq * s_max, D)
+
+    grp_base = p * G * page
+    live = live_lane & (p < npg) & (grp_base < base)
+    if window > 0:
+        live = live & (grp_base + G * page > base - window)
+
+    @pl.when(live)
+    def _prefix():
+        k = jnp.concatenate(
+            [r[0, 0, 0].transpose(1, 0, 2) for r in kv_refs], axis=1
+        )  # [Hkv, G*page, D]
+        v = jnp.concatenate(
+            [r[0, 1, 0].transpose(1, 0, 2) for r in kv_refs], axis=1
+        )
+        s = jax.lax.dot_general(
+            q4(), k,
+            dimension_numbers=(((3,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Hkv, n_rep, s_max, G*page]
+        kpos = grp_base + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=3
+        )
+        keep = kpos < base
+        if window > 0:
+            qpos = base + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, dimension=2
+            )
+            keep = keep & (kpos > qpos - window)
+        accumulate(jnp.where(keep, s, _NEG_INF), v)
+
+    @pl.when(live_lane & (p == npg))
+    def _fresh():
+        fk = fk_ref[pl.ds(off, s_max)].transpose(1, 0, 2)  # [Hkv, s_max, D]
+        fv = fv_ref[pl.ds(off, s_max)].transpose(1, 0, 2)
+        s = jax.lax.dot_general(
+            q4(), fk,
+            dimension_numbers=(((3,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Hkv, n_rep, s_max, s_max]
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=2)
+        kj = jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=3)
+        keep = (kj <= qi) & (kj < q_len)
+        if window > 0:
+            keep = keep & (qi - kj < window)
+        accumulate(jnp.where(keep, s, _NEG_INF), fv)
+        l = l_scr[:]
+        safe = jnp.where(l > 0.0, l, 1.0)
+        out = (acc_scr[:] / safe).reshape(Hkv, n_rep, s_max, D)
+        o_ref[pl.ds(off, s_max)] = (
+            out.reshape(Hq, s_max, D).transpose(1, 0, 2).astype(o_ref.dtype)
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("s_max", "window", "group", "interpret")
+)
+def packed_ragged_attention(
+    q: jax.Array,  # [Np, Hq, D] packed queries (lane's row i at base + i)
+    k: jax.Array,  # [Np, Hkv, D] packed fresh keys
+    v: jax.Array,  # [Np, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    page_table: jax.Array,  # [B, P] int32 page ids
+    base: jax.Array,  # [B] committed cache length per lane
+    seg_off: jax.Array,  # [B] lane's segment offset into the packed axis
+    q_lens: jax.Array,  # [B] fresh rows per lane (0 = no segment)
+    s_max: int,  # static per-lane window capacity (pow2 of max segment)
+    layer: jax.Array | int = 0,
+    window: int = 0,
+    group: int = 4,
+    interpret: bool = False,
+) -> jax.Array:
+    """Packed-layout ragged paged attention (see the section comment):
+    one flat ``[Np]`` token axis, per-lane segment offsets, the same
+    page-group-streaming grid as :func:`ragged_paged_attention`.  The
+    packed operands live in VMEM for the whole launch, so ``Np`` (the
+    mixed-dispatch token budget) bounds the resident footprint --
+    budgets into the low thousands of tokens fit comfortably."""
+    Np, Hq, D = q.shape
+    L, _, num_pages, page, Hkv, _ = kv_pages.shape
+    B, P = page_table.shape
+    G = min(group, P)
+    while P % G:
+        G -= 1
+    npg = P // G
+
+    pt = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
+    lyr = jnp.clip(jnp.asarray(layer, jnp.int32), 0, L - 1).reshape(1)
+
+    def kv_map(g):
+        def m(b, p, layer_ref, pt_ref, base_ref, off_ref, len_ref):
+            pp = jnp.minimum(p, npg - 1)
+            return (layer_ref[0], 0, pt_ref[b, pp * G + g], 0, 0, 0)
+
+        return m
+
+    def packed_map(b, p, *_):
+        # the whole packed axis is one block, revisited every grid step
+        return (0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(B, npg + 1),
+        in_specs=[
+            pl.BlockSpec((1, 2, 1, page, Hkv, D), kv_map(g)) for g in range(G)
+        ]
+        + [
+            pl.BlockSpec((Np, Hq, D), packed_map),
+            pl.BlockSpec((Np, Hkv, D), packed_map),
+            pl.BlockSpec((Np, Hkv, D), packed_map),
+        ],
+        out_specs=pl.BlockSpec((Np, Hq, D), packed_map),
+        scratch_shapes=[
+            pltpu.VMEM((Hq * s_max, 1), jnp.float32),
+            pltpu.VMEM((Hq * s_max, 1), jnp.float32),
+            pltpu.VMEM((Hq * s_max, D), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_packed_kernel, G=G, s_max=s_max, window=window),
+        out_shape=jax.ShapeDtypeStruct((Np, Hq, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(
+        lyr, pt, base.astype(jnp.int32), seg_off.astype(jnp.int32),
+        q_lens.astype(jnp.int32), *([kv_pages] * G), q, k, v,
+    )
+
+
+def packed_ragged_attention_xla(
+    q: jax.Array,  # [Np, Hq, D] packed queries
+    k: jax.Array,  # [Np, Hkv, D] packed fresh keys
+    v: jax.Array,  # [Np, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    page_table: jax.Array,  # [B, P]
+    base: jax.Array,  # [B]
+    seg_off: jax.Array,  # [B]
+    q_lens: jax.Array,  # [B]
+    lane: jax.Array,  # [Np] lane per packed token (B = padding)
+    rel: jax.Array,  # [Np] row index within the lane's segment
+    s_max: int,
+    layer: jax.Array | int = 0,
+    window: int = 0,
+) -> jax.Array:
+    """Pure-XLA packed reference: unpack the flat axis into the lane
+    rectangle with per-lane dynamic windows, run the EXACT rectangle
+    reference (:func:`ragged_paged_attention_xla` -- same math, same
+    masks), and repack valid rows.  Attention numerics are therefore
+    identical to the rectangle path by construction; the packed layout's
+    compute win on this backend is the trunk (the step runs ``Np`` rows
+    instead of ``B*S``), while the Pallas kernel above also streams
+    packed operands.  Rows past a lane's ``q_len`` unpack into the next
+    lane's tokens -- harmless, the reference masks fresh keys by
+    ``q_lens`` and the repack gather never reads an invalid row's
+    output."""
+    Np = q.shape[0]
+    B = page_table.shape[0]
+    idx = seg_off[:, None] + jnp.arange(s_max, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(idx, 0, Np - 1)  # [B, s_max]
+    out_rect = ragged_paged_attention_xla(
+        q[idx], k[idx], v[idx], kv_pages, page_table, base, q_lens,
+        layer, window,
+    )  # [B, s_max, Hq, D]
+    lane_c = jnp.clip(lane.astype(jnp.int32), 0, B - 1)
+    rel_c = jnp.clip(rel.astype(jnp.int32), 0, s_max - 1)
+    out = out_rect[lane_c, rel_c]  # [Np, Hq, D]
+    valid = (lane.astype(jnp.int32) < B)[:, None, None]
+    return jnp.where(valid, out, jnp.zeros_like(out))

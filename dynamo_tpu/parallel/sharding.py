@@ -230,6 +230,7 @@ class ShardedSteps:
     kv_sharding: NamedSharding
     decode_block: Any
     unified_step: Any
+    packed_unified_step: Any
     verify_and_sample: Any
     update_lanes: Any
     inject_token: Any
@@ -306,6 +307,22 @@ def make_sharded_steps(
         in_shardings=(
             param_sh, kv_sh, vec, vec, vec, vec, mat, mat,
             mat, vec, vec, vec, vec, None, samp,
+        ),
+        out_shardings=(None, vec, vec, vec, kv_sh, None),
+    )
+    packed_unified_step = jax.jit(
+        _step._packed_unified_step,
+        static_argnames=("cfg", "s_max", "top_n", "use_filters"),
+        donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
+        # (params, kv, tokens, seq_lens, limit_lens, active, stop_ids,
+        #  page_table, t_tokens, t_lane, t_rel, t_dec, p_start, p_lens,
+        #  p_sample, p_activate, dec_cap, seg_off, rng, sampling): the
+        # packed [Np] token axis interleaves lanes arbitrarily, so it
+        # stays unconstrained (GSPMD gathers from the dp-sharded state)
+        in_shardings=(
+            param_sh, kv_sh, vec, vec, vec, vec, mat, mat,
+            None, None, None, None, vec, vec, vec, vec, vec, vec,
+            None, samp,
         ),
         out_shardings=(None, vec, vec, vec, kv_sh, None),
     )
@@ -390,6 +407,7 @@ def make_sharded_steps(
         kv_sharding=kv_sh,
         decode_block=decode_block,
         unified_step=unified_step,
+        packed_unified_step=packed_unified_step,
         verify_and_sample=verify_and_sample,
         update_lanes=update_lanes,
         inject_token=inject_token,

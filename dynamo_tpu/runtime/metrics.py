@@ -214,6 +214,17 @@ class EngineMetrics:
             "Prefill tokens packed into a unified mixed-batch dispatch",
             buckets=(0, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
         )
+        # fresh-token accounting per unified dispatch (ISSUE 10): `used`
+        # counts real rows (decode lanes + packed prefill tokens),
+        # `dispatched` the rows the executable actually ran, `rectangle`
+        # the rows the lane-rectangle layout would have run -- the
+        # padded-token fractions the long-context bench reports are
+        # 1 - used/dispatched and 1 - used/rectangle
+        self.mixed_tokens = reg.counter(
+            "dynamo_engine_mixed_tokens",
+            "Fresh-token rows per unified mixed dispatch by accounting kind",
+            ["kind"],  # used | dispatched | rectangle
+        )
         if max_slots:
             self.slots.set(max_slots)
 
@@ -232,6 +243,13 @@ class EngineMetrics:
     def observe_mixed(self, decode_lanes: int, prefill_tokens: int) -> None:
         self.mixed_decode_lanes.observe(decode_lanes)
         self.mixed_prefill_tokens.observe(prefill_tokens)
+
+    def observe_mixed_tokens(
+        self, used: int, dispatched: int, rectangle: int
+    ) -> None:
+        self.mixed_tokens.labels("used").inc(used)
+        self.mixed_tokens.labels("dispatched").inc(dispatched)
+        self.mixed_tokens.labels("rectangle").inc(rectangle)
 
     def observe_kv(self, used: int, total: int) -> None:
         self.kv_used.set(used)
@@ -315,6 +333,28 @@ class OffloadMetrics:
             "dynamo_kv_offload_copy_failures",
             "Offload materializations dropped (I/O errors or injected "
             "offload.copy_fail faults)",
+        )
+        # queue-side prefetch (ISSUE 10): tracked walks that stage
+        # offloaded prefix chains toward host RAM during queue wait
+        self.prefetch_issued = reg.counter(
+            "dynamo_kv_prefetch_issued_blocks",
+            "Prefix blocks requested by tracked queue-side prefetch walks",
+        )
+        self.prefetch_hits = reg.counter(
+            "dynamo_kv_prefetch_hits",
+            "Prefetch-staged blocks found host-resident and consumed at "
+            "admission (the onboard scatter never waited on a disk read)",
+        )
+        self.prefetch_wasted = reg.counter(
+            "dynamo_kv_prefetch_wasted_bytes",
+            "Bytes prefetch-staged but never consumed (request cancelled "
+            "before admission, or the admission matched elsewhere)",
+        )
+        self.prefetch_overlap = reg.histogram(
+            "dynamo_kv_prefetch_overlap_ratio",
+            "Fraction of each tracked prefetch walk that overlapped queue "
+            "wait instead of the TTFT critical path (1.0 = fully hidden)",
+            buckets=RATIO_BUCKETS,
         )
 
     def record_offload(self, tier: str, nbytes: int, seconds: float) -> None:
