@@ -213,6 +213,7 @@ async def run_serving(engine) -> dict:
     from dynamo_tpu.http import HttpService
     from dynamo_tpu.llm.backend import Backend
     from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.runtime import profiling
     from dynamo_tpu.runtime.pipeline import link
 
     with tempfile.TemporaryDirectory() as td:
@@ -223,12 +224,20 @@ async def run_serving(engine) -> dict:
         svc.manager.add_chat_model(name, pipeline)
         svc.manager.add_completion_model(name, pipeline)
         await svc.start()
+        prof = profiling.profiler
+        prof_was_enabled = prof.enabled
         try:
             host, port = svc.address
             vocab = max(3, tok.vocab_size - 1)
             warm = synth_workload(8, isl=128, osl=8, request_rate=0.0,
                                   vocab=vocab, seed=7)
             await run_bench(host, port, name, warm, concurrency=8)
+            # tick-phase profiling covers only the measured window (the
+            # warmup's compile storms would drown the steady-state split);
+            # the serving line reports where host tick time actually goes
+            # and the dispatch gap -- the ROADMAP item 2 localizers
+            prof.clear()
+            prof.enable()
             work = synth_workload(48, isl=128, osl=64, request_rate=0.0,
                                   vocab=vocab, seed=8)
             report = await run_bench(host, port, name, work, concurrency=16)
@@ -239,14 +248,22 @@ async def run_serving(engine) -> dict:
             lat_report = await run_bench(host, port, name, lat, concurrency=4)
             ls = lat_report.summary()
             assert ls["num_errors"] == 0, f"latency bench errors: {ls}"
+            psum = prof.summary()
             return {
                 "serving_tok_s": s["output_tok_s"],
                 "ttft_p50_ms": s["ttft_ms"]["p50"],
                 "ttft_p99_ms": s["ttft_ms"]["p99"],
                 "ttft_lat_p50_ms": ls["ttft_ms"]["p50"],
                 "ttft_lat_p99_ms": ls["ttft_ms"]["p99"],
+                # top host phases of the serving window (name, seconds):
+                # which host-side leg to attack before the next TPU round
+                "host_phase_top3": psum["top_phases"][:3],
+                "host_occupancy": psum["host_occupancy"],
+                "dispatch_gap_p50_ms": psum["gap_p50_ms"],
             }
         finally:
+            if not prof_was_enabled:
+                prof.disable()
             await svc.stop()
 
 
@@ -991,6 +1008,19 @@ async def run_long_context(
             per_class = {i: [] for i in range(len(lengths))}
             for (ttft, _n), p in zip(results, interleaved):
                 per_class[lengths.index(len(p))].append(ttft * 1000.0)
+            # per-bucket SLO attainment (runtime/slo.py): the DYN_SLO ttft
+            # target if armed, else a ladder default -- the number the
+            # SLO-loop planner work (ROADMAP item 1) scales against
+            from dynamo_tpu.runtime import slo as _slo
+
+            slo_spec = os.environ.get("DYN_SLO", "")
+            try:
+                ttft_target = _slo.parse_slo_spec(slo_spec)[0].get("ttft")
+            except _slo.SloSpecError:
+                ttft_target = None
+            if ttft_target is None:
+                ttft_target = 2.0  # seconds; CPU-smoke-realistic default
+            out["lctx_slo_ttft_target_ms"] = round(ttft_target * 1e3, 1)
             names = ["short", "mid", "long"][: len(lengths)]
             for i, name in enumerate(names):
                 vals = per_class[i]
@@ -999,6 +1029,12 @@ async def run_long_context(
                 )
                 out[f"lctx_ttft_p95_ms_{name}"] = round(
                     float(np.percentile(vals, 95)), 1
+                )
+                att = _slo.attainment_of(
+                    [v / 1e3 for v in vals], ttft_target
+                )
+                out[f"lctx_slo_ttft_attainment_{name}"] = (
+                    round(att, 4) if att is not None else None
                 )
             used = engine.mixed_used_tokens - used0
             disp = engine.mixed_dispatched_tokens - disp0

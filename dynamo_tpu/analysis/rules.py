@@ -1,4 +1,4 @@
-"""dynalint rules DT001-DT011: this repo's real async/JAX hazard classes.
+"""dynalint rules DT001-DT012: this repo's real async/JAX hazard classes.
 
 Each rule is deliberately narrow: it encodes a bug class this codebase has
 actually exhibited (blocking WAL I/O on the hub event loop, silent
@@ -1112,6 +1112,60 @@ class MultichipShardingsDeclared(Rule):
 
 
 # ---------------------------------------------------------------------------
+# DT012: ad-hoc perf_counter timing in engine/ hot paths
+# ---------------------------------------------------------------------------
+
+
+class AdHocTimingInEngine(Rule):
+    id = "DT012"
+    name = "adhoc-timing-in-engine"
+    severity = "error"
+    description = (
+        "A direct ``time.perf_counter()`` / ``perf_counter_ns()`` call in "
+        "an ``engine/`` module.  The tick loop now has a first-class "
+        "timing plane (runtime/profiling.TickProfiler: phase marks, "
+        "dispatch-gap accounting, the tick ring) and a metrics registry; "
+        "ad-hoc stopwatch pairs in the hot path measure one thing for one "
+        "debug session, drift from the exported numbers, and stay behind "
+        "as per-tick overhead.  Route the measurement through the "
+        "profiler (``tick.mark(...)`` / ``observe_phase``) or a registry "
+        "family; the pre-existing justified sites (dispatch stamps that "
+        "feed ``dynamo_*`` histograms) carry inline suppressions.  "
+        "``field(default_factory=time.perf_counter)`` references are out "
+        "of scope -- they are stamps consumed by metrics code, not "
+        "stopwatch pairs."
+    )
+
+    _CLOCK_NAMES = {
+        "time.perf_counter", "perf_counter",
+        "time.perf_counter_ns", "perf_counter_ns",
+    }
+
+    @staticmethod
+    def _applies(relpath: str) -> bool:
+        head, _, fname = relpath.rpartition("/")
+        return fname.endswith(".py") and (
+            head == "engine" or head.endswith("/engine")
+        )
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not self._applies(module.relpath):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) not in self._CLOCK_NAMES:
+                continue
+            yield self.finding(
+                module, node,
+                "ad-hoc perf_counter timing in engine/: route through "
+                "TickProfiler (tick.mark/observe_phase) or a "
+                "runtime/metrics.py family so the number is exported, "
+                "not stranded",
+            )
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -1127,6 +1181,7 @@ ALL_RULES: List[Rule] = [
     OffloadSyncTransfer(),
     HotPathManifestDrift(),
     MultichipShardingsDeclared(),
+    AdHocTimingInEngine(),
 ]
 
 

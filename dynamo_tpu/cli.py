@@ -289,6 +289,28 @@ def build_parser() -> argparse.ArgumentParser:
 
     # profile-sla: pre-deployment TTFT/ITL profiling (reference
     # docs/architecture/planner.md profile_sla workflow)
+    # profile: the tick-phase profiler's read side against a live frontend
+    # (GET /profile/ticks; runtime/profiling.py) -- where does a serving
+    # tick's wall time go, and how big is the dispatch gap?
+    pf = sub.add_parser("profile",
+                        help="tick-phase profile of a live serving frontend")
+    pf.add_argument("url", help="frontend base url, e.g. "
+                                "http://127.0.0.1:8080")
+    pf.add_argument("--enable", action="store_true",
+                    help="arm tick profiling on the server first "
+                         "(POST /profile/ticks)")
+    pf.add_argument("--disable", action="store_true",
+                    help="disarm tick profiling on the server and exit")
+    pf.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="arm profiling, wait this long under live "
+                         "traffic, then report (implies --enable)")
+    pf.add_argument("--json", dest="json_out",
+                    help="write the merged Chrome-trace JSON (tick phases "
+                         "+ request spans) here")
+    pf.add_argument("--device", type=float, default=None, metavar="SECONDS",
+                    help="also capture a bounded jax.profiler device "
+                         "trace (POST /profile/device)")
+
     ps = sub.add_parser("profile-sla",
                         help="measure TTFT/ITL per config, recommend SLO point")
     ps.add_argument("--out", default="jax", choices=["jax", "mocker", "echo"],
@@ -915,6 +937,98 @@ async def run_llmctl(args) -> int:
         await hub.close()
 
 
+async def run_profile(args) -> int:
+    """profile: read a live frontend's tick-phase profile
+    (``GET /profile/ticks``) and print where tick wall time goes -- the
+    host phases, occupancy, and the dispatch gap ROADMAP item 2 attacks.
+    ``--watch S`` arms profiling, samples S seconds of live traffic, then
+    reports; ``--device S`` additionally triggers a bounded
+    ``jax.profiler`` capture on the server."""
+    import json as _json
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+
+    def _call(path: str, payload=None):
+        import urllib.error
+
+        data = None
+        if payload is not None:
+            data = _json.dumps(payload).encode()
+        req = urllib.request.Request(
+            base + path,
+            data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=35.0) as resp:
+                return _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            # structured non-2xx bodies (e.g. /profile/device's graceful
+            # 503 {ok:false,error}) are answers, not connectivity failures
+            body = e.read().decode(errors="replace")
+            try:
+                return _json.loads(body)
+            except ValueError:
+                raise OSError(f"HTTP {e.code}: {body[:200]}") from e
+
+    async def call(path: str, payload=None):
+        return await asyncio.to_thread(_call, path, payload)
+
+    try:
+        if args.disable:
+            out = await call("/profile/ticks", {"enabled": False})
+            print(f"tick profiling disabled (server enabled={out['enabled']})")
+            return 0
+        if args.enable or args.watch is not None:
+            await call("/profile/ticks", {"enabled": True, "clear": True})
+        if args.watch is not None:
+            print(f"profiling armed; sampling {args.watch:g}s of traffic...")
+            await asyncio.sleep(max(args.watch, 0.0))
+        if args.device is not None:
+            dev = await call(
+                "/profile/device", {"duration_s": args.device}
+            )
+            if dev.get("ok"):
+                print(f"device trace captured to {dev['log_dir']}")
+            else:
+                print(f"device trace unavailable: {dev.get('error')}")
+        data = await call("/profile/ticks")
+    except OSError as e:
+        print(f"cannot reach {base}: {e}")
+        return 1
+    summ = data.get("summary") or {}
+    if not summ.get("ticks"):
+        print(
+            "no tick records yet (is the profiler enabled -- "
+            "DYN_TICK_PROFILE=1, --enable, or --watch -- and is the "
+            "engine serving traffic?)"
+        )
+        return 1
+    wall = summ.get("wall_s") or 0.0
+    print(
+        f"{summ['ticks']} ticks, {summ['dispatches']} dispatches, "
+        f"wall {wall:.3f}s, host occupancy "
+        f"{summ.get('host_occupancy')}"
+    )
+    print(f"{'phase':<12} {'total_s':>10} {'% wall':>8}")
+    totals = summ.get("phase_totals_s") or {}
+    for name, tot in sorted(totals.items(), key=lambda kv: -kv[1]):
+        frac = 100.0 * tot / wall if wall else 0.0
+        print(f"{name:<12} {tot:>10.4f} {frac:>7.1f}%")
+    print(
+        f"dispatch gap p50={summ.get('gap_p50_ms')}ms "
+        f"p95={summ.get('gap_p95_ms')}ms"
+    )
+    if args.json_out:
+        payload = _json.dumps(data.get("chrome_trace") or {}, indent=2)
+        await asyncio.to_thread(_write_text, args.json_out, payload)
+        print(f"chrome trace written to {args.json_out}")
+    return 0
+
+
 async def run_profile_sla(args) -> int:
     """profile-sla: drive the engine, print the TTFT/ITL table + the SLO
     recommendation as one JSON object."""
@@ -1429,6 +1543,8 @@ def main(argv=None) -> int:
         return asyncio.run(run_metrics(args))
     if args.cmd == "datagen":
         return run_datagen(args)
+    if args.cmd == "profile":
+        return asyncio.run(run_profile(args))
     if args.cmd == "profile-sla":
         return asyncio.run(run_profile_sla(args))
     if args.cmd == "bench":

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.hotpath import hot_path
+from ..runtime import profiling, slo
 from ..runtime.engine import Annotated, Context, ResponseStream
 from ..runtime.utils import log_throttled
 from ..protocols.common import (
@@ -381,6 +382,8 @@ class KVExportStream:
                 assert self._group is not None
                 span = await self._group.host_span(idx)
                 part = span[:, :, self._page_off : self._page_off + k]
+            # dynalint: disable=DT012 -- export-stream readiness stamps feed
+            # the bench's export-before-first-byte stats, not ad-hoc timing
             now = time.perf_counter()
             if self.first_ready_at is None:
                 self.first_ready_at = now
@@ -737,6 +740,13 @@ class JaxEngine:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.spec_verify_steps = 0
+        # tick-phase profiler (runtime/profiling.py): the process-wide
+        # instance, armed by DYN_TICK_PROFILE / profiler.enable().  The
+        # loop opens one tick record per iteration when enabled;
+        # ``self._tick`` is the in-progress record every instrumented
+        # site consults -- None (one attribute check) when disabled.
+        self.profiler = profiling.profiler
+        self._tick: Optional[Any] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -844,7 +854,26 @@ class JaxEngine:
             # a ready swap blob must wake a sleeping tick loop (all lanes
             # parked = nothing runnable = the loop is waiting on _wake)
             self.offload_engine.wake_cb = self._wake_from_thread
+        self._flightrec_key = profiling.flight_recorder.add_provider(
+            "engine", self._flightrec_state
+        )
         self._task = asyncio.create_task(self._run(), name="jax-engine-loop")
+
+    def _flightrec_state(self) -> Dict[str, Any]:
+        """Queue/batch/KV occupancy for flight-recorder snapshots (called
+        from failure edges on arbitrary threads: reads only)."""
+        alloc = self.kv.allocator
+        return {
+            "waiting": len(self.sched.waiting),
+            "active": self.sched.num_active,
+            "slots": self.cfg.max_batch_size,
+            "kv_pages_used": alloc.used_pages,
+            "kv_pages_total": alloc.num_pages - 1,
+            "chunking": len(self._chunking),
+            "external_parked": len(self._external),
+            "swapped": len(self._swapped),
+            "tokens_generated": self._tokens_generated,
+        }
 
     def _wake_from_thread(self) -> None:
         loop, wake = self._loop, self._wake
@@ -869,6 +898,9 @@ class JaxEngine:
                 logger.debug("engine loop raised during stop", exc_info=True)
             self._task = None
         self._ex.shutdown(wait=False)
+        profiling.flight_recorder.remove_provider(
+            getattr(self, "_flightrec_key", "engine"), self._flightrec_state
+        )
         if self.offload_engine is not None:
             self.offload_engine.close()
 
@@ -1813,8 +1845,14 @@ class JaxEngine:
         loop = asyncio.get_running_loop()
         assert self._wake is not None
         pending: List[Any] = []  # InflightPrefill | InflightBlock, FIFO
+        prof = self.profiler
         while self._running:
             try:
+                # tick-phase profiling: one record per working iteration,
+                # marks attribute elapsed time to phases (disabled = one
+                # attribute check here and a None check per site)
+                tick = prof.begin_tick() if prof.enabled else None
+                self._tick = tick
                 self._process_cancellations()
                 for work in self._process_deliveries():
                     if work[0] == "blob":
@@ -1843,11 +1881,16 @@ class JaxEngine:
                     await loop.run_in_executor(
                         self._ex, self._apply_swap_in, seq, rec
                     )
+                if tick is not None:
+                    tick.mark("onboard")
                 if (
                     not self.sched.has_runnable_work
                     and not pending
                     and not self._chunking
                 ):
+                    if tick is not None:
+                        tick.discard()
+                        self._tick = tick = None
                     self._wake.clear()
                     if self._external or self._swapped:
                         # bounded wait so parked-lane timeouts still fire
@@ -1859,6 +1902,8 @@ class JaxEngine:
                         await self._wake.wait()
                     continue
                 self._drive_prefetch()
+                if tick is not None:
+                    tick.mark("onboard")
                 plan = self.sched.plan()
                 if self.sched.num_active > 0:
                     # pre-grow pages to cover the in-flight block plus this
@@ -1905,6 +1950,8 @@ class JaxEngine:
                 mixed_ok = self._mixed_tick_ok()
                 if not mixed_ok and self.sched.mix_pending:
                     self._drain_mixed_to_classic()
+                if tick is not None:
+                    tick.mark("plan")
                 # advance chunked prefills: one chunk per seq per tick, so
                 # decode blocks interleave below instead of stalling behind
                 # one long prompt
@@ -1925,6 +1972,8 @@ class JaxEngine:
                     else:
                         still_chunking.append(seq)
                 self._chunking = still_chunking
+                if tick is not None:
+                    tick.mark("dispatch")
                 # batch plain prefills by compiled shape: a burst of N
                 # admissions costs one weight-streaming pass per shape
                 # group instead of N (chunked-prefill candidates go one at
@@ -1985,6 +2034,8 @@ class JaxEngine:
                         self._ex, self._do_prefill_group, items
                     )
                     fresh.extend(pfs)
+                if tick is not None:
+                    tick.mark("dispatch")
                 chunks = (
                     self.sched.form_mixed_chunks(
                         self._mixed_budget, self._chunk_tokens
@@ -1992,6 +2043,8 @@ class JaxEngine:
                     if mixed_ok
                     else []
                 )
+                if tick is not None:
+                    tick.mark("assemble")
                 if chunks:
                     # ONE dispatch serves the whole batch: every decode
                     # lane rides alongside the packed prefill chunks
@@ -2012,6 +2065,8 @@ class JaxEngine:
                         self._ex, self._commit_all, pending
                     )
                     self._dispatch(events)
+                    if tick is not None:
+                        tick.mark("fanout")
                 pending = fresh
                 # speculative verify dispatches AFTER the commit above: a
                 # lane's next draft extends its post-commit history, so
@@ -2028,6 +2083,11 @@ class JaxEngine:
                     )
                     if vb is not None:
                         pending.append(vb)
+                    if tick is not None:
+                        tick.mark("dispatch")
+                if tick is not None:
+                    prof.finish_tick(tick)
+                    self._tick = tick = None
                 if not fresh and not pending:
                     self._handle_stalled_admission()
                     # nothing dispatched and nothing in flight (e.g. waiting
@@ -2039,6 +2099,7 @@ class JaxEngine:
                 raise
             except Exception as e:  # engine must never die silently
                 logger.exception("engine tick failed")
+                self._tick = None
                 pending = []
                 self._pending_injects.clear()
                 self._chunking = []
@@ -2586,6 +2647,8 @@ class JaxEngine:
         seq.prefilled_tokens = start + suffix_len
         self._steps += 1
         self.obs.observe_dispatch("chunk")
+        if self._tick is not None:
+            self._tick.note_dispatch("chunk")
         logger.debug(
             "prefill chunk id=%s %d..%d/%d", seq.request_id, start,
             seq.prefilled_tokens, prompt_len,
@@ -2627,6 +2690,8 @@ class JaxEngine:
             )
         self._steps += 1
         self.obs.observe_dispatch("prefill")
+        if self._tick is not None:
+            self._tick.note_dispatch("prefill")
         if tracing.collector.enabled:
             with tracing.span(
                 "engine.prefill_dispatch", seq.request_id
@@ -2720,6 +2785,8 @@ class JaxEngine:
             entries.append(pf)
         self._steps += 1
         self.obs.observe_dispatch("prefill")
+        if self._tick is not None:
+            self._tick.note_dispatch("prefill")
         _start_host_copy(sampled)
         # ONE group handle: commit fetches the [Bp] array in one transfer
         # instead of one round trip per lane's [1] slice
@@ -3114,6 +3181,9 @@ class JaxEngine:
                 )
         elif not use_penalties:
             d["counts"] = None  # free the 8MB-class buffer when unused
+        tick = self._tick
+        if tick is not None:
+            tick.mark("assemble")
         (
             sampled,
             d["tokens"],
@@ -3145,6 +3215,9 @@ class JaxEngine:
         self._steps += 1
         self.obs.observe_dispatch("decode_block")
         _start_host_copy(sampled)
+        if tick is not None:
+            tick.note_dispatch("decode_block")
+            tick.mark("dispatch")
         return InflightBlock(sampled=sampled, slots=list(self.sched.slots))
 
     @hot_path
@@ -3280,6 +3353,9 @@ class JaxEngine:
                 else:
                     t_dec[o] = True
             disp_tokens = Np
+            tick = self._tick
+            if tick is not None:
+                tick.mark("assemble")
             (
                 packed,
                 d["tokens"],
@@ -3320,6 +3396,9 @@ class JaxEngine:
                     ch.start : ch.start + ch.length
                 ]
             disp_tokens = B * S
+            tick = self._tick
+            if tick is not None:
+                tick.mark("assemble")
             (
                 packed,
                 d["tokens"],
@@ -3388,6 +3467,9 @@ class JaxEngine:
         self.obs.observe_dispatch("unified")
         self.obs.observe_mixed(n_decode, n_pf_tokens)
         _start_host_copy(packed)
+        if tick is not None:
+            tick.note_dispatch("unified")
+            tick.mark("dispatch")
         logger.debug(
             "unified dispatch: %d decode lanes + %d prefill tokens "
             "(%d chunks, %d final) S=%d",
@@ -3430,6 +3512,7 @@ class JaxEngine:
         limits = self._compute_limits()
         lanes: List[Tuple[SeqState, int, List[int]]] = []
         max_d = 0
+        # dynalint: disable=DT012 -- routes into dynamo_spec_draft_seconds
         t_draft0 = time.perf_counter()
         for b, seq in enumerate(sched.slots):
             if seq is None or seq.spec is None or seq.finish is not None:
@@ -3489,6 +3572,7 @@ class JaxEngine:
         use_filters = any(
             self._sampling_needs_filters(s.sampling) for s, _b, _d in lanes
         )
+        # dynalint: disable=DT012 -- routes into dynamo_spec_draft_seconds
         draft_s = time.perf_counter() - t_draft0
         # numpy copy of the page-table mirror for the same aliasing reason
         # as _push_device_state: the scheduler mutates it on later ticks
@@ -3507,6 +3591,8 @@ class JaxEngine:
         )
         self._steps += 1
         self.obs.observe_dispatch("verify")
+        if self._tick is not None:
+            self._tick.note_dispatch("verify")
         self.spec_metrics.draft_latency.observe(max(draft_s, 0.0))
         _start_host_copy(sampled)
         return InflightVerify(sampled=sampled, lanes=lanes)
@@ -3693,6 +3779,7 @@ class JaxEngine:
         ids_dev = jnp.asarray(ids_p)
         padded = pad_page_axis(blob, bucket)
         L = int(blob.shape[0])
+        # dynalint: disable=DT012 -- routes into dynamo_kv_onboard_seconds
         t0 = time.perf_counter()
         for lo, hi in layer_chunk_spans(L, None, DEFAULT_EXPORT_CHUNKS):
             self.kv.pages = self._fns.scatter_layer_pages(
@@ -3702,6 +3789,7 @@ class JaxEngine:
                 jnp.asarray(padded[lo:hi]),
             )
         self.offload_engine.record_onboard(
+            # dynalint: disable=DT012 -- routes into dynamo_kv_onboard_seconds
             "prefix", blob.nbytes, time.perf_counter() - t0
         )
         for seq_hash, pages, _blob, meta in pending:
@@ -3887,6 +3975,7 @@ class JaxEngine:
             ids_dev = jnp.asarray(ids)
             padded = pad_page_axis(blob, bucket)
             L = int(blob.shape[0])
+            # dynalint: disable=DT012 -- routes into dynamo_kv_onboard_seconds
             t0 = time.perf_counter()
             for lo, hi in layer_chunk_spans(L, None, DEFAULT_EXPORT_CHUNKS):
                 self.kv.pages = self._fns.scatter_layer_pages(
@@ -3897,6 +3986,7 @@ class JaxEngine:
                 )
             self.kv.pages.block_until_ready()
             self.offload_engine.record_onboard(
+                # dynalint: disable=DT012 -- routes into dynamo_kv_onboard_seconds
                 "swap", blob.nbytes, time.perf_counter() - t0
             )
         except Exception:
@@ -3919,6 +4009,11 @@ class JaxEngine:
         per handle)."""
         from .sampling import unpack_sampled_logprobs
 
+        tick = self._tick
+        if tick is not None:
+            # close the loop->executor hop under "dispatch" so the
+            # device_wait below measures only the blocked fetch
+            tick.mark("dispatch")
         handles = [e.sampled for e in entries]
         # echo+logprobs scoring rows ride the same bundled transfer
         lp_refs: List[Tuple[Any, int]] = []
@@ -3950,6 +4045,9 @@ class JaxEngine:
             # dynalint: disable=DT004 -- the pipeline's ONE designed sync point:
             # block i's results materialize here while block i+1 computes
             mats = jax.device_get(handles)
+        if tick is not None:
+            tick.mark("device_wait")
+            self.profiler.note_results_ready()
         lp_mats = {id(pf): mats[i] for pf, i in lp_refs}
         events: List[StepEvent] = []
 
@@ -4053,6 +4151,8 @@ class JaxEngine:
 
         # mats are host-resident np arrays (device_get / allgather output):
         # no further np.asarray wrapping, which would read as a sync here
+        # dynalint: disable=DT012 -- the commit clock: one read serves every
+        # entry's dispatch->commit latency observe (dynamo_engine_step_latency)
         now = time.perf_counter()
         for e, mat in zip(entries, mats):
             if isinstance(e, InflightPrefillGroup):
@@ -4119,6 +4219,8 @@ class JaxEngine:
                 self.obs.observe_step("decode_block", now - e.dispatched_at)
         alloc = self.kv.allocator
         self.obs.observe_kv(alloc.used_pages, alloc.num_pages - 1)
+        if tick is not None:
+            tick.mark("commit")
         return events
 
     # -- event/output dispatch (loop thread) --------------------------------
@@ -4134,6 +4236,20 @@ class JaxEngine:
             if ev.tokens:
                 self._tokens_generated += len(ev.tokens)
                 self.obs.tokens.inc(len(ev.tokens))
+                if not ev.seq.slo_noted:
+                    # first token: hand the SLO plane this request's
+                    # queue-wait (arrival -> admission) vs service
+                    # (admission -> first commit) decomposition, the
+                    # attribution a TTFT miss is classified with
+                    ev.seq.slo_noted = True
+                    if slo.tracker.enabled:
+                        now_m = time.monotonic()
+                        adm = ev.seq.admitted_s or now_m
+                        slo.tracker.note_first_token(
+                            ev.seq.request_id,
+                            queue_s=adm - ev.seq.arrival_s,
+                            service_s=now_m - adm,
+                        )
             if ev.completed_blocks and pool is None:
                 self._publish_stored(ev.seq, ev.completed_blocks)
             if queue is None:

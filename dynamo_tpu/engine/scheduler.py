@@ -195,6 +195,11 @@ class SeqState:
     # during queue wait (engine._note_prefetch_admission; span attr +
     # dynamo_kv_prefetch_hits)
     prefetch_hits: int = 0
+    # SLO attainment plane (runtime/slo.py): admission stamp closing the
+    # queue-wait leg, and a once-only latch for the first-token
+    # queue/service decomposition note
+    admitted_s: float = 0.0
+    slo_noted: bool = False
 
     @property
     def seq_len(self) -> int:
@@ -469,6 +474,10 @@ class Scheduler:
         seq.owned_pages = onboard + fresh
         seq.pages = cached_pages + fresh
         seq.slot = slot
+        # SLO queue-wait/service decomposition stamp (runtime/slo.py):
+        # admission ends the queue-wait leg; re-admissions after
+        # preemption re-stamp (the first-token note fires only once)
+        seq.admitted_s = time.monotonic()
         self.slots[slot] = seq
         self._write_slot_arrays(seq)
         self._queue_prompt_registrations(seq)

@@ -16,6 +16,7 @@ import logging
 import time
 from typing import Optional
 
+from ..runtime import slo
 from ..runtime.metrics import MetricsRegistry
 
 _DURATION_BUCKETS = (
@@ -71,8 +72,10 @@ class ServiceMetrics:
             ["endpoint"],
         )
 
-    def guard(self, model: str, endpoint: str) -> "InflightGuard":
-        return InflightGuard(self, model, endpoint)
+    def guard(
+        self, model: str, endpoint: str, request_id: str = ""
+    ) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint, request_id)
 
     def render(self) -> tuple[bytes, str]:
         return self._metrics.render()
@@ -89,12 +92,25 @@ class InflightGuard:
     the consumer's generator torn down by cancel/GeneratorExit -- can no
     longer leak the inflight gauge.  ``finish`` is idempotent: belt-and-
     suspenders call sites cannot double-decrement.
+
+    With the SLO plane armed (``DYN_SLO``), the same stamps feed the
+    attainment tracker: TTFT at the first token, ITL per subsequent
+    token, E2E at finish -- one recording site instead of parallel
+    plumbing (``request_id`` links a TTFT miss to the engine's
+    queue-vs-service decomposition).
     """
 
-    def __init__(self, metrics: ServiceMetrics, model: str, endpoint: str) -> None:
+    def __init__(
+        self,
+        metrics: ServiceMetrics,
+        model: str,
+        endpoint: str,
+        request_id: str = "",
+    ) -> None:
         self.m = metrics
         self.model = model
         self.endpoint = endpoint
+        self.request_id = request_id
         self.start = time.monotonic()
         self._last_token: Optional[float] = None
         self._status: Optional[str] = None
@@ -118,8 +134,12 @@ class InflightGuard:
         now = time.monotonic()
         if self._last_token is None:
             self.m.ttft.labels(self.model).observe(now - self.start)
+            if slo.tracker.enabled:
+                slo.tracker.record_ttft(self.request_id, now - self.start)
         else:
             self.m.itl.labels(self.model).observe(now - self._last_token)
+            if slo.tracker.enabled:
+                slo.tracker.record_itl(now - self._last_token)
         self._last_token = now
 
     def mark_ok(self) -> None:
@@ -133,9 +153,12 @@ class InflightGuard:
             return
         self._finished = True
         self.m.inflight.labels(self.model, self.endpoint).dec()
-        self.m.duration.labels(self.model, self.endpoint).observe(
-            time.monotonic() - self.start
-        )
+        elapsed = time.monotonic() - self.start
+        self.m.duration.labels(self.model, self.endpoint).observe(elapsed)
+        if slo.tracker.enabled and self._status == "success":
+            # errored/deadline requests record their violation at the
+            # classifying site (cause=deadline/shed), not as a plain miss
+            slo.tracker.record_e2e(self.request_id, elapsed)
         self.m.requests_total.labels(
             self.model, self.endpoint, self._status or "error"
         ).inc()

@@ -30,7 +30,7 @@ from ..protocols.openai import (
     sse_error,
 )
 from ..runtime import metrics as rtmetrics
-from ..runtime import tracing
+from ..runtime import profiling, slo, tracing
 from ..runtime.engine import (
     DEADLINE_EXCEEDED_MSG,
     Annotated,
@@ -183,6 +183,14 @@ class HttpService:
         self.server.route("GET", "/live", self._health)
         self.server.route("GET", "/metrics", self._metrics)
         self.server.route_prefix("GET", "/trace/", self._trace)
+        # performance-observability plane (runtime/profiling.py): the tick
+        # ring + live enable, a bounded jax.profiler device capture, and
+        # flight-recorder snapshots for chaos postmortems
+        self.server.route("GET", "/profile/ticks", self._profile_ticks)
+        self.server.route("POST", "/profile/ticks", self._profile_ticks_post)
+        self.server.route("POST", "/profile/device", self._profile_device)
+        self.server.route("GET", "/debug/flightrec", self._flightrec_list)
+        self.server.route_prefix("GET", "/debug/flightrec/", self._flightrec_get)
 
     @property
     def address(self) -> tuple:
@@ -216,6 +224,9 @@ class HttpService:
         # router series) -- two exposition payloads concatenate cleanly as
         # long as family names are disjoint, which the naming scheme
         # guarantees ({prefix}_http_service_* vs dynamo_engine_*/_disagg_*)
+        # age stale SLO windows out of the attainment gauges before the
+        # scrape (a drained instance must not export incident-era values)
+        slo.tracker.refresh_gauges()
         body, content_type = self.metrics.render()
         runtime_body, _ = rtmetrics.render_default()
         return Response(200, {"Content-Type": content_type}, body + runtime_body)
@@ -242,9 +253,79 @@ class HttpService:
             }
         )
 
+    async def _profile_ticks(self, req: Request) -> Response:
+        """GET /profile/ticks: the tick-phase profiler's ring + aggregate
+        summary + a Chrome-trace export merged with this process's request
+        spans (one timeline: tick phases next to the span tree)."""
+        prof = profiling.profiler
+        spans = tracing.collector.dump() if tracing.collector.enabled else []
+        return Response.json(
+            {
+                "enabled": prof.enabled,
+                "summary": prof.summary(),
+                "ticks": [r.to_dict() for r in prof.records()],
+                "chrome_trace": prof.chrome_trace(spans),
+            }
+        )
+
+    async def _profile_ticks_post(self, req: Request) -> Response:
+        """POST /profile/ticks {"enabled": true|false, "clear": bool}:
+        arm/disarm tick profiling on a live server (no restart, no env)."""
+        body = req.json() or {}
+        if not isinstance(body, dict):
+            return Response.json(
+                {"error": {"message": "body must be a JSON object"}}, 400
+            )
+        prof = profiling.profiler
+        if body.get("clear"):
+            prof.clear()
+        if "enabled" in body:
+            if body["enabled"]:
+                prof.enable()
+            else:
+                prof.disable()
+        return Response.json({"enabled": prof.enabled})
+
+    async def _profile_device(self, req: Request) -> Response:
+        """POST /profile/device {"duration_s": 1.0, "log_dir": "..."}: a
+        bounded-duration ``jax.profiler`` device-trace capture.  Degrades
+        gracefully (ok=false + reason) on CPU-only stacks."""
+        body = req.json() or {}
+        if not isinstance(body, dict):
+            return Response.json(
+                {"error": {"message": "body must be a JSON object"}}, 400
+            )
+        try:
+            duration = float(body.get("duration_s", 1.0))
+        except (TypeError, ValueError):
+            return Response.json(
+                {"error": {"message": "duration_s must be a number"}}, 400
+            )
+        result = await profiling.capture_device_trace(
+            duration, body.get("log_dir")
+        )
+        return Response.json(result, 200 if result.get("ok") else 503)
+
+    async def _flightrec_list(self, req: Request) -> Response:
+        return Response.json(
+            {"snapshots": profiling.flight_recorder.list()}
+        )
+
+    async def _flightrec_get(self, req: Request) -> Response:
+        snap_id = req.path[len("/debug/flightrec/"):].strip("/")
+        snap = profiling.flight_recorder.get(snap_id)
+        if snap is None:
+            return Response.json(
+                {"error": {"message": f"no flight-recorder snapshot {snap_id!r}"}},
+                404,
+            )
+        return Response.json(snap)
+
     def _shed(self, endpoint: str) -> Response:
         """Admission-control rejection: 503 + Retry-After, counted."""
         self.metrics.sheds.labels(endpoint).inc()
+        if slo.tracker.enabled:
+            slo.tracker.record_shed()
         resp = Response.json(
             {
                 "error": {
@@ -258,6 +339,32 @@ class HttpService:
             f"{self.admission.retry_after_s:g}"
         )
         return resp
+
+    def _deadline_expired(self, request: Context, rsp=None) -> str:
+        """One deadline-expiry bookkeeping site for every 504 path: SLO
+        violation with cause=deadline, a flight-recorder snapshot, and the
+        snapshot id stamped onto the request span.  Returns the id the
+        error frame/body carries (postmortems start from it)."""
+        # record the violation BEFORE snapshotting: the dump must carry
+        # its own trigger in slo_violations
+        if slo.tracker.enabled:
+            slo.tracker.record_deadline(request.id)
+        fid = profiling.flight_recorder.snapshot(
+            "deadline_expired", request_id=request.id
+        )
+        if rsp is not None:
+            rsp.set(deadline_expired=True, flightrec_id=fid)
+        return fid
+
+    @staticmethod
+    def _deadline_body(fid: str) -> dict:
+        return {
+            "error": {
+                "message": DEADLINE_EXCEEDED_MSG,
+                "type": "timeout_error",
+                "flightrec": fid,
+            }
+        }
 
     def _request_deadline(self, req: Request) -> Optional[float]:
         """Per-request deadline budget in seconds: the
@@ -309,9 +416,9 @@ class HttpService:
             self.admission.release()
             raise
 
-        guard = self.metrics.guard(parsed.model, endpoint)
-        guard.on_finish = self.admission.release
         request = Context.new(parsed)
+        guard = self.metrics.guard(parsed.model, endpoint, request.id)
+        guard.on_finish = self.admission.release
         try:
             with guard, tracing.span(
                 "http.request", request.id, component="http",
@@ -376,8 +483,8 @@ class HttpService:
             self.admission.release()
             raise
 
-        guard = self.metrics.guard(parsed.model, endpoint)
         request = Context.new(parsed)
+        guard = self.metrics.guard(parsed.model, endpoint, request.id)
         # Deadline budget: armed here at the edge, it rides the codec
         # headers hop by hop; the local watchdog kills the request context
         # at expiry so even an engine that never checks terminates.
@@ -413,17 +520,9 @@ class HttpService:
         except DeadlineExceededError as e:
             guard.mark_error()
             guard.finish()
-            rsp.set(deadline_expired=True)
+            fid = self._deadline_expired(request, rsp)
             rsp.__exit__(type(e), e, e.__traceback__)
-            return Response.json(
-                {
-                    "error": {
-                        "message": DEADLINE_EXCEEDED_MSG,
-                        "type": "timeout_error",
-                    }
-                },
-                504,
-            )
+            return Response.json(self._deadline_body(fid), 504)
         except Exception as e:
             logger.exception("engine dispatch failed")
             guard.mark_error()
@@ -490,9 +589,10 @@ class HttpService:
                     # the watchdog killed the request: the stream ended
                     # because the budget ran out, not because it finished
                     guard.mark_error()
-                    if rsp is not None:
-                        rsp.set(deadline_expired=True)
-                    yield sse_error(DEADLINE_EXCEEDED_MSG)
+                    fid = self._deadline_expired(request, rsp)
+                    yield sse_error(
+                        f"{DEADLINE_EXCEEDED_MSG} [flightrec:{fid}]"
+                    )
                     return
                 guard.mark_ok()
                 yield SSE_DONE
@@ -521,17 +621,8 @@ class HttpService:
 
         def timeout_response() -> Response:
             guard.mark_error()
-            if rsp is not None:
-                rsp.set(deadline_expired=True)
-            return Response.json(
-                {
-                    "error": {
-                        "message": DEADLINE_EXCEEDED_MSG,
-                        "type": "timeout_error",
-                    }
-                },
-                504,
-            )
+            fid = self._deadline_expired(request, rsp)
+            return Response.json(self._deadline_body(fid), 504)
 
         try:
             with guard:

@@ -202,10 +202,20 @@ class CircuitBreaker:
         logger.warning(
             "remote-prefill circuit breaker: %s -> %s", self.state, state
         )
+        prev = self.state
         self.state = state
         if self.obs is not None:
             self.obs.breaker_state.set(self._STATE_CODE[state])
             self.obs.breaker_events.labels(state).inc()
+        if state == self.OPEN:
+            # breaker-open is a fleet-health edge: snapshot the flight
+            # recorder so the postmortem has the tick ring + queue state
+            # from the moment the remote path went dark
+            from ..runtime import profiling
+
+            profiling.flight_recorder.snapshot(
+                "breaker_open", previous_state=prev
+            )
 
     def allow(self) -> bool:
         """May a request take the remote path right now?"""

@@ -23,6 +23,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
+from ..runtime import profiling, slo
 from ..runtime.metrics import EngineMetrics
 from ..protocols.common import (
     FinishReason,
@@ -79,6 +80,12 @@ class _MockSeq:
     # prefix-cache stats are counted once per request (first admission);
     # re-admissions after preemption trivially re-hit their own blocks
     stats_counted: bool = False
+    # SLO attainment plane stamps (runtime/slo.py): same queue-wait vs
+    # service decomposition the JaxEngine notes, so SLO-loop tests run
+    # device-free
+    arrival_s: float = field(default_factory=time.monotonic)
+    admitted_s: float = 0.0
+    slo_noted: bool = False
 
     @property
     def max_tokens(self) -> int:
@@ -110,6 +117,10 @@ class MockerEngine:
         # same registry-backed series the JaxEngine exposes, so chip-free
         # stacks (mocker workers behind a frontend) light up /metrics too
         self.obs = EngineMetrics(max_slots=self.cfg.max_batch_size)
+        # tick-phase profiler: the mocker marks the same phases the real
+        # engine does (its simulated decode sleep plays device_wait), so
+        # planner/SLO-loop tests exercise the whole plane chip-free
+        self.profiler = profiling.profiler
 
     def _sink(self, ev: Dict[str, Any]) -> None:
         if self.kv_event_sink is not None:
@@ -129,7 +140,20 @@ class MockerEngine:
             return
         self._running = True
         self._wake = asyncio.Event()
+        self._flightrec_key = profiling.flight_recorder.add_provider(
+            "mocker", self._flightrec_state
+        )
         self._task = asyncio.create_task(self._run(), name="mocker-loop")
+
+    def _flightrec_state(self):
+        return {
+            "waiting": len(self._waiting_list),
+            "active": len(self.running),
+            "slots": self.cfg.max_batch_size,
+            "kv_blocks_active": self.kv.num_active_blocks,
+            "kv_blocks_total": self.kv.max_capacity,
+            "tokens_generated": self._tokens_generated,
+        }
 
     async def stop(self) -> None:
         self._running = False
@@ -144,6 +168,9 @@ class MockerEngine:
             except Exception:
                 logger.debug("mocker loop raised during stop", exc_info=True)
             self._task = None
+        profiling.flight_recorder.remove_provider(
+            getattr(self, "_flightrec_key", "mocker"), self._flightrec_state
+        )
 
     # -- AsyncEngine --------------------------------------------------------
 
@@ -248,13 +275,22 @@ class MockerEngine:
         assert self._wake is not None
         while self._running:
             try:
+                prof = self.profiler
+                tick = prof.begin_tick() if prof.enabled else None
                 self._process_cancellations()
                 if not self._waiting_list and not self.running:
+                    if tick is not None:
+                        tick.discard()
+                        tick = None
                     self._wake.clear()
                     await self._wake.wait()
                     continue
                 self._admit()
-                await self._simulate_tick()
+                if tick is not None:
+                    tick.mark("plan")
+                await self._simulate_tick(tick)
+                if tick is not None:
+                    prof.finish_tick(tick)
                 await asyncio.sleep(0)
             except asyncio.CancelledError:
                 raise
@@ -323,16 +359,24 @@ class MockerEngine:
                 break
             seq.held = hashes + [seq.partial_id]
             seq.cost = cost
+            seq.admitted_s = time.monotonic()
             self.running[seq.request_id] = seq
             budget -= cost.new_tokens
 
-    async def _simulate_tick(self) -> None:
+    async def _simulate_tick(self, tick=None) -> None:
         cfg = self.cfg
         t0 = time.perf_counter()
         self.obs.observe_sched(len(self._waiting_list), len(self.running))
         self.obs.observe_kv(self.kv.num_active_blocks, self.kv.max_capacity)
         # decode time models HBM-bound KV reads over all active tokens
         tick_s = cfg.decode_s_per_step * self.kv.num_active_blocks
+        had_work = bool(self.running)
+        if tick is not None and had_work:
+            # the simulated batch "dispatches" here: phase bookkeeping
+            # mirrors the real engine (generation = commit+fanout on
+            # host, the decode sleep = device_wait)
+            tick.note_dispatch("decode_block")
+            tick.mark("dispatch")
         for rid in list(self.running.keys()):
             seq = self.running.get(rid)
             if seq is None:
@@ -347,8 +391,13 @@ class MockerEngine:
                     )
                 seq.prefilled = True
             self._generate_one(seq)
+        if tick is not None and had_work:
+            tick.mark("commit")
         if tick_s:
             await asyncio.sleep(tick_s / cfg.speedup_ratio)
+        if tick is not None and had_work:
+            tick.mark("device_wait")
+            self.profiler.note_results_ready()
         if self.running:
             self.obs.observe_step(
                 "decode_block", time.perf_counter() - t0
@@ -369,6 +418,16 @@ class MockerEngine:
         seq.num_generated += 1
         self._tokens_generated += 1
         self.obs.tokens.inc()
+        if not seq.slo_noted:
+            seq.slo_noted = True
+            if slo.tracker.enabled:
+                now_m = time.monotonic()
+                adm = seq.admitted_s or now_m
+                slo.tracker.note_first_token(
+                    seq.request_id,
+                    queue_s=adm - seq.arrival_s,
+                    service_s=now_m - adm,
+                )
         out_of_room = False
         if completed is not None:
             # secure the next partial first; only then promote the completed

@@ -64,6 +64,20 @@ def registry_metrics_source(
 
         hits = val("dynamo_engine_prefix_hit_tokens")
         lookups = val("dynamo_engine_prefix_lookup_tokens")
+
+        # colocated tracker: age stale windows out of the gauges first,
+        # so a drained instance stops reporting incident-era attainment
+        from ..runtime import slo as _slo
+
+        _slo.tracker.refresh_gauges()
+
+        def attainment(kind: str) -> float:
+            # live SLO plane (runtime/slo.py): absent series (tracker
+            # disarmed / no samples) reads as fully attained, so
+            # load-only deployments see no phantom SLO pressure
+            got = reg.sample("dynamo_slo_attainment", {"kind": kind})
+            return 1.0 if got is None else got
+
         return {
             worker_id: ForwardPassMetrics(
                 kv_active_blocks=int(val("dynamo_engine_kv_pages_used")),
@@ -77,6 +91,9 @@ def registry_metrics_source(
                     val("dynamo_engine_batch_occupancy")
                 ),
                 request_total_slots=int(val("dynamo_engine_batch_slots")),
+                slo_ttft_attainment=attainment("ttft"),
+                slo_itl_attainment=attainment("itl"),
+                slo_e2e_attainment=attainment("e2e"),
             )
         }
 

@@ -176,6 +176,13 @@ class ForwardPassMetrics:
     host_tier_blocks: int = 0
     disk_tier_blocks: int = 0
     tier_hit_rate: float = 0.0
+    # live SLO attainment (runtime/slo.py, dynamo_slo_attainment{kind}):
+    # rolling-window fraction of requests meeting the DYN_SLO targets.
+    # 1.0 = met / not armed / no samples yet, so load-only consumers see
+    # no spurious pressure when the SLO plane is off
+    slo_ttft_attainment: float = 1.0
+    slo_itl_attainment: float = 1.0
+    slo_e2e_attainment: float = 1.0
 
     def to_dict(self) -> Dict[str, Any]:
         return self.__dict__.copy()

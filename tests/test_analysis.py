@@ -973,6 +973,96 @@ def test_dt011_ignores_other_modules(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DT012: ad-hoc perf_counter timing in engine/ hot paths
+# ---------------------------------------------------------------------------
+
+
+def test_dt012_stopwatch_pair_in_engine(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import time
+
+        def _commit(self, entries):
+            t0 = time.perf_counter()
+            do_work(entries)
+            elapsed = time.perf_counter() - t0
+            print(elapsed)
+
+        def _dispatch(self):
+            t0 = time.perf_counter_ns()
+            return t0
+        """,
+        rules=["DT012"],
+        name="fixture_pkg/engine/engine.py",
+    )
+    assert rule_ids(findings) == ["DT012"] * 3
+
+
+def test_dt012_clean_twin_routes_through_profiler(tmp_path):
+    """Timing through the TickProfiler (marks) or a registry family is
+    the sanctioned shape; stamp *references* (default_factory) are out of
+    scope -- they are consumed by metrics code, not stopwatch pairs."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import time
+        from dataclasses import dataclass, field
+
+        @dataclass
+        class Inflight:
+            dispatched_at: float = field(default_factory=time.perf_counter)
+
+        def _commit(self, entries):
+            tick = self._tick
+            if tick is not None:
+                tick.mark("dispatch")
+            do_work(entries)
+            if tick is not None:
+                tick.mark("commit")
+        """,
+        rules=["DT012"],
+        name="fixture_pkg/engine/engine.py",
+    )
+    assert findings == []
+
+
+def test_dt012_suppression(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import time
+
+        def _commit(self, entries):
+            # dynalint: disable=DT012 -- routes into a registry family
+            now = time.perf_counter()
+            self.obs.observe_step("decode", now - entries[0].dispatched_at)
+        """,
+        rules=["DT012"],
+        name="fixture_pkg/engine/engine.py",
+    )
+    assert findings == []
+
+
+def test_dt012_scoped_to_engine_modules(tmp_path):
+    """perf_counter elsewhere (the profiler itself, the mocker, bench
+    harnesses) is not DT012's business."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import time
+
+        def measure():
+            t0 = time.perf_counter()
+            return time.perf_counter() - t0
+        """,
+        rules=["DT012"],
+        name="fixture_pkg/runtime/profiling.py",
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------
 
@@ -1174,7 +1264,7 @@ def test_cli_module_entrypoint():
 
 
 def test_repo_is_dynalint_clean():
-    """Zero non-baselined DT001-DT010 violations across dynamo_tpu/.
+    """Zero non-baselined DT001-DT012 violations across dynamo_tpu/.
 
     This is the gate the whole subsystem exists for: introducing a
     blocking call on an event loop, a silent except, a host sync in a
@@ -1194,7 +1284,7 @@ def test_repo_is_dynalint_clean():
 
 def test_spec_package_is_dynalint_clean():
     """The speculative-decoding subsystem (dynamo_tpu/spec) must stay
-    zero-finding under every rule DT001-DT010 with NO baseline and NO
+    zero-finding under every rule DT001-DT012 with NO baseline and NO
     suppressions: drafting runs on the engine executor inside the verify
     cadence, so a blocking call, silent except, host sync, or recompile
     hazard there stalls every speculating lane's token stream.  Scoped

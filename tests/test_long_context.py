@@ -556,8 +556,13 @@ def test_bench_long_context_smoke(run):
         assert ratio is None or 0.0 <= ratio <= 1.0
         assert out["lctx_prefetch_hits"] > 0
         assert out["lctx_admit_skips"] >= 0
+        assert out["lctx_slo_ttft_target_ms"] > 0
         for name in ("short", "mid", "long"):
             assert out[f"lctx_ttft_p50_ms_{name}"] > 0
+            # per-bucket SLO attainment stamps (ISSUE 12): a fraction
+            # when the bucket has samples
+            att = out[f"lctx_slo_ttft_attainment_{name}"]
+            assert att is not None and 0.0 <= att <= 1.0
 
     run(body())
 

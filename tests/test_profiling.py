@@ -1,0 +1,702 @@
+"""Tick-phase profiler + SLO attainment plane + flight recorder (ISSUE 12):
+phase accounting vs tick wall, disabled-mode overhead, Chrome-trace merge,
+SLO window math and violation causes, flight-recorder dumps at failure
+edges, the HTTP surface, and the planner read path."""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.mocker.engine import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime import metrics as rtm
+from dynamo_tpu.runtime import profiling, slo, tracing
+from dynamo_tpu.runtime.engine import Context
+
+from tests.test_serving import http_request
+
+
+@pytest.fixture
+def registry():
+    prev = rtm.set_default(rtm.MetricsRegistry())
+    yield rtm.default_registry()
+    rtm.set_default(prev)
+
+
+@pytest.fixture
+def profiler():
+    """The process profiler, armed for the test and restored after."""
+    prof = profiling.profiler
+    was = prof.enabled
+    prof.clear()
+    prof.enable()
+    yield prof
+    prof.clear()
+    if not was:
+        prof.disable()
+
+
+@pytest.fixture
+def slo_tracker():
+    """The process SLO tracker, disarmed on the way out."""
+    slo.tracker.disable()
+    yield slo.tracker
+    slo.tracker.disable()
+
+
+@pytest.fixture
+def flightrec():
+    profiling.flight_recorder.clear()
+    yield profiling.flight_recorder
+    profiling.flight_recorder.clear()
+
+
+def req(tokens, max_tokens=8) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens),
+        sampling_options=SamplingOptions(temperature=0.0),
+    )
+
+
+async def collect(engine, request):
+    stream = await engine.generate(Context.new(request))
+    tokens = []
+    async for item in stream:
+        tokens.extend((item.data or {}).get("token_ids") or [])
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# Tick profiler core
+# ---------------------------------------------------------------------------
+
+
+def test_phase_sum_matches_tick_wall(run, registry, profiler):
+    """Acceptance: per tick, the attributed phases sum to within 10% of
+    the measured tick wall (marks cover the whole iteration; the
+    remainder lands in 'other'), and the serving smoke produces nonzero
+    per-phase histograms plus dispatch-gap samples."""
+
+    async def body():
+        engine = JaxEngine.random_init(
+            ModelConfig.tiny(),
+            EngineConfig(
+                max_batch_size=4, max_seq_len=64, page_size=4,
+                num_pages=64,
+                # several decode blocks per request so ticks alternate
+                # dispatch/commit and the dispatch gap closes samples
+                decode_block_size=4,
+            ),
+        )
+        try:
+            # warm (compiles) then a measured burst with concurrency
+            await collect(engine, req([1, 2, 3], max_tokens=4))
+            profiler.clear()
+            await asyncio.gather(
+                *[
+                    collect(engine, req([1, 2, 3, 4 + i], max_tokens=16))
+                    for i in range(4)
+                ]
+            )
+        finally:
+            await engine.stop()
+        recs = profiler.records()
+        assert recs, "profiling enabled but no tick records"
+        for r in recs:
+            total = sum(r.phases.values())
+            assert total == pytest.approx(r.wall_s, rel=0.10), (
+                r.to_dict()
+            )
+        # the unified mixed path ran: assembly + dispatch + device wait +
+        # commit + plan all nonzero in the histogram
+        for phase in ("plan", "assemble", "dispatch", "device_wait", "commit"):
+            got = registry.sample(
+                "dynamo_tick_phase_seconds", {"phase": phase}
+            )
+            assert got is not None and got > 0.0, phase
+        # dispatch-gap: at least one commit->next-enqueue interval closed
+        assert (
+            registry.sample("dynamo_tick_dispatch_gap_seconds") is not None
+        )
+        # host occupancy gauge live and sane
+        occ = registry.sample("dynamo_tick_host_occupancy")
+        assert occ is not None and 0.0 <= occ <= 1.0
+
+    run(body())
+
+
+def test_disabled_profiler_is_one_attribute_check(run, registry):
+    """With profiling disabled the loop never constructs a tick record:
+    begin_tick is unreachable (the `if prof.enabled` attribute check is
+    the entire disabled-mode cost) and the ring stays empty."""
+
+    async def body():
+        prof = profiling.profiler
+        assert not prof.enabled
+        orig = profiling.TickProfiler.begin_tick
+
+        def boom(self):
+            raise AssertionError("begin_tick called with profiling disabled")
+
+        profiling.TickProfiler.begin_tick = boom
+        try:
+            engine = MockerEngine(MockerConfig(block_size=4))
+            try:
+                out = await collect(engine, req([5, 6, 7], max_tokens=6))
+                assert len(out) >= 1
+            finally:
+                await engine.stop()
+        finally:
+            profiling.TickProfiler.begin_tick = orig
+        assert prof.records() == []
+        assert registry.sample("dynamo_ticks_total") is None
+
+    run(body())
+
+
+def test_mocker_emits_tick_phases(run, registry, profiler):
+    """Satellite: the mocker marks the same phase set, so planner/SLO
+    loop tests exercise the whole plane device-free."""
+
+    async def body():
+        engine = MockerEngine(
+            MockerConfig(block_size=4, decode_s_per_step=0.0002)
+        )
+        try:
+            await asyncio.gather(
+                *[
+                    collect(engine, req([1, 2, 3 + i], max_tokens=6))
+                    for i in range(3)
+                ]
+            )
+        finally:
+            await engine.stop()
+        recs = profiler.records()
+        assert recs
+        phases = set()
+        for r in recs:
+            phases.update(r.phases)
+            assert sum(r.phases.values()) == pytest.approx(
+                r.wall_s, rel=0.10
+            )
+        assert {"plan", "commit", "device_wait"} <= phases
+        assert registry.sample(
+            "dynamo_tick_phase_seconds", {"phase": "device_wait"}
+        )
+
+    run(body())
+
+
+def test_chrome_trace_merges_ticks_with_spans(run, registry, profiler):
+    """The tick ring exports next to the PR-3 span tree: one Chrome-trace
+    JSON with an engine.tick process row alongside span components."""
+
+    async def body():
+        tracing.collector.clear()
+        tracing.collector.enable()
+        try:
+            engine = MockerEngine(MockerConfig(block_size=4))
+            try:
+                with tracing.span("http.request", "rid-1", component="http"):
+                    await collect(engine, req([9, 9, 9], max_tokens=4))
+            finally:
+                await engine.stop()
+            merged = profiler.chrome_trace(tracing.collector.dump())
+        finally:
+            tracing.collector.disable()
+            tracing.collector.clear()
+        events = merged["traceEvents"]
+        comps = {
+            e["args"]["name"] for e in events if e.get("ph") == "M"
+        }
+        assert "engine.tick" in comps and "http" in comps
+        tick_events = [
+            e for e in events
+            if e.get("ph") == "X" and e["name"] == "tick"
+        ]
+        phase_events = [
+            e for e in events
+            if e.get("ph") == "X" and e["name"] in profiling.PHASES
+        ]
+        span_events = [
+            e for e in events
+            if e.get("ph") == "X" and e["name"] == "http.request"
+        ]
+        assert tick_events and phase_events and span_events
+        # phases nest inside their tick's window
+        t0 = tick_events[0]
+        kids = [
+            e for e in phase_events
+            if e["args"]["request_id"] == t0["args"]["request_id"]
+        ]
+        assert kids
+        for k in kids:  # ts/dur are µs, rounded to µs in the span dicts
+            assert k["ts"] >= t0["ts"] - 5.0
+            assert k["ts"] + k["dur"] <= t0["ts"] + t0["dur"] + 5.0
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# SLO attainment plane
+# ---------------------------------------------------------------------------
+
+
+def test_slo_spec_grammar():
+    targets, window = slo.parse_slo_spec("ttft=300ms,itl=40ms,e2e=30s")
+    assert targets == {"ttft": 0.3, "itl": 0.04, "e2e": 30.0}
+    assert window is None
+    targets, window = slo.parse_slo_spec("ttft=1.5s,window=10s")
+    assert targets == {"ttft": 1.5} and window == 10.0
+    assert slo.parse_slo_spec("itl=500us")[0]["itl"] == pytest.approx(5e-4)
+    assert slo.parse_slo_spec("e2e=30")[0]["e2e"] == 30.0  # bare = seconds
+    for bad in ("ttfx=1s", "ttft", "ttft=abcms", "ttft=-1s", "ttft=0s"):
+        with pytest.raises(slo.SloSpecError):
+            slo.parse_slo_spec(bad)
+
+
+def test_slo_attainment_matches_hand_computed_window(registry, slo_tracker):
+    """Acceptance: the rolling-window attainment gauge equals the
+    hand-computed fraction of in-target samples."""
+    slo_tracker.configure("ttft=100ms,itl=10ms,e2e=5s,window=60s")
+    samples = [0.05, 0.08, 0.15, 0.09, 0.30, 0.02, 0.11, 0.04]
+    for i, s in enumerate(samples):
+        slo_tracker.record_ttft(f"r{i}", s)
+    expect = sum(1 for s in samples if s <= 0.1) / len(samples)
+    assert slo_tracker.attainment("ttft") == pytest.approx(expect)
+    assert registry.sample(
+        "dynamo_slo_attainment", {"kind": "ttft"}
+    ) == pytest.approx(expect)
+    # violations: misses with no engine split default to cause=service
+    assert registry.sample(
+        "dynamo_slo_violations", {"kind": "ttft", "cause": "service"}
+    ) == 3.0
+    # itl + e2e windows are independent
+    slo_tracker.record_itl(0.002)
+    slo_tracker.record_itl(0.020)
+    assert slo_tracker.attainment("itl") == pytest.approx(0.5)
+    slo_tracker.record_e2e("r0", 1.0)
+    assert slo_tracker.attainment("e2e") == 1.0
+
+
+def test_slo_window_evicts_old_samples(slo_tracker, registry):
+    slo_tracker.configure("ttft=100ms,window=60s")
+    slo_tracker.record_ttft("old", 0.5)  # miss
+    assert slo_tracker.attainment("ttft") == 0.0
+    # age the miss out of the window, then record a hit
+    q = slo_tracker._windows["ttft"]
+    q[0] = (q[0][0] - 120.0, q[0][1])
+    slo_tracker.record_ttft("new", 0.05)
+    assert slo_tracker.attainment("ttft") == 1.0
+
+
+def test_slo_queue_vs_service_attribution(slo_tracker, registry):
+    """A TTFT miss whose engine decomposition says queue-wait dominated
+    is a *queue* violation (scale out), not a service one."""
+    slo_tracker.configure("ttft=100ms")
+    slo_tracker.note_first_token("rq", queue_s=0.4, service_s=0.05)
+    slo_tracker.record_ttft("rq", 0.45)
+    slo_tracker.note_first_token("rs", queue_s=0.01, service_s=0.3)
+    slo_tracker.record_ttft("rs", 0.31)
+    assert registry.sample(
+        "dynamo_slo_violations", {"kind": "ttft", "cause": "queue"}
+    ) == 1.0
+    assert registry.sample(
+        "dynamo_slo_violations", {"kind": "ttft", "cause": "service"}
+    ) == 1.0
+    causes = {
+        v["request_id"]: v["cause"] for v in slo_tracker.recent_violations()
+    }
+    assert causes == {"rq": "queue", "rs": "service"}
+
+
+def test_engine_notes_first_token_split(run, registry, slo_tracker):
+    """The mocker (and JaxEngine, same site shape) hands the tracker each
+    request's queue/service decomposition at first token."""
+    slo_tracker.configure("ttft=10s")
+
+    async def body():
+        engine = MockerEngine(MockerConfig(block_size=4))
+        try:
+            ctx = Context.new(req([4, 5, 6], max_tokens=4).to_dict())
+            stream = await engine.generate(ctx)
+            async for _item in stream:
+                pass
+            split = slo_tracker.split(ctx.id)
+            assert split is not None
+            queue_s, service_s = split
+            assert queue_s >= 0.0 and service_s >= 0.0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_planner_source_reads_attainment(registry, slo_tracker):
+    """Acceptance: dynamo_slo_attainment gauges are readable through
+    planner.registry_metrics_source -- the planner sees attainment, not
+    just load."""
+    from dynamo_tpu.planner.planner import registry_metrics_source
+
+    # an engine must have published once for the source to report
+    registry.gauge("dynamo_engine_kv_pages_total", "t").set(64)
+    src = registry_metrics_source(registry)
+    # no SLO series yet: attainment defaults to fully-met
+    m = src()[0]
+    assert m.slo_ttft_attainment == 1.0
+    slo_tracker.configure("ttft=100ms")
+    slo_tracker.record_ttft("a", 0.05)
+    slo_tracker.record_ttft("b", 0.50)
+    m = src()[0]
+    assert m.slo_ttft_attainment == pytest.approx(0.5)
+
+
+def test_guard_records_slo(registry, slo_tracker):
+    """The HTTP InflightGuard is the one frontend recording site: TTFT at
+    first token, ITL after, E2E at successful finish."""
+    from dynamo_tpu.http.metrics import ServiceMetrics
+
+    slo_tracker.configure("ttft=10s,itl=10s,e2e=10s")
+    m = ServiceMetrics()
+    g = m.guard("m", "chat_completions", "rid-slo")
+    g.token()
+    g.token()
+    g.mark_ok()
+    g.finish()
+    assert slo_tracker.attainment("ttft") == 1.0
+    assert slo_tracker.attainment("itl") == 1.0
+    assert slo_tracker.attainment("e2e") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_snapshot_contents(registry, profiler, flightrec,
+                                           slo_tracker):
+    slo_tracker.configure("ttft=1ms")
+    slo_tracker.record_ttft("slow-req", 0.5)
+    flightrec.add_provider("unit", lambda: {"queue": 3})
+    try:
+        fid = flightrec.snapshot("unit_test", request_id="slow-req")
+    finally:
+        flightrec.remove_provider("unit")
+    snap = flightrec.get(fid)
+    assert snap is not None and snap["reason"] == "unit_test"
+    assert snap["extra"]["request_id"] == "slow-req"
+    assert snap["state"]["unit"] == {"queue": 3}
+    assert any(
+        v["request_id"] == "slow-req" for v in snap["slo_violations"]
+    )
+    assert flightrec.list()[0]["id"] == fid
+
+
+def test_slo_gauge_refresh_ages_out_stale_attainment(slo_tracker, registry):
+    """After traffic drains, the read paths re-derive the gauge from the
+    (empty) window instead of exporting incident-era values forever."""
+    slo_tracker.configure("ttft=100ms,window=60s")
+    slo_tracker.record_ttft("bad", 0.5)
+    assert registry.sample(
+        "dynamo_slo_attainment", {"kind": "ttft"}
+    ) == 0.0
+    q = slo_tracker._windows["ttft"]
+    q[0] = (q[0][0] - 120.0, q[0][1])  # age the miss out of the window
+    slo_tracker.refresh_gauges()
+    assert registry.sample(
+        "dynamo_slo_attainment", {"kind": "ttft"}
+    ) == 1.0
+
+
+def test_flight_recorder_colocated_providers_both_appear(flightrec):
+    """Two engines in one process (disagg prefill+decode) must both land
+    in snapshots -- add_provider suffixes instead of clobbering."""
+    a = flightrec.add_provider("engine", lambda: {"who": "a"})
+    b = flightrec.add_provider("engine", lambda: {"who": "b"})
+    assert a == "engine" and b == "engine#2"
+    snap = flightrec.get(flightrec.snapshot("colo"))
+    assert snap["state"]["engine"] == {"who": "a"}
+    assert snap["state"]["engine#2"] == {"who": "b"}
+    flightrec.remove_provider(a)
+    flightrec.remove_provider(b)
+
+
+def test_flight_recorder_throttles_per_reason(flightrec):
+    a = flightrec.snapshot("storm")
+    b = flightrec.snapshot("storm")  # inside min_interval: same snapshot
+    assert a == b
+    c = flightrec.snapshot("other_reason")
+    assert c != a
+
+
+def test_worker_crash_produces_flightrec_snapshot(run, registry, profiler,
+                                                  flightrec):
+    """Acceptance/satellite: a chaos run -- engine.crash_after_first_token
+    killing the worker mid-stream -- leaves a retrievable flight-recorder
+    snapshot (reason worker_lost) carrying tick records."""
+    from dynamo_tpu.runtime import faults
+    from dynamo_tpu.runtime.component import FailoverPolicy, PushRouter
+
+    from tests.test_chaos import Cluster, collect as chaos_collect
+
+    faults.injector.disable()
+
+    async def body():
+        cluster = await Cluster().start(n_workers=2)
+        try:
+            faults.injector.configure(
+                "seed=5;engine.crash_after_first_token=1:max=1:match=.generate-"
+            )
+            router = PushRouter(
+                cluster.client,
+                failover=FailoverPolicy(
+                    max_redispatches=2, backoff_base_s=0.01
+                ),
+            )
+            stream = await router.generate(
+                Context.new(req([9, 8, 7], max_tokens=32).to_dict())
+            )
+            tokens, errors = await chaos_collect(stream)
+            assert errors and "lost mid-stream" in errors[0]
+            snaps = flightrec.list()
+            assert any(s["reason"] == "worker_lost" for s in snaps)
+            sid = next(
+                s["id"] for s in snaps if s["reason"] == "worker_lost"
+            )
+            snap = flightrec.get(sid)
+            assert snap["extra"]["stage"] == "mid_stream"
+            # the mocker was serving with profiling on: the dump carries
+            # the tick ring from the moment of loss
+            assert snap["ticks"], "snapshot should carry tick records"
+            assert "mocker" in snap["state"]
+        finally:
+            faults.injector.disable()
+            await cluster.stop()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+
+def _tiny_pipeline(model_dir, mocker_cfg=None):
+    from dynamo_tpu.llm import Backend, OpenAIPreprocessor, Tokenizer
+    from dynamo_tpu.runtime.pipeline import link
+
+    tok = Tokenizer.from_model_dir(model_dir)
+    engine = MockerEngine(mocker_cfg or MockerConfig(block_size=4))
+    return engine, link(OpenAIPreprocessor("m", tok), Backend(tok), engine)
+
+
+def test_profile_ticks_endpoint(run, registry, profiler, model_dir):
+    """GET /profile/ticks serves the ring + summary + merged chrome trace;
+    POST toggles the profiler live."""
+
+    async def body():
+        engine, pipeline = _tiny_pipeline(model_dir)
+        manager = ModelManager()
+        manager.add_chat_model("m", pipeline)
+        svc = HttpService(manager)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _h, _p = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 6,
+                },
+            )
+            assert status == 200
+            status, _h, payload = await http_request(
+                host, port, "GET", "/profile/ticks"
+            )
+            assert status == 200
+            assert payload["enabled"] is True
+            assert payload["summary"]["ticks"] >= 1
+            assert payload["ticks"][0]["phases_ms"]
+            assert payload["chrome_trace"]["traceEvents"]
+            # live toggle
+            status, _h, payload = await http_request(
+                host, port, "POST", "/profile/ticks", {"enabled": False}
+            )
+            assert status == 200 and payload["enabled"] is False
+            status, _h, payload = await http_request(
+                host, port, "POST", "/profile/ticks",
+                {"enabled": True, "clear": True},
+            )
+            assert status == 200 and payload["enabled"] is True
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    run(body())
+
+
+def test_profile_device_endpoint_degrades_gracefully(run, registry,
+                                                     model_dir):
+    """POST /profile/device either captures (jax present) or degrades to a
+    structured failure -- never a 500, never a crash."""
+
+    async def body():
+        engine, pipeline = _tiny_pipeline(model_dir)
+        manager = ModelManager()
+        manager.add_chat_model("m", pipeline)
+        svc = HttpService(manager)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _h, payload = await http_request(
+                host, port, "POST", "/profile/device", {"duration_s": 0.05}
+            )
+            assert status in (200, 503)
+            assert "ok" in payload
+            if payload["ok"]:
+                assert payload["log_dir"]
+            else:
+                assert payload["error"]
+            # bad body shapes are 400, not 500
+            status, _h, _p = await http_request(
+                host, port, "POST", "/profile/device",
+                {"duration_s": "nope"},
+            )
+            assert status == 400
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    run(body())
+
+
+def test_deadline_504_attaches_flightrec_and_slo_cause(run, registry,
+                                                       flightrec,
+                                                       slo_tracker,
+                                                       model_dir):
+    """Satellite: a deadline-expired request returns 504 carrying the
+    flight-recorder snapshot id, the snapshot is retrievable over HTTP,
+    and the SLO plane counts a cause=deadline violation."""
+    slo_tracker.configure("e2e=60s")
+
+    async def body():
+        engine, pipeline = _tiny_pipeline(
+            model_dir, MockerConfig(block_size=4, decode_s_per_step=0.05)
+        )
+        manager = ModelManager()
+        manager.add_chat_model("m", pipeline)
+        svc = HttpService(manager, default_deadline_s=0.3)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _h, payload = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 400,
+                },
+            )
+            assert status == 504, payload
+            fid = payload["error"]["flightrec"]
+            assert fid
+            # the snapshot is retrievable through the debug surface
+            status, _h, snap = await http_request(
+                host, port, "GET", f"/debug/flightrec/{fid}"
+            )
+            assert status == 200
+            assert snap["reason"] == "deadline_expired"
+            status, _h, listing = await http_request(
+                host, port, "GET", "/debug/flightrec"
+            )
+            assert status == 200
+            assert any(s["id"] == fid for s in listing["snapshots"])
+            # SLO: one cause=deadline violation
+            assert registry.sample(
+                "dynamo_slo_violations",
+                {"kind": "e2e", "cause": "deadline"},
+            ) == 1.0
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    run(body())
+
+
+def test_flightrec_unknown_id_is_404(run, registry, model_dir):
+    async def body():
+        engine, pipeline = _tiny_pipeline(model_dir)
+        manager = ModelManager()
+        manager.add_chat_model("m", pipeline)
+        svc = HttpService(manager)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _h, _p = await http_request(
+                host, port, "GET", "/debug/flightrec/fr-nope"
+            )
+            assert status == 404
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_profile_command(run, registry, profiler, model_dir, capsys):
+    """`dynamo-tpu profile URL` prints the phase table from a live
+    frontend (and --json writes the merged chrome trace)."""
+    import json as _json
+
+    from dynamo_tpu.cli import build_parser, run_profile
+
+    async def body(tmp_json):
+        engine, pipeline = _tiny_pipeline(model_dir)
+        manager = ModelManager()
+        manager.add_chat_model("m", pipeline)
+        svc = HttpService(manager)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _h, _p = await http_request(
+                host, port, "POST", "/v1/chat/completions",
+                {
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 6,
+                },
+            )
+            assert status == 200
+            args = build_parser().parse_args(
+                ["profile", f"http://{host}:{port}", "--json", tmp_json]
+            )
+            rc = await run_profile(args)
+            assert rc == 0
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "trace.json")
+        run(body(out))
+        trace = _json.loads(open(out).read())
+        assert trace["traceEvents"]
+    printed = capsys.readouterr().out
+    assert "dispatch gap" in printed
+    assert "device_wait" in printed
