@@ -36,6 +36,8 @@ def build_engine(
     swap_preemption: bool = True,
     mixed_batching: bool = True,
     mixed_token_budget: int = 512,
+    kv_dtype=None,
+    async_dispatch: bool = True,
 ):
     """decode_block is the throughput/latency dial: 64 steps per host round
     trip is +20% decode tok/s on the tunneled bench chip (measured 1491 vs
@@ -70,6 +72,8 @@ def build_engine(
         swap_preemption=swap_preemption,
         mixed_batching=mixed_batching,
         mixed_token_budget=mixed_token_budget,
+        kv_dtype=kv_dtype,
+        async_dispatch=async_dispatch,
         seed=0,
     )
     return JaxEngine.random_init(model_cfg, cfg)
@@ -260,11 +264,69 @@ async def run_serving(engine) -> dict:
                 "host_phase_top3": psum["top_phases"][:3],
                 "host_occupancy": psum["host_occupancy"],
                 "dispatch_gap_p50_ms": psum["gap_p50_ms"],
+                # KV pool footprint next to the serving line (ISSUE 13):
+                # the quantization win must be visible in the trajectory
+                "kv_dtype": str(engine.kv.dtype),
+                "kv_pool_gb": round(engine.kv.pool_bytes / 1e9, 4),
+                "async_dispatch": bool(engine._async_dispatch),
             }
         finally:
             if not prof_was_enabled:
                 prof.disable()
             await svc.stop()
+
+
+async def run_host_pipeline(rs) -> dict:
+    """Host tick-pipeline A/B (ISSUE 13): the identical workload on the
+    mocker with the double-buffered dispatch lanes on vs off.
+
+    The mocker simulates device time (``decode_s_per_step``), so this is
+    the chip-free measurement of exactly what the async pipeline buys:
+    with lanes on, tick N+1's dispatch is enqueued before tick N's host
+    commit/fanout runs and the host-observed dispatch gap collapses to
+    ~zero; with ``async_dispatch=False`` (the ``--no-async-dispatch``
+    fallback) every tick's host work sits in the gap.  The acceptance
+    line is ``pipe_gap_p50_ms_async <= pipe_gap_p50_ms_serial / 2``."""
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.runtime import profiling
+
+    prof = profiling.profiler
+    was_enabled = prof.enabled
+    out = {}
+    try:
+        for name, async_on in (("serial", False), ("async", True)):
+            eng = MockerEngine(
+                MockerConfig(
+                    max_batch_size=16,
+                    decode_s_per_step=2e-5,
+                    async_dispatch=async_on,
+                )
+            )
+            prompts = [
+                rs.randint(1, 30000, (64,)).tolist() for _ in range(16)
+            ]
+            await run_batch(eng, prompts, max_tokens=8)  # warm
+            prof.clear()
+            prof.enable()
+            t0 = time.monotonic()
+            total = await run_batch(eng, prompts, max_tokens=64)
+            elapsed = time.monotonic() - t0
+            psum = prof.summary()
+            prof.disable()
+            await eng.stop()
+            out[f"pipe_gap_p50_ms_{name}"] = psum["gap_p50_ms"]
+            out[f"pipe_tok_s_{name}"] = round(total / elapsed, 2)
+        gs, ga = out.get("pipe_gap_p50_ms_serial"), out.get(
+            "pipe_gap_p50_ms_async"
+        )
+        if gs is not None and ga is not None and gs > 0:
+            out["pipe_gap_reduction"] = round(gs / max(ga, 1e-6), 2)
+    finally:
+        if was_enabled:
+            prof.enable()
+        else:
+            prof.disable()
+    return out
 
 
 async def run_decode_sweep(rs) -> dict:
@@ -1145,6 +1207,8 @@ async def main():
     decode_steps_s = (total / 8) / elapsed  # token rows per lane per second
     hbm_bw = (pbytes + kv_bytes_per_step) * decode_steps_s
     util = hbm_bw / 819e9
+    kv_pool_gb = round(engine.kv.pool_bytes / 1e9, 4)
+    kv_dtype = str(engine.kv.dtype)
     await engine.stop()
     del engine
 
@@ -1163,6 +1227,23 @@ async def main():
     int8_tok_s = q_total / q_elapsed
     await q_engine.stop()
     del q_engine
+
+    # int8-quantized paged KV pool (ISSUE 13): identical A/B methodology.
+    # The pool is the HBM ceiling at large batch (bs64 est_hbm_util 0.28
+    # in r05), so the headline here is the FOOTPRINT pair (kv_pool_gb at
+    # each dtype -- freed bytes = resident batch/context headroom) next
+    # to a decode line proving the fused-dequant path costs ~nothing.
+    kq_engine = build_engine(kv_dtype="int8")
+    kv_pool_gb_int8 = round(kq_engine.kv.pool_bytes / 1e9, 4)
+    kq_prompts = [rs.randint(1, 30000, (128,)).tolist() for _ in range(8)]
+    await run_batch(kq_engine, kq_prompts, max_tokens=8)
+    await run_batch(kq_engine, kq_prompts, max_tokens=8)
+    kq_total, kq_elapsed = await best_of(
+        2, lambda: run_batch(kq_engine, kq_prompts, max_tokens=128)
+    )
+    kv_int8_tok_s = kq_total / kq_elapsed
+    await kq_engine.stop()
+    del kq_engine
 
     # latency-sensitive legs on the K=16 serving config: prefill TTFT and
     # the served SSE path must not wait out a 64-step decode block for
@@ -1209,6 +1290,7 @@ async def main():
     spec = await run_spec(rs)
     pf_load = await run_prefill_under_decode_load(rs)
     long_ctx = await run_long_context(rs)
+    host_pipe = await run_host_pipeline(rs)
     disagg_tok_s, _dev_stats = await run_disagg(rs, allow_local=True)
     disagg_wire_tok_s, wire_stats = await run_disagg(rs, allow_local=False)
 
@@ -1239,6 +1321,12 @@ async def main():
                     "overlap_ratio_p50"
                 ),
                 "decode_tok_s_int8": round(int8_tok_s, 2),
+                # ISSUE 13: the --kv-dtype int8 pool line (bf16 = the
+                # exact default); the pool-footprint pair is the win
+                "decode_tok_s_kv_int8": round(kv_int8_tok_s, 2),
+                "kv_dtype_default": kv_dtype,
+                "kv_pool_gb_default": kv_pool_gb,
+                "kv_pool_gb_int8": kv_pool_gb_int8,
                 "est_hbm_util_v5e": round(util, 4),
                 "param_bytes": pbytes,
                 **sweep,
@@ -1247,6 +1335,7 @@ async def main():
                 **spec,
                 **pf_load,
                 **long_ctx,
+                **host_pipe,
                 **serving,
             }
         )

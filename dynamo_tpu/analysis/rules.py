@@ -1,4 +1,4 @@
-"""dynalint rules DT001-DT012: this repo's real async/JAX hazard classes.
+"""dynalint rules DT001-DT013: this repo's real async/JAX hazard classes.
 
 Each rule is deliberately narrow: it encodes a bug class this codebase has
 actually exhibited (blocking WAL I/O on the hub event loop, silent
@@ -1166,6 +1166,104 @@ class AdHocTimingInEngine(Rule):
 
 
 # ---------------------------------------------------------------------------
+# DT013: blocking work on the tick thread outside the async-commit helpers
+# ---------------------------------------------------------------------------
+
+
+class BlockingOnTickThread(Rule):
+    id = "DT013"
+    name = "blocking-on-tick-thread"
+    severity = "error"
+    description = (
+        "A blocking device fetch (``jax.device_get`` / "
+        "``.block_until_ready()``), a detokenization call, or a stream-"
+        "fanout queue put (``.put_nowait``) in a tick-loop module "
+        "(engine/engine.py, mocker/engine.py) outside the functions named "
+        "in the module-level ``TICK_COMMIT_HELPERS`` tuple.  The async "
+        "dispatch pipeline (ISSUE 13) keeps the tick thread free of "
+        "host-blocking work: device results materialize only inside the "
+        "designated commit helpers (where readiness was already probed or "
+        "the pipeline chose to block), and detok/stream fanout ride the "
+        "bounded off-tick worker.  A stray blocking call anywhere else in "
+        "the tick body silently re-serializes the host between two device "
+        "dispatches -- exactly the regression BENCH_r05 measured.  Move "
+        "the call into a designated helper or route it through the "
+        "fanout/commit planes."
+    )
+
+    _MODULES = ("engine/engine.py", "mocker/engine.py")
+    _SYNC_FNS = {"jax.device_get"}
+    _BLOCKING_ATTRS = {"block_until_ready"}
+    _FANOUT_ATTRS = {"put_nowait"}
+    _DETOK_ATTRS = {"detokenize", "decode_stream"}
+
+    @classmethod
+    def _applies(cls, relpath: str) -> bool:
+        return any(
+            relpath == m or relpath.endswith("/" + m) for m in cls._MODULES
+        )
+
+    @staticmethod
+    def _helpers(module: ModuleInfo) -> Set[str]:
+        """Function names listed in the module-level
+        ``TICK_COMMIT_HELPERS`` tuple (the COPY_HELPERS pattern)."""
+        out: Set[str] = set()
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "TICK_COMMIT_HELPERS":
+                    if isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+                        out.update(
+                            e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        )
+        return out
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if not self._applies(module.relpath):
+            return
+        helpers = self._helpers(module)
+        for fi in collect_functions(module.tree):
+            if fi.name in helpers:
+                continue
+            for node in own_body_walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                attr = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else None
+                )
+                if d in self._SYNC_FNS or attr in self._BLOCKING_ATTRS:
+                    yield self.finding(
+                        module, node,
+                        f"blocking device fetch ({d or attr}) outside the "
+                        "designated TICK_COMMIT_HELPERS serializes the "
+                        "tick thread behind the device",
+                        fi.qualname,
+                    )
+                elif attr in self._FANOUT_ATTRS:
+                    yield self.finding(
+                        module, node,
+                        "stream-fanout put outside the designated "
+                        "TICK_COMMIT_HELPERS: route events through the "
+                        "fanout worker/_dispatch plane",
+                        fi.qualname,
+                    )
+                elif attr in self._DETOK_ATTRS:
+                    yield self.finding(
+                        module, node,
+                        "detokenization on the tick thread: detok belongs "
+                        "to the Backend operator / fanout worker, never "
+                        "between two device dispatches",
+                        fi.qualname,
+                    )
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -1182,6 +1280,7 @@ ALL_RULES: List[Rule] = [
     HotPathManifestDrift(),
     MultichipShardingsDeclared(),
     AdHocTimingInEngine(),
+    BlockingOnTickThread(),
 ]
 
 

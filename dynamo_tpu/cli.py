@@ -51,6 +51,18 @@ def _add_engine_flags(p) -> None:
     p.add_argument("--quantize", choices=["int8"], default=None,
                    help="weight-only quantization (int8 + per-channel "
                         "scales; ~half the HBM stream per decode step)")
+    p.add_argument("--kv-dtype", default=None, metavar="DTYPE",
+                   help="paged KV pool dtype: 'int8' = quantized per-row "
+                        "layout (~half the pool's HBM, dequant fused into "
+                        "the ragged kernels), default = model dtype (env "
+                        "DYN_KV_DTYPE overrides)")
+    p.add_argument("--no-async-dispatch", dest="async_dispatch",
+                   action="store_false", default=True,
+                   help="disable the double-buffered host tick pipeline "
+                        "(async commit + off-tick stream fanout); the "
+                        "tick loop reverts to the exact serial "
+                        "dispatch-then-commit order (env "
+                        "DYN_ASYNC_DISPATCH overrides)")
     p.add_argument("--prefill-chunk-tokens", type=int, default=None,
                    help="chunked prefill: split long prompts into chunks "
                         "of this many tokens, interleaved with decode")
@@ -415,6 +427,8 @@ async def _make_engine(args):
         packed_ragged=args.packed_ragged,
         kv_admit_budget=args.kv_admit_budget,
         quantize=args.quantize,
+        kv_dtype=args.kv_dtype,
+        async_dispatch=args.async_dispatch,
     )
     if args.mixed_token_budget is not None:
         cfg.mixed_token_budget = args.mixed_token_budget

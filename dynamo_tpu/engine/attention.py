@@ -24,8 +24,55 @@ import jax
 import jax.numpy as jnp
 
 from ..analysis.hotpath import hot_path
+from .kv_cache import (
+    QuantKV,
+    gather_layer_kv,
+    index_kv_layer,
+    kv_data,
+    kv_is_quantized,
+    quantize_kv_rows,
+)
 
 _NEG_INF = -1e30
+
+
+# -- int8 pool plumbing (kv_cache.QuantKV) ----------------------------------
+#
+# Every reader/writer below takes the pool as one opaque value: a dense
+# array for bf16/f32 pools, a QuantKV (int8 data + per-row scales) pytree
+# for quantized ones.  Reads gather data+scales and dequantize after the
+# page gather (kv_cache.gather_layer_kv -- XLA fuses the convert+scale
+# into the consuming einsum); writes route through the shared
+# quantize_kv_rows rule below and scatter both arrays.  The branch
+# resolves at trace time (pytree structure is static), so each compiled
+# executable embeds exactly one layout.
+
+
+def _kv_write(kv_pages, kv_idx, layer, ids, k_rows, *, slot=None):
+    """Scatter one side's rows into the pool at (layer, ids[, slot]):
+    quantizes on write for int8 pools.  ``ids`` (page ids) and ``slot``
+    (within-page row) are pre-flattened index arrays; ``k_rows`` is
+    ``[..., Hkv, D]`` aligned with them."""
+    if isinstance(kv_pages, QuantKV):
+        q, s = quantize_kv_rows(k_rows)
+        if slot is None:
+            new_q = kv_pages.q.at[layer, kv_idx, ids].set(q)
+            new_s = kv_pages.s.at[layer, kv_idx, ids].set(
+                s.astype(kv_pages.s.dtype)
+            )
+        else:
+            new_q = kv_pages.q.at[layer, kv_idx, ids, slot].set(q)
+            new_s = kv_pages.s.at[layer, kv_idx, ids, slot].set(
+                s.astype(kv_pages.s.dtype)
+            )
+        return QuantKV(q=new_q, s=new_s)
+    if slot is None:
+        return kv_pages.at[layer, kv_idx, ids].set(
+            k_rows.astype(kv_pages.dtype)
+        )
+    return kv_pages.at[layer, kv_idx, ids, slot].set(
+        k_rows.astype(kv_pages.dtype)
+    )
 
 
 def _env_flag(name: str):
@@ -69,8 +116,19 @@ def decode_attention_dispatch(
 ) -> jax.Array:
     """Decode attention: Pallas page-streaming kernel on TPU, XLA gather
     elsewhere.  Resolved at trace time (static), so each compiled executable
-    embeds exactly one backend."""
-    if _pallas_decode_enabled(kv_pages.shape[3]):
+    embeds exactly one backend.  Quantized pools take the XLA gather on
+    this CLASSIC path only (penalized/multimodal fallback lanes) -- the
+    serving hot path under ``--kv-dtype int8`` is the unified ragged
+    dispatch, whose Pallas kernels fuse the dequant."""
+    if (
+        not kv_is_quantized(kv_pages)
+        # the classic Pallas kernels compute directly on the pool tiles:
+        # a dense pool dtype that differs from the query/compute dtype
+        # (explicit --kv-dtype float32 under a bf16 model) takes the XLA
+        # gather, whose dequant/cast normalizes operands
+        and kv_pages.dtype == q.dtype
+        and _pallas_decode_enabled(kv_pages.shape[3])
+    ):
         from ..ops.paged_attention import paged_decode_attention_v2
 
         # group-of-8 fetches: grid-step overhead dominates per-page v1 at
@@ -79,7 +137,7 @@ def decode_attention_dispatch(
         return paged_decode_attention_v2(
             q, kv_pages, page_table, kv_lens, layer, window, group=8
         )
-    layer_kv = jax.lax.dynamic_index_in_dim(kv_pages, layer, 0, keepdims=False)
+    layer_kv = index_kv_layer(kv_pages, layer)
     return paged_decode_attention(q, layer_kv, page_table, kv_lens, window)
 
 
@@ -118,15 +176,19 @@ def ragged_attention_dispatch(
     is the ONE attention call of ``step.unified_step``: a decode lane is a
     1-row query, a chunked-prefill lane its chunk's rows, all causal at
     token granularity against the resident prefix plus the dispatch's own
-    fresh columns."""
+    fresh columns.  Quantized pools pass their row scales as extra kernel
+    operands; the dequant fuses into the page-group stream (VMEM multiply
+    per fetched group, never a full-width pool materialization)."""
     Hq, D = q.shape[2], q.shape[3]
     Hkv = k.shape[2]
-    if _pallas_ragged_enabled(kv_pages.shape[3], Hq, Hkv, D):
+    data = kv_data(kv_pages)
+    scales = kv_pages.s if kv_is_quantized(kv_pages) else None
+    if _pallas_ragged_enabled(data.shape[3], Hq, Hkv, D):
         from ..ops.ragged_attention import ragged_paged_attention
 
         return ragged_paged_attention(
-            q, k, v, kv_pages, page_table, base, q_lens, layer, window,
-            group=4,
+            q, k, v, data, page_table, base, q_lens, layer, window,
+            group=4, kv_scales=scales,
         )
     from ..ops.ragged_attention import ragged_paged_attention_xla
 
@@ -157,15 +219,18 @@ def packed_ragged_attention_dispatch(
     elsewhere -- resolved at trace time like every other dispatch gate,
     and gated by the same ``DYN_PALLAS_RAGGED`` knob as the rectangle
     kernel (the two are the same algorithm over different operand
-    layouts)."""
+    layouts).  Quantized pools fuse the row-scale dequant exactly like
+    the rectangle dispatch above."""
     Hq, D = q.shape[1], q.shape[2]
     Hkv = k.shape[1]
-    if _pallas_ragged_enabled(kv_pages.shape[3], Hq, Hkv, D):
+    data = kv_data(kv_pages)
+    scales = kv_pages.s if kv_is_quantized(kv_pages) else None
+    if _pallas_ragged_enabled(data.shape[3], Hq, Hkv, D):
         from ..ops.ragged_attention import packed_ragged_attention
 
         return packed_ragged_attention(
-            q, k, v, kv_pages, page_table, base, seg_off, q_lens, s_max,
-            layer, window, group=4,
+            q, k, v, data, page_table, base, seg_off, q_lens, s_max,
+            layer, window, group=4, kv_scales=scales,
         )
     from ..ops.ragged_attention import packed_ragged_attention_xla
 
@@ -251,18 +316,20 @@ def prefill_prefix_attention_dispatch(
     routing, where most admissions restart on a cached prefix."""
     B, T, Hq, D = q.shape
     Hkv = k.shape[2]
-    page_size = kv_pages.shape[3]
+    page_size = kv_data(kv_pages).shape[3]
     Kp = prefix_table.shape[1] * page_size
     if _pallas_prefix_prefill_enabled(T, Kp, Hq, Hkv, D):
         import math
 
         from ..ops.flash_prefill import flash_prefix_prefill_attention
 
-        layer_kv = jax.lax.dynamic_index_in_dim(
-            kv_pages, layer, 0, keepdims=False
+        layer_kv = index_kv_layer(kv_pages, layer)
+        kp = gather_layer_kv(layer_kv, 0, prefix_table, q.dtype).reshape(
+            B, Kp, Hkv, D
         )
-        kp = layer_kv[0][prefix_table].reshape(B, Kp, Hkv, D)
-        vp = layer_kv[1][prefix_table].reshape(B, Kp, Hkv, D)
+        vp = gather_layer_kv(layer_kv, 1, prefix_table, q.dtype).reshape(
+            B, Kp, Hkv, D
+        )
         # pad the prefix span to a key-tile multiple (BK = gcd(T, 256),
         # mirroring the kernel's tile choice): a tiny cached prefix must
         # not collapse the whole key axis to its width, and non-pow2 top
@@ -343,12 +410,12 @@ def paged_decode_attention(
     masks the tail (and, with ``window``, the head beyond the window).
     """
     B, Hq, D = q.shape
-    _, _, page_size, Hkv, _ = kv_pages.shape
+    _, _, page_size, Hkv, _ = kv_data(kv_pages).shape
     P = page_table.shape[1]
     n_rep = Hq // Hkv
 
-    k = kv_pages[0][page_table]  # [B, P, page, Hkv, D]
-    v = kv_pages[1][page_table]
+    k = gather_layer_kv(kv_pages, 0, page_table, q.dtype)  # [B, P, page, Hkv, D]
+    v = gather_layer_kv(kv_pages, 1, page_table, q.dtype)
     k = k.reshape(B, P * page_size, Hkv, D)
     v = v.reshape(B, P * page_size, Hkv, D)
     k = repeat_kv(k, n_rep)
@@ -385,14 +452,18 @@ def prefill_prefix_attention(
     pad slots point at trash page 0 and are masked by ``kpos < offset``.
     """
     B, T, Hq, D = q.shape
-    page_size = kv_pages.shape[3]
+    page_size = kv_data(kv_pages).shape[3]
     Pp = prefix_table.shape[1]
     Hkv = k.shape[2]
     n_rep = Hq // Hkv
 
-    layer_kv = jax.lax.dynamic_index_in_dim(kv_pages, layer, 0, keepdims=False)
-    kp = layer_kv[0][prefix_table].reshape(B, Pp * page_size, Hkv, D)
-    vp = layer_kv[1][prefix_table].reshape(B, Pp * page_size, Hkv, D)
+    layer_kv = index_kv_layer(kv_pages, layer)
+    kp = gather_layer_kv(layer_kv, 0, prefix_table, q.dtype).reshape(
+        B, Pp * page_size, Hkv, D
+    )
+    vp = gather_layer_kv(layer_kv, 1, prefix_table, q.dtype).reshape(
+        B, Pp * page_size, Hkv, D
+    )
     keys = repeat_kv(jnp.concatenate([kp, k], axis=1), n_rep)
     vals = repeat_kv(jnp.concatenate([vp, v], axis=1), n_rep)
 
@@ -440,15 +511,16 @@ def write_prefill_kv(
 ) -> jax.Array:
     """Scatter a full prompt's K/V into its pages (in place -- kv_pages is
     the scan carry).  T must be a multiple of page_size (prompts are
-    bucket-padded); pad lanes land on trash page 0."""
+    bucket-padded); pad lanes land on trash page 0.  Quantized pools
+    quantize on write (per-row scales scatter alongside)."""
     B, T, Hkv, D = k.shape
-    page_size = kv_pages.shape[3]
+    page_size = kv_data(kv_pages).shape[3]
     n_pages = T // page_size
     ids = page_table[:, :n_pages].reshape(-1)  # [B*n_pages]
     kp = k.reshape(B * n_pages, page_size, Hkv, D)
     vp = v.reshape(B * n_pages, page_size, Hkv, D)
-    kv_pages = kv_pages.at[layer, 0, ids].set(kp)
-    kv_pages = kv_pages.at[layer, 1, ids].set(vp)
+    kv_pages = _kv_write(kv_pages, 0, layer, ids, kp)
+    kv_pages = _kv_write(kv_pages, 1, layer, ids, vp)
     return kv_pages
 
 
@@ -472,7 +544,7 @@ def write_spec_kv(
     window is ``seq_lens``-bounded), and the next verify/decode step
     overwrites them in sequence order before the length passes them."""
     B, S, Hkv, D = k.shape
-    page_size = kv_pages.shape[3]
+    page_size = kv_data(kv_pages).shape[3]
     P = page_table.shape[1]
     positions = base[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # [B, S]
     valid = jnp.arange(S)[None, :] < n_tokens[:, None]  # [B, S]
@@ -482,11 +554,13 @@ def write_spec_kv(
     ids = jnp.where(valid & (page_idx < P), ids, 0)
     flat_ids = ids.reshape(B * S)
     flat_slot = slot.reshape(B * S)
-    kv_pages = kv_pages.at[layer, 0, flat_ids, flat_slot].set(
-        k.reshape(B * S, Hkv, D)
+    kv_pages = _kv_write(
+        kv_pages, 0, layer, flat_ids, k.reshape(B * S, Hkv, D),
+        slot=flat_slot,
     )
-    kv_pages = kv_pages.at[layer, 1, flat_ids, flat_slot].set(
-        v.reshape(B * S, Hkv, D)
+    kv_pages = _kv_write(
+        kv_pages, 1, layer, flat_ids, v.reshape(B * S, Hkv, D),
+        slot=flat_slot,
     )
     return kv_pages
 
@@ -508,7 +582,7 @@ def write_packed_kv(
     invalid rows (packed-axis padding, device-dead decode lanes) and
     positions past the lane's allocation route to trash page 0."""
     Np = k.shape[0]
-    page_size = kv_pages.shape[3]
+    page_size = kv_data(kv_pages).shape[3]
     B, P = page_table.shape
     lane_c = jnp.clip(lane.astype(jnp.int32), 0, B - 1)
     page_idx = pos // page_size
@@ -516,8 +590,8 @@ def write_packed_kv(
     slot = jnp.where(ok, pos % page_size, 0)
     ids = page_table[lane_c, jnp.clip(page_idx, 0, P - 1)]
     ids = jnp.where(ok, ids, 0)
-    kv_pages = kv_pages.at[layer, 0, ids, slot].set(k)
-    kv_pages = kv_pages.at[layer, 1, ids, slot].set(v)
+    kv_pages = _kv_write(kv_pages, 0, layer, ids, k, slot=slot)
+    kv_pages = _kv_write(kv_pages, 1, layer, ids, v, slot=slot)
     return kv_pages
 
 
@@ -530,7 +604,7 @@ def write_decode_kv(
     positions: jax.Array,  # [B] position the token lands at
     layer: jax.Array,  # scalar i32
 ) -> jax.Array:
-    page_size = kv_pages.shape[3]
+    page_size = kv_data(kv_pages).shape[3]
     P = page_table.shape[1]
     page_idx = positions // page_size
     slot = positions % page_size
@@ -541,6 +615,6 @@ def write_decode_kv(
     # 0, not clamp into its own last live page -- its stale write repeats
     # every step while other lanes decode
     ids = jnp.where(page_idx < P, ids, 0)
-    kv_pages = kv_pages.at[layer, 0, ids, slot].set(k)
-    kv_pages = kv_pages.at[layer, 1, ids, slot].set(v)
+    kv_pages = _kv_write(kv_pages, 0, layer, ids, k, slot=slot)
+    kv_pages = _kv_write(kv_pages, 1, layer, ids, v, slot=slot)
     return kv_pages

@@ -17,7 +17,8 @@ scheduler both import it.
 
 from __future__ import annotations
 
-from typing import List
+import collections
+from typing import List, Optional, Tuple
 
 
 def pow2_bucket(n: int, floor: int = 1) -> int:
@@ -57,3 +58,77 @@ def pick_page_bucket(n_pages: int, max_pages: int) -> int:
     if n_pages > max_pages:
         raise ValueError(f"{n_pages} prefix pages exceed max {max_pages}")
     return min(pow2_bucket(n_pages), max_pages)
+
+
+class PackedShapeBudget:
+    """Bound the packed unified step's ``(Np, s_max)`` executable set.
+
+    The packed layout compiles one executable per (packed-axis length,
+    per-lane window) pair.  Both axes already bucket to powers of two, but
+    real traffic mixes decode-only ticks, short chunks, and long-context
+    chunks, so the cross product can still mint O(log budget x log chunk)
+    pairs -- each a fresh multi-second XLA compile landing mid-serving.
+    This budget caps the ACTIVE pair set: a dispatch whose natural pair is
+    already minted (or was merged before) reuses it; a new pair mints
+    freely under ``budget``; past the budget, the dispatch is merged up
+    into the smallest already-minted pair that dominates it (``s_max' >=
+    s_max`` and ``Np'`` covering the recomputed packed extent) -- more
+    padding, identical math, zero new executables.  Only when nothing
+    dominates does a mint evict the least-recently-used pair.
+
+    Correctness contract (the kernel's slice rule): a returned pair
+    always satisfies ``off_last + s_max <= Np`` and ``total <= Np``,
+    where ``off_last`` is the last live lane's segment offset -- padding
+    rows carry lane id B and are inert.
+    """
+
+    def __init__(self, budget: int = 16) -> None:
+        self.budget = max(int(budget), 1)
+        # (Np, s_max) -> hits, LRU order (oldest first)
+        self._pairs: "collections.OrderedDict[Tuple[int, int], int]" = (
+            collections.OrderedDict()
+        )
+        self.merges = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._pairs)
+
+    @property
+    def pairs(self) -> List[Tuple[int, int]]:
+        return list(self._pairs)
+
+    @staticmethod
+    def _np_for(s_max: int, off_last: int, total: int) -> int:
+        return pow2_bucket(max(total, off_last + s_max, 1))
+
+    def fit(
+        self, s_max: int, off_last: int, total: int
+    ) -> Tuple[int, int]:
+        """Resolve a dispatch's natural ``(s_max, off_last, total)`` to a
+        budgeted ``(Np, s_max)`` pair (see class docstring)."""
+        nat = (self._np_for(s_max, off_last, total), s_max)
+        if nat in self._pairs:
+            self._pairs[nat] += 1
+            self._pairs.move_to_end(nat)
+            return nat
+        if len(self._pairs) < self.budget:
+            self._pairs[nat] = 1
+            return nat
+        # merge up: smallest minted pair that dominates the dispatch
+        best: Optional[Tuple[int, int]] = None
+        for np_m, s_m in self._pairs:
+            if s_m < s_max or np_m < self._np_for(s_m, off_last, total):
+                continue
+            if best is None or (np_m, s_m) < best:
+                best = (np_m, s_m)
+        if best is not None:
+            self.merges += 1
+            self._pairs[best] += 1
+            self._pairs.move_to_end(best)
+            return best
+        # nothing dominates (e.g. a new widest shape): evict the LRU pair
+        self._pairs.popitem(last=False)
+        self.evictions += 1
+        self._pairs[nat] = 1
+        return nat

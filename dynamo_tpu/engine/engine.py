@@ -38,7 +38,14 @@ from ..protocols.common import (
 from ..block_manager import PagePool
 from ..tokens.sequence import TokenBlock
 from .config import ModelConfig
-from .kv_cache import PagedKVCache
+from .kv_cache import (
+    PagedKVCache,
+    QuantKV,
+    as_device_blob,
+    blob_to_host,
+    coerce_kv_blob,
+    kv_blob_concat,
+)
 from .metrics import EngineMetrics
 from .model import Params, init_params
 from .sampling import SamplingParams
@@ -74,13 +81,38 @@ from .step import (
 
 logger = logging.getLogger("dynamo.engine")
 
+# The designated blocking/fanout sites of the tick-loop module (dynalint
+# DT013): blocking device fetches, detok, and stream-fanout queue puts may
+# appear ONLY inside these functions.  _commit_all is the pipeline's one
+# designed sync point (readiness probed or depth-forced); _apply_swap_in's
+# barrier is a deliberate executor-thread wait; the export helpers run in
+# the prefill-worker role on the engine executor, never inside a serving
+# tick; _dispatch/_fail_seq are the designated fanout emitters (invoked
+# from the off-tick worker in async mode, inline in the serial fallback).
+TICK_COMMIT_HELPERS = (
+    "_commit_all",
+    "_apply_swap_in",
+    "_dispatch",
+    "_fail_seq",
+    "_put_error",
+    "_prefill_export",
+    "_export_group",
+    "_export_group_stream",
+    "materialize",
+)
+
 
 def _start_host_copy(arr) -> None:
     """Kick off the async device->host DMA for ``arr`` so the later
     device_get is a wait, not a transfer.  Purely an optimization: backends
     without ``copy_to_host_async`` (CPU jax, some mocks) fall back to the
     blocking fetch at commit, logged once so a silently-degraded pipeline
-    is still visible in production."""
+    is still visible in production.  Pytree values (quantized KV pairs)
+    start one copy per leaf."""
+    if isinstance(arr, QuantKV):
+        _start_host_copy(arr.q)
+        _start_host_copy(arr.s)
+        return
     try:
         arr.copy_to_host_async()
     except Exception:
@@ -90,6 +122,30 @@ def _start_host_copy(arr) -> None:
             "blocking device_get", level=logging.DEBUG, interval_s=60.0,
             exc_info=True,
         )
+
+
+def _handles_ready(arr) -> bool:
+    """Non-blocking readiness probe for a dispatched handle: True when the
+    device result (and its async host copy) has landed, so the commit's
+    device_get is a copy, not a wait.  Backends without ``is_ready``
+    (mocks) report ready -- the commit then simply blocks as it always
+    did.  THE readiness primitive of the async-commit pipeline."""
+    if isinstance(arr, QuantKV):
+        return _handles_ready(arr.q) and _handles_ready(arr.s)
+    probe = getattr(arr, "is_ready", None)
+    if probe is None:
+        return True
+    try:
+        return bool(probe())
+    # a failed probe means "treat as ready": the commit simply blocks as
+    # the serial loop always did -- degraded pacing, never wrong results
+    except Exception:
+        log_throttled(
+            logger, "is_ready-probe",
+            "is_ready probe failed; commits fall back to blocking",
+            level=logging.DEBUG, interval_s=60.0, exc_info=True,
+        )
+        return True
 
 
 def _enable_compilation_cache() -> None:
@@ -215,6 +271,21 @@ class EngineConfig:
     # the convert into the matmul read) -- ~half the HBM stream per decode
     # step (engine/quant.py).  None = bf16/f32 as loaded.
     quantize: Optional[str] = None
+    # paged KV pool dtype (ISSUE 13): "int8" switches the pool to the
+    # quantized per-row layout (kv_cache.QuantKV -- ~half the pool's HBM,
+    # so the freed bytes become resident batch/context), dequant fused
+    # into the ragged kernels and quantize applied on every write.  bf16
+    # (the model dtype) stays the exact default; DYN_KV_DTYPE env wins at
+    # engine construction (the serving-env-knob contract).  None = model
+    # dtype.
+    kv_dtype: Optional[str] = None
+    # host tick pipelining (ISSUE 13): the tick loop runs double-buffered
+    # -- tick N+1 plans, assembles, and enqueues while tick N's dispatch
+    # executes on device, and commits consume results only when their
+    # async host copies have landed (or the pipeline is full).  Token
+    # streams are identical to the serial loop; ``--no-async-dispatch``
+    # (DYN_ASYNC_DISPATCH=0) is the exact serial fallback.
+    async_dispatch: bool = True
 
 
 @dataclass
@@ -301,10 +372,15 @@ class _GroupSpanExport:
     def _materialize(self, idx: int) -> np.ndarray:
         # per-shard assembly: a tp-sharded pool's span comes to host one
         # kv-head slice per chip and reassembles here (the wire format is
-        # always full-width); unsharded spans take the plain device_get
+        # always full-width); unsharded spans take the plain device_get.
+        # Quantized spans assemble (data, scales) together.
         from ..parallel.sharding import assemble_shards
 
-        arr = assemble_shards(self._devs[idx])
+        dev = self._devs[idx]
+        if isinstance(dev, QuantKV):
+            arr = QuantKV(q=assemble_shards(dev.q), s=assemble_shards(dev.s))
+        else:
+            arr = assemble_shards(dev)
         self._host[idx] = arr
         self._devs[idx] = None  # release the device copy
         return arr
@@ -354,11 +430,23 @@ class KVExportStream:
             dtype=str(blob.dtype),
             row=np.asarray(row),
             spans=[(0, blob.shape[0])],
-            _blob=np.asarray(blob),
+            _blob=blob_to_host(blob),
         )
 
     @property
+    def quantized(self) -> bool:
+        return jnp.dtype(self.dtype) == jnp.int8
+
+    @property
     def nbytes(self) -> int:
+        """Wire bytes of the full blob.  Quantized exports count the f32
+        row scales packed after each layer's int8 data (the
+        kv_cache.pack_quant_blob_bytes layout), so byte framing on both
+        ends derives identical extents from (shape, dtype)."""
+        if self.quantized:
+            from .kv_cache import quant_blob_nbytes
+
+            return quant_blob_nbytes(self.shape)
         return int(
             np.prod(self.shape) * jnp.dtype(self.dtype).itemsize
         )
@@ -394,8 +482,10 @@ class KVExportStream:
         """Materialize the full blob (same-process handoff / tests)."""
         parts = [part async for _, _, _, part in self.chunks()]
         if len(parts) == 1:
+            if isinstance(parts[0], QuantKV):
+                return blob_to_host(parts[0])
             return np.ascontiguousarray(parts[0])
-        return np.concatenate(parts, axis=0)
+        return kv_blob_concat(parts, axis=0)
 
 
 @dataclass
@@ -524,11 +614,25 @@ class JaxEngine:
                     "multiple of page_size %d",
                     block_size, self.cfg.page_size,
                 )
+        # KV pool dtype: config arms it, DYN_KV_DTYPE wins outright (the
+        # serving-env-knob contract: malformed env warns and keeps config,
+        # a malformed EXPLICIT config fails engine construction loudly)
+        import os as _os0
+
+        from .kv_cache import parse_kv_dtype
+
+        kv_dtype = parse_kv_dtype(self.cfg.kv_dtype)
+        env_kvd = _os0.environ.get("DYN_KV_DTYPE")
+        if env_kvd is not None and env_kvd.strip():
+            try:
+                kv_dtype = parse_kv_dtype(env_kvd)
+            except ValueError:
+                logger.warning("ignoring malformed DYN_KV_DTYPE=%r", env_kvd)
         self.kv = PagedKVCache(
             model_cfg,
             num_pages=self.cfg.num_pages,
             page_size=self.cfg.page_size,
-            dtype=self.cfg.dtype,
+            dtype=kv_dtype if kv_dtype is not None else self.cfg.dtype,
             sharding=kv_sharding,
             allocator=pool,
         )
@@ -672,6 +776,22 @@ class JaxEngine:
         self.mixed_used_tokens = 0
         self.mixed_dispatched_tokens = 0
         self.mixed_rect_tokens = 0
+        # packed-shape compaction (ISSUE 13 satellite): LRU/merge budget
+        # over the packed step's (Np, s_max) executable pairs;
+        # DYN_PACKED_SHAPE_BUDGET retunes without a restart flag
+        from .bucketing import PackedShapeBudget
+
+        shape_budget = 16
+        env_shapes = _os.environ.get("DYN_PACKED_SHAPE_BUDGET")
+        if env_shapes:
+            try:
+                shape_budget = int(env_shapes)
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed DYN_PACKED_SHAPE_BUDGET=%r",
+                    env_shapes,
+                )
+        self._packed_shapes = PackedShapeBudget(shape_budget)
         # queue-side prefetch: window resolved here, walks issued by the
         # tick loop from queue position (_drive_prefetch), finished or
         # cancelled per request
@@ -687,6 +807,26 @@ class JaxEngine:
                 except ValueError:
                     logger.warning("ignoring malformed DYN_KV_PREFETCH=%r", v)
         self._prefetch_issued: set = set()
+        # async dispatch pipelining (ISSUE 13): the tick loop carries up
+        # to ``_pipe_depth`` uncommitted dispatch generations -- tick N+1
+        # plans/assembles/enqueues while tick N executes on device, and
+        # commits consume results only when their async host copies have
+        # landed (or the pipeline hits its depth: the one blocking
+        # backpressure point).  DYN_ASYNC_DISPATCH=0 / --no-async-dispatch
+        # pins the exact serial loop.
+        self._async_dispatch = bool(self.cfg.async_dispatch)
+        env_async = _os.environ.get("DYN_ASYNC_DISPATCH")
+        if env_async is not None and env_async.strip():
+            self._async_dispatch = env_async.strip().lower() not in (
+                "0", "off", "false", "no"
+            )
+        self._pipe_depth = 2 if self._async_dispatch else 1
+        # detok/stream fanout worker (async mode): commits hand their
+        # events to a bounded queue consumed off the tick coroutine --
+        # a slow SSE consumer backpressures the tick at the queue bound
+        # instead of stretching every tick's fanout phase
+        self._fanout_q: Optional[asyncio.Queue] = None
+        self._fanout_task: Optional[asyncio.Task] = None
         self.buckets = prefill_buckets(self.cfg.page_size, self.cfg.max_seq_len)
         self._rng = jax.random.PRNGKey(self.cfg.seed)
         self._queues: Dict[str, asyncio.Queue] = {}
@@ -857,6 +997,20 @@ class JaxEngine:
         self._flightrec_key = profiling.flight_recorder.add_provider(
             "engine", self._flightrec_state
         )
+        if self._async_dispatch:
+            # bounded fanout lane: tick commits enqueue event batches,
+            # the worker does the per-request queue puts off the tick
+            # coroutine.  The bound is the tick's backpressure point.
+            import os as _os
+
+            try:
+                depth = int(_os.environ.get("DYN_FANOUT_QUEUE", "64"))
+            except ValueError:
+                depth = 64
+            self._fanout_q = asyncio.Queue(maxsize=max(depth, 1))
+            self._fanout_task = asyncio.create_task(
+                self._fanout_worker(), name="jax-engine-fanout"
+            )
         self._task = asyncio.create_task(self._run(), name="jax-engine-loop")
 
     def _flightrec_state(self) -> Dict[str, Any]:
@@ -897,6 +1051,35 @@ class JaxEngine:
             except Exception:
                 logger.debug("engine loop raised during stop", exc_info=True)
             self._task = None
+        # drain the fanout lane AFTER the tick loop stops producing:
+        # every committed event batch reaches its stream before teardown
+        # (ordering per request is the queue's FIFO), then the worker
+        # exits on the sentinel
+        if self._fanout_task is not None:
+            assert self._fanout_q is not None
+            await self._fanout_q.put(None)
+            try:
+                await asyncio.wait_for(self._fanout_task, timeout=5.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._fanout_task.cancel()
+            except Exception:
+                logger.debug("fanout worker raised during stop", exc_info=True)
+            # anything a concurrent coroutine enqueued BEHIND the sentinel
+            # (a fail_external racing shutdown) still delivers: a stream
+            # that never sees its error/terminator hangs its consumer
+            while not self._fanout_q.empty():
+                item = self._fanout_q.get_nowait()
+                if item is None:
+                    continue
+                try:
+                    if isinstance(item, tuple) and item[0] == "error":
+                        self._put_error(item[1], item[2])
+                    else:
+                        self._dispatch(item)
+                except Exception:
+                    logger.debug("late fanout drain failed", exc_info=True)
+            self._fanout_task = None
+            self._fanout_q = None
         self._ex.shutdown(wait=False)
         profiling.flight_recorder.remove_provider(
             getattr(self, "_flightrec_key", "engine"), self._flightrec_state
@@ -1210,10 +1393,24 @@ class JaxEngine:
         reassembled for sharded pools (parallel.sharding.assemble_shards),
         plain device_get otherwise.  Every export path routes through here
         so the wire/offload blob format stays full-width regardless of the
-        serving mesh."""
+        serving mesh.  Quantized slices assemble data and scales together
+        (scales are replicated -- a plain device_get)."""
         from ..parallel.sharding import assemble_shards
 
+        if isinstance(arr, QuantKV):
+            return QuantKV(
+                q=assemble_shards(arr.q), s=assemble_shards(arr.s)
+            )
         return assemble_shards(arr)
+
+    def _coerce_blob(self, blob):
+        """Bring a delivered/onboarded blob into this pool's dtype domain
+        (kv_cache.coerce_kv_blob): same-domain blobs pass through
+        untouched -- the byte-exact round trip -- while cross-geometry
+        deliveries (a bf16 prefiller feeding an int8 decode pool, or an
+        old full-width tier blob restoring into a quantized pool) convert
+        through the shared quantization rule."""
+        return coerce_kv_blob(blob, self.kv.quantized, self.kv.dtype)
 
     def _expected_blob_shape(self, seq: SeqState) -> Tuple[int, ...]:
         kp = self.kv.pages.shape  # [L, 2, num_pages, page, Hkv, D]
@@ -1374,12 +1571,14 @@ class JaxEngine:
         _n_pages, bucket, ids = self._lane_scatter_ids(seq)
         ids_dev = jnp.asarray(ids)
         for lo, hi, arr in parts:
-            padded = pad_page_axis(np.asarray(arr), bucket)
+            padded = pad_page_axis(
+                self._coerce_blob(blob_to_host(arr)), bucket
+            )
             self.kv.pages = self._fns.scatter_layer_pages(
                 self.kv.pages,
                 jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
                 ids_dev,
-                jnp.asarray(padded),
+                as_device_blob(padded),
             )
 
     def _apply_external_kv(
@@ -1400,9 +1599,9 @@ class JaxEngine:
         from .kv_cache import pad_page_axis
 
         _n_pages, bucket, ids = self._lane_scatter_ids(seq)
-        padded = pad_page_axis(blob, bucket)
+        padded = pad_page_axis(self._coerce_blob(blob), bucket)
         self.kv.pages = self._fns.scatter_block_pages(
-            self.kv.pages, jnp.asarray(ids), jnp.asarray(padded)
+            self.kv.pages, jnp.asarray(ids), as_device_blob(padded)
         )
         return self._apply_external_commit(seq, first_token, lp_row)
 
@@ -1506,7 +1705,7 @@ class JaxEngine:
                 blobs = jax.device_get([results[i][0] for i in idx])
             out: List[Any] = list(results)
             for i, blob in zip(idx, blobs):
-                out[i] = (np.asarray(blob), results[i][1])
+                out[i] = (blob_to_host(blob), results[i][1])
             return out
 
         return await asyncio.to_thread(materialize)
@@ -1775,6 +1974,7 @@ class JaxEngine:
                                     "block_hash": blk.block_hash,
                                     "parent_sequence_hash": blk.parent_sequence_hash,
                                     "position": blk.position,
+                                    "kv_dtype": str(self.kv.dtype),
                                 },
                             )
                         )
@@ -1826,6 +2026,72 @@ class JaxEngine:
     # -- the tick loop ------------------------------------------------------
 
     @hot_path
+    def _entries_ready(self, entries: List[Any]) -> bool:
+        """Non-blocking probe: have this generation's device results (and
+        their async host copies) landed?  True means the commit's
+        device_get is a copy, not a wait -- the async pipeline commits
+        such generations immediately instead of carrying them."""
+        for e in entries:
+            if not _handles_ready(e.sampled):
+                return False
+            pfs = (
+                e.entries
+                if isinstance(e, InflightPrefillGroup)
+                else e.finals
+                if isinstance(e, InflightUnified)
+                else [e] if isinstance(e, InflightPrefill) else []
+            )
+            for pf in pfs:
+                if pf.prompt_lp is not None and not _handles_ready(
+                    pf.prompt_lp
+                ):
+                    return False
+        return True
+
+    async def _emit_events(self, events: List[StepEvent]) -> None:
+        """Hand a commit's events to the stream-fanout plane: the bounded
+        worker queue in async mode (per-request ordering = the queue's
+        FIFO; a full queue backpressures the tick), the direct in-tick
+        fanout in serial mode (the exact legacy path)."""
+        if not events:
+            return
+        q = self._fanout_q
+        if q is not None:
+            await q.put(events)
+        else:
+            self._dispatch(events)
+
+    async def _fanout_worker(self) -> None:
+        """Async-mode stream fanout: one FIFO consumer does the
+        per-request queue puts (and the SLO/metrics notes inside
+        ``_dispatch``) off the tick coroutine, so commit-to-client fanout
+        cost never sits between two device dispatches.  Exits on the
+        ``None`` sentinel ``stop()`` enqueues after the tick loop halts
+        -- everything enqueued before the sentinel still delivers
+        (drain-on-stop)."""
+        assert self._fanout_q is not None
+        while True:
+            events = await self._fanout_q.get()
+            if events is None:
+                return
+            # dynalint: disable=DT012 -- routes into the tick-phase
+            # histogram (off-loop fanout contribution, the detok pattern)
+            t0 = time.perf_counter()
+            try:
+                if isinstance(events, tuple) and events[0] == "error":
+                    # a _fail_seq error frame riding the same FIFO as the
+                    # token events it must not overtake
+                    self._put_error(events[1], events[2])
+                else:
+                    self._dispatch(events)
+            except Exception:  # fanout must never kill the worker
+                logger.exception("stream fanout failed")
+            if self.profiler.enabled:
+                self.profiler.observe_phase(
+                    # dynalint: disable=DT012 -- same histogram route
+                    "fanout", time.perf_counter() - t0
+                )
+
     async def _run(self) -> None:
         """The tick loop, software-pipelined over the device queue.
 
@@ -1841,10 +2107,30 @@ class JaxEngine:
         any later-dispatched prefill reuses its freed pages, and the
         later-dispatched row scatter deactivates the lane for subsequent
         blocks.
+
+        With ``async_dispatch`` (the default), the loop is additionally
+        DOUBLE-BUFFERED on the host side (ISSUE 13): up to
+        ``_pipe_depth`` dispatch generations stay uncommitted, commits
+        fire only when a generation's results have actually landed (or
+        the pipeline is full -- the one blocking backpressure point), and
+        stream fanout rides the bounded worker queue.  The host's plan/
+        assemble/commit work therefore overlaps device compute instead of
+        sitting serially between dispatches.  Scheduler state the next
+        plan reads is the same speculative one-generation-behind view the
+        one-deep pipeline always used -- commit's slot-snapshot guards
+        and the stop-rule replay reconcile it, and a cancellation/
+        preemption/stop landing between enqueue(N+1) and commit(N) rolls
+        the stale generation's lanes back exactly like a stale decode
+        block (the InflightVerify discipline).
         """
+        import collections
+
         loop = asyncio.get_running_loop()
         assert self._wake is not None
-        pending: List[Any] = []  # InflightPrefill | InflightBlock, FIFO
+        # FIFO of dispatched-but-uncommitted generations, oldest first;
+        # each generation is one tick's entry list (the legacy ``pending``
+        # is the depth-1 special case)
+        inflight: "collections.deque[List[Any]]" = collections.deque()
         prof = self.profiler
         while self._running:
             try:
@@ -1885,9 +2171,15 @@ class JaxEngine:
                     tick.mark("onboard")
                 if (
                     not self.sched.has_runnable_work
-                    and not pending
+                    and not inflight
                     and not self._chunking
+                    and not self.sched.mix_pending
                 ):
+                    # NOTE mix_pending: with the async pipeline a
+                    # fully-committed tick can reach this gate while a
+                    # mixed-mode chunked prefill still owes chunks (the
+                    # serial loop always carried that tick's dispatch in
+                    # ``pending``, masking the case)
                     if tick is not None:
                         tick.discard()
                         self._tick = tick = None
@@ -1904,6 +2196,26 @@ class JaxEngine:
                 self._drive_prefetch()
                 if tick is not None:
                     tick.mark("onboard")
+                # async mode: commit generations whose results ALREADY
+                # landed before planning -- freed slots/pages and committed
+                # stops reach this tick's plan instead of next tick's, and
+                # preemption sees the same committed state the serial loop
+                # would (swap eligibility must not shrink just because the
+                # pipeline was on).  Non-blocking by construction: only
+                # ready generations commit here.
+                while (
+                    self._pipe_depth > 1
+                    and inflight
+                    and self._entries_ready(inflight[0])
+                ):
+                    entries = inflight.popleft()
+                    events = await loop.run_in_executor(
+                        self._ex, self._commit_all, entries,
+                        self._pipe_depth > 1 and bool(inflight),
+                    )
+                    await self._emit_events(events)
+                    if tick is not None:
+                        tick.mark("fanout")
                 plan = self.sched.plan()
                 if self.sched.num_active > 0:
                     # pre-grow pages to cover the in-flight block plus this
@@ -1913,7 +2225,12 @@ class JaxEngine:
                     # span (spec-free serving keeps its exact old watermark
                     # -- the floor must not raise preemption pressure for
                     # workloads that never speculate)
-                    lookahead = 2 * self.cfg.decode_block_size + 1
+                    # depth-scaled: every uncommitted generation may hold
+                    # a full block's writes, plus this tick's block
+                    lookahead = (
+                        (self._pipe_depth + 1) * self.cfg.decode_block_size
+                        + 1
+                    )
                     if any(
                         s is not None and s.spec is not None
                         for s in self.sched.slots
@@ -1921,7 +2238,9 @@ class JaxEngine:
                         from ..spec import MAX_DRAFT_TOKENS
 
                         lookahead = max(
-                            lookahead, 2 * (MAX_DRAFT_TOKENS + 1) + 1
+                            lookahead,
+                            (self._pipe_depth + 1) * (MAX_DRAFT_TOKENS + 1)
+                            + 1,
                         )
                     preempted = self.sched.ensure_decode_capacity(
                         lookahead=lookahead,
@@ -2055,20 +2374,45 @@ class JaxEngine:
                         fresh.append(ub)
                 elif (
                     self.sched.num_decode_runnable > 0
-                    and self._has_steppable_lane(pending)
+                    and self._has_steppable_lane(
+                        [e for gen in inflight for e in gen]
+                    )
                 ):
                     blk = await loop.run_in_executor(self._ex, self._dispatch_block)
                     if blk is not None:
                         fresh.append(blk)
-                if pending:
-                    events = await loop.run_in_executor(
-                        self._ex, self._commit_all, pending
+                if fresh:
+                    inflight.append(fresh)
+                # commit policy: the oldest generation commits when the
+                # pipeline is past its depth (the ONE blocking
+                # backpressure point -- its device_wait is the pacing
+                # sync), when nothing new dispatched (drain: keep making
+                # progress toward idle), or -- async mode -- when its
+                # results have already landed (a non-blocking commit).
+                # Serial mode (--no-async-dispatch) skips the readiness
+                # probe, reproducing the legacy
+                # dispatch-then-commit-previous loop exactly.
+                allowed = self._pipe_depth if fresh else 0
+                while inflight and (
+                    len(inflight) > allowed
+                    or (
+                        self._pipe_depth > 1
+                        and self._entries_ready(inflight[0])
                     )
-                    self._dispatch(events)
+                ):
+                    entries = inflight.popleft()
+                    # pipeline_busy only in ASYNC mode: the serial loop
+                    # must keep the legacy ready->next-enqueue gap series
+                    # (the --no-async-dispatch A/B baseline) even though
+                    # the fresh generation is technically already queued
+                    events = await loop.run_in_executor(
+                        self._ex, self._commit_all, entries,
+                        self._pipe_depth > 1 and bool(inflight),
+                    )
+                    await self._emit_events(events)
                     if tick is not None:
                         tick.mark("fanout")
-                pending = fresh
-                # speculative verify dispatches AFTER the commit above: a
+                # speculative verify dispatches AFTER the commit phase: a
                 # lane's next draft extends its post-commit history, so
                 # each spec lane runs one draft->verify->commit cycle per
                 # tick (the dispatch still overlaps this tick's in-flight
@@ -2082,13 +2426,16 @@ class JaxEngine:
                         self._ex, self._dispatch_verify
                     )
                     if vb is not None:
-                        pending.append(vb)
+                        if inflight:
+                            inflight[-1].append(vb)
+                        else:
+                            inflight.append([vb])
                     if tick is not None:
                         tick.mark("dispatch")
                 if tick is not None:
                     prof.finish_tick(tick)
                     self._tick = tick = None
-                if not fresh and not pending:
+                if not fresh and not inflight:
                     self._handle_stalled_admission()
                     # nothing dispatched and nothing in flight (e.g. waiting
                     # on slots held by parked lanes): don't spin the loop hot
@@ -2100,7 +2447,7 @@ class JaxEngine:
             except Exception as e:  # engine must never die silently
                 logger.exception("engine tick failed")
                 self._tick = None
-                pending = []
+                inflight.clear()
                 self._pending_injects.clear()
                 self._chunking = []
                 self.sched.mix_pending = []
@@ -2219,7 +2566,29 @@ class JaxEngine:
         self._cancel_prefetch(seq.request_id)
         if self._swapped.pop(seq.request_id, None) is not None:
             self.offload_engine.drop_swap(seq.request_id)
-        queue = self._queues.get(seq.request_id)
+        if self._queues.get(seq.request_id) is None:
+            return
+        # async mode: the error + stream terminator ride the fanout queue
+        # so they cannot overtake committed token events still waiting in
+        # it (per-request ordering = the queue's FIFO).  A full queue
+        # degrades to the inline put -- losing relative order beats losing
+        # the error entirely.
+        q = self._fanout_q
+        if q is not None and self._running:
+            # not during shutdown: a frame enqueued behind stop()'s None
+            # sentinel would be dropped by the exiting worker (stop()
+            # drains leftovers too, but the inline put is deterministic)
+            try:
+                q.put_nowait(("error", seq.request_id, message))
+                return
+            except asyncio.QueueFull:
+                pass
+        self._put_error(seq.request_id, message)
+
+    def _put_error(self, request_id: str, message: str) -> None:
+        """Designated error-frame emitter (TICK_COMMIT_HELPERS): the
+        stream may have been torn down since the failure was enqueued."""
+        queue = self._queues.get(request_id)
         if queue is not None:
             queue.put_nowait(Annotated.from_error(message))
             queue.put_nowait(None)
@@ -3322,18 +3691,23 @@ class JaxEngine:
             # s_max window fits (the Pallas kernel's slice rule).
             q_host = np.where(dec_cap, 1, p_lens).astype(np.int32)
             total = int(q_host.sum())
-            s_max = pow2_bucket(int(q_host.max()) if total else 1)
+            s_nat = pow2_bucket(int(q_host.max()) if total else 1)
             seg_off = np.zeros((B,), np.int32)
             off = 0
-            max_end = 1
+            off_last = 0
             for b in range(B):
                 ql = int(q_host[b])
                 if ql == 0:
                     continue
                 seg_off[b] = off
-                max_end = max(max_end, off + s_max)
+                off_last = off
                 off += ql
-            Np = pow2_bucket(max(total, max_end, 1))
+            # (Np, s_max) through the executable-shape budget: reuse or
+            # merge up into an already-minted pair instead of compiling a
+            # fresh executable for every arrival pattern (ISSUE 13
+            # satellite; the budget keeps off_last + s_max <= Np)
+            Np, s_max = self._packed_shapes.fit(s_nat, off_last, total)
+            self.obs.observe_executable_shapes(len(self._packed_shapes))
             t_tokens = np.zeros((Np,), np.int32)
             t_lane = np.full((Np,), B, np.int32)
             t_rel = np.zeros((Np,), np.int32)
@@ -3663,6 +4037,7 @@ class JaxEngine:
                 parent_sequence_hash=blk.parent_sequence_hash,
                 position=blk.position,
                 shards=self.kv.shard_geometry,
+                kv_dtype=str(self.kv.dtype),
             )
             self.offload_engine.submit_evict(blk.sequence_hash, snap, meta)
         except Exception:
@@ -3770,8 +4145,9 @@ class JaxEngine:
         ids = np.concatenate(
             [np.asarray(pages, np.int32) for _h, pages, _b, _m in pending]
         )
-        blob = np.concatenate(
-            [np.asarray(b) for _h, _p, b, _m in pending], axis=2
+        blob = kv_blob_concat(
+            [self._coerce_blob(blob_to_host(b)) for _h, _p, b, _m in pending],
+            axis=2,
         )
         bucket = pick_page_bucket(len(ids), self.sched.max_pages)
         ids_p = np.zeros((bucket,), np.int32)  # pad -> trash page 0
@@ -3786,7 +4162,7 @@ class JaxEngine:
                 self.kv.pages,
                 jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
                 ids_dev,
-                jnp.asarray(padded[lo:hi]),
+                as_device_blob(padded[lo:hi]),
             )
         self.offload_engine.record_onboard(
             # dynalint: disable=DT012 -- routes into dynamo_kv_onboard_seconds
@@ -3973,6 +4349,11 @@ class JaxEngine:
             ids = np.zeros((bucket,), np.int32)
             ids[:n_pages] = seq.pages[:n_pages]
             ids_dev = jnp.asarray(ids)
+            # device-side fast-path snapshots are already in the pool's
+            # domain; host blobs coerce (an old-dtype spill restores via
+            # the shared conversion rule instead of corrupting the pool)
+            if blob is not dev:
+                blob = self._coerce_blob(blob)
             padded = pad_page_axis(blob, bucket)
             L = int(blob.shape[0])
             # dynalint: disable=DT012 -- routes into dynamo_kv_onboard_seconds
@@ -3982,7 +4363,7 @@ class JaxEngine:
                     self.kv.pages,
                     jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
                     ids_dev,
-                    jnp.asarray(padded[lo:hi]),
+                    as_device_blob(padded[lo:hi]),
                 )
             self.kv.pages.block_until_ready()
             self.offload_engine.record_onboard(
@@ -4003,10 +4384,15 @@ class JaxEngine:
         sched.dirty_slots.add(seq.slot)
 
     @hot_path
-    def _commit_all(self, entries: List[Any]) -> List[StepEvent]:
+    def _commit_all(
+        self, entries: List[Any], pipeline_busy: bool = False
+    ) -> List[StepEvent]:
         """Materialize and commit pending prefills/blocks/verifies in
         dispatch order (one bundled device_get instead of one round trip
-        per handle)."""
+        per handle).  ``pipeline_busy`` notes that OTHER dispatch
+        generations are still queued on device behind this one -- the
+        dispatch-gap accounting then records a zero gap (the device was
+        never idle) instead of arming the ready->enqueue stopwatch."""
         from .sampling import unpack_sampled_logprobs
 
         tick = self._tick
@@ -4047,7 +4433,13 @@ class JaxEngine:
             mats = jax.device_get(handles)
         if tick is not None:
             tick.mark("device_wait")
-            self.profiler.note_results_ready()
+            if pipeline_busy:
+                # another generation is already queued on device: results
+                # landing here imply zero device idle -- record the gap
+                # as such instead of timing ready->next-enqueue
+                tick.note_zero_gap()
+            else:
+                self.profiler.note_results_ready()
         lp_mats = {id(pf): mats[i] for pf, i in lp_refs}
         events: List[StepEvent] = []
 

@@ -19,13 +19,284 @@ pool.
 from __future__ import annotations
 
 import threading
-from typing import Any, List, Optional
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..block_manager import OutOfPages
 from .config import ModelConfig
+
+
+# ---------------------------------------------------------------------------
+# int8-quantized pool layout (ISSUE 13)
+#
+# The paged KV pool is the HBM ceiling at large batch (BENCH_r05: bs64
+# est_hbm_util 0.28 with the chip otherwise idle), so halving its bytes is
+# resident batch/context we currently cannot hold.  ``DYN_KV_DTYPE=int8`` /
+# ``--kv-dtype int8`` switches the pool to symmetric per-row int8: the data
+# array keeps the exact ``[L, 2, P, page, Hkv, D]`` geometry at one byte per
+# element, and every (layer, k/v, page, slot) token row carries one f32
+# scale in a parallel ``[L, 2, P, page]`` array.  Row granularity -- not
+# per-page -- because writes are incremental appends (decode adds one row
+# per page per step): a page-wide scale would need a read-rescale-write of
+# the whole page whenever a new row raised the amax, while a row's scale is
+# final the moment the row is written.  The scale array is
+# ``4 / (Hkv * D)`` of the data -- noise next to the 2x data win.
+#
+# Dequantization happens at the point of use (the ragged Pallas kernels
+# stream int8 pages and multiply by the prefetched row scales in VMEM; the
+# XLA references dequantize after the page gather), and every KV-egress
+# path (disagg export, offload tiers, swap snapshots, prefix onboard)
+# moves the (data, scales) pair together so same-dtype round trips are
+# byte-exact in the quantized domain.
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantKV:
+    """An int8 KV payload + its per-row scales.
+
+    Used both for the live device pool (``PagedKVCache.pages`` when
+    ``kv_dtype=int8``) and for every blob sliced out of it (offload tier
+    blocks, swap snapshots, disagg exports, chunked delivery parts) -- the
+    scales always travel WITH the bytes they decode.  A registered pytree,
+    so it rides ``lax.scan`` (the layer-stack carry), jit donation, and
+    tree_map-based sharding harvests unchanged.
+
+    Mirrors enough of the ndarray surface (``shape``/``dtype``/``ndim``/
+    ``nbytes`` of the data, leading-axis ``__getitem__``) that geometry
+    code -- shape validation, layer-span slicing, page-axis arithmetic --
+    treats it like the bf16 array it replaces.  ``q`` is int8
+    ``[L, 2, n, page, Hkv, D]``; ``s`` is f32 ``[L, 2, n, page]``.
+    """
+
+    q: Any  # int8 data, full pool/blob geometry
+    s: Any  # f32 per-row scales, data geometry minus (Hkv, D)
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.nbytes) + int(self.s.nbytes)
+
+    def __getitem__(self, key):
+        """Apply a leading-axes key to data AND scales.
+
+        Valid keys index at most the shared ``[L, 2, pages, page]`` axes
+        (layer-span slices, page-id gathers) -- exactly what the egress
+        and geometry code does.  Keys reaching into (Hkv, D) would
+        desynchronize the pair and raise."""
+        klen = len(key) if isinstance(key, tuple) else 1
+        if klen > self.s.ndim:
+            raise IndexError(
+                f"QuantKV key {key!r} reaches past the shared scale axes"
+            )
+        return QuantKV(q=self.q[key], s=self.s[key])
+
+    def block_until_ready(self) -> "QuantKV":
+        self.q.block_until_ready()
+        self.s.block_until_ready()
+        return self
+
+    def copy(self) -> "QuantKV":
+        """Host-side deep copy (tier ring get/demote semantics)."""
+        return QuantKV(q=np.array(self.q), s=np.array(self.s))
+
+    def astype_like(self, compute_dtype) -> Any:
+        """Dequantized dense array (tests / cross-dtype delivery)."""
+        return dequantize_kv_blob(self, compute_dtype)
+
+
+def kv_data(kv_pages):
+    """The dense data array of either pool form (shape/dtype queries,
+    Pallas operand plumbing)."""
+    return kv_pages.q if isinstance(kv_pages, QuantKV) else kv_pages
+
+
+def kv_is_quantized(kv_pages) -> bool:
+    return isinstance(kv_pages, QuantKV)
+
+
+def index_kv_layer(kv_pages, layer):
+    """``dynamic_index_in_dim(pool, layer, 0)`` for either pool form."""
+    if isinstance(kv_pages, QuantKV):
+        return QuantKV(
+            q=jax.lax.dynamic_index_in_dim(
+                kv_pages.q, layer, 0, keepdims=False
+            ),
+            s=jax.lax.dynamic_index_in_dim(
+                kv_pages.s, layer, 0, keepdims=False
+            ),
+        )
+    return jax.lax.dynamic_index_in_dim(kv_pages, layer, 0, keepdims=False)
+
+
+def gather_layer_kv(layer_kv, kv_idx, page_table, out_dtype):
+    """Gather one side (k=0 / v=1) of a layer's pages: ``[B, P, page,
+    Hkv, D]`` in ``out_dtype``, dequantized when the pool is int8.  The
+    dequant runs on the GATHERED pages (a few MB), never the pool."""
+    if isinstance(layer_kv, QuantKV):
+        pages = layer_kv.q[kv_idx][page_table]  # [B, P, page, Hkv, D] int8
+        scales = layer_kv.s[kv_idx][page_table]  # [B, P, page]
+        return (
+            pages.astype(jnp.float32) * scales[..., None, None]
+        ).astype(out_dtype)
+    return layer_kv[kv_idx][page_table].astype(out_dtype)
+
+
+def parse_kv_dtype(spec: Optional[str]) -> Optional[str]:
+    """Normalize a ``--kv-dtype`` / ``DYN_KV_DTYPE`` value: ``int8`` is
+    the quantized layout, ``bf16``/``bfloat16``/``f32``/``float32`` pass
+    through as plain pool dtypes, empty/None defers to the model dtype."""
+    if spec is None:
+        return None
+    s = str(spec).strip().lower()
+    if not s or s in ("auto", "default", "model"):
+        return None
+    aliases = {
+        "bf16": "bfloat16",
+        "f32": "float32",
+        "fp32": "float32",
+        "f16": "float16",
+        "fp16": "float16",
+    }
+    s = aliases.get(s, s)
+    if s not in ("int8", "bfloat16", "float32", "float16"):
+        raise ValueError(f"unsupported kv dtype {spec!r}")
+    return s
+
+
+def quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-row int8 over the trailing (heads, head_dim) axes.
+
+    ``x`` is ``[..., Hkv, D]``; returns ``(q int8 [..., Hkv, D],
+    s f32 [...])``.  The ONE quantization rule shared by the jitted write
+    paths (engine/attention.py) and the host-side blob conversion below,
+    so device-quantized and host-quantized bytes can never disagree."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(xf / s[..., None, None]), -127, 127
+    ).astype(jnp.int8)
+    return q, s
+
+
+def quantize_kv_blob(blob: Any) -> QuantKV:
+    """Host-side blob conversion (cross-dtype delivery into an int8 pool):
+    a dense ``[L, 2, n, page, Hkv, D]`` array becomes a :class:`QuantKV`
+    pair under the same per-row rule as the device writes."""
+    arr = np.asarray(blob, np.float32)
+    amax = np.max(np.abs(arr), axis=(-2, -1))
+    s = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(
+        np.rint(arr / s[..., None, None]), -127, 127
+    ).astype(np.int8)
+    return QuantKV(q=q, s=s)
+
+
+def dequantize_kv_blob(blob: QuantKV, dtype: Any = np.float32) -> Any:
+    """The inverse direction (int8 blob delivered into a full-width pool)."""
+    q, s = blob.q, blob.s
+    if isinstance(q, jax.Array):
+        return (q.astype(jnp.float32) * s[..., None, None]).astype(
+            jnp.dtype(dtype)
+        )
+    return (
+        np.asarray(q, np.float32) * np.asarray(s, np.float32)[..., None, None]
+    ).astype(dtype)
+
+
+def kv_blob_concat(blobs: List[Any], axis: int = 2) -> Any:
+    """Concatenate KV blobs along a shared leading axis (the onboard path
+    stacks an admission's tier hits on the pages axis) -- pair-aware."""
+    if blobs and isinstance(blobs[0], QuantKV):
+        return QuantKV(
+            q=np.concatenate([np.asarray(b.q) for b in blobs], axis=axis),
+            s=np.concatenate([np.asarray(b.s) for b in blobs], axis=axis),
+        )
+    return np.concatenate([np.asarray(b) for b in blobs], axis=axis)
+
+
+def as_device_blob(blob: Any) -> Any:
+    """``jnp.asarray`` for either blob form (scatter-site upload)."""
+    if isinstance(blob, QuantKV):
+        return QuantKV(q=jnp.asarray(blob.q), s=jnp.asarray(blob.s))
+    return jnp.asarray(blob)
+
+
+def blob_to_host(blob: Any) -> Any:
+    """``np.asarray`` for either blob form (tier materialize)."""
+    if isinstance(blob, QuantKV):
+        return QuantKV(q=np.asarray(blob.q), s=np.asarray(blob.s))
+    return np.asarray(blob)
+
+
+def coerce_kv_blob(blob: Any, pool_quantized: bool, compute_dtype) -> Any:
+    """Bring a delivered blob into the receiving pool's dtype domain.
+
+    Same-domain blobs pass through untouched (byte-exact round trip);
+    cross-geometry deliveries -- a bf16 exporter feeding an int8 pool, or
+    an int8 tier blob restoring into a full-width pool -- convert through
+    the shared quantization rule, so delivery stays exact up to the int8
+    rounding the pool itself applies."""
+    is_quant = isinstance(blob, QuantKV)
+    if pool_quantized and not is_quant:
+        return quantize_kv_blob(blob)
+    if not pool_quantized and is_quant:
+        return dequantize_kv_blob(blob, compute_dtype)
+    return blob
+
+
+def pack_quant_blob_bytes(blob: QuantKV) -> bytes:
+    """Wire form of a quantized blob (disagg/prefix-onboard frames): the
+    data bytes followed by the scale bytes, both C-order.  The receiver
+    re-derives both extents from the shape + ``kv_dtype`` metadata."""
+    q = np.ascontiguousarray(np.asarray(blob.q))
+    s = np.ascontiguousarray(np.asarray(blob.s, np.float32))
+    return q.tobytes() + s.tobytes()
+
+
+def unpack_quant_blob_bytes(buf, shape: Tuple[int, ...]) -> QuantKV:
+    """Inverse of :func:`pack_quant_blob_bytes` for a ``shape``-d blob.
+
+    ``buf`` is anything exposing the buffer protocol (bytes, a uint8
+    ndarray, a memoryview) -- the returned pair ALIASES it, so a
+    staging-buffer caller gets a zero-copy unpack (the refcount keeps the
+    backing buffer alive)."""
+    shape = tuple(int(x) for x in shape)
+    q_n = int(np.prod(shape))
+    q = np.frombuffer(buf, np.int8, count=q_n).reshape(shape)
+    s = np.frombuffer(buf, np.float32, offset=q_n).reshape(shape[:4])
+    return QuantKV(q=q, s=s)
+
+
+def quant_blob_nbytes(shape: Tuple[int, ...]) -> int:
+    """Wire size of a quantized blob: int8 data + f32 per-row scales."""
+    shape = tuple(int(x) for x in shape)
+    return int(np.prod(shape)) + int(np.prod(shape[:4])) * 4
 
 
 class PageAllocator:
@@ -80,7 +351,17 @@ class PagedKVCache:
         self.cfg = cfg
         self.num_pages = num_pages
         self.page_size = page_size
-        self.dtype = jnp.dtype(dtype or cfg.dtype)
+        # "int8" selects the quantized layout (see module section comment);
+        # anything else is a plain dense pool of that dtype
+        self.quantized = dtype is not None and (
+            (isinstance(dtype, str) and dtype.strip().lower() == "int8")
+            or (not isinstance(dtype, str) and jnp.dtype(dtype) == jnp.int8)
+        )
+        self.dtype = (
+            jnp.dtype(jnp.int8)
+            if self.quantized
+            else jnp.dtype(dtype or cfg.dtype)
+        )
         # default is the plain free list; the engine passes a PagePool
         # (block_manager) to get the sequence-hash reuse registry
         self.allocator = allocator if allocator is not None else PageAllocator(num_pages)
@@ -92,18 +373,47 @@ class PagedKVCache:
             cfg.num_kv_heads,
             cfg.head_dim,
         )
-        arr = jnp.zeros(shape, self.dtype)
-        if sharding is not None:
-            arr = jax.device_put(arr, sharding)
-        self.pages = arr
+        if self.quantized:
+            q = jnp.zeros(shape, jnp.int8)
+            s = jnp.zeros(shape[:4], jnp.float32)
+            if sharding is not None:
+                # data shards like the dense pool (kv heads over tp); the
+                # row scales have no head axis and replicate -- they are
+                # 4/(Hkv*D) of the data, so replication costs ~nothing
+                q = jax.device_put(q, sharding)
+                mesh = getattr(sharding, "mesh", None)
+                if mesh is not None:
+                    s = jax.device_put(
+                        s,
+                        jax.sharding.NamedSharding(
+                            mesh, jax.sharding.PartitionSpec()
+                        ),
+                    )
+            self.pages: Any = QuantKV(q=q, s=s)
+        else:
+            arr = jnp.zeros(shape, self.dtype)
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            self.pages = arr
 
     @property
     def bytes_per_page(self) -> int:
+        """HBM bytes per pool page -- dtype-true, so the bench's
+        ``est_hbm_util`` and ``kv_pool_gb`` lines report the actual
+        footprint.  Quantized pages count their scale rows too."""
         c = self.cfg
-        return (
+        data = (
             c.num_layers * 2 * self.page_size * c.num_kv_heads * c.head_dim
             * self.dtype.itemsize
         )
+        if self.quantized:
+            data += c.num_layers * 2 * self.page_size * 4  # f32 row scales
+        return data
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total pool footprint (every page, trash page included)."""
+        return self.bytes_per_page * self.num_pages
 
     def pages_for_tokens(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
@@ -121,7 +431,8 @@ class PagedKVCache:
         restore sites can assert pool compatibility."""
         from ..parallel.sharding import kv_shard_geometry
 
-        return kv_shard_geometry(self.pages)
+        arr = self.pages.q if isinstance(self.pages, QuantKV) else self.pages
+        return kv_shard_geometry(arr)
 
 
 def layer_chunk_spans(
@@ -157,7 +468,12 @@ def pad_page_axis(blob, bucket: int):
     tier onboard, swap-in restore).  Pad entries target trash page 0 with
     zero content, so one executable per page bucket serves every blob
     size.  Device-resident blobs pad on device (``np.pad`` would silently
-    pull them to host and re-upload)."""
+    pull them to host and re-upload).  Quantized blobs pad data and
+    scales together (zero scale rows decode to zero -- inert)."""
+    if isinstance(blob, QuantKV):
+        return QuantKV(
+            q=pad_page_axis(blob.q, bucket), s=pad_page_axis(blob.s, bucket)
+        )
     n = blob.shape[2]
     if bucket <= n:
         return blob
@@ -165,8 +481,6 @@ def pad_page_axis(blob, bucket: int):
     pad[2] = (0, bucket - n)
     if isinstance(blob, jax.Array):
         return jnp.pad(blob, pad)
-    import numpy as np
-
     return np.pad(blob, pad)
 
 

@@ -921,12 +921,20 @@ seed_count_rows = partial(jax.jit, donate_argnames=("counts",))(
 
 
 def _scatter_block_pages(
-    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D]
+    kv_pages: jax.Array,  # [L, 2, num_pages, page, Hkv, D] | QuantKV
     ids: jax.Array,  # [pages_per_block] page ids
-    blob: jax.Array,  # [L, 2, pages_per_block, page, Hkv, D]
+    blob: jax.Array,  # [L, 2, pages_per_block, page, Hkv, D] | QuantKV
 ) -> jax.Array:
     """Write an offloaded block's contents back into fresh pages (G2/G3 ->
-    G1 onboarding).  Donated so the cache updates in place."""
+    G1 onboarding).  Donated so the cache updates in place.  Quantized
+    pools restore (data, scales) byte-for-byte."""
+    from .kv_cache import QuantKV
+
+    if isinstance(kv_pages, QuantKV):
+        return QuantKV(
+            q=kv_pages.q.at[:, :, ids].set(blob.q.astype(jnp.int8)),
+            s=kv_pages.s.at[:, :, ids].set(blob.s.astype(kv_pages.s.dtype)),
+        )
     return kv_pages.at[:, :, ids].set(blob.astype(kv_pages.dtype))
 
 
@@ -938,7 +946,12 @@ scatter_block_pages = partial(jax.jit, donate_argnames=("kv_pages",))(
 def _slice_block_pages(kv_pages: jax.Array, ids: jax.Array) -> jax.Array:
     """Read a block's pages (pre-eviction snapshot for G1 -> G2 demotion).
     Dispatched before the free-list reuses the pages, so device program
-    order guarantees it reads the pre-reuse contents."""
+    order guarantees it reads the pre-reuse contents.  A quantized pool's
+    snapshot is the (data, scales) pair."""
+    from .kv_cache import QuantKV
+
+    if isinstance(kv_pages, QuantKV):
+        return QuantKV(q=kv_pages.q[:, :, ids], s=kv_pages.s[:, :, ids])
     return kv_pages[:, :, ids]
 
 

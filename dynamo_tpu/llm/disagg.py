@@ -311,7 +311,13 @@ def _blob_chunks(blob: np.ndarray) -> Iterator[bytes]:
     ``memoryview`` could cast copy-free anyway.  The per-chunk slices are
     zero-copy memoryviews over it.
     """
-    raw = blob.tobytes()
+    yield from _byte_chunks(blob.tobytes())
+
+
+def _byte_chunks(raw: bytes) -> Iterator[bytes]:
+    """KV_CHUNK_BYTES slices over pre-packed bytes (the one chunking
+    loop; :func:`_blob_chunks` and the quantized-blob wire form --
+    data followed by row scales -- both route through it)."""
     view = memoryview(raw)
     for off in range(0, len(view), KV_CHUNK_BYTES):
         yield view[off : off + KV_CHUNK_BYTES]
@@ -590,8 +596,18 @@ class DisaggDecodeEngine:
         else:
             dtype = jnp.dtype(meta["dtype"])  # resolves bfloat16 via ml_dtypes
             shape = tuple(int(s) for s in meta["shape"])
-            buf = np.empty(shape, dtype)
-            flat = buf.view(np.uint8).reshape(-1)
+            quant = dtype == jnp.dtype(jnp.int8)
+            if quant:
+                # quantized wire form: data bytes then f32 row scales
+                # (kv_cache.pack_quant_blob_bytes); extents derive from
+                # (shape, dtype) on both ends
+                from ..engine.kv_cache import quant_blob_nbytes
+
+                flat = np.empty((quant_blob_nbytes(shape),), np.uint8)
+                buf = None
+            else:
+                buf = np.empty(shape, dtype)
+                flat = buf.view(np.uint8).reshape(-1)
             size = flat.size
             off = 0
             truncated = False
@@ -615,6 +631,12 @@ class DisaggDecodeEngine:
                     f"KV delivery truncated: got {off} of {size} bytes",
                 )
             else:
+                if quant:
+                    from ..engine.kv_cache import unpack_quant_blob_bytes
+
+                    # zero-copy: the delivered pair aliases the landing
+                    # buffer (multi-GB blobs must not double on receive)
+                    buf = unpack_quant_blob_bytes(flat, shape)
                 lp_row = meta.get("lp_row")
                 ok = self.engine.deliver_external(
                     rid, buf, int(meta["first_token"]),
@@ -985,16 +1007,25 @@ class PrefillWorker:
         first = int(np.asarray(row).reshape(-1)[0])
         lp_row = [int(x) for x in np.asarray(row).reshape(-1)]
         local = self._local_engine(msg)
+        # lazy: QuantKV lives with the (jax-importing) engine package, and
+        # chip-free stacks import this module without jax
+        from ..engine.kv_cache import QuantKV, blob_to_host
+
+        quant = isinstance(blob, QuantKV)
         t0 = time.perf_counter()
         if local is not None and not isinstance(blob, np.ndarray):
-            # same-process handoff: the device-resident blob goes straight
-            # into the decode engine's delivery queue; the scatter is a
-            # device-to-device copy at its next tick
+            # same-process handoff: the device-resident blob (or quantized
+            # pair) goes straight into the decode engine's delivery queue;
+            # the scatter is a device-to-device copy at its next tick
             self.local_deliveries += 1
             local.deliver_external(
                 rid, blob, first, np.asarray(lp_row, np.int32)
             )
-            nbytes = int(np.prod(blob.shape)) * blob.dtype.itemsize
+            nbytes = (
+                blob.nbytes
+                if quant
+                else int(np.prod(blob.shape)) * blob.dtype.itemsize
+            )
             path = "device"
         else:
             meta = {
@@ -1007,18 +1038,36 @@ class PrefillWorker:
             shards = self._kv_shard_geometry()
             if shards is not None:
                 meta["kv_shards"] = shards
-            if not isinstance(blob, np.ndarray):
-                # mixed batch: a device export targeting a remote decode
-                # worker still ships over the wire
-                blob = np.asarray(blob)
+            if quant:
+                # int8 export: the wire carries data bytes then the f32
+                # row scales (the pack_quant_blob_bytes layout, streamed
+                # as two buffer-protocol views so no (q+s)-sized concat
+                # buffer ever materializes); the receiver re-derives both
+                # extents from (shape, dtype)
+                import itertools
+
+                blob = blob_to_host(blob)
+                q_arr = np.ascontiguousarray(blob.q)
+                s_arr = np.ascontiguousarray(blob.s, np.float32)
+                chunks_iter = itertools.chain(
+                    _byte_chunks(q_arr.reshape(-1).view(np.uint8)),
+                    _byte_chunks(s_arr.reshape(-1).view(np.uint8)),
+                )
+                nbytes = q_arr.nbytes + s_arr.nbytes
+            else:
+                if not isinstance(blob, np.ndarray):
+                    # mixed batch: a device export targeting a remote
+                    # decode worker still ships over the wire
+                    blob = np.asarray(blob)
+                chunks_iter = _blob_chunks(blob)
+                nbytes = blob.nbytes
             try:
                 if faults.injector.enabled:
                     await faults.injector.maybe_delay("disagg.slow_export", rid)
-                await self._upload(msg, meta, _blob_chunks(blob))
+                await self._upload(msg, meta, chunks_iter)
             except Exception:
                 logger.exception("KV delivery failed for request %s", rid)
                 raise
-            nbytes = blob.nbytes
             path = "wire"
         self._record_delivery(
             {
@@ -1064,11 +1113,18 @@ class PrefillWorker:
             meta["kv_shards"] = stream.shards
 
         async def frames() -> AsyncIterator[bytes]:
+            from ..engine.kv_cache import QuantKV, pack_quant_blob_bytes
+
             truncated = False
             async for idx, _lo, _hi, part in stream.chunks():
                 if truncated:
                     continue  # drain the export without sending (fault)
-                raw = part.tobytes()  # C-order bytes of the layer slab
+                if isinstance(part, QuantKV):
+                    # quantized slab: int8 data then f32 row scales --
+                    # matches the receiver's quant staging-buffer bounds
+                    raw = pack_quant_blob_bytes(part)
+                else:
+                    raw = part.tobytes()  # C-order bytes of the layer slab
                 for frame in iter_chunk_frames(
                     idx, bounds[idx][0], raw, KV_CHUNK_BYTES
                 ):

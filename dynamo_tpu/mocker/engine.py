@@ -37,6 +37,15 @@ from .kv_manager import MockKvManager, PrefillCost
 
 logger = logging.getLogger("dynamo.mocker")
 
+# The designated stream-fanout emitters of the mocker's tick loop
+# (dynalint DT013, mirroring engine/engine.py's tuple): queue puts happen
+# only in the per-lane commit/finish/error paths.
+TICK_COMMIT_HELPERS = (
+    "_generate_one",
+    "_finish",
+    "_emit_error",
+)
+
 _partial_ids = itertools.count(1)
 
 
@@ -64,6 +73,16 @@ class MockerConfig:
     # model mock network for chip-free failure/SLO testing)
     network_latency_ms: float = 0.0
     network_jitter_ms: float = 0.0
+    # double-buffered tick pipeline (ISSUE 13, mirrors
+    # EngineConfig.async_dispatch): tick N+1's "dispatch" (the simulated
+    # decode sleep) starts BEFORE tick N's host commit/fanout runs, so
+    # host work overlaps simulated device time and the dispatch gap
+    # collapses to zero -- the same lane structure the JaxEngine runs,
+    # exercised device-free in tier-1.  False = the exact serial loop.
+    # Only engages when decode_s_per_step > 0 (with no simulated device
+    # time there is nothing to overlap, and unit tests keep their
+    # same-tick token delivery).
+    async_dispatch: bool = True
 
 
 @dataclass
@@ -121,6 +140,9 @@ class MockerEngine:
         # engine does (its simulated decode sleep plays device_wait), so
         # planner/SLO-loop tests exercise the whole plane chip-free
         self.profiler = profiling.profiler
+        # double-buffered lane: the in-flight simulated dispatch --
+        # (sleep_task, rids snapshot) -- whose host commit runs next tick
+        self._inflight_tick = None
 
     def _sink(self, ev: Dict[str, Any]) -> None:
         if self.kv_event_sink is not None:
@@ -157,6 +179,11 @@ class MockerEngine:
 
     async def stop(self) -> None:
         self._running = False
+        inflight = self._inflight_tick
+        if inflight is not None:
+            self._inflight_tick = None
+            if inflight[0] is not None:
+                inflight[0].cancel()
         if self._wake is not None:
             self._wake.set()
         if self._task is not None:
@@ -278,7 +305,11 @@ class MockerEngine:
                 prof = self.profiler
                 tick = prof.begin_tick() if prof.enabled else None
                 self._process_cancellations()
-                if not self._waiting_list and not self.running:
+                if (
+                    not self._waiting_list
+                    and not self.running
+                    and self._inflight_tick is None
+                ):
                     if tick is not None:
                         tick.discard()
                         tick = None
@@ -296,6 +327,11 @@ class MockerEngine:
                 raise
             except Exception as e:
                 logger.exception("mocker tick failed")
+                inflight = self._inflight_tick
+                if inflight is not None:
+                    self._inflight_tick = None
+                    if inflight[0] is not None:
+                        inflight[0].cancel()
                 for seq in list(self.running.values()) + self._waiting_list:
                     self._emit_error(seq, f"mocker error: {e}")
                     self.kv.deref(seq.held)
@@ -363,21 +399,13 @@ class MockerEngine:
             self.running[seq.request_id] = seq
             budget -= cost.new_tokens
 
-    async def _simulate_tick(self, tick=None) -> None:
+    async def _commit_generation(self, rids) -> None:
+        """Host commit of one simulated dispatch: generate (and fan out)
+        one token for every lane the dispatch snapshot covered.  Lanes
+        cancelled/preempted since the snapshot simply skip -- the mocker
+        analog of the engine's stale-slot commit guards."""
         cfg = self.cfg
-        t0 = time.perf_counter()
-        self.obs.observe_sched(len(self._waiting_list), len(self.running))
-        self.obs.observe_kv(self.kv.num_active_blocks, self.kv.max_capacity)
-        # decode time models HBM-bound KV reads over all active tokens
-        tick_s = cfg.decode_s_per_step * self.kv.num_active_blocks
-        had_work = bool(self.running)
-        if tick is not None and had_work:
-            # the simulated batch "dispatches" here: phase bookkeeping
-            # mirrors the real engine (generation = commit+fanout on
-            # host, the decode sleep = device_wait)
-            tick.note_dispatch("decode_block")
-            tick.mark("dispatch")
-        for rid in list(self.running.keys()):
+        for rid in rids:
             seq = self.running.get(rid)
             if seq is None:
                 continue
@@ -391,6 +419,64 @@ class MockerEngine:
                     )
                 seq.prefilled = True
             self._generate_one(seq)
+
+    async def _simulate_tick(self, tick=None) -> None:
+        cfg = self.cfg
+        t0 = time.perf_counter()
+        self.obs.observe_sched(len(self._waiting_list), len(self.running))
+        self.obs.observe_kv(self.kv.num_active_blocks, self.kv.max_capacity)
+        # decode time models HBM-bound KV reads over all active tokens
+        tick_s = cfg.decode_s_per_step * self.kv.num_active_blocks
+        had_work = bool(self.running)
+        # double-buffered lanes (ISSUE 13): with simulated device time
+        # armed, tick N's sleep starts BEFORE tick N-1's host commit runs
+        # -- host work overlaps "device compute", dispatch gap collapses
+        # to zero, exactly the JaxEngine pipeline's shape.  Unit-test mode
+        # (decode_s_per_step == 0) and async_dispatch=False keep the
+        # serial same-tick commit.
+        pipelined = cfg.async_dispatch and (
+            tick_s > 0 or self._inflight_tick is not None
+        )
+        if pipelined:
+            if tick is not None and had_work:
+                tick.note_dispatch("decode_block")
+                tick.mark("dispatch")
+            sleep_task = (
+                asyncio.create_task(
+                    asyncio.sleep(tick_s / cfg.speedup_ratio)
+                )
+                if had_work and tick_s > 0
+                else None
+            )
+            prev = self._inflight_tick
+            self._inflight_tick = (
+                (sleep_task, list(self.running.keys())) if had_work else None
+            )
+            if prev is not None:
+                prev_task, rids = prev
+                await self._commit_generation(rids)
+                if tick is not None:
+                    tick.mark("commit")
+                if prev_task is not None:
+                    await prev_task
+                if tick is not None:
+                    tick.mark("device_wait")
+                    if self._inflight_tick is not None:
+                        tick.note_zero_gap()
+                    else:
+                        self.profiler.note_results_ready()
+            if self.running:
+                self.obs.observe_step(
+                    "decode_block", time.perf_counter() - t0
+                )
+            return
+        if tick is not None and had_work:
+            # the simulated batch "dispatches" here: phase bookkeeping
+            # mirrors the real engine (generation = commit+fanout on
+            # host, the decode sleep = device_wait)
+            tick.note_dispatch("decode_block")
+            tick.mark("dispatch")
+        await self._commit_generation(list(self.running.keys()))
         if tick is not None and had_work:
             tick.mark("commit")
         if tick_s:

@@ -54,7 +54,13 @@ def to_host(arr: Any) -> np.ndarray:
     Runs only on the offload engine's thread: by the time it is called the
     async DMA (``copy_to_host_async``, started at dispatch) has usually
     landed, so this is a wait, not a transfer -- and if it is a transfer,
-    it blocks a thread nobody's tick latency depends on."""
+    it blocks a thread nobody's tick latency depends on.  Quantized pool
+    snapshots (kv_cache.QuantKV) materialize data and scales together --
+    the pair is the blob."""
+    from .engine.kv_cache import QuantKV
+
+    if isinstance(arr, QuantKV):
+        return QuantKV(q=np.asarray(arr.q), s=np.asarray(arr.s))
     return np.asarray(arr)
 
 
@@ -69,6 +75,11 @@ class BlockMeta:
     # reassemble on export), so this is provenance for restore-site
     # validation, not a layout switch.
     shards: Optional[Dict[str, int]] = None
+    # dtype of the pool the blob was sliced from ("int8" = quantized
+    # kv_cache.QuantKV pair -- its per-row scales travel inside the blob).
+    # Restore sites use this to route cross-geometry deliveries through
+    # the shared conversion rule; None = pre-ISSUE-13 full-width blob.
+    kv_dtype: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -78,16 +89,20 @@ class BlockMeta:
         }
         if self.shards is not None:
             out["shards"] = dict(self.shards)
+        if self.kv_dtype is not None:
+            out["kv_dtype"] = str(self.kv_dtype)
         return out
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "BlockMeta":
         shards = d.get("shards")
+        kv_dtype = d.get("kv_dtype")
         return cls(
             int(d.get("block_hash", 0)),
             int(d.get("parent_sequence_hash", 0)),
             int(d.get("position", 0)),
             dict(shards) if shards else None,
+            str(kv_dtype) if kv_dtype else None,
         )
 
 
@@ -102,8 +117,20 @@ class KVStagingBuffer:
     map to byte ranges because layer slabs are contiguous in the C-order
     blob ``[L, 2, pages, page, Hkv, D]``."""
 
-    def __init__(self, shape, dtype, bounds) -> None:
-        self.array = np.empty(tuple(int(s) for s in shape), dtype)
+    def __init__(self, shape, dtype, bounds, quant: bool = False) -> None:
+        shape = tuple(int(s) for s in shape)
+        self.quant = quant
+        self.shape = shape
+        if quant:
+            # quantized wire layout (kv_cache.pack_quant_blob_bytes): each
+            # layer slab is its int8 data followed by its f32 row scales,
+            # so the landing zone is a flat byte buffer and layer_slice
+            # unpacks the (data, scales) pair per span
+            from .engine.kv_cache import quant_blob_nbytes
+
+            self.array = np.empty((quant_blob_nbytes(shape),), np.uint8)
+        else:
+            self.array = np.empty(shape, dtype)
         self.flat = self.array.view(np.uint8).reshape(-1)
         self.bounds = [(int(s), int(e)) for s, e in bounds]
         if self.bounds and self.bounds[-1][1] != self.flat.size:
@@ -114,31 +141,76 @@ class KVStagingBuffer:
 
     @classmethod
     def for_layer_spans(cls, shape, dtype, spans) -> "KVStagingBuffer":
-        """One chunk per layer-group span [lo, hi) over axis 0."""
+        """One chunk per layer-group span [lo, hi) over axis 0.  An int8
+        ``dtype`` selects the quantized wire layout (data + row scales per
+        layer slab)."""
         shape = tuple(int(s) for s in shape)
+        if np.dtype("int8") == np.dtype(str(dtype)):
+            from .engine.kv_cache import quant_blob_nbytes
+
+            bpl = quant_blob_nbytes(shape) // max(shape[0], 1)
+            return cls(
+                shape, dtype, [(lo * bpl, hi * bpl) for lo, hi in spans],
+                quant=True,
+            )
         total = int(np.prod(shape)) * np.dtype(dtype).itemsize
         bpl = total // max(shape[0], 1)
         return cls(shape, dtype, [(lo * bpl, hi * bpl) for lo, hi in spans])
 
     @classmethod
     def for_byte_chunks(cls, shape, dtype, chunk_bytes: int) -> "KVStagingBuffer":
-        """Fixed-size byte chunks (the block-blob transfer framing)."""
+        """Fixed-size byte chunks (the block-blob transfer framing).  An
+        int8 ``dtype`` selects the quantized wire layout."""
         shape = tuple(int(s) for s in shape)
-        total = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        quant = np.dtype("int8") == np.dtype(str(dtype))
+        if quant:
+            from .engine.kv_cache import quant_blob_nbytes
+
+            total = quant_blob_nbytes(shape)
+        else:
+            total = int(np.prod(shape)) * np.dtype(dtype).itemsize
         if total == 0:
-            return cls(shape, dtype, [(0, 0)])
+            return cls(shape, dtype, [(0, 0)], quant=quant)
         bounds = [
             (off, min(off + chunk_bytes, total))
             for off in range(0, total, chunk_bytes)
         ]
-        return cls(shape, dtype, bounds)
+        return cls(shape, dtype, bounds, quant=quant)
+
+    def payload(self):
+        """The assembled blob in its engine-facing form: the ndarray for
+        dense pools, the unpacked (data, scales) pair for quantized wire
+        bytes.  Valid for whole-blob staging (``for_byte_chunks``) only --
+        the layer-span layout packs (data | scales) PER SPAN, so those
+        consumers unpack via :meth:`layer_slice` instead."""
+        if self.quant:
+            from .engine.kv_cache import unpack_quant_blob_bytes
+
+            # zero-copy: the pair aliases the staging buffer's bytes
+            return unpack_quant_blob_bytes(self.flat, self.shape)
+        return self.array
 
     @property
     def memoryview(self) -> memoryview:
         return memoryview(self.flat)
 
     def layer_slice(self, lo: int, hi: int) -> np.ndarray:
-        """View of layers [lo, hi) -- stable once their bytes landed."""
+        """View of layers [lo, hi) -- stable once their bytes landed.  For
+        the quantized layout this unpacks the span's (data, scales) pair;
+        like the dense path it ALIASES the staging buffer (zero-copy), so
+        it is valid only while the buffer's bytes stay untouched."""
+        if self.quant:
+            from .engine.kv_cache import (
+                quant_blob_nbytes,
+                unpack_quant_blob_bytes,
+            )
+
+            bpl = quant_blob_nbytes(self.shape) // max(self.shape[0], 1)
+            span_shape = (hi - lo,) + self.shape[1:]
+            # zero-copy: the pair aliases the staging buffer's bytes
+            return unpack_quant_blob_bytes(
+                self.flat[lo * bpl : hi * bpl], span_shape
+            )
         return self.array[lo:hi]
 
 
@@ -176,12 +248,21 @@ class DiskTier:
         a temp file, rename into place): the lock guards only the in-RAM
         index, so ``__contains__`` probes from the admission path never
         wait behind a multi-MB compressed write."""
+        from .engine.kv_cache import QuantKV
+
         if self.capacity <= 0:
             return
         path = self._path(seq_hash)
         tmp = path + ".tmp.npz"  # .npz suffix so np.savez appends nothing
         try:
-            np.savez(tmp, blob=blob, **meta.to_dict())
+            meta_d = {
+                k: v for k, v in meta.to_dict().items() if k != "shards"
+            }
+            if isinstance(blob, QuantKV):
+                # quantized pair: scales are part of the block's bytes
+                np.savez(tmp, blob=blob.q, blob_scales=blob.s, **meta_d)
+            else:
+                np.savez(tmp, blob=blob, **meta_d)
             os.replace(tmp, path)
         except OSError:
             logger.exception("disk tier write failed for %x", seq_hash)
@@ -205,13 +286,20 @@ class DiskTier:
             if seq_hash not in self._lru:
                 self.misses += 1
                 return None
+        from .engine.kv_cache import QuantKV
+
         try:
             with np.load(self._path(seq_hash)) as z:
                 blob = z["blob"]
+                if "blob_scales" in z.files:
+                    blob = QuantKV(q=blob, s=z["blob_scales"])
                 meta = BlockMeta(
                     int(z["block_hash"]),
                     int(z["parent_sequence_hash"]),
                     int(z["position"]),
+                    kv_dtype=(
+                        str(z["kv_dtype"]) if "kv_dtype" in z.files else None
+                    ),
                 )
         except OSError:
             with self._lock:
@@ -259,6 +347,9 @@ class HostTier:
         self._misc: Dict[int, Tuple[np.ndarray, BlockMeta]] = {}
         self._meta: Dict[int, BlockMeta] = {}
         self._ring: Optional[np.ndarray] = None
+        # scale ring of a quantized pool's blocks (kv_cache.QuantKV): the
+        # pair occupies one LRU slot -- scales are part of the block
+        self._ring_s: Optional[np.ndarray] = None
         self._ring_failed = False
         self._free_slots: List[int] = []
         # prefetch pins: hash -> refcount.  A pinned block is skipped by
@@ -276,15 +367,28 @@ class HostTier:
 
     @property
     def ring_nbytes(self) -> int:
-        return self._ring.nbytes if self._ring is not None else 0
+        n = self._ring.nbytes if self._ring is not None else 0
+        if self._ring_s is not None:
+            n += self._ring_s.nbytes
+        return n
 
-    def _ensure_ring(self, blob: np.ndarray) -> None:
+    def _ensure_ring(self, blob: Any) -> None:
+        from .engine.kv_cache import QuantKV
+
         if self._ring is not None or self._ring_failed or self.capacity <= 0:
             return
         try:
-            self._ring = np.empty(
-                (self.capacity,) + tuple(blob.shape), blob.dtype
-            )
+            if isinstance(blob, QuantKV):
+                self._ring = np.empty(
+                    (self.capacity,) + tuple(blob.q.shape), blob.q.dtype
+                )
+                self._ring_s = np.empty(
+                    (self.capacity,) + tuple(blob.s.shape), blob.s.dtype
+                )
+            else:
+                self._ring = np.empty(
+                    (self.capacity,) + tuple(blob.shape), blob.dtype
+                )
         except MemoryError:
             # remember the failure: retrying a multi-GB allocation on
             # every eviction would hammer the allocator on the one thread
@@ -294,30 +398,60 @@ class HostTier:
                 "back to per-entry storage", self.capacity,
             )
             self._ring = None
+            self._ring_s = None
             self._ring_failed = True
             return
         self._free_slots = list(range(self.capacity - 1, -1, -1))
+
+    def _ring_fits(self, blob: Any) -> bool:
+        from .engine.kv_cache import QuantKV
+
+        if self._ring is None:
+            return False
+        if isinstance(blob, QuantKV):
+            return (
+                self._ring_s is not None
+                and tuple(blob.q.shape) == self._ring.shape[1:]
+                and blob.q.dtype == self._ring.dtype
+                and tuple(blob.s.shape) == self._ring_s.shape[1:]
+            )
+        return (
+            self._ring_s is None
+            and tuple(blob.shape) == self._ring.shape[1:]
+            and blob.dtype == self._ring.dtype
+        )
+
+    def _ring_read(self, slot: int):
+        from .engine.kv_cache import QuantKV
+
+        if self._ring_s is not None:
+            return QuantKV(
+                q=self._ring[slot].copy(), s=self._ring_s[slot].copy()
+            )
+        return self._ring[slot].copy()
 
     def put(self, seq_hash: int, blob: np.ndarray, meta: BlockMeta) -> None:
         if self.capacity <= 0:
             if self.parent is not None:
                 self.parent.put(seq_hash, blob, meta)
             return
+        from .engine.kv_cache import QuantKV
+
         demote: List[Tuple[int, np.ndarray, BlockMeta]] = []
         with self._lock:
             self._evict_locked(seq_hash)  # overwrite: recycle the old slot
             self._ensure_ring(blob)
             slot: Optional[int] = None
-            if (
-                self._ring is not None
-                and tuple(blob.shape) == self._ring.shape[1:]
-                and blob.dtype == self._ring.dtype
-            ):
+            if self._ring_fits(blob):
                 if not self._free_slots:
                     self._demote_lru_locked(demote)
                 if self._free_slots:
                     slot = self._free_slots.pop()
-                    np.copyto(self._ring[slot], blob)
+                    if isinstance(blob, QuantKV):
+                        np.copyto(self._ring[slot], blob.q)
+                        np.copyto(self._ring_s[slot], blob.s)
+                    else:
+                        np.copyto(self._ring[slot], blob)
             if slot is None:
                 # geometry mismatch (or ring unavailable): side table
                 self._misc[seq_hash] = (blob.copy(), meta)
@@ -348,7 +482,7 @@ class HostTier:
         if slot is None:
             vb, meta = self._misc.pop(victim)
         else:
-            vb = self._ring[slot].copy()
+            vb = self._ring_read(slot)
             self._free_slots.append(slot)
         demote.append((victim, vb, meta))
         return True
@@ -379,7 +513,10 @@ class HostTier:
     def block_nbytes(self) -> int:
         """Bytes of one resident block blob (0 until the first put)."""
         if self._ring is not None:
-            return int(self._ring[0].nbytes)
+            n = int(self._ring[0].nbytes)
+            if self._ring_s is not None:
+                n += int(self._ring_s[0].nbytes)
+            return n
         with self._lock:
             for blob, _meta in self._misc.values():
                 return int(blob.nbytes)
@@ -407,7 +544,7 @@ class HostTier:
             if slot is None:
                 blob, meta = self._misc[seq_hash]
                 return blob.copy(), meta
-            return self._ring[slot].copy(), self._meta[seq_hash]
+            return self._ring_read(slot), self._meta[seq_hash]
 
     def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta]]:
         """Tiered get: RAM first, then the disk parent (promoting the hit

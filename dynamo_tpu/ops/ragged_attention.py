@@ -55,15 +55,33 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def _dequant_block(blk, s_ref, kv_idx, out_dtype):
+    """In-kernel fused dequant of one fetched page block: ``blk`` is the
+    raw ``[page, Hkv, D]`` VMEM tile (int8 for a quantized pool), and
+    ``s_ref`` its ``[1, 2, 1, page]`` row-scale block (None for dense
+    pools).  The multiply runs on the VMEM-resident tile right after the
+    HBM fetch -- the pool's int8 bytes are the only thing that ever
+    streams.  Dense pools whose dtype differs from the compute dtype
+    (an explicit ``--kv-dtype float32`` under a bf16 model) convert here
+    too -- ``lax.dot_general`` rejects mixed operand dtypes."""
+    if s_ref is None:
+        return blk if blk.dtype == out_dtype else blk.astype(out_dtype)
+    return (
+        blk.astype(jnp.float32) * s_ref[0, kv_idx, 0][:, None, None]
+    ).astype(out_dtype)
+
+
 def _ragged_kernel(
     # scalar prefetch
     layer_ref,  # [1] layer index (SMEM)
     pt_ref,  # [B, P] page table (SMEM)
     base_ref,  # [B] committed cache length = first fresh position (SMEM)
     len_ref,  # [B] fresh query rows per lane (SMEM)
-    *refs,  # G kv blocks [1, 2, 1, page, Hkv, D], q, fresh k, fresh v,
-    # then o_ref and m/l/acc scratch
+    *refs,  # G kv blocks [1, 2, 1, page, Hkv, D] (+ G row-scale blocks
+    # [1, 2, 1, page] when the pool is int8), q, fresh k, fresh v, then
+    # o_ref and m/l/acc scratch
     G: int,
+    quant: bool = False,
     window: int = 0,
 ):
     """Grid (B, P/G + 1): steps ``p < P/G`` stream the lane's resident
@@ -72,7 +90,10 @@ def _ragged_kernel(
     accumulator serves both phases, so the rescale math cannot diverge
     between the prefix and fresh halves."""
     kv_refs = refs[:G]
-    q_ref, fk_ref, fv_ref, o_ref, m_scr, l_scr, acc_scr = refs[G:]
+    s_refs = refs[G : 2 * G] if quant else [None] * G
+    q_ref, fk_ref, fv_ref, o_ref, m_scr, l_scr, acc_scr = refs[
+        2 * G if quant else G :
+    ]
     b = pl.program_id(0)
     p = pl.program_id(1)
     npg = pl.num_programs(1) - 1  # page-group steps before the fresh step
@@ -124,10 +145,22 @@ def _ragged_kernel(
     @pl.when(live)
     def _prefix():
         k = jnp.concatenate(
-            [r[0, 0, 0].transpose(1, 0, 2) for r in kv_refs], axis=1
+            [
+                _dequant_block(r[0, 0, 0], sr, 0, q_ref.dtype).transpose(
+                    1, 0, 2
+                )
+                for r, sr in zip(kv_refs, s_refs)
+            ],
+            axis=1,
         )  # [Hkv, G*page, D]
         v = jnp.concatenate(
-            [r[0, 1, 0].transpose(1, 0, 2) for r in kv_refs], axis=1
+            [
+                _dequant_block(r[0, 1, 0], sr, 1, q_ref.dtype).transpose(
+                    1, 0, 2
+                )
+                for r, sr in zip(kv_refs, s_refs)
+            ],
+            axis=1,
         )
         s = jax.lax.dot_general(
             q4(), k,
@@ -181,11 +214,14 @@ def ragged_paged_attention(
     window: int = 0,
     group: int = 4,  # pages per grid step
     interpret: bool = False,
+    kv_scales: jax.Array | None = None,  # [L, 2, num_pages, page] int8 pool
 ) -> jax.Array:
     """Ragged mixed-batch attention over the paged KV pool (see module
     docstring).  When the table width doesn't divide by ``group``, the
     group degrades to the largest divisor (callers pass power-of-two
-    widths >= 8, so the full group applies)."""
+    widths >= 8, so the full group applies).  ``kv_scales`` arms the
+    fused int8 path: each fetched page group carries its row-scale block
+    and dequantizes in VMEM (ISSUE 13)."""
     B, S, Hq, D = q.shape
     L, _, num_pages, page, Hkv, _ = kv_pages.shape
     P = page_table.shape[1]
@@ -193,28 +229,38 @@ def ragged_paged_attention(
     while P % G:
         G -= 1
     npg = P // G
+    quant = kv_scales is not None
 
     pt = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
     lyr = jnp.clip(jnp.asarray(layer, jnp.int32), 0, L - 1).reshape(1)
 
-    def kv_map(g):
+    def kv_map(g, ndim=6):
         def m(b, p, layer_ref, pt_ref, base_ref, len_ref):
             # the fresh step (p == npg) re-targets the last group: the
             # fetch is dead weight there but keeps the operand spec static
             pp = jnp.minimum(p, npg - 1)
-            return (layer_ref[0], 0, pt_ref[b, pp * G + g], 0, 0, 0)
+            return (layer_ref[0], 0, pt_ref[b, pp * G + g], 0, 0, 0)[:ndim]
 
         return m
 
     def row_map(b, p, *_):
         return (b, 0, 0, 0)
 
+    scale_specs = (
+        [
+            pl.BlockSpec((1, 2, 1, page), kv_map(g, ndim=4))
+            for g in range(G)
+        ]
+        if quant
+        else []
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B, npg + 1),
         in_specs=[
             pl.BlockSpec((1, 2, 1, page, Hkv, D), kv_map(g)) for g in range(G)
         ]
+        + scale_specs
         + [
             pl.BlockSpec((1, S, Hq, D), row_map),
             pl.BlockSpec((1, S, Hkv, D), row_map),
@@ -227,14 +273,15 @@ def ragged_paged_attention(
             pltpu.VMEM((Hq * S, D), jnp.float32),
         ],
     )
+    scale_ops = [kv_scales] * G if quant else []
     return pl.pallas_call(
-        functools.partial(_ragged_kernel, G=G, window=window),
+        functools.partial(_ragged_kernel, G=G, quant=quant, window=window),
         out_shape=jax.ShapeDtypeStruct((B, S, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
     )(
         lyr, pt, base.astype(jnp.int32), q_lens.astype(jnp.int32),
-        *([kv_pages] * G), q, k, v,
+        *([kv_pages] * G), *scale_ops, q, k, v,
     )
 
 
@@ -254,18 +301,27 @@ def ragged_paged_attention_xla(
     ``kpos < base``), concatenate the fresh columns, one masked softmax.
     Same math as ``engine.attention.prefill_prefix_attention`` run with
     the whole page table as the prefix -- the kernel's parity oracle and
-    the CPU tier-1 code path."""
+    the CPU tier-1 code path.  Takes either pool form: a ``QuantKV``
+    pool's pages dequantize right after the gather (same rule the fused
+    kernel applies per VMEM tile)."""
+    from ..engine.kv_cache import gather_layer_kv, index_kv_layer, kv_data
+
     B, S, Hq, D = q.shape
-    L = kv_pages.shape[0]
-    page_size = kv_pages.shape[3]
+    data = kv_data(kv_pages)
+    L = data.shape[0]
+    page_size = data.shape[3]
     P = page_table.shape[1]
     Hkv = k.shape[2]
     n_rep = Hq // Hkv
 
     lyr = jnp.clip(jnp.asarray(layer, jnp.int32), 0, L - 1)
-    layer_kv = jax.lax.dynamic_index_in_dim(kv_pages, lyr, 0, keepdims=False)
-    kp = layer_kv[0][page_table].reshape(B, P * page_size, Hkv, D)
-    vp = layer_kv[1][page_table].reshape(B, P * page_size, Hkv, D)
+    layer_kv = index_kv_layer(kv_pages, lyr)
+    kp = gather_layer_kv(layer_kv, 0, page_table, q.dtype).reshape(
+        B, P * page_size, Hkv, D
+    )
+    vp = gather_layer_kv(layer_kv, 1, page_table, q.dtype).reshape(
+        B, P * page_size, Hkv, D
+    )
 
     def rep(x):
         return x if n_rep == 1 else jnp.repeat(x, n_rep, axis=-2)
@@ -324,9 +380,11 @@ def _packed_kernel(
     base_ref,  # [B] committed cache length = first fresh position (SMEM)
     off_ref,  # [B] lane's segment offset into the packed axis (SMEM)
     len_ref,  # [B] fresh rows per lane (SMEM)
-    *refs,  # G kv blocks, packed q, packed fresh k/v, o_ref, m/l/acc scratch
+    *refs,  # G kv blocks (+ G row-scale blocks when the pool is int8),
+    # packed q, packed fresh k/v, o_ref, m/l/acc scratch
     G: int,
     s_max: int,
+    quant: bool = False,
     window: int = 0,
 ):
     """Grid ``(B, P/G + 1)``, the page-streaming structure of
@@ -346,7 +404,10 @@ def _packed_kernel(
     both compute and the write (their offset is 0 and would clobber the
     first live lane)."""
     kv_refs = refs[:G]
-    q_ref, fk_ref, fv_ref, o_ref, m_scr, l_scr, acc_scr = refs[G:]
+    s_refs = refs[G : 2 * G] if quant else [None] * G
+    q_ref, fk_ref, fv_ref, o_ref, m_scr, l_scr, acc_scr = refs[
+        2 * G if quant else G :
+    ]
     b = pl.program_id(0)
     p = pl.program_id(1)
     npg = pl.num_programs(1) - 1
@@ -404,10 +465,22 @@ def _packed_kernel(
     @pl.when(live)
     def _prefix():
         k = jnp.concatenate(
-            [r[0, 0, 0].transpose(1, 0, 2) for r in kv_refs], axis=1
+            [
+                _dequant_block(r[0, 0, 0], sr, 0, q_ref.dtype).transpose(
+                    1, 0, 2
+                )
+                for r, sr in zip(kv_refs, s_refs)
+            ],
+            axis=1,
         )  # [Hkv, G*page, D]
         v = jnp.concatenate(
-            [r[0, 1, 0].transpose(1, 0, 2) for r in kv_refs], axis=1
+            [
+                _dequant_block(r[0, 1, 0], sr, 1, q_ref.dtype).transpose(
+                    1, 0, 2
+                )
+                for r, sr in zip(kv_refs, s_refs)
+            ],
+            axis=1,
         )
         s = jax.lax.dot_general(
             q4(), k,
@@ -465,13 +538,16 @@ def packed_ragged_attention(
     window: int = 0,
     group: int = 4,
     interpret: bool = False,
+    kv_scales: jax.Array | None = None,  # [L, 2, num_pages, page] int8 pool
 ) -> jax.Array:
     """Packed-layout ragged paged attention (see the section comment):
     one flat ``[Np]`` token axis, per-lane segment offsets, the same
     page-group-streaming grid as :func:`ragged_paged_attention`.  The
     packed operands live in VMEM for the whole launch, so ``Np`` (the
     mixed-dispatch token budget) bounds the resident footprint --
-    budgets into the low thousands of tokens fit comfortably."""
+    budgets into the low thousands of tokens fit comfortably.
+    ``kv_scales`` arms the fused int8 dequant, exactly as in the
+    rectangle kernel."""
     Np, Hq, D = q.shape
     L, _, num_pages, page, Hkv, _ = kv_pages.shape
     B, P = page_table.shape
@@ -479,14 +555,15 @@ def packed_ragged_attention(
     while P % G:
         G -= 1
     npg = P // G
+    quant = kv_scales is not None
 
     pt = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
     lyr = jnp.clip(jnp.asarray(layer, jnp.int32), 0, L - 1).reshape(1)
 
-    def kv_map(g):
+    def kv_map(g, ndim=6):
         def m(b, p, layer_ref, pt_ref, base_ref, off_ref, len_ref):
             pp = jnp.minimum(p, npg - 1)
-            return (layer_ref[0], 0, pt_ref[b, pp * G + g], 0, 0, 0)
+            return (layer_ref[0], 0, pt_ref[b, pp * G + g], 0, 0, 0)[:ndim]
 
         return m
 
@@ -494,12 +571,21 @@ def packed_ragged_attention(
         # the whole packed axis is one block, revisited every grid step
         return (0, 0, 0)
 
+    scale_specs = (
+        [
+            pl.BlockSpec((1, 2, 1, page), kv_map(g, ndim=4))
+            for g in range(G)
+        ]
+        if quant
+        else []
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(B, npg + 1),
         in_specs=[
             pl.BlockSpec((1, 2, 1, page, Hkv, D), kv_map(g)) for g in range(G)
         ]
+        + scale_specs
         + [
             pl.BlockSpec((Np, Hq, D), packed_map),
             pl.BlockSpec((Np, Hkv, D), packed_map),
@@ -512,14 +598,17 @@ def packed_ragged_attention(
             pltpu.VMEM((Hq * s_max, D), jnp.float32),
         ],
     )
+    scale_ops = [kv_scales] * G if quant else []
     return pl.pallas_call(
-        functools.partial(_packed_kernel, G=G, s_max=s_max, window=window),
+        functools.partial(
+            _packed_kernel, G=G, s_max=s_max, quant=quant, window=window
+        ),
         out_shape=jax.ShapeDtypeStruct((Np, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
     )(
         lyr, pt, base.astype(jnp.int32), seg_off.astype(jnp.int32),
-        q_lens.astype(jnp.int32), *([kv_pages] * G), q, k, v,
+        q_lens.astype(jnp.int32), *([kv_pages] * G), *scale_ops, q, k, v,
     )
 
 

@@ -114,6 +114,16 @@ def shard_params(params: Params, cfg: ModelConfig, mesh: Mesh) -> Params:
 
 
 def shard_kv(kv: jax.Array, cfg: ModelConfig, mesh: Mesh) -> jax.Array:
+    from ..engine.kv_cache import QuantKV
+
+    if isinstance(kv, QuantKV):
+        # int8 pool: data shards like the dense pool (kv heads over tp);
+        # the per-row scales carry no head axis and replicate
+        spec = _compatible_spec(kv_pspec(cfg), kv.q.shape, mesh)
+        return QuantKV(
+            q=jax.device_put(kv.q, NamedSharding(mesh, spec)),
+            s=jax.device_put(kv.s, NamedSharding(mesh, P())),
+        )
     spec = _compatible_spec(kv_pspec(cfg), kv.shape, mesh)
     return jax.device_put(kv, NamedSharding(mesh, spec))
 
@@ -266,7 +276,10 @@ def make_sharded_steps(
     from ..engine import step as _step
 
     param_sh = jax.tree_util.tree_map(lambda x: x.sharding, params)
-    kv_sh = kv_pages.sharding
+    # the KV pool may be a QuantKV pytree (int8 data + replicated row
+    # scales): harvest per-leaf, so the pinned in/out shardings follow
+    # whatever layout the pool was actually placed with
+    kv_sh = jax.tree_util.tree_map(lambda x: x.sharding, kv_pages)
     B = max_batch_size
     # the engine's whole device-resident decode state (tokens, seq_lens,
     # limit_lens, active, stop_ids, page_table, counts, SamplingParams

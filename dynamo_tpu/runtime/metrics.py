@@ -225,6 +225,13 @@ class EngineMetrics:
             "Fresh-token rows per unified mixed dispatch by accounting kind",
             ["kind"],  # used | dispatched | rectangle
         )
+        # packed-shape budget (ISSUE 13 satellite): active (Np, s_max)
+        # executable pairs the packed unified step may dispatch -- bounded
+        # by engine/bucketing.PackedShapeBudget's LRU/merge pass
+        self.executable_shapes = reg.gauge(
+            "dynamo_engine_executable_shapes",
+            "Active packed-dispatch (Np, s_max) executable shape pairs",
+        )
         if max_slots:
             self.slots.set(max_slots)
 
@@ -255,6 +262,9 @@ class EngineMetrics:
         self.kv_used.set(used)
         self.kv_total.set(total)
         self.kv_util.set(used / total if total else 0.0)
+
+    def observe_executable_shapes(self, n: int) -> None:
+        self.executable_shapes.set(n)
 
 
 class OffloadMetrics:

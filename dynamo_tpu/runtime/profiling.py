@@ -188,6 +188,17 @@ class _Tick:
             self.record.n_gaps += 1
             prof._observe_gap(gap)
 
+    def note_zero_gap(self) -> None:
+        """Results landed while ANOTHER dispatch was already queued on
+        device (the async pipeline's steady state): the device-idle gap
+        this sample represents is zero by construction, so record it as
+        such -- the gap_p50 series stays honest instead of timing a
+        ready->enqueue interval the device never idled through."""
+        self.record.n_gaps += 1
+        prof = self.profiler
+        prof._last_ready = None
+        prof._observe_gap(0.0)
+
     def discard(self) -> None:
         self.discarded = True
 

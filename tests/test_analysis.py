@@ -1263,6 +1263,93 @@ def test_cli_module_entrypoint():
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# DT013: blocking work on the tick thread outside the async-commit helpers
+# ---------------------------------------------------------------------------
+
+
+def test_dt013_blocking_calls_in_tick_module(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        TICK_COMMIT_HELPERS = ("_commit_all",)
+
+        def _dispatch_block(self):
+            mats = jax.device_get(self.handles)
+            self.kv.pages.block_until_ready()
+            return mats
+
+        def _run(self):
+            self.queue.put_nowait(42)
+            text = self.decoder.decode_stream()
+        """,
+        rules=["DT013"],
+        name="fixture_pkg/engine/engine.py",
+    )
+    assert rule_ids(findings) == ["DT013"] * 4
+
+
+def test_dt013_clean_twin_designated_helpers(tmp_path):
+    """The same calls inside TICK_COMMIT_HELPERS-listed functions are the
+    sanctioned shape (the designed sync/fanout points)."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        TICK_COMMIT_HELPERS = ("_commit_all", "_dispatch")
+
+        def _commit_all(self, entries):
+            mats = jax.device_get([e.sampled for e in entries])
+            return mats
+
+        def _dispatch(self, events):
+            for ev in events:
+                self.queue.put_nowait(ev)
+        """,
+        rules=["DT013"],
+        name="fixture_pkg/engine/engine.py",
+    )
+    assert findings == []
+
+
+def test_dt013_scope_is_tick_modules_only(tmp_path):
+    """Other modules (export workers, offload, tests) are out of scope --
+    the rule guards the tick thread, not every device_get in the repo."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        def helper(x):
+            return jax.device_get(x)
+        """,
+        rules=["DT013"],
+        name="fixture_pkg/engine/step.py",
+    )
+    assert findings == []
+
+
+def test_dt013_mocker_module_covered(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        TICK_COMMIT_HELPERS = ("_finish",)
+
+        def _simulate_tick(self):
+            self.queue.put_nowait(1)
+
+        def _finish(self, seq):
+            self.queue.put_nowait(None)
+        """,
+        rules=["DT013"],
+        name="fixture_pkg/mocker/engine.py",
+    )
+    assert rule_ids(findings) == ["DT013"]
+
+
 def test_repo_is_dynalint_clean():
     """Zero non-baselined DT001-DT012 violations across dynamo_tpu/.
 

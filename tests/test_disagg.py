@@ -597,7 +597,7 @@ def test_prefill_export_stream_matches_monolithic(run):
     run(body())
 
 
-async def _wire_disagg_tokens(prompt, max_tokens, chunked):
+async def _wire_disagg_tokens(prompt, max_tokens, chunked, **engine_kw):
     """Full wire-path disagg stack (decode + prefill worker over a hub);
     returns (tokens, transfer stats row list)."""
     hub = HubServer()
@@ -605,7 +605,7 @@ async def _wire_disagg_tokens(prompt, max_tokens, chunked):
     addr = f"{host}:{port}"
     drt = await DistributedRuntime.detached(addr)
     dns = drt.namespace("disagg")
-    decode_engine = make_engine()
+    decode_engine = make_engine(**engine_kw)
     disagg = DisaggDecodeEngine(
         decode_engine, dns, "decode", instance_id=drt.primary_lease,
         cfg=DisaggConfig(max_local_prefill_length=8), block_size=4,
@@ -614,7 +614,7 @@ async def _wire_disagg_tokens(prompt, max_tokens, chunked):
         disagg.kv_deliver_handler()
     )
     prt = await DistributedRuntime.detached(addr)
-    prefill_engine = make_engine()
+    prefill_engine = make_engine(**engine_kw)
     pw = PrefillWorker(
         prefill_engine, prt.namespace("disagg"), allow_local=False,
         chunked=chunked, layers_per_chunk=1,
@@ -659,6 +659,33 @@ def test_chunked_wire_delivery_is_bit_identical_to_monolithic(run):
         assert stats_c and stats_c[0]["chunks"] == 2
         assert "overlap_ratio" in stats_c[0]
         assert stats_m and "chunks" not in stats_m[0]
+
+    run(body())
+
+
+def test_int8_pool_wire_delivery_matches_aggregated(run):
+    """ISSUE 13: the disagg wire carries an int8 pool's (data, scales)
+    pair -- chunked AND monolithic framing -- and decode output equals
+    aggregated int8 serving (the quantized-domain exactness contract at
+    the full-stack level)."""
+
+    async def body():
+        prompt = [7, 3, 7, 3, 5, 5, 9, 1, 2, 8, 4, 6]
+        agg = make_engine(kv_dtype="int8")
+        try:
+            expect, _ = await collect(agg, req(prompt, max_tokens=6))
+        finally:
+            await agg.stop()
+        got_chunked, stats_c = await _wire_disagg_tokens(
+            prompt, 6, True, kv_dtype="int8"
+        )
+        got_mono, _stats_m = await _wire_disagg_tokens(
+            prompt, 6, False, kv_dtype="int8"
+        )
+        assert got_chunked == expect
+        assert got_mono == expect
+        # the chunked leg really streamed (pipeline stats recorded)
+        assert stats_c and stats_c[0]["bytes"] > 0
 
     run(body())
 

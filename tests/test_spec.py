@@ -390,6 +390,11 @@ def _pressure_engine(num_pages: int, **kw):
         num_pages=num_pages,
         host_offload_blocks=32,
         swap_preemption=True,
+        # serial tick loop: these tests assert preemption actually fires,
+        # which needs deterministic growth-vs-commit pacing (see
+        # test_offload._pressure_engine); async-mode preemption identity
+        # is covered in test_async_dispatch.py / test_kv_int8.py
+        async_dispatch=False,
     )
     defaults.update(kw)
     return JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**defaults))
