@@ -2,8 +2,10 @@
 
 The serving stack's hazard classes are mechanical -- a blocking call on an
 event loop, a silent ``except Exception`` around a KV transfer, a host
-sync on the tick loop -- so they are checked mechanically: six AST rules
-(DT001-DT010), inline ``# dynalint: disable=RULE`` suppressions, a
+sync on the tick loop, an attribute shared across threads without a lock
+-- so they are checked mechanically: AST rules DT001-DT016 (DT014-DT016
+are interprocedural, built on a project-wide call graph + thread-role
+inference), inline ``# dynalint: disable=RULE`` suppressions, a
 checked-in baseline for grandfathered findings, and a CLI
 (``python -m dynamo_tpu.analysis``) that tier-1 runs as a zero-violation
 gate.  Stdlib-only by design.
@@ -12,14 +14,18 @@ Public surface:
 
 * :func:`dynamo_tpu.analysis.hotpath.hot_path` -- mark a serving-critical
   function for DT004/DT005 (imported by engine code; pure annotation).
+* :data:`dynamo_tpu.analysis.threads.THREAD_ROLE_MANIFEST` -- thread roles
+  inference cannot pin (DT014-DT016); the role model's single source of
+  truth, validated at runtime by ``runtime/thread_sentry.py``.
 * :class:`Analyzer`, :class:`Baseline`, :data:`ALL_RULES` -- programmatic
   use (the tier-1 gate test drives these directly).
 * :func:`dynamo_tpu.analysis.cli.run` -- the CLI.
 """
 
-from .core import Analyzer, Baseline, Finding, ModuleInfo, Rule
+from .core import Analyzer, Baseline, Finding, ModuleInfo, ProjectRule, Rule
 from .hotpath import HOT_PATH_MANIFEST, hot_path
 from .rules import ALL_RULES, get_rules
+from .threads import THREAD_ROLE_MANIFEST
 
 __all__ = [
     "ALL_RULES",
@@ -28,7 +34,9 @@ __all__ = [
     "Finding",
     "HOT_PATH_MANIFEST",
     "ModuleInfo",
+    "ProjectRule",
     "Rule",
+    "THREAD_ROLE_MANIFEST",
     "get_rules",
     "hot_path",
 ]

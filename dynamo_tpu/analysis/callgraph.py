@@ -1,0 +1,606 @@
+"""Project-wide call graph for dynalint's interprocedural rules.
+
+Everything before this module inspected one file at a time; the thread-role
+and race rules (DT014-DT016, ``analysis/threads.py``) need to answer *which
+function can call which* across the whole package: the kv-offload engine
+submits ``self.host.get`` to its worker, the tick loop awaits executor
+hops into ``engine/engine.py`` helpers, and a role inferred at one entry
+point must flow through those edges.
+
+:class:`ProjectIndex` is the shared parse: every module is loaded ONCE
+(through a process-level cache keyed on path + mtime, so the three tier-1
+repo gates do not re-tokenize ~150 files each) and every rule -- per-module
+or project-wide -- reads the same :class:`~.core.ModuleInfo` objects.
+
+Resolution is deliberately conservative (stdlib ``ast`` only, no imports
+executed): a call resolves to a function only when the evidence is local
+and unambiguous --
+
+* bare names: nested defs in the caller, then ``from x import name``
+  symbols, then module-level functions/classes of the caller's module;
+* ``self.meth()`` / ``cls.meth()``: methods of the caller's class,
+  following base classes resolvable by name;
+* ``alias.fn()`` where ``alias`` is an imported module of this project;
+* ``self.attr.meth()`` / ``var.meth()`` where the attribute or local was
+  assigned ``ClassName(...)`` and ``ClassName`` resolves in this project;
+* ``functools.partial(f, ...)`` peels to ``f``; calling a class resolves
+  to its ``__init__``.
+
+Anything else (duck-typed handles, call results, foreign libraries)
+resolves to nothing -- under-approximation keeps role propagation from
+smearing every role onto every function.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import ModuleInfo, load_module
+
+__all__ = [
+    "FunctionNode",
+    "ClassInfo",
+    "ProjectIndex",
+    "dotted",
+    "peel_partial",
+    "own_scope_walk",
+]
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Attribute chains over a Name base; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def peel_partial(node: ast.AST) -> ast.AST:
+    """``functools.partial(f, ...)`` -> ``f`` (recursively); identity for
+    anything else.  Thread targets are routinely partial-wrapped."""
+    while (
+        isinstance(node, ast.Call)
+        and dotted(node.func) in ("partial", "functools.partial")
+        and node.args
+    ):
+        node = node.args[0]
+    return node
+
+
+def own_scope_walk(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's own statements without descending into nested
+    def/lambda scopes (those are separate :class:`FunctionNode`\\ s with
+    their own roles)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FunctionNode:
+    """One function/method definition anywhere in the project."""
+
+    relpath: str
+    qualname: str  # dotted within the module, e.g. "HostTier.get"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    cls: Optional[str]  # enclosing class name, if a method
+    parent_qual: str = ""  # enclosing function qualname ("" = top scope)
+
+    @property
+    def key(self) -> str:
+        return f"{self.relpath}::{self.qualname}"
+
+    @property
+    def name(self) -> str:
+        return self.node.name  # type: ignore[attr-defined]
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+    def decorator_names(self) -> List[str]:
+        out = []
+        for dec in self.node.decorator_list:  # type: ignore[attr-defined]
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            d = dotted(target)
+            if d is not None:
+                out.append(d)
+        return out
+
+
+# constructor dotted-name -> handoff kind, for attributes whose *type*
+# already implies a safe cross-thread discipline (DT014 exempts them)
+THREAD_SAFE_CTORS: Dict[str, str] = {
+    "queue.Queue": "queue",
+    "queue.SimpleQueue": "queue",
+    "queue.LifoQueue": "queue",
+    "queue.PriorityQueue": "queue",
+    "asyncio.Queue": "queue",
+    "asyncio.Event": "event",
+    "asyncio.Lock": "lock",
+    "asyncio.Condition": "lock",
+    "asyncio.Semaphore": "lock",
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "threading.Condition": "lock",
+    "threading.Semaphore": "lock",
+    "threading.BoundedSemaphore": "lock",
+    "threading.Event": "event",
+    "threading.local": "tls",
+    "concurrent.futures.ThreadPoolExecutor": "executor",
+    "ThreadPoolExecutor": "executor",
+}
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+
+# mutable-container evidence for DT015 (publication hazard) and DT014
+MUTABLE_CONTAINER_CTORS = {
+    "list": "list",
+    "dict": "dict",
+    "set": "set",
+    "collections.deque": "deque",
+    "deque": "deque",
+    "collections.defaultdict": "dict",
+    "defaultdict": "dict",
+    "collections.OrderedDict": "dict",
+    "OrderedDict": "dict",
+    "collections.Counter": "dict",
+}
+
+
+@dataclass
+class ClassInfo:
+    """Per-class facts the thread rules need: methods, attribute types
+    (``self.x = Ctor(...)``), lock attributes, executor attributes (and
+    their ``thread_name_prefix``), and mutable-container attributes."""
+
+    relpath: str
+    name: str
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)
+    methods: Dict[str, FunctionNode] = field(default_factory=dict)
+    # attr -> dotted constructor name of the LAST 'self.attr = Ctor(...)'
+    attr_ctors: Dict[str, str] = field(default_factory=dict)
+    lock_attrs: Set[str] = field(default_factory=set)
+    safe_attrs: Set[str] = field(default_factory=set)  # THREAD_SAFE_CTORS
+    executor_attrs: Dict[str, str] = field(default_factory=dict)  # attr->prefix
+    container_attrs: Dict[str, str] = field(default_factory=dict)  # attr->kind
+
+    @property
+    def key(self) -> str:
+        return f"{self.relpath}::{self.name}"
+
+
+# ---------------------------------------------------------------------------
+# Import maps
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ImportMap:
+    # local name -> module relpath within the project
+    module_aliases: Dict[str, str] = field(default_factory=dict)
+    # local name -> (module relpath, symbol name)
+    symbols: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+
+def _module_parts(relpath: str) -> List[str]:
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return parts
+
+
+def _to_relpath(parts: Sequence[str], known: Set[str]) -> Optional[str]:
+    """Dotted-module parts -> project relpath, trying plain module then
+    package ``__init__``."""
+    if not parts:
+        return None
+    plain = "/".join(parts) + ".py"
+    if plain in known:
+        return plain
+    pkg = "/".join(parts) + "/__init__.py"
+    if pkg in known:
+        return pkg
+    return None
+
+
+def build_import_map(module: ModuleInfo, known: Set[str]) -> ImportMap:
+    out = ImportMap()
+    pkg = _module_parts(module.relpath)[:-1]  # package containing the module
+    if module.relpath.endswith("/__init__.py"):
+        pkg = _module_parts(module.relpath)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                parts = a.name.split(".")
+                rel = _to_relpath(parts, known)
+                if rel is None:
+                    continue
+                if a.asname:
+                    out.module_aliases[a.asname] = rel
+                else:
+                    # ``import a.b`` binds ``a``: map the top-level package
+                    # (deep attribute paths are out of resolution scope)
+                    top = _to_relpath(parts[:1], known)
+                    if top is not None:
+                        out.module_aliases[parts[0]] = top
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg[: len(pkg) - (node.level - 1)] if node.level > 1 else pkg
+                if node.level - 1 > len(pkg):
+                    continue
+            else:
+                base = []
+            base = list(base) + (node.module.split(".") if node.module else [])
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                # imported name may itself be a submodule ...
+                sub = _to_relpath(base + [a.name], known)
+                if sub is not None:
+                    out.module_aliases[local] = sub
+                    continue
+                # ... or a symbol inside the base module
+                rel = _to_relpath(base, known)
+                if rel is not None:
+                    out.symbols[local] = (rel, a.name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The index
+# ---------------------------------------------------------------------------
+
+# process-level ModuleInfo cache: (abspath, root) -> (mtime_ns, size, info).
+# The tier-1 suite runs three repo-wide gates plus dozens of fixture lints;
+# without this every gate re-reads and re-tokenizes the whole package.
+_MODULE_CACHE: Dict[Tuple[str, str], Tuple[int, int, ModuleInfo]] = {}
+
+
+def load_module_cached(abspath: str, root: str) -> Optional[ModuleInfo]:
+    st = os.stat(abspath)
+    key = (abspath, root)
+    hit = _MODULE_CACHE.get(key)
+    if hit is not None and hit[0] == st.st_mtime_ns and hit[1] == st.st_size:
+        return hit[2]
+    info = load_module(abspath, root)
+    if info is not None:
+        _MODULE_CACHE[key] = (st.st_mtime_ns, st.st_size, info)
+    return info
+
+
+class ProjectIndex:
+    """All parsed modules of one analyzer run plus the cross-module maps:
+    functions, classes, imports, and call resolution."""
+
+    def __init__(self, modules: Sequence[ModuleInfo], root: str) -> None:
+        self.root = root
+        self.modules: Dict[str, ModuleInfo] = {m.relpath: m for m in modules}
+        known = set(self.modules)
+        self.functions: Dict[str, FunctionNode] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        # per-module: class name -> ClassInfo (top-level classes)
+        self._module_classes: Dict[str, Dict[str, ClassInfo]] = {}
+        self._module_funcs: Dict[str, Dict[str, FunctionNode]] = {}
+        self.imports: Dict[str, ImportMap] = {}
+        for m in modules:
+            self._index_module(m)
+        for m in modules:
+            self.imports[m.relpath] = build_import_map(m, known)
+        # memo for per-function local constructor types
+        self._local_types: Dict[str, Dict[str, str]] = {}
+
+    # -- construction ------------------------------------------------------
+
+    def _index_module(self, module: ModuleInfo) -> None:
+        rel = module.relpath
+        self._module_classes[rel] = {}
+        self._module_funcs[rel] = {}
+
+        def walk(node: ast.AST, prefix: str, cls: Optional[str],
+                 parent: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    fn = FunctionNode(rel, qn, child, cls, parent)
+                    self.functions[fn.key] = fn
+                    if prefix == "":
+                        self._module_funcs[rel][child.name] = fn
+                    walk(child, qn + ".", cls, qn)
+                elif isinstance(child, ast.ClassDef):
+                    if prefix == "":
+                        ci = self._build_class(rel, child)
+                        self.classes[ci.key] = ci
+                        self._module_classes[rel][child.name] = ci
+                        for name, m in ci.methods.items():
+                            self.functions[m.key] = m
+                            walk(m.node, m.qualname + ".", child.name,
+                                 m.qualname)
+                    else:
+                        walk(child, f"{prefix}{child.name}.", child.name,
+                             parent)
+                else:
+                    walk(child, prefix, cls, parent)
+
+        walk(module.tree, "", None, "")
+
+    def _build_class(self, rel: str, node: ast.ClassDef) -> ClassInfo:
+        ci = ClassInfo(
+            relpath=rel, name=node.name, node=node,
+            bases=[d for d in (dotted(b) for b in node.bases) if d],
+        )
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{node.name}.{child.name}"
+                ci.methods[child.name] = FunctionNode(
+                    rel, qn, child, node.name, ""
+                )
+        # attribute facts: every 'self.attr = <expr>' in any method
+        for m in ci.methods.values():
+            for sub in ast.walk(m.node):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                value = sub.value
+                if value is None:
+                    continue
+                for t in targets:
+                    if not (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        continue
+                    self._note_attr(ci, t.attr, value)
+        return ci
+
+    @staticmethod
+    def _note_attr(ci: ClassInfo, attr: str, value: ast.AST) -> None:
+        if isinstance(value, ast.IfExp):
+            # 'self._io = ThreadPoolExecutor(...) if path else None': the
+            # informative arm is the constructor call
+            for arm in (value.body, value.orelse):
+                if isinstance(arm, ast.Call):
+                    value = arm
+                    break
+        if isinstance(value, (ast.List, ast.ListComp)):
+            ci.container_attrs[attr] = "list"
+            return
+        if isinstance(value, (ast.Dict, ast.DictComp)):
+            ci.container_attrs[attr] = "dict"
+            return
+        if isinstance(value, (ast.Set, ast.SetComp)):
+            ci.container_attrs[attr] = "set"
+            return
+        if not isinstance(value, ast.Call):
+            return
+        d = dotted(value.func)
+        if d is None:
+            return
+        ci.attr_ctors[attr] = d
+        tail = d.rpartition(".")[2]
+        if d in _LOCK_CTORS:
+            ci.lock_attrs.add(attr)
+        if d in THREAD_SAFE_CTORS or tail in (
+            "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+        ):
+            ci.safe_attrs.add(attr)
+        if d in MUTABLE_CONTAINER_CTORS:
+            ci.container_attrs[attr] = MUTABLE_CONTAINER_CTORS[d]
+        if tail == "ThreadPoolExecutor":
+            prefix = ""
+            for kw in value.keywords:
+                if kw.arg == "thread_name_prefix" and isinstance(
+                    kw.value, ast.Constant
+                ):
+                    prefix = str(kw.value.value)
+            ci.executor_attrs[attr] = prefix
+
+    # -- lookups -----------------------------------------------------------
+
+    def module_function(self, rel: str, name: str) -> Optional[FunctionNode]:
+        return self._module_funcs.get(rel, {}).get(name)
+
+    def module_class(self, rel: str, name: str) -> Optional[ClassInfo]:
+        return self._module_classes.get(rel, {}).get(name)
+
+    def class_of(self, fn: FunctionNode) -> Optional[ClassInfo]:
+        if fn.cls is None:
+            return None
+        return self.module_class(fn.relpath, fn.cls)
+
+    def resolve_symbol(
+        self, rel: str, name: str
+    ) -> Tuple[Optional[FunctionNode], Optional[ClassInfo]]:
+        """A bare name in module ``rel``: local function, imported symbol
+        (followed one hop), or local class."""
+        fn = self.module_function(rel, name)
+        if fn is not None:
+            return fn, None
+        ci = self.module_class(rel, name)
+        if ci is not None:
+            return None, ci
+        imp = self.imports.get(rel)
+        if imp is not None:
+            sym = imp.symbols.get(name)
+            if sym is not None:
+                target_rel, target_name = sym
+                fn = self.module_function(target_rel, target_name)
+                if fn is not None:
+                    return fn, None
+                ci = self.module_class(target_rel, target_name)
+                if ci is not None:
+                    return None, ci
+        return None, None
+
+    def _class_method(
+        self, ci: ClassInfo, name: str, _seen: Optional[Set[str]] = None
+    ) -> Optional[FunctionNode]:
+        """Method lookup following base classes resolvable by name."""
+        seen = _seen or set()
+        if ci.key in seen:
+            return None
+        seen.add(ci.key)
+        m = ci.methods.get(name)
+        if m is not None:
+            return m
+        for base in ci.bases:
+            tail = base.rpartition(".")[2]
+            _, base_ci = self.resolve_symbol(ci.relpath, tail)
+            if base_ci is not None:
+                m = self._class_method(base_ci, name, seen)
+                if m is not None:
+                    return m
+        return None
+
+    def resolve_ctor_name(
+        self, rel: str, ctor: str
+    ) -> Optional[ClassInfo]:
+        """A dotted constructor name as it appears at an assignment site
+        ('HostTier', 'offload.KVOffloadEngine') -> its ClassInfo."""
+        if "." not in ctor:
+            _, ci = self.resolve_symbol(rel, ctor)
+            return ci
+        base, _, last = ctor.rpartition(".")
+        imp = self.imports.get(rel)
+        if imp is not None and base in imp.module_aliases:
+            return self.module_class(imp.module_aliases[base], last)
+        return None
+
+    def _locals_of(self, fn: FunctionNode) -> Dict[str, str]:
+        """Local var -> dotted constructor name, for ``v = Ctor(...)``
+        assignments in the function's own scope."""
+        memo = self._local_types.get(fn.key)
+        if memo is not None:
+            return memo
+        out: Dict[str, str] = {}
+        for node in own_scope_walk(fn.node):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                d = dotted(node.value.func)
+                if d is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = d
+        self._local_types[fn.key] = out
+        return out
+
+    # -- call resolution ---------------------------------------------------
+
+    def resolve_callable(
+        self, expr: ast.AST, caller: FunctionNode
+    ) -> Optional[FunctionNode]:
+        """Resolve a *callable expression* (a call's func, or a function
+        handle passed as a thread target) to its FunctionNode, or None."""
+        expr = peel_partial(expr)
+        if isinstance(expr, ast.Lambda):
+            return None  # caller handles lambdas (anonymous scope)
+        d = dotted(expr)
+        if d is None:
+            return None
+        parts = d.split(".")
+        rel = caller.relpath
+        if len(parts) == 1:
+            name = parts[0]
+            # a nested def in the caller (or an enclosing scope's nested def)
+            nested = self.functions.get(f"{rel}::{caller.qualname}.{name}")
+            if nested is not None:
+                return nested
+            if caller.parent_qual:
+                nested = self.functions.get(
+                    f"{rel}::{caller.parent_qual}.{name}"
+                )
+                if nested is not None:
+                    return nested
+            fn, ci = self.resolve_symbol(rel, name)
+            if fn is not None:
+                return fn
+            if ci is not None:
+                return self._class_method(ci, "__init__")
+            return None
+        base, meth = parts[0], parts[-1]
+        if base in ("self", "cls") and caller.cls is not None:
+            ci = self.class_of(caller)
+            if ci is None:
+                return None
+            if len(parts) == 2:
+                return self._class_method(ci, meth)
+            if len(parts) == 3:
+                ctor = ci.attr_ctors.get(parts[1])
+                if ctor is not None:
+                    tci = self.resolve_ctor_name(rel, ctor)
+                    if tci is not None:
+                        return self._class_method(tci, meth)
+            return None
+        if len(parts) == 2:
+            # imported module's function / class
+            imp = self.imports.get(rel)
+            if imp is not None and base in imp.module_aliases:
+                target_rel = imp.module_aliases[base]
+                fn = self.module_function(target_rel, meth)
+                if fn is not None:
+                    return fn
+                tci = self.module_class(target_rel, meth)
+                if tci is not None:
+                    return self._class_method(tci, "__init__")
+                return None
+            # ClassName.method (unbound) or typed local: v = Ctor(...)
+            _, ci = self.resolve_symbol(rel, base)
+            if ci is not None:
+                return self._class_method(ci, meth)
+            ctor = self._locals_of(caller).get(base)
+            if ctor is not None:
+                tci = self.resolve_ctor_name(rel, ctor)
+                if tci is not None:
+                    return self._class_method(tci, meth)
+        return None
+
+    def callees(self, fn: FunctionNode) -> List[FunctionNode]:
+        """Directly-called project functions from ``fn``'s own scope."""
+        out: List[FunctionNode] = []
+        seen: Set[str] = set()
+        for node in own_scope_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self.resolve_callable(node.func, fn)
+            if target is not None and target.key not in seen:
+                seen.add(target.key)
+                out.append(target)
+        return out
+
+
+def build_index(paths: Sequence[str], root: str) -> ProjectIndex:
+    """Parse (with cache) every python file under ``paths`` into one
+    ProjectIndex."""
+    from .core import iter_python_files
+
+    modules: List[ModuleInfo] = []
+    for path in iter_python_files(paths):
+        try:
+            info = load_module_cached(os.path.abspath(path), root)
+        except (OSError, SyntaxError, ValueError):
+            continue  # the analyzer reports parse errors separately
+        if info is not None:
+            modules.append(info)
+    return ProjectIndex(modules, root)

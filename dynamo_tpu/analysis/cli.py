@@ -3,7 +3,10 @@
 Exit status is the CI contract: 0 when no non-baselined findings, 1 when
 any remain, 2 on usage / unreadable-source errors.  ``--format json``
 emits a stable machine-readable report (sorted findings, schema versioned)
-for future CI consumption.
+for future CI consumption; with ``--baseline`` it also audits the baseline
+(which fingerprints were consumed, which are stale and prunable).
+``--only DT014,DT015 --changed`` is the fast local loop: one rule family
+over just the files changed vs ``git merge-base HEAD main``.
 """
 
 from __future__ import annotations
@@ -11,13 +14,22 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional, Sequence
 
 from .core import Analyzer, Baseline, Finding
 from .rules import ALL_RULES, get_rules
 
-JSON_SCHEMA_VERSION = 1
+JSON_SCHEMA_VERSION = 2
+
+_EXIT_CODES_HELP = """\
+exit codes:
+  0   no findings beyond the baseline (the gate is green)
+  1   at least one non-baselined finding
+  2   usage error, unknown rule id, unreadable source, or git failure
+      (--changed outside a work tree)
+"""
 
 
 def _default_target() -> str:
@@ -29,7 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dynamo_tpu.analysis",
         description="dynalint: AST hazard analysis for async/JAX hot paths "
-                    "(rules DT001-DT010)",
+                    "and cross-thread state (rules DT001-DT016)",
+        epilog=_EXIT_CODES_HELP,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument(
         "paths", nargs="*",
@@ -56,8 +70,16 @@ def build_parser() -> argparse.ArgumentParser:
              "--baseline) and exit 0",
     )
     p.add_argument(
-        "--select", default=None, metavar="DT001,DT003",
-        help="comma-separated rule ids to run (default: all)",
+        "--only", "--select", default=None, metavar="DT001,DT003",
+        dest="only",
+        help="comma-separated rule ids to run (default: all); --select is "
+             "the historical alias",
+    )
+    p.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed vs 'git merge-base HEAD main' "
+             "(committed + working tree) under the given paths -- the "
+             "fast local loop; exits 0 when nothing relevant changed",
     )
     p.add_argument(
         "--list-rules", action="store_true",
@@ -93,7 +115,7 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     try:
-        rules = get_rules(args.select.split(",") if args.select else None)
+        rules = get_rules(args.only.split(",") if args.only else None)
     except ValueError as e:
         print(f"dynalint: {e}", file=sys.stderr)
         return 2
@@ -104,8 +126,25 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         print(f"dynalint: no such path: {missing}", file=sys.stderr)
         return 2
 
-    analyzer = Analyzer(rules, root=_resolve_root(paths, args.root))
-    findings = analyzer.analyze_paths(paths)
+    root = _resolve_root(paths, args.root)
+    context_paths: Optional[List[str]] = None
+    if args.changed:
+        try:
+            changed = _changed_paths(paths, root)
+        except (OSError, subprocess.SubprocessError) as e:
+            print(f"dynalint: --changed needs git: {e}", file=sys.stderr)
+            return 2
+        if not changed:
+            if not args.quiet:
+                print("dynalint: no changed python files vs merge-base")
+            return 0
+        # interprocedural rules still analyze the ORIGINAL paths (roles
+        # resolve through unchanged modules); only reporting narrows
+        context_paths = list(paths)
+        paths = changed
+
+    analyzer = Analyzer(rules, root=root)
+    findings = analyzer.analyze_paths(paths, context_paths=context_paths)
 
     if args.write_baseline:
         if not args.baseline:
@@ -121,14 +160,16 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     baselined = 0
+    audit: Optional[dict] = None
     if args.baseline and os.path.exists(args.baseline):
         baseline = Baseline.load(args.baseline)
-        kept = baseline.filter(findings)
+        kept, used, stale = baseline.audit(findings)
         baselined = len(findings) - len(kept)
         findings = kept
+        audit = {"used": used, "stale": stale}
 
     if args.fmt == "json":
-        print(_render_json(findings, analyzer.errors, baselined))
+        print(_render_json(findings, analyzer.errors, baselined, audit))
     else:
         for f in findings:
             print(f.render())
@@ -145,8 +186,52 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
     return 1 if findings else 0
 
 
+def _changed_paths(paths: Sequence[str], root: str) -> List[str]:
+    """Python files under ``paths`` changed vs ``git merge-base HEAD main``
+    (committed AND working-tree edits)."""
+    # git prints paths relative to the work-tree TOPLEVEL, which need not
+    # be the analyzer root (linting a subdirectory): join against it
+    toplevel = subprocess.run(
+        ["git", "-C", root, "rev-parse", "--show-toplevel"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    base = subprocess.run(
+        ["git", "-C", toplevel, "merge-base", "HEAD", "main"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    # run the listings FROM the toplevel: ls-files prints cwd-relative
+    # paths (unlike diff --name-only), so anchoring both there keeps
+    # every path toplevel-relative
+    diff = subprocess.run(
+        ["git", "-C", toplevel, "diff", "--name-only", "-z", base],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    # untracked files are changes too (a brand-new module must not dodge
+    # the fast loop)
+    diff += subprocess.run(
+        ["git", "-C", toplevel, "ls-files", "--others",
+         "--exclude-standard", "-z"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    wanted = [os.path.abspath(p) for p in paths]
+    out: List[str] = []
+    for rel in sorted(set(filter(None, diff.split("\0")))):
+        if not rel.endswith(".py"):
+            continue
+        ab = os.path.join(toplevel, rel)
+        if not os.path.exists(ab):
+            continue  # deleted file
+        if any(
+            ab == w or ab.startswith(w.rstrip(os.sep) + os.sep)
+            for w in wanted
+        ):
+            out.append(ab)
+    return sorted(out)
+
+
 def _render_json(
-    findings: List[Finding], errors: List[str], baselined: int
+    findings: List[Finding], errors: List[str], baselined: int,
+    audit: Optional[dict] = None,
 ) -> str:
     by_rule: dict = {}
     for f in findings:
@@ -161,4 +246,12 @@ def _render_json(
             "parse_errors": errors,
         },
     }
+    if audit is not None:
+        # the audit makes checked-in baselines prunable without re-deriving
+        # hashes: "used" fingerprints are still earning their keep, "stale"
+        # ones match nothing and can be deleted from the baseline file
+        doc["baseline"] = {
+            "used": dict(sorted(audit["used"].items())),
+            "stale": dict(sorted(audit["stale"].items())),
+        }
     return json.dumps(doc, indent=2, sort_keys=True)

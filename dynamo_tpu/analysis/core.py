@@ -212,6 +212,19 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """A rule that needs the WHOLE analyzed file set at once (call graph,
+    thread roles).  The analyzer runs :meth:`check_project` exactly once
+    per run over the shared :class:`~.callgraph.ProjectIndex` -- every
+    project rule reads the same single parse."""
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        return iter(())  # project rules contribute nothing per-module
+
+    def check_project(self, index) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
 # ---------------------------------------------------------------------------
 # Baseline
 # ---------------------------------------------------------------------------
@@ -268,15 +281,28 @@ class Baseline:
     def filter(self, findings: Sequence[Finding]) -> List[Finding]:
         """Drop findings the baseline grandfathers (up to the recorded
         count per fingerprint); everything beyond is returned as new."""
+        return self.audit(findings)[0]
+
+    def audit(
+        self, findings: Sequence[Finding]
+    ) -> Tuple[List[Finding], Dict[str, int], Dict[str, int]]:
+        """Like :meth:`filter`, but also report how the baseline was
+        consumed: ``(fresh, used, stale)`` where ``used`` maps fingerprint
+        -> grandfathered occurrences actually matched this run and
+        ``stale`` maps fingerprint -> recorded-but-unmatched count (the
+        entries a baseline prune can delete)."""
         budget = dict(self.counts)
+        used: Dict[str, int] = {}
         fresh: List[Finding] = []
         for f in findings:
             fp = f.fingerprint
             if budget.get(fp, 0) > 0:
                 budget[fp] -= 1
+                used[fp] = used.get(fp, 0) + 1
             else:
                 fresh.append(f)
-        return fresh
+        stale = {fp: n for fp, n in budget.items() if n > 0}
+        return fresh, used, stale
 
 
 # ---------------------------------------------------------------------------
@@ -299,20 +325,102 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
             yield p
 
 
+# ProjectIndex cache: keyed on the identity of the (already-cached)
+# ModuleInfo objects, so the three repo-wide tier-1 gates build the call
+# graph once instead of once per test.  A module edit mints a fresh
+# ModuleInfo in the module cache, which changes the key and invalidates
+# the index naturally.
+_INDEX_CACHE: Dict[Tuple[str, Tuple[int, ...]], object] = {}
+
+
+def _cached_index(modules: Sequence[ModuleInfo], root: str):
+    from .callgraph import ProjectIndex
+
+    key = (root, tuple(sorted(id(m) for m in modules)))
+    index = _INDEX_CACHE.get(key)
+    if index is None:
+        index = ProjectIndex(modules, root)
+        if len(_INDEX_CACHE) > 16:
+            _INDEX_CACHE.clear()
+        _INDEX_CACHE[key] = index
+    return index
+
+
 class Analyzer:
     def __init__(self, rules: Sequence[Rule], root: Optional[str] = None):
         self.rules = list(rules)
         self.root = os.path.abspath(root) if root else os.getcwd()
         self.errors: List[str] = []  # unparseable files
 
-    def analyze_paths(self, paths: Sequence[str]) -> List[Finding]:
+    def analyze_paths(
+        self,
+        paths: Sequence[str],
+        context_paths: Optional[Sequence[str]] = None,
+    ) -> List[Finding]:
+        """One shared parse for everything: every module loads once (via
+        the process-level cache) and both the per-module rules and the
+        project-wide rules (:class:`ProjectRule`) read the same
+        :class:`ModuleInfo` objects.
+
+        ``context_paths`` widens the *analysis* scope without widening the
+        *reporting* scope: the interprocedural rules build their call
+        graph and thread roles over ``context_paths`` (so a ``--changed``
+        fast loop over one file still resolves roles through the rest of
+        the package) while findings are reported only for ``paths``."""
+        from .callgraph import load_module_cached
+
+        def load(targets: Sequence[str]) -> List[ModuleInfo]:
+            out: List[ModuleInfo] = []
+            for path in iter_python_files(targets):
+                try:
+                    module = load_module_cached(
+                        os.path.abspath(path), self.root
+                    )
+                except (OSError, SyntaxError, ValueError) as e:
+                    self.errors.append(f"{path}: {e}")
+                    continue
+                if module is not None:
+                    out.append(module)
+            return out
+
+        modules = load(paths)
+
+        module_rules = [
+            r for r in self.rules if not isinstance(r, ProjectRule)
+        ]
+        project_rules = [r for r in self.rules if isinstance(r, ProjectRule)]
+
         findings: List[Finding] = []
-        for path in iter_python_files(paths):
-            findings.extend(self.analyze_file(path))
+        for module in modules:
+            for rule in module_rules:
+                for finding in rule.check(module):
+                    if not module.is_suppressed(finding.rule, finding.line):
+                        findings.append(finding)
+        if project_rules:
+            by_rel = {m.relpath: m for m in modules}
+            index_modules = modules
+            if context_paths is not None:
+                seen = set(by_rel)
+                index_modules = list(modules)
+                for m in load(context_paths):
+                    if m.relpath not in seen:
+                        seen.add(m.relpath)
+                        index_modules.append(m)
+            index = _cached_index(index_modules, self.root)
+            for rule in project_rules:
+                for finding in rule.check_project(index):
+                    module = by_rel.get(finding.path)
+                    if module is None:
+                        continue  # context-only module: not in report scope
+                    if module.is_suppressed(finding.rule, finding.line):
+                        continue
+                    findings.append(finding)
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
         return findings
 
     def analyze_file(self, path: str) -> List[Finding]:
+        """Per-module rules over one file (project rules need
+        :meth:`analyze_paths`, which sees the whole file set)."""
         try:
             module = load_module(os.path.abspath(path), self.root)
         except (OSError, SyntaxError, ValueError) as e:

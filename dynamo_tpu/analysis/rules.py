@@ -1,4 +1,4 @@
-"""dynalint rules DT001-DT013: this repo's real async/JAX hazard classes.
+"""dynalint rules DT001-DT016: this repo's real async/JAX hazard classes.
 
 Each rule is deliberately narrow: it encodes a bug class this codebase has
 actually exhibited (blocking WAL I/O on the hub event loop, silent
@@ -14,7 +14,7 @@ import ast
 import fnmatch
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
-from .core import Finding, ModuleInfo, Rule
+from .core import Finding, ModuleInfo, ProjectRule, Rule
 from .hotpath import HOT_PATH_MANIFEST
 
 # ---------------------------------------------------------------------------
@@ -51,6 +51,13 @@ class FunctionInfo:
 
 
 def collect_functions(tree: ast.Module) -> List[FunctionInfo]:
+    """All function defs in ``tree`` with qualnames.  Memoized on the tree
+    object: five rules walk the same module, and the tier-1 gates re-lint
+    the whole package several times per test session -- one shared pass
+    (ModuleInfo objects are themselves cached by analysis/callgraph.py)."""
+    memo = getattr(tree, "_dynalint_functions", None)
+    if memo is not None:
+        return memo
     out: List[FunctionInfo] = []
 
     def walk(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
@@ -65,6 +72,10 @@ def collect_functions(tree: ast.Module) -> List[FunctionInfo]:
                 walk(child, prefix, cls)
 
     walk(tree, "", None)
+    try:
+        tree._dynalint_functions = out  # type: ignore[attr-defined]
+    except AttributeError:
+        pass
     return out
 
 
@@ -461,9 +472,14 @@ class SilentExceptSwallow(Rule):
 def _manifest_match(relpath: str, *names: str) -> bool:
     """Whether any of ``names`` matches a HOT_PATH_MANIFEST pattern for a
     module at ``relpath`` -- the ONE manifest matcher (decorator-based
-    hotness is separate; see _is_hot)."""
+    hotness is separate; see _is_hot).  Module keys match in either
+    orientation (threads._module_key_match): a subdirectory-rooted run
+    reporting ``engine/step.py`` hits the ``dynamo_tpu/engine/step.py``
+    entry too."""
+    from .threads import _module_key_match
+
     for suffix, patterns in HOT_PATH_MANIFEST.items():
-        if relpath.endswith(suffix):
+        if _module_key_match(relpath, suffix):
             for pat in patterns:
                 if any(fnmatch.fnmatchcase(n, pat) for n in names):
                     return True
@@ -1264,6 +1280,303 @@ class BlockingOnTickThread(Rule):
 
 
 # ---------------------------------------------------------------------------
+# DT014/DT015/DT016: interprocedural thread-role rules (analysis/threads.py)
+# ---------------------------------------------------------------------------
+
+
+def _thread_analysis(index):
+    """One ThreadRoleAnalysis per ProjectIndex, shared by DT014-DT016."""
+    from .threads import ThreadRoleAnalysis
+
+    memo = getattr(index, "_dynalint_thread_roles", None)
+    if memo is None:
+        memo = ThreadRoleAnalysis(index)
+        index._dynalint_thread_roles = memo
+    return memo
+
+
+class SharedMutableAttributeRace(ProjectRule):
+    id = "DT014"
+    name = "shared-mutable-attribute-race"
+    severity = "error"
+    description = (
+        "An instance attribute written from one thread role and "
+        "read/written from a conflicting role with no common lockset.  "
+        "Roles come from analysis/threads.py (thread-entry discovery + "
+        "call-graph propagation + THREAD_ROLE_MANIFEST); a lockset is the "
+        "set of 'with self._lock:' regions covering the access (plus the "
+        "*_locked-suffix convention for helpers called under the class "
+        "lock).  Attributes whose type is a designed handoff (queue.Queue, "
+        "asyncio.Queue, Event, executors) and writes in __init__ (before "
+        "any thread exists) are exempt.  Justify a reviewed exception with "
+        "@thread_confined('role') on the mis-roled function or an inline "
+        "'# dynalint: disable=DT014 -- why' at the reported write."
+    )
+
+    def check_project(self, index) -> Iterator[Finding]:
+        from .threads import rolesets_conflict
+
+        analysis = _thread_analysis(index)
+        from .threads import collect_attr_accesses
+
+        for ci in index.classes.values():
+            accesses = collect_attr_accesses(ci, index)
+            by_attr: Dict[str, List] = {}
+            for a in accesses:
+                if analysis.roles_of(a.fn):
+                    by_attr.setdefault(a.attr, []).append(a)
+            for attr in sorted(by_attr):
+                acc = by_attr[attr]
+                writes = [a for a in acc if a.kind == "write"]
+                if not writes:
+                    continue
+                hit = None
+                for w in writes:
+                    wr = analysis.roles_of(w.fn)
+                    for other in acc:
+                        if other is w:
+                            # a multi-role function racing itself still
+                            # needs the single-access case below
+                            pair = rolesets_conflict(wr, wr)
+                            if pair is None:
+                                continue
+                        else:
+                            pair = rolesets_conflict(
+                                wr, analysis.roles_of(other.fn)
+                            )
+                        if pair is None:
+                            continue
+                        if w.locks & other.locks:
+                            continue
+                        hit = (w, other, pair)
+                        break
+                    if hit:
+                        break
+                if hit is None:
+                    continue
+                w, other, (r1, r2) = hit
+                # anchor at the UNLOCKED side so the justification (an
+                # inline suppression) sits on the access that needs it
+                anchor, remote, ra, rb = w, other, r1, r2
+                if w.locks and not other.locks and other is not w:
+                    anchor, remote, ra, rb = other, w, r2, r1
+                module = index.modules.get(ci.relpath)
+                src = ""
+                if module is not None:
+                    src = module.source_line(anchor.line)
+                where = (
+                    "itself (the function runs under conflicting roles)"
+                    if remote is anchor else
+                    f"{remote.fn.qualname} [{rb}] at line {remote.line} "
+                    f"({remote.kind})"
+                )
+                yield Finding(
+                    rule=self.id, severity=self.severity, path=ci.relpath,
+                    line=anchor.line, col=anchor.col,
+                    qualname=anchor.fn.qualname, source_line=src,
+                    message=(
+                        f"attribute '{attr}' of {ci.name}: {anchor.kind} "
+                        f"in {anchor.fn.qualname} [{ra}] races "
+                        f"{where} with no common lock: roles {ra}/{rb} "
+                        "run in parallel -- guard both sides with one "
+                        "lock, confine to a single role, or hand off "
+                        "through a queue"
+                    ),
+                )
+
+
+class CrossThreadPublication(ProjectRule):
+    id = "DT015"
+    name = "cross-thread-publication-hazard"
+    severity = "warning"
+    description = (
+        "A live mutable container attribute (self.<list/dict/set/deque>) "
+        "passed directly into Thread(target=..., args=...), "
+        "executor.submit(...), run_in_executor(...), asyncio.to_thread"
+        "(...) or a queue put: the receiving thread iterates/reads the "
+        "SAME object the owner keeps mutating (RuntimeError: dict changed "
+        "size during iteration -- or silently torn reads).  Snapshot at "
+        "the boundary (list(x), dict(x), x.copy()) or document the "
+        "handoff with an inline suppression."
+    )
+
+    _COPY_WRAPPERS = {
+        "list", "dict", "set", "tuple", "sorted", "frozenset", "bytes",
+    }
+
+    def _is_live_container(self, expr: ast.AST, ci) -> Optional[str]:
+        """The attribute name if ``expr`` is a bare self.<container-attr>."""
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in ci.container_attrs
+        ):
+            return expr.attr
+        return None
+
+    def check_project(self, index) -> Iterator[Finding]:
+        analysis = _thread_analysis(index)
+        # thread/executor handoffs: every argument of the entry call
+        for entry in analysis.entries:
+            ci = index.class_of(entry.caller)
+            if ci is None:
+                continue
+            args = list(entry.site.args) + [
+                kw.value for kw in entry.site.keywords
+            ]
+            for arg in args:
+                for sub in self._publication_args(arg):
+                    attr = self._is_live_container(sub, ci)
+                    if attr is None:
+                        continue
+                    module = index.modules.get(entry.caller.relpath)
+                    yield Finding(
+                        rule=self.id, severity=self.severity,
+                        path=entry.caller.relpath,
+                        line=sub.lineno, col=sub.col_offset + 1,
+                        qualname=entry.caller.qualname,
+                        source_line=(
+                            module.source_line(sub.lineno)
+                            if module else ""
+                        ),
+                        message=(
+                            f"live mutable attribute 'self.{attr}' "
+                            f"({ci.container_attrs[attr]}) passed into a "
+                            f"{entry.kind} boundary: the worker sees "
+                            "every later mutation mid-flight -- snapshot "
+                            f"it (e.g. list(self.{attr})) or document "
+                            "the handoff"
+                        ),
+                    )
+        # queue puts
+        for fn in index.functions.values():
+            ci = index.class_of(fn)
+            if ci is None:
+                continue
+            for node in _walk_own(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in ("put", "put_nowait")
+                ):
+                    continue
+                recv = func.value
+                recv_attr = (
+                    recv.attr
+                    if isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"
+                    else None
+                )
+                if recv_attr is None or recv_attr not in ci.safe_attrs:
+                    # only a receiver provably bound to a queue type is a
+                    # handoff boundary; session.put(url, ...) is not
+                    continue
+                for arg in node.args:
+                    attr = self._is_live_container(arg, ci)
+                    if attr is None:
+                        continue
+                    module = index.modules.get(fn.relpath)
+                    yield Finding(
+                        rule=self.id, severity=self.severity,
+                        path=fn.relpath, line=arg.lineno,
+                        col=arg.col_offset + 1, qualname=fn.qualname,
+                        source_line=(
+                            module.source_line(arg.lineno) if module else ""
+                        ),
+                        message=(
+                            f"live mutable attribute 'self.{attr}' "
+                            f"({ci.container_attrs[attr]}) put on a "
+                            "queue: the consumer reads the SAME object "
+                            "the producer keeps mutating -- snapshot it "
+                            f"(e.g. list(self.{attr})) before the put"
+                        ),
+                    )
+
+    def _publication_args(self, arg: ast.AST) -> List[ast.AST]:
+        """Expressions inside one entry argument that are published as-is:
+        the argument itself, or tuple/list elements (Thread args=(...)).
+        Copy wrappers (list(x), x.copy(), x[:]) neutralize the hazard."""
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            out: List[ast.AST] = []
+            for el in arg.elts:
+                out.extend(self._publication_args(el))
+            return out
+        if isinstance(arg, ast.Call):
+            d = dotted_name(arg.func)
+            if d in self._COPY_WRAPPERS:
+                return []
+            if (
+                isinstance(arg.func, ast.Attribute)
+                and arg.func.attr == "copy"
+            ):
+                return []
+            return []  # other call results: fresh objects, not live attrs
+        if isinstance(arg, ast.Subscript):
+            return []  # x[:] or an element -- not the live container
+        return [arg]
+
+
+class ThreadRoleManifestDrift(ProjectRule):
+    id = "DT016"
+    name = "thread-role-manifest-drift"
+    severity = "error"
+    description = (
+        "A thread entry point (threading.Thread(target=...), "
+        "executor.submit, run_in_executor, asyncio.to_thread) whose "
+        "target gets NO role: the executor has no thread_name_prefix, "
+        "the target is a handle inference cannot resolve, and no "
+        "THREAD_ROLE_MANIFEST pattern covers it.  DT014 scans exactly "
+        "the roled surface, so an unroled entry silently loses race "
+        "coverage for everything it runs -- manifest drift: the thread "
+        "was added, the role model was not.  Name the executor "
+        "(thread_name_prefix=...), or add the entry to "
+        "THREAD_ROLE_MANIFEST (analysis/threads.py)."
+    )
+
+    def check_project(self, index) -> Iterator[Finding]:
+        analysis = _thread_analysis(index)
+        for entry in analysis.entries:
+            if entry.covered:
+                continue
+            module = index.modules.get(entry.caller.relpath)
+            src = (
+                module.source_line(entry.site.lineno) if module else ""
+            )
+            if entry.role is None:
+                why = (
+                    "no role: the executor/thread carries no "
+                    "thread_name_prefix and no manifest entry names it"
+                )
+            else:
+                why = (
+                    f"target '{entry.target_text}' cannot be resolved to "
+                    "a project function and no manifest pattern covers it"
+                )
+            yield Finding(
+                rule=self.id, severity=self.severity,
+                path=entry.caller.relpath, line=entry.site.lineno,
+                col=entry.site.col_offset + 1,
+                qualname=entry.caller.qualname, source_line=src,
+                message=(
+                    f"{entry.kind} entry '{entry.target_text}' is not "
+                    f"covered by thread-role inference ({why}): add a "
+                    "THREAD_ROLE_MANIFEST entry or name the executor so "
+                    "DT014 can see what runs there"
+                ),
+            )
+
+
+def _walk_own(fn: ast.AST) -> Iterator[ast.AST]:
+    from .callgraph import own_scope_walk
+
+    return own_scope_walk(fn)
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -1281,6 +1594,9 @@ ALL_RULES: List[Rule] = [
     MultichipShardingsDeclared(),
     AdHocTimingInEngine(),
     BlockingOnTickThread(),
+    SharedMutableAttributeRace(),
+    CrossThreadPublication(),
+    ThreadRoleManifestDrift(),
 ]
 
 

@@ -1,0 +1,720 @@
+"""Thread-role inference for dynalint DT014-DT016.
+
+PR 13 made the engine genuinely concurrent -- a double-buffered tick
+coroutine, executor-thread dispatch fns, a bounded fanout worker -- on top
+of the already-threaded kv-offload plane, hub WAL writer, and recorder.
+The question the per-module rules cannot answer is *which thread touches
+this attribute*: this module answers it statically.
+
+Role model
+----------
+A *role* is a logical execution domain.  Two accesses can race iff their
+roles can run in parallel (:func:`roles_conflict`):
+
+====================  =====================================================
+role                  meaning
+====================  =====================================================
+``tick``              the engine's single-worker device executor
+                      (``thread_name_prefix="jax-engine"``): dispatch and
+                      commit fns the tick coroutine awaits one at a time
+``tick-coro``         the tick coroutine itself (loop-resident).  The tick
+                      loop awaits every executor hop, so ``tick`` and
+                      ``tick-coro`` are mutually serialized BY CONTRACT --
+                      the contract ``runtime/thread_sentry.py`` asserts at
+                      runtime when armed
+``fanout-worker``     the engine's bounded off-tick stream-fanout task
+                      (loop-resident)
+``event-loop``        any other coroutine (request handlers, admission,
+                      cancellation) and the sync helpers they call
+``kv-offload``        the offload engine's dedicated worker thread
+``hub-io``            the hub journal's single WAL writer thread
+``worker``            anonymous pool threads (``asyncio.to_thread``,
+                      ``run_in_executor(None, ...)``) -- conflicts even
+                      with itself (many threads)
+*<prefix>*            any other ``ThreadPoolExecutor`` auto-mints a role
+                      named after its ``thread_name_prefix`` (e.g.
+                      ``recorder-io``, ``planner-log``)
+====================  =====================================================
+
+Loop-resident roles (``tick-coro``/``fanout-worker``/``event-loop``) share
+one OS thread, so they never *data*-race each other; ``tick`` is
+await-serialized with ``tick-coro``; everything else is true parallelism.
+
+Inference
+---------
+Thread entries are discovered from ``threading.Thread(target=...)``,
+``executor.submit(fn, ...)``, ``loop.run_in_executor(ex, fn, ...)`` and
+``asyncio.to_thread(fn, ...)`` sites (lambda and ``functools.partial``
+targets are peeled/descended into); the kv-offload ``COPY_HELPERS`` and
+tick ``TICK_COMMIT_HELPERS`` tuples seed their module roles; roles then
+propagate over the project call graph.  Async functions that inference
+left unroled default to ``event-loop``.  :data:`THREAD_ROLE_MANIFEST`
+pins what inference cannot (the tick coroutine, duck-typed handles), the
+``@thread_confined("role")`` decorator pins one function as a reviewed
+justification, and an entry covered by NONE of these is manifest drift
+(DT016): the thread was added, the role model was not.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import (
+    ClassInfo,
+    FunctionNode,
+    ProjectIndex,
+    dotted,
+    own_scope_walk,
+    peel_partial,
+)
+
+# ---------------------------------------------------------------------------
+# Roles
+# ---------------------------------------------------------------------------
+
+ROLE_TICK = "tick"
+ROLE_TICK_CORO = "tick-coro"
+ROLE_FANOUT = "fanout-worker"
+ROLE_EVENT_LOOP = "event-loop"
+ROLE_KV_OFFLOAD = "kv-offload"
+ROLE_HUB_IO = "hub-io"
+ROLE_WORKER = "worker"
+
+# executor thread_name_prefix -> canonical role
+EXECUTOR_PREFIX_ROLES: Dict[str, str] = {
+    "jax-engine": ROLE_TICK,
+    "hub-journal": ROLE_HUB_IO,
+    "kv-offload": ROLE_KV_OFFLOAD,
+}
+
+# roles that are cooperatively scheduled on the one event-loop thread:
+# they interleave only at awaits, so they cannot data-race each other
+LOOP_RESIDENT_ROLES: FrozenSet[str] = frozenset(
+    {ROLE_TICK_CORO, ROLE_FANOUT, ROLE_EVENT_LOOP}
+)
+
+# pairs serialized by an explicit engine contract (the tick coroutine
+# awaits every executor call before touching shared state again)
+SERIALIZED_PAIRS: FrozenSet[FrozenSet[str]] = frozenset(
+    {frozenset({ROLE_TICK, ROLE_TICK_CORO})}
+)
+
+# roles backed by MORE than one OS thread: even same-role accesses race
+MULTI_THREAD_ROLES: FrozenSet[str] = frozenset({ROLE_WORKER})
+
+# the reviewed-justification role: ``@thread_confined("handoff")`` on a
+# per-request VALUE class (TokenBlockSequence and friends) documents that
+# instances cross domains only through an ownership transfer with a
+# happens-before edge (admission, queue put) -- never shared live.  It
+# conflicts with nothing and does not propagate.
+ROLE_HANDOFF = "handoff"
+
+
+def roles_conflict(a: str, b: str) -> bool:
+    """Can code in role ``a`` run truly in parallel with code in ``b``?"""
+    if ROLE_HANDOFF in (a, b):
+        return False
+    if a == b:
+        return a in MULTI_THREAD_ROLES
+    if a in LOOP_RESIDENT_ROLES and b in LOOP_RESIDENT_ROLES:
+        return False
+    if frozenset((a, b)) in SERIALIZED_PAIRS:
+        return False
+    return True
+
+
+def rolesets_conflict(ra: Set[str], rb: Set[str]) -> Optional[Tuple[str, str]]:
+    """First conflicting (role_a, role_b) pair across two role sets."""
+    for x in sorted(ra):
+        for y in sorted(rb):
+            if roles_conflict(x, y):
+                return (x, y)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The manifest: roles inference cannot pin (hotpath.HOT_PATH_MANIFEST
+# pattern).  Keys are module-path suffixes; values map fnmatch patterns --
+# over function qualnames, or over an entry's *target expression text* for
+# duck-typed handles inference cannot resolve -- to roles.
+# ---------------------------------------------------------------------------
+
+THREAD_ROLE_MANIFEST: Dict[str, Dict[str, str]] = {
+    "dynamo_tpu/engine/engine.py": {
+        # the double-buffered tick coroutine: loop-resident, but
+        # await-serialized with every executor hop it issues
+        "JaxEngine._run": ROLE_TICK_CORO,
+        # the bounded off-tick stream-fanout consumer task
+        "JaxEngine._fanout_worker": ROLE_FANOUT,
+        # scheduler-installed callbacks (sched.offload_lookup = ...):
+        # the call edge lives in a stored attribute, so inference cannot
+        # see that the scheduler invokes these during plan (tick-coro)
+        # and executor-side admission (tick).  The multi-role pin keeps
+        # the offload plane's engine-facing API in the race scan.
+        "JaxEngine._offload_lookup": "tick,tick-coro",
+        "JaxEngine._swap_out": "tick,tick-coro",
+        "JaxEngine._on_pool_evict": "tick,tick-coro",
+    },
+    "dynamo_tpu/mocker/engine.py": {
+        # the mocker is single-threaded by design: its tick loop is just
+        # another coroutine on the loop
+        "MockerEngine._run": ROLE_EVENT_LOOP,
+    },
+    "dynamo_tpu/runtime/recorder.py": {
+        # the writer-thread close: a file-handle method, not a project
+        # function -- inference cannot resolve it, the role is the
+        # writer's by construction
+        "self._fh.close": "recorder-io",
+    },
+    "dynamo_tpu/runtime/transports/hub.py": {
+        # journal close on the WAL writer (bound method of a file handle)
+        "self.journal.close": ROLE_HUB_IO,
+    },
+    "dynamo_tpu/cli.py": {
+        # interactive stdin reads ride the default pool; stdlib handle
+        "sys.stdin.readline": ROLE_WORKER,
+    },
+    "dynamo_tpu/llm/prefix_onboard.py": {
+        # offload is a duck-typed engine param; drain() is its barrier
+        "offload.drain": ROLE_WORKER,
+    },
+}
+
+
+def _split_roles(spec: str) -> Set[str]:
+    """A manifest role value may be comma-separated ('tick,tick-coro')
+    when one entry point executes under several serialized domains."""
+    return {r.strip() for r in spec.split(",") if r.strip()}
+
+
+def _module_key_match(relpath: str, key: str) -> bool:
+    """Boundary-aware two-way suffix match: the analyzer root may sit
+    above OR below ``dynamo_tpu/`` (linting a subdirectory reports
+    ``engine/engine.py``, the repo gate ``dynamo_tpu/engine/engine.py``
+    -- both must hit the same manifest entry)."""
+    return (
+        relpath == key
+        or relpath.endswith("/" + key)
+        or key.endswith("/" + relpath)
+    )
+
+
+def manifest_role_for(
+    relpath: str, *names: str
+) -> Optional[str]:
+    """Manifest lookup: the role (possibly comma-separated) of the first
+    pattern matching any of ``names`` for a module at ``relpath``."""
+    for key, patterns in THREAD_ROLE_MANIFEST.items():
+        if _module_key_match(relpath, key):
+            for pat, role in patterns.items():
+                if any(fnmatch.fnmatchcase(n, pat) for n in names):
+                    return role
+    return None
+
+
+# the decorator is read SYNTACTICALLY (by name); the runtime attribute it
+# sets lives in runtime/thread_sentry.py (analysis/ stays stdlib-only)
+def _decorated_role(decorator_list: Sequence[ast.AST]) -> Optional[str]:
+    for dec in decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        d = dotted(dec.func)
+        if d is None or d.rpartition(".")[2] != "thread_confined":
+            continue
+        if dec.args and isinstance(dec.args[0], ast.Constant):
+            v = dec.args[0].value
+            if isinstance(v, str):
+                return v
+    return None
+
+
+def _confined_role(fn: FunctionNode, index: ProjectIndex) -> Optional[str]:
+    """The role pinned by an ``@thread_confined("role")`` decorator on the
+    function itself or (for every method at once) its class."""
+    role = _decorated_role(fn.node.decorator_list)  # type: ignore[attr-defined]
+    if role is not None:
+        return role
+    ci = index.class_of(fn)
+    if ci is not None:
+        return _decorated_role(ci.node.decorator_list)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Entry discovery
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ThreadEntry:
+    """One site that hands a callable to another execution domain."""
+
+    site: ast.Call
+    caller: FunctionNode
+    kind: str  # "thread" | "submit" | "run_in_executor" | "to_thread"
+    target_text: str  # source-ish text of the target expression
+    target: Optional[FunctionNode]  # resolved project function, if any
+    target_lambda: Optional[ast.Lambda]
+    role: Optional[str]  # inferred/manifest role; None = uncovered
+
+    @property
+    def covered(self) -> bool:
+        return self.role is not None and (
+            self.target is not None
+            or self.target_lambda is not None
+            or self.target_manifest_covered
+        )
+
+    target_manifest_covered: bool = False
+
+
+def _target_text(expr: ast.AST) -> str:
+    d = dotted(expr)
+    if d is not None:
+        return d
+    if isinstance(expr, ast.Lambda):
+        return "<lambda>"
+    return "<expr>"
+
+
+def _executor_role_of_expr(
+    expr: ast.AST, caller: FunctionNode, index: ProjectIndex,
+    local_executors: Dict[str, Optional[str]],
+) -> Tuple[bool, Optional[str]]:
+    """Is ``expr`` a known executor, and what role does it imply?
+    Returns (is_executor, role-or-None)."""
+    d = dotted(expr)
+    if d is None:
+        return False, None
+    parts = d.split(".")
+    if parts[0] in ("self", "cls") and len(parts) == 2:
+        ci = index.class_of(caller)
+        if ci is not None and parts[1] in ci.executor_attrs:
+            prefix = ci.executor_attrs[parts[1]]
+            return True, _prefix_role(prefix)
+    if len(parts) == 1 and parts[0] in local_executors:
+        return True, local_executors[parts[0]]
+    return False, None
+
+
+def _prefix_role(prefix: str) -> Optional[str]:
+    if not prefix:
+        return None  # anonymous executor: must be manifest-covered
+    return EXECUTOR_PREFIX_ROLES.get(prefix, prefix)
+
+
+def _local_executors(fn: FunctionNode) -> Dict[str, Optional[str]]:
+    """Local names bound to ``ThreadPoolExecutor(...)`` in this scope,
+    mapped to their prefix-derived role (None for prefix-less)."""
+    out: Dict[str, Optional[str]] = {}
+    for node in own_scope_walk(fn.node):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        d = dotted(node.value.func)
+        if d is None or d.rpartition(".")[2] != "ThreadPoolExecutor":
+            continue
+        prefix = ""
+        for kw in node.value.keywords:
+            if kw.arg == "thread_name_prefix" and isinstance(
+                kw.value, ast.Constant
+            ):
+                prefix = str(kw.value.value)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = _prefix_role(prefix)
+    return out
+
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+
+def discover_entries(index: ProjectIndex) -> List[ThreadEntry]:
+    entries: List[ThreadEntry] = []
+    for fn in list(index.functions.values()):
+        local_ex = _local_executors(fn)
+        for node in own_scope_walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            target_expr: Optional[ast.AST] = None
+            kind = ""
+            role: Optional[str] = None
+            if d in _THREAD_CTORS:
+                kind = "thread"
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target_expr = kw.value
+                if target_expr is None and node.args:
+                    continue  # Thread(group, target, ...) positional: rare
+                if target_expr is None:
+                    continue  # no target (subclass run()): out of scope
+            elif d in ("asyncio.to_thread", "to_thread"):
+                kind = "to_thread"
+                role = ROLE_WORKER
+                if node.args:
+                    target_expr = node.args[0]
+            elif isinstance(node.func, ast.Attribute) and node.func.attr == "submit":
+                is_ex, ex_role = _executor_role_of_expr(
+                    node.func.value, fn, index, local_ex
+                )
+                if not is_ex:
+                    continue
+                kind = "submit"
+                role = ex_role
+                if node.args:
+                    target_expr = node.args[0]
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "run_in_executor"
+            ):
+                kind = "run_in_executor"
+                if len(node.args) >= 2:
+                    ex_arg, target_expr = node.args[0], node.args[1]
+                    if isinstance(ex_arg, ast.Constant) and ex_arg.value is None:
+                        role = ROLE_WORKER
+                    else:
+                        is_ex, ex_role = _executor_role_of_expr(
+                            ex_arg, fn, index, local_ex
+                        )
+                        role = ex_role if is_ex else None
+                else:
+                    continue
+            else:
+                continue
+            if target_expr is None:
+                continue
+            peeled = peel_partial(target_expr)
+            lam = peeled if isinstance(peeled, ast.Lambda) else None
+            target = (
+                None if lam is not None
+                else index.resolve_callable(peeled, fn)
+            )
+            text = _target_text(peeled)
+            # manifest can (a) override the role, (b) cover an
+            # unresolvable target by its expression text
+            names = [text]
+            if target is not None:
+                names = [target.qualname, target.name, text]
+            m_role = manifest_role_for(fn.relpath, *names)
+            # an unresolvable target that is a method OF a known executor
+            # attr (ex.shutdown, ex.submit handles) is lifecycle plumbing
+            # of an already-roled domain, not a new entry to cover
+            ex_method = False
+            if target is None and lam is None:
+                tparts = text.split(".")
+                if tparts[0] in ("self", "cls") and len(tparts) == 3:
+                    ci = index.class_of(fn)
+                    if ci is not None and tparts[1] in ci.executor_attrs:
+                        ex_method = True
+            entry = ThreadEntry(
+                site=node, caller=fn, kind=kind, target_text=text,
+                target=target, target_lambda=lam,
+                role=m_role if m_role is not None else role,
+                target_manifest_covered=(
+                    target is None and lam is None
+                    and (m_role is not None or ex_method)
+                ),
+            )
+            entries.append(entry)
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# Role propagation
+# ---------------------------------------------------------------------------
+
+
+class ThreadRoleAnalysis:
+    """Roles for every function in a :class:`ProjectIndex`.
+
+    ``roles[fn.key]`` is the set of roles the function can execute under;
+    missing/empty = inference saw no evidence (excluded from race
+    checking).  ``pinned`` holds ``@thread_confined`` justifications --
+    final, never widened by propagation."""
+
+    def __init__(self, index: ProjectIndex) -> None:
+        self.index = index
+        self.entries = discover_entries(index)
+        self.roles: Dict[str, Set[str]] = {}
+        self.pinned: Dict[str, Set[str]] = {}
+        self._infer()
+
+    # -- seeding -----------------------------------------------------------
+
+    def _module_helper_tuples(self) -> List[Tuple[FunctionNode, str]]:
+        """COPY_HELPERS (offload modules -> kv-offload) and
+        TICK_COMMIT_HELPERS (tick modules -> tick) seed their named
+        functions: these tuples already declare 'runs on the designated
+        thread' for DT009/DT013."""
+        out: List[Tuple[FunctionNode, str]] = []
+        tuple_roles = {
+            "COPY_HELPERS": ROLE_KV_OFFLOAD,
+            "TICK_COMMIT_HELPERS": ROLE_TICK,
+        }
+        for rel, module in self.index.modules.items():
+            for node in module.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not (
+                        isinstance(t, ast.Name) and t.id in tuple_roles
+                    ):
+                        continue
+                    role = tuple_roles[t.id]
+                    if not isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+                        continue
+                    names = {
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    }
+                    for fn in self.index.functions.values():
+                        if fn.relpath == rel and fn.name in names:
+                            out.append((fn, role))
+        return out
+
+    def _manifest_functions(self) -> List[Tuple[FunctionNode, str]]:
+        out = []
+        for fn in self.index.functions.values():
+            role = manifest_role_for(fn.relpath, fn.qualname, fn.name)
+            if role is not None:
+                out.append((fn, role))
+        return out
+
+    # -- propagation -------------------------------------------------------
+
+    def _seed(self, fn: FunctionNode, role: str, work: List[str]) -> None:
+        if fn.key in self.pinned:
+            return
+        bucket = self.roles.setdefault(fn.key, set())
+        missing = _split_roles(role) - bucket
+        if missing:
+            bucket.update(missing)
+            work.append(fn.key)
+
+    def _seed_lambda(
+        self, lam: ast.Lambda, caller: FunctionNode, role: str,
+        work: List[str],
+    ) -> None:
+        """A lambda thread target: everything it calls runs in ``role``."""
+        for node in ast.walk(lam):
+            if isinstance(node, ast.Call):
+                target = self.index.resolve_callable(node.func, caller)
+                if target is not None:
+                    self._seed(target, role, work)
+
+    def _infer(self) -> None:
+        index = self.index
+        # pins, strongest first: @thread_confined beats the manifest beats
+        # inference.  A pinned function's role set never widens -- that is
+        # the whole point of a justification.
+        for fn in index.functions.values():
+            role = _confined_role(fn, index)
+            if role is not None:
+                self.pinned[fn.key] = _split_roles(role)
+                self.roles[fn.key] = _split_roles(role)
+        for fn, role in self._manifest_functions():
+            if fn.key not in self.pinned:
+                self.pinned[fn.key] = _split_roles(role)
+                self.roles[fn.key] = _split_roles(role)
+
+        work: List[str] = list(self.pinned)
+        for entry in self.entries:
+            if entry.role is None:
+                continue
+            if entry.target is not None:
+                self._seed(entry.target, entry.role, work)
+            elif entry.target_lambda is not None:
+                self._seed_lambda(
+                    entry.target_lambda, entry.caller, entry.role, work
+                )
+        for fn, role in self._module_helper_tuples():
+            if role == ROLE_KV_OFFLOAD:
+                self._seed(fn, role, work)  # COPY_HELPERS: always offload
+        self._propagate(work)
+
+        # TICK_COMMIT_HELPERS fallback: members the executor-submission
+        # inference did not reach run inline on the loop in some engines
+        # (the mocker) and on the device executor in others -- only an
+        # otherwise-unroled member defaults to the tick role
+        work = []
+        for fn, role in self._module_helper_tuples():
+            if role == ROLE_TICK and not self.roles.get(fn.key):
+                self._seed(fn, role, work)
+        self._propagate(work)
+
+        # default: an async function nobody roled runs on the event loop
+        work = []
+        for fn in index.functions.values():
+            if fn.is_async and not self.roles.get(fn.key):
+                self._seed(fn, ROLE_EVENT_LOOP, work)
+        self._propagate(work)
+
+    def _propagate(self, work: List[str]) -> None:
+        index = self.index
+        while work:
+            key = work.pop()
+            fn = index.functions.get(key)
+            if fn is None:
+                continue
+            # the handoff justification never propagates: it documents an
+            # ownership-transfer discipline, not an execution domain
+            src = self.roles.get(key, set()) - {ROLE_HANDOFF}
+            if not src:
+                continue
+            for callee in index.callees(fn):
+                if callee.key in self.pinned:
+                    continue
+                bucket = self.roles.setdefault(callee.key, set())
+                missing = src - bucket
+                if missing:
+                    bucket.update(missing)
+                    work.append(callee.key)
+
+    # -- queries -----------------------------------------------------------
+
+    def roles_of(self, fn: FunctionNode) -> Set[str]:
+        return self.roles.get(fn.key, set())
+
+
+# ---------------------------------------------------------------------------
+# Attribute accesses + locksets (DT014's raw material)
+# ---------------------------------------------------------------------------
+
+# container methods that mutate the receiver in place
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "add", "discard", "update",
+    "setdefault", "sort", "reverse", "move_to_end", "rotate",
+}
+
+# methods excluded from access analysis entirely: they run before (or
+# after) any thread exists
+_LIFECYCLE_EXEMPT = {"__init__", "__post_init__", "__new__", "__del__"}
+
+
+@dataclass
+class AttrAccess:
+    attr: str
+    fn: FunctionNode
+    kind: str  # "read" | "write"
+    line: int
+    col: int
+    locks: FrozenSet[str] = frozenset()
+
+
+def _lock_regions(
+    method: FunctionNode, ci: ClassInfo
+) -> List[Tuple[int, int, str]]:
+    """Lexical ``with self.<lock>:`` regions in this method's own scope."""
+    out: List[Tuple[int, int, str]] = []
+    for node in own_scope_walk(method.node):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            d = dotted(item.context_expr)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if (
+                len(parts) == 2
+                and parts[0] in ("self", "cls")
+                and parts[1] in ci.lock_attrs
+            ):
+                end = getattr(node, "end_lineno", node.lineno) or node.lineno
+                out.append((node.lineno, end, parts[1]))
+    return out
+
+
+def _base_locks(method: FunctionNode, ci: ClassInfo) -> FrozenSet[str]:
+    """``*_locked``-suffix methods are called with the class lock held
+    (the repo's convention: HostTier._demote_lru_locked and friends)."""
+    if method.name.endswith("_locked") and ci.lock_attrs:
+        return frozenset(ci.lock_attrs)
+    return frozenset()
+
+
+def _self_attr(expr: ast.AST) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def collect_attr_accesses(
+    ci: ClassInfo, index: ProjectIndex
+) -> List[AttrAccess]:
+    """Every ``self.<attr>`` read/write in the class's methods (and the
+    methods' nested defs, attributed to the nested scope's own roles),
+    with the lockset lexically held at each site.  Memoized per ClassInfo
+    (the tier-1 gates re-run the race scan over one shared index)."""
+    memo = getattr(ci, "_access_memo", None)
+    if memo is not None:
+        return memo
+    out: List[AttrAccess] = []
+    skip = ci.lock_attrs | ci.safe_attrs | set(ci.executor_attrs)
+
+    methods: List[FunctionNode] = []
+    for fn in index.functions.values():
+        if fn.relpath == ci.relpath and fn.cls == ci.name:
+            if fn.qualname.split(".")[1] in _LIFECYCLE_EXEMPT:
+                continue
+            methods.append(fn)
+
+    for method in methods:
+        regions = _lock_regions(method, ci)
+        base = _base_locks(method, ci)
+
+        def locks_at(line: int) -> FrozenSet[str]:
+            held = set(base)
+            for lo, hi, name in regions:
+                if lo <= line <= hi:
+                    held.add(name)
+            return frozenset(held)
+
+        def note(attr: Optional[str], kind: str, node: ast.AST) -> None:
+            if attr is None or attr in skip:
+                return
+            out.append(
+                AttrAccess(
+                    attr=attr, fn=method, kind=kind,
+                    line=getattr(node, "lineno", 1),
+                    col=getattr(node, "col_offset", 0) + 1,
+                    locks=locks_at(getattr(node, "lineno", 1)),
+                )
+            )
+
+        for node in own_scope_walk(method.node):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for el in ast.walk(t):
+                        note(_self_attr(el), "write", el)
+                        if isinstance(el, ast.Subscript):
+                            note(_self_attr(el.value), "write", el)
+            elif isinstance(node, ast.AugAssign):
+                note(_self_attr(node.target), "write", node)
+                if isinstance(node.target, ast.Subscript):
+                    note(_self_attr(node.target.value), "write", node)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    note(_self_attr(t), "write", t)
+                    if isinstance(t, ast.Subscript):
+                        note(_self_attr(t.value), "write", t)
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in MUTATOR_METHODS
+                ):
+                    note(_self_attr(node.func.value), "write", node)
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                note(_self_attr(node), "read", node)
+    ci._access_memo = out  # type: ignore[attr-defined]
+    return out

@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
@@ -26,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.hotpath import hot_path
-from ..runtime import profiling, slo
+from ..runtime import profiling, slo, thread_sentry
 from ..runtime.engine import Annotated, Context, ResponseStream
 from ..runtime.utils import log_throttled
 from ..protocols.common import (
@@ -381,7 +382,11 @@ class _GroupSpanExport:
             arr = QuantKV(q=assemble_shards(dev.q), s=assemble_shards(dev.s))
         else:
             arr = assemble_shards(dev)
+        # dynalint: disable=DT014 -- per-span slots are disjoint: host_span
+        # dedupes to ONE to_thread task per idx on the loop, so concurrent
+        # workers never touch the same index
         self._host[idx] = arr
+        # dynalint: disable=DT014 -- same disjoint-slot discipline
         self._devs[idx] = None  # release the device copy
         return arr
 
@@ -807,6 +812,12 @@ class JaxEngine:
                 except ValueError:
                     logger.warning("ignoring malformed DYN_KV_PREFETCH=%r", v)
         self._prefetch_issued: set = set()
+        # guards _prefetch_issued: the tick coroutine adds (prefetch
+        # drive), executor-side admission settles, and event-loop cancel
+        # paths clear -- the check-then-act pairs in
+        # _note_prefetch_admission/_cancel_prefetch race without it
+        # (dynalint DT014) and could double-settle one request's pins
+        self._prefetch_lock = threading.Lock()
         # async dispatch pipelining (ISSUE 13): the tick loop carries up
         # to ``_pipe_depth`` uncommitted dispatch generations -- tick N+1
         # plans/assembles/enqueues while tick N executes on device, and
@@ -993,6 +1004,8 @@ class JaxEngine:
         if self.offload_engine is not None:
             # a ready swap blob must wake a sleeping tick loop (all lanes
             # parked = nothing runnable = the loop is waiting on _wake)
+            # dynalint: disable=DT014 -- installed in start() before the
+            # tick task (and any executor dispatch) exists
             self.offload_engine.wake_cb = self._wake_from_thread
         self._flightrec_key = profiling.flight_recorder.add_provider(
             "engine", self._flightrec_state
@@ -1574,6 +1587,9 @@ class JaxEngine:
             padded = pad_page_axis(
                 self._coerce_blob(blob_to_host(arr)), bucket
             )
+            # dynalint: disable=DT014 -- the worker-side reader
+            # (prefill_export_batch.materialize) touches only immutable kv
+            # geometry (shard_geometry); pages rebinds stay tick-domain
             self.kv.pages = self._fns.scatter_layer_pages(
                 self.kv.pages,
                 jnp.asarray(np.arange(lo, hi, dtype=np.int32)),
@@ -4065,20 +4081,19 @@ class JaxEngine:
                 break
             count += 1
             rid = seq.request_id
-            if (
-                rid in self._prefetch_issued
-                or seq.blocks is None
+            if seq.blocks is None or seq.awaiting_kv:
                 # external / swap-parked lanes admit with fresh pages
                 # only and never consume onboards -- a pinned walk for
                 # them is pure ring pressure
-                or seq.awaiting_kv
-            ):
                 continue
             # rid stays marked even when nothing is offloaded: rescanning
             # a fully-G1-resident 128k chain every tick would burn the
             # loop thread on no-op registry probes (a block evicted after
             # this scan is handled by the admission-time tier lookup)
-            self._prefetch_issued.add(rid)
+            with self._prefetch_lock:
+                if rid in self._prefetch_issued:
+                    continue
+                self._prefetch_issued.add(rid)
             max_blocks = max(
                 0, (len(seq.prompt) - 1) // self.sched.block_size
             )
@@ -4096,19 +4111,27 @@ class JaxEngine:
         tier hits), release the ring pins, record the overlap ratio.
         Must run BEFORE ``_apply_onboards`` drains the pending list."""
         oe = self.offload_engine
-        if oe is None or seq.request_id not in self._prefetch_issued:
+        if oe is None:
             return
-        self._prefetch_issued.discard(seq.request_id)
+        # atomic check-and-clear: an event-loop cancel racing this
+        # executor-side settle must resolve to exactly one of the two
+        # paths releasing the ring pins (dynalint DT014)
+        with self._prefetch_lock:
+            issued = seq.request_id in self._prefetch_issued
+            self._prefetch_issued.discard(seq.request_id)
+        if not issued:
+            return
         consumed = [h for h, _p, _b, _m in seq.pending_onboard]
         seq.prefetch_hits = oe.finish_prefetch(seq.request_id, consumed)
 
     def _cancel_prefetch(self, rid: str) -> None:
         """A request left the queue without admitting (cancel / error):
         free its host-staged prefetch state (the ISSUE 10 leak fix)."""
-        if rid in self._prefetch_issued:
+        with self._prefetch_lock:
+            issued = rid in self._prefetch_issued
             self._prefetch_issued.discard(rid)
-            if self.offload_engine is not None:
-                self.offload_engine.cancel_prefetch(rid)
+        if issued and self.offload_engine is not None:
+            self.offload_engine.cancel_prefetch(rid)
 
     def _offload_lookup(self, seq_hash: int):
         """Scheduler-facing tier lookup (``_match_prefix`` G1 -> G2 -> G3
@@ -4393,6 +4416,11 @@ class JaxEngine:
         generations are still queued on device behind this one -- the
         dispatch-gap accounting then records a zero gap (the device was
         never idle) instead of arming the ready->enqueue stopwatch."""
+        # the commit walk owns the tick domain's hottest shared state
+        # (scheduler lanes, KV pages, inflight entries): armed, assert the
+        # declared confinement -- executor thread or the serialized tick
+        # coroutine, never a foreign thread
+        thread_sentry.assert_role("tick", what="JaxEngine._commit_all")
         from .sampling import unpack_sampled_logprobs
 
         tick = self._tick

@@ -23,7 +23,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
-from ..runtime import profiling, slo
+from ..runtime import profiling, slo, thread_sentry
 from ..runtime.metrics import EngineMetrics
 from ..protocols.common import (
     FinishReason,
@@ -490,6 +490,11 @@ class MockerEngine:
             )
 
     def _generate_one(self, seq: _MockSeq) -> None:
+        # the mocker is single-threaded by declaration (its whole tick
+        # plane is loop-resident); armed, the sentry proves it
+        thread_sentry.assert_role(
+            "event-loop", what="MockerEngine._generate_one"
+        )
         token = self._next_token(seq)
         stop = seq.req.stop_conditions
         n_gen = seq.num_generated + 1
@@ -562,6 +567,7 @@ class MockerEngine:
         self._waiting_list.insert(0, seq)
 
     def _finish(self, seq: _MockSeq, reason: FinishReason) -> None:
+        thread_sentry.assert_role("event-loop", what="MockerEngine._finish")
         seq.finish = reason
         self.running.pop(seq.request_id, None)
         self.kv.deref(seq.held)

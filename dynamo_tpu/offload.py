@@ -39,6 +39,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .runtime import thread_sentry
+
 logger = logging.getLogger("dynamo.offload")
 
 # The designated sync-transfer helpers (dynalint DT009): every synchronous
@@ -57,6 +59,7 @@ def to_host(arr: Any) -> np.ndarray:
     it blocks a thread nobody's tick latency depends on.  Quantized pool
     snapshots (kv_cache.QuantKV) materialize data and scales together --
     the pair is the blob."""
+    thread_sentry.assert_role("kv-offload", what="offload.to_host")
     from .engine.kv_cache import QuantKV
 
     if isinstance(arr, QuantKV):
@@ -248,6 +251,7 @@ class DiskTier:
         a temp file, rename into place): the lock guards only the in-RAM
         index, so ``__contains__`` probes from the admission path never
         wait behind a multi-MB compressed write."""
+        thread_sentry.assert_role("kv-offload", what="DiskTier.put")
         from .engine.kv_cache import QuantKV
 
         if self.capacity <= 0:
@@ -282,6 +286,7 @@ class DiskTier:
         """Offload-thread only (single reader; puts rename atomically, so
         a file listed in the index is always complete).  The lock again
         covers only the index."""
+        thread_sentry.assert_role("kv-offload", what="DiskTier.get")
         with self._lock:
             if seq_hash not in self._lru:
                 self.misses += 1
@@ -372,7 +377,7 @@ class HostTier:
             n += self._ring_s.nbytes
         return n
 
-    def _ensure_ring(self, blob: Any) -> None:
+    def _ensure_ring_locked(self, blob: Any) -> None:
         from .engine.kv_cache import QuantKV
 
         if self._ring is not None or self._ring_failed or self.capacity <= 0:
@@ -403,7 +408,7 @@ class HostTier:
             return
         self._free_slots = list(range(self.capacity - 1, -1, -1))
 
-    def _ring_fits(self, blob: Any) -> bool:
+    def _ring_fits_locked(self, blob: Any) -> bool:
         from .engine.kv_cache import QuantKV
 
         if self._ring is None:
@@ -421,7 +426,7 @@ class HostTier:
             and blob.dtype == self._ring.dtype
         )
 
-    def _ring_read(self, slot: int):
+    def _ring_read_locked(self, slot: int):
         from .engine.kv_cache import QuantKV
 
         if self._ring_s is not None:
@@ -440,9 +445,9 @@ class HostTier:
         demote: List[Tuple[int, np.ndarray, BlockMeta]] = []
         with self._lock:
             self._evict_locked(seq_hash)  # overwrite: recycle the old slot
-            self._ensure_ring(blob)
+            self._ensure_ring_locked(blob)
             slot: Optional[int] = None
-            if self._ring_fits(blob):
+            if self._ring_fits_locked(blob):
                 if not self._free_slots:
                     self._demote_lru_locked(demote)
                 if self._free_slots:
@@ -482,7 +487,7 @@ class HostTier:
         if slot is None:
             vb, meta = self._misc.pop(victim)
         else:
-            vb = self._ring_read(slot)
+            vb = self._ring_read_locked(slot)
             self._free_slots.append(slot)
         demote.append((victim, vb, meta))
         return True
@@ -544,7 +549,7 @@ class HostTier:
             if slot is None:
                 blob, meta = self._misc[seq_hash]
                 return blob.copy(), meta
-            return self._ring_read(slot), self._meta[seq_hash]
+            return self._ring_read_locked(slot), self._meta[seq_hash]
 
     def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta]]:
         """Tiered get: RAM first, then the disk parent (promoting the hit
@@ -796,15 +801,19 @@ class KVOffloadEngine:
             if faults.injector.enabled and faults.injector.should_fire(
                 "offload.copy_fail", f"evict/{seq_hash:x}"
             ):
-                self.copy_fails += 1
+                # copy_fails is also bumped by swap_out on the engine
+                # executor: both increments go through the lock (DT014)
+                with self._lock:
+                    self.copy_fails += 1
                 self.metrics.copy_fails.inc()
                 return  # lost offload = a cache miss later, never an error
             t0 = time.perf_counter()
             blob = to_host(snap)
             self.host.put(seq_hash, blob, meta)
             dt = time.perf_counter() - t0
-            self.offload_bytes += blob.nbytes
-            self.offload_seconds += dt
+            with self._lock:
+                self.offload_bytes += blob.nbytes
+                self.offload_seconds += dt
             self.metrics.record_offload("host", blob.nbytes, dt)
             self._observe_occupancy()
         except Exception:
@@ -1037,9 +1046,12 @@ class KVOffloadEngine:
         if faults.injector.enabled and faults.injector.should_fire(
             "offload.copy_fail", f"swap/{request_id}"
         ):
-            self.copy_fails += 1
+            # runs on the engine executor while the offload thread may be
+            # bumping the same counters: lock-guard the increments (DT014)
+            with self._lock:
+                self.copy_fails += 1
+                self.swap_fallbacks += 1
             self.metrics.copy_fails.inc()
-            self.swap_fallbacks += 1
             self.metrics.swap_fallbacks.labels("copy_fail").inc()
             return False
         keep_dev = self.swap_device_blocks > 0
@@ -1059,13 +1071,14 @@ class KVOffloadEngine:
                 shards=dict(shards) if shards else None,
                 dev=snap if keep_dev else None,
             )
-        self.swap_outs += 1
+            self.swap_outs += 1
         self.metrics.swap_events.labels("out").inc()
         self._ex.submit(self._store_swap, request_id, snap)
         return True
 
     def _store_swap(self, request_id: str, snap: Any) -> None:
-        rec = self._swaps.get(request_id)
+        with self._lock:  # racing drop_swap pops under the same lock
+            rec = self._swaps.get(request_id)
         if rec is None:
             return  # dropped (cancel / already restored from the device copy)
         try:
@@ -1074,8 +1087,9 @@ class KVOffloadEngine:
             rec.nbytes = rec.blob.nbytes
             dt = time.perf_counter() - t0
             rec.state = SWAP_READY
-            self.offload_bytes += rec.nbytes
-            self.offload_seconds += dt
+            with self._lock:
+                self.offload_bytes += rec.nbytes
+                self.offload_seconds += dt
             self.metrics.record_offload("swap", rec.nbytes, dt)
             # host spill landed: drop the device copy if the staging
             # budget is oversubscribed (long parks ride the host blob)
@@ -1123,10 +1137,12 @@ class KVOffloadEngine:
     # -- observability -------------------------------------------------------
 
     def _observe_occupancy(self) -> None:
+        with self._lock:  # _swap_used mutates under the lock on two roles
+            swap_used = self._swap_used
         self.metrics.tier_blocks.labels("host").set(len(self.host))
         if self.disk is not None:
             self.metrics.tier_blocks.labels("disk").set(len(self.disk))
-        self.metrics.tier_blocks.labels("swap").set(self._swap_used)
+        self.metrics.tier_blocks.labels("swap").set(swap_used)
 
     @property
     def tier_hit_rate(self) -> float:

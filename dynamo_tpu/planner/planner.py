@@ -315,6 +315,11 @@ class Planner:
 
     def _append_log_line(self, line: str) -> None:
         """Log-writer thread only."""
+        from ..runtime import thread_sentry
+
+        thread_sentry.assert_role(
+            "planner-log", what="Planner._append_log_line"
+        )
         try:
             with open(self.cfg.adjustment_log_path, "a") as f:
                 f.write(line + "\n")

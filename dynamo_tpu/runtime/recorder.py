@@ -20,6 +20,7 @@ import json
 import time
 from typing import Any, AsyncIterator, Dict, List, Optional
 
+from . import thread_sentry
 from .engine import Annotated, AsyncEngine, Context, ResponseStream
 
 
@@ -49,6 +50,9 @@ class RecordingEngine:
 
     def _append(self, line: str) -> None:
         """Writer thread only."""
+        thread_sentry.assert_role(
+            "recorder-io", what="RecordingEngine._append"
+        )
         self._fh.write(line + "\n")
         self._fh.flush()
 

@@ -497,6 +497,9 @@ class HubJournal:
         """Worker thread: frame, write, flush (fsync if configured)."""
         import os
 
+        from .. import thread_sentry
+
+        thread_sentry.assert_role("hub-io", what="HubJournal._do_append")
         try:
             if self._wal is None:
                 self.open()

@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
+from ..runtime.thread_sentry import thread_confined
 from .hashing import KV_HASH_SEED, block_hash, chain_hash, hash_blocks
 
 
@@ -27,11 +28,19 @@ class TokenBlock:
     position: int  # block index in the sequence
 
 
+@thread_confined("handoff")
 class TokenBlockSequence:
     """Append-only (with unwind) sequence of tokens, chunked into blocks.
 
     Complete blocks are hashed and frozen; the tail (< block_size tokens)
     stays mutable.  ``append`` returns the newly-completed block, if any.
+
+    Thread model (the ``handoff`` confinement, dynalint DT014): a sequence
+    is a per-request value object.  It is built where the request arrives
+    (event loop / mocker tick) and, on admission, ownership transfers to
+    whichever domain drives the lane (the engine's tick domain) -- the
+    admission handoff is the happens-before edge; two domains never hold
+    a live reference concurrently.
     """
 
     def __init__(

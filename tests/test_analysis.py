@@ -1212,7 +1212,7 @@ def test_cli_json_and_exit_codes(tmp_path, capsys):
     rc = cli_run([str(bad), "--root", str(tmp_path), "--format", "json"])
     doc = json.loads(capsys.readouterr().out)
     assert rc == 1
-    assert doc["schema_version"] == 1
+    assert doc["schema_version"] == 2
     assert doc["summary"]["total"] == 1
     assert doc["summary"]["by_rule"] == {"DT001": 1}
     f = doc["findings"][0]
@@ -1348,6 +1348,637 @@ def test_dt013_mocker_module_covered(tmp_path):
         name="fixture_pkg/mocker/engine.py",
     )
     assert rule_ids(findings) == ["DT013"]
+
+
+# ---------------------------------------------------------------------------
+# DT014: shared-mutable-attribute race (interprocedural thread roles)
+# ---------------------------------------------------------------------------
+
+RACY_COUNTER = """
+    import asyncio
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Plane:
+        def __init__(self):
+            self._ex = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kv-offload"
+            )
+            self.copied = 0
+
+        def submit(self, snap):
+            self._ex.submit(self._store, snap)
+
+        def _store(self, snap):
+            self.copied += 1
+
+        async def stats(self):
+            return self.copied
+    """
+
+
+def test_dt014_unlocked_cross_role_counter(tmp_path):
+    findings = lint_source(tmp_path, RACY_COUNTER, rules=["DT014"])
+    assert rule_ids(findings) == ["DT014"]
+    f = findings[0]
+    assert "copied" in f.message
+    assert "kv-offload" in f.message and "event-loop" in f.message
+
+
+def test_dt014_lock_protected_twin(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import asyncio
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+                self._lock = threading.Lock()
+                self.copied = 0
+
+            def submit(self, snap):
+                self._ex.submit(self._store, snap)
+
+            def _store(self, snap):
+                with self._lock:
+                    self.copied += 1
+
+            async def stats(self):
+                with self._lock:
+                    return self.copied
+        """,
+        rules=["DT014"],
+    )
+    assert findings == []
+
+
+def test_dt014_queue_handoff_twin(tmp_path):
+    """State crossing domains through a queue.Queue attribute is the
+    sanctioned handoff -- no shared plain attribute, no finding."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import queue
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+                self._q = queue.Queue()
+
+            def submit(self, snap):
+                self._ex.submit(self._store, snap)
+
+            def _store(self, snap):
+                self._q.put(("stored", snap))
+
+            async def drain(self):
+                return self._q.get_nowait()
+        """,
+        rules=["DT014"],
+    )
+    assert findings == []
+
+
+def test_dt014_thread_confined_justification(tmp_path):
+    """@thread_confined('kv-offload') pins the reader into the writer's
+    role: the reviewed justification silences the race."""
+    findings = lint_source(
+        tmp_path,
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def thread_confined(role):
+            def deco(fn):
+                return fn
+            return deco
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+                self.copied = 0
+
+            def submit(self, snap):
+                self._ex.submit(self._store, snap)
+
+            def _store(self, snap):
+                self.copied += 1
+
+            @thread_confined("kv-offload")
+            def stats_probe(self):
+                return self.copied
+        """,
+        rules=["DT014"],
+    )
+    assert findings == []
+
+
+def test_dt014_locked_suffix_convention(tmp_path):
+    """*_locked helpers are called with the class lock held (the HostTier
+    convention): their accesses carry the lockset."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Ring:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+                self._lock = threading.Lock()
+                self.slots = {}
+
+            def submit(self, h, blob):
+                self._ex.submit(self._store, h, blob)
+
+            def _store(self, h, blob):
+                with self._lock:
+                    self._insert_locked(h, blob)
+
+            def _insert_locked(self, h, blob):
+                self.slots[h] = blob
+
+            async def lookup(self, h):
+                with self._lock:
+                    return self.slots.get(h)
+        """,
+        rules=["DT014"],
+    )
+    assert findings == []
+
+
+def test_dt014_inline_suppression(tmp_path):
+    src = RACY_COUNTER.replace(
+        "self.copied += 1",
+        "self.copied += 1  # dynalint: disable=DT014 -- test-only counter",
+    )
+    assert lint_source(tmp_path, src, rules=["DT014"]) == []
+
+
+def test_dt014_serialized_tick_roles_do_not_conflict():
+    """The engine contract: 'tick' (executor) and 'tick-coro' (the awaiting
+    coroutine) are mutually serialized; loop-resident roles co-schedule."""
+    from dynamo_tpu.analysis.threads import roles_conflict
+
+    assert not roles_conflict("tick", "tick-coro")
+    assert not roles_conflict("event-loop", "fanout-worker")
+    assert not roles_conflict("event-loop", "tick-coro")
+    assert roles_conflict("tick", "event-loop")
+    assert roles_conflict("kv-offload", "tick")
+    assert roles_conflict("kv-offload", "event-loop")
+    # the anonymous pool races even itself; handoff conflicts with nothing
+    assert roles_conflict("worker", "worker")
+    assert not roles_conflict("handoff", "kv-offload")
+
+
+# ---------------------------------------------------------------------------
+# DT014 role-inference edge cases: lambda, partial, method handles
+# ---------------------------------------------------------------------------
+
+
+def test_dt014_lambda_target_inference(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+                self.n = 0
+
+            def submit(self, snap):
+                self._ex.submit(lambda: self._store(snap))
+
+            def _store(self, snap):
+                self.n += 1
+
+            async def stats(self):
+                return self.n
+        """,
+        rules=["DT014"],
+    )
+    assert rule_ids(findings) == ["DT014"]
+
+
+def test_dt014_partial_target_inference(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        from concurrent.futures import ThreadPoolExecutor
+        from functools import partial
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+                self.n = 0
+
+            def submit(self, snap):
+                self._ex.submit(partial(self._store, snap))
+
+            def _store(self, snap):
+                self.n += 1
+
+            async def stats(self):
+                return self.n
+        """,
+        rules=["DT014"],
+    )
+    assert rule_ids(findings) == ["DT014"]
+
+
+def test_dt014_method_handle_inference(tmp_path):
+    """self.tier.put as a submit target resolves through the attribute's
+    constructor type: Tier.put runs under kv-offload, and its unlocked
+    write races Tier's async reader."""
+    findings = lint_source(
+        tmp_path,
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Tier:
+            def __init__(self):
+                self.stored = 0
+
+            def put(self, blob):
+                self.stored += 1
+
+            async def occupancy(self):
+                return self.stored
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+                self.tier = Tier()
+
+            def submit(self, blob):
+                self._ex.submit(self.tier.put, blob)
+        """,
+        rules=["DT014"],
+    )
+    assert rule_ids(findings) == ["DT014"]
+    assert "stored" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# DT015: cross-thread publication hazard
+# ---------------------------------------------------------------------------
+
+
+def test_dt015_live_container_published(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+                self.pending = []
+
+            def flush(self):
+                self._ex.submit(self._store, self.pending)
+
+            def _store(self, items):
+                for item in items:
+                    pass
+        """,
+        rules=["DT015"],
+    )
+    assert rule_ids(findings) == ["DT015"]
+    assert "pending" in findings[0].message
+
+
+def test_dt015_snapshot_twin_is_clean(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+                self.pending = []
+                self.index = {}
+
+            def flush(self):
+                self._ex.submit(self._store, list(self.pending))
+                self._ex.submit(self._store, self.index.copy())
+
+            def _store(self, items):
+                for item in items:
+                    pass
+        """,
+        rules=["DT015"],
+    )
+    assert findings == []
+
+
+def test_dt015_queue_put_of_live_container(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import queue
+
+        class Plane:
+            def __init__(self):
+                self._q = queue.Queue()
+                self.batch = {}
+
+            def publish(self):
+                self._q.put_nowait(self.batch)
+
+            def publish_safely(self):
+                self._q.put_nowait(dict(self.batch))
+        """,
+        rules=["DT015"],
+    )
+    assert rule_ids(findings) == ["DT015"]
+    assert findings[0].qualname == "Plane.publish"
+
+
+# ---------------------------------------------------------------------------
+# DT016: thread-role manifest drift
+# ---------------------------------------------------------------------------
+
+
+def test_dt016_raw_thread_without_role(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class Loop:
+            def start(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+
+            def _run(self):
+                pass
+        """,
+        rules=["DT016"],
+    )
+    assert rule_ids(findings) == ["DT016"]
+    assert "_run" in findings[0].message
+
+
+def test_dt016_prefixless_executor_is_drift(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(max_workers=1)
+
+            def go(self):
+                self._ex.submit(self._work)
+
+            def _work(self):
+                pass
+        """,
+        rules=["DT016"],
+    )
+    assert rule_ids(findings) == ["DT016"]
+    assert "thread_name_prefix" in findings[0].message
+
+
+def test_dt016_named_executor_auto_minted_role(tmp_path):
+    """A thread_name_prefix IS the role declaration: no drift, and the
+    prefix-minted role feeds DT014."""
+    findings = lint_source(
+        tmp_path,
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Plane:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="my-new-plane"
+                )
+
+            def go(self):
+                self._ex.submit(self._work)
+
+            def _work(self):
+                pass
+        """,
+        rules=["DT016"],
+    )
+    assert findings == []
+
+
+def test_dt016_manifest_covers_entry(tmp_path):
+    """The THREAD_ROLE_MANIFEST pins what inference cannot -- adding the
+    entry turns the drift failure green (and removing it turns it red:
+    the drift gate)."""
+    from dynamo_tpu.analysis import threads
+
+    src = """
+    import threading
+
+    class Loop:
+        def start(self):
+            t = threading.Thread(target=self._run, daemon=True)
+            t.start()
+
+        def _run(self):
+            pass
+    """
+    key = "fixture_pkg/threaded.py"
+    old = threads.THREAD_ROLE_MANIFEST.get(key)
+    threads.THREAD_ROLE_MANIFEST[key] = {"Loop._run": "worker"}
+    try:
+        covered = lint_source(
+            tmp_path, src, rules=["DT016"], name="fixture_pkg/threaded.py"
+        )
+    finally:
+        if old is None:
+            del threads.THREAD_ROLE_MANIFEST[key]
+        else:
+            threads.THREAD_ROLE_MANIFEST[key] = old
+    assert covered == []
+    # without the manifest entry the same module fails: drift is a gate
+    drifted = lint_source(
+        tmp_path, src, rules=["DT016"], name="fixture_pkg/threaded2.py"
+    )
+    assert rule_ids(drifted) == ["DT016"]
+
+
+def test_dt016_to_thread_of_project_function_is_covered(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import asyncio
+
+        class Export:
+            async def run(self):
+                return await asyncio.to_thread(self._materialize)
+
+            def _materialize(self):
+                return 1
+        """,
+        rules=["DT016"],
+    )
+    assert findings == []
+
+
+def test_thread_role_manifest_matches_repo():
+    """The checked-in manifest's engine pins exist: a rename must fail
+    here, not silently unpin the tick coroutine from the race scan."""
+    from dynamo_tpu.analysis.threads import THREAD_ROLE_MANIFEST
+
+    eng = THREAD_ROLE_MANIFEST["dynamo_tpu/engine/engine.py"]
+    assert eng["JaxEngine._run"] == "tick-coro"
+    assert eng["JaxEngine._fanout_worker"] == "fanout-worker"
+    import dynamo_tpu.engine.engine as engine_mod
+
+    assert hasattr(engine_mod.JaxEngine, "_run")
+    assert hasattr(engine_mod.JaxEngine, "_fanout_worker")
+    assert hasattr(engine_mod.JaxEngine, "_offload_lookup")
+
+
+# ---------------------------------------------------------------------------
+# CLI satellites: --only/--changed, JSON baseline audit
+# ---------------------------------------------------------------------------
+
+
+def test_cli_only_alias(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    rc = cli_run([str(bad), "--root", str(tmp_path), "--only", "DT001"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "DT001" in out
+    rc = cli_run([str(bad), "--root", str(tmp_path), "--only", "DT003"])
+    assert rc == 0  # filtered to a rule the file does not trip
+
+
+def test_cli_changed_mode(tmp_path, capsys):
+    """--changed lints exactly the files changed vs merge-base HEAD main
+    (committed and working-tree), and exits 0 with nothing changed."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    git = ["git", "-C", str(repo)]
+    subprocess.run(git + ["init", "-q", "-b", "main"], check=True)
+    subprocess.run(git + ["config", "user.email", "t@t"], check=True)
+    subprocess.run(git + ["config", "user.name", "t"], check=True)
+    (repo / "clean.py").write_text("X = 1\n")
+    (repo / "old_bad.py").write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    subprocess.run(git + ["add", "."], check=True)
+    subprocess.run(git + ["commit", "-qm", "base"], check=True)
+
+    # nothing changed: exit 0 without linting the pre-existing offender
+    rc = cli_run([str(repo), "--root", str(repo), "--changed"])
+    assert rc == 0
+    assert "no changed python files" in capsys.readouterr().out
+
+    # a fresh working-tree offender IS linted; old_bad.py stays invisible
+    (repo / "new_bad.py").write_text(
+        "import time\n\nasync def g():\n    time.sleep(2)\n"
+    )
+    rc = cli_run([str(repo), "--root", str(repo), "--changed"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "new_bad.py" in out and "old_bad.py" not in out
+
+    # linting a SUBDIRECTORY still sees its changes (git paths are
+    # toplevel-relative; they must not be joined onto the sub-root)
+    sub = repo / "pkg"
+    sub.mkdir()
+    (sub / "sub_bad.py").write_text(
+        "import time\n\nasync def h():\n    time.sleep(3)\n"
+    )
+    rc = cli_run([str(sub), "--root", str(sub), "--changed"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "sub_bad.py" in out and "new_bad.py" not in out
+
+
+def test_cli_changed_without_git_is_exit_2(tmp_path, capsys):
+    lone = tmp_path / "lone"
+    lone.mkdir()
+    (lone / "x.py").write_text("X = 1\n")
+    env_home = os.environ.get("GIT_CEILING_DIRECTORIES")
+    os.environ["GIT_CEILING_DIRECTORIES"] = str(tmp_path)
+    try:
+        rc = cli_run([str(lone), "--root", str(lone), "--changed"])
+    finally:
+        if env_home is None:
+            os.environ.pop("GIT_CEILING_DIRECTORIES", None)
+        else:
+            os.environ["GIT_CEILING_DIRECTORIES"] = env_home
+    assert rc == 2
+    assert "--changed needs git" in capsys.readouterr().err
+
+
+def test_cli_help_documents_exit_codes(capsys):
+    with pytest.raises(SystemExit) as exc:
+        cli_run(["--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    assert "exit codes" in out
+    for code in ("0 ", "1 ", "2 "):
+        assert code in out
+
+
+def test_cli_json_baseline_audit(tmp_path, capsys):
+    """--format json + --baseline reports used and stale fingerprints, so
+    a checked-in baseline can be pruned without re-deriving hashes."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    bl = tmp_path / "bl.json"
+    rc = cli_run(
+        [str(bad), "--root", str(tmp_path), "--baseline", str(bl),
+         "--write-baseline"]
+    )
+    assert rc == 0
+    capsys.readouterr()
+
+    # same file: the one baseline entry is "used", nothing stale
+    rc = cli_run(
+        [str(bad), "--root", str(tmp_path), "--baseline", str(bl),
+         "--format", "json"]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["summary"]["baselined"] == 1
+    assert len(doc["baseline"]["used"]) == 1
+    assert doc["baseline"]["stale"] == {}
+
+    # offender fixed: the entry flips to stale (prunable)
+    bad.write_text("import asyncio\n\nasync def f():\n    await asyncio.sleep(1)\n")
+    rc = cli_run(
+        [str(bad), "--root", str(tmp_path), "--baseline", str(bl),
+         "--format", "json"]
+    )
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["baseline"]["used"] == {}
+    assert len(doc["baseline"]["stale"]) == 1
 
 
 def test_repo_is_dynalint_clean():

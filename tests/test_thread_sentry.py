@@ -1,0 +1,294 @@
+"""Thread sentry (runtime/thread_sentry.py) + the DT014-found race fix.
+
+Three layers: unit tests for the role asserts and the ``thread_confined``
+decorator; a regression test for the prefetch-bookkeeping race the static
+detector surfaced (JaxEngine._cancel_prefetch vs _note_prefetch_admission
+mutating ``_prefetch_issued`` from two roles); and a sentry-armed mocker
+serve smoke proving the declared confinement model matches runtime
+behavior (``DYN_THREAD_SENTRY=1``)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+import threading
+import types
+
+import pytest
+
+from dynamo_tpu.runtime import thread_sentry
+from dynamo_tpu.runtime.thread_sentry import (
+    ThreadConfinementError,
+    assert_role,
+    thread_confined,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def armed():
+    thread_sentry.arm(True)
+    try:
+        yield
+    finally:
+        thread_sentry.arm(False)
+
+
+# ---------------------------------------------------------------------------
+# assert_role
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_is_noop():
+    assert not thread_sentry.armed()
+    assert_role("kv-offload", what="anything")  # no loop, wrong thread: ok
+
+
+def test_armed_rejects_foreign_thread(armed):
+    with pytest.raises(ThreadConfinementError) as exc:
+        assert_role("kv-offload", what="offload.to_host")
+    assert "kv-offload" in str(exc.value)
+    assert "offload.to_host" in str(exc.value)
+
+
+def test_armed_accepts_named_thread(armed):
+    err = []
+
+    def work():
+        try:
+            assert_role("kv-offload", what="tier put")
+        except Exception as e:  # pragma: no cover - failure path
+            err.append(e)
+
+    t = threading.Thread(target=work, name="kv-offload_0")
+    t.start()
+    t.join()
+    assert err == []
+
+
+def test_armed_loop_roles(armed):
+    async def main():
+        # the whole loop-resident family is satisfied on the loop thread,
+        # including "tick" (the await-serialized half of the tick domain)
+        assert_role("event-loop", what="handler")
+        assert_role("tick-coro", what="tick loop")
+        assert_role("fanout-worker", what="fanout")
+        assert_role("tick", what="commit (serial fallback)")
+
+    asyncio.run(main())
+    # off-loop, the loop-resident roles fail
+    with pytest.raises(ThreadConfinementError):
+        assert_role("event-loop", what="handler")
+
+
+def test_auto_minted_prefix_role(armed):
+    """A role auto-minted from an executor's thread_name_prefix (not in
+    ROLE_THREAD_PREFIXES) matches threads carrying that prefix: naming
+    the executor is the whole declaration, on both sides."""
+    ok = []
+
+    def work():
+        assert_role("router-io", what="router flush")
+        ok.append(True)
+
+    t = threading.Thread(target=work, name="router-io_0")
+    t.start()
+    t.join()
+    assert ok == [True]
+    with pytest.raises(ThreadConfinementError):
+        assert_role("router-io", what="router flush")
+
+
+def test_multi_role_any_of(armed):
+    async def main():
+        assert_role("kv-offload", "event-loop", what="shared probe")
+
+    asyncio.run(main())  # event-loop arm satisfies the pair
+
+
+# ---------------------------------------------------------------------------
+# thread_confined
+# ---------------------------------------------------------------------------
+
+
+def test_thread_confined_tags_without_wrapping_when_disarmed():
+    @thread_confined("kv-offload")
+    def helper():
+        return 42
+
+    assert helper() == 42
+    assert getattr(helper, thread_sentry.THREAD_CONFINED_ATTR) == "kv-offload"
+
+
+def test_thread_confined_class_tag():
+    from dynamo_tpu.tokens.sequence import TokenBlockSequence
+
+    assert (
+        getattr(TokenBlockSequence, thread_sentry.THREAD_CONFINED_ATTR)
+        == "handoff"
+    )
+
+
+def test_thread_confined_wraps_when_armed(armed):
+    # decoration happens while armed -> calls assert
+    @thread_confined("kv-offload")
+    def helper():
+        return 1
+
+    with pytest.raises(ThreadConfinementError):
+        helper()
+
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(helper()), name="kv-offload_7"
+    )
+    t.start()
+    t.join()
+    assert out == [1]
+
+
+def test_mocker_tick_helpers_assert_event_loop(armed):
+    """The mocker's fanout emitters declare event-loop confinement: armed,
+    calling one from a foreign thread is a sentry violation."""
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+
+    eng = MockerEngine(MockerConfig())
+    seq = types.SimpleNamespace(request_id="r1")
+    err = []
+
+    def foreign():
+        try:
+            eng._emit_error(seq, "x")  # queue-less: only the assert runs
+            eng._finish(seq, None)
+        except ThreadConfinementError as e:
+            err.append(e)
+
+    t = threading.Thread(target=foreign, name="rogue")
+    t.start()
+    t.join()
+    assert len(err) == 1  # _finish trips the sentry before touching state
+
+
+# ---------------------------------------------------------------------------
+# The DT014-found race fix: _prefetch_issued check-then-act
+# ---------------------------------------------------------------------------
+
+
+class _RecordingOffload:
+    """Counts settle/cancel calls per request id (thread-safe)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.finishes = []
+        self.cancels = []
+
+    def finish_prefetch(self, rid, consumed):
+        with self.lock:
+            self.finishes.append(rid)
+        return 0
+
+    def cancel_prefetch(self, rid):
+        with self.lock:
+            self.cancels.append(rid)
+
+
+def test_prefetch_cancel_vs_admission_settles_exactly_once():
+    """The race dynalint DT014 flagged: an event-loop cancel and an
+    executor-side admission settle both ran ``if rid in _prefetch_issued:
+    discard`` with no lock -- both could pass the check and double-settle
+    one request's ring pins.  The fix makes check-and-clear atomic under
+    ``_prefetch_lock``; exactly ONE of the two paths may win, every time.
+
+    Regression shape: the two methods are driven unbound on a stub (they
+    touch only the guarded set and the offload engine), racing across a
+    barrier for many rounds."""
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    rounds = 200
+    for i in range(rounds):
+        rid = f"req-{i}"
+        rec = _RecordingOffload()
+        stub = types.SimpleNamespace(
+            offload_engine=rec,
+            _prefetch_issued={rid},
+            _prefetch_lock=threading.Lock(),
+        )
+        seq = types.SimpleNamespace(
+            request_id=rid, pending_onboard=[], prefetch_hits=0
+        )
+        barrier = threading.Barrier(2)
+
+        def cancel():
+            barrier.wait()
+            JaxEngine._cancel_prefetch(stub, rid)
+
+        def admit():
+            barrier.wait()
+            JaxEngine._note_prefetch_admission(stub, seq)
+
+        t1 = threading.Thread(target=cancel)
+        t2 = threading.Thread(target=admit)
+        t1.start(); t2.start(); t1.join(); t2.join()
+
+        settled = len(rec.finishes) + len(rec.cancels)
+        assert settled == 1, (
+            f"round {i}: {len(rec.finishes)} finishes + "
+            f"{len(rec.cancels)} cancels (must be exactly one)"
+        )
+        assert stub._prefetch_issued == set()
+
+
+# ---------------------------------------------------------------------------
+# Sentry-armed mocker serve smoke (subprocess: arming happens at import)
+# ---------------------------------------------------------------------------
+
+_SMOKE = """
+import asyncio, os
+assert os.environ.get("DYN_THREAD_SENTRY") == "1"
+from dynamo_tpu.runtime import thread_sentry
+assert thread_sentry.armed()
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+
+async def main():
+    # simulated decode time engages the double-buffered (pipelined) tick
+    eng = MockerEngine(MockerConfig(decode_s_per_step=0.0005))
+    streams = []
+    for i in range(3):
+        req = PreprocessedRequest(
+            token_ids=[1, 2, 3 + i],
+            stop_conditions=StopConditions(max_tokens=5),
+            sampling_options=SamplingOptions(temperature=0.0),
+        )
+        stream = await eng.generate(Context.new(req))
+        got = []
+        async for item in stream:
+            assert not item.is_error(), item.error_message()
+            got.extend((item.data or {}).get("token_ids") or [])
+        streams.append(got)
+    await eng.stop()
+    assert all(len(s) == 5 for s in streams), streams
+
+asyncio.run(main())
+print("SENTRY_SMOKE_OK")
+"""
+
+
+def test_sentry_armed_mocker_serve_smoke():
+    """A short mocker serve loop with DYN_THREAD_SENTRY=1: every
+    tick-helper confinement assert runs hot and passes -- the declared
+    role model matches runtime behavior, not just the manifest."""
+    env = dict(os.environ)
+    env["DYN_THREAD_SENTRY"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _SMOKE],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "SENTRY_SMOKE_OK" in proc.stdout
