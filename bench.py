@@ -38,6 +38,7 @@ def build_engine(
     mixed_token_budget: int = 512,
     kv_dtype=None,
     async_dispatch: bool = True,
+    **extra_cfg,
 ):
     """decode_block is the throughput/latency dial: 64 steps per host round
     trip is +20% decode tok/s on the tunneled bench chip (measured 1491 vs
@@ -75,6 +76,7 @@ def build_engine(
         kv_dtype=kv_dtype,
         async_dispatch=async_dispatch,
         seed=0,
+        **extra_cfg,
     )
     return JaxEngine.random_init(model_cfg, cfg)
 
@@ -233,8 +235,17 @@ async def run_serving(engine) -> dict:
         try:
             host, port = svc.address
             vocab = max(3, tok.vocab_size - 1)
+            # the serving line runs SPECULATION ON by default (ISSUE 15 /
+            # RTP-LLM posture): every request arms the n-gram drafter and
+            # the engine's acceptance-aware auto-disable reverts
+            # low-acceptance lanes to plain decode -- spec_accept_rate +
+            # spec_enabled_frac land next to the throughput pair so the
+            # trajectory shows what default-on speculation actually does
+            # under random (low-repetition) serving traffic
+            spec_knobs = {"num_draft_tokens": 4, "drafter": "ngram"}
             warm = synth_workload(8, isl=128, osl=8, request_rate=0.0,
-                                  vocab=vocab, seed=7)
+                                  vocab=vocab, seed=7,
+                                  speculation=spec_knobs)
             await run_bench(host, port, name, warm, concurrency=8)
             # tick-phase profiling covers only the measured window (the
             # warmup's compile storms would drown the steady-state split);
@@ -242,17 +253,22 @@ async def run_serving(engine) -> dict:
             # and the dispatch gap -- the ROADMAP item 2 localizers
             prof.clear()
             prof.enable()
+            d0, a0 = engine.spec_drafted, engine.spec_accepted
             work = synth_workload(48, isl=128, osl=64, request_rate=0.0,
-                                  vocab=vocab, seed=8)
+                                  vocab=vocab, seed=8,
+                                  speculation=spec_knobs)
             report = await run_bench(host, port, name, work, concurrency=16)
             s = report.summary()
             assert s["num_errors"] == 0, f"serving bench errors: {s}"
             lat = synth_workload(16, isl=128, osl=64, request_rate=0.0,
-                                 vocab=vocab, seed=9)
+                                 vocab=vocab, seed=9,
+                                 speculation=spec_knobs)
             lat_report = await run_bench(host, port, name, lat, concurrency=4)
             ls = lat_report.summary()
             assert ls["num_errors"] == 0, f"latency bench errors: {ls}"
             psum = prof.summary()
+            drafted = engine.spec_drafted - d0
+            accepted = engine.spec_accepted - a0
             return {
                 "serving_tok_s": s["output_tok_s"],
                 "ttft_p50_ms": s["ttft_ms"]["p50"],
@@ -269,6 +285,15 @@ async def run_serving(engine) -> dict:
                 "kv_dtype": str(engine.kv.dtype),
                 "kv_pool_gb": round(engine.kv.pool_bytes / 1e9, 4),
                 "async_dispatch": bool(engine._async_dispatch),
+                # default-on speculation health (acceptance-aware disable):
+                # accept rate over the measured window and the fraction of
+                # spec-armed requests that kept drafting
+                "serving_spec_accept_rate": (
+                    round(accepted / drafted, 4) if drafted else None
+                ),
+                "serving_spec_enabled_frac": round(
+                    engine.spec_enabled_frac, 4
+                ),
             }
         finally:
             if not prof_was_enabled:
@@ -488,7 +513,7 @@ async def run_mem_pressure(rs) -> dict:
     return out
 
 
-async def run_spec(rs) -> dict:
+async def run_spec(rs, build=build_engine, bs: int = 8, osl: int = 64) -> dict:
     """Speculative-decoding scenario: the same workload measured with
     per-request n-gram/prompt-lookup drafting on and off.
 
@@ -508,8 +533,6 @@ async def run_spec(rs) -> dict:
         StopConditions,
     )
     from dynamo_tpu.runtime.engine import Context
-
-    bs, osl = 8, 64
 
     def mk_prompts():
         # per-lane tiled pattern: repetition inside one prompt (lookup
@@ -544,29 +567,54 @@ async def run_spec(rs) -> dict:
 
     out = {}
     tok_s = {}
-    engine = build_engine(decode_block=16)
-    try:
-        for spec_on in (False, True):
+    disp_s = {}
+    # folded-vs-post-commit A/B (ISSUE 15): the same spec workload on the
+    # default engine (verify columns folded into the packed unified
+    # dispatch) and on the two-dispatch fallback.  ``*_dispatches_s`` is
+    # the per-leg device-launch rate -- the folded leg's headline is
+    # fewer dispatches for the same committed tokens.
+    legs = (
+        ("base", dict(), False),
+        ("spec", dict(), True),  # folded (the default)
+        ("spec_postcommit", dict(fold_spec_verify=False), True),
+    )
+    for name, cfg_extra, spec_on in legs:
+        engine = build(decode_block=16, **cfg_extra)
+        try:
             await run_mode(engine, mk_prompts(), spec_on)  # warm/compile
             measured = mk_prompts()
             d0, a0 = engine.spec_drafted, engine.spec_accepted
             v0 = engine.spec_verify_steps
+            s0 = engine._steps
             t0 = time.monotonic()
             total = await run_mode(engine, measured, spec_on)
             elapsed = time.monotonic() - t0
-            tok_s["spec" if spec_on else "base"] = total / elapsed
+            tok_s[name] = total / elapsed
+            disp_s[name] = (engine._steps - s0) / elapsed
             if spec_on:
                 drafted = engine.spec_drafted - d0
                 accepted = engine.spec_accepted - a0
                 assert drafted > 0, "speculation not exercised"
-                out["spec_accept_rate"] = round(accepted / drafted, 4)
-                out["spec_drafted_per_req"] = round(drafted / bs, 1)
-                out["spec_verify_steps"] = engine.spec_verify_steps - v0
-    finally:
-        await engine.stop()
+                if name == "spec":
+                    assert engine._fold_spec, "fold must be the default"
+                    out["spec_accept_rate"] = round(accepted / drafted, 4)
+                    out["spec_drafted_per_req"] = round(drafted / bs, 1)
+                    out["spec_verify_steps"] = engine.spec_verify_steps - v0
+                    out["spec_enabled_frac"] = round(
+                        engine.spec_enabled_frac, 4
+                    )
+        finally:
+            await engine.stop()
+            del engine
     out["spec_tok_s"] = round(tok_s["spec"], 2)
     out["spec_base_tok_s"] = round(tok_s["base"], 2)
     out["spec_speedup"] = round(tok_s["spec"] / tok_s["base"], 3)
+    out["spec_postcommit_tok_s"] = round(tok_s["spec_postcommit"], 2)
+    out["spec_fold_speedup"] = round(
+        tok_s["spec"] / tok_s["spec_postcommit"], 3
+    )
+    out["spec_dispatches_s"] = round(disp_s["spec"], 2)
+    out["spec_postcommit_dispatches_s"] = round(disp_s["spec_postcommit"], 2)
     return out
 
 

@@ -52,6 +52,7 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
         "packed_unified_step",
         "_packed_unified_step",
         "_mixed_sample_epilogue",
+        "_spec_columns_epilogue",
         "verify_and_sample",
         "_verify_and_sample",
         "score_prompt_step",
@@ -85,6 +86,7 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
     # routes the sharded engine dispatches long prompts through
     "dynamo_tpu/parallel/sharding.py": [
         "make_sharded_steps",
+        "make_sharded_drafter",
     ],
     "dynamo_tpu/parallel/pipeline_parallel.py": [
         "pp_prefill_step",
@@ -138,6 +140,16 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
     "dynamo_tpu/spec/drafter.py": [
         "NGramDrafter.propose",
         "longest_accepted",
+    ],
+    # model-based drafter (ISSUE 15): the jitted greedy draft forward is
+    # hot like every other step body (DT010 covers spec/ modules too).
+    # ModelDrafter.propose itself is deliberately NOT marked: it performs
+    # the drafter's one designed host sync (fetching the proposed token
+    # ids), and the engine keeps that sync off the dispatch-assembly path
+    # via the commit-time precompute (SpecState.pending_draft).
+    "dynamo_tpu/spec/model_drafter.py": [
+        "draft_greedy_tokens",
+        "_draft_greedy_tokens",
     ],
 }
 

@@ -984,9 +984,12 @@ class HotPathManifestDrift(Rule):
             return True
         head, _, fname = relpath.rpartition("/")
         return fname.endswith(".py") and (
-            head in ("ops", "parallel")
+            head in ("ops", "parallel", "spec")
             or head.endswith("/ops")
             or head.endswith("/parallel")
+            # the speculative-decoding package grew jitted entry points
+            # (the model drafter's forward): same drift class, same rule
+            or head.endswith("/spec")
         )
 
     @classmethod

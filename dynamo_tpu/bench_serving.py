@@ -85,19 +85,25 @@ def synth_workload(
     request_rate: float,
     vocab: int = 29000,
     seed: int = 0,
+    speculation: Optional[Dict[str, Any]] = None,
 ) -> List[Dict[str, Any]]:
-    """Poisson arrivals (rate 0 = all at t0), random prompts (no sharing)."""
+    """Poisson arrivals (rate 0 = all at t0), random prompts (no sharing).
+
+    ``speculation`` stamps every request with the OpenAI speculation
+    extension (e.g. ``{"num_draft_tokens": 4}``) -- the spec-on serving
+    line runs the same workload with per-request drafting armed."""
     rs = np.random.RandomState(seed)
     t = 0.0
     out = []
     for _ in range(num_requests):
-        out.append(
-            {
-                "token_ids": rs.randint(2, vocab, (isl,)).tolist(),
-                "max_tokens": osl,
-                "at": t,
-            }
-        )
+        item: Dict[str, Any] = {
+            "token_ids": rs.randint(2, vocab, (isl,)).tolist(),
+            "max_tokens": osl,
+            "at": t,
+        }
+        if speculation is not None:
+            item["speculation"] = speculation
+        out.append(item)
         if request_rate > 0:
             t += float(rs.exponential(1.0 / request_rate))
     return out
@@ -231,15 +237,16 @@ async def _sse_request(
     host: str, port: int, model: str, item: Dict[str, Any]
 ) -> RequestResult:
     """POST /v1/completions (token-id prompt, streaming) and time the chunks."""
-    body = json.dumps(
-        {
-            "model": model,
-            "prompt": item["token_ids"],
-            "max_tokens": item["max_tokens"],
-            "stream": True,
-            "ignore_eos": True,
-        }
-    ).encode()
+    payload: Dict[str, Any] = {
+        "model": model,
+        "prompt": item["token_ids"],
+        "max_tokens": item["max_tokens"],
+        "stream": True,
+        "ignore_eos": True,
+    }
+    if item.get("speculation") is not None:
+        payload["speculation"] = item["speculation"]
+    body = json.dumps(payload).encode()
     t0 = time.monotonic()
     writer = None
     try:

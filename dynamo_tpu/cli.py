@@ -82,6 +82,22 @@ def _add_engine_flags(p) -> None:
                         "unified dispatches (revert to the lane rectangle "
                         "padded to the max chunk; env DYN_PACKED_RAGGED "
                         "overrides)")
+    p.add_argument("--no-fold-spec-verify", dest="fold_spec_verify",
+                   action="store_false", default=True,
+                   help="disable folded speculative verify (spec columns "
+                        "riding the packed unified dispatch); verify "
+                        "reverts to the standalone post-commit dispatch "
+                        "(env DYN_SPEC_FOLD overrides)")
+    p.add_argument("--no-spec-auto-disable", dest="spec_auto_disable",
+                   action="store_false", default=True,
+                   help="keep low-acceptance lanes drafting instead of "
+                        "reverting them to plain decode (env "
+                        "DYN_SPEC_AUTO_DISABLE overrides)")
+    p.add_argument("--draft-model", default=None, metavar="PATH",
+                   help="model-based drafter: checkpoint dir (or "
+                        "'random[:seed]' test preset) loaded as a second "
+                        "weight set, registered under drafter kind "
+                        "'model' (env DYN_DRAFT_MODEL overrides)")
     p.add_argument("--kv-admit-budget", default=None, metavar="SPEC",
                    help="KV-budget admission: 'on' or "
                         "'util=0.9,headroom=256,reserve=16,floor_s=2,"
@@ -429,6 +445,9 @@ async def _make_engine(args):
         quantize=args.quantize,
         kv_dtype=args.kv_dtype,
         async_dispatch=args.async_dispatch,
+        fold_spec_verify=args.fold_spec_verify,
+        spec_auto_disable=args.spec_auto_disable,
+        draft_model=args.draft_model,
     )
     if args.mixed_token_budget is not None:
         cfg.mixed_token_budget = args.mixed_token_budget

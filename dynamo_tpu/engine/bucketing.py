@@ -61,22 +61,29 @@ def pick_page_bucket(n_pages: int, max_pages: int) -> int:
 
 
 class PackedShapeBudget:
-    """Bound the packed unified step's ``(Np, s_max)`` executable set.
+    """Bound the packed unified step's ``(Np, s_max, s_spec)`` executable set.
 
     The packed layout compiles one executable per (packed-axis length,
-    per-lane window) pair.  Both axes already bucket to powers of two, but
-    real traffic mixes decode-only ticks, short chunks, and long-context
-    chunks, so the cross product can still mint O(log budget x log chunk)
-    pairs -- each a fresh multi-second XLA compile landing mid-serving.
-    This budget caps the ACTIVE pair set: a dispatch whose natural pair is
-    already minted (or was merged before) reuses it; a new pair mints
+    per-lane window, spec-column width) triple.  All three axes already
+    bucket to powers of two (``s_spec`` is the folded-verify column count,
+    ``1 + pow2(draft)`` -- the MAX_DRAFT_TOKENS pad rule, so it draws from
+    {0, 1, 2, 3, 5, 9}), but real traffic mixes decode-only ticks, short
+    chunks, long-context chunks, and speculating lanes, so the cross
+    product can still mint O(log budget x log chunk x log draft) triples
+    -- each a fresh multi-second XLA compile landing mid-serving.  This
+    budget caps the ACTIVE triple set: a dispatch whose natural triple is
+    already minted (or was merged before) reuses it; a new triple mints
     freely under ``budget``; past the budget, the dispatch is merged up
-    into the smallest already-minted pair that dominates it (``s_max' >=
-    s_max`` and ``Np'`` covering the recomputed packed extent) -- more
-    padding, identical math, zero new executables.  Only when nothing
-    dominates does a mint evict the least-recently-used pair.
+    into the smallest already-minted triple that dominates it (``s_max' >=
+    s_max``, ``s_spec' >= s_spec``, and ``Np'`` covering the recomputed
+    packed extent) -- more padding, identical math, zero new executables.
+    Padding spec columns up is legal the same way padding the window is:
+    columns past a lane's ``v_lens`` are invalid, sample garbage that the
+    commit walk never reads (it is bounded by the dispatched draft
+    length), and their KV writes route to the trash page.  Only when
+    nothing dominates does a mint evict the least-recently-used triple.
 
-    Correctness contract (the kernel's slice rule): a returned pair
+    Correctness contract (the kernel's slice rule): a returned triple
     always satisfies ``off_last + s_max <= Np`` and ``total <= Np``,
     where ``off_last`` is the last live lane's segment offset -- padding
     rows carry lane id B and are inert.
@@ -84,8 +91,8 @@ class PackedShapeBudget:
 
     def __init__(self, budget: int = 16) -> None:
         self.budget = max(int(budget), 1)
-        # (Np, s_max) -> hits, LRU order (oldest first)
-        self._pairs: "collections.OrderedDict[Tuple[int, int], int]" = (
+        # (Np, s_max, s_spec) -> hits, LRU order (oldest first)
+        self._pairs: "collections.OrderedDict[Tuple[int, int, int], int]" = (
             collections.OrderedDict()
         )
         self.merges = 0
@@ -95,19 +102,28 @@ class PackedShapeBudget:
         return len(self._pairs)
 
     @property
-    def pairs(self) -> List[Tuple[int, int]]:
+    def pairs(self) -> List[Tuple[int, int, int]]:
         return list(self._pairs)
+
+    @property
+    def spec_shapes(self) -> List[Tuple[int, int, int]]:
+        """The minted triples carrying folded-verify columns (s_spec > 0)."""
+        return [t for t in self._pairs if t[2] > 0]
 
     @staticmethod
     def _np_for(s_max: int, off_last: int, total: int) -> int:
         return pow2_bucket(max(total, off_last + s_max, 1))
 
     def fit(
-        self, s_max: int, off_last: int, total: int
-    ) -> Tuple[int, int]:
-        """Resolve a dispatch's natural ``(s_max, off_last, total)`` to a
-        budgeted ``(Np, s_max)`` pair (see class docstring)."""
-        nat = (self._np_for(s_max, off_last, total), s_max)
+        self, s_max: int, off_last: int, total: int, s_spec: int = 0
+    ) -> Tuple[int, int, int]:
+        """Resolve a dispatch's natural ``(s_max, off_last, total,
+        s_spec)`` to a budgeted ``(Np, s_max, s_spec)`` triple (see class
+        docstring).  ``s_spec`` is 0 for spec-free dispatches -- those
+        never merge into a spec-carrying executable (the spec column
+        sampler would run for nothing every tick of a spec-free
+        workload)."""
+        nat = (self._np_for(s_max, off_last, total), s_max, s_spec)
         if nat in self._pairs:
             self._pairs[nat] += 1
             self._pairs.move_to_end(nat)
@@ -115,19 +131,21 @@ class PackedShapeBudget:
         if len(self._pairs) < self.budget:
             self._pairs[nat] = 1
             return nat
-        # merge up: smallest minted pair that dominates the dispatch
-        best: Optional[Tuple[int, int]] = None
-        for np_m, s_m in self._pairs:
+        # merge up: smallest minted triple that dominates the dispatch
+        best: Optional[Tuple[int, int, int]] = None
+        for np_m, s_m, sp_m in self._pairs:
             if s_m < s_max or np_m < self._np_for(s_m, off_last, total):
                 continue
-            if best is None or (np_m, s_m) < best:
-                best = (np_m, s_m)
+            if sp_m < s_spec or (s_spec == 0 and sp_m > 0):
+                continue
+            if best is None or (np_m, s_m, sp_m) < best:
+                best = (np_m, s_m, sp_m)
         if best is not None:
             self.merges += 1
             self._pairs[best] += 1
             self._pairs.move_to_end(best)
             return best
-        # nothing dominates (e.g. a new widest shape): evict the LRU pair
+        # nothing dominates (e.g. a new widest shape): evict the LRU triple
         self._pairs.popitem(last=False)
         self.evictions += 1
         self._pairs[nat] = 1

@@ -37,6 +37,7 @@ from ..protocols.common import (
     PreprocessedRequest,
 )
 from ..block_manager import PagePool
+from ..spec.drafter import spec_live as _spec_state_live
 from ..tokens.sequence import TokenBlock
 from .config import ModelConfig
 from .kv_cache import (
@@ -287,6 +288,35 @@ class EngineConfig:
     # streams are identical to the serial loop; ``--no-async-dispatch``
     # (DYN_ASYNC_DISPATCH=0) is the exact serial fallback.
     async_dispatch: bool = True
+    # folded speculative verify (ISSUE 15): speculating lanes' verify
+    # columns ride the packed unified dispatch as additional flat-axis
+    # segments -- a speculating mixed tick is ONE device dispatch instead
+    # of decode + verify.  Token-identical (greedy and seeded) to the
+    # post-commit ``verify_and_sample`` path, which remains the fallback
+    # for classic ticks (penalized lanes), the rectangle layout, and
+    # ``fold_spec_verify=False``.  DYN_SPEC_FOLD=0/1 overrides at engine
+    # construction (the serving-env-knob contract).  Only consulted when
+    # mixed batching + the packed layout are on.
+    fold_spec_verify: bool = True
+    # acceptance-aware per-request auto-disable: a speculating lane whose
+    # acceptance rate sits below ``spec_min_accept`` after
+    # ``spec_disable_after`` drafted tokens stops drafting and reverts to
+    # the plain decode scan -- low-acceptance traffic degrades to exactly
+    # plain decode (no output change; the SpecState stays attached for
+    # stats) instead of paying draft + rejected-column cost forever.
+    # This is what makes speculation safe to run default-on in the
+    # serving line.  DYN_SPEC_AUTO_DISABLE=0 turns the auto-off off.
+    spec_auto_disable: bool = True
+    spec_min_accept: float = 0.35
+    spec_disable_after: int = 64
+    # model-based drafter (second weight load): a checkpoint path or
+    # ``random[:seed]`` (spec/model_drafter.load_draft_model grammar).
+    # When set, the engine loads the draft model at startup -- TP-sharded
+    # onto the serving mesh with explicit shardings when one exists --
+    # and registers it under drafter kind "model", so requests select it
+    # with ``speculation: {"drafter": "model"}``.  None = host-side
+    # drafters only.  DYN_DRAFT_MODEL wins over config.
+    draft_model: Optional[str] = None
 
 
 @dataclass
@@ -335,6 +365,16 @@ class InflightUnified:
     finals: List[InflightPrefill]
     n_decode: int = 0
     n_prefill_tokens: int = 0
+    # folded speculative verify (ISSUE 15): the per-column target samples
+    # of the dispatch's verify segments (packed [B, s_spec, 2 + 2N]) and
+    # the (seq, slot, draft) snapshots the host accept walk commits them
+    # against -- the InflightVerify discipline riding the unified record,
+    # so preempt/cancel between dispatch and commit discards a lane's
+    # whole column exactly like the standalone path.
+    spec_sampled: Any = None
+    spec_lanes: List[Tuple[SeqState, int, List[int]]] = field(
+        default_factory=list
+    )
     dispatched_at: float = field(default_factory=time.perf_counter)
 
 
@@ -349,6 +389,13 @@ class InflightVerify:
     sampled: Any  # packed [B, S, 2 + 2N]
     lanes: List[Tuple[SeqState, int, List[int]]]
     dispatched_at: float = field(default_factory=time.perf_counter)
+
+
+def _spec_live(seq: SeqState) -> bool:
+    """Whether a lane is actively speculating: armed AND not auto-disabled
+    (``spec.drafter.spec_live`` -- shared with the scheduler's
+    decode-runnable count so the two sides cannot drift)."""
+    return _spec_state_live(seq.spec)
 
 
 # layer-group count the chunked KV export aims for when the caller doesn't
@@ -891,6 +938,43 @@ class JaxEngine:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.spec_verify_steps = 0
+        # folded verify (ISSUE 15): speculating lanes' verify columns ride
+        # the packed unified dispatch.  Requires the packed mixed plane;
+        # DYN_SPEC_FOLD overrides config (serving-env-knob contract).
+        self._fold_spec = (
+            bool(self.cfg.fold_spec_verify) and self._mixed and self._packed
+        )
+        env_fold = _os.environ.get("DYN_SPEC_FOLD")
+        if env_fold is not None and env_fold.strip():
+            self._fold_spec = (
+                env_fold.strip().lower() not in ("0", "off", "false", "no")
+                and self._mixed
+                and self._packed
+            )
+        # acceptance-aware auto-disable knobs (+ request-lifetime counters
+        # backing the bench's spec_enabled_frac line)
+        self._spec_auto_disable = bool(self.cfg.spec_auto_disable)
+        env_auto = _os.environ.get("DYN_SPEC_AUTO_DISABLE")
+        if env_auto is not None and env_auto.strip():
+            self._spec_auto_disable = env_auto.strip().lower() not in (
+                "0", "off", "false", "no"
+            )
+        self._spec_min_accept = float(self.cfg.spec_min_accept)
+        self._spec_disable_after = max(int(self.cfg.spec_disable_after), 1)
+        self.spec_armed_requests = 0
+        self.spec_auto_disabled = 0
+        # model-based drafter: load the second weight set and bind it to
+        # this engine under kind "model" (requests opt in per-request);
+        # env wins
+        self.model_drafter: Optional[Any] = None
+        draft_spec = self.cfg.draft_model
+        env_draft = _os.environ.get("DYN_DRAFT_MODEL")
+        if env_draft is not None and env_draft.strip():
+            draft_spec = env_draft.strip()
+            if draft_spec.lower() in ("0", "off", "none"):
+                draft_spec = None
+        if draft_spec:
+            self._init_model_drafter(draft_spec)
         # tick-phase profiler (runtime/profiling.py): the process-wide
         # instance, armed by DYN_TICK_PROFILE / profiler.enable().  The
         # loop opens one tick record per iteration when enabled;
@@ -1210,12 +1294,64 @@ class JaxEngine:
             return
         from ..spec import MAX_DRAFT_TOKENS, SpecState, make_drafter
 
+        # the model drafter binds ENGINE-scoped, not through the
+        # process-global registry: a stopped engine's draft weights must
+        # not leak into (or silently serve) later engines in the process,
+        # and the vocab check ran against THIS engine's target.  A "model"
+        # request on an unarmed engine falls through to make_drafter,
+        # which raises unless a test/extension registered its own.
+        if opts.drafter == "model" and self.model_drafter is not None:
+            drafter = self.model_drafter
+        else:
+            drafter = make_drafter(opts.drafter)  # raises on unknown kind
         seq.spec = SpecState(
-            drafter=make_drafter(opts.drafter),  # raises on unknown kind
+            drafter=drafter,
             num_draft_tokens=min(int(opts.num_draft_tokens), MAX_DRAFT_TOKENS),
             kind=opts.drafter,
         )
         self.spec_metrics.requests.inc()
+        self.spec_armed_requests += 1
+        self.spec_metrics.enabled_frac.set(self.spec_enabled_frac)
+
+    def _init_model_drafter(self, spec: str) -> None:
+        """Load the draft model (second weight load) and bind it to THIS
+        engine under drafter kind ``"model"`` (``_arm_speculation``
+        resolves the kind engine-locally, so stopping the engine releases
+        the draft weights with it -- the process-global registry stays
+        for host-side/custom drafters).
+
+        Runs once at engine construction on the caller thread -- no
+        thread is spawned (the load is synchronous, like the target's).
+        On a serving mesh the draft params shard over ``tp`` with the
+        same explicit-shardings contract as the target's steps
+        (parallel.sharding.make_sharded_drafter), so TP deployments get a
+        TP drafter for free.  One shared ModelDrafter instance serves
+        every request (``propose`` is stateless), keeping a single
+        compile cache for the draft forward."""
+        from ..spec.model_drafter import ModelDrafter, load_draft_model
+
+        dcfg, dparams = load_draft_model(spec, mesh=self.mesh)
+        if dcfg.vocab_size != self.model_cfg.vocab_size:
+            raise ValueError(
+                f"draft_model {spec!r} vocab {dcfg.vocab_size} != target "
+                f"vocab {self.model_cfg.vocab_size}: drafts and targets "
+                "must share one token space"
+            )
+        self.model_drafter = ModelDrafter(dparams, dcfg, mesh=self.mesh)
+        logger.info(
+            "model drafter armed: %s (%d layers, hidden %d%s)",
+            spec, dcfg.num_layers, dcfg.hidden_size,
+            ", tp-sharded" if self.mesh is not None else "",
+        )
+
+    @property
+    def spec_enabled_frac(self) -> float:
+        """Fraction of spec-armed requests still drafting (1 -
+        auto-disabled / armed) -- the bench's acceptance-aware health
+        number next to spec_accept_rate."""
+        if not self.spec_armed_requests:
+            return 1.0
+        return 1.0 - self.spec_auto_disabled / self.spec_armed_requests
 
     async def embed(self, token_batches: List[List[int]]) -> List[List[float]]:
         """Pooled embeddings for pre-tokenized inputs (/v1/embeddings).
@@ -2050,6 +2186,12 @@ class JaxEngine:
         for e in entries:
             if not _handles_ready(e.sampled):
                 return False
+            if (
+                isinstance(e, InflightUnified)
+                and e.spec_sampled is not None
+                and not _handles_ready(e.spec_sampled)
+            ):
+                return False
             pfs = (
                 e.entries
                 if isinstance(e, InflightPrefillGroup)
@@ -2248,7 +2390,7 @@ class JaxEngine:
                         + 1
                     )
                     if any(
-                        s is not None and s.spec is not None
+                        s is not None and _spec_live(s)
                         for s in self.sched.slots
                     ):
                         from ..spec import MAX_DRAFT_TOKENS
@@ -2371,24 +2513,43 @@ class JaxEngine:
                     fresh.extend(pfs)
                 if tick is not None:
                     tick.mark("dispatch")
+                # folded speculation (ISSUE 15): on packed mixed ticks the
+                # speculating lanes' verify columns ride the SAME unified
+                # dispatch as decode rows + prefill chunks -- a
+                # speculating tick is ONE device dispatch.  ``reserve``
+                # keeps the dispatch's fresh-token budget honest about the
+                # verify segments it is about to pack.
+                fold_active = self._fold_spec and mixed_ok
+                spec_reserve = (
+                    self._spec_fold_reserve() if fold_active else 0
+                )
                 chunks = (
                     self.sched.form_mixed_chunks(
-                        self._mixed_budget, self._chunk_tokens
+                        self._mixed_budget, self._chunk_tokens,
+                        reserve_tokens=spec_reserve,
                     )
                     if mixed_ok
                     else []
                 )
                 if tick is not None:
                     tick.mark("assemble")
-                if chunks:
+                ub = None
+                if chunks or spec_reserve:
                     # ONE dispatch serves the whole batch: every decode
-                    # lane rides alongside the packed prefill chunks
+                    # lane rides alongside the packed prefill chunks and
+                    # (folded) the speculating lanes' verify segments
                     ub = await loop.run_in_executor(
-                        self._ex, self._dispatch_unified, chunks
+                        self._ex, self._dispatch_unified, chunks,
+                        fold_active,
                     )
                     if ub is not None:
                         fresh.append(ub)
-                elif (
+                if ub is None and (
+                    # no unified dispatch went out (or the spec candidates
+                    # vanished between the loop-thread check and the
+                    # executor hop): plain decode lanes must still get
+                    # their block -- this branch is a fallthrough, not an
+                    # elif, so that race can never starve them
                     self.sched.num_decode_runnable > 0
                     and self._has_steppable_lane(
                         [e for gen in inflight for e in gen]
@@ -2428,14 +2589,18 @@ class JaxEngine:
                     await self._emit_events(events)
                     if tick is not None:
                         tick.mark("fanout")
-                # speculative verify dispatches AFTER the commit phase: a
-                # lane's next draft extends its post-commit history, so
-                # each spec lane runs one draft->verify->commit cycle per
-                # tick (the dispatch still overlaps this tick's in-flight
-                # decode block on device).  The slot scan gates the
+                # CLASSIC speculative verify dispatches AFTER the commit
+                # phase: a lane's next draft extends its post-commit
+                # history, so each spec lane runs one
+                # draft->verify->commit cycle per tick (the dispatch still
+                # overlaps this tick's in-flight decode block on device).
+                # With folding active the verify columns already rode the
+                # unified dispatch above -- the standalone path serves
+                # classic ticks (penalized lanes), the rectangle layout,
+                # and --no-fold-spec-verify.  The slot scan gates the
                 # executor hop so spec-free serving pays nothing here.
-                if any(
-                    s is not None and s.spec is not None
+                if not fold_active and any(
+                    s is not None and _spec_live(s)
                     for s in self.sched.slots
                 ):
                     vb = await loop.run_in_executor(
@@ -2540,12 +2705,47 @@ class JaxEngine:
                 or s.finish is not None
                 or s.awaiting_kv
                 or s.prefilling
-                or s.spec is not None
+                or _spec_live(s)
             ):
                 continue
             if int(limits[b]) > int(sched.seq_lens[b]) + inflight:
                 return True
         return False
+
+    def _spec_fold_reserve(self) -> int:
+        """Fresh-token rows the speculating lanes would contribute to this
+        tick's unified dispatch (1 committed-token column + the lane's
+        draft budget each), 0 when no lane is verify-eligible right now.
+
+        Loop-thread twin of ``_gather_spec_lanes``'s eligibility gates,
+        INCLUDING the write-headroom gate -- a headroom-paused spec lane
+        (growth pending, capacity cap) must not steer the tick into a
+        unified dispatch that then has nothing to pack, or a chunk-less
+        tick would skip the decode block and starve every plain lane.
+        It decides (a) whether a chunk-less tick still needs the unified
+        dispatch and (b) how many packed rows ``form_mixed_chunks`` must
+        reserve.  An over-estimate (the drafter proposes fewer tokens
+        than budgeted) only costs pad rows the packed fit absorbs."""
+        total = 0
+        limits: Optional[np.ndarray] = None
+        for b, s in enumerate(self.sched.slots):
+            if (
+                s is None
+                or s.finish is not None
+                or not _spec_live(s)
+                or s.spec.inflight
+                or s.awaiting_kv
+                or s.prefilling
+                or b in self._pending_injects
+                or s.num_generated + s.prior_generated < 1
+            ):
+                continue
+            if limits is None:
+                limits = self._compute_limits()
+            if int(limits[b]) - int(self.sched.seq_lens[b]) < 1:
+                continue  # no writable position (the _gather gate)
+            total += 1 + s.spec.num_draft_tokens
+        return total
 
     def _handle_stalled_admission(self) -> None:
         """Nothing running, nothing admitted: requests whose prompts can never
@@ -3266,7 +3466,9 @@ class JaxEngine:
                 and limits[b] > int(sched.seq_lens[b])
                 and not seq.awaiting_kv
                 and not seq.prefilling
-                and seq.spec is None  # spec lanes advance via verify
+                # live-spec lanes advance via verify columns; an
+                # acceptance-disabled lane reverts to the decode scan here
+                and not _spec_live(seq)
             )
             rows["stop"][i] = self._lane_stop_row(seq)
             rows["pages"][i] = sched.page_table[b]
@@ -3415,12 +3617,13 @@ class JaxEngine:
             # a lane with no write headroom must not run: it would scatter
             # its next KV write to the trash page and emit a garbage token.
             # Lanes awaiting a remote prefill's KV stay parked until
-            # delivery; speculating lanes advance via verify dispatches.
+            # delivery; live-spec lanes advance via verify columns (an
+            # acceptance-disabled one is a plain decode lane again).
             active[b] = (
                 limit[b] > int(sched.seq_lens[b])
                 and not seq.awaiting_kv
                 and not seq.prefilling
-                and seq.spec is None
+                and not _spec_live(seq)
             )
             # stop tokens the device may swallow itself (shared helper so
             # the full-rebuild and dirty-row paths cannot diverge)
@@ -3607,7 +3810,7 @@ class JaxEngine:
 
     @hot_path
     def _dispatch_unified(
-        self, chunks: List[Any]
+        self, chunks: List[Any], fold_spec: bool = False
     ) -> Optional["InflightUnified"]:
         """Enqueue one unified ragged mixed-batch step (executor thread).
 
@@ -3622,10 +3825,25 @@ class JaxEngine:
         ride-along.  Host chunk bookkeeping advances at dispatch, exactly
         like ``_dispatch_chunk``, so next tick's formation never re-packs
         dispatched tokens.
+
+        With ``fold_spec`` (packed layout only) the tick's verify-eligible
+        speculating lanes contribute ``1 + draft`` extra segments -- last
+        committed token + host-proposed drafts -- scored in this SAME
+        dispatch (ISSUE 15): a speculating tick pays ONE device launch,
+        not decode + verify.  Their per-column target samples ride the
+        returned record's ``spec_sampled`` handle and commit through the
+        host accept walk at commit time.
         """
         from ..runtime import tracing
 
         sched = self.sched
+        spec_lanes = self._gather_spec_lanes() if fold_spec else []
+        if not chunks and not spec_lanes:
+            # the loop thread saw verify-eligible lanes that vanished
+            # before the executor hop (cancel/preempt race): nothing to
+            # dispatch -- plain decode lanes are better served by the
+            # K-step block next tick
+            return None
         for ch in chunks:
             seq = ch.seq
             self._note_prefetch_admission(seq)
@@ -3666,16 +3884,35 @@ class JaxEngine:
             p_start[b] = ch.start
             p_lens[b] = ch.length
             p_sample[b] = ch.final
-            # speculating lanes sample their first token here but stay
-            # device-inactive: they advance via verify dispatches, and a
-            # device-activated spec lane would be decoded TWICE
-            p_act[b] = ch.final and ch.seq.spec is None
+            # live-spec lanes sample their first token here but stay
+            # device-inactive: they advance via verify columns, and a
+            # device-activated spec lane would be decoded TWICE (an
+            # acceptance-disabled lane activates like any decode lane)
+            p_act[b] = ch.final and not _spec_live(ch.seq)
             n_pf_tokens += ch.length
             # dispatch-ordered host bookkeeping (the _dispatch_chunk rule)
             ch.seq.prefilled_tokens = ch.start + ch.length
             if ch.final:
                 ch.seq.prefilling = False
                 final_chunks.append(ch)
+        # folded verify segments: host-authoritative, exactly like the
+        # standalone verify step -- base = committed cache length (rides
+        # p_start), row 0 = last committed token, rows 1.. = the drafts.
+        # ``inflight`` latches here (dispatch time), released at commit.
+        v_host = np.zeros((B,), np.int32)
+        n_spec_tokens = 0
+        max_d = 0
+        for seq, b, draft in spec_lanes:
+            p_start[b] = sched.seq_lens[b]
+            v_host[b] = 1 + len(draft)
+            n_spec_tokens += 1 + len(draft)
+            max_d = max(max_d, len(draft))
+            seq.spec.inflight = True
+        # verify columns pad to the MAX_DRAFT_TOKENS pow2 rule: the same
+        # {1, 2, 3, 5, 9} set the standalone verify dispatch compiles
+        s_spec = 0
+        if spec_lanes:
+            s_spec = 1 + (pow2_bucket(max_d) if max_d else 0)
         self._sync_device_state()
         d = self._dev
         Pb = self._live_page_bucket()
@@ -3687,10 +3924,11 @@ class JaxEngine:
             dec_cap[b] = (
                 s is not None
                 and p_lens[b] == 0
+                and v_host[b] == 0
                 and s.finish is None
                 and not s.awaiting_kv
                 and not s.prefilling
-                and s.spec is None
+                and not _spec_live(s)
             )
         n_decode = int(dec_cap.sum())
         use_filters = any(
@@ -3705,7 +3943,9 @@ class JaxEngine:
             # chunk.  Segments pack contiguously in slot order; the
             # packed-axis pad also guarantees every live lane's static
             # s_max window fits (the Pallas kernel's slice rule).
-            q_host = np.where(dec_cap, 1, p_lens).astype(np.int32)
+            q_host = np.where(
+                dec_cap, 1, np.where(v_host > 0, v_host, p_lens)
+            ).astype(np.int32)
             total = int(q_host.sum())
             s_nat = pow2_bucket(int(q_host.max()) if total else 1)
             seg_off = np.zeros((B,), np.int32)
@@ -3718,16 +3958,20 @@ class JaxEngine:
                 seg_off[b] = off
                 off_last = off
                 off += ql
-            # (Np, s_max) through the executable-shape budget: reuse or
-            # merge up into an already-minted pair instead of compiling a
-            # fresh executable for every arrival pattern (ISSUE 13
-            # satellite; the budget keeps off_last + s_max <= Np)
-            Np, s_max = self._packed_shapes.fit(s_nat, off_last, total)
+            # (Np, s_max, s_spec) through the executable-shape budget:
+            # reuse or merge up into an already-minted triple instead of
+            # compiling a fresh executable for every arrival pattern
+            # (ISSUE 13 satellite, verify columns included since ISSUE
+            # 15; the budget keeps off_last + s_max <= Np)
+            Np, s_max, s_spec = self._packed_shapes.fit(
+                s_nat, off_last, total, s_spec
+            )
             self.obs.observe_executable_shapes(len(self._packed_shapes))
             t_tokens = np.zeros((Np,), np.int32)
             t_lane = np.full((Np,), B, np.int32)
             t_rel = np.zeros((Np,), np.int32)
             t_dec = np.zeros((Np,), bool)
+            spec_by_slot = {b: draft for _s, b, draft in spec_lanes}
             for b in range(B):
                 ql = int(q_host[b])
                 if ql == 0:
@@ -3740,6 +3984,13 @@ class JaxEngine:
                     t_tokens[o : o + ql] = ch.seq.prompt[
                         ch.start : ch.start + ql
                     ]
+                elif b in spec_by_slot:
+                    # verify segment: committed token + drafts (host
+                    # mirrors authoritative, the verify-dispatch rule)
+                    t_tokens[o] = sched.tokens[b]
+                    dr = spec_by_slot[b]
+                    if dr:
+                        t_tokens[o + 1 : o + 1 + len(dr)] = dr
                 else:
                     t_dec[o] = True
             disp_tokens = Np
@@ -3748,6 +3999,7 @@ class JaxEngine:
                 tick.mark("assemble")
             (
                 packed,
+                spec_packed,
                 d["tokens"],
                 d["seq_lens"],
                 d["active"],
@@ -3773,13 +4025,18 @@ class JaxEngine:
                 self._put_batch(p_act),
                 self._put_batch(dec_cap),
                 self._put_batch(seg_off),
+                self._put_batch(v_host),
                 self._rng,
                 d["sampling"],
                 s_max,
+                s_spec,
                 top_n,
                 use_filters,
             )
         else:
+            # rectangle layout: fold never routes here (fold_spec requires
+            # the packed layout), so no verify segments to place
+            spec_packed = None
             p_tokens = np.zeros((B, S), np.int32)
             for ch in chunks:
                 p_tokens[ch.seq.slot, : ch.length] = ch.seq.prompt[
@@ -3820,7 +4077,7 @@ class JaxEngine:
         # dispatch: `used` real rows, `dispatched` what actually ran,
         # `rectangle` what the [B, S] layout would have run -- the bench
         # reports 1 - used/dispatched vs 1 - used/rectangle
-        used_tokens = n_pf_tokens + n_decode
+        used_tokens = n_pf_tokens + n_decode + n_spec_tokens
         self.mixed_used_tokens += used_tokens
         self.mixed_dispatched_tokens += disp_tokens
         self.mixed_rect_tokens += B * S
@@ -3857,13 +4114,16 @@ class JaxEngine:
         self.obs.observe_dispatch("unified")
         self.obs.observe_mixed(n_decode, n_pf_tokens)
         _start_host_copy(packed)
+        if spec_lanes:
+            _start_host_copy(spec_packed)
         if tick is not None:
             tick.note_dispatch("unified")
             tick.mark("dispatch")
         logger.debug(
             "unified dispatch: %d decode lanes + %d prefill tokens "
-            "(%d chunks, %d final) S=%d",
-            n_decode, n_pf_tokens, len(chunks), len(finals), S,
+            "+ %d verify segments (%d chunks, %d final) S=%d",
+            n_decode, n_pf_tokens, len(spec_lanes), len(chunks),
+            len(finals), S,
         )
         return InflightUnified(
             sampled=packed,
@@ -3871,41 +4131,43 @@ class JaxEngine:
             finals=finals,
             n_decode=n_decode,
             n_prefill_tokens=n_pf_tokens,
+            spec_sampled=spec_packed if spec_lanes else None,
+            spec_lanes=spec_lanes,
         )
 
     # -- speculative decoding (spec/: draft on host, verify in one pass) ----
 
-    @hot_path
-    def _dispatch_verify(self) -> Optional["InflightVerify"]:
-        """Enqueue one batched multi-token verify for the speculating lanes
-        (executor thread).
+    def _gather_spec_lanes(self) -> List[Tuple[SeqState, int, List[int]]]:
+        """Collect the verify-eligible speculating lanes with their drafts
+        (executor thread) -- the ONE eligibility + drafting body behind
+        both the folded unified dispatch and the standalone verify path,
+        so the two cannot drift.
 
-        Per eligible lane: the drafter proposes up to ``num_draft_tokens``
-        continuations of the committed token history (clamped to the
-        lane's write headroom so a draft can never outrun its pages or
-        token budget), and the scheduler packs them as extra columns next
-        to the lane's last committed token.  One ``verify_and_sample``
-        forward scores every column; the host accept walk runs at commit.
-        A lane with no proposal still rides along with zero draft columns
-        -- its verify degenerates to a plain decode step, so speculation
-        never stalls progress.
+        Per eligible lane the proposal comes from the cross-tick draft
+        pipeline first: ``SpecState.pending_draft`` was precomputed at
+        the previous generation's commit (while that tick's device work
+        and async host copies were in flight), so this dispatch-assembly
+        path usually pays a list slice, not a drafter run -- the model
+        drafter's device round trip in particular never sits between two
+        tick dispatches.  A stale or missing precompute falls back to an
+        inline propose.  Draft length clamps to the lane's write headroom
+        so a draft can never outrun its pages or token budget.
 
         Eligibility gates keep the host mirrors authoritative: no verify
-        while the lane's first token is device-only (pending inject), while
-        parked (awaiting_kv / prefilling), or while a previous verify is in
-        flight (the next draft must extend the post-commit history).
-        """
+        while the lane's first token is device-only (pending inject),
+        while parked (awaiting_kv / prefilling), or while a previous
+        verify is in flight (the next draft must extend the post-commit
+        history)."""
         from ..runtime import faults
         from ..spec import MAX_DRAFT_TOKENS
 
         sched = self.sched
         limits = self._compute_limits()
         lanes: List[Tuple[SeqState, int, List[int]]] = []
-        max_d = 0
         # dynalint: disable=DT012 -- routes into dynamo_spec_draft_seconds
         t_draft0 = time.perf_counter()
         for b, seq in enumerate(sched.slots):
-            if seq is None or seq.spec is None or seq.finish is not None:
+            if seq is None or not _spec_live(seq) or seq.finish is not None:
                 continue
             st = seq.spec
             if (
@@ -3923,7 +4185,11 @@ class JaxEngine:
             n = min(st.num_draft_tokens, headroom - 1, MAX_DRAFT_TOKENS)
             draft: List[int] = []
             if n > 0 and seq.blocks is not None:
-                draft = list(st.drafter.propose(seq.blocks.tokens, n))[:n]
+                history = seq.blocks.tokens
+                got = st.take_pending_draft(len(history), n)
+                if got is None:
+                    got = list(st.drafter.propose(history, n))[:n]
+                draft = got
                 if (
                     draft
                     and faults.injector.enabled
@@ -3937,10 +4203,34 @@ class JaxEngine:
                     V = self.model_cfg.vocab_size
                     draft = [(t + 1) % V for t in draft]
             lanes.append((seq, b, draft))
-            if len(draft) > max_d:
-                max_d = len(draft)
+        if lanes:
+            self.spec_metrics.draft_latency.observe(
+                # dynalint: disable=DT012 -- same histogram route
+                max(time.perf_counter() - t_draft0, 0.0)
+            )
+        return lanes
+
+    @hot_path
+    def _dispatch_verify(self) -> Optional["InflightVerify"]:
+        """Enqueue one batched multi-token verify for the speculating lanes
+        (executor thread) -- the STANDALONE verify dispatch, serving
+        classic ticks (penalized lanes), the rectangle layout, and
+        fold-off engines.  Folded engines score verify columns inside the
+        packed unified dispatch instead (``_dispatch_unified``); the two
+        share :meth:`_gather_spec_lanes` and the commit-side accept walk.
+
+        The scheduler packs each gathered lane's draft as extra columns
+        next to its last committed token; one ``verify_and_sample``
+        forward scores every column and the host accept walk runs at
+        commit.  A lane with no proposal still rides along with zero
+        draft columns -- its verify degenerates to a plain decode step,
+        so speculation never stalls progress.
+        """
+        sched = self.sched
+        lanes = self._gather_spec_lanes()
         if not lanes:
             return None
+        max_d = max(len(draft) for _s, _b, draft in lanes)
         B = self.cfg.max_batch_size
         # pad the draft axis to a power of two so compile-cache entries
         # stay at {1, 1+1, 1+2, 1+4, 1+8} columns
@@ -3962,8 +4252,6 @@ class JaxEngine:
         use_filters = any(
             self._sampling_needs_filters(s.sampling) for s, _b, _d in lanes
         )
-        # dynalint: disable=DT012 -- routes into dynamo_spec_draft_seconds
-        draft_s = time.perf_counter() - t_draft0
         # numpy copy of the page-table mirror for the same aliasing reason
         # as _push_device_state: the scheduler mutates it on later ticks
         sampled, self.kv.pages = self._fns.verify_and_sample(
@@ -3983,7 +4271,6 @@ class JaxEngine:
         self.obs.observe_dispatch("verify")
         if self._tick is not None:
             self._tick.note_dispatch("verify")
-        self.spec_metrics.draft_latency.observe(max(draft_s, 0.0))
         _start_host_copy(sampled)
         return InflightVerify(sampled=sampled, lanes=lanes)
 
@@ -4429,9 +4716,14 @@ class JaxEngine:
             # device_wait below measures only the blocked fetch
             tick.mark("dispatch")
         handles = [e.sampled for e in entries]
-        # echo+logprobs scoring rows ride the same bundled transfer
+        # echo+logprobs scoring rows and folded-verify column handles ride
+        # the same bundled transfer
         lp_refs: List[Tuple[Any, int]] = []
+        spec_refs: List[Tuple[Any, int]] = []
         for e in entries:
+            if isinstance(e, InflightUnified) and e.spec_sampled is not None:
+                spec_refs.append((e, len(handles)))
+                handles.append(e.spec_sampled)
             pfs = (
                 e.entries
                 if isinstance(e, InflightPrefillGroup)
@@ -4469,6 +4761,7 @@ class JaxEngine:
             else:
                 self.profiler.note_results_ready()
         lp_mats = {id(pf): mats[i] for pf, i in lp_refs}
+        spec_mats = {id(e): mats[i] for e, i in spec_refs}
         events: List[StepEvent] = []
 
         def commit_prefill(pf: InflightPrefill, row: np.ndarray) -> None:
@@ -4501,73 +4794,6 @@ class JaxEngine:
                 ev.prompt_logprobs = self._prompt_lp_entries(seq, plp[0])
                 seq.prompt_lp_sent = True
             events.append(ev)
-
-        def commit_verify(e: InflightVerify, arr: np.ndarray) -> None:
-            # arr: packed [B, S, 2 + 2N] target samples at every column
-            from ..spec import longest_accepted
-
-            N = (arr.shape[-1] - 2) // 2
-            toks, lps, tids, tlps = unpack_sampled_logprobs(arr, N)
-            for seq, slot, draft in e.lanes:
-                st = seq.spec
-                if st is not None:
-                    st.inflight = False
-                if (
-                    seq.finish is not None
-                    or seq.slot != slot
-                    or self.sched.slots[slot] is not seq
-                    or seq.awaiting_kv
-                ):
-                    # preempted/cancelled mid-verify: the whole column is
-                    # discarded (the existing speculative-rollback path --
-                    # resume re-derives these tokens deterministically)
-                    continue
-                col = toks[slot]
-                m = longest_accepted(draft, col)
-                # committed tokens are the TARGET samples: the verified
-                # draft prefix plus the bonus token at the first mismatch;
-                # trailing columns are marked dead for the host replay
-                column = np.full((col.shape[0],), -1, np.int32)
-                column[: m + 1] = col[: m + 1]
-                ev = self.sched._commit_lane_column(
-                    seq, column, lps[slot],
-                    tids[slot] if N else None,
-                    tlps[slot] if N else None,
-                )
-                if st is not None:
-                    # accepted counts only verified drafts that actually
-                    # COMMITTED: the stop-rule replay can finish the lane
-                    # mid-column, and acceptance must not exceed emitted
-                    # tokens (a verified-but-swallowed stop token is
-                    # conservatively uncounted)
-                    accepted = min(m, len(ev.tokens))
-                    st.drafted += len(draft)
-                    st.accepted += accepted
-                    st.verify_steps += 1
-                    self.spec_drafted += len(draft)
-                    self.spec_accepted += accepted
-                    if draft:
-                        self.spec_metrics.drafted.labels(st.kind).inc(
-                            len(draft)
-                        )
-                        if accepted:
-                            self.spec_metrics.accepted.labels(st.kind).inc(
-                                accepted
-                            )
-                if ev.finished is not None:
-                    seq.finish = ev.finished
-                    self.sched._release_slot(seq)
-                if ev.tokens or ev.finished is not None:
-                    events.append(ev)
-            self.spec_verify_steps += 1
-            self.spec_metrics.verify_steps.inc()
-            if self.spec_drafted:
-                self.spec_metrics.accept_rate.set(
-                    self.spec_accepted / self.spec_drafted
-                )
-            self.spec_metrics.verify_latency.observe(
-                max(now - e.dispatched_at, 0.0)
-            )
 
         # mats are host-resident np arrays (device_get / allgather output):
         # no further np.asarray wrapping, which would read as a sync here
@@ -4622,9 +4848,24 @@ class JaxEngine:
                         )
                         seq.prompt_lp_sent = True
                 events.extend(unified_events)
+                sp = spec_mats.get(id(e))
+                if sp is not None:
+                    # folded verify columns commit AFTER the dispatch's
+                    # decode/prefill columns (disjoint lane sets): same
+                    # accept walk as the standalone path
+                    events.extend(
+                        self._commit_spec_columns(
+                            e.spec_lanes, sp, e.dispatched_at, now
+                        )
+                    )
+                    self.spec_metrics.folded_steps.inc()
                 self.obs.observe_step("unified", now - e.dispatched_at)
             elif isinstance(e, InflightVerify):
-                commit_verify(e, mat)
+                events.extend(
+                    self._commit_spec_columns(
+                        e.lanes, mat, e.dispatched_at, now
+                    )
+                )
                 self.obs.observe_step("verify", now - e.dispatched_at)
             else:
                 arr = mat  # [B, K, 2 + 2N]
@@ -4642,6 +4883,140 @@ class JaxEngine:
         if tick is not None:
             tick.mark("commit")
         return events
+
+    def _commit_spec_columns(
+        self,
+        lanes: List[Tuple[SeqState, int, List[int]]],
+        arr: np.ndarray,  # packed [B, S, 2 + 2N] target samples per column
+        dispatched_at: float,
+        now: float,
+    ) -> List[StepEvent]:
+        """Host accept walk over one verify dispatch's packed columns --
+        the ONE commit body behind the standalone ``InflightVerify`` and
+        the folded unified record, so the two paths cannot drift.
+
+        Committed tokens are the TARGET samples: the verified draft
+        prefix plus the bonus token at the first mismatch; trailing
+        columns are marked dead for the host replay.  A lane
+        preempted/cancelled since dispatch discards its whole column (the
+        existing speculative-rollback path -- resume re-derives these
+        tokens deterministically)."""
+        from ..spec import longest_accepted
+        from .sampling import unpack_sampled_logprobs
+
+        events: List[StepEvent] = []
+        N = (arr.shape[-1] - 2) // 2
+        toks, lps, tids, tlps = unpack_sampled_logprobs(arr, N)
+        for seq, slot, draft in lanes:
+            st = seq.spec
+            if st is not None:
+                st.inflight = False
+            if (
+                seq.finish is not None
+                or seq.slot != slot
+                or self.sched.slots[slot] is not seq
+                or seq.awaiting_kv
+            ):
+                continue
+            col = toks[slot]
+            m = longest_accepted(draft, col)
+            column = np.full((col.shape[0],), -1, np.int32)
+            column[: m + 1] = col[: m + 1]
+            ev = self.sched._commit_lane_column(
+                seq, column, lps[slot],
+                tids[slot] if N else None,
+                tlps[slot] if N else None,
+            )
+            if st is not None:
+                # accepted counts only verified drafts that actually
+                # COMMITTED: the stop-rule replay can finish the lane
+                # mid-column, and acceptance must not exceed emitted
+                # tokens (a verified-but-swallowed stop token is
+                # conservatively uncounted)
+                accepted = min(m, len(ev.tokens))
+                st.drafted += len(draft)
+                st.accepted += accepted
+                st.verify_steps += 1
+                self.spec_drafted += len(draft)
+                self.spec_accepted += accepted
+                if draft:
+                    self.spec_metrics.drafted.labels(st.kind).inc(len(draft))
+                    if accepted:
+                        self.spec_metrics.accepted.labels(st.kind).inc(
+                            accepted
+                        )
+            if ev.finished is not None:
+                seq.finish = ev.finished
+                self.sched._release_slot(seq)
+            elif st is not None:
+                self._spec_post_commit(seq, st)
+            if ev.tokens or ev.finished is not None:
+                events.append(ev)
+        self.spec_verify_steps += 1
+        self.spec_metrics.verify_steps.inc()
+        if self.spec_drafted:
+            self.spec_metrics.accept_rate.set(
+                self.spec_accepted / self.spec_drafted
+            )
+        self.spec_metrics.verify_latency.observe(
+            max(now - dispatched_at, 0.0)
+        )
+        return events
+
+    def _spec_post_commit(self, seq: SeqState, st: Any) -> None:
+        """After a lane's verify columns commit: acceptance-aware
+        auto-disable, then the cross-tick draft pipeline's precompute.
+
+        Auto-disable first: once the lane has drafted past the warmup and
+        its acceptance sits under the floor, speculation turns OFF for
+        the request -- the lane reverts to the plain decode scan (its
+        mirror row folds back with ``active`` True on the next dirty-row
+        scatter) with no output change, because committed tokens were
+        always the target model's.
+
+        Otherwise, propose the NEXT generation's draft right here at
+        commit -- this runs while the pipeline's other generations and
+        their async host copies are still in flight, so the proposal
+        (including a model drafter's device round trip) overlaps device
+        work instead of sitting on the next tick's dispatch-assembly
+        path.  Stamped with the history length; preempt/cancel/rollback
+        invalidates it by construction (``SpecState.take_pending_draft``).
+        """
+        if (
+            self._spec_auto_disable
+            and st.enabled
+            and st.drafted >= self._spec_disable_after
+            and st.accept_rate < self._spec_min_accept
+        ):
+            st.enabled = False
+            st.auto_disabled = True
+            st.pending_draft = None
+            self.spec_auto_disabled += 1
+            self.spec_metrics.auto_disabled.inc()
+            self.spec_metrics.enabled_frac.set(self.spec_enabled_frac)
+            self.sched.dirty_slots.add(seq.slot)
+            logger.debug(
+                "speculation auto-disabled for %s: accept %.3f < %.3f "
+                "after %d drafted",
+                seq.request_id, st.accept_rate, self._spec_min_accept,
+                st.drafted,
+            )
+            return
+        if not st.enabled or seq.blocks is None:
+            return
+        n = st.num_draft_tokens
+        if n <= 0:
+            return
+        history = seq.blocks.tokens
+        try:
+            st.pending_draft = (
+                len(history),
+                list(st.drafter.propose(history, n))[:n],
+            )
+        except Exception:
+            # a drafter crash must cost a proposal, never the request
+            st.pending_draft = None
+            logger.debug("draft precompute failed", exc_info=True)
 
     # -- event/output dispatch (loop thread) --------------------------------
 
@@ -4707,6 +5082,7 @@ class JaxEngine:
                         "accepted_tokens": st.accepted,
                         "acceptance_rate": round(st.accept_rate, 6),
                         "drafter": st.kind,
+                        "auto_disabled": st.auto_disabled,
                     }
                     from ..runtime import tracing
 

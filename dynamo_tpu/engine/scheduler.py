@@ -23,6 +23,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..block_manager import PagePool
+from ..spec.drafter import spec_live
 from ..protocols.common import (
     FinishReason,
     PreprocessedRequest,
@@ -360,17 +361,20 @@ class Scheduler:
 
     @property
     def num_decode_runnable(self) -> int:
-        """Runnable lanes the decode SCAN should step: speculating lanes
-        are excluded -- they advance via the engine's verify dispatches
-        (host-mirror driven), and a decode block over only-spec lanes
-        would burn a dispatch on dead rows."""
+        """Runnable lanes the decode SCAN should step: actively
+        speculating lanes are excluded -- they advance via the engine's
+        verify columns (host-mirror driven), and a decode block over
+        only-spec lanes would burn a dispatch on dead rows.  A lane whose
+        speculation auto-disabled is a plain decode lane again and counts
+        (``spec.drafter.spec_live``: the same predicate the engine's
+        eligibility sites consult)."""
         return sum(
             1
             for s in self.slots
             if s is not None
             and not s.awaiting_kv
             and not s.prefilling
-            and s.spec is None
+            and not spec_live(s.spec)
         )
 
     @property
@@ -566,13 +570,17 @@ class Scheduler:
             self.mix_pending.append(seq)
 
     def form_mixed_chunks(
-        self, budget: int, chunk_cap: Optional[int] = None
+        self, budget: int, chunk_cap: Optional[int] = None,
+        reserve_tokens: int = 0,
     ) -> List[MixedChunk]:
         """Pack pending prefill work into this tick's unified dispatch.
 
         ``budget`` is the dispatch's total fresh-token budget
         (``DYN_MIXED_TOKEN_BUDGET``): every decode-runnable lane costs one
-        token, the remainder goes to prefill chunks in arrival order.  At
+        token, ``reserve_tokens`` rows are withheld for the tick's folded
+        speculative-verify segments (the engine's spec-fold reserve -- a
+        verify column is a fresh row like any other under the packed
+        layout), the remainder goes to prefill chunks in arrival order.  At
         least one prompt token always packs when prefill work is pending,
         so a decode batch as wide as the budget can never starve
         admission.  ``chunk_cap`` bounds one lane's chunk (the
@@ -591,7 +599,7 @@ class Scheduler:
         page packs anyway (slight budget overshoot beats starvation).
         """
         ps = self.cfg.page_size
-        left = max(budget - self.num_decode_runnable, 1)
+        left = max(budget - self.num_decode_runnable - reserve_tokens, 1)
         chunks: List[MixedChunk] = []
         still: List[SeqState] = []
         seen: set = set()

@@ -443,6 +443,56 @@ unified_step = partial(
 )(_unified_step)
 
 
+def _spec_columns_epilogue(
+    params: Params,
+    cfg: ModelConfig,
+    hidden: jax.Array,  # [Np, H] packed trunk output
+    base: jax.Array,  # [B] committed cache length per lane
+    seg_off: jax.Array,  # [B] lane's segment offset into the packed axis
+    v_lens: jax.Array,  # [B] verify columns per lane (0 = not speculating)
+    rng: jax.Array,
+    sampling: SamplingParams,
+    s_spec: int,  # static column width (1 + pow2(draft), budget-merged)
+    top_n: int,
+    use_filters: bool,
+) -> jax.Array:
+    """Folded-verify sampling: the per-column half of
+    :func:`_verify_and_sample` over the packed layout.
+
+    Column ``j`` of a speculating lane sits at packed row ``seg_off + j``
+    (its KV landed at ``base + j`` via the shared packed write) and its
+    logits sample the target token for position ``base + j + 1`` -- the
+    exact position-keying of the standalone verify step and the decode
+    scan, so greedy and seeded lanes are bit-identical to the
+    two-dispatch path.  All ``B x s_spec`` columns sample in ONE
+    vectorized call (sampling params repeat per column; per-request
+    seeded noise is a pure function of (seed, position), so column
+    batching cannot perturb it).  Invalid columns (j >= v_lens,
+    non-speculating lanes) report token ``-1``.
+
+    Returns packed [B, s_spec, 2 + 2*top_n] int32."""
+    B = base.shape[0]
+    Np = hidden.shape[0]
+    cols = jnp.arange(s_spec, dtype=jnp.int32)
+    idx = jnp.clip(seg_off[:, None] + cols[None, :], 0, Np - 1)  # [B, S]
+    rows = hidden[idx.reshape(-1)]  # [B*S, H]
+    logits = lm_logits(params, cfg, rows)  # [B*S, V]
+    positions = (base[:, None] + 1 + cols[None, :]).reshape(-1)
+    tiled = SamplingParams(
+        *(
+            jnp.repeat(leaf, s_spec, axis=0) if leaf is not None else None
+            for leaf in sampling
+        )
+    )
+    sampled = sample_tokens(logits, rng, tiled, use_filters, positions=positions)
+    lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
+    valid = (cols[None, :] < v_lens[:, None]).reshape(-1)
+    out = jnp.where(valid, sampled, -1)
+    return pack_sampled_logprobs(out, lp, top_ids, top_lps).reshape(
+        B, s_spec, -1
+    )
+
+
 def _packed_unified_step(
     params: Params,
     cfg: ModelConfig,
@@ -453,26 +503,36 @@ def _packed_unified_step(
     active: jax.Array,  # [B] bool: decode lanes the scan would step
     stop_ids: jax.Array,  # [B, E] device-checked stop tokens (-1 = pad)
     page_table: jax.Array,  # [B, P] (bucketed)
-    t_tokens: jax.Array,  # [Np] packed fresh tokens (prefill chunk rows)
+    t_tokens: jax.Array,  # [Np] packed fresh tokens (prefill chunk rows,
+    # and a speculating lane's last-committed token + draft columns)
     t_lane: jax.Array,  # [Np] lane per packed token (B = padding)
     t_rel: jax.Array,  # [Np] row index within the lane's segment
     t_dec: jax.Array,  # [Np] bool: row carries a decode lane's query (its
     # token is read from the device-resident ``tokens`` vector, so packed
     # steps pipeline exactly like rectangle ones)
-    p_start: jax.Array,  # [B] chunk start position (0 on decode lanes)
-    p_lens: jax.Array,  # [B] chunk length; 0 = decode (or idle) lane
+    p_start: jax.Array,  # [B] chunk start position (0 on decode lanes;
+    # the committed cache length on speculating lanes -- host mirrors are
+    # authoritative for them, exactly like the standalone verify step)
+    p_lens: jax.Array,  # [B] chunk length; 0 = decode / spec / idle lane
     p_sample: jax.Array,  # [B] bool: final chunk -> sample first token
     p_activate: jax.Array,  # [B] bool: final chunk also joins decode
     dec_cap: jax.Array,  # [B] bool: host packed a decode row for the lane
     seg_off: jax.Array,  # [B] lane's segment offset into the packed axis
+    v_lens: jax.Array,  # [B] folded-verify columns (1 + draft len; 0 =
+    # lane not speculating this dispatch)
     rng: jax.Array,
     sampling: SamplingParams,
     s_max: int,  # static per-lane window capacity (pow2 of max segment)
+    s_spec: int = 0,  # static folded-verify column width (0 = spec-free
+    # dispatch: the program is exactly the pre-fold one, no spec sampler
+    # and no extra rng split, so spec-free serving compiles and runs the
+    # identical executable it always did)
     top_n: int = 0,
     use_filters: bool = True,
 ) -> Tuple[jax.Array, ...]:
-    """Fully-packed unified mixed step (ISSUE 10): the rectangle step's
-    semantics over a flat ``[Np]`` token axis.
+    """Fully-packed unified mixed step (ISSUE 10 + folded verify, ISSUE
+    15): the rectangle step's semantics over a flat ``[Np]`` token axis,
+    with speculative verify columns as just more segments.
 
     Where :func:`_unified_step` pads every lane's query axis to the
     dispatch's max chunk (a ``[B, S]`` trunk for ``used << B*S`` real
@@ -490,14 +550,34 @@ def _packed_unified_step(
     greedy and seeded lanes are token-identical to the rectangle and
     classic paths.
 
-    Returns ``(packed [B, 2 + 2*top_n], tokens, seq_lens, active,
-    kv_pages, rng)`` -- the exact :func:`_unified_step` contract, so the
-    engine's commit path is layout-blind."""
+    A speculating lane (``v_lens > 0``) contributes ``1 + draft`` rows:
+    row 0 its last committed token, rows 1.. the host-proposed drafts.
+    Attention (resident prefix ``< base`` + causal fresh rows) and the
+    token-granular KV scatter are the SAME packed calls every other
+    segment takes -- verify columns stopped being a dispatch and became
+    a layout.  Their per-column target samples come from
+    :func:`_spec_columns_epilogue` and commit through the host accept
+    walk; the single-token epilogue ignores them (``active`` is False
+    and ``p_lens`` is 0 on spec lanes, so ``live`` never fires).
+
+    Returns ``(packed [B, 2 + 2*top_n], spec_packed [B, s_spec, 2 +
+    2*top_n], tokens, seq_lens, active, kv_pages, rng)`` -- the
+    :func:`_unified_step` contract plus the folded-verify columns
+    (zero-width when ``s_spec == 0``)."""
     B = tokens.shape[0]
     Np = t_tokens.shape[0]
     is_pf = p_lens > 0
-    q_lens = jnp.where(is_pf, p_lens, (dec_cap & active).astype(jnp.int32))
-    base = jnp.where(is_pf, p_start, seq_lens).astype(jnp.int32)
+    if s_spec > 0:
+        is_sp = v_lens > 0
+        q_lens = jnp.where(
+            is_pf,
+            p_lens,
+            jnp.where(is_sp, v_lens, (dec_cap & active).astype(jnp.int32)),
+        )
+        base = jnp.where(is_pf | is_sp, p_start, seq_lens).astype(jnp.int32)
+    else:
+        q_lens = jnp.where(is_pf, p_lens, (dec_cap & active).astype(jnp.int32))
+        base = jnp.where(is_pf, p_start, seq_lens).astype(jnp.int32)
     lane_c = jnp.clip(t_lane, 0, B - 1)
     tok_flat = jnp.where(t_dec, tokens[lane_c], t_tokens)
     pos = base[lane_c] + t_rel
@@ -517,6 +597,14 @@ def _packed_unified_step(
     hidden, kv_pages = transformer(
         params, cfg, tok_flat[None], positions[None], kv_pages, attn_fn
     )
+    if s_spec > 0:
+        rng, spec_sub = jax.random.split(rng)
+        spec_packed = _spec_columns_epilogue(
+            params, cfg, hidden[0], base, seg_off, v_lens, spec_sub,
+            sampling, s_spec, top_n, use_filters,
+        )
+    else:
+        spec_packed = jnp.zeros((B, 0, 2 + 2 * top_n), jnp.int32)
     last = jnp.clip(seg_off + q_lens - 1, 0, Np - 1)
     hidden_last = hidden[0, last]  # [B, H]
     logits = lm_logits(params, cfg, hidden_last)  # [B, V]
@@ -525,12 +613,12 @@ def _packed_unified_step(
         tokens, seq_lens, limit_lens, active, stop_ids, rng, sampling,
         top_n, use_filters,
     )
-    return packed, new_tokens, new_seq, new_active, kv_pages, rng
+    return packed, spec_packed, new_tokens, new_seq, new_active, kv_pages, rng
 
 
 packed_unified_step = partial(
     jax.jit,
-    static_argnames=("cfg", "s_max", "top_n", "use_filters"),
+    static_argnames=("cfg", "s_max", "s_spec", "top_n", "use_filters"),
     donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
 )(_packed_unified_step)
 

@@ -325,19 +325,22 @@ def make_sharded_steps(
     )
     packed_unified_step = jax.jit(
         _step._packed_unified_step,
-        static_argnames=("cfg", "s_max", "top_n", "use_filters"),
+        static_argnames=("cfg", "s_max", "s_spec", "top_n", "use_filters"),
         donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
         # (params, kv, tokens, seq_lens, limit_lens, active, stop_ids,
         #  page_table, t_tokens, t_lane, t_rel, t_dec, p_start, p_lens,
-        #  p_sample, p_activate, dec_cap, seg_off, rng, sampling): the
-        # packed [Np] token axis interleaves lanes arbitrarily, so it
-        # stays unconstrained (GSPMD gathers from the dp-sharded state)
+        #  p_sample, p_activate, dec_cap, seg_off, v_lens, rng, sampling):
+        # the packed [Np] token axis interleaves lanes arbitrarily, so it
+        # stays unconstrained (GSPMD gathers from the dp-sharded state);
+        # the two packed outputs (single-token + folded-verify columns)
+        # are host-bound device_get handles, left unconstrained like the
+        # other steps' packed outputs
         in_shardings=(
             param_sh, kv_sh, vec, vec, vec, vec, mat, mat,
-            None, None, None, None, vec, vec, vec, vec, vec, vec,
+            None, None, None, None, vec, vec, vec, vec, vec, vec, vec,
             None, samp,
         ),
-        out_shardings=(None, vec, vec, vec, kv_sh, None),
+        out_shardings=(None, None, vec, vec, vec, kv_sh, None),
     )
     verify_and_sample = jax.jit(
         _step._verify_and_sample,
@@ -432,4 +435,24 @@ def make_sharded_steps(
         slice_block_pages=slice_block_pages,
         gather_layer_pages=gather_layer_pages,
         scatter_layer_pages=scatter_layer_pages,
+    )
+
+
+def make_sharded_drafter(mesh: Mesh, params: Params):
+    """Re-jit the model drafter's greedy forward with explicit in/out
+    shardings for the serving mesh (the make_sharded_steps contract
+    applied to the SECOND weight load): draft params stay pinned to the
+    tp layout the loader placed them with, the tiny token window and the
+    [1, n] proposal are replicated -- a placement drift of the draft
+    weights surfaces at the next propose, never as a silent all-gather
+    on the target's decode path."""
+    from ..spec.model_drafter import _draft_greedy_tokens
+
+    param_sh = jax.tree_util.tree_map(lambda x: x.sharding, params)
+    return jax.jit(
+        _draft_greedy_tokens,
+        static_argnames=("cfg", "n"),
+        # (params, tokens, length): window/length/proposal replicated
+        in_shardings=(param_sh, None, None),
+        out_shardings=None,
     )

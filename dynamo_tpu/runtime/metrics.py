@@ -401,7 +401,21 @@ class SpecMetrics:
         )
         self.verify_steps = reg.counter(
             "dynamo_spec_verify_steps",
-            "Batched multi-token verify dispatches",
+            "Batched multi-token verify passes (standalone or folded)",
+        )
+        self.folded_steps = reg.counter(
+            "dynamo_spec_folded_verify_steps",
+            "Verify column groups folded into packed unified dispatches "
+            "(ISSUE 15: no standalone verify dispatch was paid for these)",
+        )
+        self.auto_disabled = reg.counter(
+            "dynamo_spec_auto_disabled_requests",
+            "Requests whose speculation auto-disabled on low acceptance",
+        )
+        self.enabled_frac = reg.gauge(
+            "dynamo_spec_enabled_frac",
+            "Fraction of spec-armed requests still drafting "
+            "(1 - auto_disabled/armed)",
         )
         self.requests = reg.counter(
             "dynamo_spec_requests",

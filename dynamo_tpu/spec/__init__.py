@@ -16,9 +16,19 @@ decode for greedy and seeded lanes (per-lane noise is a pure function of
 (seed, position) -- ``sampling._lane_gumbel``).
 
 The package is engine-agnostic: drafters see token histories, never device
-state.  ``Drafter`` is the extension point (RTP-LLM-style small-model
-drafting would plug in here); :class:`NGramDrafter` is the model-free
-prompt-lookup baseline that needs no second weight load.
+state.  ``Drafter`` is the extension point; :class:`NGramDrafter` is the
+model-free prompt-lookup baseline that needs no second weight load, and
+:class:`~.model_drafter.ModelDrafter` (``spec/model_drafter.py``) is the
+RTP-LLM-style learned proposer -- a second small weight load, TP-sharded
+onto the serving mesh, registered under kind ``"model"`` when the engine
+is armed with ``draft_model``.
+
+With the packed unified dispatch (ISSUE 15), verify is not even a
+separate dispatch on the serving hot path: speculating lanes' columns
+fold into ``step.packed_unified_step`` as additional flat-axis segments
+(``verify_and_sample`` remains the classic-path / rectangle fallback),
+and acceptance-aware auto-disable reverts low-acceptance lanes to plain
+decode so speculation is safe to run default-on.
 """
 
 from .drafter import (
@@ -30,6 +40,7 @@ from .drafter import (
     longest_accepted,
     make_drafter,
     register_drafter,
+    spec_live,
 )
 
 __all__ = [
@@ -41,4 +52,5 @@ __all__ = [
     "longest_accepted",
     "make_drafter",
     "register_drafter",
+    "spec_live",
 ]

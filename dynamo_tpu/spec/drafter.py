@@ -27,7 +27,16 @@ way).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Protocol, Sequence, runtime_checkable
+from typing import (
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Protocol,
+    Sequence,
+    Tuple,
+    runtime_checkable,
+)
 
 from ..analysis.hotpath import hot_path
 
@@ -100,6 +109,16 @@ class NGramDrafter:
         return []
 
 
+def spec_live(spec: Optional["SpecState"]) -> bool:
+    """Whether a lane's speculation is ACTIVE: armed and not
+    auto-disabled.  The ONE predicate every eligibility site consults --
+    the engine's device-activity/dec_cap/verify gates AND the scheduler's
+    decode-runnable count -- so an acceptance-disabled lane looks exactly
+    like a plain decode lane everywhere at once (host and device views of
+    who steps it must never diverge)."""
+    return spec is not None and spec.enabled
+
+
 def longest_accepted(draft: Sequence[int], target: Sequence[int]) -> int:
     """Length of the verified draft prefix: ``draft[j]`` is accepted while
     it equals ``target[j]`` -- the token the model sampled at that same
@@ -129,10 +148,35 @@ class SpecState:
     # a verify dispatch for this lane is in flight; the next one waits for
     # its commit (drafts extend the post-commit history)
     inflight: bool = False
+    # acceptance-aware auto-disable (engine knob spec_auto_disable): a
+    # lane whose warmed-up acceptance rate stays below the floor stops
+    # drafting and reverts to the plain decode scan -- low-acceptance
+    # traffic must not keep paying draft + rejected-column cost.  The
+    # SpecState stays attached (stats still ship in the usage extension);
+    # ``enabled`` is what every engine eligibility site consults.
+    enabled: bool = True
+    auto_disabled: bool = False
+    # cross-tick draft pipelining: the NEXT generation's proposal,
+    # precomputed at commit time (while the tick's other device work and
+    # async host copies are in flight) as ``(history_len, tokens)``.  The
+    # dispatch assembly consumes it only when ``history_len`` still equals
+    # the lane's committed history -- a preempt/cancel/rollback since the
+    # precompute invalidates it by construction (committed histories only
+    # ever extend, so a length match IS an identity match for one seq).
+    pending_draft: Optional[Tuple[int, List[int]]] = None
 
     @property
     def accept_rate(self) -> float:
         return self.accepted / self.drafted if self.drafted else 0.0
+
+    def take_pending_draft(self, history_len: int, n: int) -> Optional[List[int]]:
+        """Consume the precomputed proposal if it extends exactly the
+        current committed history; None forces an inline propose."""
+        got = self.pending_draft
+        self.pending_draft = None
+        if got is None or got[0] != history_len:
+            return None
+        return got[1][:n]
 
 
 # kind -> zero-arg factory.  ``prompt_lookup`` aliases ``ngram`` (the

@@ -1,0 +1,188 @@
+"""Model-based drafter: a second (small) weight load proposing drafts.
+
+The n-gram drafter earns its acceptance only on repetitive text; real
+traffic needs a learned proposer (RTP-LLM ships speculative decode as a
+first-class production path with exactly this shape).  :class:`ModelDrafter`
+runs a small draft model -- a SECOND weight load, TP-sharded onto the same
+serving mesh as the target when one exists -- greedily for ``n`` tokens
+over a bounded history window, in ONE jitted device dispatch per proposal
+(:func:`draft_greedy_tokens` scans the n autoregressive steps on device).
+
+Design constraints, in order:
+
+* **No KV cache.**  The draft model recomputes causal attention over the
+  last ``window`` history tokens each proposal.  A paged draft-KV pool
+  would double the cache-management surface for a model that is supposed
+  to be ~10x smaller than the target; an O(window^2) recompute of a tiny
+  trunk is cheaper than owning that machinery, and it makes the drafter
+  stateless -- preemption, swap, and cancellation need no draft-side
+  bookkeeping at all.
+* **Bounded executables.**  The window pads to a pow2 bucket and the
+  draft count to the verify path's own pow2 rule, so the compile-cache
+  surface is O(log window x log MAX_DRAFT_TOKENS).
+* **Proposals are hints.**  Like every drafter, a wrong (or truncated,
+  or stale) proposal costs acceptance, never output -- the verify step
+  commits only the target model's samples.
+
+The one deliberate protocol deviation: ``propose`` performs a device
+round trip (dispatch + host fetch of n int32s).  That sync must stay off
+the tick's dispatch-assembly path, which is why the engine precomputes
+proposals at commit time (``SpecState.pending_draft``) -- the drafter
+forward then overlaps the next generation's device work instead of
+sitting between two dispatches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.bucketing import pow2_bucket
+from ..engine.config import ModelConfig
+from ..engine.model import init_params, lm_logits, transformer
+from .drafter import MAX_DRAFT_TOKENS
+
+
+def _draft_greedy_tokens(
+    params: Any,
+    cfg: ModelConfig,
+    tokens: jax.Array,  # [1, W + n] window tokens, zero-padded tail
+    length: jax.Array,  # scalar i32: valid history tokens in the window
+    n: int,  # static draft count (pow2-bucketed by the caller)
+) -> jax.Array:
+    """Greedy n-token draft in one dispatch: each step reruns the trunk
+    causally over the (growing) window -- no KV pages, the window IS the
+    context -- takes the last valid position's logits, argmaxes, and
+    appends.  The trunk is the same :func:`~..engine.model.transformer`
+    the target runs, so any supported draft architecture works.
+
+    Returns [1, n] int32 proposed tokens."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    # the trunk only reads kv_pages.shape[0] (layer count) when the attn
+    # callback never touches the cache; a [L, 0] placeholder keeps the
+    # scan signature without allocating a pool
+    dummy_kv = jnp.zeros((cfg.num_layers, 0), jnp.dtype(cfg.dtype))
+
+    def step(carry, _):
+        buf, cur = carry  # buf [B, T], cur scalar: valid tokens so far
+
+        def attn_fn(q, k, v, kv, layer):
+            from ..engine import attention as att
+
+            out = att.prefill_attention(
+                q, k, v, jnp.full((B,), cur, jnp.int32),
+                cfg.sliding_window or 0,
+            )
+            return out, kv
+
+        hidden, _ = transformer(params, cfg, buf, positions, dummy_kv, attn_fn)
+        last = jnp.clip(cur - 1, 0, T - 1)
+        logits = lm_logits(params, cfg, hidden[:, last])  # [B, V]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+        buf = buf.at[jnp.arange(B), jnp.minimum(cur, T - 1)].set(nxt)
+        return (buf, cur + 1), nxt
+
+    (_, _), drafted = jax.lax.scan(step, (tokens, length), None, length=n)
+    return drafted.T  # [B, n]
+
+
+draft_greedy_tokens = partial(jax.jit, static_argnames=("cfg", "n"))(
+    _draft_greedy_tokens
+)
+
+
+class ModelDrafter:
+    """Drafter protocol over a loaded draft model (one shared instance per
+    engine: ``propose`` is stateless, so every speculating request reuses
+    the same jitted forward and compile cache)."""
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: ModelConfig,
+        window: int = 64,
+        mesh: Optional[Any] = None,
+    ) -> None:
+        self.params = params
+        self.cfg = cfg
+        self.window = max(int(window), 8)
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharding import make_sharded_drafter
+
+            self._fwd = make_sharded_drafter(mesh, params)
+        else:
+            self._fwd = draft_greedy_tokens
+
+    def propose(self, history: Sequence[int], n: int) -> List[int]:
+        n = min(int(n), MAX_DRAFT_TOKENS)
+        if n <= 0 or not history:
+            return []
+        n_pad = pow2_bucket(n)  # static draft axis: {1, 2, 4, 8}
+        tail = list(history[-self.window:])
+        # window bucket covers history + the n_pad appended drafts so the
+        # scan never clips a freshly-drafted token out of context
+        T = pow2_bucket(len(tail) + n_pad, floor=8)
+        buf = np.zeros((1, T), np.int32)
+        buf[0, : len(tail)] = tail
+        drafted = self._fwd(
+            self.params, self.cfg, jnp.asarray(buf),
+            jnp.int32(len(tail)), n_pad,
+        )
+        # the ONE designed host sync of the model drafter (n_pad int32s);
+        # the engine schedules propose off the dispatch path (see module
+        # docstring) so this never sits between two tick dispatches
+        return [int(t) for t in np.asarray(drafted)[0][:n]]
+
+
+def load_draft_model(
+    spec: str, mesh: Optional[Any] = None
+) -> Tuple[ModelConfig, Any]:
+    """Resolve a ``draft_model`` spec to (config, params), TP-sharded onto
+    ``mesh`` when one exists.
+
+    Grammar: a checkpoint directory path (safetensors/GGUF, the exact
+    loaders the target uses), or ``random[:seed]`` -- a tiny random-init
+    draft model for tests and the CPU bench smoke (seed defaults to 0,
+    which matches ``JaxEngine.random_init``'s default so a tiny target
+    and its ``random`` drafter share weights -- a deterministic
+    perfect-drafter preset)."""
+    if spec.startswith("random"):
+        _, _, seed_s = spec.partition(":")
+        seed = int(seed_s) if seed_s else 0
+        cfg = ModelConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(seed))
+    else:
+        cfg = ModelConfig.from_pretrained(spec)
+        shardings = None
+        if mesh is not None:
+            from ..parallel.sharding import param_shardings
+
+            shardings = param_shardings(cfg, mesh)
+        import os
+
+        from ..engine.weights import load_safetensors_params
+
+        if os.path.isdir(spec) and any(
+            f.endswith(".safetensors") for f in os.listdir(spec)
+        ):
+            params = load_safetensors_params(spec, cfg, shardings=shardings)
+        else:
+            from ..llm.gguf import find_gguf_file, load_gguf_params
+
+            gguf = find_gguf_file(spec)
+            if gguf is None:
+                raise FileNotFoundError(
+                    f"draft_model {spec!r}: no .safetensors and no .gguf"
+                )
+            params = load_gguf_params(gguf, cfg, shardings=shardings)
+    if mesh is not None and spec.startswith("random"):
+        from ..parallel.sharding import shard_params
+
+        params = shard_params(params, cfg, mesh)
+    return cfg, params

@@ -314,28 +314,45 @@ def test_mocker_zero_latency_mode_unchanged(run):
 
 def test_packed_shape_budget_reuse_and_merge():
     b = PackedShapeBudget(budget=2)
-    # two natural pairs mint freely
+    # two natural triples mint freely
     p1 = b.fit(4, 10, 14)  # Np = pow2(14) = 16
-    assert p1 == (16, 4)
+    assert p1 == (16, 4, 0)
     p2 = b.fit(8, 8, 16)  # Np = pow2(16) = 16
-    assert p2 == (16, 8)
+    assert p2 == (16, 8, 0)
     assert len(b) == 2
-    # a third, smaller shape merges up into a dominating minted pair
-    p3 = b.fit(2, 6, 8)  # natural would be (8, 2); (16,4) dominates
-    assert p3 in ((16, 4), (16, 8))
+    # a third, smaller shape merges up into a dominating minted triple
+    p3 = b.fit(2, 6, 8)  # natural would be (8, 2, 0); (16,4,0) dominates
+    assert p3 in ((16, 4, 0), (16, 8, 0))
     assert len(b) == 2 and b.merges == 1
-    # the kernel slice rule holds for the merged pair
-    np_m, s_m = p3
+    # the kernel slice rule holds for the merged triple
+    np_m, s_m, _sp = p3
     assert 6 + s_m <= np_m and 8 <= np_m
 
 
 def test_packed_shape_budget_eviction_on_new_widest():
     b = PackedShapeBudget(budget=1)
-    assert b.fit(2, 2, 4) == (4, 2)
+    assert b.fit(2, 2, 4) == (4, 2, 0)
     # nothing minted dominates a wider window: evict LRU and mint
     got = b.fit(16, 0, 16)
-    assert got == (16, 16)
+    assert got == (16, 16, 0)
     assert b.evictions == 1 and len(b) == 1
+
+
+def test_packed_shape_budget_spec_columns():
+    """Folded-verify column widths (ISSUE 15) ride the same budget: a
+    spec-carrying dispatch mints/merges triples with s_spec > 0, a
+    spec-free dispatch never merges INTO one (it would pay the column
+    sampler for nothing), and spec widths only pad UP."""
+    b = PackedShapeBudget(budget=2)
+    assert b.fit(8, 0, 8, s_spec=5) == (8, 8, 5)
+    # spec-free request at the budget: must not merge into the spec triple
+    assert b.fit(8, 0, 8, s_spec=0) == (8, 8, 0)
+    assert b.merges == 0 and len(b) == 2
+    # a narrower spec width merges up into the dominating spec triple
+    got = b.fit(8, 0, 8, s_spec=3)
+    assert got == (8, 8, 5) and b.merges == 1
+    # spec shapes are observable for the gauge test
+    assert b.spec_shapes == [(8, 8, 5)]
 
 
 def test_packed_shape_budget_invariant_random():
@@ -347,8 +364,13 @@ def test_packed_shape_budget_invariant_random():
         s = pow2_bucket(rng.randint(1, 64))
         off = rng.randint(0, 256)
         total = off + rng.randint(1, s)
-        np_got, s_got = b.fit(s, off, total)
+        # ~half the dispatches speculate: the verify pad rule's widths
+        sp = rng.choice((0, 0, 2, 3, 5, 9))
+        np_got, s_got, sp_got = b.fit(s, off, total, s_spec=sp)
         assert s_got >= s
+        assert sp_got >= sp
+        assert sp == 0 or sp_got > 0
+        assert not (sp == 0 and sp_got > 0)
         assert off + s_got <= np_got
         assert total <= np_got
     assert len(b) <= 4

@@ -1,0 +1,671 @@
+"""Folded speculative verify (ISSUE 15): verify columns ride the packed
+unified dispatch -- a speculating tick is ONE device launch -- with
+token identity (greedy AND seeded) against the two-dispatch path, the
+acceptance-aware auto-disable, the cross-tick draft pipeline, and the
+registry-loaded model-based drafter.
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.engine.model import init_params
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    SpeculationOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Annotated, Context
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+from dynamo_tpu.spec import register_drafter
+
+from tests.test_spec import OracleDrafter, WrongDrafter, collect, req, spec_opts
+
+
+def make_engine(registry=None, **cfg_kw) -> JaxEngine:
+    defaults = dict(max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64)
+    defaults.update(cfg_kw)
+    return JaxEngine(
+        ModelConfig.tiny(),
+        init_params(ModelConfig.tiny(), jax.random.PRNGKey(0)),
+        EngineConfig(**defaults),
+        metrics_registry=registry,
+    )
+
+
+# -- the acceptance criterion: ONE dispatch per speculating tick -------------
+
+
+def test_folded_spec_single_dispatch_per_tick(run):
+    """With folding on (the default), a speculating workload issues ZERO
+    standalone verify dispatches -- every verify rode a unified dispatch
+    -- asserted through dynamo_engine_dispatches_total{kind} and the
+    folded-steps counter, while speculation still commits multi-token
+    columns (verify passes < tokens)."""
+
+    async def body():
+        reg = MetricsRegistry()
+        engine = make_engine(registry=reg)
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=16))
+            register_drafter(
+                "fold-oracle", lambda: OracleDrafter(prompt + base)
+            )
+            v0 = engine.spec_verify_steps
+            out, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=16, spec=spec_opts(drafter="fold-oracle")),
+            )
+            assert out == base
+            assert stats["accepted_tokens"] > 0
+            # the one-dispatch invariant: no verify-kind dispatch was paid
+            # (the labeled series never even appears)
+            assert (
+                reg.sample(
+                    "dynamo_engine_dispatches", {"kind": "verify"}
+                ) or 0
+            ) == 0
+            assert reg.sample(
+                "dynamo_engine_dispatches", {"kind": "unified"}
+            ) > 0
+            folded = reg.sample("dynamo_spec_folded_verify_steps")
+            assert folded > 0
+            assert folded == engine.spec_verify_steps - v0
+            # multi-token commits: fewer verify passes than tokens
+            assert engine.spec_verify_steps - v0 < len(out)
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_fold_off_keeps_standalone_verify_dispatch(run):
+    """--no-fold-spec-verify is the exact two-dispatch fallback: verify
+    dispatches reappear under the 'verify' kind and output is unchanged."""
+
+    async def body():
+        reg = MetricsRegistry()
+        engine = make_engine(registry=reg, fold_spec_verify=False)
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=12))
+            register_drafter(
+                "unfold-oracle", lambda: OracleDrafter(prompt + base)
+            )
+            out, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=12,
+                    spec=spec_opts(drafter="unfold-oracle")),
+            )
+            assert out == base and stats["accepted_tokens"] > 0
+            assert reg.sample(
+                "dynamo_engine_dispatches", {"kind": "verify"}
+            ) > 0
+            assert reg.sample("dynamo_spec_folded_verify_steps") == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- token identity folded vs post-commit ------------------------------------
+
+
+def _mixed_requests(prompts, base_outs, drafter_prefix):
+    """Half the lanes speculate (oracle drafters), half decode plain."""
+    reqs = []
+    for i, (p, b) in enumerate(zip(prompts, base_outs)):
+        name = f"{drafter_prefix}-{i}"
+        register_drafter(name, (lambda full: lambda: OracleDrafter(full))(p + b))
+        reqs.append(
+            req(p, max_tokens=10,
+                spec=spec_opts(drafter=name) if i % 2 == 0 else None)
+        )
+    return reqs
+
+
+def test_folded_identity_vs_postcommit_mixed_batch(run):
+    """The headline identity: a mixed spec/non-spec batch produces
+    byte-identical token streams with folding on vs the two-dispatch
+    path, greedy, under async dispatch."""
+
+    prompts = [[1, 2, 3], [9, 8, 7, 6], [5, 5, 5, 5, 5], [2, 4]]
+
+    async def one(fold):
+        engine = make_engine(fold_spec_verify=fold)
+        try:
+            base = [
+                (await collect(engine, req(p, max_tokens=10)))[0]
+                for p in prompts
+            ]
+            reqs = _mixed_requests(
+                prompts, base, f"ab-{'fold' if fold else 'two'}"
+            )
+            results = await asyncio.gather(
+                *[collect(engine, r) for r in reqs]
+            )
+            return base, [r[0] for r in results]
+        finally:
+            await engine.stop()
+
+    async def body():
+        base_f, folded = await one(True)
+        base_t, two = await one(False)
+        assert base_f == base_t  # plain decode is config-independent
+        assert folded == two == base_f
+
+    run(body())
+
+
+def test_folded_seeded_identity(run):
+    """Seeded sampling at temperature: folded verify keys every column by
+    (seed, position), so output is bit-identical to plain decode through
+    the accept path."""
+
+    async def body():
+        samp = SamplingOptions(temperature=0.9, top_p=0.95, seed=4321)
+        engine = make_engine()
+        try:
+            prompt = [7, 8, 9]
+            base, _, _, _ = await collect(
+                engine, req(prompt, max_tokens=16, sampling=samp)
+            )
+            register_drafter(
+                "fold-seeded-oracle", lambda: OracleDrafter(prompt + base)
+            )
+            out, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=16, sampling=samp,
+                    spec=spec_opts(drafter="fold-seeded-oracle")),
+            )
+            assert out == base
+            assert stats["accepted_tokens"] > 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_folded_composes_with_chunked_prefill(run):
+    """A speculating lane behind a chunked prompt plus a concurrent plain
+    lane: verify segments, prefill chunks, and decode rows share unified
+    dispatches without output drift."""
+
+    async def body():
+        engine = make_engine(prefill_chunk_tokens=8)
+        try:
+            long_p = list(range(1, 21))
+            short_p = [9, 8, 7]
+            base_long, _, _, _ = await collect(engine, req(long_p, max_tokens=10))
+            base_short, _, _, _ = await collect(engine, req(short_p, max_tokens=10))
+            (out_l, _, _, _), (out_s, _, _, _) = await asyncio.gather(
+                collect(engine, req(long_p, max_tokens=10, spec=spec_opts())),
+                collect(engine, req(short_p, max_tokens=10)),
+            )
+            assert out_l == base_long
+            assert out_s == base_short
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_folded_survives_swap_preemption(run):
+    """Preemption mid-folded-verify discards the in-flight column like the
+    standalone path (serial tick loop for deterministic growth pacing)."""
+    from tests.test_spec import _pressure_engine
+
+    prompt_a = [3, 1, 4, 1, 5, 9, 2, 6]
+    prompt_b = [2, 7, 1, 8, 2, 8, 1, 8]
+
+    async def one(num_pages):
+        engine = _pressure_engine(num_pages)
+        assert engine._fold_spec  # folding stays active in serial mode
+        try:
+            (ta, _, _, _), (tb, _, _, _) = await asyncio.gather(
+                collect(engine, req(prompt_a, max_tokens=24, spec=spec_opts())),
+                collect(engine, req(prompt_b, max_tokens=24, spec=spec_opts())),
+            )
+            return (ta, tb), engine.sched.preempt_swap + \
+                engine.sched.preempt_recompute
+        finally:
+            await engine.stop()
+
+    async def body():
+        roomy, _ = await one(num_pages=41)
+        tight, n_pre = await one(num_pages=13)
+        assert n_pre >= 1, "preemption must have been exercised"
+        assert tight == roomy
+
+    run(body())
+
+
+def test_folded_cancellation_discards_column(run):
+    """Cancelling a speculating request mid-stream leaves the engine
+    clean: the in-flight folded column is dropped, pages are freed, and a
+    follow-up request decodes normally."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [1, 2, 3, 4]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=8))
+            stream = await engine.generate(
+                Context.new(req(prompt, max_tokens=40, spec=spec_opts()))
+            )
+            got = 0
+            async for item in stream:
+                ann = (
+                    item if isinstance(item, Annotated)
+                    else Annotated.from_dict(item)
+                )
+                got += len((ann.data or {}).get("token_ids") or [])
+                if got >= 2:
+                    stream.ctx.stop_generating()
+            assert got >= 2
+            # let the loop process the cancellation (in-flight folded
+            # columns for the lane are discarded at their commit)
+            for _ in range(50):
+                await asyncio.sleep(0.01)
+                if engine.kv.allocator.used_pages == 0:
+                    break
+            assert engine.kv.allocator.used_pages == 0
+            out, _, _, _ = await collect(engine, req(prompt, max_tokens=8))
+            assert out == base
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- executable-shape budget covers spec columns -----------------------------
+
+
+def test_executable_shape_gauge_covers_spec_shapes(run):
+    """Folded dispatches mint (Np, s_max, s_spec > 0) triples through the
+    shared PackedShapeBudget; the gauge tracks them and the budget bound
+    holds with speculation in the mix."""
+
+    async def body():
+        reg = MetricsRegistry()
+        engine = make_engine(registry=reg)
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=8))
+            register_drafter(
+                "shape-oracle", lambda: OracleDrafter(prompt + base)
+            )
+            await collect(
+                engine,
+                req(prompt, max_tokens=8, spec=spec_opts(drafter="shape-oracle")),
+            )
+            shapes = engine._packed_shapes
+            assert shapes.spec_shapes, shapes.pairs
+            assert all(t[2] > 0 for t in shapes.spec_shapes)
+            assert 1 <= len(shapes) <= shapes.budget
+            assert reg.sample("dynamo_engine_executable_shapes") == len(shapes)
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- acceptance-aware auto-disable -------------------------------------------
+
+
+def test_spec_auto_disable_reverts_to_plain_decode(run):
+    """An always-wrong drafter trips the acceptance floor: speculation
+    turns off mid-request, the lane finishes through the plain decode
+    scan, output is unchanged, and the disable is observable (usage
+    extension, engine counters, enabled-frac gauge)."""
+
+    async def body():
+        reg = MetricsRegistry()
+        engine = make_engine(
+            registry=reg, spec_min_accept=0.5, spec_disable_after=4
+        )
+        try:
+            prompt = [3, 1, 4, 1, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=20))
+            register_drafter("fold-wrong", WrongDrafter)
+            out, fin, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=20, spec=spec_opts(drafter="fold-wrong")),
+            )
+            assert out == base and fin == "length"
+            assert stats["auto_disabled"] is True
+            assert stats["accepted_tokens"] == 0
+            assert engine.spec_auto_disabled == 1
+            assert engine.spec_enabled_frac < 1.0
+            assert reg.sample("dynamo_spec_auto_disabled_requests") == 1
+            assert reg.sample("dynamo_spec_enabled_frac") < 1.0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_auto_disable_off_keeps_drafting(run):
+    """spec_auto_disable=False: even a hopeless drafter keeps drafting to
+    the end (the knob, not the floor, is in charge)."""
+
+    async def body():
+        engine = make_engine(
+            spec_auto_disable=False, spec_min_accept=0.99, spec_disable_after=1
+        )
+        try:
+            prompt = [3, 1, 4, 1, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=12))
+            register_drafter("fold-wrong2", WrongDrafter)
+            out, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=12, spec=spec_opts(drafter="fold-wrong2")),
+            )
+            assert out == base
+            assert stats["auto_disabled"] is False
+            assert stats["drafted_tokens"] > 8  # kept drafting throughout
+            assert engine.spec_auto_disabled == 0
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- echo+logprobs x speculation (ROADMAP "smaller grabs") -------------------
+
+
+def test_echo_logprobs_composes_with_speculation(run):
+    """An echo+logprobs request with speculation enabled composes with
+    score_prompt_step: the prompt-logprobs block is identical to the
+    non-speculative run and the completion tokens are unchanged."""
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [5, 6, 7, 8, 5, 6, 7, 8]
+            samp = SamplingOptions(temperature=0.0, logprobs=2)
+            base, _, _, base_plp = await collect(
+                engine,
+                req(prompt, max_tokens=6, sampling=samp, prompt_logprobs=2),
+            )
+            assert base_plp is not None and len(base_plp) == len(prompt)
+            register_drafter(
+                "echo-oracle", lambda: OracleDrafter(prompt + base)
+            )
+            out, _, stats, plp = await collect(
+                engine,
+                req(prompt, max_tokens=6, sampling=samp, prompt_logprobs=2,
+                    spec=spec_opts(drafter="echo-oracle")),
+            )
+            assert out == base
+            assert stats is not None and stats["accepted_tokens"] > 0
+            assert plp is not None and len(plp) == len(prompt)
+            # same scoring forward -> same per-position entries
+            assert plp[0] == base_plp[0]
+            for a, b in zip(plp, base_plp):
+                assert a[0] == b[0]
+                if a[1] is not None:
+                    assert a[1] == pytest.approx(b[1], rel=1e-5)
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- cross-tick draft pipeline ------------------------------------------------
+
+
+def test_pending_draft_precompute_consumed(run):
+    """Commit precomputes the next generation's proposal; the dispatch
+    assembly consumes it (history-length stamped) instead of re-running
+    the drafter inline."""
+
+    class CountingOracle(OracleDrafter):
+        calls = 0
+
+        def propose(self, history, n):
+            CountingOracle.calls += 1
+            return super().propose(history, n)
+
+    async def body():
+        engine = make_engine()
+        try:
+            prompt = [1, 2, 3, 4, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=16))
+            register_drafter(
+                "counting-oracle", lambda: CountingOracle(prompt + base)
+            )
+            CountingOracle.calls = 0
+            v0 = engine.spec_verify_steps
+            out, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=16,
+                    spec=spec_opts(drafter="counting-oracle")),
+            )
+            assert out == base and stats["accepted_tokens"] > 0
+            verifies = engine.spec_verify_steps - v0
+            assert verifies > 0
+            # every verify consumed ONE proposal: the first is inline, the
+            # rest come from commit-time precompute (plus one final
+            # precompute the finish discards).  A broken pipeline -- every
+            # precompute stale, every assembly re-proposing inline --
+            # would pay ~2 proposals per verify.
+            assert CountingOracle.calls <= verifies + 2
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_model_drafter_registry_and_acceptance(run):
+    """The model-based drafter loads through the registry (draft_model
+    knob) and proposes real continuations: with the 'random' preset the
+    draft model IS the tiny target (shared seed), so greedy drafts match
+    the target's samples and multi-token columns commit."""
+
+    async def body():
+        engine = make_engine(draft_model="random")
+        try:
+            from dynamo_tpu.spec import DRAFTERS
+            from dynamo_tpu.spec.model_drafter import ModelDrafter
+
+            assert isinstance(engine.model_drafter, ModelDrafter)
+            # the binding is ENGINE-scoped: the process-global registry
+            # must NOT carry this engine's draft weights (a later engine
+            # in the process would silently draft with stale params)
+            assert "model" not in DRAFTERS
+            prompt = [1, 2, 3, 4, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=12))
+            v0 = engine.spec_verify_steps
+            out, _, stats, _ = await collect(
+                engine,
+                req(prompt, max_tokens=12, spec=spec_opts(drafter="model")),
+            )
+            assert out == base  # output is ALWAYS the target's
+            assert stats["drafter"] == "model"
+            assert stats["drafted_tokens"] > 0
+            # same weights -> greedy drafts track the target: columns commit
+            assert stats["accepted_tokens"] > 0
+            assert engine.spec_verify_steps - v0 < len(out)
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_model_drafter_vocab_mismatch_fails_loudly():
+    """A draft model whose vocab differs from the target's must fail
+    engine construction, not silently propose alien token ids."""
+    target = ModelConfig.tiny(vocab_size=128)
+    with pytest.raises(ValueError, match="vocab"):
+        JaxEngine(
+            target,
+            init_params(target, jax.random.PRNGKey(0)),
+            EngineConfig(
+                max_batch_size=2, max_seq_len=64, page_size=4, num_pages=32,
+                draft_model="random",  # tiny preset: vocab 256 != 128
+            ),
+        )
+
+
+def test_model_drafter_propose_unit():
+    """Drafter-level unit: proposals are greedy continuations under the
+    draft model, clamped to n, empty on empty history."""
+    from dynamo_tpu.spec.model_drafter import ModelDrafter
+
+    cfg = ModelConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    d = ModelDrafter(params, cfg, window=32)
+    assert d.propose([], 4) == []
+    assert d.propose([1, 2, 3], 0) == []
+    got = d.propose([1, 2, 3], 4)
+    assert len(got) == 4
+    assert all(0 <= t < cfg.vocab_size for t in got)
+    # deterministic (greedy, stateless)
+    assert d.propose([1, 2, 3], 4) == got
+    # a longer request clamps to MAX_DRAFT_TOKENS
+    from dynamo_tpu.spec import MAX_DRAFT_TOKENS
+
+    assert len(d.propose(list(range(1, 20)), 99)) == MAX_DRAFT_TOKENS
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs >= 4 (virtual) devices"
+)
+def test_model_drafter_tp_sharded(run):
+    """On a serving mesh the draft params load TP-sharded with explicit
+    shardings (make_sharded_drafter) and proposals still work."""
+
+    async def body():
+        engine = JaxEngine.random_init(
+            ModelConfig.tiny(),
+            EngineConfig(
+                max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64,
+                tp=2, draft_model="random",
+            ),
+        )
+        try:
+            md = engine.model_drafter
+            assert md.mesh is not None
+            from dynamo_tpu.parallel.sharding import _flatten_with_paths
+
+            flat = _flatten_with_paths(md.params)
+            sharded = [
+                p for p, leaf in flat.items()
+                if not leaf.sharding.is_fully_replicated
+            ]
+            assert sharded, "draft params must shard over tp"
+            got = md.propose([1, 2, 3, 4], 4)
+            assert len(got) == 4
+            prompt = [1, 2, 3, 4, 5]
+            base, _, _, _ = await collect(engine, req(prompt, max_tokens=8))
+            out, _, _, _ = await collect(
+                engine,
+                req(prompt, max_tokens=8, spec=spec_opts(drafter="model")),
+            )
+            assert out == base
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+# -- bench A/B leg on the CPU smoke ------------------------------------------
+
+
+def test_bench_run_spec_folded_ab_cpu_smoke(run):
+    """The bench's folded-vs-post-commit A/B leg runs end to end on CPU
+    with a tiny trunk and records both throughput and dispatch-rate pairs
+    (the real-TPU round re-measures the wall-clock; the smoke certifies
+    the machinery and the accounting)."""
+    import numpy as np
+
+    from bench import run_spec
+
+    def tiny_build(decode_block=16, **extra):
+        cfg = ModelConfig.tiny(vocab_size=32000)
+        return JaxEngine.random_init(
+            cfg,
+            EngineConfig(
+                max_batch_size=4, max_seq_len=256, page_size=16,
+                num_pages=96, decode_block_size=decode_block, **extra,
+            ),
+        )
+
+    out = run(
+        run_spec(np.random.RandomState(0), build=tiny_build, bs=4, osl=12)
+    )
+    for key in (
+        "spec_tok_s", "spec_base_tok_s", "spec_postcommit_tok_s",
+        "spec_speedup", "spec_fold_speedup", "spec_dispatches_s",
+        "spec_postcommit_dispatches_s", "spec_accept_rate",
+        "spec_enabled_frac", "spec_verify_steps",
+    ):
+        assert key in out, key
+    assert out["spec_tok_s"] > 0 and out["spec_postcommit_tok_s"] > 0
+    assert out["spec_dispatches_s"] > 0
+    assert out["spec_postcommit_dispatches_s"] > 0
+    assert 0.0 <= out["spec_accept_rate"] <= 1.0
+    assert 0.0 <= out["spec_enabled_frac"] <= 1.0
+    assert out["spec_verify_steps"] > 0
+
+
+def test_model_drafter_unarmed_engine_errors(run):
+    """A 'model' request on an engine with no draft_model fails as a
+    request error (unknown drafter), not by borrowing another engine's
+    weights."""
+
+    async def body():
+        engine = make_engine()  # no draft_model
+        try:
+            stream = await engine.generate(
+                Context.new(
+                    req([1, 2, 3], max_tokens=4,
+                        spec=spec_opts(drafter="model"))
+                )
+            )
+            items = [item async for item in stream]
+            assert any(
+                isinstance(i, Annotated) and i.is_error() for i in items
+            )
+        finally:
+            await engine.stop()
+
+    run(body())
+
+
+def test_spec_fold_reserve_respects_headroom(run):
+    """A headroom-paused spec lane (cache at its page-capacity cap) must
+    not count toward the fold reserve: a chunk-less tick would otherwise
+    route into a unified dispatch that packs nothing and skip the decode
+    block, starving every plain lane."""
+    from dynamo_tpu.engine.scheduler import SeqState
+    from dynamo_tpu.protocols.common import StopConditions
+    from dynamo_tpu.spec import NGramDrafter, SpecState
+
+    async def body():
+        engine = make_engine()  # page_size 4
+        try:
+            seq = SeqState(
+                request_id="r", prompt=[1, 2, 3],
+                stop=StopConditions(max_tokens=32),
+                sampling=SamplingOptions(temperature=0.0), eos_ids=[],
+            )
+            seq.spec = SpecState(drafter=NGramDrafter(), num_draft_tokens=4)
+            seq.num_generated = 1
+            seq.slot = 0
+            seq.pages = [1]  # 4 writable positions
+            engine.sched.slots[0] = seq
+            engine.sched.seq_lens[0] = 4  # cache AT capacity: headroom 0
+            assert engine._spec_fold_reserve() == 0
+            seq.pages = [1, 2]  # growth landed: headroom again
+            assert engine._spec_fold_reserve() == 1 + 4
+        finally:
+            engine.sched.slots[0] = None
+            await engine.stop()
+
+    run(body())
