@@ -311,20 +311,37 @@ async def run_host_pipeline(rs) -> dict:
     commit/fanout runs and the host-observed dispatch gap collapses to
     ~zero; with ``async_dispatch=False`` (the ``--no-async-dispatch``
     fallback) every tick's host work sits in the gap.  The acceptance
-    line is ``pipe_gap_p50_ms_async <= pipe_gap_p50_ms_serial / 2``."""
+    line is ``pipe_gap_p50_ms_async <= pipe_gap_p50_ms_serial / 2``.
+
+    The multi-step K sweep (ISSUE 16) rides the same workload: K in
+    {1, 4, 8} plus the adaptive controller, each leg reporting host
+    occupancy, dispatch-gap p50, and tok/s -- a K-step fused dispatch
+    amortizes the per-tick host work over K tokens, so occupancy and gap
+    must fall monotonically toward K=8 (``pipe_host_occ_k8 <
+    pipe_host_occ_k1`` is the acceptance line)."""
     from dynamo_tpu.mocker import MockerConfig, MockerEngine
     from dynamo_tpu.runtime import profiling
 
     prof = profiling.profiler
     was_enabled = prof.enabled
     out = {}
+    legs = (
+        ("serial", False, 1),
+        ("async", True, 1),
+        # multi-step sweep: fixed K, then the adaptive controller (0)
+        ("k1", True, 1),
+        ("k4", True, 4),
+        ("k8", True, 8),
+        ("kadapt", True, 0),
+    )
     try:
-        for name, async_on in (("serial", False), ("async", True)):
+        for name, async_on, ms_k in legs:
             eng = MockerEngine(
                 MockerConfig(
                     max_batch_size=16,
                     decode_s_per_step=2e-5,
                     async_dispatch=async_on,
+                    multistep_k=ms_k,
                 )
             )
             prompts = [
@@ -341,6 +358,8 @@ async def run_host_pipeline(rs) -> dict:
             await eng.stop()
             out[f"pipe_gap_p50_ms_{name}"] = psum["gap_p50_ms"]
             out[f"pipe_tok_s_{name}"] = round(total / elapsed, 2)
+            if name.startswith("k"):
+                out[f"pipe_host_occ_{name}"] = psum["host_occupancy"]
         gs, ga = out.get("pipe_gap_p50_ms_serial"), out.get(
             "pipe_gap_p50_ms_async"
         )

@@ -51,6 +51,8 @@ HOT_PATH_MANIFEST: Dict[str, List[str]] = {
         "_unified_step",
         "packed_unified_step",
         "_packed_unified_step",
+        "packed_unified_multistep",
+        "_packed_unified_multistep",
         "_mixed_sample_epilogue",
         "_spec_columns_epilogue",
         "verify_and_sample",

@@ -82,6 +82,18 @@ def _add_engine_flags(p) -> None:
                         "unified dispatches (revert to the lane rectangle "
                         "padded to the max chunk; env DYN_PACKED_RAGGED "
                         "overrides)")
+    p.add_argument("--no-multistep-decode", dest="multistep_decode",
+                   action="store_false", default=True,
+                   help="disable multi-step device-resident decode (K "
+                        "iterations fused into one packed dispatch on "
+                        "pure-decode ticks, adaptive K); pure-decode "
+                        "ticks revert to the classic fixed-width decode "
+                        "block (env DYN_MULTISTEP overrides: 0=off, "
+                        "adaptive, or a fixed integer K)")
+    p.add_argument("--multistep-max-k", type=int, default=8,
+                   metavar="K",
+                   help="ceiling for the adaptive multi-step decode "
+                        "controller (default 8)")
     p.add_argument("--no-fold-spec-verify", dest="fold_spec_verify",
                    action="store_false", default=True,
                    help="disable folded speculative verify (spec columns "
@@ -448,6 +460,8 @@ async def _make_engine(args):
         fold_spec_verify=args.fold_spec_verify,
         spec_auto_disable=args.spec_auto_disable,
         draft_model=args.draft_model,
+        multistep_decode=args.multistep_decode,
+        multistep_max_k=args.multistep_max_k,
     )
     if args.mixed_token_budget is not None:
         cfg.mixed_token_budget = args.mixed_token_budget

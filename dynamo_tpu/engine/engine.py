@@ -76,6 +76,7 @@ from .step import (
     scatter_block_pages,
     scatter_layer_pages,
     slice_block_pages,
+    packed_unified_multistep,
     packed_unified_step,
     unified_step,
     verify_and_sample,
@@ -201,9 +202,12 @@ class EngineConfig:
     # stalls the decode batch behind a separate launch and TTFT/ITL stop
     # trading off.  Output is bit-identical to the separate paths for
     # greedy/seeded lanes.  ``--no-mixed-batching`` restores the classic
-    # separate-dispatch behavior exactly; penalized and multimodal
-    # requests always take the classic paths (the unified step carries no
-    # penalty histograms / soft-prompt injection).
+    # separate-dispatch behavior exactly; penalized requests always take
+    # the classic paths (the unified step carries no penalty histograms).
+    # Multimodal prompts PREFILL classically (soft-prompt injection), but
+    # once prefilled their decode lanes ride the unified/packed (and
+    # multi-step) dispatches like any text lane -- decode state carries
+    # no modality (ISSUE 16 satellite; identity-asserted in tier-1).
     mixed_batching: bool = True
     # total fresh tokens per unified dispatch (decode lanes cost one each,
     # the remainder packs prefill chunks); DYN_MIXED_TOKEN_BUDGET
@@ -309,6 +313,23 @@ class EngineConfig:
     spec_auto_disable: bool = True
     spec_min_accept: float = 0.35
     spec_disable_after: int = 64
+    # multi-step device-resident packed decode (ISSUE 16, ROADMAP item
+    # 2): chunk-free packed dispatches fuse K decode iterations into ONE
+    # device launch (step.packed_unified_multistep -- the decode_block
+    # treatment for the default packed path), so the host plans,
+    # assembles, and commits once per K tokens instead of per token.  K
+    # adapts per tick (engine._multistep_plan_k): prefill/mixed queue
+    # pressure, speculating lanes, or pending admissions collapse it to 1
+    # (admission/preemption granularity never hurts TTFT); an idle queue
+    # ramps it toward ``multistep_max_k``, jumping straight there when
+    # the tick profiler reports a host-bound loop.  Token-identical
+    # (greedy, seeded, and unseeded-temperature) to K=1 -- the commit
+    # replays stop rules over the [B, K] block exactly like decode_block.
+    # ``--no-multistep-decode`` / DYN_MULTISTEP=0 pin the exact previous
+    # behavior; DYN_MULTISTEP=N forces fixed K=N; "adaptive"/1 arm the
+    # controller.  Only consulted when mixed batching + packed are on.
+    multistep_decode: bool = True
+    multistep_max_k: int = 8
     # model-based drafter (second weight load): a checkpoint path or
     # ``random[:seed]`` (spec/model_drafter.load_draft_model grammar).
     # When set, the engine loads the draft model at startup -- TP-sharded
@@ -375,6 +396,12 @@ class InflightUnified:
     spec_lanes: List[Tuple[SeqState, int, List[int]]] = field(
         default_factory=list
     )
+    # multi-step decode (ISSUE 16): decode iterations fused into this
+    # dispatch.  1 = the classic single-step record (``sampled`` is
+    # [B, 2 + 2N]); > 1 widens ``sampled`` to [B, K, 2 + 2N] and the
+    # commit replays the whole block (Scheduler.commit_block), exactly
+    # like an InflightBlock.
+    n_steps: int = 1
     dispatched_at: float = field(default_factory=time.perf_counter)
 
 
@@ -579,6 +606,7 @@ _MODULE_STEPS = SimpleNamespace(
     decode_block=decode_block,
     unified_step=unified_step,
     packed_unified_step=packed_unified_step,
+    packed_unified_multistep=packed_unified_multistep,
     verify_and_sample=verify_and_sample,
     update_lanes=update_lanes,
     inject_token=inject_token,
@@ -951,6 +979,35 @@ class JaxEngine:
                 and self._mixed
                 and self._packed
             )
+        # multi-step packed decode (ISSUE 16): requires the packed mixed
+        # plane like folded verify.  DYN_MULTISTEP grammar: 0/off =
+        # disabled (pins the exact single-step behavior), 1/on/adaptive =
+        # the adaptive-K controller, an integer N > 1 = fixed K=N (test /
+        # bench pinning).  Malformed values warn and keep config.
+        self._multistep = (
+            bool(self.cfg.multistep_decode) and self._mixed and self._packed
+        )
+        self._multistep_fixed: Optional[int] = None  # None = adaptive
+        self._multistep_max = max(int(self.cfg.multistep_max_k), 1)
+        env_ms = _os.environ.get("DYN_MULTISTEP")
+        if env_ms is not None and env_ms.strip():
+            v = env_ms.strip().lower()
+            if v in ("0", "off", "false", "no"):
+                self._multistep = False
+            elif v in ("1", "on", "true", "adaptive"):
+                self._multistep = self._mixed and self._packed
+                self._multistep_fixed = None
+            else:
+                try:
+                    k = int(v)
+                    self._multistep = k > 1 and self._mixed and self._packed
+                    self._multistep_fixed = max(k, 1)
+                    self._multistep_max = max(self._multistep_max, k)
+                except ValueError:
+                    logger.warning("ignoring malformed DYN_MULTISTEP=%r", v)
+        # adaptive-K ramp state: consecutive pressure-free ticks double
+        # the next block's K toward the ceiling; any pressure resets to 1
+        self._ms_ramp = 1
         # acceptance-aware auto-disable knobs (+ request-lifetime counters
         # backing the bench's spec_enabled_frac line)
         self._spec_auto_disable = bool(self.cfg.spec_auto_disable)
@@ -1265,6 +1322,16 @@ class JaxEngine:
                     yield item
             finally:
                 self._queues.pop(request.id, None)
+                if ctx.is_killed():
+                    # kill() races the consumer's teardown against our
+                    # stop_waiter branch above and usually wins (the
+                    # ResponseStream cancels the producer first), so the
+                    # cancellation must also be recorded here or the lane
+                    # keeps decoding into a dropped queue, holding its
+                    # KV pages until max_tokens
+                    self._cancelled.add(request.id)
+                    if self._wake is not None:
+                        self._wake.set()
 
         return ResponseStream(ctx, stream())
 
@@ -2374,6 +2441,19 @@ class JaxEngine:
                     await self._emit_events(events)
                     if tick is not None:
                         tick.mark("fanout")
+                # K-granular admission (ISSUE 16): tell the budget planner
+                # how many uncommitted multi-step tokens each decode lane
+                # may be carrying across the pipeline before this plan's
+                # admissions could possibly take effect
+                self.sched.decode_inflight_tokens = (
+                    self._pipe_depth
+                    * min(
+                        self._multistep_fixed or self._ms_ramp,
+                        self._multistep_max,
+                    )
+                    if self._multistep
+                    else 0
+                )
                 plan = self.sched.plan()
                 if self.sched.num_active > 0:
                     # pre-grow pages to cover the in-flight block plus this
@@ -2384,9 +2464,15 @@ class JaxEngine:
                     # -- the floor must not raise preemption pressure for
                     # workloads that never speculate)
                     # depth-scaled: every uncommitted generation may hold
-                    # a full block's writes, plus this tick's block
+                    # a full block's writes, plus this tick's block.  With
+                    # multi-step decode armed a packed generation holds up
+                    # to K writes per lane, so the floor covers whichever
+                    # block shape is larger (K <= decode_block_size keeps
+                    # the exact old watermark)
+                    ms_block = self._multistep_max if self._multistep else 1
                     lookahead = (
-                        (self._pipe_depth + 1) * self.cfg.decode_block_size
+                        (self._pipe_depth + 1)
+                        * max(self.cfg.decode_block_size, ms_block)
                         + 1
                     )
                     if any(
@@ -2534,6 +2620,15 @@ class JaxEngine:
                 if tick is not None:
                     tick.mark("assemble")
                 ub = None
+                # adaptive multi-step K (ISSUE 16): chunk/spec/admission
+                # pressure collapses the next packed block to one step
+                # (TTFT granularity); a pressure-free tick ramps K toward
+                # the ceiling and fuses the whole block into one dispatch
+                ms_k = (
+                    self._multistep_plan_k(chunks, spec_reserve)
+                    if self._multistep and mixed_ok
+                    else 0
+                )
                 if chunks or spec_reserve:
                     # ONE dispatch serves the whole batch: every decode
                     # lane rides alongside the packed prefill chunks and
@@ -2541,6 +2636,24 @@ class JaxEngine:
                     ub = await loop.run_in_executor(
                         self._ex, self._dispatch_unified, chunks,
                         fold_active,
+                    )
+                    if ub is not None:
+                        fresh.append(ub)
+                elif (
+                    ms_k > 0
+                    and self.sched.num_decode_runnable > 0
+                    and self._has_steppable_lane(
+                        [e for gen in inflight for e in gen]
+                    )
+                ):
+                    # pure-decode tick with multi-step open: K decode
+                    # iterations through the packed plane in one launch,
+                    # replacing the classic fixed-width decode_block scan
+                    # so admission granularity follows the controller
+                    # (post-prefill multimodal lanes ride this like any
+                    # text lane -- decode state carries no modality)
+                    ub = await loop.run_in_executor(
+                        self._ex, self._dispatch_unified, [], False, ms_k,
                     )
                     if ub is not None:
                         fresh.append(ub)
@@ -2696,7 +2809,7 @@ class JaxEngine:
             if isinstance(e, InflightBlock):
                 inflight += self.cfg.decode_block_size
             elif isinstance(e, InflightUnified):
-                inflight += 1
+                inflight += e.n_steps
         sched = self.sched
         limits = self._compute_limits()
         for b, s in enumerate(sched.slots):
@@ -2746,6 +2859,57 @@ class JaxEngine:
                 continue  # no writable position (the _gather gate)
             total += 1 + s.spec.num_draft_tokens
         return total
+
+    def _multistep_plan_k(self, chunks: List[Any], spec_reserve: int) -> int:
+        """Decode steps to fuse into this tick's packed dispatch (ISSUE 16).
+
+        The controller reads the same queue/lane state the scheduler
+        plans from, so the decision is made once per tick on the loop
+        thread with no device sync:
+
+        * **Pressure collapses K to 1.**  Prefill chunks, speculating
+          lanes, a non-empty admission queue, pending mixed prefills,
+          classic chunk restarts, or pending spec injects all mean some
+          lane wants the batch re-planned at single-token granularity --
+          a fused block would hold admission (TTFT) hostage for K steps
+          and would race the chunk machinery's KV writes.
+        * **Fixed mode** (``DYN_MULTISTEP=<N>``) returns N whenever
+          pressure-free -- the bench/ablation pin.
+        * **Adaptive mode** ramps K geometrically (1, 2, 4, ... up to
+          ``multistep_max_k``) per consecutive pressure-free tick, and
+          jumps straight to the ceiling when the PR-11 profiler says the
+          host is the bottleneck (recent host occupancy >= 0.5): that is
+          precisely the regime where fusing dispatches buys throughput.
+
+        The ramp (rather than an instant max) bounds the worst-case
+        tokens a mid-block cancel/deadline discards right after a busy
+        phase, while steady pure-decode traffic still converges to the
+        ceiling in log2(K) ticks."""
+        sched = self.sched
+        pressure = (
+            bool(chunks)
+            or bool(spec_reserve)
+            or bool(sched.waiting)
+            or bool(sched.mix_pending)
+            or bool(self._chunking)
+            or bool(self._pending_injects)
+            or any(
+                s is not None
+                and (s.prefilling or s.awaiting_kv or _spec_live(s))
+                for s in sched.slots
+            )
+        )
+        if pressure:
+            self._ms_ramp = 1
+            return 1
+        if self._multistep_fixed is not None:
+            return self._multistep_fixed
+        occ = self.profiler.recent_host_occupancy()
+        if occ is not None and occ >= 0.5:
+            self._ms_ramp = self._multistep_max
+        k = min(self._ms_ramp, self._multistep_max)
+        self._ms_ramp = min(self._ms_ramp * 2, self._multistep_max)
+        return k
 
     def _handle_stalled_admission(self) -> None:
         """Nothing running, nothing admitted: requests whose prompts can never
@@ -3802,6 +3966,7 @@ class JaxEngine:
             d["counts"] = counts_out
         self._steps += 1
         self.obs.observe_dispatch("decode_block")
+        self.obs.observe_multistep_k(1)
         _start_host_copy(sampled)
         if tick is not None:
             tick.note_dispatch("decode_block")
@@ -3810,7 +3975,10 @@ class JaxEngine:
 
     @hot_path
     def _dispatch_unified(
-        self, chunks: List[Any], fold_spec: bool = False
+        self,
+        chunks: List[Any],
+        fold_spec: bool = False,
+        num_steps: int = 0,
     ) -> Optional["InflightUnified"]:
         """Enqueue one unified ragged mixed-batch step (executor thread).
 
@@ -3830,20 +3998,33 @@ class JaxEngine:
         speculating lanes contribute ``1 + draft`` extra segments -- last
         committed token + host-proposed drafts -- scored in this SAME
         dispatch (ISSUE 15): a speculating tick pays ONE device launch,
-        not decode + verify.  Their per-column target samples ride the
+        not decode + verify.  Their per-column samples ride the
         returned record's ``spec_sampled`` handle and commit through the
         host accept walk at commit time.
+
+        With ``num_steps >= 1`` (packed layout, chunk-free, spec-free --
+        the tick loop only routes pure-decode multistep ticks here, with
+        K from the adaptive controller) the dispatch runs the decode rows
+        alone; for K > 1 it runs ``packed_unified_multistep``: K decode
+        iterations fused into one launch, sampling and appending KV on
+        device each step, so the host syncs one ``[B, K]`` token block
+        per K generated tokens.  Commit replays the block through
+        ``commit_block`` exactly like an :class:`InflightBlock`, so stop
+        rules stay host-authoritative and mid-block cancels discard for
+        free.  ``num_steps == 0`` (the default) marks a non-multistep
+        call, where a chunk-less spec-less dispatch has nothing to pack.
         """
         from ..runtime import tracing
 
         sched = self.sched
         spec_lanes = self._gather_spec_lanes() if fold_spec else []
-        if not chunks and not spec_lanes:
+        if not chunks and not spec_lanes and num_steps <= 0:
             # the loop thread saw verify-eligible lanes that vanished
             # before the executor hop (cancel/preempt race): nothing to
             # dispatch -- plain decode lanes are better served by the
             # K-step block next tick
             return None
+        num_steps = max(num_steps, 1)
         for ch in chunks:
             seq = ch.seq
             self._note_prefetch_admission(seq)
@@ -3931,6 +4112,10 @@ class JaxEngine:
                 and not _spec_live(s)
             )
         n_decode = int(dec_cap.sum())
+        if num_steps > 1 and n_decode == 0:
+            # pure-decode multistep tick whose lanes vanished before the
+            # executor hop (cancel/preempt race): nothing to fuse
+            return None
         use_filters = any(
             s is not None and self._sampling_needs_filters(s.sampling)
             for s in sched.slots
@@ -3993,19 +4178,11 @@ class JaxEngine:
                         t_tokens[o + 1 : o + 1 + len(dr)] = dr
                 else:
                     t_dec[o] = True
-            disp_tokens = Np
+            disp_tokens = Np + B * (num_steps - 1)
             tick = self._tick
             if tick is not None:
                 tick.mark("assemble")
-            (
-                packed,
-                spec_packed,
-                d["tokens"],
-                d["seq_lens"],
-                d["active"],
-                self.kv.pages,
-                self._rng,
-            ) = self._fns.packed_unified_step(
+            operands = (
                 self.params,
                 self.model_cfg,
                 self.kv.pages,
@@ -4028,11 +4205,33 @@ class JaxEngine:
                 self._put_batch(v_host),
                 self._rng,
                 d["sampling"],
-                s_max,
-                s_spec,
-                top_n,
-                use_filters,
             )
+            if num_steps > 1:
+                # K decode iterations fused into the launch: packed is
+                # [B, K, 2 + 2*top_n], row k = on-device step k's sample
+                (
+                    packed,
+                    spec_packed,
+                    d["tokens"],
+                    d["seq_lens"],
+                    d["active"],
+                    self.kv.pages,
+                    self._rng,
+                ) = self._fns.packed_unified_multistep(
+                    *operands, s_max, num_steps, s_spec, top_n, use_filters,
+                )
+            else:
+                (
+                    packed,
+                    spec_packed,
+                    d["tokens"],
+                    d["seq_lens"],
+                    d["active"],
+                    self.kv.pages,
+                    self._rng,
+                ) = self._fns.packed_unified_step(
+                    *operands, s_max, s_spec, top_n, use_filters,
+                )
         else:
             # rectangle layout: fold never routes here (fold_spec requires
             # the packed layout), so no verify segments to place
@@ -4076,11 +4275,12 @@ class JaxEngine:
         # padded-token accounting, BOTH layouts derived from this one
         # dispatch: `used` real rows, `dispatched` what actually ran,
         # `rectangle` what the [B, S] layout would have run -- the bench
-        # reports 1 - used/dispatched vs 1 - used/rectangle
-        used_tokens = n_pf_tokens + n_decode + n_spec_tokens
+        # reports 1 - used/dispatched vs 1 - used/rectangle.  Multi-step
+        # scan iterations each run (and use) one row per decode lane.
+        used_tokens = n_pf_tokens + n_decode * num_steps + n_spec_tokens
         self.mixed_used_tokens += used_tokens
         self.mixed_dispatched_tokens += disp_tokens
-        self.mixed_rect_tokens += B * S
+        self.mixed_rect_tokens += B * S + B * (num_steps - 1)
         self.obs.observe_mixed_tokens(used_tokens, disp_tokens, B * S)
         finals: List[InflightPrefill] = []
         for ch in final_chunks:
@@ -4110,9 +4310,10 @@ class JaxEngine:
                         mixed=True,
                         kv_prefetch_hits=seq.prefetch_hits,
                     )
-        self._steps += 1
+        self._steps += num_steps
         self.obs.observe_dispatch("unified")
         self.obs.observe_mixed(n_decode, n_pf_tokens)
+        self.obs.observe_multistep_k(num_steps)
         _start_host_copy(packed)
         if spec_lanes:
             _start_host_copy(spec_packed)
@@ -4121,9 +4322,9 @@ class JaxEngine:
             tick.mark("dispatch")
         logger.debug(
             "unified dispatch: %d decode lanes + %d prefill tokens "
-            "+ %d verify segments (%d chunks, %d final) S=%d",
+            "+ %d verify segments (%d chunks, %d final) S=%d K=%d",
             n_decode, n_pf_tokens, len(spec_lanes), len(chunks),
-            len(finals), S,
+            len(finals), S, num_steps,
         )
         return InflightUnified(
             sampled=packed,
@@ -4133,6 +4334,7 @@ class JaxEngine:
             n_prefill_tokens=n_pf_tokens,
             spec_sampled=spec_packed if spec_lanes else None,
             spec_lanes=spec_lanes,
+            n_steps=num_steps,
         )
 
     # -- speculative decoding (spec/: draft on host, verify in one pass) ----
@@ -4809,21 +5011,36 @@ class JaxEngine:
                 commit_prefill(e, mat[0])
                 self.obs.observe_step("prefill", now - e.dispatched_at)
             elif isinstance(e, InflightUnified):
-                # mat: packed [B, 2 + 2N] -- decode columns AND final
-                # prefill columns commit through the same K=1 block
-                # replay, so the stop rules cannot diverge between the
-                # lanes of one dispatch
+                # mat: packed [B, 2 + 2N] (single-step) or [B, K, 2 + 2N]
+                # (multi-step) -- decode columns AND final prefill columns
+                # commit through the same block replay, so the stop rules
+                # cannot diverge between the lanes of one dispatch
                 N = (mat.shape[-1] - 2) // 2
                 toks, lps, tids, tlps = unpack_sampled_logprobs(mat, N)
                 final_slots = {pf.slot: pf for pf in e.finals}
                 for pf in e.finals:
                     if self._pending_injects.get(pf.slot) is pf:
                         del self._pending_injects[pf.slot]
-                unified_events = self.sched.commit_block(
-                    toks[:, None], e.slots, lps[:, None],
-                    tids[:, None] if N else None,
-                    tlps[:, None] if N else None,
-                )
+                if e.n_steps > 1:
+                    # the K-block replay discards uncommitted steps of
+                    # lanes cancelled/preempted mid-block (the commit
+                    # guards), and each of the K-1 device-internal step
+                    # boundaries had zero host-visible idle by
+                    # construction -- record them as such so the gap
+                    # profile reflects the fused dispatch
+                    unified_events = self.sched.commit_block(
+                        toks, e.slots, lps,
+                        tids if N else None, tlps if N else None,
+                    )
+                    if tick is not None:
+                        for _ in range(e.n_steps - 1):
+                            tick.note_zero_gap()
+                else:
+                    unified_events = self.sched.commit_block(
+                        toks[:, None], e.slots, lps[:, None],
+                        tids[:, None] if N else None,
+                        tlps[:, None] if N else None,
+                    )
                 for ev in unified_events:
                     # slot-keyed (commit events only fire for lanes still
                     # resident, so ev.seq.slot is its dispatch-time lane);

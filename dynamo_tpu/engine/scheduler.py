@@ -312,6 +312,13 @@ class Scheduler:
         self.kv_admit = cfg.kv_admit
         self.admit_skips = 0  # admissions that passed a blocked head
         self.admit_blocked = 0  # passes whose head did not fit the budget
+        # K-granular admission (ISSUE 16): tokens a decode lane may grow
+        # by before the scheduler can react again -- the engine sets this
+        # to its multi-step K x pipeline depth each tick, so the budget
+        # planner charges every decode-phase lane at least that much
+        # uncommitted in-flight growth and an admission decision can never
+        # be invalidated by a block that was already dispatched
+        self.decode_inflight_tokens = 0
         # observability hook (engine/metrics.EngineMetrics): the scheduler
         # stays sans-IO -- it only pokes gauges the engine wired in
         self.metrics: Optional[Any] = None
@@ -498,9 +505,20 @@ class Scheduler:
         by ``headroom_tokens``.  Never below what the sequence already
         holds, never above the per-lane page ceiling."""
         adm = self.kv_admit
-        head = self.remaining_budget(seq)
+        remaining = self.remaining_budget(seq)
+        head = remaining
         if adm is not None and adm.headroom_tokens is not None:
             head = min(head, adm.headroom_tokens)
+        if (
+            seq.slot is not None
+            and not seq.prefilling
+            and not seq.awaiting_kv
+        ):
+            # a decode-phase lane has up to decode_inflight_tokens of
+            # uncommitted multi-step growth in flight: charge at least
+            # that (still capped by what it may legally emit), even when
+            # headroom_tokens clamps tighter
+            head = max(head, min(self.decode_inflight_tokens, remaining))
         n = min(seq.seq_len + head, self.cfg.max_seq_len)
         pages = -(-n // self.cfg.page_size)
         return max(min(pages, self.max_pages), len(seq.pages))

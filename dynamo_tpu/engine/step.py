@@ -623,6 +623,120 @@ packed_unified_step = partial(
 )(_packed_unified_step)
 
 
+def _packed_unified_multistep(
+    params: Params,
+    cfg: ModelConfig,
+    kv_pages: jax.Array,
+    tokens: jax.Array,  # [B] device-resident last committed token per lane
+    seq_lens: jax.Array,  # [B] cache length (next decode write position)
+    limit_lens: jax.Array,  # [B] cache length at which a lane must stop
+    active: jax.Array,  # [B] bool
+    stop_ids: jax.Array,  # [B, E]
+    page_table: jax.Array,  # [B, P] (pre-grown for num_steps of growth)
+    t_tokens: jax.Array,  # [Np]
+    t_lane: jax.Array,  # [Np]
+    t_rel: jax.Array,  # [Np]
+    t_dec: jax.Array,  # [Np] bool
+    p_start: jax.Array,  # [B]
+    p_lens: jax.Array,  # [B]
+    p_sample: jax.Array,  # [B] bool
+    p_activate: jax.Array,  # [B] bool
+    dec_cap: jax.Array,  # [B] bool
+    seg_off: jax.Array,  # [B]
+    v_lens: jax.Array,  # [B]
+    rng: jax.Array,
+    sampling: SamplingParams,
+    s_max: int,
+    num_steps: int,
+    s_spec: int = 0,
+    top_n: int = 0,
+    use_filters: bool = True,
+) -> Tuple[jax.Array, ...]:
+    """``num_steps`` decode iterations through the packed unified path in
+    ONE device dispatch (the multi-step decode tentpole): step 0 is the
+    full :func:`_packed_unified_step`, steps 1..K-1 scan
+    :func:`_decode_block`'s live/dead decode step over the device-resident
+    state the epilogue folded -- on-device sampling, per-step KV append
+    through the paged pool, stop-flag detection -- so the host syncs one
+    ``[B, K, 2 + 2*top_n]`` packed block per K tokens and replays the
+    authoritative stop rules at commit (``Scheduler.commit_block``),
+    exactly like the classic ``decode_block``.
+
+    rng identity: step 0 splits exactly like a lone packed dispatch and
+    each scan step splits once, matching K sequential single-step
+    dispatches key-for-key -- greedy, seeded, AND unseeded-temperature
+    lanes are token-identical to K=1 (asserted in tier-1).
+
+    Frozen lanes (dead, speculating, mid-chunk) re-write the KV their
+    device row already describes: KV at a position is a pure function of
+    (token, position, committed prefix), so the repeated stale write is
+    idempotent -- the same argument that makes ``decode_block``'s masked
+    dead lanes safe.  Lanes past their page allocation self-pause via
+    ``limit_lens`` before the table runs out (the engine pre-grows
+    ``num_steps`` tokens of lookahead).
+
+    The engine dispatches ``num_steps > 1`` only on chunk-free, spec-free
+    ticks (the adaptive-K controller collapses to 1 under prefill or
+    speculation pressure), but the scan is correct for any dispatch: a
+    final-chunk lane activated by step 0's epilogue keeps decoding inside
+    the block, which is how post-prefill lanes ride multi-step.
+
+    Returns the :func:`_packed_unified_step` contract with ``packed``
+    widened to ``[B, num_steps, 2 + 2*top_n]`` (row 0 = step 0; ``-1``
+    tokens mark steps a lane was already dead for)."""
+    packed0, spec_packed, tokens, seq_lens, active, kv_pages, rng = (
+        _packed_unified_step(
+            params, cfg, kv_pages, tokens, seq_lens, limit_lens, active,
+            stop_ids, page_table, t_tokens, t_lane, t_rel, t_dec, p_start,
+            p_lens, p_sample, p_activate, dec_cap, seg_off, v_lens, rng,
+            sampling, s_max, s_spec, top_n, use_filters,
+        )
+    )
+
+    def live_step(carry):
+        tokens, seq_lens, active, rng, kv = carry
+        logits, kv = _decode_once(params, cfg, kv, tokens, seq_lens, page_table)
+        rng, sub = jax.random.split(rng)
+        sampled = sample_tokens(
+            logits, sub, sampling, use_filters, positions=seq_lens + 1
+        )
+        lp, top_ids, top_lps = token_logprobs(logits, sampled, top_n)
+        hit_stop = jnp.any(sampled[:, None] == stop_ids, axis=1)
+        emit = active & ~hit_stop
+        new_seq = seq_lens + emit.astype(jnp.int32)
+        new_active = emit & (new_seq < limit_lens)
+        new_tokens = jnp.where(emit, sampled, tokens)
+        out = jnp.where(active, sampled, -1)
+        packed = pack_sampled_logprobs(out, lp, top_ids, top_lps)
+        return (new_tokens, new_seq, new_active, rng, kv), packed
+
+    def dead_step(carry):
+        B = carry[0].shape[0]
+        packed = jnp.full((B, 2 + 2 * top_n), -1, jnp.int32)
+        return carry, packed
+
+    def body(carry, _):
+        return jax.lax.cond(jnp.any(carry[2]), live_step, dead_step, carry)
+
+    (tokens, seq_lens, active, rng, kv_pages), tail = jax.lax.scan(
+        body, (tokens, seq_lens, active, rng, kv_pages), None,
+        length=num_steps - 1,
+    )
+    packed = jnp.concatenate(
+        [packed0[:, None], tail.transpose(1, 0, 2)], axis=1
+    )
+    return packed, spec_packed, tokens, seq_lens, active, kv_pages, rng
+
+
+packed_unified_multistep = partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "s_max", "num_steps", "s_spec", "top_n", "use_filters"
+    ),
+    donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
+)(_packed_unified_multistep)
+
+
 @partial(jax.jit, static_argnames=("cfg", "top_n"))
 def score_prompt_step(
     params: Params,

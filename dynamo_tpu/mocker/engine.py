@@ -83,6 +83,15 @@ class MockerConfig:
     # time there is nothing to overlap, and unit tests keep their
     # same-tick token delivery).
     async_dispatch: bool = True
+    # multi-step decode (ISSUE 16, mirrors EngineConfig.multistep_decode):
+    # each simulated dispatch covers K decode steps -- K tokens per lane
+    # per tick, one K-wide simulated device sleep, and K-1 zero-gap step
+    # boundaries (device-internal by construction) -- so tier-1 exercises
+    # the K-block commit/discard plane device-free.  1 = the exact
+    # single-step tick (seed behavior); N > 1 = fixed K; 0 = adaptive
+    # (ramp toward 8 on pressure-free ticks, collapse to 1 while anything
+    # waits or prefills, the engine controller's shape).
+    multistep_k: int = 1
 
 
 @dataclass
@@ -141,8 +150,11 @@ class MockerEngine:
         # planner/SLO-loop tests exercise the whole plane chip-free
         self.profiler = profiling.profiler
         # double-buffered lane: the in-flight simulated dispatch --
-        # (sleep_task, rids snapshot) -- whose host commit runs next tick
+        # (sleep_task, rids snapshot, K) -- whose host commit runs next tick
         self._inflight_tick = None
+        # adaptive multi-step ramp (multistep_k == 0): doubles per
+        # pressure-free tick toward the engine's default ceiling
+        self._ms_ramp = 1
 
     def _sink(self, ev: Dict[str, Any]) -> None:
         if self.kv_event_sink is not None:
@@ -399,11 +411,37 @@ class MockerEngine:
             self.running[seq.request_id] = seq
             budget -= cost.new_tokens
 
-    async def _commit_generation(self, rids) -> None:
+    def _plan_k(self) -> int:
+        """Decode steps the next simulated dispatch fuses (the engine's
+        ``_multistep_plan_k`` shape, device-free): anything waiting or
+        still prefilling collapses K to single-token granularity so
+        admission never stalls behind a fused block; a pressure-free tick
+        returns the fixed K (``multistep_k > 1``) or ramps the adaptive
+        one (``multistep_k == 0``) toward the engine's default ceiling."""
+        cfg = self.cfg
+        if cfg.multistep_k == 1:
+            return 1
+        pressure = bool(self._waiting_list) or any(
+            not s.prefilled for s in self.running.values()
+        )
+        if pressure:
+            self._ms_ramp = 1
+            return 1
+        if cfg.multistep_k > 1:
+            return cfg.multistep_k
+        k = self._ms_ramp
+        self._ms_ramp = min(self._ms_ramp * 2, 8)
+        return k
+
+    async def _commit_generation(self, rids, k: int = 1) -> None:
         """Host commit of one simulated dispatch: generate (and fan out)
-        one token for every lane the dispatch snapshot covered.  Lanes
-        cancelled/preempted since the snapshot simply skip -- the mocker
-        analog of the engine's stale-slot commit guards."""
+        the K tokens the dispatch covered for every lane its snapshot
+        held.  Lanes cancelled/preempted since the snapshot simply skip,
+        and a lane that finishes/preempts mid-block drops its remaining
+        steps -- the mocker analog of the engine's stale-slot commit
+        guards and K-block replay discard.  Token identity is K-invariant
+        by construction: ``_next_token`` is a pure function of (prompt,
+        num_generated)."""
         cfg = self.cfg
         for rid in rids:
             seq = self.running.get(rid)
@@ -418,15 +456,20 @@ class MockerEngine:
                         / cfg.speedup_ratio
                     )
                 seq.prefilled = True
-            self._generate_one(seq)
+            for _ in range(k):
+                if self.running.get(rid) is not seq:
+                    break  # finished or preempted mid-block: discard rest
+                self._generate_one(seq)
 
     async def _simulate_tick(self, tick=None) -> None:
         cfg = self.cfg
         t0 = time.perf_counter()
         self.obs.observe_sched(len(self._waiting_list), len(self.running))
         self.obs.observe_kv(self.kv.num_active_blocks, self.kv.max_capacity)
-        # decode time models HBM-bound KV reads over all active tokens
-        tick_s = cfg.decode_s_per_step * self.kv.num_active_blocks
+        # decode time models HBM-bound KV reads over all active tokens;
+        # a K-step fused dispatch sleeps K steps' worth in one launch
+        k = self._plan_k()
+        tick_s = cfg.decode_s_per_step * self.kv.num_active_blocks * k
         had_work = bool(self.running)
         # double-buffered lanes (ISSUE 13): with simulated device time
         # armed, tick N's sleep starts BEFORE tick N-1's host commit runs
@@ -450,17 +493,24 @@ class MockerEngine:
             )
             prev = self._inflight_tick
             self._inflight_tick = (
-                (sleep_task, list(self.running.keys())) if had_work else None
+                (sleep_task, list(self.running.keys()), k)
+                if had_work
+                else None
             )
             if prev is not None:
-                prev_task, rids = prev
-                await self._commit_generation(rids)
+                prev_task, rids, prev_k = prev
+                await self._commit_generation(rids, prev_k)
                 if tick is not None:
                     tick.mark("commit")
                 if prev_task is not None:
                     await prev_task
                 if tick is not None:
                     tick.mark("device_wait")
+                    # K-1 step boundaries of the fused block were
+                    # device-internal: zero host-visible idle by
+                    # construction (the engine commit notes the same)
+                    for _ in range(prev_k - 1):
+                        tick.note_zero_gap()
                     if self._inflight_tick is not None:
                         tick.note_zero_gap()
                     else:
@@ -476,13 +526,15 @@ class MockerEngine:
             # host, the decode sleep = device_wait)
             tick.note_dispatch("decode_block")
             tick.mark("dispatch")
-        await self._commit_generation(list(self.running.keys()))
+        await self._commit_generation(list(self.running.keys()), k)
         if tick is not None and had_work:
             tick.mark("commit")
         if tick_s:
             await asyncio.sleep(tick_s / cfg.speedup_ratio)
         if tick is not None and had_work:
             tick.mark("device_wait")
+            for _ in range(k - 1):
+                tick.note_zero_gap()
             self.profiler.note_results_ready()
         if self.running:
             self.obs.observe_step(

@@ -241,6 +241,7 @@ class ShardedSteps:
     decode_block: Any
     unified_step: Any
     packed_unified_step: Any
+    packed_unified_multistep: Any
     verify_and_sample: Any
     update_lanes: Any
     inject_token: Any
@@ -342,6 +343,23 @@ def make_sharded_steps(
         ),
         out_shardings=(None, None, vec, vec, vec, kv_sh, None),
     )
+    packed_unified_multistep = jax.jit(
+        _step._packed_unified_multistep,
+        static_argnames=(
+            "cfg", "s_max", "num_steps", "s_spec", "top_n", "use_filters"
+        ),
+        donate_argnames=("kv_pages", "tokens", "seq_lens", "active"),
+        # identical operand layout to packed_unified_step (the multi-step
+        # entry IS that step plus a decode scan over the same state); the
+        # widened [B, K, ...] packed output is host-bound like every other
+        # packed output and stays unconstrained
+        in_shardings=(
+            param_sh, kv_sh, vec, vec, vec, vec, mat, mat,
+            None, None, None, None, vec, vec, vec, vec, vec, vec, vec,
+            None, samp,
+        ),
+        out_shardings=(None, None, vec, vec, vec, kv_sh, None),
+    )
     verify_and_sample = jax.jit(
         _step._verify_and_sample,
         static_argnames=("cfg", "top_n", "use_filters"),
@@ -424,6 +442,7 @@ def make_sharded_steps(
         decode_block=decode_block,
         unified_step=unified_step,
         packed_unified_step=packed_unified_step,
+        packed_unified_multistep=packed_unified_multistep,
         verify_and_sample=verify_and_sample,
         update_lanes=update_lanes,
         inject_token=inject_token,

@@ -232,6 +232,13 @@ class EngineMetrics:
             "dynamo_engine_executable_shapes",
             "Active packed-dispatch (Np, s_max) executable shape pairs",
         )
+        # multi-step decode (ISSUE 16): decode iterations fused into the
+        # last packed dispatch -- 1 = single-step (pressure or disabled),
+        # up to multistep_max_k when the adaptive controller opens up
+        self.multistep_k = reg.gauge(
+            "dynamo_engine_multistep_k",
+            "Decode steps fused into the last packed unified dispatch",
+        )
         if max_slots:
             self.slots.set(max_slots)
 
@@ -265,6 +272,9 @@ class EngineMetrics:
 
     def observe_executable_shapes(self, n: int) -> None:
         self.executable_shapes.set(n)
+
+    def observe_multistep_k(self, k: int) -> None:
+        self.multistep_k.set(k)
 
 
 class OffloadMetrics:

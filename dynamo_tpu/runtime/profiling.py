@@ -324,6 +324,18 @@ class TickProfiler:
             recs = list(self._ring)
         return recs[-last:] if last else recs
 
+    def recent_host_occupancy(self, last: int = 32) -> Optional[float]:
+        """Mean host occupancy over the last ``last`` completed ticks, or
+        ``None`` when nothing has been profiled (disabled profiler, cold
+        ring).  The adaptive multi-step decode controller's signal
+        (engine ``_multistep_plan_k``): a host-bound loop (occupancy near
+        1) is exactly the condition K amortizes, so the controller jumps
+        straight to its ceiling instead of ramping."""
+        recs = self.records(last)
+        if not recs:
+            return None
+        return sum(r.host_occupancy for r in recs) / len(recs)
+
     def summary(self) -> Dict[str, Any]:
         """Aggregate over the ring: per-phase totals + fractions of host
         time, mean host occupancy, dispatch-gap percentiles, tick count.
