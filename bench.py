@@ -318,9 +318,15 @@ async def run_host_pipeline(rs) -> dict:
     occupancy, dispatch-gap p50, and tok/s -- a K-step fused dispatch
     amortizes the per-tick host work over K tokens, so occupancy and gap
     must fall monotonically toward K=8 (``pipe_host_occ_k8 <
-    pipe_host_occ_k1`` is the acceptance line)."""
+    pipe_host_occ_k1`` is the acceptance line).
+
+    Each leg also reports ``pipe_compiles_<name>``: the compile-sentry
+    events the leg's engine minted (one per distinct fused-K executable),
+    so the silicon round can price what a K sweep costs in recompiles --
+    a controller that buys occupancy by melting the compile cache shows
+    up here, not just in tok/s."""
     from dynamo_tpu.mocker import MockerConfig, MockerEngine
-    from dynamo_tpu.runtime import profiling
+    from dynamo_tpu.runtime import compile_sentry, profiling
 
     prof = profiling.profiler
     was_enabled = prof.enabled
@@ -336,6 +342,7 @@ async def run_host_pipeline(rs) -> dict:
     )
     try:
         for name, async_on, ms_k in legs:
+            compiles_before = compile_sentry.total()
             eng = MockerEngine(
                 MockerConfig(
                     max_batch_size=16,
@@ -358,6 +365,9 @@ async def run_host_pipeline(rs) -> dict:
             await eng.stop()
             out[f"pipe_gap_p50_ms_{name}"] = psum["gap_p50_ms"]
             out[f"pipe_tok_s_{name}"] = round(total / elapsed, 2)
+            out[f"pipe_compiles_{name}"] = (
+                compile_sentry.total() - compiles_before
+            )
             if name.startswith("k"):
                 out[f"pipe_host_occ_{name}"] = psum["host_occupancy"]
         gs, ga = out.get("pipe_gap_p50_ms_serial"), out.get(

@@ -3,12 +3,13 @@
 The serving stack's hazard classes are mechanical -- a blocking call on an
 event loop, a silent ``except Exception`` around a KV transfer, a host
 sync on the tick loop, an attribute shared across threads without a lock
--- so they are checked mechanically: AST rules DT001-DT016 (DT014-DT016
-are interprocedural, built on a project-wide call graph + thread-role
-inference), inline ``# dynalint: disable=RULE`` suppressions, a
-checked-in baseline for grandfathered findings, and a CLI
-(``python -m dynamo_tpu.analysis``) that tier-1 runs as a zero-violation
-gate.  Stdlib-only by design.
+-- so they are checked mechanically: AST rules DT001-DT020 (DT014-DT016
+are interprocedural race rules built on a project-wide call graph +
+thread-role inference; DT017-DT020 are the recompile/dispatch-discipline
+pass over the same index), inline ``# dynalint: disable=RULE``
+suppressions, a checked-in baseline for grandfathered findings, and a CLI
+(``python -m dynamo_tpu.analysis``, text/JSON/SARIF) that tier-1 runs as
+a zero-violation gate.  Stdlib-only by design.
 
 Public surface:
 
@@ -17,11 +18,16 @@ Public surface:
 * :data:`dynamo_tpu.analysis.threads.THREAD_ROLE_MANIFEST` -- thread roles
   inference cannot pin (DT014-DT016); the role model's single source of
   truth, validated at runtime by ``runtime/thread_sentry.py``.
+* :data:`dynamo_tpu.analysis.buckets.BUCKETING_HELPERS` -- the blessed
+  round-up/pad functions DT017 accepts as shape launderers, mirrored at
+  runtime by ``runtime/compile_sentry.py``'s ``COMPILE_BUDGET``
+  enforcement.
 * :class:`Analyzer`, :class:`Baseline`, :data:`ALL_RULES` -- programmatic
   use (the tier-1 gate test drives these directly).
 * :func:`dynamo_tpu.analysis.cli.run` -- the CLI.
 """
 
+from .buckets import BUCKETING_HELPERS
 from .core import Analyzer, Baseline, Finding, ModuleInfo, ProjectRule, Rule
 from .hotpath import HOT_PATH_MANIFEST, hot_path
 from .rules import ALL_RULES, get_rules
@@ -29,6 +35,7 @@ from .threads import THREAD_ROLE_MANIFEST
 
 __all__ = [
     "ALL_RULES",
+    "BUCKETING_HELPERS",
     "Analyzer",
     "Baseline",
     "Finding",

@@ -1,0 +1,67 @@
+"""Blessed-bucketing manifest for dynalint DT017 (unbucketed traced shapes).
+
+The recompile story of the engine rests on one discipline: every
+request-varying quantity (number of requests, token counts, page counts)
+that ends up determining the SHAPE of a traced argument must first pass
+through a registered round-up/pad helper so jitted entry points only ever
+see a small closed set of shapes.  This module is the registry of those
+helpers.  DT017 treats a call to any of them as a laundering point: values
+flowing out of a blessed helper are shape-safe.
+
+Like ``hotpath.py``, this module must stay import-light (stdlib only) --
+the analyzer imports it and the analyzer must run anywhere, including
+environments without jax installed.
+
+Two declaration forms:
+
+- ``BUCKETING_HELPERS``: dotted-name suffixes of free functions.  A call
+  site matches when its resolved dotted name (or its trailing component
+  path) ends with an entry -- so ``pow2_bucket(n)``,
+  ``bucketing.pow2_bucket(n)`` and
+  ``dynamo_tpu.engine.bucketing.pow2_bucket(n)`` all match
+  ``"bucketing.pow2_bucket"``.
+- ``BUCKETING_METHODS``: bare method names matched against the final
+  attribute of a method call whose receiver we cannot resolve statically
+  (``self._packed_shapes.fit(...)``).  Keep this list short and the names
+  distinctive; a broad name here would launder taint everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+# Free functions whose RESULT is a bucketed (bounded-cardinality) quantity.
+BUCKETING_HELPERS: Tuple[str, ...] = (
+    "bucketing.pow2_bucket",
+    "bucketing.prefill_buckets",
+    "bucketing.pick_bucket",
+    "bucketing.pick_page_bucket",
+)
+
+# Methods (matched by name only) whose result is bucketed.
+# PackedShapeBudget.fit returns an (Np, s_max, s_spec) triple drawn from a
+# bounded LRU of padded shapes -- the packed plane's one shape authority.
+BUCKETING_METHODS: Tuple[str, ...] = (
+    "fit",
+)
+
+
+def is_bucketing_call(dotted: str) -> bool:
+    """True when ``dotted`` (a resolved dotted call name) is a blessed
+    bucketing helper.  Suffix-matched on dot boundaries."""
+    if not dotted:
+        return False
+    for entry in BUCKETING_HELPERS:
+        if dotted == entry or dotted.endswith("." + entry):
+            return True
+        # allow the bare tail too ("pow2_bucket" resolved without module)
+        tail = entry.rsplit(".", 1)[-1]
+        if dotted == tail:
+            return True
+    return False
+
+
+def is_bucketing_method(attr: str) -> bool:
+    """True when a method call's final attribute name is a blessed
+    bucketing method (used when the receiver cannot be resolved)."""
+    return attr in BUCKETING_METHODS

@@ -4,7 +4,8 @@ Exit status is the CI contract: 0 when no non-baselined findings, 1 when
 any remain, 2 on usage / unreadable-source errors.  ``--format json``
 emits a stable machine-readable report (sorted findings, schema versioned)
 for future CI consumption; with ``--baseline`` it also audits the baseline
-(which fingerprints were consumed, which are stale and prunable).
+(which fingerprints were consumed, which are stale and prunable);
+``--format sarif`` emits SARIF 2.1.0 for code-scanning UIs.
 ``--only DT014,DT015 --changed`` is the fast local loop: one rule family
 over just the files changed vs ``git merge-base HEAD main``.
 """
@@ -41,7 +42,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dynamo_tpu.analysis",
         description="dynalint: AST hazard analysis for async/JAX hot paths "
-                    "and cross-thread state (rules DT001-DT016)",
+                    "and cross-thread state (rules DT001-DT020)",
         epilog=_EXIT_CODES_HELP,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
@@ -57,8 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
              "runs for baseline fingerprints to be stable",
     )
     p.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        dest="fmt", help="output format (default: text)",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        dest="fmt", help="output format (default: text); sarif emits a "
+                         "SARIF 2.1.0 log for code-scanning UIs",
     )
     p.add_argument(
         "--baseline", default=None, metavar="FILE",
@@ -170,6 +172,8 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.fmt == "json":
         print(_render_json(findings, analyzer.errors, baselined, audit))
+    elif args.fmt == "sarif":
+        print(_render_sarif(findings, rules))
     else:
         for f in findings:
             print(f.render())
@@ -254,4 +258,81 @@ def _render_json(
             "used": dict(sorted(audit["used"].items())),
             "stale": dict(sorted(audit["stale"].items())),
         }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+# severity -> SARIF defaultConfiguration.level / result level
+_SARIF_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _render_sarif(findings: List[Finding], rules) -> str:
+    """Minimal SARIF 2.1.0 log: one run, the executed rule catalog in
+    tool.driver.rules, one result per finding with the dynalint
+    fingerprint (so code-scanning dedup tracks findings across pushes the
+    same way the JSON baseline does)."""
+    rule_ids = sorted({r.id for r in rules})
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    by_id = {r.id: r for r in rules}
+    sarif_rules = [
+        {
+            "id": rid,
+            "name": by_id[rid].name,
+            "shortDescription": {"text": by_id[rid].name},
+            "fullDescription": {"text": by_id[rid].description},
+            "defaultConfiguration": {
+                "level": _SARIF_LEVELS.get(by_id[rid].severity, "warning"),
+            },
+        }
+        for rid in rule_ids
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index.get(f.rule, -1),
+            "level": _SARIF_LEVELS.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/"),
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col,
+                        },
+                    },
+                    "logicalLocations": (
+                        [{"fullyQualifiedName": f.qualname}]
+                        if f.qualname else []
+                    ),
+                }
+            ],
+            "partialFingerprints": {"dynalint/v1": f.fingerprint},
+        }
+        for f in findings
+    ]
+    doc = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "dynalint",
+                        "informationUri": (
+                            "https://github.com/ai-dynamo/dynamo"
+                        ),
+                        "rules": sarif_rules,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }
     return json.dumps(doc, indent=2, sort_keys=True)

@@ -1,0 +1,721 @@
+"""dynalint rules DT017-DT020: recompile hazards + dispatch discipline.
+
+The engine's perf story rests on two compile-side invariants nothing
+checked until now: jitted entry points see only a small bucketed set of
+shapes (otherwise XLA recompiles per request), and the tick thread issues
+exactly the declared packed dispatches (otherwise "one dispatch per tick"
+quietly becomes several).  These rules make both statically checkable on
+the same ProjectIndex/call-graph the race rules (DT014-DT016) use:
+
+* **DT017** -- value provenance: a request-varying quantity (``len(...)``
+  and arithmetic over it) determines the SHAPE of a value passed as a
+  *traced* argument of a jitted entry point without passing through a
+  blessed bucketing helper (``analysis/buckets.py``).  Every distinct
+  value is a distinct compiled executable.
+* **DT018** -- the same unbounded quantity reaching a *static* argument
+  position (``static_argnames``/``static_argnums``) of a jitted call:
+  static args key the compile cache directly, so unbounded cardinality is
+  a guaranteed cache explosion.
+* **DT019** -- device-touching ops (``jnp.*``, ``jax.device_put/get``,
+  calls resolving to jitted entries, ``self._fns.*`` dispatch-table
+  calls) reachable under the tick/tick-coro role outside the module's
+  declared ``PACKED_DISPATCH_SITES`` tuple -- one-dispatch-per-tick as a
+  lint invariant, layered on the thread-role inference.
+* **DT020** -- ``jax.jit(...)``/``partial(jax.jit, ...)`` constructed
+  inside a per-tick/per-request function instead of at module scope: a
+  fresh wrapper has a fresh (empty) compile cache, so every call
+  retraces.  Construction-time factories (``make_*``/``build_*``) are
+  exempt -- building the dispatch table once at startup is the pattern.
+
+The runtime complement is ``runtime/compile_sentry.py``: what these rules
+prove about shapes statically, the sentry enforces against the actual XLA
+compile-event stream under ``COMPILE_BUDGET``.
+
+Import discipline: this module must not import ``rules.py`` (rules.py
+imports it to register DT017-DT020); everything shared lives in
+``core``/``callgraph``/``threads``/``hotpath``/``buckets``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .buckets import is_bucketing_call, is_bucketing_method
+from .callgraph import FunctionNode, dotted, own_scope_walk
+from .core import Finding, ProjectRule
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIALS = {"partial", "functools.partial"}
+
+
+def _body_walk(fn: FunctionNode) -> Iterator[ast.AST]:
+    """Like callgraph.own_scope_walk but over the BODY only: decorator
+    expressions are declarations (``@partial(jax.jit, ...)`` is the jit
+    we bless, not a per-call construction), so they must not count as
+    calls made by the function."""
+    stack: List[ast.AST] = list(fn.node.body)  # type: ignore[attr-defined]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _thread_analysis(index):
+    """Same memo slot as rules.py's copy -- one ThreadRoleAnalysis per
+    ProjectIndex no matter which rule asks first."""
+    from .threads import ThreadRoleAnalysis
+
+    memo = getattr(index, "_dynalint_thread_roles", None)
+    if memo is None:
+        memo = ThreadRoleAnalysis(index)
+        index._dynalint_thread_roles = memo
+    return memo
+
+
+# ---------------------------------------------------------------------------
+# jit-sink index: every jitted entry point + its static-argument spec
+# ---------------------------------------------------------------------------
+
+
+class JitEntry:
+    """One jitted entry point (decorator or assignment form)."""
+
+    __slots__ = (
+        "name", "relpath", "params", "static_names", "static_nums",
+        "impl_key",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        relpath: str,
+        params: List[str],
+        static_names: Set[str],
+        static_nums: Set[int],
+        impl_key: Optional[str] = None,
+    ) -> None:
+        self.name = name
+        self.relpath = relpath
+        self.params = params
+        self.static_names = static_names
+        self.static_nums = static_nums
+        self.impl_key = impl_key  # FunctionNode.key of the raw impl
+
+    def is_static(self, pos: Optional[int], kw: Optional[str]) -> bool:
+        if kw is not None:
+            return kw in self.static_names
+        if pos is None:
+            return False
+        if pos in self.static_nums:
+            return True
+        if pos < len(self.params):
+            return self.params[pos] in self.static_names
+        return False
+
+
+def _static_spec(call: Optional[ast.Call]) -> Tuple[Set[str], Set[int]]:
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    if call is None:
+        return names, nums
+    for kw in call.keywords:
+        v = kw.value
+        if kw.arg == "static_argnames":
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                names.update(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                )
+        elif kw.arg == "static_argnums":
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums.update(
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                )
+    return names, nums
+
+
+def _param_names(node: ast.AST) -> List[str]:
+    a = node.args  # type: ignore[attr-defined]
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _jit_decorator_call(fn: FunctionNode) -> Tuple[bool, Optional[ast.Call]]:
+    """(is_jitted, the call carrying static kwargs or None)."""
+    for dec in fn.node.decorator_list:  # type: ignore[attr-defined]
+        if dotted(dec) in _JIT_NAMES:
+            return True, None  # bare @jax.jit
+        if isinstance(dec, ast.Call):
+            d = dotted(dec.func)
+            if d in _JIT_NAMES:
+                return True, dec  # @jax.jit(static_argnames=...)
+            if (
+                d in _PARTIALS and dec.args
+                and dotted(dec.args[0]) in _JIT_NAMES
+            ):
+                return True, dec  # @partial(jax.jit, static_argnames=...)
+    return False, None
+
+
+def _assignment_jit(value: ast.AST) -> Tuple[Optional[str], Optional[ast.Call]]:
+    """(impl dotted name, static-kwarg-carrying call) for
+    ``jax.jit(impl, ...)`` or ``partial(jax.jit, ...)(impl)``."""
+    if not isinstance(value, ast.Call) or not value.args:
+        return None, None
+    if dotted(value.func) in _JIT_NAMES:
+        return dotted(value.args[0]), value
+    inner = value.func
+    if (
+        isinstance(inner, ast.Call)
+        and dotted(inner.func) in _PARTIALS
+        and inner.args
+        and dotted(inner.args[0]) in _JIT_NAMES
+    ):
+        return dotted(value.args[0]), inner
+    return None, None
+
+
+class JitSinks:
+    """All jitted entry points in the project, addressable three ways:
+    by FunctionNode key (decorator form), by (relpath, exported name)
+    (assignment form + module fns), and by bare name (dispatch tables)."""
+
+    def __init__(self, index) -> None:
+        self.by_key: Dict[str, JitEntry] = {}
+        self.assigned: Dict[Tuple[str, str], JitEntry] = {}
+        self.by_name: Dict[str, List[JitEntry]] = {}
+        for fn in index.functions.values():
+            jitted, spec_call = _jit_decorator_call(fn)
+            if not jitted:
+                continue
+            names, nums = _static_spec(spec_call)
+            entry = JitEntry(
+                fn.name, fn.relpath, _param_names(fn.node), names, nums,
+                impl_key=fn.key,
+            )
+            self.by_key[fn.key] = entry
+            self.assigned[(fn.relpath, fn.qualname)] = entry
+            self.by_name.setdefault(fn.name, []).append(entry)
+        for relpath, module in index.modules.items():
+            for node in module.tree.body:
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    continue
+                impl, spec_call = _assignment_jit(node.value)
+                if impl is None:
+                    continue
+                exported = node.targets[0].id
+                names, nums = _static_spec(spec_call)
+                impl_fn = index.functions.get(
+                    f"{relpath}::{impl.rsplit('.', 1)[-1]}"
+                )
+                params = _param_names(impl_fn.node) if impl_fn else []
+                entry = JitEntry(
+                    exported, relpath, params, names, nums,
+                    impl_key=impl_fn.key if impl_fn else None,
+                )
+                self.assigned[(relpath, exported)] = entry
+                self.by_name.setdefault(exported, []).append(entry)
+
+    def resolve(self, index, call: ast.Call, caller: FunctionNode
+                ) -> Optional[JitEntry]:
+        """The JitEntry a call site dispatches into, or None."""
+        f = call.func
+        # dispatch-table idiom: self._fns.X(...) / fns.X(...)
+        if isinstance(f, ast.Attribute):
+            recv = dotted(f.value)
+            if recv is not None and recv.split(".")[-1].endswith("_fns"):
+                entries = self.by_name.get(f.attr)
+                if entries:
+                    return entries[0]
+        target = index.resolve_callable(f, caller)
+        if target is not None:
+            hit = self.by_key.get(target.key)
+            if hit is not None:
+                return hit
+        d = dotted(f)
+        if d is None:
+            return None
+        parts = d.split(".")
+        rel = caller.relpath
+        imp = index.imports.get(rel)
+        if len(parts) == 1:
+            hit = self.assigned.get((rel, d))
+            if hit is not None:
+                return hit
+            if imp is not None:
+                sym = imp.symbols.get(d)
+                if sym is not None:
+                    return self.assigned.get(sym)
+        elif len(parts) == 2 and imp is not None:
+            target_rel = imp.module_aliases.get(parts[0])
+            if target_rel is not None:
+                return self.assigned.get((target_rel, parts[1]))
+        return None
+
+
+def jit_sinks(index) -> JitSinks:
+    memo = getattr(index, "_dynalint_jit_sinks", None)
+    if memo is None:
+        memo = JitSinks(index)
+        index._dynalint_jit_sinks = memo
+    return memo
+
+
+def _traced_world(index) -> Set[str]:
+    """FunctionNode keys of everything that runs INSIDE a jit trace: the
+    entry impls plus their transitive project callees.  Their jnp.* calls
+    are staged once at trace time, not launched per call, so the
+    dispatch-discipline rule (DT019) must not count them."""
+    memo = getattr(index, "_dynalint_traced_world", None)
+    if memo is not None:
+        return memo
+    sinks = jit_sinks(index)
+    seeds = set(sinks.by_key)
+    for entry in sinks.assigned.values():
+        if entry.impl_key is not None:
+            seeds.add(entry.impl_key)
+    world: Set[str] = set()
+    stack = [k for k in seeds if k in index.functions]
+    while stack:
+        key = stack.pop()
+        if key in world:
+            continue
+        world.add(key)
+        fn = index.functions.get(key)
+        if fn is None:
+            continue
+        for callee in index.callees(fn):
+            if callee.key not in world:
+                stack.append(callee.key)
+    index._dynalint_traced_world = world
+    return world
+
+
+# ---------------------------------------------------------------------------
+# value-provenance (taint) evaluation, per function scope
+# ---------------------------------------------------------------------------
+
+# builtins through which request-varying scalars pass unlaundered
+_PASSTHROUGH = {"min", "max", "sum", "abs", "int", "round"}
+
+# numpy/jnp constructors whose SHAPE comes from their arguments
+_ARRAY_CTOR_TAILS = {"zeros", "ones", "full", "empty", "arange"}
+_ARRAY_WRAP_TAILS = {"array", "asarray", "stack", "concatenate"}
+_ARRAY_BASES = {"np", "numpy", "jnp", "jax.numpy"}
+
+
+def _array_base(d: str) -> bool:
+    base = d.rsplit(".", 1)[0] if "." in d else ""
+    return base in _ARRAY_BASES
+
+
+class _Taint:
+    """Per-function two-level taint: SCALAR (a request-varying count) and
+    SHAPE (an array/sequence whose dimensions carry such a count).
+    Conservative in the anti-false-positive direction: any call that is
+    neither a source, a known passthrough, nor an array constructor
+    launders its result clean."""
+
+    def __init__(self, fn: FunctionNode) -> None:
+        self.scalar: Set[str] = set()
+        self.shape: Set[str] = set()
+        assigns = [
+            n for n in _body_walk(fn)
+            if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+        ]
+        assigns.sort(key=lambda n: n.lineno)
+        for _ in range(2):  # fixpoint over simple forward/loop flows
+            for node in assigns:
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign):
+                    targets, value = [node.target], node.value
+                else:  # AugAssign: target op= value keeps prior taint
+                    targets, value = [node.target], node.value
+                if value is None:
+                    continue
+                s = self.is_scalar(value)
+                sh = self.is_shape(value)
+                for t in targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if s:
+                        self.scalar.add(t.id)
+                    if sh:
+                        self.shape.add(t.id)
+
+    # -- evaluators --------------------------------------------------------
+
+    def is_scalar(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.scalar
+        if isinstance(expr, ast.BinOp):
+            return self.is_scalar(expr.left) or self.is_scalar(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_scalar(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            return self.is_scalar(expr.body) or self.is_scalar(expr.orelse)
+        if isinstance(expr, ast.Call):
+            d = dotted(expr.func)
+            if d is None:
+                return False
+            if self._launders(expr, d):
+                return False
+            if d == "len":
+                return True  # THE source: a request-varying count
+            if d in _PASSTHROUGH:
+                return any(self.is_scalar(a) for a in expr.args)
+            return False  # unknown call launders (conservative)
+        return False
+
+    def is_shape(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.shape
+        if isinstance(expr, ast.IfExp):
+            return self.is_shape(expr.body) or self.is_shape(expr.orelse)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mult):
+            # [pad] * n -- a Python sequence whose LENGTH is the count
+            left, right = expr.left, expr.right
+            if isinstance(left, ast.List) and self.is_scalar(right):
+                return True
+            if isinstance(right, ast.List) and self.is_scalar(left):
+                return True
+            return self.is_shape(left) or self.is_shape(right)
+        if isinstance(expr, ast.Call):
+            d = dotted(expr.func)
+            if d is None:
+                return False
+            tail = d.rsplit(".", 1)[-1]
+            if _array_base(d) and tail in _ARRAY_CTOR_TAILS:
+                return any(self._dim_tainted(a) for a in expr.args) or any(
+                    kw.arg == "shape" and self._dim_tainted(kw.value)
+                    for kw in expr.keywords
+                )
+            if _array_base(d) and tail in _ARRAY_WRAP_TAILS:
+                return any(self.is_shape(a) for a in expr.args)
+        return False
+
+    def _dim_tainted(self, arg: ast.AST) -> bool:
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            return any(self.is_scalar(e) for e in arg.elts)
+        return self.is_scalar(arg)
+
+    @staticmethod
+    def _launders(call: ast.Call, d: str) -> bool:
+        if is_bucketing_call(d):
+            return True
+        # method call on an unresolvable receiver: bless by method name
+        if isinstance(call.func, ast.Attribute) and dotted(
+            call.func.value
+        ) is None:
+            return False
+        if "." in d and is_bucketing_method(d.rsplit(".", 1)[-1]):
+            return True
+        return False
+
+
+def _pfind(index, rule, relpath: str, node: ast.AST, qualname: str,
+           message: str) -> Finding:
+    module = index.modules.get(relpath)
+    line = getattr(node, "lineno", 1)
+    src = module.source_line(line) if module is not None else ""
+    return Finding(
+        rule=rule.id, severity=rule.severity, path=relpath, line=line,
+        col=getattr(node, "col_offset", 0) + 1, message=message,
+        qualname=qualname, source_line=src,
+    )
+
+
+# ---------------------------------------------------------------------------
+# DT017 / DT018
+# ---------------------------------------------------------------------------
+
+
+class UnbucketedTracedShape(ProjectRule):
+    id = "DT017"
+    name = "unbucketed-traced-shape"
+    severity = "error"
+    description = (
+        "A request-varying count (len(...) and arithmetic over it) "
+        "determines the shape of a value passed as a TRACED argument of "
+        "a jitted entry point without passing through a blessed bucketing "
+        "helper (analysis/buckets.py: pow2_bucket, pick_bucket, "
+        "pick_page_bucket, prefill_buckets, PackedShapeBudget.fit).  "
+        "Every distinct count is a distinct shape is a distinct XLA "
+        "compile -- the cache melts under load.  Route the count through "
+        "a bucketing helper (pad to the bucket) before it becomes a "
+        "dimension.  The runtime compile sentry (DYN_COMPILE_SENTRY=1) "
+        "enforces the same invariant against COMPILE_BUDGET."
+    )
+
+    def check_project(self, index) -> Iterator[Finding]:
+        sinks = jit_sinks(index)
+        if not sinks.by_name:
+            return
+        for fn in index.functions.values():
+            taint = None
+            for node in _body_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                entry = sinks.resolve(index, node, fn)
+                if entry is None:
+                    continue
+                if taint is None:
+                    taint = _Taint(fn)
+                for pos, arg in enumerate(node.args):
+                    if entry.is_static(pos, None):
+                        continue
+                    if taint.is_shape(arg):
+                        yield _pfind(
+                            index, self, fn.relpath, arg, fn.qualname,
+                            f"shape of traced argument {pos} of jitted "
+                            f"entry '{entry.name}' derives from an "
+                            "unbucketed request-varying count -- every "
+                            "distinct count compiles a new executable; "
+                            "round it through a bucketing helper "
+                            "(analysis/buckets.py) first",
+                        )
+                for kw in node.keywords:
+                    if kw.arg is None or entry.is_static(None, kw.arg):
+                        continue
+                    if taint.is_shape(kw.value):
+                        yield _pfind(
+                            index, self, fn.relpath, kw.value, fn.qualname,
+                            f"shape of traced argument '{kw.arg}' of "
+                            f"jitted entry '{entry.name}' derives from an "
+                            "unbucketed request-varying count -- round it "
+                            "through a bucketing helper "
+                            "(analysis/buckets.py) first",
+                        )
+
+
+class UnboundedStaticArgument(ProjectRule):
+    id = "DT018"
+    name = "unbounded-static-argument"
+    severity = "error"
+    description = (
+        "A request-varying count reaches a static argument position "
+        "(static_argnames/static_argnums) of a jitted call.  Static args "
+        "key the compile cache by VALUE, so unbounded cardinality is a "
+        "guaranteed compile-cache explosion (worse than DT017: no shape "
+        "reuse can save it).  Statics must be genuinely finite -- configs, "
+        "flags, bucketed sizes."
+    )
+
+    def check_project(self, index) -> Iterator[Finding]:
+        sinks = jit_sinks(index)
+        if not sinks.by_name:
+            return
+        for fn in index.functions.values():
+            taint = None
+            for node in _body_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                entry = sinks.resolve(index, node, fn)
+                if entry is None:
+                    continue
+                if taint is None:
+                    taint = _Taint(fn)
+                for pos, arg in enumerate(node.args):
+                    if entry.is_static(pos, None) and taint.is_scalar(arg):
+                        yield _pfind(
+                            index, self, fn.relpath, arg, fn.qualname,
+                            f"static argument {pos} of jitted entry "
+                            f"'{entry.name}' carries an unbounded "
+                            "request-varying value -- each distinct value "
+                            "is a full retrace+compile; bucket it or make "
+                            "it a traced array",
+                        )
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    if entry.is_static(None, kw.arg) and taint.is_scalar(
+                        kw.value
+                    ):
+                        yield _pfind(
+                            index, self, fn.relpath, kw.value, fn.qualname,
+                            f"static argument '{kw.arg}' of jitted entry "
+                            f"'{entry.name}' carries an unbounded "
+                            "request-varying value -- each distinct value "
+                            "is a full retrace+compile; bucket it or make "
+                            "it a traced array",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# DT019: one dispatch per tick, as a manifest
+# ---------------------------------------------------------------------------
+
+
+def _packed_sites(module) -> Set[str]:
+    """Function names in the module-level PACKED_DISPATCH_SITES tuple
+    (the TICK_COMMIT_HELPERS declaration pattern)."""
+    out: Set[str] = set()
+    for node in module.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "PACKED_DISPATCH_SITES":
+                if isinstance(node.value, (ast.Tuple, ast.List, ast.Set)):
+                    out.update(
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    )
+    return out
+
+
+class TickDispatchOutsideManifest(ProjectRule):
+    id = "DT019"
+    name = "tick-dispatch-outside-manifest"
+    severity = "error"
+    description = (
+        "A device-touching operation (jnp.*, jax.device_put/get, a call "
+        "resolving to a jitted entry, a self._fns.* dispatch-table call) "
+        "is reachable under the tick/tick-coro thread role outside the "
+        "module's declared PACKED_DISPATCH_SITES tuple.  The perf story "
+        "is ONE packed dispatch per tick; an undeclared device touch on "
+        "the tick thread is either a second dispatch (host-sync stall) "
+        "or an accidental transfer.  Move it inside a declared dispatch "
+        "site, off the tick role, or add the function to "
+        "PACKED_DISPATCH_SITES with a comment justifying the extra "
+        "launch."
+    )
+
+    _ROLES = {"tick", "tick-coro"}
+
+    def check_project(self, index) -> Iterator[Finding]:
+        sinks = jit_sinks(index)
+        analysis = _thread_analysis(index)
+        traced = _traced_world(index)
+        site_cache: Dict[str, Set[str]] = {}
+        for fn in index.functions.values():
+            if fn.key in traced:
+                continue  # runs inside the trace, not on the tick thread
+            if not (self._ROLES & analysis.roles_of(fn)):
+                continue
+            sites = site_cache.get(fn.relpath)
+            if sites is None:
+                module = index.modules.get(fn.relpath)
+                sites = _packed_sites(module) if module is not None else set()
+                site_cache[fn.relpath] = sites
+            if fn.name in sites:
+                continue
+            for node in _body_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                evidence = None
+                if d is not None and (
+                    d.startswith("jnp.")
+                    or d.startswith("jax.numpy.")
+                    or d in ("jax.device_put", "jax.device_get")
+                ):
+                    evidence = d
+                elif sinks.resolve(index, node, fn) is not None:
+                    evidence = d or "<jitted entry>"
+                if evidence is None:
+                    continue
+                yield _pfind(
+                    index, self, fn.relpath, node, fn.qualname,
+                    f"device-touching call '{evidence}' runs under the "
+                    f"tick role in '{fn.qualname}', which is not in this "
+                    "module's PACKED_DISPATCH_SITES -- an undeclared "
+                    "device launch on the tick thread breaks "
+                    "one-dispatch-per-tick; move it into a declared "
+                    "dispatch site or declare this one",
+                )
+
+
+# ---------------------------------------------------------------------------
+# DT020: jit construction on a hot/per-tick path
+# ---------------------------------------------------------------------------
+
+
+class JitConstructionOnHotPath(ProjectRule):
+    id = "DT020"
+    name = "jit-construction-on-hot-path"
+    severity = "error"
+    description = (
+        "jax.jit(...) / partial(jax.jit, ...) constructed inside a "
+        "function that runs per-tick/per-request (tick, tick-coro or "
+        "fanout-worker role, or hot-path-marked) rather than at module "
+        "scope.  A fresh wrapper object has a fresh compile cache, so "
+        "every call retraces and recompiles from zero.  Build wrappers "
+        "at module scope or in a construction-time factory (make_*/"
+        "build_* functions are exempt: building the dispatch table once "
+        "at startup is exactly the pattern)."
+    )
+
+    _ROLES = {"tick", "tick-coro", "fanout-worker"}
+    _FACTORY_PREFIXES = ("make_", "build_")
+
+    @classmethod
+    def _is_hot(cls, fn: FunctionNode) -> bool:
+        from .hotpath import HOT_PATH_MANIFEST
+
+        for d in fn.decorator_names():
+            if d.endswith("hot_path"):
+                return True
+        for suffix, patterns in HOT_PATH_MANIFEST.items():
+            if fn.relpath.endswith(suffix):
+                for pat in patterns:
+                    if fnmatch.fnmatch(fn.qualname, pat):
+                        return True
+        return False
+
+    def check_project(self, index) -> Iterator[Finding]:
+        analysis = _thread_analysis(index)
+        for fn in index.functions.values():
+            if fn.name.startswith(self._FACTORY_PREFIXES):
+                continue
+            if not (self._ROLES & analysis.roles_of(fn)) and not self._is_hot(
+                fn
+            ):
+                continue
+            for node in _body_walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                hit = None
+                if d in _JIT_NAMES:
+                    hit = d
+                elif (
+                    d in _PARTIALS and node.args
+                    and dotted(node.args[0]) in _JIT_NAMES
+                ):
+                    hit = f"{d}(jax.jit, ...)"
+                if hit is None:
+                    continue
+                yield _pfind(
+                    index, self, fn.relpath, node, fn.qualname,
+                    f"'{hit}' constructs a jit wrapper inside "
+                    f"'{fn.qualname}', which runs per-tick/per-request -- "
+                    "a fresh wrapper retraces on every call; hoist the "
+                    "jit to module scope or into a make_*/build_* "
+                    "startup factory",
+                )
+
+
+RECOMPILE_RULES = (
+    UnbucketedTracedShape(),
+    UnboundedStaticArgument(),
+    TickDispatchOutsideManifest(),
+    JitConstructionOnHotPath(),
+)

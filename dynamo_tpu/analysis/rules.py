@@ -1,4 +1,6 @@
-"""dynalint rules DT001-DT016: this repo's real async/JAX hazard classes.
+"""dynalint rules DT001-DT016: this repo's real async/JAX hazard classes
+(DT017-DT020, the recompile/dispatch-discipline pass, live in compiles.py
+and register here).
 
 Each rule is deliberately narrow: it encodes a bug class this codebase has
 actually exhibited (blocking WAL I/O on the hub event loop, silent
@@ -1583,6 +1585,8 @@ def _walk_own(fn: ast.AST) -> Iterator[ast.AST]:
 # Registry
 # ---------------------------------------------------------------------------
 
+from .compiles import RECOMPILE_RULES  # noqa: E402  (needs Rule/core loaded)
+
 ALL_RULES: List[Rule] = [
     BlockingInAsync(),
     ThreadingLockAcrossAwait(),
@@ -1600,6 +1604,8 @@ ALL_RULES: List[Rule] = [
     SharedMutableAttributeRace(),
     CrossThreadPublication(),
     ThreadRoleManifestDrift(),
+    # DT017-DT020 (compiles.py): recompile hazards + dispatch discipline
+    *RECOMPILE_RULES,
 ]
 
 

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.hotpath import hot_path
-from ..runtime import profiling, slo, thread_sentry
+from ..runtime import compile_sentry, profiling, slo, thread_sentry
 from ..runtime.engine import Annotated, Context, ResponseStream
 from ..runtime.utils import log_throttled
 from ..protocols.common import (
@@ -102,6 +102,48 @@ TICK_COMMIT_HELPERS = (
     "_export_group",
     "_export_group_stream",
     "materialize",
+)
+
+# The declared device-touch inventory of the tick role (dynalint DT019):
+# every function here may issue device work (a jitted dispatch, a
+# device_put/get, jnp staging) while running under the tick/tick-coro
+# role; anything else that touches the device on the tick thread is an
+# undeclared launch and fails the lint.  Grouping:
+# - the dispatch plane proper (one packed launch per tick, plus the
+#   prefill/verify/score columns it absorbs or falls back to),
+# - _commit_all, the pipeline's single designed sync point,
+# - KV page maintenance (swap/onboard/evict/external delivery), which
+#   batches scatter/slice launches between dispatches by design,
+# - the export plane (prefill-worker role on the engine executor), and
+# - _push_device_state/_put_batch, the host->device staging helpers
+#   every dispatch assembly shares.
+PACKED_DISPATCH_SITES = (
+    "_dispatch_block",
+    "_dispatch_unified",
+    "_dispatch_verify",
+    "_dispatch_chunk",
+    "_dispatch_prompt_score",
+    "_dispatch_full_prefill",
+    "_dispatch_full_prefill_batch",
+    "_dispatch_mm_prefill_batch",
+    "_dispatch_suffix_prefill_batch",
+    "_dispatch_parallel_prefill",
+    "_do_prefill_group",
+    "_finish_prefill",
+    "_commit_all",
+    "_embed_sync",
+    "_apply_swap_in",
+    "_apply_onboards",
+    "_apply_dirty_rows",
+    "_apply_external_chunks",
+    "_apply_external_kv",
+    "_on_pool_evict",
+    "_swap_out",
+    "_push_device_state",
+    "_put_batch",
+    "_prefill_export",
+    "_export_group",
+    "_export_group_stream",
 )
 
 
@@ -634,6 +676,9 @@ class JaxEngine:
         metrics_registry=None,  # runtime.metrics.MetricsRegistry | None
     ) -> None:
         _enable_compilation_cache()
+        # compile-cache sentry: attribute every XLA compile to its entry
+        # label and (armed) enforce step.COMPILE_BUDGET
+        compile_sentry.install()
         self.model_cfg = model_cfg
         self.cfg = cfg or EngineConfig()
         self.params = params
@@ -1450,6 +1495,7 @@ class JaxEngine:
         return await loop.run_in_executor(self._ex, self._embed_sync, token_batches)
 
     def _embed_sync(self, token_batches: List[List[int]]) -> List[List[float]]:
+        compile_sentry.set_entry("embed_step")
         from .step import embed_step
 
         out: List[Optional[List[float]]] = [None] * len(token_batches)
@@ -1782,6 +1828,7 @@ class JaxEngine:
         """Executor thread: scatter staged layer-group chunks into the
         lane's pages (the incremental half of a chunked delivery; the
         first-token commit waits for the barrier)."""
+        compile_sentry.set_entry("kv_pages")
         from .kv_cache import pad_page_axis
 
         _n_pages, bucket, ids = self._lane_scatter_ids(seq)
@@ -1808,6 +1855,7 @@ class JaxEngine:
     ) -> StepEvent:
         """Executor thread: scatter the delivered KV into the lane's pages,
         then commit the remotely-sampled first token."""
+        compile_sentry.set_entry("kv_pages")
         blob = seq._kv_blob  # type: ignore[attr-defined]
         del seq._kv_blob  # type: ignore[attr-defined]
         # donated, jitted scatter (scatter_block_pages): an out-of-jit
@@ -1864,6 +1912,7 @@ class JaxEngine:
         return await loop.run_in_executor(self._ex, self._prefill_export, req)
 
     def _prefill_export(self, req: PreprocessedRequest) -> Tuple[np.ndarray, int]:
+        compile_sentry.set_entry("kv_export")
         prompt = list(req.token_ids)
         if not prompt:
             raise ValueError("empty prompt")
@@ -1970,6 +2019,7 @@ class JaxEngine:
         results: List[Any],
         device: bool = False,
     ) -> None:
+        compile_sentry.set_entry("kv_export")
         ps = self.cfg.page_size
         allocated: List[List[int]] = []
         try:
@@ -2089,6 +2139,7 @@ class JaxEngine:
         scratch pages free as soon as the gathers are dispatched (device
         program order) and nothing blocks on the bulk transfer here --
         only the tiny sampled rows come to host."""
+        compile_sentry.set_entry("kv_export")
         from .kv_cache import layer_chunk_spans
 
         ps = self.cfg.page_size
@@ -3105,6 +3156,7 @@ class JaxEngine:
         the single-request path and the disagg export path both call it, so
         they cannot diverge (the disagg-equals-aggregated invariant rests
         on identical dispatch here)."""
+        compile_sentry.set_entry("prefill")
         ps = self.cfg.page_size
         bucket = pick_bucket(
             self.buckets, max(len(prompt) for _, prompt, _ in items)
@@ -3157,6 +3209,7 @@ class JaxEngine:
         """Soft-prompt (multimodal) full prefill: inject each lane's vision
         embeddings over its leading positions.  The soft-prompt length pads
         to a power-of-two bucket so compile-cache entries stay bounded."""
+        compile_sentry.set_entry("prefill")
         from .step import prefill_mm_and_sample
 
         H = self.model_cfg.hidden_size
@@ -3206,6 +3259,7 @@ class JaxEngine:
         divisible by sp (sliding windows mask over global positions); pp
         needs the layer count divisible by pp and the batch divisible by
         the microbatch count."""
+        compile_sentry.set_entry("prefill")
         if self.mesh is None or (self._sp <= 1 and self._pp <= 1):
             return None
         Bp = tokens.shape[0]
@@ -3257,6 +3311,7 @@ class JaxEngine:
         """Suffix prefills (cached prefix resident) for up to ``Bp`` lanes;
         ``entries`` are (seq, prompt_len, cached) with page-aligned cached
         > 0.  The single-request and group paths share this builder."""
+        compile_sentry.set_entry("prefill")
         ps = self.cfg.page_size
         bucket = pick_bucket(
             self.buckets, max(pl - c for _, pl, c in entries)
@@ -3354,6 +3409,7 @@ class JaxEngine:
         thread).  Intermediate chunks write KV and sample nothing; the final
         chunk runs the normal sample-and-inject path and re-activates the
         lane (dirty row ordered after the dispatch)."""
+        compile_sentry.set_entry("prefill")
         prompt_len = len(seq.prompt)
         start = seq.prefilled_tokens
         chunk = self._chunk_tokens
@@ -3407,6 +3463,7 @@ class JaxEngine:
     def _finish_prefill(
         self, seq: SeqState, prompt_len: int, cached: int
     ) -> InflightPrefill:
+        compile_sentry.set_entry("prefill")
         from ..runtime import tracing
 
         if cached > 0:
@@ -3467,6 +3524,7 @@ class JaxEngine:
         O(buckets x batch).  The array construction lives in the shared
         ``_dispatch_*_prefill_batch`` builders, the same dispatch sites the
         single-request and disagg-export paths use."""
+        compile_sentry.set_entry("prefill")
         from ..runtime import tracing
 
         for seq, _pl in items:
@@ -3591,6 +3649,7 @@ class JaxEngine:
         never carry uncommitted in-flight decode progress: admission,
         release, revival and external-KV arrival all act on lanes that are
         parked, fresh, or committed-through."""
+        compile_sentry.set_entry("kv_pages")
         sched = self.sched
         d = self._dev
         assert d is not None
@@ -3769,6 +3828,7 @@ class JaxEngine:
 
     def _push_device_state(self) -> None:
         """Rebuild device-resident decode state from the scheduler mirrors."""
+        compile_sentry.set_entry("kv_pages")
         sched = self.sched
         B = self.cfg.max_batch_size
         E = self.cfg.device_stop_width
@@ -3894,6 +3954,7 @@ class JaxEngine:
     @hot_path
     def _dispatch_block(self) -> Optional["InflightBlock"]:
         """Enqueue one decode block; does not wait for results."""
+        compile_sentry.set_entry("decode_block")
         K = self.cfg.decode_block_size
         if self.sched.num_active == 0:
             return None  # everything was preempted
@@ -4014,6 +4075,7 @@ class JaxEngine:
         free.  ``num_steps == 0`` (the default) marks a non-multistep
         call, where a chunk-less spec-less dispatch has nothing to pack.
         """
+        compile_sentry.set_entry("packed_unified_step")
         from ..runtime import tracing
 
         sched = self.sched
@@ -4209,6 +4271,7 @@ class JaxEngine:
             if num_steps > 1:
                 # K decode iterations fused into the launch: packed is
                 # [B, K, 2 + 2*top_n], row k = on-device step k's sample
+                compile_sentry.set_entry("packed_unified_multistep")
                 (
                     packed,
                     spec_packed,
@@ -4245,6 +4308,7 @@ class JaxEngine:
             tick = self._tick
             if tick is not None:
                 tick.mark("assemble")
+            compile_sentry.set_entry("unified_step")
             (
                 packed,
                 d["tokens"],
@@ -4428,6 +4492,7 @@ class JaxEngine:
         draft columns -- its verify degenerates to a plain decode step,
         so speculation never stalls progress.
         """
+        compile_sentry.set_entry("verify_and_sample")
         sched = self.sched
         lanes = self._gather_spec_lanes()
         if not lanes:
@@ -4481,6 +4546,7 @@ class JaxEngine:
         writes, step.score_prompt_step) alongside the lane's prefill; the
         packed rows materialize with the prefill commit.  One extra
         forward, paid only by requests that asked for prompt logprobs."""
+        compile_sentry.set_entry("score_prompt_step")
         from .step import score_prompt_step
 
         prompt = seq.prompt
@@ -4528,6 +4594,7 @@ class JaxEngine:
         order places the read before any reuse; the blocking materialize
         and the tier store run on the offload engine's dedicated thread --
         neither the tick loop nor the engine executor ever waits on them."""
+        compile_sentry.set_entry("kv_pages")
         if self.offload_engine is None:
             return
         from ..offload import BlockMeta
@@ -4642,6 +4709,7 @@ class JaxEngine:
         ``scatter_layer_pages`` path the chunked external KV delivery uses
         -- so per-block dispatch overhead is paid once per admission and
         compile-cache entries stay O(page buckets x layer groups)."""
+        compile_sentry.set_entry("kv_pages")
         from ..runtime import faults
         from .kv_cache import layer_chunk_spans, pad_page_axis
 
@@ -4719,6 +4787,7 @@ class JaxEngine:
         Declines -- recompute fallback -- whenever the lane's device state
         is not fully host-visible (mid-prefill, parked, uncommitted first
         token) or the swap budget is exhausted."""
+        compile_sentry.set_entry("kv_pages")
         if self.offload_engine is None:
             return False
         if seq.awaiting_kv or seq.prefilling or seq.finish is not None:
@@ -4820,6 +4889,7 @@ class JaxEngine:
         deliberate sync: the lane cannot run before its KV lands, and the
         wait happens on the executor (never the event loop), yielding the
         true H2D throughput for the ``kv_onboard_gbps`` accounting."""
+        compile_sentry.set_entry("kv_pages")
         from .kv_cache import layer_chunk_spans, pad_page_axis
 
         rid = seq.request_id
@@ -4905,6 +4975,7 @@ class JaxEngine:
         generations are still queued on device behind this one -- the
         dispatch-gap accounting then records a zero gap (the device was
         never idle) instead of arming the ready->enqueue stopwatch."""
+        compile_sentry.set_entry("commit")
         # the commit walk owns the tick domain's hottest shared state
         # (scheduler lanes, KV pages, inflight entries): armed, assert the
         # declared confinement -- executor thread or the serialized tick

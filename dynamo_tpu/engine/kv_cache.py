@@ -29,6 +29,16 @@ import numpy as np
 from ..block_manager import OutOfPages
 from .config import ModelConfig
 
+# Declared tick-role device-touch sites (dynalint DT019): the KV blob
+# coercion helpers stage device uploads/dequants for the onboard and
+# external-delivery paths, which the engine runs between dispatches by
+# design -- the launches batch with the page scatters they feed.
+PACKED_DISPATCH_SITES = (
+    "dequantize_kv_blob",
+    "as_device_blob",
+    "pad_page_axis",
+)
+
 
 # ---------------------------------------------------------------------------
 # int8-quantized pool layout (ISSUE 13)

@@ -1177,3 +1177,46 @@ from .bucketing import (  # noqa: E402,F401
     pow2_bucket,
     prefill_buckets,
 )
+
+# ---------------------------------------------------------------------------
+# Compile budgets (runtime/compile_sentry.py, dynalint DT017/DT018's
+# runtime complement).  Each key is a dispatch-plane entry label (the
+# engine's compile_sentry.set_entry sites); each value is the ceiling on
+# XLA compile events that entry may trigger in one process.  The numbers
+# derive from the declared shape sets -- exceeding one means a shape
+# leaked past the bucketing helpers:
+#
+# - decode_block: page buckets (pow2 over live pages, <= ~6 in practice)
+#   x the use_filters flag.
+# - unified_step / packed_unified_step: PackedShapeBudget caps the live
+#   (Np, s_max, s_spec) set at 16 (DYN_PACKED_SHAPES); top_n / filter
+#   variants ride the same budget's headroom.
+# - packed_unified_multistep: the packed set x the K ramp {1, 2, 4, 8}
+#   (each K is a distinct lax.scan length, i.e. a distinct executable).
+# - prefill: pow2 length buckets (prefill_buckets: log2(max_len/page)
+#   entries) x batch-shape variants of the batched/suffix/mm planes.
+# - verify_and_sample: draft-length buckets x page buckets.
+# - commit: the fixed family of small epilogue jits (inject_token/s,
+#   update_lanes, bump/seed/zero counts) x a couple of shapes each.
+# - kv_pages / kv_export: scatter/slice/gather page ops over page-count
+#   buckets (pick_page_bucket) and layer-range chunks.
+#
+# Budgets are per-process totals, enforced only when DYN_COMPILE_SENTRY=1
+# (tier-1 arms it around the engine tests after compile_sentry.reset()).
+COMPILE_BUDGET = {
+    "decode_block": 12,
+    "unified_step": 16,
+    "packed_unified_step": 24,
+    "packed_unified_multistep": 96,
+    "prefill": 32,
+    "verify_and_sample": 16,
+    "score_prompt_step": 12,
+    "embed_step": 12,
+    "commit": 48,
+    "kv_pages": 48,
+    "kv_export": 32,
+}
+
+from ..runtime import compile_sentry as _compile_sentry  # noqa: E402
+
+_compile_sentry.register_budgets(COMPILE_BUDGET)

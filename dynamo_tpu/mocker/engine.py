@@ -23,7 +23,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
-from ..runtime import profiling, slo, thread_sentry
+from ..runtime import compile_sentry, profiling, slo, thread_sentry
 from ..runtime.metrics import EngineMetrics
 from ..protocols.common import (
     FinishReason,
@@ -155,6 +155,11 @@ class MockerEngine:
         # adaptive multi-step ramp (multistep_k == 0): doubles per
         # pressure-free tick toward the engine's default ceiling
         self._ms_ramp = 1
+        # fused-K values already "compiled": each distinct K is a
+        # distinct lax.scan-length executable in the real engine, so the
+        # first dispatch at a new K mints one synthetic compile event --
+        # the device-free compile-sentry signal tier-1 asserts against
+        self._minted_ks: set = set()
 
     def _sink(self, ev: Dict[str, Any]) -> None:
         if self.kv_event_sink is not None:
@@ -471,6 +476,11 @@ class MockerEngine:
         k = self._plan_k()
         tick_s = cfg.decode_s_per_step * self.kv.num_active_blocks * k
         had_work = bool(self.running)
+        if had_work and k not in self._minted_ks:
+            self._minted_ks.add(k)
+            compile_sentry.note_compilation(
+                "packed_unified_multistep" if k > 1 else "packed_unified_step"
+            )
         # double-buffered lanes (ISSUE 13): with simulated device time
         # armed, tick N's sleep starts BEFORE tick N-1's host commit runs
         # -- host work overlaps "device compute", dispatch gap collapses

@@ -27,6 +27,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..engine.config import ModelConfig
 from ..engine.model import Params
 
+# Declared tick-role device-touch site (dynalint DT019): assemble_shards
+# is the designed per-shard fetch behind the engine's commit/export sync
+# points -- its device_get is the sync those sites already declare.
+PACKED_DISPATCH_SITES = ("assemble_shards",)
+
 
 def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
     """Pytree-path (``a/b``) -> PartitionSpec for every parameter."""

@@ -1,0 +1,224 @@
+"""Runtime complement of dynalint's recompile rules (DT017-DT018).
+
+The static pass (``analysis/compiles.py``) proves shape discipline only
+relative to the blessed-bucketing manifest; this module makes the invariant
+checkable where it actually bites -- the XLA compile cache.  Every backend
+compilation is attributed to the engine entry point that triggered it and
+counted against a per-entry ``COMPILE_BUDGET`` (declared next to the jits in
+``engine/step.py``).  Armed with ``DYN_COMPILE_SENTRY=1`` (tier-1 arms it
+like the thread sentry), an entry that compiles more distinct executables
+than its budget raises ``CompileBudgetError`` at the moment of the overrun,
+so an unbucketed shape fails a test instead of silently melting the cache.
+
+Event source: ``jax.monitoring``'s
+``/jax/core/compile/backend_compile_duration`` duration event, which fires
+once per *new* executable (cache hits are free) synchronously on the thread
+that called the jitted function.  The engine's dispatches run on the
+"jax-engine" executor thread, so attribution uses a ``threading.local``
+label set by the ``entry(...)`` context manager around each dispatch -- a
+contextvar set in the tick coroutine would not be visible there.
+
+This module itself never imports jax: ``install()`` does, lazily, so the
+mocker (and any jax-free consumer) can feed synthetic events through
+``note_compilation(entry=...)`` directly -- each distinct fused-K value the
+mocker mints maps to a distinct ``lax.scan``-length executable in the real
+engine, so the mocker is an honest device-free event source.
+
+Overhead discipline (the thread-sentry pattern): disarmed, the budget check
+is one module-global bool; counting + the ``dynamo_compile_events_total``
+counter stay live either way so bench legs can price recompiles unarmed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+from typing import Dict, Iterator, Mapping, Optional
+
+logger = logging.getLogger("dynamo.compile_sentry")
+
+ENV_VAR = "DYN_COMPILE_SENTRY"
+
+_ARMED = os.environ.get(ENV_VAR, "").strip().lower() not in (
+    "", "0", "false", "no", "off",
+)
+
+#: the jax.monitoring duration event that fires once per backend compile
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: label used when a compile fires outside any ``entry(...)`` scope
+UNATTRIBUTED = "unattributed"
+
+
+class CompileBudgetError(AssertionError):
+    """An entry point compiled more executables than its declared budget."""
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def arm(on: bool = True) -> bool:
+    """Flip the sentry (tests).  Returns the previous state."""
+    global _ARMED
+    prev = _ARMED
+    _ARMED = bool(on)
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# entry attribution + counts
+
+_tls = threading.local()
+
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}
+_budgets: Dict[str, int] = {}
+
+_installed = False
+
+# lazy per-registry counter (the profiling pattern: rebuild when the
+# default registry is swapped by a test or a fresh serving process)
+_counter = None
+_counter_reg = None
+
+
+def _metric():
+    global _counter, _counter_reg
+    from . import metrics as rtm
+
+    reg = rtm.default_registry()
+    if _counter is None or _counter_reg is not reg:
+        _counter = reg.counter(
+            "dynamo_compile_events_total",
+            "XLA backend compilations attributed to the engine entry point "
+            "whose dispatch triggered them",
+            ("entry",),
+        )
+        _counter_reg = reg
+    return _counter
+
+
+@contextlib.contextmanager
+def entry(name: str) -> Iterator[None]:
+    """Attribute compilations on THIS thread to ``name`` for the scope.
+
+    Nestable; the innermost label wins (a packed dispatch that lazily
+    builds a helper executable attributes the helper's compile to the
+    packed entry, which is the budget that pays for it)."""
+    prev = getattr(_tls, "entry", None)
+    _tls.entry = name
+    try:
+        yield
+    finally:
+        _tls.entry = prev
+
+
+def set_entry(name: Optional[str]) -> None:
+    """Sticky thread-local label: dispatch-plane functions call this at
+    entry and the label holds until the next set on the same thread.  The
+    engine's device work is phase-structured (dispatch -> commit -> KV
+    maintenance, each of which labels itself), so sticky semantics
+    attribute every compile to the phase that is actually running; use
+    the ``entry(...)`` context manager where scoped restore matters."""
+    _tls.entry = name
+
+
+def current_entry() -> Optional[str]:
+    return getattr(_tls, "entry", None)
+
+
+def register_budgets(budgets: Mapping[str, int]) -> None:
+    """Merge per-entry compile budgets (``engine/step.py`` registers its
+    ``COMPILE_BUDGET`` at import).  Budgets are ceilings on TOTAL compile
+    events per entry within this process; only registered entries are
+    enforced, so ad-hoc entries count and export but never raise."""
+    with _lock:
+        for name, limit in budgets.items():
+            _budgets[name] = int(limit)
+
+
+def budgets() -> Dict[str, int]:
+    with _lock:
+        return dict(_budgets)
+
+
+def counts() -> Dict[str, int]:
+    """Snapshot of per-entry compile-event counts (bench legs diff this)."""
+    with _lock:
+        return dict(_counts)
+
+
+def total() -> int:
+    with _lock:
+        return sum(_counts.values())
+
+
+def reset() -> None:
+    """Zero the per-entry counts (tests; the prometheus counter, being
+    monotonic by contract, is left alone)."""
+    with _lock:
+        _counts.clear()
+
+
+def note_compilation(entry_name: Optional[str] = None) -> None:
+    """Record one compile event.
+
+    Called by the jax.monitoring listener (entry resolved from the
+    thread-local label) and directly by synthetic sources (mocker).  When
+    armed and the entry has a registered budget, an overrun raises
+    immediately -- the thread-sentry contract: fail at the site, on the
+    thread that did it."""
+    name = entry_name or current_entry() or UNATTRIBUTED
+    with _lock:
+        _counts[name] = _counts.get(name, 0) + 1
+        count = _counts[name]
+        limit = _budgets.get(name)
+    try:
+        _metric().labels(name).inc()
+    except Exception:  # metrics must never break the compile path
+        logger.debug("compile-event metric emit failed", exc_info=True)
+    try:
+        from . import profiling
+
+        profiling.profiler.note_compile_event(name)
+    except Exception:
+        logger.debug("compile-event profiler note failed", exc_info=True)
+    if _ARMED and limit is not None and count > limit:
+        raise CompileBudgetError(
+            f"compile budget overrun: entry {name!r} compiled {count} "
+            f"executables, budget {limit} (set {ENV_VAR}=0 to disarm; if "
+            f"the shape set legitimately grew, raise COMPILE_BUDGET in "
+            f"engine/step.py)"
+        )
+
+
+def _on_event(event: str, duration: float, **kwargs: object) -> None:
+    if event == COMPILE_EVENT:
+        note_compilation()
+
+
+def install() -> bool:
+    """Idempotently register the jax.monitoring compile listener.
+
+    Returns True when the listener is (already) registered, False when jax
+    or its monitoring API is unavailable (mocker-only processes)."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring  # deferred: module stays jax-free
+    except Exception:
+        logger.debug("jax.monitoring unavailable; sentry not installed",
+                     exc_info=True)
+        return False
+    register = getattr(
+        monitoring, "register_event_duration_secs_listener", None
+    )
+    if register is None:
+        return False
+    register(_on_event)
+    _installed = True
+    return True

@@ -228,6 +228,9 @@ class TickProfiler:
         # monotonic stamp of the most recent "previous dispatch's results
         # are on host" event; consumed by the next dispatch enqueue
         self._last_ready: Optional[float] = None
+        # per-entry XLA compile events (fed by runtime.compile_sentry);
+        # cleared with the ring so bench legs read per-leg counts
+        self._compiles: Dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -240,6 +243,7 @@ class TickProfiler:
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
+            self._compiles.clear()
         self._last_ready = None
 
     # -- tick accounting ---------------------------------------------------
@@ -262,6 +266,15 @@ class TickProfiler:
         with self._lock:
             self._ring.append(rec)
         self._observe_record(rec)
+
+    def note_compile_event(self, entry: str) -> None:
+        """One XLA compilation attributed to ``entry`` (compile_sentry
+        calls this on every event so tick summaries price recompiles next
+        to the phases they stall)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._compiles[entry] = self._compiles.get(entry, 0) + 1
 
     def note_results_ready(self) -> None:
         """The pending dispatch's outputs just materialized on host: from
@@ -341,6 +354,8 @@ class TickProfiler:
         time, mean host occupancy, dispatch-gap percentiles, tick count.
         The bench's serving line prints the top-3 phases from here."""
         recs = self.records()
+        with self._lock:
+            compiles = dict(self._compiles)
         totals: Dict[str, float] = {}
         gaps: List[float] = []
         wall = host = 0.0
@@ -383,6 +398,7 @@ class TickProfiler:
             ],
             "gap_p50_ms": pct(0.50),
             "gap_p95_ms": pct(0.95),
+            "compile_events": dict(sorted(compiles.items())),
         }
 
     def chrome_trace(

@@ -1845,6 +1845,357 @@ def test_dt016_to_thread_of_project_function_is_covered(tmp_path):
     assert findings == []
 
 
+# ---------------------------------------------------------------------------
+# DT017/DT018: recompile hazards (unbucketed shapes, unbounded statics)
+# ---------------------------------------------------------------------------
+
+JITTED_SINK = """
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+
+    @partial(jax.jit, static_argnames=("width",))
+    def decode_step(tokens, width):
+        return tokens * 2
+"""
+
+
+def test_dt017_unbucketed_traced_shape(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        JITTED_SINK + """
+
+    def dispatch(reqs):
+        n = len(reqs)
+        buf = jnp.zeros((n, 4))
+        pad = [0] * n
+        return decode_step(buf, width=4), decode_step(jnp.array(pad), width=4)
+        """,
+        rules=["DT017"],
+    )
+    assert rule_ids(findings) == ["DT017", "DT017"]
+    assert "decode_step" in findings[0].message
+    assert "bucketing helper" in findings[0].message
+
+
+def test_dt017_bucketed_twin_is_clean(tmp_path):
+    """The same flow routed through a blessed bucketing helper (free
+    function or .fit method) launders the count: bounded shape set."""
+    findings = lint_source(
+        tmp_path,
+        JITTED_SINK + """
+
+    from dynamo_tpu.engine.bucketing import pow2_bucket
+
+
+    def dispatch(self, reqs):
+        m = pow2_bucket(len(reqs))
+        buf = jnp.zeros((m, 4))
+        np_rows = self.budget.fit(len(reqs))
+        packed = jnp.zeros((np_rows, 4))
+        return decode_step(buf, width=4), decode_step(packed, width=4)
+        """,
+        rules=["DT017"],
+    )
+    assert findings == []
+
+
+def test_dt017_constant_shapes_are_clean(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        JITTED_SINK + """
+
+    def dispatch(reqs):
+        buf = jnp.zeros((8, 4))
+        return decode_step(buf, width=4)
+        """,
+        rules=["DT017"],
+    )
+    assert findings == []
+
+
+def test_dt018_unbounded_static_argument(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        JITTED_SINK + """
+
+    def dispatch(reqs, buf):
+        n = len(reqs)
+        return decode_step(buf, width=n)
+        """,
+        rules=["DT018"],
+    )
+    assert rule_ids(findings) == ["DT018"]
+    assert "'width'" in findings[0].message
+
+
+def test_dt018_static_argnums_positional(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def _impl(tokens, k):
+            return tokens
+
+
+        fused_step = jax.jit(_impl, static_argnums=(1,))
+
+
+        def dispatch(reqs, buf):
+            total = sum(len(reqs), 1)
+            return fused_step(buf, total)
+        """,
+        rules=["DT018"],
+    )
+    # the assignment-form wrapper's static_argnums position is honored
+    assert "DT018" in rule_ids(findings)
+
+
+def test_dt018_bucketed_static_is_clean(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        JITTED_SINK + """
+
+    from dynamo_tpu.engine.bucketing import pow2_bucket
+
+
+    def dispatch(reqs, buf):
+        w = pow2_bucket(len(reqs))
+        return decode_step(buf, width=w)
+        """,
+        rules=["DT018"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# DT019: one dispatch per tick (PACKED_DISPATCH_SITES manifest)
+# ---------------------------------------------------------------------------
+
+
+def test_dt019_undeclared_device_touch_on_tick(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Engine:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="jax-engine"
+                )
+
+            def submit(self, x):
+                self._ex.submit(self._touch, x)
+
+            def _touch(self, x):
+                return jnp.asarray(x)
+        """,
+        rules=["DT019"],
+    )
+    assert rule_ids(findings) == ["DT019"]
+    assert "jnp.asarray" in findings[0].message
+    assert "PACKED_DISPATCH_SITES" in findings[0].message
+
+
+def test_dt019_declared_site_is_clean(tmp_path):
+    """The same touch inside a declared packed-dispatch site is the
+    sanctioned shape, and jnp.* inside the jitted trace (the entry impl
+    and its transitive callees) never counts as a tick-thread launch."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+        from concurrent.futures import ThreadPoolExecutor
+
+        PACKED_DISPATCH_SITES = ("_dispatch",)
+
+        @jax.jit
+        def step(x):
+            return _inner(x)
+
+        def _inner(x):
+            return jnp.add(x, 1)
+
+        class Engine:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="jax-engine"
+                )
+
+            def tick(self, x):
+                self._ex.submit(self._dispatch, x)
+
+            def _dispatch(self, x):
+                return step(x)
+        """,
+        rules=["DT019"],
+    )
+    assert findings == []
+
+
+def test_dt019_jitted_entry_call_is_a_dispatch(tmp_path):
+    """Calling a jitted entry point IS a device launch, even with no
+    jnp.* in sight -- an undeclared one on the tick role is a second
+    dispatch."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+        from concurrent.futures import ThreadPoolExecutor
+
+        @jax.jit
+        def step(x):
+            return x
+
+        class Engine:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="jax-engine"
+                )
+
+            def tick(self, x):
+                self._ex.submit(self._sneak, x)
+
+            def _sneak(self, x):
+                return step(x)
+        """,
+        rules=["DT019"],
+    )
+    assert rule_ids(findings) == ["DT019"]
+    assert "step" in findings[0].message
+
+
+def test_dt019_off_tick_roles_out_of_scope(tmp_path):
+    """Device touches on non-tick roles (offload workers) are DT009/DT013
+    territory, not dispatch discipline."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Offload:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="kv-offload"
+                )
+
+            def submit(self, x):
+                self._ex.submit(self._store, x)
+
+            def _store(self, x):
+                return jnp.asarray(x)
+        """,
+        rules=["DT019"],
+    )
+    assert findings == []
+
+
+def test_dt019_engine_manifest_matches_repo():
+    """The real engine module's PACKED_DISPATCH_SITES entries exist: a
+    dispatch-method rename must fail here, not silently undeclare the
+    site and re-trip DT019 on the next run."""
+    import dynamo_tpu.engine.engine as engine_mod
+
+    sites = engine_mod.PACKED_DISPATCH_SITES
+    assert "_dispatch_unified" in sites and "_commit_all" in sites
+    for name in sites:
+        assert hasattr(engine_mod.JaxEngine, name), name
+
+
+# ---------------------------------------------------------------------------
+# DT020: jit construction on a per-tick/hot path
+# ---------------------------------------------------------------------------
+
+
+def test_dt020_jit_construction_on_tick_role(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+        from functools import partial
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Engine:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="jax-engine"
+                )
+
+            def go(self, fn, x):
+                self._ex.submit(self._hot, fn, x)
+
+            def _hot(self, fn, x):
+                stepper = jax.jit(fn)
+                wrapped = partial(jax.jit, donate_argnums=(0,))(fn)
+                return stepper(x), wrapped(x)
+        """,
+        rules=["DT020"],
+    )
+    assert rule_ids(findings) == ["DT020", "DT020"]
+    assert "fresh wrapper" in findings[0].message
+
+
+def test_dt020_hot_path_marker(tmp_path):
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+
+        def hot_path(fn):
+            return fn
+
+        @hot_path
+        def per_request(fn, x):
+            return jax.jit(fn)(x)
+        """,
+        rules=["DT020"],
+    )
+    assert rule_ids(findings) == ["DT020"]
+
+
+def test_dt020_factory_and_decorator_are_clean(tmp_path):
+    """make_*/build_* construction-time factories are the sanctioned
+    place for jit(); a @partial(jax.jit) DECORATOR on a tick-roled
+    function is a declaration, not a per-call construction."""
+    findings = lint_source(
+        tmp_path,
+        """
+        import jax
+        from functools import partial
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Engine:
+            def __init__(self):
+                self._ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="jax-engine"
+                )
+
+            def boot(self, fn):
+                self._ex.submit(self.make_table, fn)
+                self._ex.submit(self._step, 1)
+
+            def make_table(self, fn):
+                return {"step": jax.jit(fn)}
+
+            @partial(jax.jit, static_argnames=("k",))
+            def _step(self, k):
+                return k
+        """,
+        rules=["DT020"],
+    )
+    assert findings == []
+
+
 def test_thread_role_manifest_matches_repo():
     """The checked-in manifest's engine pins exist: a rename must fail
     here, not silently unpin the tick coroutine from the race scan."""
@@ -1873,6 +2224,35 @@ def test_cli_only_alias(tmp_path, capsys):
     assert rc == 1 and "DT001" in out
     rc = cli_run([str(bad), "--root", str(tmp_path), "--only", "DT003"])
     assert rc == 0  # filtered to a rule the file does not trip
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    """--format sarif emits a valid SARIF 2.1.0 log: rules catalog,
+    results wired by ruleIndex, repo-relative artifact URIs, dynalint
+    fingerprints -- and keeps the exit-code contract."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    rc = cli_run([str(bad), "--root", str(tmp_path), "--format", "sarif"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    doc = json.loads(out)
+    assert doc["version"] == "2.1.0"
+    sarif_run = doc["runs"][0]
+    assert sarif_run["tool"]["driver"]["name"] == "dynalint"
+    results = sarif_run["results"]
+    assert [r["ruleId"] for r in results] == ["DT001"]
+    rules = sarif_run["tool"]["driver"]["rules"]
+    assert rules[results[0]["ruleIndex"]]["id"] == "DT001"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "bad.py"
+    assert loc["region"]["startLine"] == 4
+    assert results[0]["partialFingerprints"]["dynalint/v1"]
+
+    ok = tmp_path / "ok.py"
+    ok.write_text("X = 1\n")
+    rc = cli_run([str(ok), "--root", str(tmp_path), "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["runs"][0]["results"] == []
 
 
 def test_cli_changed_mode(tmp_path, capsys):

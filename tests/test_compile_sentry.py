@@ -1,0 +1,350 @@
+"""Compile sentry (runtime/compile_sentry.py) + its static twin DT017.
+
+Four layers: unit tests for attribution, counting, and budget enforcement;
+integration with the profiler and the metrics registry; armed end-to-end
+runs (the mocker's adaptive K ramp and a real tiny JaxEngine serve) proving
+the packed dispatch plane stays within COMPILE_BUDGET; and the acceptance
+pincer -- one deliberately unbucketed fixture that trips DT017 statically
+AND the sentry at runtime, from the same source text."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from dynamo_tpu.analysis import Analyzer, get_rules
+from dynamo_tpu.engine.step import COMPILE_BUDGET
+from dynamo_tpu.runtime import compile_sentry
+from dynamo_tpu.runtime.compile_sentry import CompileBudgetError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def armed():
+    """Counts are process-global (earlier tests may have minted events),
+    so arming always starts from a clean slate."""
+    compile_sentry.reset()
+    prev = compile_sentry.arm(True)
+    try:
+        yield
+    finally:
+        compile_sentry.arm(prev)
+        compile_sentry.reset()
+
+
+# ---------------------------------------------------------------------------
+# attribution + counts
+# ---------------------------------------------------------------------------
+
+
+def test_entry_label_is_thread_local():
+    compile_sentry.set_entry("alpha")
+    assert compile_sentry.current_entry() == "alpha"
+    seen = []
+    t = threading.Thread(target=lambda: seen.append(compile_sentry.current_entry()))
+    t.start()
+    t.join()
+    assert seen == [None]  # labels never leak across threads
+    compile_sentry.set_entry(None)
+
+
+def test_entry_context_manager_nests_and_restores():
+    with compile_sentry.entry("outer"):
+        assert compile_sentry.current_entry() == "outer"
+        with compile_sentry.entry("inner"):
+            assert compile_sentry.current_entry() == "inner"
+        assert compile_sentry.current_entry() == "outer"
+    assert compile_sentry.current_entry() is None
+
+
+def test_counts_attribute_to_current_entry():
+    compile_sentry.reset()
+    with compile_sentry.entry("probe_entry"):
+        compile_sentry.note_compilation()
+        compile_sentry.note_compilation()
+    compile_sentry.note_compilation()  # outside any scope
+    c = compile_sentry.counts()
+    assert c["probe_entry"] == 2
+    assert c[compile_sentry.UNATTRIBUTED] >= 1
+    assert compile_sentry.total() >= 3
+    compile_sentry.reset()
+    assert compile_sentry.counts() == {}
+
+
+def test_metric_exported_per_entry():
+    from dynamo_tpu.runtime import metrics as rtm
+
+    before = rtm.default_registry().sample(
+        "dynamo_compile_events", {"entry": "metric_probe"}
+    ) or 0.0
+    compile_sentry.note_compilation("metric_probe")
+    after = rtm.default_registry().sample(
+        "dynamo_compile_events", {"entry": "metric_probe"}
+    )
+    assert after == before + 1.0
+    compile_sentry.reset()
+
+
+def test_profiler_records_compile_events():
+    from dynamo_tpu.runtime import profiling
+
+    prof = profiling.profiler
+    was = prof.enabled
+    prof.clear()
+    prof.enable()
+    try:
+        compile_sentry.note_compilation("prof_probe")
+        compile_sentry.note_compilation("prof_probe")
+        assert prof.summary()["compile_events"] == {"prof_probe": 2}
+    finally:
+        if not was:
+            prof.disable()
+        prof.clear()
+        compile_sentry.reset()
+
+
+# ---------------------------------------------------------------------------
+# budget enforcement
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_never_raises_on_overrun():
+    compile_sentry.reset()
+    compile_sentry.register_budgets({"t_lenient": 1})
+    for _ in range(5):
+        compile_sentry.note_compilation("t_lenient")
+    assert compile_sentry.counts()["t_lenient"] == 5
+    compile_sentry.reset()
+
+
+def test_armed_overrun_raises_at_the_site(armed):
+    compile_sentry.register_budgets({"t_strict": 2})
+    compile_sentry.note_compilation("t_strict")
+    compile_sentry.note_compilation("t_strict")
+    with pytest.raises(CompileBudgetError) as exc:
+        compile_sentry.note_compilation("t_strict")
+    msg = str(exc.value)
+    assert "t_strict" in msg and "budget 2" in msg
+    assert compile_sentry.ENV_VAR in msg  # tells the operator how to disarm
+
+
+def test_armed_unregistered_entries_count_but_never_raise(armed):
+    for _ in range(50):
+        compile_sentry.note_compilation("t_adhoc_entry")
+    assert compile_sentry.counts()["t_adhoc_entry"] == 50
+
+
+def test_engine_budget_manifest_registered():
+    """engine/step.py registers COMPILE_BUDGET at import: the dispatch
+    plane's entries are enforceable by name."""
+    budgets = compile_sentry.budgets()
+    for entry in ("packed_unified_step", "packed_unified_multistep",
+                  "prefill", "commit", "kv_pages"):
+        assert budgets[entry] == COMPILE_BUDGET[entry]
+
+
+# ---------------------------------------------------------------------------
+# armed end-to-end: mocker adaptive K ramp within budget
+# ---------------------------------------------------------------------------
+
+
+def test_mocker_adaptive_k_ramp_within_budget(armed, run):
+    """The mocker mints one synthetic compile event per distinct fused-K
+    executable (mirroring the real engine's lax.scan-length cache keys).
+    Armed, a full adaptive ramp must fit the packed plane's budget -- the
+    acceptance shape for 'multistep K ramp within COMPILE_BUDGET'."""
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    async def body():
+        eng = MockerEngine(
+            MockerConfig(decode_s_per_step=1e-5, multistep_k=0)
+        )
+        try:
+            req = PreprocessedRequest(
+                token_ids=[1, 2, 3],
+                stop_conditions=StopConditions(max_tokens=64),
+                sampling_options=SamplingOptions(temperature=0.0),
+            )
+            stream = await eng.generate(Context.new(req))
+            got = []
+            async for item in stream:
+                assert not item.is_error(), item.error_message()
+                got.extend((item.data or {}).get("token_ids") or [])
+            assert len(got) == 64
+        finally:
+            await eng.stop()
+
+    run(body())
+    c = compile_sentry.counts()
+    # the ramp visited K=1 plus at least one fused K>1, each within budget
+    assert c.get("packed_unified_step", 0) >= 1
+    assert c.get("packed_unified_multistep", 0) >= 1
+    assert c["packed_unified_step"] <= COMPILE_BUDGET["packed_unified_step"]
+    assert (
+        c["packed_unified_multistep"]
+        <= COMPILE_BUDGET["packed_unified_multistep"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# armed end-to-end: real JaxEngine serve stays within the manifest
+# ---------------------------------------------------------------------------
+
+
+def test_real_engine_serve_within_budget(armed, run):
+    """A tiny real JaxEngine serve with the sentry armed: every entry the
+    dispatch plane labels (prefill, packed steps, commit, kv_pages) stays
+    within its COMPILE_BUDGET or the serve itself raises."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Annotated, Context
+
+    assert compile_sentry.install()
+
+    async def body():
+        engine = JaxEngine.random_init(
+            ModelConfig.tiny(),
+            EngineConfig(
+                max_batch_size=4, max_seq_len=64, page_size=4, num_pages=64
+            ),
+        )
+        try:
+            for prompt in ([1, 2, 3], [4, 5, 6, 7]):
+                req = PreprocessedRequest(
+                    token_ids=prompt,
+                    stop_conditions=StopConditions(max_tokens=6),
+                    sampling_options=SamplingOptions(temperature=0.0),
+                )
+                stream = await engine.generate(Context.new(req))
+                async for item in stream:
+                    ann = (
+                        item if isinstance(item, Annotated)
+                        else Annotated.from_dict(item)
+                    )
+                    assert not ann.is_error(), ann.error_message()
+        finally:
+            await engine.stop()
+
+    run(body())  # a budget overrun would raise CompileBudgetError here
+    # In a shared test process earlier engine tests may have compiled the
+    # whole tiny-model surface already -- zero NEW events is the invariant
+    # holding, not the listener failing (the unbucketed-fixture test below
+    # proves the listener fires on genuinely fresh executables).
+    for entry, n in compile_sentry.counts().items():
+        limit = COMPILE_BUDGET.get(entry)
+        if limit is not None:
+            assert n <= limit, f"{entry}: {n} > budget {limit}"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pincer: one fixture, caught twice
+# ---------------------------------------------------------------------------
+
+# A dispatch that sizes its device buffer directly from len(requests):
+# DT017 flags the jnp.zeros((n, 4)) flowing into the traced argument, and
+# under the armed sentry every distinct n compiles a fresh executable
+# until the budget trips.
+UNBUCKETED_FIXTURE = """
+    import jax
+    import jax.numpy as jnp
+
+
+    @jax.jit
+    def embed_step(tokens):
+        return tokens * 2
+
+
+    def dispatch(requests):
+        n = len(requests)
+        buf = jnp.zeros((n, 4))
+        return embed_step(buf)
+"""
+
+
+def test_unbucketed_fixture_trips_dt017_statically(tmp_path):
+    path = tmp_path / "fixture_pkg" / "engine" / "hot.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(textwrap.dedent(UNBUCKETED_FIXTURE))
+    analyzer = Analyzer(get_rules(["DT017"]), root=str(tmp_path))
+    findings = analyzer.analyze_paths([str(path)])
+    assert [f.rule for f in findings] == ["DT017"]
+    assert "embed_step" in findings[0].message
+
+
+def test_unbucketed_fixture_trips_sentry_at_runtime(tmp_path, armed):
+    path = tmp_path / "unbucketed_fixture.py"
+    path.write_text(textwrap.dedent(UNBUCKETED_FIXTURE))
+    spec = importlib.util.spec_from_file_location("unbucketed_fixture", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    assert compile_sentry.install()
+    compile_sentry.reset()  # importing the fixture may itself compile
+    # a unique entry name so the real engine's budgets are untouched
+    compile_sentry.register_budgets({"t_unbucketed_dispatch": 6})
+    compile_sentry.set_entry("t_unbucketed_dispatch")
+    try:
+        with pytest.raises(CompileBudgetError) as exc:
+            for n in range(1, 32):  # every n is a fresh shape
+                mod.dispatch(list(range(n)))
+        assert "t_unbucketed_dispatch" in str(exc.value)
+    finally:
+        compile_sentry.set_entry(None)
+
+
+# ---------------------------------------------------------------------------
+# env-armed subprocess smoke (arming happens at import, like DYN_THREAD_SENTRY)
+# ---------------------------------------------------------------------------
+
+_SMOKE = """
+import asyncio, os
+assert os.environ.get("DYN_COMPILE_SENTRY") == "1"
+from dynamo_tpu.runtime import compile_sentry
+assert compile_sentry.armed()
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest, SamplingOptions, StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+
+async def main():
+    eng = MockerEngine(MockerConfig(decode_s_per_step=1e-5, multistep_k=0))
+    req = PreprocessedRequest(
+        token_ids=[1, 2, 3],
+        stop_conditions=StopConditions(max_tokens=48),
+        sampling_options=SamplingOptions(temperature=0.0),
+    )
+    stream = await eng.generate(Context.new(req))
+    async for item in stream:
+        assert not item.is_error(), item.error_message()
+    await eng.stop()
+    counts = compile_sentry.counts()
+    assert counts.get("packed_unified_multistep", 0) >= 1, counts
+
+asyncio.run(main())
+print("COMPILE_SENTRY_SMOKE_OK")
+"""
+
+
+def test_env_armed_mocker_smoke():
+    env = dict(os.environ)
+    env["DYN_COMPILE_SENTRY"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _SMOKE],
+        capture_output=True, text=True, cwd=REPO_ROOT, env=env,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "COMPILE_SENTRY_SMOKE_OK" in proc.stdout
