@@ -224,6 +224,19 @@ def build_parser() -> argparse.ArgumentParser:
     mt.add_argument("--host", default="0.0.0.0")
     mt.add_argument("--port", type=int, default=9091)
 
+    # fleet: the observatory's read side over the hub -- subscribe to the
+    # workers' telemetry topic, render a live cluster table
+    fl = sub.add_parser("fleet",
+                        help="live fleet table from worker telemetry")
+    fl.add_argument("--hub", required=True, help="hub address host:port")
+    fl.add_argument("--namespace", default="dynamo")
+    fl.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between table refreshes")
+    fl.add_argument("--once", action="store_true",
+                    help="print one table after --interval and exit")
+    fl.add_argument("--json", dest="json_out", action="store_true",
+                    help="print the raw /fleet summary JSON instead")
+
     # trace: assemble one request's cross-component span timeline from the
     # hub (every served component auto-exposes a _trace scrape endpoint)
     tr = sub.add_parser("trace",
@@ -392,6 +405,9 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--speedup-ratio", type=float, default=1.0,
                     help="trace replay time compression")
     bn.add_argument("--seed", type=int, default=0)
+    bn.add_argument("--fleet", action="store_true",
+                    help="also fetch GET /fleet from the frontend and "
+                         "attach the cluster summary to the report")
     return p
 
 
@@ -640,9 +656,16 @@ async def run_http_frontend(args) -> None:
             runtime, manager, router_mode=RouterMode(args.router_mode)
         )
     await watcher.start()
+    # fleet observatory: ingest every worker's telemetry snapshots off the
+    # hub and surface them at GET /fleet (+ the dynamo_fleet_* families)
+    from .fleet import FleetObservatory
+
+    observatory = FleetObservatory()
+    await observatory.start(runtime.namespace("dynamo"))
     service = HttpService(
         manager, host=args.host, port=args.port,
         template=_load_template(args),
+        observatory=observatory,
     )
     await service.start()
     print(f"frontend at {service.url} (hub {addr}); models appear on discovery")
@@ -654,6 +677,7 @@ async def run_http_frontend(args) -> None:
         await _wait_forever(stop)
     finally:
         await service.stop()
+        await observatory.stop()
         await watcher.stop()
         await runtime.shutdown()
         if owned_hub:
@@ -739,6 +763,23 @@ async def run_worker(args) -> None:
     pub.hook(engine)
     metrics_pub = WorkerMetricsPublisher(engine.metrics)
     await metrics_pub.attach(comp)
+    # fleet plane: identity-label this worker's exposition and publish
+    # periodic telemetry snapshots to the hub for the observatory
+    from .runtime import metrics as rtm
+    from .runtime.telemetry import TelemetryPublisher
+
+    role = args.disagg or "worker"
+    rtm.set_worker_identity(worker_id=runtime.primary_lease, role=role)
+    telemetry_pub = TelemetryPublisher(
+        ns,
+        worker_id=runtime.primary_lease,
+        role=role,
+        # mocker engines route their synthetic link observations through a
+        # per-engine log; everything else uses the process-wide one the
+        # disagg delivery path feeds
+        transfer_log=getattr(engine, "transfer_log", None),
+    )
+    telemetry_pub.start()
     stop = asyncio.Event()
     # hub loss orphans this worker's registrations: exit so a supervisor
     # restarts it into a live cluster (fail loud)
@@ -759,6 +800,7 @@ async def run_worker(args) -> None:
     finally:
         if prefill_worker is not None:
             await prefill_worker.stop()
+        await telemetry_pub.stop(final=False)
         await pub.close()
         await engine.stop()
         await runtime.shutdown()
@@ -1133,6 +1175,13 @@ async def run_bench(args) -> int:
         concurrency=args.concurrency,
     )
     summary = report.summary()
+    if args.fleet:
+        from .bench_serving import fetch_fleet
+
+        try:
+            summary["fleet"] = await fetch_fleet(args.host, args.port)
+        except Exception as e:
+            summary["fleet"] = {"error": repr(e)}
     print(json.dumps(summary, indent=2))
     return 0 if summary["num_errors"] == 0 else 1
 
@@ -1156,6 +1205,101 @@ async def run_metrics(args) -> int:
         await _wait_forever(stop)
     finally:
         await svc.stop()
+        await runtime.shutdown()
+    return 0
+
+
+def format_fleet_table(summary) -> str:
+    """Render one /fleet summary as the `dynamo-tpu fleet` table."""
+    lines = []
+    totals = summary.get("totals", {})
+    roles = totals.get("workers_by_role", {})
+    head = ", ".join(
+        f"{n} {role}" for role, n in sorted(roles.items())
+    ) or "no workers"
+    lines.append(
+        f"fleet: {head} | kv pressure "
+        f"{totals.get('kv_pressure', 0.0):.2f} | queue "
+        f"{totals.get('queue_depth', 0)}"
+    )
+    slo = totals.get("slo_attainment") or {}
+    if slo:
+        lines.append(
+            "slo:   "
+            + "  ".join(f"{k}={v:.3f}" for k, v in sorted(slo.items()))
+        )
+    cols = ("id", "role", "tok/s", "step ms", "kv", "queue", "slots", "flag")
+    rows = []
+    for w in summary.get("workers", []):
+        step = w.get("step_ms")
+        rows.append(
+            (
+                str(w["worker_id"]),
+                w.get("role", "?"),
+                f"{w.get('tokens_per_s', 0.0):.1f}",
+                "-" if step is None else f"{step:.2f}",
+                f"{w.get('kv_pages_used', 0)}/{w.get('kv_pages_total', 0)}",
+                str(w.get("queue_depth", 0)),
+                f"{w.get('batch_occupancy', 0)}/{w.get('batch_slots', 0)}",
+                "STRAGGLER" if w.get("straggler") else "",
+            )
+        )
+    if rows:
+        widths = [
+            max(len(cols[i]), max(len(r[i]) for r in rows))
+            for i in range(len(cols))
+        ]
+        fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+        lines.append(fmt.format(*cols))
+        for r in rows:
+            lines.append(fmt.format(*r))
+    for link in summary.get("links", []):
+        bw = link.get("bandwidth_bytes_per_s")
+        setup = link.get("setup_ms")
+        lines.append(
+            f"link {link['src']}->{link['dst']}: "
+            + ("fitting..." if bw is None
+               else f"{bw / 1e6:.1f} MB/s + {setup or 0.0:.2f} ms setup")
+            + f" ({link.get('samples', 0)} samples)"
+        )
+    return "\n".join(lines)
+
+
+async def run_fleet(args) -> int:
+    """fleet: subscribe to worker telemetry on the hub, print a live
+    cluster table (the CLI face of GET /fleet)."""
+    import json
+
+    from .fleet import FleetObservatory
+    from .runtime.component import DistributedRuntime
+    from .runtime.metrics import MetricsRegistry
+
+    runtime = await DistributedRuntime.detached(args.hub)
+    # private registry: the CLI process has no scrape surface, and must
+    # not pollute a colocated default registry with fleet families
+    observatory = FleetObservatory(MetricsRegistry())
+    await observatory.start(runtime.namespace(args.namespace))
+    stop = asyncio.Event()
+    if hasattr(runtime.hub, "on_connection_lost"):
+        runtime.hub.on_connection_lost = stop.set
+    try:
+        while not stop.is_set():
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(stop.wait(), args.interval)
+            if stop.is_set():
+                break
+            summary = observatory.summary()
+            if args.json_out:
+                print(json.dumps(summary, indent=2))
+            else:
+                print(format_fleet_table(summary))
+                print()
+            if args.once:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        await observatory.stop()
         await runtime.shutdown()
     return 0
 
@@ -1588,6 +1732,8 @@ def main(argv=None) -> int:
         return asyncio.run(run_llmctl(args))
     if args.cmd == "metrics":
         return asyncio.run(run_metrics(args))
+    if args.cmd == "fleet":
+        return asyncio.run(run_fleet(args))
     if args.cmd == "datagen":
         return run_datagen(args)
     if args.cmd == "profile":

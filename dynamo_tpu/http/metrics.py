@@ -38,6 +38,14 @@ class ServiceMetrics:
     ) -> None:
         self._metrics = registry or MetricsRegistry()
         self.registry = self._metrics.registry
+        # the service's private registry carries the same worker identity
+        # the runtime registry renders with (satellite: multi-worker
+        # Prometheus scrapes must not collide on identical series)
+        from ..runtime import metrics as rtm
+
+        identity = rtm.worker_identity()
+        if identity and not self._metrics.default_labels:
+            self._metrics.set_default_labels(**identity)
         self.requests_total = self._metrics.counter(
             f"{prefix}_http_service_requests",
             "Total HTTP service requests",

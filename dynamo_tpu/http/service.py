@@ -165,8 +165,10 @@ class HttpService:
         template=None,  # Optional[RequestTemplate]: body defaults
         max_inflight: Optional[int] = None,  # admission bound (None = env)
         default_deadline_s: Optional[float] = None,  # None = env / no deadline
+        observatory=None,  # Optional[FleetObservatory]: /fleet surface
     ) -> None:
         self.manager = manager or ModelManager()
+        self.observatory = observatory
         self.template = template
         self.admission = AdmissionControl(max_inflight)
         if default_deadline_s is None:
@@ -191,6 +193,10 @@ class HttpService:
         self.server.route("POST", "/profile/device", self._profile_device)
         self.server.route("GET", "/debug/flightrec", self._flightrec_list)
         self.server.route_prefix("GET", "/debug/flightrec/", self._flightrec_get)
+        # fleet observatory surface (fleet/observatory.py): cluster summary
+        # + the dynamo_fleet_* exposition, 503 until an observatory is wired
+        self.server.route("GET", "/fleet", self._fleet)
+        self.server.route("GET", "/fleet/metrics", self._fleet_metrics)
 
     @property
     def address(self) -> tuple:
@@ -230,6 +236,25 @@ class HttpService:
         body, content_type = self.metrics.render()
         runtime_body, _ = rtmetrics.render_default()
         return Response(200, {"Content-Type": content_type}, body + runtime_body)
+
+    async def _fleet(self, req: Request) -> Response:
+        """GET /fleet: the observatory's cluster summary -- per-worker
+        rows, role-aggregated totals, the learned link table, stragglers."""
+        if self.observatory is None:
+            return Response.json(
+                {"error": {"message": "no fleet observatory attached"}}, 503
+            )
+        return Response.json(self.observatory.summary())
+
+    async def _fleet_metrics(self, req: Request) -> Response:
+        """GET /fleet/metrics: only the ``dynamo_fleet_*`` families, for
+        scrapers that want cluster rollups without per-process series."""
+        if self.observatory is None:
+            return Response.json(
+                {"error": {"message": "no fleet observatory attached"}}, 503
+            )
+        body, content_type = self.observatory.render()
+        return Response(200, {"Content-Type": content_type}, body)
 
     async def _trace(self, req: Request) -> Response:
         """GET /trace/{request_id}: this process's spans for one request,

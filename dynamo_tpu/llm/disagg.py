@@ -800,6 +800,17 @@ class PrefillWorker:
         self.obs.export_latency.observe(row["export_ms"] / 1e3)
         if "overlap_ratio" in row:
             self.obs.overlap_ratio.observe(row["overlap_ratio"])
+        # fleet plane: dst-attributed wire transfers feed the observatory's
+        # per-(src, dst) link model via the next telemetry snapshot
+        if path == "wire" and "dst" in row:
+            from ..runtime import telemetry
+
+            telemetry.note_transfer(
+                src=self.namespace.runtime.primary_lease,
+                dst=row["dst"],
+                nbytes=row["bytes"],
+                seconds=row["deliver_ms"] / 1e3,
+            )
 
     def transfer_stats(self) -> Dict[str, Any]:
         """Percentile summary of the recorded deliveries (bench/metrics
@@ -1072,6 +1083,7 @@ class PrefillWorker:
         self._record_delivery(
             {
                 "path": path,
+                "dst": int(msg["decode_instance"]),
                 "bytes": nbytes,
                 "export_ms": export_ms,
                 "deliver_ms": (time.perf_counter() - t0) * 1000.0,
@@ -1152,6 +1164,7 @@ class PrefillWorker:
         self._record_delivery(
             {
                 "path": "wire",
+                "dst": int(msg["decode_instance"]),
                 "bytes": stream.nbytes,
                 # export-before-first-byte: the number the chunked pipeline
                 # exists to shrink (the legacy path's export_ms covers the

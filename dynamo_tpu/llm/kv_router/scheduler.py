@@ -33,12 +33,20 @@ class KvRouterConfig:
     plane's warmth signal: a worker whose G2/G3 tiers keep serving prefix
     hits onboards a repeat prefix from host RAM (no re-prefill), so it
     beats an otherwise-equal cold worker.  Deliberately smaller than the
-    G1 overlap weight -- an HBM-resident prefix still wins outright."""
+    G1 overlap weight -- an HBM-resident prefix still wins outright.
+
+    ``transfer_ms_weight`` is the NetKV-style link-cost term: when a
+    selector is built with a ``transfer_cost`` source (the fleet
+    observatory's learned per-link model), each candidate's logit is
+    charged ``weight * predicted_seconds`` for moving the request's
+    uncached KV to it.  0.0 (default) keeps the reference function
+    bit-identical."""
 
     overlap_score_weight: float = 2.0
     gpu_cache_usage_weight: float = 1.0
     waiting_requests_weight: float = 1.0
     tier_hit_weight: float = 0.25
+    transfer_ms_weight: float = 0.0
 
 
 @dataclass
@@ -70,8 +78,16 @@ class ProcessedEndpoints:
 class DefaultWorkerSelector:
     """The reference cost function (scheduler.rs:248-330)."""
 
-    def __init__(self, config: Optional[KvRouterConfig] = None) -> None:
+    def __init__(
+        self,
+        config: Optional[KvRouterConfig] = None,
+        transfer_cost: Optional[Callable[[int, int], Optional[float]]] = None,
+    ) -> None:
         self.config = config or KvRouterConfig()
+        # (worker_id, uncached_tokens) -> predicted transfer ms, or None
+        # while the link has no observations (no penalty applied) -- see
+        # FleetObservatory.transfer_cost_source
+        self.transfer_cost = transfer_cost
 
     def select_worker(
         self,
@@ -112,6 +128,15 @@ class DefaultWorkerSelector:
                 - cfg.waiting_requests_weight * normalized_waiting
                 + cfg.tier_hit_weight * tier_warmth
             )
+            if cfg.transfer_ms_weight > 0.0 and self.transfer_cost is not None:
+                uncached_tokens = max(
+                    isl_tokens
+                    - overlap.scores.get(worker_id, 0) * block_size,
+                    0,
+                )
+                pred_ms = self.transfer_cost(worker_id, uncached_tokens)
+                if pred_ms is not None:
+                    logit -= cfg.transfer_ms_weight * pred_ms / 1000.0
             if logit > best_logit:
                 best_logit = logit
                 best = [worker_id]

@@ -92,6 +92,22 @@ class MockerConfig:
     # (ramp toward 8 on pressure-free ticks, collapse to 1 while anything
     # waits or prefills, the engine controller's shape).
     multistep_k: int = 1
+    # fleet-telemetry identity (runtime/telemetry.py): who this engine
+    # claims to be in published snapshots
+    worker_id: int = 0
+    role: str = "decode"
+    # synthetic KV-transfer link model: with link_bandwidth_bytes_per_s > 0
+    # every admission's fresh prefill tokens record one transfer from
+    # link_src as if their KV arrived over the wire --
+    # seconds = link_setup_s + nbytes / bandwidth, nbytes = new_tokens *
+    # kv_bytes_per_token, jittered by +-link_jitter_frac.  Record-only (no
+    # sleeps): the chip-free plane exercises the observatory's learned
+    # cost model against a known ground truth.
+    link_src: int = -1
+    link_bandwidth_bytes_per_s: float = 0.0
+    link_setup_s: float = 0.0
+    link_jitter_frac: float = 0.0
+    kv_bytes_per_token: int = 4096
 
 
 @dataclass
@@ -124,8 +140,14 @@ class _MockSeq:
 class MockerEngine:
     """AsyncEngine-compatible deterministic engine (no device, no JAX)."""
 
-    def __init__(self, cfg: Optional[MockerConfig] = None) -> None:
+    def __init__(
+        self, cfg: Optional[MockerConfig] = None, registry=None
+    ) -> None:
         self.cfg = cfg or MockerConfig()
+        # optional private MetricsRegistry: in-process fleets (several
+        # mockers under one test) keep their engine series -- and hence
+        # their telemetry snapshots -- from colliding on shared gauges
+        self.registry = registry
         self.kv_event_sink: Optional[Callable[[Dict[str, Any]], None]] = None
         self.kv = MockKvManager(
             self.cfg.kv_capacity_blocks,
@@ -144,7 +166,14 @@ class MockerEngine:
         self._tokens_generated = 0
         # same registry-backed series the JaxEngine exposes, so chip-free
         # stacks (mocker workers behind a frontend) light up /metrics too
-        self.obs = EngineMetrics(max_slots=self.cfg.max_batch_size)
+        self.obs = EngineMetrics(
+            registry=registry, max_slots=self.cfg.max_batch_size
+        )
+        # per-engine transfer log: the synthetic link model's observations
+        # ride this engine's telemetry snapshots, never another engine's
+        from ..runtime.telemetry import TransferLog
+
+        self.transfer_log = TransferLog()
         # tick-phase profiler: the mocker marks the same phases the real
         # engine does (its simulated decode sleep plays device_wait), so
         # planner/SLO-loop tests exercise the whole plane chip-free
@@ -414,7 +443,39 @@ class MockerEngine:
             seq.cost = cost
             seq.admitted_s = time.monotonic()
             self.running[seq.request_id] = seq
+            self._note_synthetic_transfer(cost.new_tokens)
             budget -= cost.new_tokens
+
+    def _note_synthetic_transfer(self, new_tokens: int) -> None:
+        """Configured link model (``link_bandwidth_bytes_per_s > 0``):
+        record the admission's fresh KV as one wire transfer into the
+        per-engine transfer log -- honest ground truth for the fleet
+        observatory's learned cost model, with zero added latency."""
+        cfg = self.cfg
+        if cfg.link_bandwidth_bytes_per_s <= 0 or new_tokens <= 0:
+            return
+        nbytes = new_tokens * cfg.kv_bytes_per_token
+        seconds = cfg.link_setup_s + nbytes / cfg.link_bandwidth_bytes_per_s
+        if cfg.link_jitter_frac:
+            seconds *= 1.0 + cfg.link_jitter_frac * (2 * random.random() - 1)
+        self.transfer_log.note(cfg.link_src, cfg.worker_id, nbytes, seconds)
+
+    def telemetry_publisher(
+        self, namespace=None, *, interval_s: float = 1.0, sink=None
+    ):
+        """A :class:`~dynamo_tpu.runtime.telemetry.TelemetryPublisher`
+        wired to this engine's identity, registry, and transfer log."""
+        from ..runtime.telemetry import TelemetryPublisher
+
+        return TelemetryPublisher(
+            namespace,
+            worker_id=self.cfg.worker_id,
+            role=self.cfg.role,
+            registry=self.registry,
+            interval_s=interval_s,
+            transfer_log=self.transfer_log,
+            sink=sink,
+        )
 
     def _plan_k(self) -> int:
         """Decode steps the next simulated dispatch fuses (the engine's

@@ -100,6 +100,23 @@ def registry_metrics_source(
     return source
 
 
+def fleet_metrics_source(
+    observatory,
+) -> Callable[[], Dict[int, ForwardPassMetrics]]:
+    """Metrics source reading a
+    :class:`~dynamo_tpu.fleet.observatory.FleetObservatory` -- the
+    fleet-plane twin of :func:`registry_metrics_source`: same
+    ``ForwardPassMetrics`` construction, but one entry per live telemetry
+    publisher instead of one colocated registry, so the planner scales on
+    cluster-wide state.  On a single-worker fleet the two sources are
+    decision-equivalent (tested in tests/test_fleet.py)."""
+
+    def source() -> Dict[int, ForwardPassMetrics]:
+        return observatory.forward_pass_metrics()
+
+    return source
+
+
 @dataclass
 class PlannerConfig:
     adjustment_interval_s: float = 10.0

@@ -52,6 +52,32 @@ TRANSFER_LATENCY_BUCKETS = (
 RATIO_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0)
 
 
+class _LabeledView:
+    """``generate_latest`` target that merges a registry's default labels
+    into every rendered sample.
+
+    Families are minted unlabeled (or with their own dynamic labels); the
+    identity labels are a render-time concern, so ``sample()`` readers and
+    label-less in-process consumers never see them.  Explicit per-sample
+    labels win on collision.
+    """
+
+    def __init__(self, registry: CollectorRegistry, labels: Dict[str, str]):
+        self._registry = registry
+        self._labels = labels
+
+    def collect(self):
+        from prometheus_client.metrics_core import Metric
+
+        for m in self._registry.collect():
+            out = Metric(m.name, m.documentation, m.type, getattr(m, "unit", ""))
+            for s in m.samples:
+                merged = dict(self._labels)
+                merged.update(s.labels)
+                out.samples.append(s._replace(labels=merged))
+            yield out
+
+
 class MetricsRegistry:
     """Get-or-create facade over a private ``CollectorRegistry``."""
 
@@ -59,6 +85,19 @@ class MetricsRegistry:
         self.registry = CollectorRegistry()
         self._families: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        # identity labels stamped onto every rendered sample (worker_id,
+        # role): multi-worker Prometheus scrapes and fleet-observatory
+        # rollups stop colliding on identical series names.  Empty dict =
+        # exact legacy exposition.
+        self.default_labels: Dict[str, str] = {}
+
+    def set_default_labels(self, **labels: Any) -> None:
+        """Replace the render-time identity label set (None values drop
+        the key)."""
+        with self._lock:
+            self.default_labels = {
+                k: str(v) for k, v in labels.items() if v is not None
+            }
 
     def _get_or_create(
         self,
@@ -106,6 +145,9 @@ class MetricsRegistry:
         )
 
     def render(self) -> Tuple[bytes, str]:
+        if self.default_labels:
+            view = _LabeledView(self.registry, dict(self.default_labels))
+            return generate_latest(view), CONTENT_TYPE_LATEST
         return generate_latest(self.registry), CONTENT_TYPE_LATEST
 
     def sample(
@@ -466,3 +508,21 @@ def set_default(reg: MetricsRegistry) -> MetricsRegistry:
 
 def render_default() -> Tuple[bytes, str]:
     return _default.render()
+
+
+def set_worker_identity(
+    worker_id: Optional[Any] = None, role: Optional[str] = None
+) -> None:
+    """Stamp this process's worker identity onto the default registry's
+    rendered exposition (and keep it across test-time ``set_default``
+    swaps is the caller's concern -- workers set it once at startup)."""
+    labels: Dict[str, Any] = {}
+    if worker_id is not None:
+        labels["worker_id"] = str(worker_id)
+    if role:
+        labels["role"] = str(role)
+    default_registry().set_default_labels(**labels)
+
+
+def worker_identity() -> Dict[str, str]:
+    return dict(default_registry().default_labels)

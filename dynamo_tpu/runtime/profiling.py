@@ -529,6 +529,9 @@ class FlightRecorder:
             "id": snap_id,
             "reason": reason,
             "ts": time.time(),
+            # promoted so /flightrec rows link straight to /trace/{id}
+            # (call sites pass request_id=...; trace_id aliases it)
+            "trace_id": extra.get("trace_id") or extra.get("request_id"),
             "extra": extra,
             "ticks": [
                 r.to_dict() for r in profiler.records(self.tick_window)
@@ -553,6 +556,7 @@ class FlightRecorder:
                     "id": s["id"],
                     "reason": s["reason"],
                     "ts": s["ts"],
+                    "trace_id": s.get("trace_id"),
                     "extra": s["extra"],
                 }
                 for s in self._snaps.values()

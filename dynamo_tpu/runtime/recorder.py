@@ -71,6 +71,9 @@ class RecordingEngine:
             {
                 "type": "request",
                 "request_id": request.id,
+                # request id doubles as the trace id: a recorded request
+                # is one hop from GET /trace/{request_id}
+                "trace_id": request.id,
                 "ts": round(time.time(), 6),
                 "data": request.data,
             }

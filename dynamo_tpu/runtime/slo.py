@@ -311,6 +311,10 @@ class SloTracker:
                     "kind": kind,
                     "cause": cause,
                     "request_id": request_id,
+                    # the request id IS the trace id -- carried explicitly
+                    # so a violation row is one hop from GET /trace/{id}
+                    "trace_id": request_id or None,
+                    "trace": f"/trace/{request_id}" if request_id else None,
                     "value_s": round(seconds, 6),
                 }
             )

@@ -1,0 +1,620 @@
+"""Fleet observatory tests: telemetry codec, ring retention, the learned
+link model (unit + mocker end-to-end), worker churn, straggler detection,
+planner-source equivalence, HTTP surface, and the identity/trace satellites.
+
+Reference behavior spec: ISSUE 18 acceptance criteria.
+"""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+
+from dynamo_tpu.fleet import FleetObservatory, LinkModel, SeriesRing
+from dynamo_tpu.http.service import HttpService, ModelManager
+from dynamo_tpu.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.planner.connector import LocalConnector
+from dynamo_tpu.planner.planner import (
+    Planner,
+    PlannerConfig,
+    fleet_metrics_source,
+    registry_metrics_source,
+)
+from dynamo_tpu.runtime import metrics as rtm
+from dynamo_tpu.runtime import profiling, slo
+from dynamo_tpu.runtime.telemetry import (
+    TelemetryPublisher,
+    TelemetrySnapshot,
+    TransferLog,
+)
+from tests.test_mocker import collect, req
+from tests.test_serving import http_request
+
+
+@pytest.fixture
+def registry():
+    prev = rtm.set_default(rtm.MetricsRegistry())
+    yield rtm.default_registry()
+    rtm.set_default(prev)
+
+
+@pytest.fixture
+def slo_tracker():
+    slo.tracker.disable()
+    yield slo.tracker
+    slo.tracker.disable()
+
+
+@pytest.fixture
+def flightrec():
+    profiling.flight_recorder.clear()
+    yield profiling.flight_recorder
+    profiling.flight_recorder.clear()
+
+
+def snap(wid, seq, ts, *, started=100.0, role="decode", **kw):
+    """Synthetic snapshot with sane engine gauges unless overridden."""
+    kw.setdefault("kv_pages_used", 10)
+    kw.setdefault("kv_pages_total", 100)
+    kw.setdefault("kv_utilization", 0.1)
+    kw.setdefault("batch_slots", 8)
+    return TelemetrySnapshot(
+        worker_id=wid, role=role, seq=seq, ts=ts, started_ts=started, **kw
+    )
+
+
+# -- wire codec --------------------------------------------------------------
+
+
+def test_snapshot_codec_roundtrip():
+    s = TelemetrySnapshot(
+        worker_id=7,
+        role="prefill",
+        seq=42,
+        ts=1234.5,
+        started_ts=1000.25,
+        tokens_generated=999.0,
+        step_count=50.0,
+        step_seconds=1.5,
+        prefix_hit_tokens=30.0,
+        prefix_lookup_tokens=60.0,
+        kv_pages_used=12,
+        kv_pages_total=256,
+        kv_utilization=0.046875,
+        queue_depth=3,
+        batch_occupancy=4,
+        batch_slots=8,
+        slo={"ttft": 0.875, "e2e": 1.0},
+        transfers=[{"src": 1, "dst": 7, "bytes": 4096, "seconds": 0.001}],
+        extra={"note": "x"},
+    )
+    blob = s.encode()
+    # compact JSON on the wire, schema-versioned
+    doc = json.loads(blob)
+    assert doc["v"] == 1
+    back = TelemetrySnapshot.decode(blob)
+    assert back == s
+    # dict path (what the hub pump feeds ingest) round-trips too
+    assert TelemetrySnapshot.from_dict(s.to_dict()) == s
+    # decoder tolerates missing optional fields (older publishers)
+    old = TelemetrySnapshot.from_dict({"worker_id": 3})
+    assert old.worker_id == 3 and old.slo == {} and old.transfers == []
+
+
+def test_transfer_log_rejects_garbage():
+    log = TransferLog()
+    log.note(1, 2, 0, 0.5)  # zero bytes
+    log.note(1, 2, -5, 0.5)  # negative bytes
+    log.note(1, 2, 100, -0.1)  # negative time
+    assert len(log) == 0
+    log.note(1, 2, 100, 0.0)  # zero seconds is a valid (fast) sample
+    assert len(log) == 1
+    drained = log.drain()
+    assert drained == [{"src": 1, "dst": 2, "bytes": 100, "seconds": 0.0}]
+    assert len(log) == 0
+
+
+# -- series ring -------------------------------------------------------------
+
+
+def test_series_ring_retention_and_downsampling():
+    ring = SeriesRing(raw_capacity=10, coarse_capacity=256, bucket=5)
+    for i in range(100):
+        ring.append(float(i), float(i))
+    # raw keeps the newest window; overflow folded 5-point buckets into
+    # one averaged coarse point each
+    assert ring.raw_len == 10
+    assert ring.coarse_len == (100 - 10) // 5
+    assert ring.last() == 99.0
+    assert ring.recent(3) == [97.0, 98.0, 99.0]
+    pts = ring.points()
+    # coarse points first (averages of consecutive 5-buckets), then raw
+    assert pts[0] == (2.0, 2.0)  # mean of 0..4
+    assert pts[1] == (7.0, 7.0)  # mean of 5..9
+    assert pts[-1] == (99.0, 99.0)
+    assert len(pts) == ring.raw_len + ring.coarse_len
+    # coarse side is itself bounded
+    small = SeriesRing(raw_capacity=4, coarse_capacity=3, bucket=2)
+    for i in range(100):
+        small.append(float(i), float(i))
+    assert small.coarse_len == 3
+    small.clear()
+    assert len(small) == 0 and small.last() is None
+
+
+# -- link model --------------------------------------------------------------
+
+
+def test_link_model_unit_convergence():
+    model = LinkModel()
+    rng = random.Random(0)
+    bw, setup = 100e6, 0.002
+    for _ in range(60):
+        n = rng.randint(10_000, 5_000_000)
+        model.observe(n, setup + n / bw)
+    assert model.bandwidth_bytes_per_s == pytest.approx(bw, rel=0.05)
+    assert model.setup_s == pytest.approx(setup, rel=0.05)
+    assert model.predict_s(1_000_000) == pytest.approx(
+        setup + 1_000_000 / bw, rel=0.05
+    )
+
+
+def test_link_model_degenerate_sizes_fall_back_to_origin_fit():
+    # all samples the same size: slope/intercept are unidentifiable, the
+    # model must fall back to a through-origin fit instead of exploding
+    model = LinkModel()
+    for _ in range(10):
+        model.observe(1_000_000, 0.01)
+    assert model.predict_s(2_000_000) == pytest.approx(0.02, rel=0.01)
+
+
+def test_mocker_link_model_converges_within_20pct(registry, slo_tracker, run):
+    """Acceptance: predict_transfer_ms converges to within 20% of the
+    mocker's configured synthetic bandwidth."""
+    bw, setup = 50e6, 0.001
+    engine = MockerEngine(
+        MockerConfig(
+            block_size=4,
+            worker_id=5,
+            role="decode",
+            link_src=1,
+            link_bandwidth_bytes_per_s=bw,
+            link_setup_s=setup,
+            link_jitter_frac=0.05,
+            kv_bytes_per_token=4096,
+        ),
+        registry=rtm.MetricsRegistry(),
+    )
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    pub = engine.telemetry_publisher(sink=obs.ingest)
+
+    async def drive():
+        rng = random.Random(1)
+        try:
+            for i in range(12):
+                toks = [i * 300 + j for j in range(rng.randint(20, 200))]
+                await collect(engine, req(toks, max_tokens=4))
+                await pub.publish_once()
+        finally:
+            await engine.stop()
+
+    run(drive())
+    pred = obs.predict_transfer_ms(1_000_000, 1, 5)
+    truth = (setup + 1_000_000 / bw) * 1000.0
+    assert pred is not None
+    assert abs(pred - truth) / truth < 0.2
+    rows = obs.link_table()
+    assert rows and rows[0]["src"] == 1 and rows[0]["dst"] == 5
+    assert rows[0]["samples"] > 0
+
+
+# -- worker churn ------------------------------------------------------------
+
+
+def test_worker_restart_resets_rings_and_link_model():
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    t0 = time.time()
+    for i in range(1, 6):
+        obs.ingest(
+            snap(
+                2,
+                i,
+                t0 + i,
+                tokens_generated=100.0 * i,
+                step_count=10.0 * i,
+                step_seconds=0.01 * i,
+                transfers=[
+                    {"src": 1, "dst": 2, "bytes": 1 << 20, "seconds": 0.02}
+                ],
+            )
+        )
+    series = obs.worker_series(2)
+    assert len(series["tokens_per_s"]) == 4  # deltas, so N-1 points
+    assert obs.predict_transfer_ms(1 << 20, 1, 2) is not None
+
+    # same id, new incarnation (fresh started_ts, seq reset): counters on
+    # the other side restarted from zero, so rings AND the link edges this
+    # worker participated in must drop
+    obs.ingest(snap(2, 1, t0 + 10, started=200.0, tokens_generated=5.0))
+    series = obs.worker_series(2)
+    assert series["restarts"] == 1
+    assert series["tokens_per_s"] == []
+    assert obs.predict_transfer_ms(1 << 20, 1, 2) is None
+    # next snapshot diffs against the new incarnation, not the old one
+    obs.ingest(
+        snap(2, 2, t0 + 11, started=200.0, tokens_generated=15.0)
+    )
+    assert obs.worker_series(2)["tokens_per_s"][-1][1] == pytest.approx(10.0)
+
+
+def test_worker_leave_expires_and_drops_links():
+    obs = FleetObservatory(rtm.MetricsRegistry(), stale_after_s=5.0)
+    t0 = time.time()
+    obs.ingest(snap(1, 1, t0))
+    obs.ingest(
+        snap(
+            2,
+            1,
+            t0 + 4,
+            transfers=[{"src": 1, "dst": 2, "bytes": 4096, "seconds": 0.01}],
+        )
+    )
+    assert obs.worker_count == 2
+    gone = obs.expire_stale(now=t0 + 7)  # worker 1 is 7s stale, 2 only 3s
+    assert gone == [1]
+    assert obs.worker_count == 1
+    assert obs.predict_transfer_ms(4096, 1, 2) is None  # edge dropped too
+    assert obs.expire_stale(now=t0 + 100) == [2]
+    assert obs.worker_count == 0
+
+
+def test_gauge_rows_zeroed_after_last_worker_of_role_leaves():
+    # labeled prometheus rows outlive their label value: once the last
+    # worker of a role expires, the next render must show 0, not the
+    # role's final headcount
+    reg = rtm.MetricsRegistry()
+    obs = FleetObservatory(reg, stale_after_s=5.0)
+    t0 = time.time()
+    obs.ingest(snap(1, 1, t0, role="decode"))
+    obs.summary()
+    text = reg.render()[0].decode()
+    assert 'dynamo_fleet_workers{role="decode"} 1.0' in text
+    obs.expire_stale(now=t0 + 100)
+    obs.summary()
+    text = reg.render()[0].decode()
+    assert 'dynamo_fleet_workers{role="decode"} 0.0' in text
+    assert 'dynamo_fleet_tokens_per_s{role="decode"} 0.0' in text
+
+
+# -- straggler detection -----------------------------------------------------
+
+
+def _publish_fleet(obs, step_s_by_worker, rounds=6):
+    t0 = time.time()
+    for i in range(1, rounds + 1):
+        for wid, step_s in step_s_by_worker.items():
+            obs.ingest(
+                snap(
+                    wid,
+                    i,
+                    t0 + i,
+                    tokens_generated=10.0 * i,
+                    step_count=10.0 * i,
+                    step_seconds=step_s * 10.0 * i,
+                )
+            )
+
+
+def test_straggler_fires_on_slow_worker(flightrec):
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    _publish_fleet(obs, {1: 0.001, 2: 0.001, 3: 0.020, 4: 0.001})
+    assert obs.stragglers == [3]
+    doc = obs.summary()
+    assert doc["stragglers"] == [3]
+    row = next(w for w in doc["workers"] if w["worker_id"] == 3)
+    assert row["straggler"] is True
+    # the flight recorder got exactly one trigger for the flagged worker
+    snaps = [
+        s for s in flightrec.list() if s["reason"] == "straggler_detected"
+    ]
+    assert len(snaps) == 1
+    detail = flightrec.get(snaps[0]["id"])
+    assert detail["extra"]["worker_id"] == 3
+    # gauge reflects the flagged count
+    body, _ = obs.render()
+    assert b"dynamo_fleet_stragglers 1.0" in body
+
+
+def test_straggler_silent_on_healthy_fleet(flightrec):
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    _publish_fleet(
+        obs, {1: 0.00100, 2: 0.00102, 3: 0.00098, 4: 0.00101}
+    )
+    assert obs.stragglers == []
+    assert not [
+        s for s in flightrec.list() if s["reason"] == "straggler_detected"
+    ]
+    body, _ = obs.render()
+    assert b"dynamo_fleet_stragglers 0.0" in body
+
+
+def test_straggler_fires_in_slowed_mocker_fleet(registry, flightrec, run):
+    """Acceptance: a chaos-armed mocker fleet where one worker is
+    artificially slowed trips the straggler detector."""
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    engines, pubs = [], []
+    for wid in range(4):
+        cfg = MockerConfig(
+            block_size=4,
+            worker_id=wid,
+            decode_s_per_step=0.02 if wid == 3 else 0.0005,
+        )
+        eng = MockerEngine(cfg, registry=rtm.MetricsRegistry())
+        engines.append(eng)
+        pubs.append(eng.telemetry_publisher(sink=obs.ingest))
+
+    async def drive():
+        try:
+            for _ in range(3):
+                await asyncio.gather(
+                    *[
+                        collect(eng, req([1, 2, 3], max_tokens=6))
+                        for eng in engines
+                    ]
+                )
+                for pub in pubs:
+                    await pub.publish_once()
+        finally:
+            for eng in engines:
+                await eng.stop()
+
+    run(drive())
+    assert obs.stragglers == [3]
+    assert [
+        s for s in flightrec.list() if s["reason"] == "straggler_detected"
+    ]
+
+
+# -- kv-router link-cost integration -----------------------------------------
+
+
+def test_router_transfer_cost_penalizes_expensive_link():
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.scheduler import (
+        DefaultWorkerSelector,
+        KvRouterConfig,
+        ProcessedEndpoints,
+    )
+    from dynamo_tpu.protocols.common import ForwardPassMetrics
+
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    t0 = time.time()
+    # teach the observatory two links out of worker 0: fast to 1, slow to 2
+    for i, (dst, bw) in enumerate([(1, 1e9), (2, 1e7)] * 5):
+        obs.ingest(
+            snap(
+                dst,
+                i // 2 + 1,
+                t0 + i,
+                transfers=[
+                    {
+                        "src": 0,
+                        "dst": dst,
+                        "bytes": 1 << 20,
+                        "seconds": (1 << 20) / bw,
+                    }
+                ],
+            )
+        )
+    workers = ProcessedEndpoints()
+    m = dict(kv_active_blocks=10, kv_total_blocks=100,
+             num_requests_waiting=0, gpu_cache_usage_perc=0.1)
+    workers.update(1, ForwardPassMetrics(**m))
+    workers.update(2, ForwardPassMetrics(**m))
+    overlap = OverlapScores()  # no prefix anywhere: workers tie otherwise
+    cost = obs.transfer_cost_source(src=0, bytes_per_token=4096)
+
+    armed = DefaultWorkerSelector(
+        KvRouterConfig(transfer_ms_weight=1.0), transfer_cost=cost
+    )
+    picks = {
+        armed.select_worker(workers, overlap, 4096, 16)[0]
+        for _ in range(8)
+    }
+    assert picks == {1}  # the slow link always loses
+    # default config is bit-identical to the reference function: the tie
+    # stands and both workers stay reachable
+    plain = DefaultWorkerSelector(transfer_cost=cost)
+    picks = {
+        plain.select_worker(workers, overlap, 4096, 16)[0]
+        for _ in range(64)
+    }
+    assert picks == {1, 2}
+
+
+# -- planner adapter equivalence ---------------------------------------------
+
+
+def _seed_engine_gauges(reg):
+    g = reg.gauge
+    g("dynamo_engine_kv_pages_total", "t").set(256)
+    g("dynamo_engine_kv_pages_used", "t").set(230)
+    g("dynamo_engine_kv_utilization", "t").set(230 / 256)
+    g("dynamo_engine_prefill_queue_depth", "t").set(5)
+    g("dynamo_engine_batch_occupancy", "t").set(3)
+    g("dynamo_engine_batch_slots", "t").set(8)
+    reg.counter("dynamo_engine_prefix_hit_tokens", "t").inc(30)
+    reg.counter("dynamo_engine_prefix_lookup_tokens", "t").inc(120)
+
+
+def test_fleet_source_matches_registry_source(registry, slo_tracker):
+    """Acceptance: on a single-worker fleet the observatory-backed planner
+    source produces the same ForwardPassMetrics as the colocated one."""
+    _seed_engine_gauges(registry)
+    local = registry_metrics_source(registry, worker_id=7)()
+
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    pub = TelemetryPublisher(worker_id=7, role="decode", registry=registry)
+    obs.ingest(pub.collect().to_dict())
+    fleet = fleet_metrics_source(obs)()
+
+    assert set(local) == set(fleet) == {7}
+    a, b = local[7], fleet[7]
+    assert a.kv_active_blocks == b.kv_active_blocks == 230
+    assert a.kv_total_blocks == b.kv_total_blocks == 256
+    assert a.num_requests_waiting == b.num_requests_waiting == 5
+    assert a.gpu_cache_usage_perc == pytest.approx(b.gpu_cache_usage_perc)
+    assert a.gpu_prefix_cache_hit_rate == pytest.approx(
+        b.gpu_prefix_cache_hit_rate
+    )
+    assert a.request_active_slots == b.request_active_slots == 3
+    assert a.request_total_slots == b.request_total_slots == 8
+    assert a.slo_ttft_attainment == b.slo_ttft_attainment == 1.0
+    assert a.slo_itl_attainment == b.slo_itl_attainment == 1.0
+    assert a.slo_e2e_attainment == b.slo_e2e_attainment == 1.0
+
+
+def test_planner_decisions_identical_across_sources(
+    registry, slo_tracker, run
+):
+    _seed_engine_gauges(registry)  # kv load 0.9 -> decode scale-up
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    pub = TelemetryPublisher(worker_id=0, role="decode", registry=registry)
+    obs.ingest(pub.collect().to_dict())
+
+    async def noop_worker():
+        return object()
+
+    async def run_planner(source):
+        conn = LocalConnector(
+            {"decode": noop_worker, "prefill": noop_worker}
+        )
+        await conn.add_worker("decode")
+        planner = Planner(
+            conn,
+            source,
+            queue_depth_source=None,
+            cfg=PlannerConfig(adjustment_interval_s=3600.0),
+        )
+        await planner.step()
+        return conn, planner
+
+    conn_a, plan_a = run(run_planner(registry_metrics_source(registry)))
+    conn_b, plan_b = run(run_planner(fleet_metrics_source(obs)))
+    decisions_a = [(a.kind, a.action, a.count_before) for a in plan_a.adjustments]
+    decisions_b = [(a.kind, a.action, a.count_before) for a in plan_b.adjustments]
+    assert decisions_a == decisions_b
+    assert decisions_a == [("decode", "up", 1)]
+    assert conn_a.worker_count("decode") == conn_b.worker_count("decode") == 2
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+def test_fleet_http_endpoints(run):
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    t0 = time.time()
+    obs.ingest(snap(1, 1, t0, role="prefill"))
+    obs.ingest(
+        snap(
+            2,
+            1,
+            t0,
+            transfers=[{"src": 1, "dst": 2, "bytes": 4096, "seconds": 0.01}],
+        )
+    )
+
+    async def go():
+        svc = HttpService(ModelManager(), observatory=obs)
+        await svc.start()
+        try:
+            host, port = svc.address
+            status, _h, doc = await http_request(host, port, "GET", "/fleet")
+            assert status == 200
+            assert {w["worker_id"] for w in doc["workers"]} == {1, 2}
+            assert doc["totals"]["workers_by_role"] == {
+                "prefill": 1,
+                "decode": 1,
+            }
+            assert doc["links"][0]["src"] == 1
+            status, headers, body = await http_request(
+                host, port, "GET", "/fleet/metrics", raw_response=True
+            )
+            assert status == 200
+            assert b"dynamo_fleet_workers" in body
+            assert b"dynamo_engine_" not in body
+        finally:
+            await svc.stop()
+
+        bare = HttpService(ModelManager())
+        await bare.start()
+        try:
+            host, port = bare.address
+            status, _h, doc = await http_request(host, port, "GET", "/fleet")
+            assert status == 503
+            status, _h, _p = await http_request(
+                host, port, "GET", "/fleet/metrics", raw_response=True
+            )
+            assert status == 503
+        finally:
+            await bare.stop()
+
+    run(go())
+
+
+# -- satellite: worker-identity default labels -------------------------------
+
+
+def test_default_labels_applied_at_render_only():
+    reg = rtm.MetricsRegistry()
+    reg.counter("dynamo_test_tokens", "t", ["kind"]).labels("a").inc(5)
+    reg.gauge("dynamo_test_depth", "t").set(2)
+    reg.set_default_labels(worker_id=7, role="decode")
+    body, _ = reg.render()
+    text = body.decode()
+    assert (
+        'dynamo_test_tokens_total{kind="a",role="decode",worker_id="7"} 5.0'
+        in text
+    )
+    assert 'dynamo_test_depth{role="decode",worker_id="7"} 2.0' in text
+    # the read path is unaffected: sample() still resolves bare series
+    assert reg.sample("dynamo_test_depth") == 2.0
+    # explicit labels win over identity defaults on collision
+    reg.counter("dynamo_test_other", "t", ["worker_id"]).labels("9").inc()
+    body, _ = reg.render()
+    assert b'dynamo_test_other_total{role="decode",worker_id="9"} 1.0' in body
+    # clearing identity restores plain exposition
+    reg.set_default_labels()
+    body, _ = reg.render()
+    assert b'dynamo_test_depth 2.0' in body
+
+
+def test_set_worker_identity_reaches_default_registry(registry):
+    rtm.set_worker_identity(worker_id=3, role="prefill")
+    try:
+        assert rtm.worker_identity() == {"worker_id": "3", "role": "prefill"}
+        registry.gauge("dynamo_test_idn", "t").set(1)
+        body, _ = rtm.render_default()
+        assert b'worker_id="3"' in body
+    finally:
+        rtm.set_worker_identity()
+
+
+# -- satellite: trace ids on violations and snapshots ------------------------
+
+
+def test_slo_violation_carries_trace_id(registry, slo_tracker):
+    slo_tracker.configure("ttft=1ms")
+    slo_tracker.record_ttft("req-abc", 5.0)
+    rows = slo_tracker.recent_violations()
+    assert rows and rows[-1]["trace_id"] == "req-abc"
+    assert rows[-1]["trace"] == "/trace/req-abc"
+
+
+def test_flight_recorder_snapshot_carries_trace_id(flightrec):
+    sid = flightrec.snapshot("test_reason", request_id="req-xyz", foo=1)
+    rows = [s for s in flightrec.list() if s["id"] == sid]
+    assert rows and rows[0]["trace_id"] == "req-xyz"
+    assert flightrec.get(sid)["trace_id"] == "req-xyz"
