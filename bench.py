@@ -383,6 +383,438 @@ async def run_host_pipeline(rs) -> dict:
     return out
 
 
+async def run_slo_rig(scale: str = "smoke") -> dict:
+    """Self-healing fleet control proof rig (ISSUE 19): a mocker fleet at
+    production shape under bursty Poisson + diurnal arrivals and mixed
+    prompt lengths, with ``DYN_FAULTS`` armed to kill workers mid-run.
+
+    Three legs, identical workload seed:
+
+      * ``noloss``   -- planner ON, no chaos (the baseline the SLOs were
+        sized against);
+      * ``loss_on``  -- planner ON, >=2 ``worker.kill`` fires mid-run:
+        the control loop must detect the attainment breach, scale the
+        pool back out (drain-safe actuation, standby promotion), and
+        recover;
+      * ``loss_off`` -- same kills, planner absent: what worker loss
+        costs with the loop open.
+
+    The acceptance lines ride the report: ``slo_rig_attainment_gain``
+    (planner ON minus OFF, must be > 0), ``slo_rig_recovery_s``
+    (per-kill time from first post-kill breach back to min(floor,
+    pre-kill attainment), must be finite), ``slo_rig_planner_forced_kills``
+    and
+    ``slo_rig_dropped`` (must be 0: planner scale-downs drain, never
+    drop), and ``slo_rig_identity_failures`` (greedy token identity is
+    unaffected by quarantine/scale events).  ``scale="smoke"`` is the
+    CPU-sized tier-1 shape; ``scale="full"`` is the slow-lane production
+    shape (thousands of streams)."""
+    import itertools
+    import random as _random
+
+    from dynamo_tpu.fleet.observatory import FleetObservatory
+    from dynamo_tpu.llm.kv_router.indexer import OverlapScores
+    from dynamo_tpu.llm.kv_router.scheduler import (
+        DefaultWorkerSelector,
+        NoEndpointsError,
+        ProcessedEndpoints,
+    )
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from dynamo_tpu.planner.connector import LocalConnector
+    from dynamo_tpu.planner.planner import Planner, PlannerConfig
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime import faults, slo
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    shapes = {
+        # CPU-sized smoke: ~hundreds of streams, seconds per leg
+        "smoke": dict(
+            base_workers=3, min_workers=2, max_workers=6,
+            duration_s=3.0, base_rate=80.0, burst_p=0.06, burst_n=4,
+            max_batch=6, kv_blocks=96, decode_s_per_step=7e-4,
+            prompt_lens=(16, 48, 96), prompt_weights=(0.5, 0.3, 0.2),
+            max_tokens=12, ttft_ms=200.0, itl_ms=10.0,
+            kill_fracs=(0.30, 0.55), interval_s=0.12, window_s=1.0,
+        ),
+        # slow-lane production shape: thousands of concurrent streams
+        "full": dict(
+            base_workers=6, min_workers=3, max_workers=12,
+            duration_s=20.0, base_rate=160.0, burst_p=0.08, burst_n=8,
+            max_batch=16, kv_blocks=512, decode_s_per_step=1.5e-4,
+            prompt_lens=(32, 128, 512), prompt_weights=(0.5, 0.35, 0.15),
+            max_tokens=24, ttft_ms=300.0, itl_ms=12.0,
+            kill_fracs=(0.30, 0.50, 0.70), interval_s=0.25, window_s=2.0,
+        ),
+    }
+    shp = shapes[scale]
+    # diurnal phases: arrival-rate multipliers over equal slices of the run
+    phases = (1.0, 1.8, 0.7, 1.5)
+    floor = 0.9
+    vocab = 32000
+    block_size = 16
+
+    class _RigWorker:
+        """One fleet member: engine + its telemetry publisher, exposing
+        the drain/stop/crash surface the connector and chaos use."""
+
+        def __init__(self, engine, publisher):
+            self.engine = engine
+            self.publisher = publisher
+            self.worker_id = engine.cfg.worker_id
+
+        async def drain(self, timeout_s: float = 2.0) -> bool:
+            return await self.engine.drain(timeout_s)
+
+        async def stop(self) -> None:
+            await self.publisher.stop(final=False)
+            await self.engine.stop()
+
+        async def crash(self) -> None:
+            await self.publisher.stop(final=False)
+            await self.engine.crash()
+
+    wid_counter = itertools.count(0)
+
+    async def run_leg(leg: str, *, planner_on: bool, chaos_on: bool) -> dict:
+        rng = _random.Random(1234)  # identical workload schedule per leg
+        slo.tracker.configure(
+            f"ttft={shp['ttft_ms']}ms,itl={shp['itl_ms']}ms,"
+            f"window={shp['window_s']}s"
+        )
+        if chaos_on:
+            faults.injector.configure("seed=42;worker.kill=1")
+        else:
+            faults.injector.disable()
+        obs = FleetObservatory(registry=MetricsRegistry())
+        selector = DefaultWorkerSelector(quarantine=obs.quarantine_source())
+
+        async def make_worker():
+            wid = next(wid_counter)
+            eng = MockerEngine(
+                MockerConfig(
+                    block_size=block_size,
+                    kv_capacity_blocks=shp["kv_blocks"],
+                    max_batch_size=shp["max_batch"],
+                    decode_s_per_step=shp["decode_s_per_step"],
+                    worker_id=wid,
+                ),
+                registry=MetricsRegistry(),
+            )
+            await eng.start()
+            pub = eng.telemetry_publisher(
+                None, interval_s=0.05, sink=obs.ingest
+            )
+            pub.start()
+            return _RigWorker(eng, pub)
+
+        connector = LocalConnector(
+            {"decode": make_worker},
+            drain_timeout_s=2.0,
+            victim_source=obs.victim_source(),
+            standby_spares=1 if planner_on else 0,
+        )
+        for _ in range(shp["base_workers"]):
+            await connector.add_worker("decode")
+        if planner_on:
+            await connector.prewarm("decode")
+
+        def metrics_source():
+            att = {
+                k: slo.tracker.attainment(k) for k in ("ttft", "itl")
+            }
+            out = {}
+            for h in list(connector.workers["decode"]):
+                m = h.engine.metrics()
+                m.slo_ttft_attainment = (
+                    1.0 if att["ttft"] is None else att["ttft"]
+                )
+                m.slo_itl_attainment = (
+                    1.0 if att["itl"] is None else att["itl"]
+                )
+                m.slo_ttft_queue_violations = float(
+                    slo.tracker.violation_count("ttft", "queue")
+                )
+                m.slo_ttft_service_violations = float(
+                    slo.tracker.violation_count("ttft", "service")
+                )
+                out[h.worker_id] = m
+            return out
+
+        planner = None
+        if planner_on:
+            planner = Planner(
+                connector,
+                metrics_source,
+                cfg=PlannerConfig(
+                    adjustment_interval_s=shp["interval_s"],
+                    kv_load_scale_up=0.85,
+                    kv_load_scale_down=0.05,
+                    min_decode_workers=shp["min_workers"],
+                    max_decode_workers=shp["max_workers"],
+                    decode_grace_periods=2,
+                    slo_attainment_floor=floor,
+                    slo_breach_rounds=2,
+                    slo_cooldown_rounds=2,
+                ),
+                quarantine_source=obs.quarantine_source(),
+                on_adjustment=lambda adj: obs.note_adjustment(
+                    adj.kind, adj.action, adj.reason, adj.count_before
+                ),
+            )
+            await planner.start()
+
+        ttft_samples: list = []  # (t_monotonic, seconds)
+        itl_samples: list = []
+        kills: list = []  # (t_monotonic, worker_id)
+        stats = {
+            "completed": 0, "dropped": 0, "identity_failures": 0,
+            "retries": 0,
+        }
+        rid_counter = itertools.count(0)
+        t0 = time.monotonic()
+        t_end = t0 + shp["duration_s"]
+
+        def pick_worker(isl: int):
+            pool = list(connector.workers["decode"])
+            if not pool:
+                return None
+            eps = ProcessedEndpoints(
+                endpoints={h.worker_id: h.engine.metrics() for h in pool}
+            )
+            try:
+                wid, _ = selector.select_worker(
+                    eps, OverlapScores(scores={}), isl, block_size
+                )
+            except NoEndpointsError:
+                return None
+            return next((h for h in pool if h.worker_id == wid), pool[0])
+
+        async def one_stream(prompt):
+            rid = f"rig-{next(rid_counter)}"
+            t_arr = time.monotonic()
+            got_first = False
+            last_t = None
+            for _ in range(4):  # original attempt + failover retries
+                h = pick_worker(len(prompt))
+                if h is None:
+                    stats["dropped"] += 1
+                    return
+                req = PreprocessedRequest(
+                    token_ids=list(prompt),
+                    stop_conditions=StopConditions(
+                        max_tokens=shp["max_tokens"]
+                    ),
+                    sampling_options=SamplingOptions(temperature=0.0),
+                )
+                stream = await h.engine.generate(Context.new(req))
+                tokens: list = []
+                errored = False
+                async for item in stream:
+                    if item.event == "error":
+                        errored = True
+                        break
+                    data = item.data or {}
+                    got = data.get("token_ids") or []
+                    if got:
+                        now = time.monotonic()
+                        tokens.extend(got)
+                        if not got_first:
+                            got_first = True
+                            ttft = now - t_arr
+                            slo.tracker.record_ttft(rid, ttft)
+                            ttft_samples.append((now, ttft))
+                        elif last_t is not None:
+                            itl = now - last_t
+                            slo.tracker.record_itl(itl)
+                            itl_samples.append((now, itl))
+                        last_t = now
+                if errored:
+                    # the worker died under us: client-side failover --
+                    # re-dispatch from scratch on a live worker (partial
+                    # tokens discarded; TTFT stays anchored to arrival)
+                    stats["retries"] += 1
+                    continue
+                stats["completed"] += 1
+                # greedy token identity: the mocker's token function is
+                # pure (prompt, index), so quarantine/scale/failover
+                # events must never change what a request decodes
+                base = (
+                    sum(prompt) * 1000003 + len(prompt) * 8191
+                )
+                expect = [
+                    (base + i * 7919) % vocab for i in range(len(tokens))
+                ]
+                if tokens != expect:
+                    stats["identity_failures"] += 1
+                return
+            stats["dropped"] += 1
+
+        async def chaos():
+            for frac in shp["kill_fracs"]:
+                delay = t0 + frac * shp["duration_s"] - time.monotonic()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                pool = connector.workers["decode"]
+                if len(pool) <= 1:
+                    continue
+                victim = pool[0]  # oldest = carrying the most streams
+                if faults.injector.should_fire(
+                    "worker.kill", f"worker-{victim.worker_id}"
+                ):
+                    pool.remove(victim)
+                    kills.append((time.monotonic(), victim.worker_id))
+                    await victim.crash()
+
+        chaos_task = (
+            asyncio.create_task(chaos()) if chaos_on else None
+        )
+        stream_tasks: list = []
+        now = time.monotonic()
+        while now < t_end:
+            frac = (now - t0) / shp["duration_s"]
+            rate = shp["base_rate"] * phases[
+                min(int(frac * len(phases)), len(phases) - 1)
+            ]
+            await asyncio.sleep(rng.expovariate(rate))
+            n = 1 + (shp["burst_n"] if rng.random() < shp["burst_p"] else 0)
+            for _ in range(n):
+                L = rng.choices(
+                    shp["prompt_lens"], weights=shp["prompt_weights"]
+                )[0]
+                prompt = [rng.randrange(1, vocab) for _ in range(L)]
+                stream_tasks.append(
+                    asyncio.create_task(one_stream(prompt))
+                )
+            now = time.monotonic()
+        if chaos_task is not None:
+            await chaos_task
+        await asyncio.wait_for(
+            asyncio.gather(*stream_tasks, return_exceptions=True),
+            timeout=30.0,
+        )
+        adjustments = 0
+        if planner is not None:
+            await planner.stop()
+            adjustments = sum(
+                1 for a in planner.adjustments if a.action != "hold"
+            )
+        quarantined_peak = len(obs.quarantined)
+        for h in list(connector.workers["decode"]) + list(
+            connector.spares.get("decode") or []
+        ):
+            await h.stop()
+
+        def windowed_attainment(samples, target_s, t, width=0.5):
+            recent = [v for ts, v in samples if t - width <= ts <= t]
+            from dynamo_tpu.runtime.slo import attainment_of
+
+            return attainment_of(recent, target_s)
+
+        # recovery per kill: first post-kill breach -> first return to the
+        # pre-kill service level (0.0 when the kill never dented
+        # attainment).  The recovery bar is min(floor, pre-kill worst
+        # attainment): on a contended host the whole run may sit under
+        # the absolute floor, and "recovered" then means "back to the
+        # service level the fleet was actually delivering before the
+        # loss", not an unreachable absolute
+        def worst_at(t):
+            atts = [
+                windowed_attainment(ttft_samples, shp["ttft_ms"] / 1e3, t),
+                windowed_attainment(itl_samples, shp["itl_ms"] / 1e3, t),
+            ]
+            real = [a for a in atts if a is not None]
+            return min(real) if real else None
+
+        recoveries = []
+        for t_kill, _wid in kills:
+            baseline = worst_at(t_kill)  # window ends at the kill instant
+            bar = floor if baseline is None else min(floor, baseline)
+            breach_t = None
+            recover_t = None
+            t = t_kill
+            while t <= t_end + 1.0:
+                worst = worst_at(t)
+                if worst is not None:
+                    if breach_t is None and worst < bar:
+                        breach_t = t
+                    elif breach_t is not None and worst >= bar:
+                        recover_t = t
+                        break
+                t += 0.05
+            if breach_t is None:
+                recoveries.append(0.0)
+            elif recover_t is not None:
+                recoveries.append(round(recover_t - t_kill, 3))
+            else:
+                recoveries.append(None)  # never recovered (open loop)
+
+        from dynamo_tpu.runtime.slo import attainment_of
+
+        att_ttft = attainment_of(
+            [v for _, v in ttft_samples], shp["ttft_ms"] / 1e3
+        )
+        att_itl = attainment_of(
+            [v for _, v in itl_samples], shp["itl_ms"] / 1e3
+        )
+        slo.tracker.disable()
+        faults.injector.disable()
+        return {
+            "attainment_ttft": round(att_ttft, 4) if att_ttft else 0.0,
+            "attainment_itl": round(att_itl, 4) if att_itl else 0.0,
+            "kills": len(kills),
+            "recoveries_s": recoveries,
+            "adjustments": adjustments,
+            "forced_kills": connector.forced_kills,
+            "final_workers": connector.worker_count("decode"),
+            "quarantined": quarantined_peak,
+            **stats,
+        }
+
+    legs = {}
+    legs["noloss"] = await run_leg("noloss", planner_on=True, chaos_on=False)
+    legs["loss_on"] = await run_leg("loss_on", planner_on=True, chaos_on=True)
+    legs["loss_off"] = await run_leg(
+        "loss_off", planner_on=False, chaos_on=True
+    )
+
+    def score(leg):
+        return min(leg["attainment_ttft"], leg["attainment_itl"])
+
+    out = {"slo_rig_scale": scale}
+    for name, leg in legs.items():
+        out[f"slo_rig_attainment_ttft_{name}"] = leg["attainment_ttft"]
+        out[f"slo_rig_attainment_itl_{name}"] = leg["attainment_itl"]
+        out[f"slo_rig_streams_{name}"] = leg["completed"]
+    out["slo_rig_kills"] = legs["loss_on"]["kills"]
+    out["slo_rig_recovery_s"] = legs["loss_on"]["recoveries_s"]
+    finite = [r for r in legs["loss_on"]["recoveries_s"] if r is not None]
+    out["slo_rig_recovery_max_s"] = max(finite) if finite else None
+    out["slo_rig_adjustments_on"] = legs["loss_on"]["adjustments"]
+    out["slo_rig_planner_forced_kills"] = (
+        legs["noloss"]["forced_kills"]
+        + legs["loss_on"]["forced_kills"]
+    )
+    out["slo_rig_dropped"] = sum(leg["dropped"] for leg in legs.values())
+    out["slo_rig_retries"] = sum(leg["retries"] for leg in legs.values())
+    out["slo_rig_identity_failures"] = sum(
+        leg["identity_failures"] for leg in legs.values()
+    )
+    out["slo_rig_quarantined_peak"] = max(
+        leg["quarantined"] for leg in legs.values()
+    )
+    out["slo_rig_final_workers_on"] = legs["loss_on"]["final_workers"]
+    out["slo_rig_final_workers_off"] = legs["loss_off"]["final_workers"]
+    out["slo_rig_attainment_gain"] = round(
+        score(legs["loss_on"]) - score(legs["loss_off"]), 4
+    )
+    return out
+
+
 async def run_decode_sweep(rs) -> dict:
     """Decode throughput at larger batches on a 64-lane engine (the bs=8
     headline engine stays separate for round-over-round comparability).
@@ -1368,6 +1800,7 @@ async def main():
     pf_load = await run_prefill_under_decode_load(rs)
     long_ctx = await run_long_context(rs)
     host_pipe = await run_host_pipeline(rs)
+    slo_rig = await run_slo_rig(scale="full")
     disagg_tok_s, _dev_stats = await run_disagg(rs, allow_local=True)
     disagg_wire_tok_s, wire_stats = await run_disagg(rs, allow_local=False)
 
@@ -1413,6 +1846,7 @@ async def main():
                 **pf_load,
                 **long_ctx,
                 **host_pipe,
+                **slo_rig,
                 **serving,
             }
         )

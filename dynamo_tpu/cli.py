@@ -236,6 +236,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="print one table after --interval and exit")
     fl.add_argument("--json", dest="json_out", action="store_true",
                     help="print the raw /fleet summary JSON instead")
+    fl.add_argument("--plan", action="store_true",
+                    help="show the planner's last adjustment + reason per "
+                         "pool (note_adjustment / snapshot plan merge)")
 
     # trace: assemble one request's cross-component span timeline from the
     # hub (every served component auto-exposes a _trace scrape endpoint)
@@ -1209,7 +1212,7 @@ async def run_metrics(args) -> int:
     return 0
 
 
-def format_fleet_table(summary) -> str:
+def format_fleet_table(summary, show_plan: bool = False) -> str:
     """Render one /fleet summary as the `dynamo-tpu fleet` table."""
     lines = []
     totals = summary.get("totals", {})
@@ -1241,7 +1244,8 @@ def format_fleet_table(summary) -> str:
                 f"{w.get('kv_pages_used', 0)}/{w.get('kv_pages_total', 0)}",
                 str(w.get("queue_depth", 0)),
                 f"{w.get('batch_occupancy', 0)}/{w.get('batch_slots', 0)}",
-                "STRAGGLER" if w.get("straggler") else "",
+                "QUARANTINED" if w.get("quarantined")
+                else ("STRAGGLER" if w.get("straggler") else ""),
             )
         )
     if rows:
@@ -1253,6 +1257,23 @@ def format_fleet_table(summary) -> str:
         lines.append(fmt.format(*cols))
         for r in rows:
             lines.append(fmt.format(*r))
+    if show_plan:
+        plan = summary.get("plan") or {}
+        if not plan:
+            lines.append("plan:  (no planner adjustments yet)")
+        for kind in sorted(plan):
+            rec = plan[kind]
+            age = ""
+            ts = rec.get("ts")
+            if ts:
+                import time as _time
+
+                age = f" ({max(_time.time() - ts, 0.0):.0f}s ago)"
+            lines.append(
+                f"plan:  {kind}: {rec.get('action', '?')} from "
+                f"{rec.get('count_before', '?')} -- "
+                f"{rec.get('reason', '')}{age}"
+            )
     for link in summary.get("links", []):
         bw = link.get("bandwidth_bytes_per_s")
         setup = link.get("setup_ms")
@@ -1292,7 +1313,9 @@ async def run_fleet(args) -> int:
             if args.json_out:
                 print(json.dumps(summary, indent=2))
             else:
-                print(format_fleet_table(summary))
+                print(format_fleet_table(
+                    summary, show_plan=getattr(args, "plan", False)
+                ))
                 print()
             if args.once:
                 break

@@ -109,6 +109,18 @@ class SeriesRing:
         self._raw.clear()
         self._coarse.clear()
 
+    def carry_average(self) -> Optional[float]:
+        """Average of the freshest bucket's worth of raw points (falling
+        back to the newest coarse point) -- the value a consumer should
+        assume while a just-reset ring refills (satellite: a restarting
+        worker must not read as idle)."""
+        vals = self.recent(self.bucket)
+        if vals:
+            return sum(vals) / len(vals)
+        if self._coarse:
+            return self._coarse[-1][1]
+        return None
+
 
 class LinkModel:
     """Online fit of one (src, dst) KV-transfer link:
@@ -219,6 +231,11 @@ class FleetMetrics:
             "dynamo_fleet_stragglers",
             "Workers currently flagged as step-latency stragglers",
         )
+        self.quarantined = reg.gauge(
+            "dynamo_fleet_quarantined",
+            "Workers quarantined from new placements until their step "
+            "series recovers K consecutive windows",
+        )
         self.link_bandwidth = reg.gauge(
             "dynamo_fleet_link_bandwidth_bytes_per_s",
             "Learned KV-transfer link bandwidth per (src, dst) worker pair",
@@ -239,15 +256,39 @@ class _WorkerState:
     __slots__ = (
         "worker_id", "role", "started_ts", "seq", "first_ts", "last_ts",
         "prev", "latest", "tok_s", "step_ms", "kv_util", "queue",
-        "restarts",
+        "restarts", "carry",
     )
 
     def __init__(self, snap: TelemetrySnapshot, ring_kw: Dict[str, int]):
         self.worker_id = snap.worker_id
         self.restarts = 0
+        self.carry: Dict[str, float] = {}
         self._reset(snap, ring_kw)
 
     def _reset(self, snap: TelemetrySnapshot, ring_kw: Dict[str, int]) -> None:
+        # restart: stash the dying incarnation's last coarse-bucket
+        # averages before dropping the rings, so planner-facing reads can
+        # keep reporting the last known load until the fresh rings hold
+        # enough samples to trust -- a just-reset ring otherwise reads as
+        # "idle" and triggers a spurious scale-down
+        old = getattr(self, "kv_util", None)
+        if old is not None:
+            prev_snap = self.latest
+            kv_carry = self.kv_util.carry_average()
+            q_carry = self.queue.carry_average()
+            self.carry = {
+                "kv_utilization": (
+                    prev_snap.kv_utilization if kv_carry is None else kv_carry
+                ),
+                "queue_depth": (
+                    float(prev_snap.queue_depth)
+                    if q_carry is None else q_carry
+                ),
+                "kv_pages_used": float(prev_snap.kv_pages_used),
+                "kv_pages_total": float(prev_snap.kv_pages_total),
+                "batch_occupancy": float(prev_snap.batch_occupancy),
+                "batch_slots": float(prev_snap.batch_slots),
+            }
         self.role = snap.role
         self.started_ts = snap.started_ts
         self.seq = snap.seq
@@ -294,6 +335,7 @@ class FleetObservatory:
         straggler_min_ratio: float = 1.5,
         straggler_min_workers: int = 3,
         straggler_window: int = 8,
+        quarantine_recovery_windows: int = 5,
         link_decay: float = 0.97,
         ring_raw_capacity: int = 256,
         ring_coarse_capacity: int = 256,
@@ -305,6 +347,7 @@ class FleetObservatory:
         self.straggler_min_ratio = float(straggler_min_ratio)
         self.straggler_min_workers = int(straggler_min_workers)
         self.straggler_window = int(straggler_window)
+        self.quarantine_recovery_windows = int(quarantine_recovery_windows)
         self.link_decay = float(link_decay)
         self._ring_kw = {
             "raw_capacity": ring_raw_capacity,
@@ -314,6 +357,16 @@ class FleetObservatory:
         self._workers: Dict[int, _WorkerState] = {}
         self._links: Dict[Tuple[int, int], LinkModel] = {}
         self._stragglers: set = set()
+        # quarantine ledger: wid -> {"streak": healthy windows in a row,
+        # "seq": last snapshot seq that advanced the streak}.  Entered on
+        # straggler detection; exits after quarantine_recovery_windows
+        # consecutive non-flagged snapshots.  Survives the worker's own
+        # restart (a kill-restart loop must re-earn trust), cleared only
+        # by recovery or the worker leaving the fleet entirely.
+        self._quarantined: Dict[int, Dict[str, int]] = {}
+        # planner's last adjustment per pool kind (note_adjustment /
+        # snapshots' extra["plan"]) -- the `dynamo-tpu fleet --plan` column
+        self._plan: Dict[str, Dict[str, Any]] = {}
         # label values written to each labeled fleet gauge, so rows whose
         # label vanished (last worker of a role leaving) get zeroed on the
         # next refresh instead of exposing their final value forever
@@ -352,6 +405,13 @@ class FleetObservatory:
                 ws._reset(snap, self._ring_kw)
                 self._reset_links_locked(snap.worker_id)
                 self._stragglers.discard(snap.worker_id)
+                if snap.worker_id in self._quarantined:
+                    # new incarnation starts its recovery clock over --
+                    # quarantine itself persists (a crash-restart loop
+                    # must re-earn K healthy windows, not skip them)
+                    self._quarantined[snap.worker_id] = {
+                        "streak": 0, "seq": snap.seq,
+                    }
                 logger.info(
                     "fleet: worker %d restarted (incarnation reset)",
                     snap.worker_id,
@@ -360,9 +420,18 @@ class FleetObservatory:
                 self._advance_locked(ws, snap)
             for rec in snap.transfers:
                 self._observe_transfer_locked(rec)
-            new_stragglers = self._detect_stragglers_locked()
+            plan = snap.extra.get("plan")
+            if isinstance(plan, dict):
+                # an off-process planner publishes its last adjustments in
+                # snapshot extra; merge so `fleet --plan` sees them
+                for kind, rec in plan.items():
+                    if isinstance(rec, dict):
+                        self._plan[str(kind)] = dict(rec)
+            new_stragglers, recovered = self._detect_stragglers_locked()
         for wid, step_ms, median_ms in new_stragglers:
             self._trip_straggler(wid, step_ms, median_ms)
+        for wid in recovered:
+            self._note_recovery(wid)
 
     def _advance_locked(
         self, ws: _WorkerState, snap: TelemetrySnapshot
@@ -421,47 +490,84 @@ class FleetObservatory:
                 del self._workers[wid]
                 self._reset_links_locked(wid)
                 self._stragglers.discard(wid)
+                self._quarantined.pop(wid, None)
         for wid in gone:
             logger.info("fleet: worker %d went stale, removed", wid)
         return gone
 
     # -- straggler detection --------------------------------------------------
 
-    def _detect_stragglers_locked(self) -> List[Tuple[int, float, float]]:
+    def _detect_stragglers_locked(
+        self,
+    ) -> Tuple[List[Tuple[int, float, float]], List[int]]:
         """Robust z-score of each worker's recent mean step latency vs the
         fleet median (MAD-scaled).  A worker is a straggler only when it is
         BOTH statistically extreme (z > straggler_z) and materially slow
         (> straggler_min_ratio x median) -- the ratio floor keeps a
         near-identical healthy fleet silent even when its MAD is tiny.
-        Returns the newly-flagged (worker_id, step_ms, median_ms) rows."""
+
+        Also advances the quarantine ledger: a newly-flagged worker enters
+        quarantine; a quarantined worker exits after
+        ``quarantine_recovery_windows`` consecutive snapshots without a
+        flag (counted per-snapshot via its publisher seq, so one slow
+        peer's ingest cadence cannot fast-forward another's recovery).
+        Returns (newly-flagged (worker_id, step_ms, median_ms) rows,
+        recovered worker ids)."""
         means: Dict[int, float] = {}
         for wid, ws in self._workers.items():
             window = ws.step_ms.recent(self.straggler_window)
             if window:
                 means[wid] = sum(window) / len(window)
-        if len(means) < self.straggler_min_workers:
-            if self._stragglers:
-                self._stragglers.clear()
-            return []
-        median = statistics.median(means.values())
-        mad = statistics.median(abs(v - median) for v in means.values())
-        flagged = set()
-        for wid, mean_ms in means.items():
-            if median <= 0:
-                continue
-            if mean_ms <= self.straggler_min_ratio * median:
-                continue
-            # 0.6745 * MAD ~= sigma for normal data; guard tiny MAD with a
-            # floor proportional to the median so z stays finite
-            sigma = max(mad / 0.6745, 0.02 * median, 1e-9)
-            if (mean_ms - median) / sigma > self.straggler_z:
-                flagged.add(wid)
+        flagged: set = set()
+        if len(means) >= self.straggler_min_workers:
+            median = statistics.median(means.values())
+            mad = statistics.median(abs(v - median) for v in means.values())
+            for wid, mean_ms in means.items():
+                if median <= 0:
+                    continue
+                if mean_ms <= self.straggler_min_ratio * median:
+                    continue
+                # 0.6745 * MAD ~= sigma for normal data; guard tiny MAD
+                # with a floor proportional to the median so z stays finite
+                sigma = max(mad / 0.6745, 0.02 * median, 1e-9)
+                if (mean_ms - median) / sigma > self.straggler_z:
+                    flagged.add(wid)
+        else:
+            median = 0.0
         fresh = [
             (wid, means[wid], median)
             for wid in sorted(flagged - self._stragglers)
         ]
         self._stragglers = flagged
-        return fresh
+        # quarantine ledger: enters ...
+        for wid, _, _ in fresh:
+            entry = self._quarantined.get(wid)
+            ws = self._workers.get(wid)
+            seq = ws.seq if ws is not None else 0
+            if entry is None:
+                self._quarantined[wid] = {"streak": 0, "seq": seq}
+            else:
+                entry["streak"] = 0
+                entry["seq"] = seq
+        # ... and recoveries (one streak tick per new snapshot of that
+        # worker; a re-flag resets the streak)
+        recovered: List[int] = []
+        for wid in list(self._quarantined):
+            ws = self._workers.get(wid)
+            if ws is None:
+                continue  # expire_stale owns removal of vanished workers
+            entry = self._quarantined[wid]
+            if ws.seq <= entry["seq"]:
+                continue  # no new evidence since the last ledger tick
+            entry["seq"] = ws.seq
+            if wid in flagged:
+                entry["streak"] = 0
+                continue
+            entry["streak"] += 1
+            if entry["streak"] >= self.quarantine_recovery_windows:
+                del self._quarantined[wid]
+                recovered.append(wid)
+        return fresh, recovered
 
     def _trip_straggler(
         self, worker_id: int, step_ms: float, median_ms: float
@@ -478,12 +584,106 @@ class FleetObservatory:
             worker_id=worker_id,
             step_ms=round(step_ms, 3),
             fleet_median_ms=round(median_ms, 3),
+            quarantined=True,
+        )
+
+    def _note_recovery(self, worker_id: int) -> None:
+        logger.info(
+            "fleet: worker %d recovered (%d healthy windows); quarantine "
+            "lifted",
+            worker_id, self.quarantine_recovery_windows,
+        )
+        from ..runtime.profiling import flight_recorder
+
+        flight_recorder.snapshot(
+            "straggler_recovered",
+            worker_id=worker_id,
+            healthy_windows=self.quarantine_recovery_windows,
         )
 
     @property
     def stragglers(self) -> List[int]:
         with self._lock:
             return sorted(self._stragglers)
+
+    @property
+    def quarantined(self) -> List[int]:
+        """Workers currently excluded from new placements."""
+        with self._lock:
+            return sorted(self._quarantined)
+
+    def quarantine_source(self) -> Callable[[], List[int]]:
+        """Adapter for the KV router's placement exclusion
+        (``DefaultWorkerSelector(quarantine=...)``) and the planner's
+        victim selection: a zero-arg callable returning the currently
+        quarantined worker ids."""
+        return lambda: self.quarantined
+
+    def victim_source(
+        self,
+        worker_id_of: Callable[[Any], Optional[int]] = (
+            lambda h: getattr(h, "worker_id", None)
+        ),
+    ) -> Callable[[str, List[Any]], Any]:
+        """Adapter for ``LocalConnector(victim_source=...)``: pick the
+        scale-down victim by observatory state -- least-loaded (batch
+        occupancy + queue depth from the last snapshot), and never the
+        last *healthy* worker while peers sit in straggler quarantine
+        (retiring it would leave the pool serving from known-bad boxes).
+        When quarantined workers exist and at most one healthy peer
+        remains, the victim comes from the quarantined set instead: a
+        quarantined worker receives no new placements anyway, so it is
+        the cheapest capacity to give back."""
+
+        def load_of(handle: Any) -> float:
+            wid = worker_id_of(handle)
+            with self._lock:
+                ws = self._workers.get(wid) if wid is not None else None
+                if ws is None:
+                    # never-published (coldest cache): prefer as victim
+                    return -1.0
+                return float(
+                    ws.latest.batch_occupancy + ws.latest.queue_depth
+                )
+
+        def pick(kind: str, handles: List[Any]) -> Any:
+            if not handles:
+                return None
+            with self._lock:
+                bad = set(self._quarantined)
+            healthy = [h for h in handles if worker_id_of(h) not in bad]
+            quarantined = [h for h in handles if worker_id_of(h) in bad]
+            if len(healthy) >= 2 or not quarantined:
+                pool = healthy or handles
+            else:
+                pool = quarantined
+            return min(pool, key=load_of)
+
+        return pick
+
+    # -- planner plan surface -------------------------------------------------
+
+    def note_adjustment(
+        self,
+        kind: str,
+        action: str,
+        reason: str,
+        count_before: int,
+        *,
+        ts: Optional[float] = None,
+    ) -> None:
+        """Record the planner's latest adjustment for one pool kind (the
+        colocated wiring of ``Planner.on_adjustment``); surfaces in
+        ``summary()["plan"]`` and the ``fleet --plan`` column."""
+        rec = {
+            "kind": str(kind),
+            "action": str(action),
+            "reason": str(reason),
+            "count_before": int(count_before),
+            "ts": time.time() if ts is None else float(ts),
+        }
+        with self._lock:
+            self._plan[str(kind)] = rec
 
     # -- link model -----------------------------------------------------------
 
@@ -584,9 +784,12 @@ class FleetObservatory:
                         "batch_slots": snap.batch_slots,
                         "slo": dict(snap.slo),
                         "straggler": wid in self._stragglers,
+                        "quarantined": wid in self._quarantined,
                     }
                 )
             stragglers = sorted(self._stragglers)
+            quarantined = sorted(self._quarantined)
+            plan = {k: dict(v) for k, v in self._plan.items()}
         doc = {
             "ts": now,
             "workers": workers,
@@ -607,6 +810,8 @@ class FleetObservatory:
             },
             "links": self.link_table(),
             "stragglers": stragglers,
+            "quarantined": quarantined,
+            "plan": plan,
         }
         self._refresh_gauges(doc)
         return doc
@@ -631,6 +836,7 @@ class FleetObservatory:
             m.slo_attainment, self._seen_slo_kinds, totals["slo_attainment"]
         )
         m.stragglers.set(len(doc["stragglers"]))
+        m.quarantined.set(len(doc["quarantined"]))
         live_links = set()
         for row in doc["links"]:
             key = (str(row["src"]), str(row["dst"]))
@@ -664,6 +870,31 @@ class FleetObservatory:
         with self._lock:
             for wid, ws in self._workers.items():
                 snap = ws.latest
+                carry = ws.carry if ws.kv_util.raw_len < 2 else {}
+                if carry:
+                    # just-restarted worker: its fresh rings (and freshly
+                    # zeroed counters) read as idle, which is a lie for
+                    # scaling purposes -- report the stashed pre-restart
+                    # coarse-bucket averages until the new incarnation has
+                    # >= 2 real samples behind it
+                    kv_total = int(carry["kv_pages_total"])
+                    batch_slots = int(carry["batch_slots"])
+                    if kv_total <= 0 and batch_slots <= 0:
+                        continue
+                    out[wid] = ForwardPassMetrics(
+                        kv_active_blocks=int(carry["kv_pages_used"]),
+                        kv_total_blocks=kv_total,
+                        num_requests_waiting=int(
+                            round(carry["queue_depth"])
+                        ),
+                        gpu_cache_usage_perc=carry["kv_utilization"],
+                        request_active_slots=int(carry["batch_occupancy"]),
+                        request_total_slots=batch_slots,
+                        slo_ttft_attainment=snap.slo.get("ttft", 1.0),
+                        slo_itl_attainment=snap.slo.get("itl", 1.0),
+                        slo_e2e_attainment=snap.slo.get("e2e", 1.0),
+                    )
+                    continue
                 if snap.kv_pages_total <= 0 and snap.batch_slots <= 0:
                     # mirrors the local source's "no engine sample yet"
                     # guard: a worker that has published nothing but its
@@ -683,6 +914,12 @@ class FleetObservatory:
                     slo_ttft_attainment=snap.slo.get("ttft", 1.0),
                     slo_itl_attainment=snap.slo.get("itl", 1.0),
                     slo_e2e_attainment=snap.slo.get("e2e", 1.0),
+                    slo_ttft_queue_violations=snap.slo_violations.get(
+                        "ttft/queue", 0.0
+                    ),
+                    slo_ttft_service_violations=snap.slo_violations.get(
+                        "ttft/service", 0.0
+                    ),
                 )
         return out
 

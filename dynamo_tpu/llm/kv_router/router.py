@@ -52,6 +52,7 @@ class KvRouter:
         config: Optional[KvRouterConfig] = None,
         scrape_interval_s: float = 0.2,
         index_shards: int = 1,
+        quarantine=None,
     ) -> None:
         self.namespace = namespace
         self.component = component
@@ -66,8 +67,11 @@ class KvRouter:
             )
         else:
             self.indexer = KvIndexer(block_size=block_size)
+        # quarantine: FleetObservatory.quarantine_source() -- stragglers
+        # flagged by the fleet plane stop winning selections until their
+        # series recovers (scheduler.py weight-zeroing)
         self.scheduler = KvScheduler(
-            block_size, DefaultWorkerSelector(config)
+            block_size, DefaultWorkerSelector(config, quarantine=quarantine)
         )
         # one shared ProcessedEndpoints: the aggregator writes scrapes into
         # the same snapshot the scheduler reads/predictively bumps

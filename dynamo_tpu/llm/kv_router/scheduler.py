@@ -17,6 +17,7 @@ overwrites the estimates.
 
 from __future__ import annotations
 
+import logging
 import random
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -82,12 +83,18 @@ class DefaultWorkerSelector:
         self,
         config: Optional[KvRouterConfig] = None,
         transfer_cost: Optional[Callable[[int, int], Optional[float]]] = None,
+        quarantine: Optional[Callable[[], object]] = None,
     ) -> None:
         self.config = config or KvRouterConfig()
         # (worker_id, uncached_tokens) -> predicted transfer ms, or None
         # while the link has no observations (no penalty applied) -- see
         # FleetObservatory.transfer_cost_source
         self.transfer_cost = transfer_cost
+        # worker ids excluded from new placements (fleet straggler
+        # quarantine: FleetObservatory.quarantine_source()); a quarantined
+        # worker keeps serving what it already has, it just stops winning
+        # selections until its step series recovers
+        self.quarantine = quarantine
 
     def select_worker(
         self,
@@ -103,13 +110,37 @@ class DefaultWorkerSelector:
         isl_tokens = max(isl_tokens, 1)
         cfg = self.config
 
+        candidates = workers.endpoints
+        if self.quarantine is not None:
+            try:
+                bad = set(self.quarantine())
+            except Exception:
+                # a broken quarantine feed must not break placement
+                from ...runtime.utils import log_throttled
+
+                log_throttled(
+                    logging.getLogger("dynamo.kv_router"),
+                    "quarantine_source_failed",
+                    "quarantine source failed; selecting from all workers",
+                    exc_info=True,
+                )
+                bad = set()
+            filtered = {
+                wid: m for wid, m in candidates.items() if wid not in bad
+            }
+            # weight-zero, not hard-fail: if quarantine covers the whole
+            # fleet, serving degraded on a known straggler beats serving
+            # nothing at all
+            if filtered:
+                candidates = filtered
+
         max_waiting = max(
-            (m.num_requests_waiting for m in workers.endpoints.values()),
+            (m.num_requests_waiting for m in candidates.values()),
             default=0.0,
         )
         best_logit = float("-inf")
         best: List[int] = []
-        for worker_id, m in workers.endpoints.items():
+        for worker_id, m in candidates.items():
             score = (
                 overlap.scores.get(worker_id, 0) * block_size / isl_tokens
             )

@@ -245,6 +245,38 @@ class MockerEngine:
             getattr(self, "_flightrec_key", "mocker"), self._flightrec_state
         )
 
+    async def drain(self, timeout_s: float = 5.0) -> bool:
+        """Graceful retirement: stop admitting nothing new arrives here --
+        the caller (LocalConnector scale-down) stops routing first -- and
+        wait for every in-flight sequence to finish.  Returns True when
+        the engine emptied within ``timeout_s`` (safe to stop()), False
+        when work remains (the connector refunds the replica instead of
+        dropping requests).  The in-process twin of the SIGTERM drain
+        handler real workers install."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if (
+                not self.running
+                and not self._waiting_list
+                and self._inflight_tick is None
+            ):
+                return True
+            await asyncio.sleep(0.005)
+        return not self.running and not self._waiting_list
+
+    async def crash(self) -> None:
+        """Die like a killed process: every in-flight and queued sequence
+        gets an error frame (clients see the dropped connection and run
+        failover), then the loop stops.  Chaos drivers (the SLO rig's
+        worker.kill) call this; a planner scale-down never does."""
+        for seq in list(self.running.values()) + list(self._waiting_list):
+            self._emit_error(seq, "mocker crashed (injected worker.kill)")
+            self.kv.deref(seq.held)
+            seq.held = []
+        self.running.clear()
+        self._waiting_list.clear()
+        await self.stop()
+
     # -- AsyncEngine --------------------------------------------------------
 
     async def generate(self, request: Context[Any]) -> AsyncIterator[Annotated]:
@@ -537,6 +569,20 @@ class MockerEngine:
         k = self._plan_k()
         tick_s = cfg.decode_s_per_step * self.kv.num_active_blocks * k
         had_work = bool(self.running)
+        # chaos plane: worker.slow injects deterministic per-step latency
+        # into this worker's tick (delay= seconds x K fused steps); match=
+        # on "worker-<id>" degrades exactly one worker, which is how
+        # straggler detection/quarantine is driven from DYN_FAULTS
+        from ..runtime import faults
+
+        if (
+            had_work
+            and faults.injector.enabled
+            and faults.injector.should_fire(
+                "worker.slow", f"worker-{cfg.worker_id}"
+            )
+        ):
+            tick_s += faults.injector.delay_s("worker.slow") * k
         if had_work and k not in self._minted_ks:
             self._minted_ks.add(k)
             compile_sentry.note_compilation(

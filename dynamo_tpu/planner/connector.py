@@ -39,30 +39,104 @@ class LocalConnector(Connector):
     """Spawn/retire worker handles via per-kind async factories.
 
     ``factories[kind]()`` returns a live handle; ``stopper(handle)`` (or the
-    handle's own ``stop()``) retires it.  Removal is LIFO: the youngest
-    worker drains first (its cache is coldest).
+    handle's own ``stop()``) retires it.
+
+    Safe actuation (ISSUE 19):
+
+    * **Victim selection** -- removal asks ``victim_source(kind, handles)``
+      (wire it to the fleet observatory: least-loaded, never quarantined)
+      for which handle to retire; without one, removal is LIFO (the
+      youngest worker's cache is coldest).
+    * **Drain before stop** -- a handle exposing ``drain(timeout_s)`` is
+      drained first (the in-process twin of the supervisor's SIGTERM
+      grace); on drain timeout the handle is *refunded* to the pool
+      instead of force-killed -- a planner scale-down must never drop
+      in-flight requests, so the would-be forced kill is logged, counted
+      in ``forced_kills``, and retried by a later round.
+    * **Standby pool** -- ``prewarm(kind, n)`` keeps warm spares;
+      ``add_worker`` promotes a spare (instant capacity, no cold start)
+      and replenishes the pool in the background.
     """
 
     def __init__(
         self,
         factories: Dict[str, Callable[[], Awaitable[Any]]],
         stopper: Optional[Callable[[Any], Awaitable[None]]] = None,
+        *,
+        drain_timeout_s: float = 5.0,
+        victim_source: Optional[Callable[[str, List[Any]], Any]] = None,
+        standby_spares: int = 0,
     ) -> None:
         self.factories = factories
         self.stopper = stopper
+        self.drain_timeout_s = drain_timeout_s
+        self.victim_source = victim_source
+        self.standby_spares = standby_spares
         self.workers: Dict[str, List[Any]] = {k: [] for k in factories}
+        self.spares: Dict[str, List[Any]] = {k: [] for k in factories}
+        # refused forced kills: drains that timed out and refunded the
+        # replica (mirrors supervisor.Watcher.forced_kills semantics)
+        self.forced_kills = 0
+
+    async def prewarm(self, kind: str, n: Optional[int] = None) -> None:
+        """Fill the standby pool for ``kind`` up to ``n`` (default
+        ``standby_spares``) warm handles."""
+        target = self.standby_spares if n is None else n
+        pool = self.spares.setdefault(kind, [])
+        while len(pool) < target:
+            pool.append(await self.factories[kind]())
 
     async def add_worker(self, kind: str) -> None:
-        handle = await self.factories[kind]()
+        spares = self.spares.get(kind) or []
+        if spares:
+            # promote a pre-warmed spare: capacity lands this round, the
+            # cold start already happened off the critical path
+            handle = spares.pop(0)
+            promoted = True
+        else:
+            handle = await self.factories[kind]()
+            promoted = False
         self.workers.setdefault(kind, []).append(handle)
-        logger.info("local connector: added %s worker (now %d)",
-                    kind, len(self.workers[kind]))
+        logger.info(
+            "local connector: added %s worker%s (now %d)",
+            kind, " from standby" if promoted else "",
+            len(self.workers[kind]),
+        )
+        if promoted and self.standby_spares > 0:
+            await self.prewarm(kind)
 
     async def remove_worker(self, kind: str) -> None:
         pool = self.workers.get(kind) or []
         if not pool:
             return
-        handle = pool.pop()
+        handle = None
+        if self.victim_source is not None:
+            try:
+                handle = self.victim_source(kind, list(pool))
+            except Exception:
+                logger.exception("victim source failed; falling back to LIFO")
+        if handle is None or handle not in pool:
+            handle = pool[-1]
+        pool.remove(handle)
+        drain = getattr(handle, "drain", None)
+        if drain is not None:
+            try:
+                drained = await asyncio.wait_for(
+                    drain(self.drain_timeout_s), self.drain_timeout_s + 1.0
+                )
+            except asyncio.TimeoutError:
+                drained = False
+            if not drained:
+                # refund: never force-kill in-flight work on a planner
+                # scale-down; a later round retries once the worker drains
+                pool.append(handle)
+                self.forced_kills += 1
+                logger.warning(
+                    "local connector: %s worker refused to drain in %.1fs; "
+                    "refunding replica (forced_kills=%d)",
+                    kind, self.drain_timeout_s, self.forced_kills,
+                )
+                return
         if self.stopper is not None:
             await self.stopper(handle)
         elif hasattr(handle, "stop"):

@@ -1,25 +1,55 @@
-"""Planner: reactive autoscaling of decode / prefill workers.
+"""Planner: SLO-driven autoscaling of decode / prefill workers.
 
-Rebuild of the reference planner (examples/llm/components/planner.py:40-49
-thresholds+grace constants, :142 collect_metrics, :214-340 make_adjustments):
-every adjustment interval, average the fleet's KV-cache load and the prefill
-queue depth, then scale
+The control loop closes here (ISSUE 19): the deployment's promise is SLO
+*attainment* (``runtime/slo.py``), so attainment drives the pool sizes and
+the classic load thresholds survive as the coarse fallback signal.
 
-  * **decode workers** on KV load: above ``kv_load_scale_up`` add one, below
-    ``kv_load_scale_down`` (and nobody waiting) remove one;
-  * **prefill workers** on queue depth per worker: above
-    ``queue_scale_up_per_worker`` add one, below ``queue_scale_down`` remove.
+Per adjustment round, in priority order:
 
-A freshly added worker warms up (engine start, weight load, cache fill), so
-each scale-up opens a grace period during which further changes of that kind
-are suppressed (reference NEW_DECODE_WORKER_GRACE_PERIOD /
-NEW_PREFILL_WORKER_QUEUE_BUFFER_PERIOD = 3 intervals).
+  * **SLO pass** -- the rolling TTFT / ITL attainment each worker reports
+    (``ForwardPassMetrics.slo_*_attainment``) is compared against
+    ``slo_attainment_floor``:
+
+      - ITL below the floor for ``slo_breach_rounds`` consecutive rounds
+        scales the **decode** pool up (decode is what paces tokens);
+      - TTFT below the floor scales the **prefill** pool up, but only when
+        the violation-cause evidence attributes the misses to *queueing*
+        (``slo_ttft_queue_violations`` deltas / backlog) -- a
+        service-caused TTFT miss means the engine is slow, and adding
+        prefill replicas would not help, so the planner records a hold
+        with the evidence instead of thrashing;
+
+    every SLO-driven actuation opens a ``slo_cooldown_rounds`` cooldown
+    for its pool, and hysteresis (the consecutive-rounds requirement)
+    keeps one noisy window from scaling anything: together they make the
+    controller stable under square-wave load.
+
+  * **load pass** (the reference thresholds,
+    examples/llm/components/planner.py:40-49, :214-340): decode scales on
+    average KV load (``kv_load_scale_up`` / ``kv_load_scale_down``),
+    prefill on queue depth per worker.  Scale-*down* is SLO-gated: a pool
+    below its attainment floor never shrinks, whatever the load says.
+
+Quarantined workers (fleet observatory straggler quarantine, wired via
+``quarantine_source``) are excluded from the aggregates: their latency is
+known-bad and being handled by placement exclusion, so it must not be
+read as pool-wide SLO pressure.
+
+Every :class:`Adjustment` is stamped with the attainment/cause evidence
+that triggered it and appended to the JSONL log -- the decision history
+is replayable from the file alone.  A freshly added worker warms up
+(engine start, weight load, cache fill), so each scale-up opens a grace
+period during which further changes of that kind are suppressed
+(reference NEW_DECODE_WORKER_GRACE_PERIOD = 3 intervals).
 
 The planner is deliberately sans-IO: ``metrics_source`` yields the current
 per-worker ``ForwardPassMetrics`` (wire it to a KvMetricsAggregator's shared
-``ProcessedEndpoints`` in production, or to in-process engines in tests) and
+``ProcessedEndpoints`` in production, the fleet observatory via
+``fleet_metrics_source``, or in-process engines in tests) and
 ``queue_depth_source`` yields the prefill queue depth (hub ``queue_depth``).
-Scaling goes through a :class:`~.connector.Connector`.
+Scaling goes through a :class:`~.connector.Connector`; ``on_adjustment``
+(wire it to ``FleetObservatory.note_adjustment``) surfaces the last
+decision per pool in ``GET /fleet`` and ``dynamo-tpu fleet --plan``.
 """
 
 from __future__ import annotations
@@ -94,6 +124,12 @@ def registry_metrics_source(
                 slo_ttft_attainment=attainment("ttft"),
                 slo_itl_attainment=attainment("itl"),
                 slo_e2e_attainment=attainment("e2e"),
+                slo_ttft_queue_violations=float(
+                    _slo.tracker.violation_count("ttft", "queue")
+                ),
+                slo_ttft_service_violations=float(
+                    _slo.tracker.violation_count("ttft", "service")
+                ),
             )
         }
 
@@ -133,6 +169,16 @@ class PlannerConfig:
     # intervals to wait after a scale-up before acting again on that kind
     decode_grace_periods: int = 3
     prefill_grace_periods: int = 3
+    # -- SLO loop (ISSUE 19) --------------------------------------------------
+    # minimum acceptable rolling attainment; a pool whose worst
+    # (non-quarantined) worker reports less is under SLO pressure
+    slo_attainment_floor: float = 0.9
+    # hysteresis: consecutive under-floor rounds required before an
+    # SLO-driven scale-up fires (one noisy window scales nothing)
+    slo_breach_rounds: int = 2
+    # rounds after an SLO-driven actuation during which further SLO-driven
+    # actions on that pool are suppressed (the load pass still runs)
+    slo_cooldown_rounds: int = 2
     # observe and log decisions without acting (reference no-operation mode)
     no_op: bool = False
     # machine-readable adjustment history: one JSON line per decision,
@@ -151,6 +197,10 @@ class Adjustment:
     action: str  # "up" | "down" | "hold"
     reason: str
     count_before: int
+    # the attainment / violation-cause numbers the decision was made on
+    # (None for pure load-pass decisions) -- serialized into the JSONL log
+    # so the decision history replays from the file alone
+    evidence: Optional[Dict[str, object]] = None
 
 
 class Planner:
@@ -160,15 +210,33 @@ class Planner:
         metrics_source: Callable[[], Dict[int, ForwardPassMetrics]],
         queue_depth_source: Optional[Callable[[], Awaitable[int]]] = None,
         cfg: Optional[PlannerConfig] = None,
+        quarantine_source: Optional[Callable[[], object]] = None,
+        on_adjustment: Optional[Callable[[Adjustment], None]] = None,
     ) -> None:
         self.connector = connector
         self.metrics_source = metrics_source
         self.queue_depth_source = queue_depth_source
         self.cfg = cfg or PlannerConfig()
+        # worker ids currently quarantined by the fleet observatory: their
+        # latency is being handled by placement exclusion, so they are
+        # dropped from the SLO/load aggregates (FleetObservatory
+        # .quarantine_source() returns the matching callable)
+        self.quarantine_source = quarantine_source
+        # decision hook (non-hold only): FleetObservatory.note_adjustment
+        # surfaces the last plan per pool in /fleet and the CLI --plan view
+        self.on_adjustment = on_adjustment
         self.adjustments: List[Adjustment] = []
         self._decode_grace = 0
         self._prefill_grace = 0
         self._prev_queue_depth: Optional[int] = None
+        # SLO hysteresis / cooldown state, per pool
+        self._itl_breach = 0
+        self._ttft_breach = 0
+        self._decode_cooldown = 0
+        self._prefill_cooldown = 0
+        # last-seen cumulative TTFT violation counts per worker, diffed
+        # round-over-round to attribute fresh misses to queue vs service
+        self._prev_ttft_causes: Dict[int, tuple] = {}
         self._task: Optional[asyncio.Task] = None
         # single-thread writer for the JSONL adjustment log: _record runs
         # on the event loop (called from the async adjust passes), so the
@@ -216,8 +284,9 @@ class Planner:
         if self.queue_depth_source is not None:
             queue_depth = await self.queue_depth_source()
         await self._adjust_decode(metrics)
-        await self._adjust_prefill(queue_depth)
+        await self._adjust_prefill(queue_depth, metrics)
         self._prev_queue_depth = queue_depth
+        self._refresh_pool_gauges()
         # barrier: when the round completes, its decisions are on disk
         # (threshold-tuning tools tail the file between rounds) -- the
         # waiting happens here, off the per-decision path, not per line
@@ -232,18 +301,81 @@ class Planner:
             return
         await asyncio.wrap_future(fut)
 
+    def _healthy(
+        self, metrics: Dict[int, ForwardPassMetrics]
+    ) -> Dict[int, ForwardPassMetrics]:
+        """Drop quarantined workers from the aggregates: a known straggler
+        is handled by placement exclusion, and reading its latency as
+        pool-wide SLO pressure would double-actuate."""
+        if self.quarantine_source is None:
+            return metrics
+        try:
+            quarantined = set(self.quarantine_source())
+        except Exception:
+            logger.exception("quarantine source failed; using all workers")
+            return metrics
+        healthy = {
+            wid: m for wid, m in metrics.items() if wid not in quarantined
+        }
+        # an all-quarantined fleet still needs *some* signal; degrade to
+        # the full view rather than flying blind
+        return healthy or metrics
+
     async def _adjust_decode(self, metrics: Dict[int, ForwardPassMetrics]) -> None:
         cfg = self.cfg
         n = self.connector.worker_count(DECODE)
+        if self._decode_cooldown > 0:
+            self._decode_cooldown -= 1
         if self._decode_grace > 0:
             self._decode_grace -= 1
             self._record(DECODE, "hold", f"grace ({self._decode_grace} left)", n)
             return
         if not metrics:
             return
-        loads = [m.gpu_cache_usage_perc for m in metrics.values()]
-        waiting = sum(m.num_requests_waiting for m in metrics.values())
+        healthy = self._healthy(metrics)
+        loads = [m.gpu_cache_usage_perc for m in healthy.values()]
+        waiting = sum(m.num_requests_waiting for m in healthy.values())
         avg_load = sum(loads) / len(loads)
+        # -- SLO pass: ITL attainment paces the decode pool ------------------
+        itl_att = min(m.slo_itl_attainment for m in healthy.values())
+        if itl_att < cfg.slo_attainment_floor:
+            self._itl_breach += 1
+        else:
+            self._itl_breach = 0
+        slo_pressure = itl_att < cfg.slo_attainment_floor
+        if (
+            self._itl_breach >= cfg.slo_breach_rounds
+            and self._decode_cooldown == 0
+            and n < cfg.max_decode_workers
+        ):
+            evidence = {
+                "itl_attainment": round(itl_att, 4),
+                "floor": cfg.slo_attainment_floor,
+                "breach_rounds": self._itl_breach,
+                "cause": "service",
+            }
+            self._record(
+                DECODE, "up",
+                f"itl attainment {itl_att:.2f} < floor "
+                f"{cfg.slo_attainment_floor:.2f}", n, evidence,
+            )
+            if not cfg.no_op:
+                await self.connector.add_worker(DECODE)
+                self._decode_grace = cfg.decode_grace_periods
+                self._decode_cooldown = cfg.slo_cooldown_rounds
+                self._itl_breach = 0
+            return
+        if slo_pressure and self._itl_breach < cfg.slo_breach_rounds:
+            # under the floor but hysteresis not yet satisfied: explicitly
+            # a hold, so the JSONL log shows the breach building
+            self._record(
+                DECODE, "hold",
+                f"itl attainment {itl_att:.2f} < floor (breach "
+                f"{self._itl_breach}/{cfg.slo_breach_rounds})", n,
+                {"itl_attainment": round(itl_att, 4),
+                 "breach_rounds": self._itl_breach},
+            )
+        # -- load pass (reference thresholds) --------------------------------
         if avg_load > cfg.kv_load_scale_up and n < cfg.max_decode_workers:
             self._record(DECODE, "up", f"avg kv load {avg_load:.2f}", n)
             if not cfg.no_op:
@@ -253,19 +385,96 @@ class Planner:
             avg_load < cfg.kv_load_scale_down
             and waiting == 0
             and n > cfg.min_decode_workers
+            and not slo_pressure  # SLO gate: a pool under its floor never shrinks
         ):
             self._record(DECODE, "down", f"avg kv load {avg_load:.2f}", n)
             if not cfg.no_op:
                 await self.connector.remove_worker(DECODE)
 
-    async def _adjust_prefill(self, queue_depth: int) -> None:
+    def _ttft_cause_deltas(
+        self, healthy: Dict[int, ForwardPassMetrics]
+    ) -> tuple:
+        """Round-over-round delta of cumulative TTFT violation counts,
+        summed over the healthy fleet: (fresh queue-caused misses, fresh
+        service-caused misses).  Restarted workers report counters that
+        regressed; clamp at zero so an incarnation flip cannot read as
+        negative evidence."""
+        dq = ds = 0.0
+        for wid, m in healthy.items():
+            cur = (m.slo_ttft_queue_violations, m.slo_ttft_service_violations)
+            prev = self._prev_ttft_causes.get(wid, cur)
+            dq += max(cur[0] - prev[0], 0.0)
+            ds += max(cur[1] - prev[1], 0.0)
+            self._prev_ttft_causes[wid] = cur
+        return dq, ds
+
+    async def _adjust_prefill(
+        self,
+        queue_depth: int,
+        metrics: Optional[Dict[int, ForwardPassMetrics]] = None,
+    ) -> None:
         cfg = self.cfg
-        if self.queue_depth_source is None:
+        healthy = self._healthy(metrics) if metrics else {}
+        if self.queue_depth_source is None and not healthy:
             return
         n = self.connector.worker_count(PREFILL)
+        if self._prefill_cooldown > 0:
+            self._prefill_cooldown -= 1
         if self._prefill_grace > 0:
             self._prefill_grace -= 1
             self._record(PREFILL, "hold", f"grace ({self._prefill_grace} left)", n)
+            return
+        # -- SLO pass: TTFT attainment with cause attribution -----------------
+        ttft_att = 1.0
+        if healthy:
+            ttft_att = min(m.slo_ttft_attainment for m in healthy.values())
+            dq, ds = self._ttft_cause_deltas(healthy)
+            if ttft_att < cfg.slo_attainment_floor:
+                self._ttft_breach += 1
+            else:
+                self._ttft_breach = 0
+            if (
+                self._ttft_breach >= cfg.slo_breach_rounds
+                and self._prefill_cooldown == 0
+            ):
+                waiting = sum(
+                    m.num_requests_waiting for m in healthy.values()
+                )
+                # cause attribution: fresh queue-caused misses dominate, or
+                # (no fresh counter evidence) there is a visible backlog
+                queue_caused = (dq > 0 and dq >= ds) or (
+                    dq == ds == 0 and (queue_depth > 0 or waiting > 0)
+                )
+                evidence = {
+                    "ttft_attainment": round(ttft_att, 4),
+                    "floor": cfg.slo_attainment_floor,
+                    "breach_rounds": self._ttft_breach,
+                    "queue_violations_delta": dq,
+                    "service_violations_delta": ds,
+                    "cause": "queue" if queue_caused else "service",
+                }
+                if queue_caused and n < cfg.max_prefill_workers:
+                    self._record(
+                        PREFILL, "up",
+                        f"ttft attainment {ttft_att:.2f} < floor, "
+                        f"cause=queue", n, evidence,
+                    )
+                    if not cfg.no_op:
+                        await self.connector.add_worker(PREFILL)
+                        self._prefill_grace = cfg.prefill_grace_periods
+                        self._prefill_cooldown = cfg.slo_cooldown_rounds
+                        self._ttft_breach = 0
+                    return
+                if not queue_caused:
+                    # service-caused TTFT miss: more prefill replicas would
+                    # not help (the engine itself is slow -- the ITL/decode
+                    # pass owns that); hold with the evidence on record
+                    self._record(
+                        PREFILL, "hold",
+                        f"ttft attainment {ttft_att:.2f} < floor but "
+                        f"cause=service (decode-side)", n, evidence,
+                    )
+        if self.queue_depth_source is None:
             return
         per_worker = queue_depth / max(n, 1)
         if per_worker > cfg.queue_scale_up_per_worker and n < cfg.max_prefill_workers:
@@ -291,36 +500,78 @@ class Planner:
             if not cfg.no_op:
                 await self.connector.add_worker(PREFILL)
                 self._prefill_grace = cfg.prefill_grace_periods
-        elif per_worker < cfg.queue_scale_down and n > cfg.min_prefill_workers:
+        elif (
+            per_worker < cfg.queue_scale_down
+            and n > cfg.min_prefill_workers
+            and ttft_att >= cfg.slo_attainment_floor  # SLO gate on shrink
+        ):
             self._record(PREFILL, "down", f"queue/worker {per_worker:.1f}", n)
             if not cfg.no_op:
                 await self.connector.remove_worker(PREFILL)
 
-    def _record(self, kind: str, action: str, reason: str, count: int) -> None:
-        self.adjustments.append(
-            Adjustment(
-                t=time.monotonic(),
-                kind=kind,
-                action=action,
-                reason=reason,
-                count_before=count,
-            )
+    def _refresh_pool_gauges(self) -> None:
+        from ..runtime import metrics as rtm
+
+        gauge = rtm.default_registry().gauge(
+            "dynamo_planner_pool_size",
+            "Planner's view of the worker pool size per kind",
+            ["kind"],
         )
+        for kind in (DECODE, PREFILL):
+            try:
+                gauge.labels(kind).set(self.connector.worker_count(kind))
+            except Exception:
+                # connector without that pool: gauge row simply stays unset
+                logger.debug(
+                    "pool gauge refresh skipped for %s", kind, exc_info=True
+                )
+
+    def _record(
+        self,
+        kind: str,
+        action: str,
+        reason: str,
+        count: int,
+        evidence: Optional[Dict[str, object]] = None,
+    ) -> None:
+        adj = Adjustment(
+            t=time.monotonic(),
+            kind=kind,
+            action=action,
+            reason=reason,
+            count_before=count,
+            evidence=evidence,
+        )
+        self.adjustments.append(adj)
         if action != "hold":
             logger.info("planner: %s %s (%s), count was %d", kind, action, reason, count)
+            from ..runtime import metrics as rtm
+
+            rtm.default_registry().counter(
+                "dynamo_planner_adjustments",
+                "Planner scale decisions actuated (or logged in no-op "
+                "mode), by pool kind and direction",
+                ["kind", "action"],
+            ).labels(kind, action).inc()
+            if self.on_adjustment is not None:
+                try:
+                    self.on_adjustment(adj)
+                except Exception:
+                    logger.exception("planner on_adjustment hook failed")
         if self._log_io is not None:
             import json
 
-            line = json.dumps(
-                {
+            doc = {
                     "ts": time.time(),
                     "kind": kind,
                     "action": action,
                     "reason": reason,
                     "count_before": count,
                     "no_op": self.cfg.no_op,
-                }
-            )
+            }
+            if evidence is not None:
+                doc["evidence"] = evidence
+            line = json.dumps(doc)
             # append off the event loop (_record is called mid-adjustment);
             # the single worker keeps decision order in the file
             try:

@@ -183,6 +183,14 @@ class ForwardPassMetrics:
     slo_ttft_attainment: float = 1.0
     slo_itl_attainment: float = 1.0
     slo_e2e_attainment: float = 1.0
+    # cumulative TTFT-violation counts by attributed cause (runtime/slo.py
+    # queue-vs-service first-token decomposition).  Cumulative, not rates:
+    # the planner diffs consecutive rounds, so a lost scrape costs one
+    # round of resolution, never drift -- same contract as telemetry
+    # counters.  0 = SLO plane disarmed / no misses, which reads as "no
+    # evidence" to cause-gated scaling rules
+    slo_ttft_queue_violations: float = 0.0
+    slo_ttft_service_violations: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         return self.__dict__.copy()

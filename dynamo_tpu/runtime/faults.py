@@ -57,6 +57,16 @@ Site catalog (README "Failure model & fault injection"):
                                     corrupted before dispatch; the verify
                                     accept walk must reject it (output
                                     unchanged, only acceptance rate drops)
+    worker.slow                     injected per-step latency in a worker's
+                                    tick loop (``delay=`` seconds added to
+                                    each fired simulated step; ``match=``
+                                    on ``worker-<id>`` targets one worker)
+                                    -- makes straggler detection/quarantine
+                                    drivable from DYN_FAULTS
+    worker.kill                     a whole worker process dies mid-run
+                                    (evaluated by fleet chaos drivers --
+                                    the SLO rig -- per kill opportunity,
+                                    keyed ``worker-<id>``)
 """
 
 from __future__ import annotations
@@ -80,6 +90,8 @@ SITES = frozenset(
         "offload.copy_fail",
         "onboard.truncate",
         "spec.draft_corrupt",
+        "worker.slow",
+        "worker.kill",
     }
 )
 

@@ -128,6 +128,12 @@ class SloTracker:
         self._violations: "collections.deque" = collections.deque(
             maxlen=violation_capacity
         )
+        # cumulative (kind, cause) violation counts since arm time: the
+        # in-process twin of the dynamo_slo_violations counter family,
+        # readable without walking the prometheus exposition -- metric
+        # sources (planner, telemetry snapshots) diff consecutive reads
+        # to attribute fresh misses to queue vs service
+        self._counts: Dict[Tuple[str, str], int] = {}
         self._lock = threading.Lock()
 
     @classmethod
@@ -152,6 +158,7 @@ class SloTracker:
             for q in self._windows.values():
                 q.clear()
             self._violations.clear()
+            self._counts.clear()
         self.enabled = bool(targets)
         if self.enabled:
             reg = self._reg()
@@ -171,6 +178,7 @@ class SloTracker:
                 q.clear()
             self._splits.clear()
             self._violations.clear()
+            self._counts.clear()
 
     # -- engine-side decomposition -----------------------------------------
 
@@ -281,6 +289,12 @@ class SloTracker:
         with self._lock:
             return list(self._violations)[-last:]
 
+    def violation_count(self, kind: str, cause: str) -> int:
+        """Cumulative violations of ``kind`` attributed to ``cause`` since
+        arm time (the readable twin of ``dynamo_slo_violations``)."""
+        with self._lock:
+            return self._counts.get((kind, cause), 0)
+
     # -- internals ----------------------------------------------------------
 
     def _evict(self, q: "collections.deque") -> None:
@@ -317,6 +331,9 @@ class SloTracker:
                     "trace": f"/trace/{request_id}" if request_id else None,
                     "value_s": round(seconds, 6),
                 }
+            )
+            self._counts[(kind, cause)] = (
+                self._counts.get((kind, cause), 0) + 1
             )
         self._reg().counter(
             "dynamo_slo_violations",

@@ -122,6 +122,11 @@ class TelemetrySnapshot:
     batch_slots: int = 0
     # SLO attainment by kind (absent kind = tracker disarmed / no samples)
     slo: Dict[str, float] = field(default_factory=dict)
+    # cumulative SLO violation counts keyed "kind/cause" (the in-process
+    # SloTracker.violation_count values) -- the observatory forwards the
+    # TTFT queue/service pair into ForwardPassMetrics so an off-worker
+    # planner can attribute misses exactly like a colocated one
+    slo_violations: Dict[str, float] = field(default_factory=dict)
     # KV-transfer observations since the previous snapshot
     transfers: List[Dict[str, Any]] = field(default_factory=list)
     extra: Dict[str, Any] = field(default_factory=dict)
@@ -146,6 +151,7 @@ class TelemetrySnapshot:
             "batch_occupancy": self.batch_occupancy,
             "batch_slots": self.batch_slots,
             "slo": {k: round(v, 6) for k, v in self.slo.items()},
+            "slo_violations": dict(self.slo_violations),
             "transfers": list(self.transfers),
             "extra": dict(self.extra),
         }
@@ -173,6 +179,10 @@ class TelemetrySnapshot:
             "batch_slots": int(d.get("batch_slots", 0)),
             "slo": {
                 str(k): float(v) for k, v in (d.get("slo") or {}).items()
+            },
+            "slo_violations": {
+                str(k): float(v)
+                for k, v in (d.get("slo_violations") or {}).items()
             },
             "transfers": list(d.get("transfers") or []),
             "extra": dict(d.get("extra") or {}),
@@ -229,6 +239,13 @@ def snapshot_from_registry(
         got = reg.sample("dynamo_slo_attainment", {"kind": kind})
         if got is not None:
             slo_att[kind] = got
+    slo_viol: Dict[str, float] = {}
+    if _slo.tracker.enabled:
+        for kind in _slo.KINDS:
+            for cause in _slo.CAUSES:
+                n = _slo.tracker.violation_count(kind, cause)
+                if n:
+                    slo_viol[f"{kind}/{cause}"] = float(n)
 
     step_count, step_seconds = _hist_totals(
         reg, "dynamo_engine_step_latency_seconds"
@@ -252,6 +269,7 @@ def snapshot_from_registry(
         batch_occupancy=int(val("dynamo_engine_batch_occupancy")),
         batch_slots=int(val("dynamo_engine_batch_slots")),
         slo=slo_att,
+        slo_violations=slo_viol,
         transfers=log.drain(),
     )
 

@@ -69,6 +69,7 @@ class Watcher:
     restarts: int = 0  # observability: total restart count
     graceful_stops: int = 0  # exited within grace after stop_signal
     forced_kills: int = 0  # needed SIGKILL after the grace expired
+    planner_scales: int = 0  # scale() calls marked planner_intent
     _procs: List[_Replica] = field(default_factory=list)
 
 
@@ -105,9 +106,25 @@ class Supervisor:
         for w in self.watchers.values():
             await self._scale_down_to(w, 0)
 
-    async def scale(self, name: str, replicas: int) -> None:
+    async def scale(
+        self, name: str, replicas: int, *, planner_intent: bool = False
+    ) -> None:
+        """Set the target replica count.
+
+        ``planner_intent=True`` marks the change as a deliberate
+        controller decision rather than crash recovery: flap counters on
+        surviving replicas reset, so the restart-backoff machinery --
+        which exists to contain *crashing* processes -- never fights a
+        scale decision the planner just made (a replica that flapped
+        during an incident would otherwise start its next life with
+        inherited backoff debt)."""
         w = self.watchers[name]
         w.replicas = max(0, replicas)
+        if planner_intent:
+            w.planner_scales += 1
+            for r in w._procs:
+                if not r.parked:
+                    r.flaps = 0
         await self._reconcile(w)
 
     def replica_count(self, name: str) -> int:

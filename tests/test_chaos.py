@@ -783,3 +783,33 @@ def test_chaos_soak_every_request_terminates(run, injector, registry):
         assert outcomes["ok"] > 0 and outcomes["ok"] + outcomes["error"] == 75
 
     run(body())
+
+
+def test_worker_slow_site_slows_mocker_ticks(injector, run):
+    """`worker.slow` (ISSUE 19): a delay-armed site keyed per worker adds
+    its latency to every fused mocker decode step of the matching worker
+    only -- the straggler detector's controllable prey."""
+    from dynamo_tpu.mocker import MockerConfig, MockerEngine
+    from tests.test_mocker import collect, req
+
+    injector.configure("seed=5;worker.slow=1:delay=0.004:match=worker-3")
+
+    async def timed(worker_id):
+        eng = MockerEngine(
+            MockerConfig(
+                block_size=4, worker_id=worker_id, decode_s_per_step=0.0
+            )
+        )
+        t0 = time.monotonic()
+        try:
+            await collect(eng, req([1, 2, 3], max_tokens=8))
+        finally:
+            await eng.stop()
+        return time.monotonic() - t0
+
+    async def body():
+        slow = await timed(3)
+        fast = await timed(1)  # match= filters it out, and without a draw
+        assert slow > fast + 0.01
+
+    run(body())

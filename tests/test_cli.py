@@ -319,3 +319,40 @@ def test_resolve_model_path(tmp_path, monkeypatch):
     monkeypatch.setattr(huggingface_hub, "snapshot_download", failing_snapshot)
     with pytest.raises(SystemExit, match="could not resolve"):
         resolve_model_path("org/other-model")
+
+
+def test_fleet_table_plan_column_and_quarantine_flag():
+    """`dynamo-tpu fleet --plan` renders the planner's last decision per
+    pool, and quarantined workers are flagged over plain stragglers."""
+    from dynamo_tpu.cli import format_fleet_table
+
+    summary = {
+        "totals": {
+            "workers_by_role": {"decode": 2},
+            "kv_pressure": 0.4,
+            "queue_depth": 1,
+        },
+        "workers": [
+            {"worker_id": 1, "role": "decode", "tokens_per_s": 10.0,
+             "step_ms": 1.0, "kv_pages_used": 4, "kv_pages_total": 10,
+             "queue_depth": 0, "batch_occupancy": 1, "batch_slots": 8},
+            {"worker_id": 2, "role": "decode", "tokens_per_s": 0.5,
+             "step_ms": 9.0, "kv_pages_used": 9, "kv_pages_total": 10,
+             "queue_depth": 1, "batch_occupancy": 2, "batch_slots": 8,
+             "straggler": True, "quarantined": True},
+        ],
+        "plan": {
+            "decode": {"action": "up", "count_before": 2,
+                       "reason": "itl attainment 0.71 < floor 0.90"},
+        },
+    }
+    out = format_fleet_table(summary, show_plan=True)
+    assert "QUARANTINED" in out
+    assert "plan:  decode: up from 2 -- itl attainment" in out
+    # without --plan the column stays off
+    assert "plan:" not in format_fleet_table(summary)
+    # and an empty ledger says so rather than rendering nothing
+    empty = dict(summary, plan={})
+    assert "(no planner adjustments yet)" in format_fleet_table(
+        empty, show_plan=True
+    )

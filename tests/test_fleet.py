@@ -618,3 +618,97 @@ def test_flight_recorder_snapshot_carries_trace_id(flightrec):
     rows = [s for s in flightrec.list() if s["id"] == sid]
     assert rows and rows[0]["trace_id"] == "req-xyz"
     assert flightrec.get(sid)["trace_id"] == "req-xyz"
+
+
+# -- straggler quarantine + safe scale-down (ISSUE 19) -----------------------
+
+
+def test_quarantine_lifecycle_recovers_after_clean_windows(flightrec):
+    """Straggler -> quarantined (excluded id published, gauge up) -> the
+    quarantine lifts only after quarantine_recovery_windows consecutive
+    clean snapshots, with flight-recorder evidence at both edges."""
+    obs = FleetObservatory(
+        rtm.MetricsRegistry(), quarantine_recovery_windows=3
+    )
+    _publish_fleet(obs, {1: 0.001, 2: 0.001, 3: 0.020, 4: 0.001})
+    assert obs.quarantined == [3]
+    assert obs.quarantine_source()() == [3]
+    body, _ = obs.render()
+    assert b"dynamo_fleet_quarantined 1.0" in body
+    row = next(
+        w for w in obs.summary()["workers"] if w["worker_id"] == 3
+    )
+    assert row["quarantined"] is True
+
+    # worker 3 heals: keep publishing fleet rounds with it at fleet speed
+    # until its windowed mean drops out of straggler territory, then the
+    # recovery streak (one tick per new snapshot) must lift quarantine
+    t0 = time.time()
+    seq = 7
+    for i in range(1, 25):
+        if 3 not in obs.quarantined:
+            break
+        for wid in (1, 2, 3, 4):
+            obs.ingest(
+                snap(
+                    wid, seq, t0 + 0.01 * i,
+                    tokens_generated=10.0 * seq,
+                    step_count=10.0 * seq,
+                    step_seconds=0.001 * 10.0 * seq,
+                )
+            )
+        seq += 1
+    assert obs.quarantined == []
+    assert [
+        s for s in flightrec.list() if s["reason"] == "straggler_recovered"
+    ]
+    body, _ = obs.render()
+    assert b"dynamo_fleet_quarantined 0.0" in body
+
+
+def test_victim_source_least_loaded_never_last_healthy():
+    """Scale-down victims: least-loaded by the observatory's last snapshot;
+    while peers sit in quarantine the last healthy worker is protected and
+    the victim comes from the quarantined set instead."""
+
+    class H:
+        def __init__(self, wid):
+            self.worker_id = wid
+
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    t0 = time.time()
+    for wid, occ in ((1, 6), (2, 1), (3, 4)):
+        for i in (1, 2):
+            obs.ingest(
+                snap(wid, i, t0 + i, batch_occupancy=occ, queue_depth=0)
+            )
+    pick = obs.victim_source()
+    h1, h2, h3 = H(1), H(2), H(3)
+    assert pick("decode", [h1, h2, h3]) is h2  # least loaded
+    # a never-published handle is the coldest cache: preferred victim
+    h9 = H(9)
+    assert pick("decode", [h1, h2, h9]) is h9
+    # quarantine 2 and 3: with one healthy worker left the victim must
+    # come from the quarantined set, not retire the last healthy box
+    with obs._lock:
+        obs._quarantined[2] = {"streak": 0, "seq": 0}
+        obs._quarantined[3] = {"streak": 0, "seq": 0}
+    victim = pick("decode", [h1, h2, h3])
+    assert victim is h2  # quarantined, least-loaded among them
+    # two healthy workers: normal least-loaded among the healthy set
+    with obs._lock:
+        del obs._quarantined[3]
+    assert pick("decode", [h1, h2, h3]) is h3
+
+
+def test_note_adjustment_surfaces_in_summary_plan():
+    """Planner.on_adjustment -> observatory ledger -> GET /fleet 'plan'
+    (the CLI --plan column reads the same record)."""
+    obs = FleetObservatory(rtm.MetricsRegistry())
+    obs.note_adjustment("decode", "up", "itl attainment 0.71 < floor", 3)
+    obs.note_adjustment("prefill", "down", "queue/worker 0.0", 2)
+    plan = obs.summary()["plan"]
+    assert plan["decode"]["action"] == "up"
+    assert plan["decode"]["count_before"] == 3
+    assert "itl attainment" in plan["decode"]["reason"]
+    assert plan["prefill"]["action"] == "down"

@@ -437,3 +437,46 @@ def test_sharded_indexer_non_contiguous_holdings():
     q = [h0, h1]
     assert sharded.find_matches(q).scores == flat.find_matches(q).scores
     assert flat.find_matches(q).scores == {1: 1, 2: 2}
+
+
+def test_selector_quarantine_excludes_worker():
+    """A quarantined worker is weight-zeroed out of placement: even a big
+    prefix-overlap score cannot win it traffic while it is quarantined."""
+    quarantined = [2]
+    sel = DefaultWorkerSelector(
+        KvRouterConfig(), quarantine=lambda: quarantined
+    )
+    workers = ProcessedEndpoints(
+        endpoints={
+            1: _metrics(gpu_cache_usage_perc=0.6),
+            2: _metrics(gpu_cache_usage_perc=0.1),
+        }
+    )
+    wid, _ = sel.select_worker(
+        workers, OverlapScores(scores={2: 8}), isl_tokens=64, block_size=16
+    )
+    assert wid == 1
+    # recovery lifts the exclusion: the overlap-rich worker wins again
+    quarantined.clear()
+    wid2, _ = sel.select_worker(
+        workers, OverlapScores(scores={2: 8}), isl_tokens=64, block_size=16
+    )
+    assert wid2 == 2
+
+
+def test_selector_all_quarantined_degrades_to_serving():
+    """When the filter would empty the candidate set, serve degraded from
+    the full set rather than failing placement outright."""
+    sel = DefaultWorkerSelector(
+        KvRouterConfig(), quarantine=lambda: [1, 2]
+    )
+    workers = ProcessedEndpoints(
+        endpoints={
+            1: _metrics(gpu_cache_usage_perc=0.2),
+            2: _metrics(gpu_cache_usage_perc=0.9),
+        }
+    )
+    wid, _ = sel.select_worker(
+        workers, OverlapScores(), isl_tokens=64, block_size=16
+    )
+    assert wid == 1

@@ -392,3 +392,358 @@ def test_adjustment_jsonl_sink(run, tmp_path):
         assert {"ts", "kind", "action", "reason", "count_before"} <= set(rec)
     actions = [r["action"] for r in lines]
     assert "up" in actions and "down" in actions
+
+
+# -- SLO loop (ISSUE 19): attainment-driven scaling, hysteresis, cooldown,
+# -- cause attribution, quarantine exclusion, churn robustness ---------------
+
+
+def slo_fpm(load=0.5, waiting=0, *, itl=1.0, ttft=1.0, qv=0.0, sv=0.0):
+    """fpm() plus the live-SLO fields: rolling attainment per kind and the
+    cumulative TTFT violation counts the cause attribution diffs."""
+    return ForwardPassMetrics(
+        kv_active_blocks=0,
+        kv_total_blocks=100,
+        num_requests_waiting=waiting,
+        gpu_cache_usage_perc=load,
+        gpu_prefix_cache_hit_rate=0.0,
+        request_active_slots=0,
+        request_total_slots=8,
+        slo_itl_attainment=itl,
+        slo_ttft_attainment=ttft,
+        slo_ttft_queue_violations=qv,
+        slo_ttft_service_violations=sv,
+    )
+
+
+def test_slo_itl_breach_needs_hysteresis_then_scales_decode(run):
+    """One under-floor window scales nothing (hysteresis); the second
+    consecutive breach round scales decode up with evidence attached."""
+
+    async def body():
+        conn = FakeConnector()
+        metrics = {1: slo_fpm(itl=0.5)}
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(decode_grace_periods=0, slo_breach_rounds=2),
+        )
+        await planner.step()
+        assert conn.counts[DECODE] == 1  # breach 1/2: hold
+        holds = [a for a in planner.adjustments if a.action == "hold"]
+        assert holds and holds[-1].evidence["itl_attainment"] == 0.5
+        await planner.step()
+        assert conn.counts[DECODE] == 2  # breach 2/2: actuate
+        up = next(a for a in planner.adjustments if a.action == "up")
+        assert up.kind == DECODE
+        assert up.evidence["cause"] == "service"
+        assert up.evidence["itl_attainment"] == 0.5
+
+    run(body())
+
+
+def test_slo_square_wave_never_actuates(run):
+    """Alternating good/bad windows (square-wave load) never satisfy the
+    consecutive-rounds hysteresis: zero scale actions over 8 rounds."""
+
+    async def body():
+        conn = FakeConnector()
+        metrics = {}
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(decode_grace_periods=0, slo_breach_rounds=2),
+        )
+        for i in range(8):
+            metrics[1] = slo_fpm(itl=0.5 if i % 2 == 0 else 1.0)
+            await planner.step()
+        assert conn.counts[DECODE] == 1
+        assert not [a for a in planner.adjustments if a.action != "hold"]
+
+    run(body())
+
+
+def test_slo_cooldown_paces_sustained_breach(run):
+    """Under a sustained breach the cooldown paces actuation: 6 rounds of
+    itl=0.5 with cooldown=3 yield exactly 2 scale-ups, not 5."""
+
+    async def body():
+        conn = FakeConnector()
+        metrics = {1: slo_fpm(itl=0.5)}
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(
+                decode_grace_periods=0,
+                slo_breach_rounds=2,
+                slo_cooldown_rounds=3,
+                max_decode_workers=8,
+            ),
+        )
+        for _ in range(6):
+            await planner.step()
+        ups = [a for a in planner.adjustments if a.action == "up"]
+        assert len(ups) == 2
+        assert conn.counts[DECODE] == 3
+
+    run(body())
+
+
+def test_slo_pressure_blocks_legacy_scale_down(run):
+    """A pool below its attainment floor never shrinks, whatever the KV
+    load says; once attainment recovers the load pass shrinks it again."""
+
+    async def body():
+        conn = FakeConnector(decode=3)
+        metrics = {1: slo_fpm(load=0.1, itl=0.5)}
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(decode_grace_periods=0),
+        )
+        await planner.step()
+        assert conn.counts[DECODE] == 3  # low load, but SLO gate holds
+        assert not [a for a in planner.adjustments if a.action == "down"]
+        metrics[1] = slo_fpm(load=0.1, itl=1.0)
+        await planner.step()
+        assert conn.counts[DECODE] == 2  # gate lifted: legacy down fires
+
+    run(body())
+
+
+def test_slo_ttft_queue_cause_scales_prefill(run):
+    """TTFT misses attributed to queueing (fresh queue-caused violation
+    deltas) scale the prefill pool up, stamped with the cause evidence."""
+
+    async def body():
+        conn = FakeConnector()
+        metrics = {1: slo_fpm(ttft=0.6, qv=0.0, sv=0.0)}
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(
+                prefill_grace_periods=0, slo_breach_rounds=2
+            ),
+        )
+        await planner.step()  # breach 1/2, baseline counters recorded
+        assert conn.counts[PREFILL] == 1
+        metrics[1] = slo_fpm(ttft=0.6, qv=5.0, sv=0.0)
+        await planner.step()  # breach 2/2, dq=5 > ds=0 -> queue-caused
+        assert conn.counts[PREFILL] == 2
+        up = next(
+            a for a in planner.adjustments
+            if a.action == "up" and a.kind == PREFILL
+        )
+        assert up.evidence["cause"] == "queue"
+        assert up.evidence["queue_violations_delta"] == 5.0
+
+    run(body())
+
+
+def test_slo_ttft_service_cause_holds_prefill(run):
+    """Service-caused TTFT misses (the engine is slow, not the queue) must
+    not add prefill replicas: the planner records a hold with evidence."""
+
+    async def body():
+        conn = FakeConnector()
+        metrics = {1: slo_fpm(ttft=0.6, qv=0.0, sv=0.0)}
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(
+                prefill_grace_periods=0, slo_breach_rounds=2
+            ),
+        )
+        await planner.step()
+        metrics[1] = slo_fpm(ttft=0.6, qv=0.0, sv=7.0)
+        await planner.step()
+        assert conn.counts[PREFILL] == 1  # no thrash
+        hold = next(
+            a for a in planner.adjustments
+            if a.kind == PREFILL and a.evidence is not None
+        )
+        assert hold.action == "hold"
+        assert hold.evidence["cause"] == "service"
+
+    run(body())
+
+
+def test_slo_quarantined_worker_excluded_from_aggregates(run):
+    """A quarantined straggler's terrible attainment must not read as
+    pool-wide SLO pressure (placement exclusion already handles it)."""
+
+    async def body():
+        conn = FakeConnector(decode=2)
+        metrics = {1: slo_fpm(itl=1.0), 2: slo_fpm(itl=0.2)}
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(
+                decode_grace_periods=0, slo_breach_rounds=1
+            ),
+            quarantine_source=lambda: [2],
+        )
+        await planner.step()
+        await planner.step()
+        assert conn.counts[DECODE] == 2
+        assert not [a for a in planner.adjustments if a.action == "up"]
+
+    run(body())
+
+
+def test_slo_quarantine_mid_window_resets_breach(run):
+    """Churn case: a worker quarantined mid-breach-window drops out of the
+    aggregates, the building breach resets, and no adjustment ever fires."""
+
+    async def body():
+        conn = FakeConnector(decode=2)
+        metrics = {1: slo_fpm(itl=1.0), 2: slo_fpm(itl=0.5)}
+        quarantined = []
+        planner = Planner(
+            conn,
+            metrics_source=lambda: metrics,
+            cfg=PlannerConfig(decode_grace_periods=0, slo_breach_rounds=2),
+            quarantine_source=lambda: quarantined,
+        )
+        await planner.step()  # breach 1/2 building on worker 2
+        quarantined.append(2)  # observatory trips mid-window
+        await planner.step()  # healthy view fully attained: breach resets
+        await planner.step()
+        assert conn.counts[DECODE] == 2
+        assert not [a for a in planner.adjustments if a.action != "hold"]
+
+    run(body())
+
+
+def test_slo_restart_carry_keeps_planner_quiet(run):
+    """Churn case: a worker restart zeroes its gauges and resets its rings;
+    the fleet source carries the pre-restart coarse average until fresh
+    samples exist, so the planner sees steady load and holds instead of
+    scaling down on a phantom idle."""
+    from dynamo_tpu.fleet import FleetObservatory
+    from dynamo_tpu.planner.planner import fleet_metrics_source
+    from dynamo_tpu.runtime import metrics as rtm
+    from dynamo_tpu.runtime.telemetry import TelemetrySnapshot
+
+    def snap(seq, ts, *, started, util):
+        return TelemetrySnapshot(
+            worker_id=1, role="decode", seq=seq, ts=ts, started_ts=started,
+            kv_pages_used=int(util * 100), kv_pages_total=100,
+            kv_utilization=util, batch_slots=8,
+        )
+
+    async def body():
+        import time
+
+        obs = FleetObservatory(rtm.MetricsRegistry())
+        t0 = time.time() - 8
+        for i in range(1, 7):
+            obs.ingest(snap(i, t0 + i, started=t0, util=0.5))
+        conn = FakeConnector(decode=2)
+        planner = Planner(
+            conn,
+            metrics_source=fleet_metrics_source(obs),
+            cfg=PlannerConfig(decode_grace_periods=0),
+        )
+        # restart: new incarnation, seq reset, gauges zeroed, ring has one
+        # sample -- the carried coarse average (0.5) must be served instead
+        obs.ingest(snap(1, t0 + 7.5, started=t0 + 7, util=0.0))
+        await planner.step()
+        assert conn.counts[DECODE] == 2
+        assert not [a for a in planner.adjustments if a.action == "down"]
+        m = obs.forward_pass_metrics()[1]
+        assert m.gpu_cache_usage_perc > 0.3  # carried, not the raw 0.0
+
+    run(body())
+
+
+# -- LocalConnector safe actuation (drain/refund, standby, victims) ----------
+
+
+class _DrainHandle:
+    def __init__(self, mode="ok"):
+        self.mode = mode  # "ok" | "hang"
+        self.stopped = False
+
+    async def drain(self, timeout_s):
+        if self.mode == "hang":
+            await asyncio.sleep(60)
+        return True
+
+    async def stop(self):
+        self.stopped = True
+
+
+def test_local_connector_drain_refunds_on_timeout(run):
+    """A scale-down whose drain times out must refund the replica (never
+    drop in-flight work): the pool keeps the handle, forced_kills counts
+    the refused kill, and a later round can retry."""
+
+    async def body():
+        handles = [_DrainHandle(), _DrainHandle()]
+        it = iter(handles)
+
+        async def factory():
+            return next(it)
+
+        conn = LocalConnector({"decode": factory}, drain_timeout_s=0.05)
+        await conn.add_worker("decode")
+        await conn.add_worker("decode")
+        handles[1].mode = "hang"
+        await conn.remove_worker("decode")  # LIFO victim hangs draining
+        assert conn.worker_count("decode") == 2  # refunded
+        assert conn.forced_kills == 1
+        assert not handles[1].stopped
+        handles[1].mode = "ok"
+        await conn.remove_worker("decode")  # retry drains cleanly
+        assert conn.worker_count("decode") == 1
+        assert handles[1].stopped
+
+    run(body())
+
+
+def test_local_connector_standby_promotion(run):
+    """add_worker promotes a pre-warmed spare (no cold start on the scaling
+    path) and replenishes the standby pool."""
+
+    async def body():
+        built = []
+
+        async def factory():
+            h = _DrainHandle()
+            built.append(h)
+            return h
+
+        conn = LocalConnector(
+            {"decode": factory}, standby_spares=1
+        )
+        await conn.prewarm("decode")
+        assert len(built) == 1 and conn.worker_count("decode") == 0
+        await conn.add_worker("decode")
+        assert conn.worker_count("decode") == 1
+        assert conn.workers["decode"][0] is built[0]  # the spare, promoted
+        assert len(conn.spares["decode"]) == 1  # replenished
+        assert len(built) == 2
+
+    run(body())
+
+
+def test_local_connector_victim_source_picks_named_handle(run):
+    async def body():
+        handles = [_DrainHandle(), _DrainHandle(), _DrainHandle()]
+        it = iter(handles)
+
+        async def factory():
+            return next(it)
+
+        conn = LocalConnector(
+            {"decode": factory},
+            victim_source=lambda kind, pool: pool[0],
+        )
+        for _ in range(3):
+            await conn.add_worker("decode")
+        await conn.remove_worker("decode")
+        assert handles[0].stopped  # victim source chose the oldest
+        assert conn.workers["decode"] == handles[1:]
+
+    run(body())

@@ -195,3 +195,38 @@ def test_parked_replica_rearms_on_scale(run, tmp_path):
             await sup.stop()
 
     run(body())
+
+
+def test_planner_intent_scale_resets_flaps(run):
+    """scale(planner_intent=True) is a deliberate controller decision, not
+    crash recovery: flap counters on surviving replicas reset so a
+    planner-grown pool never inherits incident-era backoff debt, and the
+    intent is counted for observability."""
+
+    async def body():
+        sup = Supervisor()
+        sup.add_watcher(
+            "w", [sys.executable, "-c", "import time; time.sleep(60)"],
+            replicas=1,
+        )
+        await sup.start()
+        try:
+            w = sup.watchers["w"]
+            for _ in range(100):
+                if w._procs:
+                    break
+                await asyncio.sleep(0.05)
+            w._procs[0].flaps = 3  # flapped during an incident
+            await sup.scale("w", 2, planner_intent=True)
+            assert w.planner_scales == 1
+            assert w._procs[0].flaps == 0
+            assert sup.replica_count("w") == 2
+            # a plain (crash-path) scale leaves flap state alone
+            w._procs[0].flaps = 2
+            await sup.scale("w", 1)
+            assert w.planner_scales == 1
+            assert w._procs[0].flaps == 2
+        finally:
+            await sup.stop()
+
+    run(body())
