@@ -815,6 +815,232 @@ async def run_slo_rig(scale: str = "smoke") -> dict:
     return out
 
 
+async def run_prefix_economy(scale: str = "smoke") -> dict:
+    """Fleet KV economy proof rig (ISSUE 20): cold-worker TTFT on a long
+    shared prefix, three ways.
+
+    A warm worker W serves the prefix, mirrors its host-tier evictions
+    into a fleet G4 blob store, then churns until the prefix is fully
+    off-device.  Two cold workers answer the same prompt: R recomputes
+    the whole prefill; C fetches the prefix frames from the G4 store
+    through the offload onboarding plane and prefills only the suffix.
+    All three engines share one weight seed, so token identity across
+    warm-local / recompute / G4-fetch is asserted outright -- greedy AND
+    per-request-seeded sampling.
+
+    The acceptance lines: ``prefix_econ_ttft_g4_fetch_ms`` strictly below
+    ``prefix_econ_ttft_recompute_ms`` (the economy's premise), the fleet
+    prefix hit rate, ``kv_g4_gbps`` from the transfer telemetry, and the
+    router gate's decision evidence (both cost estimates, the JSONL row
+    bench consumers scrape)."""
+    from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+    from dynamo_tpu.llm.kv_router.indexer import REMOTE_SOURCE_ID
+    from dynamo_tpu.llm.kv_router.router import KvPushRouter
+    from dynamo_tpu.llm.prefix_onboard import PrefixOnboardEngine
+    from dynamo_tpu.offload import InMemoryBlobStore
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.tokens.sequence import TokenBlockSequence
+
+    shapes = {
+        # CPU-sized smoke: a 64-block (256-token) shared prefix on a
+        # 4-layer/128-hidden tiny variant -- deep enough that recomputing
+        # the prefix prefill measurably loses to fetching its KV frames
+        "smoke": dict(page=4, prefix_blocks=64, sfx=4, pages=160,
+                      max_seq=320, max_tokens=6),
+        # slow-lane shape: the bench model, 32-block (512-token) prefix
+        "full": dict(page=16, prefix_blocks=32, sfx=16, pages=640,
+                     max_seq=1024, max_tokens=16),
+    }
+    shp = shapes[scale]
+    page, n_prefix, sfx = shp["page"], shp["prefix_blocks"], shp["sfx"]
+    plen = n_prefix * page
+
+    def mk_engine(host_blocks: int):
+        if scale == "smoke":
+            cfg = EngineConfig(
+                max_batch_size=2,
+                max_seq_len=shp["max_seq"],
+                page_size=page,
+                num_pages=shp["pages"],
+                host_offload_blocks=host_blocks,
+                seed=0,
+            )
+            model = ModelConfig.tiny(
+                hidden_size=128,
+                intermediate_size=256,
+                num_layers=4,
+                num_heads=8,
+                num_kv_heads=4,
+                max_position=1024,
+            )
+            return JaxEngine.random_init(model, cfg)
+        return build_engine(
+            max_batch_size=2,
+            num_pages=shp["pages"],
+            max_seq_len=shp["max_seq"],
+            host_offload_blocks=host_blocks,
+        )
+
+    # deterministic token streams; co-prime strides keep block hashes
+    # distinct across the prefixes, suffixes, warmups and churn prompts
+    pfx = [(7 * i) % 197 + 1 for i in range(plen)]
+    pfx2 = [(11 * i) % 193 + 1 for i in range(plen)]
+    sfx_t = [(3 * i) % 50 + 20 for i in range(sfx)]
+    sfx_b = [(5 * i) % 50 + 90 for i in range(sfx)]
+    sfx_c = [(7 * i) % 50 + 150 for i in range(sfx)]
+    warm0 = [(13 * i) % 191 + 1 for i in range(plen + sfx)]
+    pstar = pfx + sfx_t
+
+    async def run_one(engine, tokens, *, temperature=0.0, seed=None):
+        """Returns (ttft_seconds, output_tokens) for one request."""
+        r = PreprocessedRequest(
+            token_ids=list(tokens),
+            stop_conditions=StopConditions(max_tokens=shp["max_tokens"]),
+            sampling_options=SamplingOptions(
+                temperature=temperature, seed=seed
+            ),
+        )
+        t0 = time.perf_counter()
+        stream = await engine.generate(Context.new(r))
+        ttft, out = None, []
+        async for item in stream:
+            data = item.data or {}
+            toks = data.get("token_ids") or []
+            if toks and ttft is None:
+                ttft = time.perf_counter() - t0
+            out.extend(toks)
+        return ttft, out
+
+    store = InMemoryBlobStore()
+
+    # ---- W: the warm worker -- serves, measures warm-local, publishes ----
+    w = mk_engine(host_blocks=4 * n_prefix)
+    try:
+        w.offload_engine.attach_remote(
+            store, worker_id=1, namespace="bench", mirror=True
+        )
+        bs = w.sched.block_size
+        pfx_hashes = TokenBlockSequence(pfx, block_size=bs).sequence_hashes()
+        pfx2_hashes = TokenBlockSequence(pfx2, block_size=bs).sequence_hashes()
+        await run_one(w, warm0)  # compile the prefill bucket + decode
+        _, tok_warm = await run_one(w, pstar)
+        # compile the cached-prefix suffix-prefill bucket off the clock
+        await run_one(w, pfx + sfx_c)
+        # warm-local TTFT: same prefix, different suffix, all blocks G1
+        ttft_warm, _ = await run_one(w, pfx + sfx_b)
+        _, stok_warm = await run_one(w, pstar, temperature=0.8, seed=7)
+        await run_one(w, pfx2 + sfx_t)  # the fetch leg's warmup prefix
+        pool = w.sched.pool
+        remote = w.offload_engine.remote
+        all_hashes = [*pfx_hashes, *pfx2_hashes]
+        for i in range(32):
+            w.offload_engine.drain()
+            resident = sum(1 for h in all_hashes if pool.is_registered(h))
+            if resident == 0 and all(remote.contains(h) for h in all_hashes):
+                break
+            churn = [
+                (29 * j + 37 * i) % 180 + 1 for j in range(plen + sfx)
+            ]
+            await run_one(w, churn)
+        w.offload_engine.drain()
+        published = sum(1 for h in pfx_hashes if remote.contains(h))
+        g4_bytes = sum(
+            len(store.get(f"kv/bench/{h & (2**64 - 1):016x}") or b"")
+            for h in pfx_hashes
+        )
+    finally:
+        await w.stop()
+
+    # ---- R: cold recompute -- no shared blocks, full prefill ----
+    r_eng = mk_engine(host_blocks=0)
+    try:
+        await run_one(r_eng, warm0)  # compile: same bucket, no shared prefix
+        ttft_rec, tok_rec = await run_one(r_eng, pstar)
+        _, stok_rec = await run_one(r_eng, pstar, temperature=0.8, seed=7)
+    finally:
+        await r_eng.stop()
+
+    # ---- C: cold fetch -- G4 frames through the onboarding plane ----
+    c = mk_engine(host_blocks=4 * n_prefix)
+    try:
+        c_remote = c.offload_engine.attach_remote(
+            store, worker_id=2, namespace="bench", mirror=False
+        )
+        onboarder = PrefixOnboardEngine.__new__(PrefixOnboardEngine)
+        onboarder.inner = c
+        onboarder.engine = c
+        onboarder.onboarded_blocks = 0
+        onboarder.failed_fetches = 0
+        await run_one(c, warm0)  # compile the prefill bucket + decode
+        # warm the fetch+scatter+suffix-prefill paths on the OTHER prefix
+        await onboarder._onboard_remote([int(h) for h in pfx2_hashes])
+        await run_one(c, pfx2 + sfx_t)
+        # the gate's verdict for this donor, priced with the real bytes
+        gate = KvPushRouter(
+            None,
+            c.sched,  # duck-typed: the gate only reads .block_size
+            remote_spec={"prefill_tok_s": 2000.0, "gbps": 1.0},
+        )
+        gate_row = gate._gate_donor(
+            "bench-prefix-economy",
+            2,
+            0,
+            {
+                "instance": REMOTE_SOURCE_ID,
+                "blocks": n_prefix,
+                "source": "remote",
+                "nbytes": g4_bytes,
+            },
+        )
+        # measured leg: TTFT includes the G4 fetch + host put + the
+        # suffix-only prefill -- exactly what a routed request pays
+        t0 = time.perf_counter()
+        await onboarder._onboard_remote([int(h) for h in pfx_hashes])
+        onboard_s = time.perf_counter() - t0
+        gen_ttft, tok_fetch = await run_one(c, pstar)
+        ttft_fetch = onboard_s + (gen_ttft or 0.0)
+        _, stok_fetch = await run_one(c, pstar, temperature=0.8, seed=7)
+        fetch_stats = dict(c_remote.stats())
+    finally:
+        await c.stop()
+
+    fetched = int(onboarder.onboarded_blocks)
+    return {
+        "prefix_econ_scale": scale,
+        "prefix_econ_prefix_tokens": plen,
+        "prefix_econ_ttft_warm_local_ms": round(ttft_warm * 1e3, 2),
+        "prefix_econ_ttft_recompute_ms": round(ttft_rec * 1e3, 2),
+        "prefix_econ_ttft_g4_fetch_ms": round(ttft_fetch * 1e3, 2),
+        "prefix_econ_g4_onboard_ms": round(onboard_s * 1e3, 2),
+        "prefix_econ_published_blocks": published,
+        "prefix_econ_fetched_blocks": fetched,
+        # both onboard passes (warmup prefix + measured prefix) count:
+        # every block the fleet needed that G4 actually delivered
+        "prefix_econ_fleet_prefix_hit_rate": round(
+            fetched / (2 * n_prefix), 3
+        ),
+        "prefix_econ_failed_fetches": int(onboarder.failed_fetches),
+        "prefix_econ_g4_bytes": g4_bytes,
+        "prefix_econ_kv_g4_gbps": fetch_stats.get("kv_g4_gbps"),
+        "prefix_econ_token_identity_greedy": (
+            tok_fetch == tok_rec == tok_warm
+        ),
+        "prefix_econ_token_identity_seeded": (
+            stok_fetch == stok_rec == stok_warm
+        ),
+        "prefix_econ_gate_decision": gate_row["decision"],
+        "prefix_econ_gate_source": gate_row["source"],
+        "prefix_econ_gate_pred_fetch_ms": gate_row["pred_fetch_ms"],
+        "prefix_econ_gate_pred_prefill_ms": gate_row["pred_prefill_ms"],
+        "prefix_econ_gate_ship_bytes": gate_row["ship_bytes"],
+    }
+
+
 async def run_decode_sweep(rs) -> dict:
     """Decode throughput at larger batches on a 64-lane engine (the bs=8
     headline engine stays separate for round-over-round comparability).
@@ -1801,6 +2027,7 @@ async def main():
     long_ctx = await run_long_context(rs)
     host_pipe = await run_host_pipeline(rs)
     slo_rig = await run_slo_rig(scale="full")
+    prefix_econ = await run_prefix_economy(scale="full")
     disagg_tok_s, _dev_stats = await run_disagg(rs, allow_local=True)
     disagg_wire_tok_s, wire_stats = await run_disagg(rs, allow_local=False)
 
@@ -1847,6 +2074,7 @@ async def main():
                 **long_ctx,
                 **host_pipe,
                 **slo_rig,
+                **prefix_econ,
                 **serving,
             }
         )

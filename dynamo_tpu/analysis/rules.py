@@ -893,8 +893,11 @@ class OffloadSyncTransfer(Rule):
 
     @staticmethod
     def _copy_helpers(module: ModuleInfo) -> Set[str]:
-        """Function names listed in the module-level ``COPY_HELPERS``
-        assignment (tuple/list/set of string literals)."""
+        """Function names -- or dotted qualnames like
+        ``RemoteTier._put``, pinning one method of a class whose other
+        methods stay checked -- listed in the module-level
+        ``COPY_HELPERS`` assignment (tuple/list/set of string
+        literals)."""
         out: Set[str] = set()
         for node in module.tree.body:
             if not isinstance(node, ast.Assign):
@@ -917,7 +920,7 @@ class OffloadSyncTransfer(Rule):
             return
         helpers = self._copy_helpers(module)
         for fi in collect_functions(module.tree):
-            if fi.name in helpers:
+            if fi.name in helpers or fi.qualname in helpers:
                 continue
             for node in own_body_walk(fi.node):
                 if not isinstance(node, ast.Call):

@@ -80,6 +80,7 @@ ROLE_TICK_CORO = "tick-coro"
 ROLE_FANOUT = "fanout-worker"
 ROLE_EVENT_LOOP = "event-loop"
 ROLE_KV_OFFLOAD = "kv-offload"
+ROLE_KV_REMOTE = "kv-remote"
 ROLE_HUB_IO = "hub-io"
 ROLE_WORKER = "worker"
 
@@ -88,6 +89,7 @@ EXECUTOR_PREFIX_ROLES: Dict[str, str] = {
     "jax-engine": ROLE_TICK,
     "hub-journal": ROLE_HUB_IO,
     "kv-offload": ROLE_KV_OFFLOAD,
+    "kv-remote": ROLE_KV_REMOTE,
 }
 
 # roles that are cooperatively scheduled on the one event-loop thread:
@@ -172,6 +174,20 @@ THREAD_ROLE_MANIFEST: Dict[str, Dict[str, str]] = {
     "dynamo_tpu/runtime/transports/hub.py": {
         # journal close on the WAL writer (bound method of a file handle)
         "self.journal.close": ROLE_HUB_IO,
+        # blob-store disk verbs ride the journal's I/O executor
+        # (attach_disk receives journal._io; thread_sentry asserts the
+        # role on entry); the in-RAM variants are loop-resident
+        "HubBlobStore.put_sync": ROLE_HUB_IO,
+        "HubBlobStore.get_sync": ROLE_HUB_IO,
+        "HubBlobStore.del_sync": ROLE_HUB_IO,
+    },
+    "dynamo_tpu/offload.py": {
+        # G4 blob-store calls ride duck-typed store handles (hub blob
+        # client / InMemoryBlobStore) inference cannot resolve; the
+        # kv-remote executor owns them by construction (RemoteTier._put
+        # and _get assert the role on entry)
+        "self.store.put": ROLE_KV_REMOTE,
+        "self.store.get": ROLE_KV_REMOTE,
     },
     "dynamo_tpu/cli.py": {
         # interactive stdin reads ride the default pool; stdlib handle

@@ -128,6 +128,12 @@ def _add_engine_flags(p) -> None:
                    help="G3 disk KV offload capacity (blocks); 0 = off")
     p.add_argument("--disk-offload-dir",
                    help="directory for G3 disk offload files")
+    p.add_argument("--kv-remote", default=None, metavar="SPEC",
+                   help="G4 fleet KV store tier: 'on', or "
+                        "'mirror=1,fetch=1,prefill_tok_s=4000,gbps=1.0,"
+                        "namespace=dynamo' (offload.parse_kv_remote_spec); "
+                        "requires the offload plane armed and a hub; env "
+                        "DYN_KV_REMOTE wins")
     p.add_argument("--no-swap-preemption", dest="swap_preemption",
                    action="store_false", default=True,
                    help="disable swap-based preemption (offload the "
@@ -471,6 +477,7 @@ async def _make_engine(args):
         disk_offload_blocks=args.disk_offload_blocks,
         disk_offload_dir=args.disk_offload_dir,
         swap_preemption=args.swap_preemption,
+        kv_remote=args.kv_remote,
         packed_ragged=args.packed_ragged,
         kv_admit_budget=args.kv_admit_budget,
         quantize=args.quantize,
@@ -631,11 +638,25 @@ async def run_http_frontend(args) -> None:
     addr, owned_hub = await _resolve_hub(args)
     runtime = await DistributedRuntime.detached(addr)
     manager = ModelManager()
+    # fleet observatory: ingest every worker's telemetry snapshots off the
+    # hub and surface them at GET /fleet (+ the dynamo_fleet_* families).
+    # Built before the router factory: the KV router's quarantine filter
+    # and fetch-vs-recompute gate read its live link/straggler state.
+    from .fleet import FleetObservatory
+
+    observatory = FleetObservatory()
     if args.router_mode == "kv":
         from .llm.backend import Backend
         from .llm.kv_router.router import KvPushRouter, KvRouter
         from .llm.preprocessor import OpenAIPreprocessor
+        from .offload import env_remote_spec
         from .runtime.pipeline import link
+
+        try:
+            remote_spec = env_remote_spec()
+        except ValueError:
+            logger.warning("ignoring malformed DYN_KV_REMOTE")
+            remote_spec = None
 
         async def kv_factory(entry, card, client, router):
             ns = runtime.namespace(entry.namespace)
@@ -643,13 +664,18 @@ async def run_http_frontend(args) -> None:
             chooser = KvRouter(
                 ns, comp, block_size=card.kv_block_size,
                 index_shards=args.router_index_shards,
+                quarantine=observatory.quarantine_source(),
             )
             await chooser.start()
             tokenizer = card.tokenizer()
             engine = link(
                 OpenAIPreprocessor(entry.name, tokenizer),
                 Backend(tokenizer),
-                KvPushRouter(router, chooser),
+                KvPushRouter(
+                    router, chooser,
+                    transfer_ms=observatory.predict_transfer_ms,
+                    remote_spec=remote_spec,
+                ),
             )
             return engine, chooser.stop  # watcher stops the chooser w/ model
 
@@ -659,11 +685,6 @@ async def run_http_frontend(args) -> None:
             runtime, manager, router_mode=RouterMode(args.router_mode)
         )
     await watcher.start()
-    # fleet observatory: ingest every worker's telemetry snapshots off the
-    # hub and surface them at GET /fleet (+ the dynamo_fleet_* families)
-    from .fleet import FleetObservatory
-
-    observatory = FleetObservatory()
     await observatory.start(runtime.namespace("dynamo"))
     service = HttpService(
         manager, host=args.host, port=args.port,
@@ -764,6 +785,23 @@ async def run_worker(args) -> None:
         )
     pub = KvEventPublisher(ns, worker_id=runtime.primary_lease)
     pub.hook(engine)
+    # fleet KV economy: arm the G4 tier over the hub blob verbs when the
+    # engine parsed a kv_remote spec, and publish tier-residency deltas
+    # whenever the offload plane exists at all (peer host/disk holdings
+    # feed the cluster-global prefix index even without G4)
+    holdings_pub = None
+    if getattr(engine, "offload_engine", None) is not None:
+        from .llm.kv_router.publisher import KvHoldingsPublisher
+
+        if getattr(engine, "kv_remote_spec", None) is not None:
+            from .runtime.transports.client import HubBlobClient
+
+            engine.attach_remote_kv(
+                HubBlobClient(runtime.hub, asyncio.get_running_loop()),
+                worker_id=runtime.primary_lease,
+            )
+        holdings_pub = KvHoldingsPublisher(ns, worker_id=runtime.primary_lease)
+        holdings_pub.hook(engine)
     metrics_pub = WorkerMetricsPublisher(engine.metrics)
     await metrics_pub.attach(comp)
     # fleet plane: identity-label this worker's exposition and publish
@@ -804,6 +842,8 @@ async def run_worker(args) -> None:
         if prefill_worker is not None:
             await prefill_worker.stop()
         await telemetry_pub.stop(final=False)
+        if holdings_pub is not None:
+            await holdings_pub.close()
         await pub.close()
         await engine.stop()
         await runtime.shutdown()

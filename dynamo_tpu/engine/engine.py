@@ -289,6 +289,15 @@ class EngineConfig:
     host_offload_blocks: int = 0
     disk_offload_blocks: int = 0
     disk_offload_dir: Optional[str] = None
+    # G4 remote tier (fleet KV economy): spec per
+    # offload.parse_kv_remote_spec -- "on", or
+    # "mirror=1,fetch=1,prefill_tok_s=4000,gbps=1.0,namespace=prod".
+    # The parsed spec is held on the engine (``kv_remote_spec``); the
+    # actual store attaches at serve wiring via ``attach_remote_kv``
+    # (config alone cannot name a live hub connection).  Requires the
+    # offload plane armed -- G4 hangs off its eviction/onboard flow.
+    # DYN_KV_REMOTE env wins; malformed env warns and keeps config.
+    kv_remote: Optional[str] = None
     # swap-based preemption (FlowKV, arXiv:2504.03775): a capacity-preempted
     # lane's KV is offloaded and restored through the chunked scatter path
     # instead of re-prefilled.  Effective only when the offload plane is
@@ -724,6 +733,9 @@ class JaxEngine:
             self.params = quantize_params(self.params, model_cfg)
         # KV event sink: fn(event_dict) -- wired to the router event publisher
         self.kv_event_sink: Optional[Callable[[Dict[str, Any]], None]] = None
+        # holdings sink: fn(event_dict) -- wired to KvHoldingsPublisher;
+        # fed tier-residency deltas from the offload plane (fleet KV economy)
+        self.kv_holdings_sink: Optional[Callable[[Dict[str, Any]], None]] = None
         block_size = self.cfg.block_size or self.cfg.page_size
         pool: Optional[PagePool] = None
         if self.cfg.enable_prefix_caching:
@@ -852,10 +864,34 @@ class JaxEngine:
                 registry=metrics_registry,
             )
             self.offload = self.offload_engine.host
+            self.offload_engine.holdings_cb = self._emit_kv_holdings
             pool.on_evict = self._on_pool_evict
             self.sched.offload_lookup = self._offload_lookup
             if swap_on:
                 self.sched.swap_out = self._swap_out
+        # G4 remote tier spec (fleet KV economy): parsed now, attached at
+        # serve wiring (attach_remote_kv) once a hub blob client exists.
+        # Same env-knob contract as the rest of the plane: DYN_KV_REMOTE
+        # wins over config; a malformed env value warns and keeps config.
+        from ..offload import env_remote_spec, parse_kv_remote_spec
+
+        self.kv_remote_spec: Optional[Dict[str, Any]] = None
+        try:
+            self.kv_remote_spec = parse_kv_remote_spec(self.cfg.kv_remote or "")
+        except ValueError:
+            logger.warning(
+                "ignoring malformed kv_remote config %r", self.cfg.kv_remote
+            )
+        if "DYN_KV_REMOTE" in _os.environ:
+            # env wins outright, including an explicit "off" disarming a
+            # config-armed tier
+            try:
+                self.kv_remote_spec = env_remote_spec()
+            except ValueError:
+                logger.warning(
+                    "ignoring malformed DYN_KV_REMOTE=%r",
+                    _os.environ.get("DYN_KV_REMOTE"),
+                )
         # chunked prefill restarts at page-aligned offsets: normalize the
         # configured chunk up to a whole page so an intermediate chunk can
         # never overrun the remaining prompt (trigger and dispatch both use
@@ -5414,6 +5450,58 @@ class JaxEngine:
                 loop.call_soon_threadsafe(sink, event)
             except RuntimeError:
                 pass  # loop already closed during shutdown
+
+    def _emit_kv_holdings(self, delta) -> None:
+        """Offload-plane holdings_cb -> the externally-wired
+        kv_holdings_sink (fleet KV economy).
+
+        Deltas fire on the offload / kv-remote threads; the sink
+        (KvHoldingsPublisher.emit uses an asyncio.Queue) is not
+        thread-safe, so emissions hop to the engine's loop exactly like
+        ``_emit_kv_event``.  Tuple rows ``(hash, tier|None, nbytes)``
+        become wire rows ``{"sequence_hash", "tier", "nbytes"}``."""
+        sink = self.kv_holdings_sink
+        if sink is None:
+            return
+        event = {
+            "type": "holdings",
+            "delta": [
+                {"sequence_hash": int(h), "tier": tier, "nbytes": int(n)}
+                for h, tier, n in delta
+            ],
+        }
+        loop = self._loop
+        if loop is None:
+            sink(event)
+            return
+        try:
+            on_loop = asyncio.get_running_loop() is loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            sink(event)
+        else:
+            try:
+                loop.call_soon_threadsafe(sink, event)
+            except RuntimeError:
+                pass  # loop already closed during shutdown
+
+    def attach_remote_kv(
+        self, store, *, worker_id: int = 0, namespace: str = "dynamo"
+    ) -> None:
+        """Arm the G4 remote tier on the offload plane (fleet KV economy).
+
+        ``store`` is any blob store with put/get (offload.InMemoryBlobStore,
+        runtime.transports.client.HubBlobClient).  No-op unless the offload
+        plane and a parsed ``kv_remote`` spec are both armed."""
+        if self.offload_engine is None or self.kv_remote_spec is None:
+            return
+        self.offload_engine.attach_remote(
+            store,
+            worker_id=worker_id,
+            namespace=str(self.kv_remote_spec.get("namespace", namespace)),
+            mirror=bool(self.kv_remote_spec.get("mirror", True)),
+        )
 
     def _publish_stored(self, seq: SeqState, blocks: List[TokenBlock]) -> None:
         if self.kv_event_sink is None:

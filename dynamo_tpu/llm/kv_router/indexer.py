@@ -192,11 +192,129 @@ class _NativeIndex:
         return self._lib.dyn_radix_num_workers(self._ptr)
 
 
+# Pseudo worker id the G4 fleet store answers prefix_sources under
+# (mirrors offload.G4_STORE_ID without importing the offload plane)
+REMOTE_SOURCE_ID = -4
+
+
+class HoldingsIndex:
+    """Cluster-global offload-tier holdings: which worker parks which
+    block in which tier (G2 host / G3 disk / G4 remote), at what size.
+
+    The G1 index above answers "route to the warm worker"; this one
+    answers "fetch the prefix from a peer's tiers or from the G4 store".
+    Fed by the workers' ``kv_holdings`` topic (tier residency deltas from
+    the offload plane -- every put/promote/demote/evict publishes, so
+    the index never advertises a tier a worker already dropped).
+
+    ``tier == "remote"`` adverts are keyed under :data:`REMOTE_SOURCE_ID`
+    rather than the publishing worker: a blob in the fleet store is
+    fetchable regardless of which worker uploaded it, and its lifecycle
+    is the STORE's, not the uploader's -- the worker later evicting its
+    own host copy (a ``tier=None`` row) or dying must not wipe the G4
+    advert while the blob still sits in the store.  A stale G4 advert
+    (the store LRU'd the blob out) self-heals as a fetch miss: the
+    onboarder recomputes, and the fetching tier forgets the hash.
+    Single-threaded by contract, like the owning indexer."""
+
+    def __init__(self) -> None:
+        # hash -> {source_id: (tier, nbytes)}; source is the holding
+        # worker, or REMOTE_SOURCE_ID for fleet-store entries
+        self._by_hash: Dict[int, Dict[int, tuple]] = {}
+        self._by_worker: Dict[int, Set[int]] = {}
+
+    def apply(self, worker_id: int, delta: Sequence[Dict]) -> None:
+        """Merge one holdings delta: rows ``{"sequence_hash", "tier",
+        "nbytes"}``; ``tier=None`` drops the worker's entry (never the
+        fleet store's -- see the class docstring)."""
+        worker_id = int(worker_id)
+        mine = self._by_worker.setdefault(worker_id, set())
+        for row in delta:
+            try:
+                h = int(row["sequence_hash"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            tier = row.get("tier")
+            if tier is None:
+                holders = self._by_hash.get(h)
+                if holders is not None:
+                    holders.pop(worker_id, None)
+                    if not holders:
+                        del self._by_hash[h]
+                mine.discard(h)
+            else:
+                src = REMOTE_SOURCE_ID if tier == "remote" else worker_id
+                self._by_hash.setdefault(h, {})[src] = (
+                    str(tier),
+                    int(row.get("nbytes") or 0),
+                )
+                if src == worker_id:
+                    mine.add(h)
+        if not mine:
+            self._by_worker.pop(worker_id, None)
+
+    def remove_worker(self, worker_id: int) -> None:
+        """Forget a dead worker's own-tier holdings.  Its G4 adverts stay:
+        the store outlives the worker and the blobs remain fetchable."""
+        mine = self._by_worker.pop(int(worker_id), None)
+        if not mine:
+            return
+        for h in mine:
+            holders = self._by_hash.get(h)
+            if holders is not None:
+                holders.pop(int(worker_id), None)
+                if not holders:
+                    del self._by_hash[h]
+
+    def holders(self, seq_hash: int) -> Dict[int, tuple]:
+        return dict(self._by_hash.get(int(seq_hash), {}))
+
+    def prefix_sources(
+        self, sequence_hashes: Sequence[int], exclude: Sequence[int] = ()
+    ) -> Dict[int, Dict[str, int]]:
+        """Per-source contiguous-prefix holdings over the request's block
+        chain: ``{source_id: {"blocks": n, "nbytes": total, "tier": t}}``
+        where ``blocks`` counts how many leading chain blocks the source
+        holds contiguously from position 0 (prefix chains are only usable
+        contiguously, same contract as the offload prefetch walk).  G4
+        entries aggregate under ``REMOTE_SOURCE_ID``; ``exclude`` drops
+        candidate workers (the chosen worker itself, quarantined ids)."""
+        excluded = {int(w) for w in exclude}
+        out: Dict[int, Dict[str, int]] = {}
+        for i, h in enumerate(sequence_hashes):
+            holders = self._by_hash.get(int(h))
+            if not holders:
+                break  # nobody holds position i: deeper blocks unusable
+            for worker_id, (tier, nbytes) in holders.items():
+                src = REMOTE_SOURCE_ID if tier == "remote" else worker_id
+                if src != REMOTE_SOURCE_ID and src in excluded:
+                    continue
+                ent = out.get(src)
+                if ent is None:
+                    if i == 0:
+                        out[src] = {"blocks": 1, "nbytes": nbytes, "tier": tier}
+                elif ent["blocks"] == i:
+                    ent["blocks"] = i + 1
+                    ent["nbytes"] += nbytes
+        return {s: e for s, e in out.items() if e["blocks"] > 0}
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._by_hash)
+
+    @property
+    def num_workers(self) -> int:
+        return len(self._by_worker)
+
+
 class KvIndexer:
     """The router-side global KV-block index.
 
     Consumes worker KV events (``stored`` / ``removed`` / ``cleared``) and
-    answers ``find_matches`` queries with per-worker overlap scores.
+    answers ``find_matches`` queries with per-worker overlap scores.  The
+    attached :class:`HoldingsIndex` extends the view below G1: holdings
+    events (``holdings`` / ``holdings_cleared``) track which offload tier
+    parks which block fleet-wide.
     """
 
     def __init__(self, block_size: int = 16, use_native: bool = True) -> None:
@@ -208,6 +326,7 @@ class KvIndexer:
             else _PyIndex()
         )
         self.native = isinstance(self._index, _NativeIndex)
+        self.holdings = HoldingsIndex()
 
     # -- event ingestion -----------------------------------------------------
 
@@ -218,6 +337,9 @@ class KvIndexer:
           {"type": "stored", "blocks": [{"sequence_hash": h, ...}, ...]}
           {"type": "removed", "sequence_hashes": [h, ...]}
           {"type": "cleared"}
+        plus the offload plane's tier-residency stream (KvHoldingsPublisher):
+          {"type": "holdings", "delta": [{"sequence_hash", "tier", "nbytes"}]}
+          {"type": "holdings_cleared"}  (publisher overflow collapse)
         """
         etype = event.get("type")
         if etype == "stored":
@@ -229,10 +351,16 @@ class KvIndexer:
             )
         elif etype == "cleared":
             self._index.remove_worker(worker_id)
+            self.holdings.remove_worker(worker_id)
+        elif etype == "holdings":
+            self.holdings.apply(worker_id, event.get("delta", []))
+        elif etype == "holdings_cleared":
+            self.holdings.remove_worker(worker_id)
 
     def remove_worker(self, worker_id: int) -> None:
         """Drop every entry of a dead worker (reference indexer.rs:382)."""
         self._index.remove_worker(worker_id)
+        self.holdings.remove_worker(worker_id)
 
     # -- queries -------------------------------------------------------------
 
@@ -289,6 +417,9 @@ class KvIndexerSharded:
             ]
         self._assignment: Dict[int, int] = {}  # worker -> shard
         self._counts = [0] * num_shards
+        # ONE wrapper-level holdings index (tier adverts are tiny next to
+        # G1 block sets; sharding them would force a merge per query)
+        self.holdings = HoldingsIndex()
         self._pool = None
         if self.shards[0].native and num_shards > 1:
             import concurrent.futures
@@ -307,15 +438,23 @@ class KvIndexerSharded:
         return s
 
     def apply_event(self, worker_id: int, event: Dict) -> None:
-        if event.get("type") == "cleared":
+        etype = event.get("type")
+        if etype == "cleared":
             # the flat index forgets the worker entirely on "cleared"; the
             # assignment and load count must follow, or dead-cleared
             # workers skew least-loaded pinning forever
             self.remove_worker(worker_id)
             return
+        if etype == "holdings":
+            self.holdings.apply(worker_id, event.get("delta", []))
+            return
+        if etype == "holdings_cleared":
+            self.holdings.remove_worker(worker_id)
+            return
         self.shards[self._shard_of(worker_id)].apply_event(worker_id, event)
 
     def remove_worker(self, worker_id: int) -> None:
+        self.holdings.remove_worker(worker_id)
         s = self._assignment.pop(worker_id, None)
         if s is not None:
             self._counts[s] -= 1

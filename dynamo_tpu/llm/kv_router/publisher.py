@@ -92,6 +92,73 @@ class KvEventPublisher:
             self._task = None
 
 
+class KvHoldingsPublisher:
+    """Forwards offload-tier holdings deltas to the event plane.
+
+    Rides the same ``{ns}.events.kv_events`` subject as the G1 publisher
+    -- the indexer dispatches on ``event["type"]`` (``holdings`` /
+    ``holdings_cleared``), so no extra subscription is needed router-side.
+    Attach with ``publisher.hook(engine)``: it installs itself as the
+    engine's ``kv_holdings_sink`` (fed from the offload thread via the
+    engine's loop hop).
+
+    Overflow policy differs from the G1 publisher: a dropped ``tier=None``
+    row would leave the cluster-global index advertising a tier the worker
+    already dropped (a fetch that can only miss), so a full queue
+    collapses the backlog into one ``holdings_cleared`` resync -- the
+    index forgets this worker's tiers until fresh deltas rebuild them.
+    """
+
+    def __init__(self, namespace: Namespace, worker_id: int) -> None:
+        self.namespace = namespace
+        self.worker_id = worker_id
+        self._queue: "asyncio.Queue[Dict[str, Any]]" = asyncio.Queue(maxsize=4096)
+        self._task: Optional[asyncio.Task] = None
+
+    def hook(self, engine: Any) -> None:
+        engine.kv_holdings_sink = self.emit
+        if self._task is None:
+            self._task = asyncio.create_task(
+                self._pump(), name="kv-holdings-pub"
+            )
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        try:
+            self._queue.put_nowait(event)
+        except asyncio.QueueFull:
+            logger.warning(
+                "kv holdings queue full; collapsing to holdings_cleared"
+            )
+            while not self._queue.empty():
+                try:
+                    self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            self._queue.put_nowait({"type": "holdings_cleared"})
+
+    async def _pump(self) -> None:
+        while True:
+            event = await self._queue.get()
+            try:
+                await self.namespace.publish(
+                    KV_EVENT_TOPIC,
+                    {"worker_id": self.worker_id, "event": event},
+                )
+            except Exception:
+                logger.exception("kv holdings publish failed")
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                logger.debug("publisher loop raised during close", exc_info=True)
+            self._task = None
+
+
 class WorkerMetricsPublisher:
     """Serves the engine's ``ForwardPassMetrics`` on a ``load_metrics``
     endpoint (single-item stream per request)."""

@@ -18,10 +18,12 @@ import asyncio
 import contextlib
 import json
 import logging
+import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 from ...protocols.common import PreprocessedRequest
 from ...runtime import metrics as rtm
+from ...runtime import tracing
 from ...runtime.component import (
     Component,
     InstanceNotFoundError,
@@ -31,7 +33,12 @@ from ...runtime.component import (
 from ...runtime.transports.request_plane import WorkerLostError
 from ...runtime.engine import Annotated, Context, ResponseStream
 from ...tokens.hashing import hash_blocks
-from .indexer import KvIndexer, KvIndexerSharded, OverlapScores
+from .indexer import (
+    KvIndexer,
+    KvIndexerSharded,
+    OverlapScores,
+    REMOTE_SOURCE_ID,
+)
 from .metrics_aggregator import KvMetricsAggregator
 from .scheduler import DefaultWorkerSelector, KvRouterConfig, KvScheduler
 
@@ -69,7 +76,9 @@ class KvRouter:
             self.indexer = KvIndexer(block_size=block_size)
         # quarantine: FleetObservatory.quarantine_source() -- stragglers
         # flagged by the fleet plane stop winning selections until their
-        # series recovers (scheduler.py weight-zeroing)
+        # series recovers (scheduler.py weight-zeroing); kept here too so
+        # donor selection never nominates a quarantined worker as a source
+        self._quarantine = quarantine
         self.scheduler = KvScheduler(
             block_size, DefaultWorkerSelector(config, quarantine=quarantine)
         )
@@ -155,25 +164,65 @@ class KvRouter:
 
     async def find_best_match_with_donor(
         self, tokens: Sequence[int]
-    ) -> Tuple[int, int, Optional[Tuple[int, int]]]:
+    ) -> Tuple[int, int, Optional[Dict[str, Any]]]:
         """Best-cost worker plus the best prefix *donor* when they differ.
 
         The cost function may send a request to a lightly-loaded worker even
         though another worker holds a longer cached prefix; that other
-        worker is the onboarding donor (G4 cross-worker block import,
-        reference block_manager.rs:119-146).  Returns ``(worker_id,
-        overlap_blocks, donor)`` with ``donor = (instance, blocks)`` or
+        worker is the onboarding donor (cross-worker block import,
+        reference block_manager.rs:119-146).  Donor candidates come from
+        two planes: the G1 overlap index (live device blocks on peers) and
+        the cluster-global holdings index (offload-tier copies -- peer
+        host/disk and the shared G4 store).  Quarantined workers never
+        donate; the G4 store cannot be quarantined away (it is a passive
+        object store, not a straggler candidate).
+
+        Returns ``(worker_id, overlap_blocks, donor)`` with ``donor`` a
+        dict ``{"instance", "blocks", "source": "peer"|"remote",
+        "nbytes"}`` (``nbytes`` None when only the G1 index knows the
+        prefix; ``instance`` is ``REMOTE_SOURCE_ID`` for the G4 store) or
         None when nobody beats the chosen worker's own cache."""
         _, seq_hashes = hash_blocks(tokens, self.block_size)
         overlap = self.indexer.find_matches(seq_hashes)
         worker_id = self.scheduler.schedule(overlap, len(tokens))
         own = overlap.scores.get(worker_id, 0)
-        donor: Optional[Tuple[int, int]] = None
+        quarantined: set = set()
+        q = getattr(self, "_quarantine", None)
+        if q is not None:
+            try:
+                quarantined = set(q())
+            except Exception:
+                logger.debug("quarantine source failed", exc_info=True)
+        donor: Optional[Dict[str, Any]] = None
         for w, blocks in overlap.scores.items():
-            if w != worker_id and blocks > own and (
-                donor is None or blocks > donor[1]
-            ):
-                donor = (w, blocks)
+            if w == worker_id or w in quarantined or blocks <= own:
+                continue
+            if donor is None or blocks > donor["blocks"]:
+                donor = {
+                    "instance": w,
+                    "blocks": blocks,
+                    "source": "peer",
+                    "nbytes": None,
+                }
+        holdings = getattr(self.indexer, "holdings", None)
+        if holdings is not None and holdings.num_blocks:
+            sources = holdings.prefix_sources(
+                seq_hashes, exclude={worker_id} | quarantined
+            )
+            for src, info in sources.items():
+                # strict improvement only: at equal coverage the G1 peer
+                # donor wins (its blocks are already device-resident)
+                if info["blocks"] <= own or (
+                    donor is not None and info["blocks"] <= donor["blocks"]
+                ):
+                    continue
+                donor = {
+                    "instance": src,
+                    "blocks": info["blocks"],
+                    "source": "remote" if src == REMOTE_SOURCE_ID else "peer",
+                    "nbytes": info["nbytes"],
+                    "tier": info["tier"],
+                }
         return worker_id, own, donor
 
 
@@ -181,17 +230,131 @@ class KvPushRouter:
     """PushRouter wrapper: best-match then ``direct()`` (reference
     kv_router.rs:220-255)."""
 
-    def __init__(self, inner: PushRouter, chooser: KvRouter) -> None:
+    # evidence ring: every gate evaluation appends a JSONL-able dict here
+    # (bench.py dumps it); bounded so long-lived routers don't grow forever
+    DECISION_LOG_CAP = 4096
+
+    def __init__(
+        self,
+        inner: PushRouter,
+        chooser: KvRouter,
+        *,
+        transfer_ms=None,
+        remote_spec: Optional[Dict[str, Any]] = None,
+    ) -> None:
         self.inner = inner
         self.chooser = chooser
         # routing decisions by cause: kv (best-match direct), kv_donor
-        # (best-match plus a cross-worker onboarding donor), and the two
-        # fallbacks -- the series smarter-routing work tunes against
-        self._decisions = rtm.default_registry().counter(
+        # (best-match plus a cross-worker onboarding donor), kv_remote
+        # (donor is the G4 store), and the two fallbacks -- the series
+        # smarter-routing work tunes against
+        reg = rtm.default_registry()
+        self._decisions = reg.counter(
             "dynamo_kv_router_decisions",
             "KV-router dispatch decisions by cause",
             ["cause"],
         )
+        # NetKV-style fetch-vs-recompute gate evidence: every donor
+        # candidate is adjudicated on predicted transfer ms vs predicted
+        # prefill ms, and both estimates are recorded whichever way the
+        # decision goes
+        self._gate_decisions = reg.counter(
+            "dynamo_kv_prefix_fetch_decisions",
+            "Fetch-vs-recompute gate outcomes by decision and donor source",
+            ["decision", "source"],
+        )
+        self._gate_pred = reg.histogram(
+            "dynamo_kv_prefix_fetch_pred_seconds",
+            "Fetch-vs-recompute gate cost predictions",
+            ["kind"],
+            buckets=rtm.TRANSFER_LATENCY_BUCKETS,
+        )
+        # transfer_ms: (nbytes, src_id, dst_id) -> predicted ms or None --
+        # normally FleetObservatory.predict_transfer_ms, which also fits
+        # the G4 store link (src/dst G4_STORE_ID) from TransferLog rows
+        self._transfer_ms = transfer_ms
+        spec = dict(remote_spec or {})
+        self._prefill_tok_s = float(spec.get("prefill_tok_s", 4000.0))
+        self._gbps = float(spec.get("gbps", 1.0))
+        self.decisions_log: list = []
+
+    def _gate_donor(
+        self,
+        request_id: str,
+        instance_id: int,
+        own: int,
+        donor: Dict[str, Any],
+    ) -> Dict[str, Any]:
+        """Adjudicate fetch-vs-recompute for one donor candidate.
+
+        Predicted fetch cost: the observatory's fitted link model when it
+        can price the (donor -> chosen worker) link, else the configured
+        flat ``gbps``.  Predicted recompute cost: the saved tokens at the
+        configured per-worker prefill rate.  Both estimates land as span
+        attrs, metric observations, and a decisions-log row regardless of
+        which way the decision goes -- the acceptance surface."""
+        blocks = int(donor["blocks"])
+        saved_blocks = max(blocks - own, 0)
+        tokens_saved = saved_blocks * self.chooser.block_size
+        pred_prefill_ms = tokens_saved / max(self._prefill_tok_s, 1e-9) * 1e3
+        nbytes = donor.get("nbytes")
+        pred_fetch_ms: Optional[float] = None
+        ship_bytes: Optional[int] = None
+        if nbytes:
+            # pro-rate the advertised bytes to the blocks actually shipped:
+            # the onboarder only imports blocks past the chosen worker's
+            # own coverage
+            ship_bytes = int(int(nbytes) * saved_blocks / max(blocks, 1))
+            if self._transfer_ms is not None:
+                try:
+                    pred_fetch_ms = self._transfer_ms(
+                        ship_bytes, donor["instance"], instance_id
+                    )
+                except Exception:
+                    logger.debug("transfer predictor failed", exc_info=True)
+            if pred_fetch_ms is None:
+                pred_fetch_ms = ship_bytes / (self._gbps * 1e9) * 1e3
+        # unknown bytes (a pure-G1 peer donor) cannot be priced: keep the
+        # pre-gate behaviour and fetch -- the onboarder's own fallback
+        # still recomputes on any failure
+        decision = "fetch"
+        if pred_fetch_ms is not None and pred_fetch_ms >= pred_prefill_ms:
+            decision = "recompute"
+        source = str(donor["source"])
+        self._gate_decisions.labels(decision, source).inc()
+        if pred_fetch_ms is not None:
+            self._gate_pred.labels("fetch").observe(pred_fetch_ms / 1e3)
+        self._gate_pred.labels("prefill").observe(pred_prefill_ms / 1e3)
+        row = {
+            "ts": time.time(),
+            "request_id": request_id,
+            "instance": instance_id,
+            "donor": donor["instance"],
+            "source": source,
+            "decision": decision,
+            "own_blocks": own,
+            "donor_blocks": blocks,
+            "ship_bytes": ship_bytes,
+            "pred_fetch_ms": pred_fetch_ms,
+            "pred_prefill_ms": pred_prefill_ms,
+        }
+        self.decisions_log.append(row)
+        if len(self.decisions_log) > self.DECISION_LOG_CAP:
+            del self.decisions_log[: -self.DECISION_LOG_CAP]
+        with tracing.span(
+            "router.prefill_dispatch",
+            request_id,
+            instance=f"{instance_id:x}",
+        ) as sp:
+            sp.set(
+                gate_decision=decision,
+                donor_source=source,
+                donor_blocks=blocks,
+                own_blocks=own,
+                pred_fetch_ms=pred_fetch_ms,
+                pred_prefill_ms=pred_prefill_ms,
+            )
+        return row
 
     async def generate(self, request: Context[Any]) -> ResponseStream[Annotated]:
         data = request.data
@@ -220,19 +383,31 @@ class KvPushRouter:
             self._decisions.labels("fallback_no_selection").inc()
             return await self.inner.generate(request)
         if donor is not None:
-            # another worker holds a longer prefix: tell the chosen worker
-            # where to import it from (llm/prefix_onboard.py consumes this)
+            # fetch-vs-recompute gate: only stamp the donor when importing
+            # its blocks is predicted cheaper than recomputing them
+            gate = self._gate_donor(request.id, instance_id, overlap, donor)
+            if gate["decision"] != "fetch":
+                donor = None
+        if donor is not None:
+            # a donor holds a longer prefix and fetching won the gate:
+            # tell the chosen worker where to import it from
+            # (llm/prefix_onboard.py consumes this)
             from ..prefix_onboard import DONOR_META_KEY
 
             request.metadata[DONOR_META_KEY] = {
-                "instance": donor[0],
-                "blocks": donor[1],
+                "instance": donor["instance"],
+                "blocks": donor["blocks"],
+                "source": donor["source"],
             }
         try:
             stream = await self.inner.direct(stamp(overlap), instance_id)
-            self._decisions.labels(
-                "kv_donor" if donor is not None else "kv"
-            ).inc()
+            if donor is None:
+                cause = "kv"
+            elif donor["source"] == "remote":
+                cause = "kv_remote"
+            else:
+                cause = "kv_donor"
+            self._decisions.labels(cause).inc()
             return stream
         except (InstanceNotFoundError, ConnectionRefusedError, WorkerLostError):
             # retryable dispatch failures are exactly those where the

@@ -185,6 +185,12 @@ class PrefixOnboardEngine:
         ]
         if not missing:
             return
+        if donor.get("source") == "remote":
+            # the donor is the shared G4 object store, not a peer worker:
+            # fetch over the offload engine's remote tier instead of the
+            # kv_export endpoint
+            await self._onboard_remote(missing)
+            return
         router = await self._router()
         stream = await router.direct_raw(
             int(donor["instance"]),
@@ -270,3 +276,29 @@ class PrefixOnboardEngine:
                 "onboarded %d prefix blocks from donor %x",
                 fetched, int(donor["instance"]),
             )
+
+    async def _onboard_remote(self, missing: List[int]) -> None:
+        """Fetch missing prefix blocks from the G4 store into the host
+        tier.  Fetches ride the kv-remote thread (futures awaited here);
+        the chain cuts at the first miss -- the scheduler's prefix match
+        stops at the first hole, so trailing blocks past a gap are
+        useless."""
+        import asyncio
+
+        offload = self.engine.offload_engine
+        remote = getattr(offload, "remote", None)
+        if remote is None:
+            return
+        fetched = 0
+        for h in missing:
+            got = await asyncio.wrap_future(remote.fetch(int(h)))
+            if got is None:
+                self.failed_fetches += 1
+                break
+            blob, meta = got
+            offload.submit_put(int(h), blob, meta)
+            fetched += 1
+        self.onboarded_blocks += fetched
+        if fetched:
+            await asyncio.to_thread(offload.drain)
+            logger.info("onboarded %d prefix blocks from G4 store", fetched)

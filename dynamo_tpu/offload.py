@@ -1,5 +1,6 @@
-"""Multi-tier KV offload plane: G2 (host RAM) and G3 (disk) behind the G1
-page pool, coordinated by :class:`KVOffloadEngine`.
+"""Multi-tier KV offload plane: G2 (host RAM), G3 (disk), and G4 (the
+fleet-shared remote store) behind the G1 page pool, coordinated by
+:class:`KVOffloadEngine`.
 
 Reference parity: lib/llm/src/block_manager offload (offload.rs:76-80 --
 eviction cascades G1 -> G2 -> G3, lookups promote back up) plus the
@@ -29,8 +30,10 @@ offload thread ever starts.
 from __future__ import annotations
 
 import collections
+import json
 import logging
 import os
+import struct
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -45,9 +48,24 @@ logger = logging.getLogger("dynamo.offload")
 
 # The designated sync-transfer helpers (dynalint DT009): every synchronous
 # device<->host materialization in this module must happen inside one of
-# these functions, so an accidental blocking transfer on a tier hot path
-# is a lint error, not a latent stall.
-COPY_HELPERS = ("to_host",)
+# these functions -- bare names cover module functions, dotted qualnames
+# pin single methods -- so an accidental blocking transfer on a tier hot
+# path is a lint error, not a latent stall.  ``pack_kv_blob_frame`` is
+# the G4 remote tier's materialize point; ``RemoteTier._put``/``_get``
+# are the store round-trips themselves: all three run only on the
+# kv-remote thread (thread_sentry asserts the role at runtime).
+COPY_HELPERS = (
+    "to_host",
+    "pack_kv_blob_frame",
+    "RemoteTier._put",
+    "RemoteTier._get",
+)
+
+# Pseudo worker id of the hub-backed G4 store in every per-link table
+# (telemetry TransferLog rows, the observatory's LinkModel, the global
+# holdings index): store<->worker edges fit and predict like any
+# worker<->worker link.
+G4_STORE_ID = -4
 
 
 def to_host(arr: Any) -> np.ndarray:
@@ -246,16 +264,24 @@ class DiskTier:
         with self._lock:
             return seq_hash in self._lru
 
-    def put(self, seq_hash: int, blob: np.ndarray, meta: BlockMeta) -> None:
+    def put(
+        self, seq_hash: int, blob: np.ndarray, meta: BlockMeta
+    ) -> List[Tuple[int, Optional[str], int]]:
         """Offload-thread only.  File I/O runs OUTSIDE the lock (write to
         a temp file, rename into place): the lock guards only the in-RAM
         index, so ``__contains__`` probes from the admission path never
-        wait behind a multi-MB compressed write."""
+        wait behind a multi-MB compressed write.
+
+        Returns the holdings delta this put caused -- ``(hash, "disk",
+        nbytes)`` for the stored block (``(hash, None, 0)`` when capacity
+        or a write error dropped it) plus ``(victim, None, 0)`` for every
+        LRU eviction -- so the publisher never advertises a tier the
+        worker already dropped."""
         thread_sentry.assert_role("kv-offload", what="DiskTier.put")
         from .engine.kv_cache import QuantKV
 
         if self.capacity <= 0:
-            return
+            return [(seq_hash, None, 0)]
         path = self._path(seq_hash)
         tmp = path + ".tmp.npz"  # .npz suffix so np.savez appends nothing
         try:
@@ -271,7 +297,7 @@ class DiskTier:
         except OSError:
             logger.exception("disk tier write failed for %x", seq_hash)
             with_suppress_remove(tmp)
-            return
+            return [(seq_hash, None, 0)]
         victims: List[int] = []
         with self._lock:
             self._lru[seq_hash] = None
@@ -281,6 +307,11 @@ class DiskTier:
                 victims.append(victim)
         for victim in victims:
             with_suppress_remove(self._path(victim))
+        delta: List[Tuple[int, Optional[str], int]] = [
+            (seq_hash, "disk", int(blob.nbytes))
+        ]
+        delta.extend((v, None, 0) for v in victims)
+        return delta
 
     def get(self, seq_hash: int) -> Optional[Tuple[np.ndarray, BlockMeta]]:
         """Offload-thread only (single reader; puts rename atomically, so
@@ -366,6 +397,11 @@ class HostTier:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # holdings sink (KVOffloadEngine._on_holdings): fired -- outside
+        # the lock, on the offload thread -- with the per-put residency
+        # delta, so every promote/demote/evict reaches the cluster-global
+        # prefix index the moment it happens
+        self.holdings_cb: Optional[Any] = None
 
     def __len__(self) -> int:
         return len(self._slots)
@@ -436,9 +472,13 @@ class HostTier:
         return self._ring[slot].copy()
 
     def put(self, seq_hash: int, blob: np.ndarray, meta: BlockMeta) -> None:
+        delta: List[Tuple[int, Optional[str], int]] = []
         if self.capacity <= 0:
             if self.parent is not None:
-                self.parent.put(seq_hash, blob, meta)
+                delta = self.parent.put(seq_hash, blob, meta)
+            else:
+                delta = [(seq_hash, None, 0)]
+            self._emit_holdings(delta)
             return
         from .engine.kv_cache import QuantKV
 
@@ -466,9 +506,36 @@ class HostTier:
             while len(self._slots) > self.capacity:
                 if not self._demote_lru_locked(demote):
                     break  # everything resident is pinned; overshoot
+        delta.append((seq_hash, "host", int(blob.nbytes)))
         for victim, vb, vm in demote:
             if self.parent is not None:
-                self.parent.put(victim, vb, vm)
+                delta.extend(self.parent.put(victim, vb, vm))
+            else:
+                delta.append((victim, None, 0))
+        self._emit_holdings(delta)
+
+    def _emit_holdings(
+        self, delta: List[Tuple[int, Optional[str], int]]
+    ) -> None:
+        """Forward a residency delta to the holdings sink.  Disk-LRU
+        victims that are still RAM-resident (a promote leaves the disk
+        copy behind; the disk ring may later churn it out) are filtered
+        -- the worker still holds them, just in a warmer tier."""
+        cb = self.holdings_cb
+        if cb is None or not delta:
+            return
+        out = []
+        for h, tier, nbytes in delta:
+            if tier is None:
+                with self._lock:
+                    if h in self._slots:
+                        continue
+            out.append((h, tier, nbytes))
+        if out:
+            try:
+                cb(out)
+            except Exception:
+                logger.debug("holdings callback failed", exc_info=True)
 
     def _demote_lru_locked(
         self, demote: List[Tuple[int, np.ndarray, BlockMeta]]
@@ -586,6 +653,385 @@ class HostTier:
                 g3_misses=self.parent.misses,
             )
         return out
+
+
+# ---------------------------------------------------------------------------
+# the G4 remote tier: fleet-shared blob store behind the hub
+# ---------------------------------------------------------------------------
+
+
+def pack_kv_blob_frame(blob: Any, meta: BlockMeta) -> bytes:
+    """Self-describing G4 wire frame for one block blob.
+
+    ``u32-LE header length | JSON header | payload``: quantized blobs
+    (kv_cache.QuantKV) pack through the shared
+    ``pack_quant_blob_bytes`` rule -- int8 pools ship half the bytes --
+    and dense blobs ship C-order raw.  A COPY_HELPERS member: this is the
+    remote tier's one sync materialize point and runs only on the
+    kv-remote thread."""
+    from .engine.kv_cache import QuantKV, pack_quant_blob_bytes
+
+    if isinstance(blob, QuantKV):
+        payload = pack_quant_blob_bytes(blob)
+        kind, dtype = "quant", "int8"
+        shape = tuple(int(s) for s in blob.q.shape)
+    else:
+        arr = np.ascontiguousarray(blob)
+        payload = arr.tobytes()
+        kind, dtype = "dense", str(arr.dtype)
+        shape = tuple(int(s) for s in arr.shape)
+    hdr = json.dumps(
+        {
+            "v": 1,
+            "kind": kind,
+            "dtype": dtype,
+            "shape": list(shape),
+            "meta": meta.to_dict(),
+            "payload_nbytes": len(payload),
+        }
+    ).encode("utf-8")
+    return struct.pack("<I", len(hdr)) + hdr + payload
+
+
+def unpack_kv_blob_frame(buf: Any) -> Tuple[Any, BlockMeta]:
+    """Inverse of :func:`pack_kv_blob_frame`; raises ``ValueError`` on any
+    framing violation (truncation, garbage header, payload/shape size
+    mismatch) so a corrupt store entry surfaces as a fetch miss -- the
+    gate falls back to recompute -- never as a malformed scatter.
+
+    The returned blob ALIASES ``buf`` (zero-copy unpack); the host-tier
+    put that follows copies into the ring."""
+    from .engine.kv_cache import quant_blob_nbytes, unpack_quant_blob_bytes
+
+    view = memoryview(buf)
+    if len(view) < 4:
+        raise ValueError("G4 frame shorter than its header-length word")
+    (hlen,) = struct.unpack_from("<I", view, 0)
+    if hlen <= 0 or 4 + hlen > len(view):
+        raise ValueError(f"G4 frame header length {hlen} exceeds frame")
+    try:
+        hdr = json.loads(bytes(view[4 : 4 + hlen]).decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ValueError("G4 frame header is not valid JSON") from e
+    if not isinstance(hdr, dict) or "shape" not in hdr:
+        raise ValueError("G4 frame header missing blob geometry")
+    shape = tuple(int(s) for s in hdr["shape"])
+    payload = view[4 + hlen :]
+    try:
+        if hdr.get("kind") == "quant":
+            expect = quant_blob_nbytes(shape)
+        else:
+            expect = int(np.prod(shape)) * np.dtype(str(hdr.get("dtype"))).itemsize
+    except TypeError as e:
+        raise ValueError("G4 frame header names an unknown dtype") from e
+    if len(payload) != expect or expect != int(hdr.get("payload_nbytes", -1)):
+        raise ValueError(
+            f"G4 frame payload holds {len(payload)} bytes, geometry "
+            f"expects {expect}"
+        )
+    meta = BlockMeta.from_dict(hdr.get("meta") or {})
+    if hdr.get("kind") == "quant":
+        return unpack_quant_blob_bytes(payload, shape), meta
+    return np.frombuffer(payload, str(hdr["dtype"])).reshape(shape), meta
+
+
+class InMemoryBlobStore:
+    """Process-local G4 store (tests, single-process bench legs): the hub
+    blob verbs' semantics -- byte-capacity LRU over named blobs -- behind
+    the same sync ``put``/``get``/``delete`` protocol :class:`RemoteTier`
+    speaks, without a hub in the loop.  Thread-safe: every worker's
+    kv-remote thread may hit the shared instance concurrently."""
+
+    def __init__(self, cap_bytes: int = 1 << 30) -> None:
+        self.cap_bytes = int(cap_bytes)
+        self._blobs: "collections.OrderedDict[str, bytes]" = (
+            collections.OrderedDict()
+        )
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def put(self, name: str, data: bytes) -> None:
+        data = bytes(data)
+        with self._lock:
+            old = self._blobs.pop(name, None)
+            if old is not None:
+                self._total -= len(old)
+            self._blobs[name] = data
+            self._total += len(data)
+            while self._total > self.cap_bytes and len(self._blobs) > 1:
+                _, dropped = self._blobs.popitem(last=False)
+                self._total -= len(dropped)
+
+    def get(self, name: str) -> Optional[bytes]:
+        with self._lock:
+            data = self._blobs.get(name)
+            if data is not None:
+                self._blobs.move_to_end(name)
+            return data
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            old = self._blobs.pop(name, None)
+            if old is not None:
+                self._total -= len(old)
+            return old is not None
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"blobs": len(self._blobs), "bytes": self._total}
+
+
+class RemoteTier:
+    """G4: block blobs in a fleet-shared object store (the hub's blob
+    verbs, or any sync ``put``/``get`` duck type).
+
+    All store I/O runs on ONE private thread (``kv-remote``) -- the same
+    isolation contract as the kv-offload thread, so a slow or wedged
+    store RPC can never stall an eviction cascade, a tick, or the event
+    loop.  ``submit_put``/``fetch`` enqueue and return futures;
+    ``fetch_blocking`` is for worker threads that may wait (the offload
+    thread's tiered ``get_blocking`` chain, the onboard path's executor
+    hop).  Every store/fetch feeds the shared telemetry
+    :class:`~dynamo_tpu.runtime.telemetry.TransferLog` with the
+    :data:`G4_STORE_ID` pseudo endpoint, so the fleet observatory fits a
+    store link and ``predict_transfer_ms`` covers the G4 edge like any
+    worker<->worker hop."""
+
+    def __init__(
+        self,
+        store: Any,
+        *,
+        worker_id: int = 0,
+        namespace: str = "dynamo",
+        registry: Any = None,
+    ) -> None:
+        self.store = store
+        self.worker_id = int(worker_id)
+        self.namespace = namespace
+        self._ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kv-remote"
+        )
+        self._lock = threading.Lock()
+        # hash -> frame nbytes known to be in the store (our own puts +
+        # adverts merged back from the cluster-global holdings index)
+        self._known: Dict[int, int] = {}
+        from .runtime.metrics import RemoteKVMetrics
+
+        self.metrics = RemoteKVMetrics(registry)
+        # holdings sink (KVOffloadEngine._on_holdings): a successful put
+        # advertises (hash, "remote", nbytes) to the global index
+        self.holdings_cb: Optional[Any] = None
+        # plain mirrors for bench/tests (no registry scrape needed)
+        self.puts = 0
+        self.fetches = 0
+        self.store_bytes = 0
+        self.store_seconds = 0.0
+        self.fetch_bytes = 0
+        self.fetch_seconds = 0.0
+        self.fetch_fails: Dict[str, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._ex.shutdown(wait=True)
+
+    def drain(self) -> None:
+        self._ex.submit(lambda: None).result()
+
+    def _name(self, seq_hash: int) -> str:
+        return f"kv/{self.namespace}/{seq_hash & (2**64 - 1):016x}"
+
+    # -- residency index ---------------------------------------------------
+
+    def contains(self, seq_hash: int) -> bool:
+        with self._lock:
+            return seq_hash in self._known
+
+    def note_remote(self, seq_hash: int, nbytes: int) -> None:
+        """Merge a G4 advert from the cluster-global index (another
+        worker published this block) into the local residency view."""
+        with self._lock:
+            self._known[seq_hash] = int(nbytes)
+
+    def known_blocks(self) -> int:
+        with self._lock:
+            return len(self._known)
+
+    # -- async surface -----------------------------------------------------
+
+    def submit_put(self, seq_hash: int, blob: Any, meta: BlockMeta):
+        """Queue a store upload; returns the future (True on success)."""
+        return self._ex.submit(self._put, seq_hash, blob, meta)
+
+    def fetch(self, seq_hash: int):
+        """Queue a store fetch; the future resolves to ``(blob, meta)``
+        or None (missing / failed / corrupt -- the caller recomputes)."""
+        return self._ex.submit(self._get, seq_hash)
+
+    def fetch_blocking(
+        self, seq_hash: int
+    ) -> Optional[Tuple[Any, BlockMeta]]:
+        """Worker-thread fetch (never the event loop): waits on the
+        kv-remote thread's result."""
+        return self.fetch(seq_hash).result()
+
+    # -- kv-remote thread side ---------------------------------------------
+
+    def _put(self, seq_hash: int, blob: Any, meta: BlockMeta) -> bool:
+        thread_sentry.assert_role("kv-remote", what="RemoteTier._put")
+        try:
+            frame = pack_kv_blob_frame(blob, meta)
+            t0 = time.perf_counter()
+            self.store.put(self._name(seq_hash), frame)
+            dt = time.perf_counter() - t0
+        except Exception:
+            logger.debug("G4 store put failed for %x", seq_hash, exc_info=True)
+            return False
+        with self._lock:
+            self._known[seq_hash] = len(frame)
+            self.puts += 1
+            self.store_bytes += len(frame)
+            self.store_seconds += dt
+            known = len(self._known)
+        self.metrics.record_store(len(frame), dt)
+        self.metrics.blocks.set(known)
+        from .runtime.telemetry import note_transfer
+
+        note_transfer(self.worker_id, G4_STORE_ID, len(frame), dt)
+        cb = self.holdings_cb
+        if cb is not None:
+            try:
+                cb([(seq_hash, "remote", len(frame))])
+            except Exception:
+                logger.debug("G4 holdings callback failed", exc_info=True)
+        return True
+
+    def _get(self, seq_hash: int) -> Optional[Tuple[Any, BlockMeta]]:
+        thread_sentry.assert_role("kv-remote", what="RemoteTier._get")
+        from .runtime import faults
+
+        if faults.injector.enabled and faults.injector.should_fire(
+            "remote.fetch_fail", f"g4/{seq_hash:x}"
+        ):
+            self._count_fail("fetch_fail")
+            return None
+        t0 = time.perf_counter()
+        try:
+            frame = self.store.get(self._name(seq_hash))
+        except Exception:
+            logger.debug(
+                "G4 store get failed for %x", seq_hash, exc_info=True
+            )
+            self._count_fail("fetch_fail")
+            return None
+        if frame is None:
+            # the store LRU'd it out from under the index: forget it
+            with self._lock:
+                self._known.pop(seq_hash, None)
+            self._count_fail("missing")
+            return None
+        dt = time.perf_counter() - t0
+        if faults.injector.enabled and faults.injector.should_fire(
+            "remote.blob_corrupt", f"g4/{seq_hash:x}"
+        ):
+            # truncate mid-payload: the frame validator must catch it
+            frame = bytes(frame)[: max(len(frame) // 2, 4)]
+        try:
+            blob, meta = unpack_kv_blob_frame(frame)
+        except ValueError:
+            logger.warning(
+                "G4 blob for %x failed frame validation; treating as miss",
+                seq_hash,
+            )
+            self._count_fail("blob_corrupt")
+            return None
+        with self._lock:
+            self.fetches += 1
+            self.fetch_bytes += len(frame)
+            self.fetch_seconds += dt
+        self.metrics.record_fetch(len(frame), dt)
+        from .runtime.telemetry import note_transfer
+
+        note_transfer(G4_STORE_ID, self.worker_id, len(frame), dt)
+        return blob, meta
+
+    def _count_fail(self, cause: str) -> None:
+        with self._lock:
+            self.fetch_fails[cause] = self.fetch_fails.get(cause, 0) + 1
+        self.metrics.fetch_failures.labels(cause).inc()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "g4_known_blocks": len(self._known),
+                "g4_puts": self.puts,
+                "g4_fetches": self.fetches,
+                "g4_store_bytes": self.store_bytes,
+                "g4_fetch_bytes": self.fetch_bytes,
+                "g4_fetch_fails": dict(self.fetch_fails),
+            }
+            seconds = self.store_seconds + self.fetch_seconds
+            if seconds > 0:
+                out["kv_g4_gbps"] = round(
+                    (self.store_bytes + self.fetch_bytes) / seconds / 1e9, 3
+                )
+        return out
+
+
+def parse_kv_remote_spec(spec: str) -> Optional[Dict[str, Any]]:
+    """Parse a ``--kv-remote`` / ``DYN_KV_REMOTE`` value into G4 settings,
+    or None when empty/off (no remote tier, no kv-remote thread).
+
+    Grammar: ``1``/``on`` arms the tier with defaults, or a
+    comma-separated ``k=v`` list::
+
+        DYN_KV_REMOTE=mirror=1,fetch=1,prefill_tok_s=4000,gbps=1.0,namespace=prod
+
+    ``mirror`` re-publishes host-tier eviction stores into the fleet
+    store; ``fetch`` lets the router gate choose G4 as a prefix source;
+    ``prefill_tok_s`` is the per-worker prefill-rate estimate and
+    ``gbps`` the unfitted-link bandwidth prior, both feeding the
+    fetch-vs-recompute gate until the observatory has real
+    observations."""
+    spec = (spec or "").strip()
+    if not spec or spec.lower() in ("0", "off", "false", "no"):
+        return None
+    out: Dict[str, Any] = {
+        "mirror": True,
+        "fetch": True,
+        "prefill_tok_s": 4000.0,
+        "gbps": 1.0,
+        "namespace": "dynamo",
+    }
+    if spec.lower() in ("1", "on", "true", "yes"):
+        return out
+    for clause in filter(None, (c.strip() for c in spec.split(","))):
+        k, sep, v = clause.partition("=")
+        k = k.strip().lower()
+        if not sep:
+            raise ValueError(f"malformed DYN_KV_REMOTE clause {clause!r}")
+        try:
+            if k in ("mirror", "fetch"):
+                out[k] = v.strip().lower() not in ("0", "off", "false", "no")
+            elif k in ("prefill_tok_s", "gbps"):
+                out[k] = float(v)
+                if out[k] <= 0:
+                    raise ValueError(f"{k} must be positive")
+            elif k == "namespace":
+                out[k] = v.strip()
+            else:
+                raise ValueError(f"unknown DYN_KV_REMOTE key {k!r}")
+        except ValueError as e:
+            raise ValueError(f"bad DYN_KV_REMOTE value {clause!r}") from e
+    return out
+
+
+def env_remote_spec(
+    environ: Optional[Dict[str, str]] = None,
+) -> Optional[Dict[str, Any]]:
+    """``DYN_KV_REMOTE`` from the environment, parsed; None when unset."""
+    env = environ if environ is not None else os.environ
+    return parse_kv_remote_spec(env.get("DYN_KV_REMOTE", ""))
 
 
 # ---------------------------------------------------------------------------
@@ -736,6 +1182,17 @@ class KVOffloadEngine:
         from .runtime.metrics import OffloadMetrics
 
         self.metrics = OffloadMetrics(registry)
+        self._registry = registry
+        # the G4 remote tier (attach_remote): host-tier eviction stores
+        # mirror into the fleet store, and the tiered get_blocking chain
+        # extends host -> disk -> remote
+        self.remote: Optional[RemoteTier] = None
+        self._remote_mirror = True
+        # holdings sink (engine._emit_kv_holdings): receives every tier
+        # residency delta [(hash, tier|None, nbytes)] for the
+        # cluster-global prefix index
+        self.holdings_cb: Optional[Any] = None
+        self.host.holdings_cb = self._on_holdings
         # called (from the offload thread) when a swap blob becomes ready,
         # so a sleeping tick loop wakes to apply it
         self.wake_cb: Optional[Any] = None
@@ -772,11 +1229,49 @@ class KVOffloadEngine:
 
     def close(self) -> None:
         self._ex.shutdown(wait=True)
+        if self.remote is not None:
+            self.remote.close()
 
     def drain(self) -> None:
         """Barrier: returns once every queued offload/prefetch/swap task
         has run (tests and shutdown; never called on a hot path)."""
         self._ex.submit(lambda: None).result()
+        if self.remote is not None:
+            self.remote.drain()
+
+    def attach_remote(
+        self,
+        store: Any,
+        *,
+        worker_id: int = 0,
+        namespace: str = "dynamo",
+        mirror: bool = True,
+    ) -> RemoteTier:
+        """Arm the G4 tier over ``store`` (the hub blob verbs or any sync
+        put/get duck type).  ``mirror=True`` re-publishes every host-tier
+        eviction store into the fleet store so peers (and cold restarts)
+        can fetch instead of recompute."""
+        remote = RemoteTier(
+            store,
+            worker_id=worker_id,
+            namespace=namespace,
+            registry=self._registry,
+        )
+        remote.holdings_cb = self._on_holdings
+        self._remote_mirror = bool(mirror)
+        self.remote = remote
+        return remote
+
+    def _on_holdings(self, delta: List[Tuple[int, Optional[str], int]]) -> None:
+        """Tier-side residency deltas (host/disk/remote puts, demotions,
+        evictions, promotes) fan into the engine-facing sink."""
+        cb = self.holdings_cb
+        if cb is None:
+            return
+        try:
+            cb(delta)
+        except Exception:
+            logger.debug("holdings sink failed", exc_info=True)
 
     def _wake(self) -> None:
         cb = self.wake_cb
@@ -815,6 +1310,15 @@ class KVOffloadEngine:
                 self.offload_bytes += blob.nbytes
                 self.offload_seconds += dt
             self.metrics.record_offload("host", blob.nbytes, dt)
+            remote = self.remote
+            if (
+                remote is not None
+                and self._remote_mirror
+                and not remote.contains(seq_hash)
+            ):
+                # fleet publication rides the kv-remote thread; the host
+                # blob is already materialized, so this enqueue is free
+                remote.submit_put(seq_hash, blob, meta)
             self._observe_occupancy()
         except Exception:
             logger.debug("offload store failed for %x", seq_hash, exc_info=True)
@@ -1023,8 +1527,22 @@ class KVOffloadEngine:
     def get_blocking(self, seq_hash: int) -> Optional[Tuple[np.ndarray, Any]]:
         """Tiered get from a worker thread (block export / donor paths):
         routes the possibly-disk read through the offload thread and
-        waits for it.  Never call on the event loop."""
-        return self._ex.submit(self.host.get, seq_hash).result()
+        waits for it, falling through to the G4 store when the local
+        tiers miss (the fetch waits on the kv-remote thread -- a
+        different executor, so no deadlock).  A G4 hit promotes into the
+        host ring so the next lookup is a RAM hit.  Never call on the
+        event loop."""
+        hit = self._ex.submit(self.host.get, seq_hash).result()
+        if (
+            hit is None
+            and self.remote is not None
+            and self.remote.contains(seq_hash)
+        ):
+            fetched = self.remote.fetch_blocking(seq_hash)
+            if fetched is not None:
+                self.submit_put(seq_hash, fetched[0], fetched[1])
+                hit = fetched
+        return hit
 
     # -- swap records (preempted-sequence KV) --------------------------------
 
@@ -1190,4 +1708,6 @@ class KVOffloadEngine:
             out["onboard_gbps"] = round(
                 self.onboard_bytes / self.onboard_seconds / 1e9, 3
             )
+        if self.remote is not None:
+            out.update(self.remote.stats())
         return out

@@ -89,6 +89,8 @@ SITES = frozenset(
         "disagg.slow_export",
         "offload.copy_fail",
         "onboard.truncate",
+        "remote.fetch_fail",
+        "remote.blob_corrupt",
         "spec.draft_corrupt",
         "worker.slow",
         "worker.kill",

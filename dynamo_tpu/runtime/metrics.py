@@ -428,6 +428,47 @@ class OffloadMetrics:
         self.onboard_latency.labels(tier).observe(max(seconds, 0.0))
 
 
+class RemoteKVMetrics:
+    """Registry-backed G4 remote-tier series (``dynamo_kv_g4_*``): the
+    fleet-shared store's transfer volume/latency per direction, local
+    residency knowledge, and the chaos-visible fetch failure causes.
+    Updated only from the kv-remote thread.  Catalog: README "Fleet KV
+    economy"."""
+
+    def __init__(self, registry: Optional["MetricsRegistry"] = None) -> None:
+        reg = registry or default_registry()
+        self.registry = reg
+        self.bytes = reg.counter(
+            "dynamo_kv_g4_bytes",
+            "KV frame bytes moved against the G4 fleet store, by direction",
+            ["op"],  # store | fetch
+        )
+        self.latency = reg.histogram(
+            "dynamo_kv_g4_seconds",
+            "G4 store round-trip latency per blob frame, by direction",
+            ["op"],
+            buckets=TRANSFER_LATENCY_BUCKETS,
+        )
+        self.blocks = reg.gauge(
+            "dynamo_kv_g4_blocks",
+            "Blocks this worker knows to be resident in the G4 store "
+            "(own publications + merged fleet adverts)",
+        )
+        self.fetch_failures = reg.counter(
+            "dynamo_kv_g4_fetch_failures",
+            "G4 fetches that fell back to recompute, by cause",
+            ["cause"],  # fetch_fail | missing | blob_corrupt
+        )
+
+    def record_store(self, nbytes: int, seconds: float) -> None:
+        self.bytes.labels("store").inc(nbytes)
+        self.latency.labels("store").observe(max(seconds, 0.0))
+
+    def record_fetch(self, nbytes: int, seconds: float) -> None:
+        self.bytes.labels("fetch").inc(nbytes)
+        self.latency.labels("fetch").observe(max(seconds, 0.0))
+
+
 class SpecMetrics:
     """Registry-backed speculative-decoding series (``dynamo_spec_*``).
 

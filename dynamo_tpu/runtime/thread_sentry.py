@@ -57,6 +57,7 @@ THREAD_CONFINED_ATTR = "__dynalint_thread_role__"
 ROLE_THREAD_PREFIXES = {
     "tick": ("jax-engine",),
     "kv-offload": ("kv-offload",),
+    "kv-remote": ("kv-remote",),
     "hub-io": ("hub-journal",),
     "recorder-io": ("recorder-io",),
     "planner-log": ("planner-log",),

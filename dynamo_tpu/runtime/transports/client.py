@@ -566,6 +566,30 @@ class HubClient:
         hdr, _ = await self._call({"op": "obj_del", "name": name})
         return bool(hdr.get("found"))
 
+    # -- KV blobs (the G4 remote tier's verbs) -----------------------------
+
+    async def blob_put(self, name: str, blob: bytes) -> None:
+        hdr, _ = await self._call({"op": "blob_put", "name": name}, blob)
+        self._check(hdr)
+
+    async def blob_get(self, name: str) -> Optional[bytes]:
+        hdr, blob = await self._call({"op": "blob_get", "name": name})
+        if not hdr.get("ok"):
+            return None
+        return blob
+
+    async def blob_del(self, name: str) -> bool:
+        hdr, _ = await self._call({"op": "blob_del", "name": name})
+        return bool(hdr.get("found"))
+
+    async def blob_stats(self) -> Dict[str, int]:
+        hdr, _ = await self._call({"op": "blob_stats"})
+        self._check(hdr)
+        return {
+            "blobs": int(hdr.get("blobs", 0)),
+            "bytes": int(hdr.get("bytes", 0)),
+        }
+
 
 def _split_entries(
     metas: List[Dict[str, Any]], blob: bytes
@@ -664,3 +688,44 @@ class StaticHub:
 
     async def obj_del(self, name: str) -> bool:
         return self.state.objects.pop(name, None) is not None
+
+    async def blob_put(self, name: str, blob: bytes) -> None:
+        await self.state.blob_store.put(name, blob)
+
+    async def blob_get(self, name: str) -> Optional[bytes]:
+        return await self.state.blob_store.get(name)
+
+    async def blob_del(self, name: str) -> bool:
+        return await self.state.blob_store.delete(name)
+
+    async def blob_stats(self) -> Dict[str, int]:
+        return self.state.blob_store.stats()
+
+
+class HubBlobClient:
+    """Sync adapter from the offload plane's kv-remote thread onto an
+    async hub client's blob verbs.
+
+    The RemoteTier's store protocol is synchronous (it already owns a
+    dedicated thread); a real deployment's store is the hub, whose
+    client is loop-bound.  Each call here schedules the coroutine on the
+    client's loop with ``run_coroutine_threadsafe`` and blocks the
+    CALLING thread only -- the loop never waits.  Never call from the
+    event loop itself (that would deadlock by definition); the thread
+    sentry on the RemoteTier's entry points already enforces this."""
+
+    def __init__(self, client: Any, loop: asyncio.AbstractEventLoop) -> None:
+        self.client = client
+        self.loop = loop
+
+    def _run(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result()
+
+    def put(self, name: str, data: bytes) -> None:
+        self._run(self.client.blob_put(name, bytes(data)))
+
+    def get(self, name: str) -> Optional[bytes]:
+        return self._run(self.client.blob_get(name))
+
+    def delete(self, name: str) -> bool:
+        return self._run(self.client.blob_del(name))

@@ -30,8 +30,11 @@ import asyncio
 import collections
 import contextlib
 import fnmatch
+import hashlib
 import itertools
 import logging
+import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional, Tuple
@@ -119,6 +122,9 @@ class HubState:
             collections.deque
         )
         self.objects: Dict[str, bytes] = {}
+        # the G4 KV-blob cache (blob_* verbs) -- unjournaled by design,
+        # disk-backed when the owning server has a data_dir
+        self.blob_store = HubBlobStore()
         # expiry-loop wakeup: called whenever a new lease deadline appears
         # (grant), so the owner's wait can re-aim at the earliest expiry
         # instead of polling on a fixed interval
@@ -287,6 +293,151 @@ class HubState:
         if existed and self.journal is not None:
             self.journal({"op": "obj_del", "name": name}, b"")
         return existed
+
+
+class HubBlobStore:
+    """The hub's G4 KV-blob store (the ``blob_put``/``blob_get``/
+    ``blob_del``/``blob_stats`` verbs).
+
+    Deliberately NOT journaled, unlike ``objects``: blobs are a fleet
+    *cache* -- losing one costs a worker a recompute, never correctness
+    -- so multi-MB KV frames stay out of the WAL and snapshots.  With a
+    ``data_dir`` (a durable HubServer) each blob is one file under
+    ``<data_dir>/blobs/`` behind an in-RAM name->size index, and every
+    file op runs on the journal's single I/O worker (role ``hub-io``) --
+    a slow disk stalls blob traffic, never the hub's event loop.
+    Without one (StaticHub, tests) the same byte-capacity LRU runs over
+    an in-RAM dict.  Capacity: ``DYN_HUB_BLOB_CAP`` bytes (default 1
+    GiB)."""
+
+    def __init__(self, cap_bytes: Optional[int] = None) -> None:
+        if cap_bytes is None:
+            cap_bytes = int(os.environ.get("DYN_HUB_BLOB_CAP", str(1 << 30)))
+        self.cap_bytes = int(cap_bytes)
+        # LRU order over resident blob names; value = blob nbytes
+        self._index: "collections.OrderedDict[str, int]" = (
+            collections.OrderedDict()
+        )
+        self._mem: Dict[str, bytes] = {}
+        self._total = 0
+        self._dir: Optional[str] = None
+        self._io: Optional[Any] = None
+        # the index is touched from the loop (StaticHub direct calls)
+        # AND the hub-io worker (disk-backed ops): lock it
+        self._lock = threading.Lock()
+
+    def attach_disk(self, root: str, io: Any) -> None:
+        """Back blobs with files under ``root``; ``io`` is the journal's
+        single-thread executor (every file op rides it)."""
+        os.makedirs(root, exist_ok=True)
+        self._dir = root
+        self._io = io
+
+    def _path(self, name: str) -> str:
+        # hashed filename: blob names carry '/' namespacing and arbitrary
+        # worker-supplied bytes -- never let them pick filesystem paths
+        digest = hashlib.sha256(name.encode("utf-8")).hexdigest()
+        return os.path.join(self._dir, digest + ".blob")
+
+    # -- RAM core (loop-safe: index + in-memory bytes, no file I/O) --------
+
+    def _index_put(self, name: str, nbytes: int, data: Optional[bytes]) -> List[str]:
+        """LRU-insert; returns evicted names (disk callers unlink them)."""
+        evicted: List[str] = []
+        with self._lock:
+            old = self._index.pop(name, None)
+            if old is not None:
+                self._total -= old
+            self._index[name] = nbytes
+            self._total += nbytes
+            if data is not None:
+                self._mem[name] = data
+            while self._total > self.cap_bytes and len(self._index) > 1:
+                victim, vb = self._index.popitem(last=False)
+                self._total -= vb
+                self._mem.pop(victim, None)
+                evicted.append(victim)
+        return evicted
+
+    def _mem_get(self, name: str) -> Optional[bytes]:
+        with self._lock:
+            if name not in self._index:
+                return None
+            self._index.move_to_end(name)
+            return self._mem.get(name)
+
+    def _index_del(self, name: str) -> bool:
+        with self._lock:
+            nbytes = self._index.pop(name, None)
+            if nbytes is not None:
+                self._total -= nbytes
+            self._mem.pop(name, None)
+        return nbytes is not None
+
+    # -- disk core (hub-io worker only: every file op lives here) ----------
+
+    def put_sync(self, name: str, data: bytes) -> None:
+        from .. import thread_sentry
+
+        thread_sentry.assert_role("hub-io", what="HubBlobStore.put")
+        path = self._path(name)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        for victim in self._index_put(name, len(data), None):
+            with contextlib.suppress(OSError):
+                os.remove(self._path(victim))
+
+    def get_sync(self, name: str) -> Optional[bytes]:
+        with self._lock:
+            if name not in self._index:
+                return None
+            self._index.move_to_end(name)
+        from .. import thread_sentry
+
+        thread_sentry.assert_role("hub-io", what="HubBlobStore.get")
+        try:
+            with open(self._path(name), "rb") as f:
+                return f.read()
+        except OSError:
+            self._index_del(name)
+            return None
+
+    def del_sync(self, name: str) -> bool:
+        existed = self._index_del(name)
+        if existed:
+            with contextlib.suppress(OSError):
+                os.remove(self._path(name))
+        return existed
+
+    # -- async surface (hub dispatch + StaticHub) --------------------------
+
+    async def put(self, name: str, data: bytes) -> None:
+        if self._io is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                self._io, self.put_sync, name, data
+            )
+        else:
+            self._index_put(name, len(data), bytes(data))
+
+    async def get(self, name: str) -> Optional[bytes]:
+        if self._io is not None:
+            return await asyncio.get_running_loop().run_in_executor(
+                self._io, self.get_sync, name
+            )
+        return self._mem_get(name)
+
+    async def delete(self, name: str) -> bool:
+        if self._io is not None:
+            return await asyncio.get_running_loop().run_in_executor(
+                self._io, self.del_sync, name
+            )
+        return self._index_del(name)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"blobs": len(self._index), "bytes": self._total}
 
 
 # ---------------------------------------------------------------------------
@@ -660,6 +811,11 @@ class HubServer:
             self.state.journal = lambda rec, payload: self.journal.append(
                 self.state, rec, payload
             )
+            # KV blobs persist as files (not WAL records), served off the
+            # journal's single I/O worker
+            self.state.blob_store.attach_disk(
+                os.path.join(data_dir, "blobs"), self.journal._io
+            )
         self._server: Optional[asyncio.AbstractServer] = None
         self._expiry_task: Optional[asyncio.Task] = None
         self._conn_writers: set = set()
@@ -892,6 +1048,24 @@ class HubServer:
                     elif op == "obj_del":
                         existed = st.obj_del(hdr["name"])
                         await send({"seq": seq, "ok": True, "found": existed})
+                    elif op == "blob_put":
+                        await st.blob_store.put(hdr["name"], payload)
+                        await send({"seq": seq, "ok": True})
+                    elif op == "blob_get":
+                        blob = await st.blob_store.get(hdr["name"])
+                        if blob is None:
+                            await send(
+                                {"seq": seq, "ok": False, "err": "not found"}
+                            )
+                        else:
+                            await send({"seq": seq, "ok": True}, blob)
+                    elif op == "blob_del":
+                        existed = await st.blob_store.delete(hdr["name"])
+                        await send({"seq": seq, "ok": True, "found": existed})
+                    elif op == "blob_stats":
+                        await send(
+                            {"seq": seq, "ok": True, **st.blob_store.stats()}
+                        )
                     elif op == "ping":
                         await send({"seq": seq, "ok": True})
                     else:

@@ -12,6 +12,17 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Tier-1 runs single-core CPU, where XLA's default optimization pipeline is
+# most of the suite's wall clock (compiling tiny test models over and over).
+# Backend optimization level 0 roughly halves the suite; identity tests
+# compare like-for-like executables and reference-parity tests stay within
+# tolerance (fp32 accumulation is forced separately below).  An explicit
+# user/CI setting of the flag wins.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_backend_optimization_level" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_backend_optimization_level=0"
+    ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 # the axon sitecustomize force-registers the TPU PJRT plugin (and pins
 # JAX_PLATFORMS=axon) whenever PALLAS_AXON_POOL_IPS is set; clear it so the

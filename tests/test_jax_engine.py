@@ -131,8 +131,10 @@ def test_cancellation_frees_pages(run):
                 if len(got) == 2:
                     stream.ctx.stop_generating()
             assert len(got) >= 2
-            # let the loop process the cancellation
-            for _ in range(20):
+            # let the loop process the cancellation; a multistep block or a
+            # mid-flight bucket compile can hold the tick for a while, so
+            # poll generously and break the moment the pages come back
+            for _ in range(500):
                 await asyncio.sleep(0.01)
                 if engine.kv.allocator.used_pages == 0:
                     break

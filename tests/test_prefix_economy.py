@@ -1,0 +1,92 @@
+"""Fleet KV economy proof rig (ISSUE 20 acceptance): ``bench.run_prefix_economy``
+serves a long shared prefix on a warm worker, mirrors its host-tier
+evictions into a fleet G4 blob store, then has two cold workers answer the
+same prompt -- one recomputing the whole prefill, one fetching the prefix
+KV frames from G4 through the offload onboarding plane.
+
+The report must show the economy earning its keep: cold-worker TTFT with
+the G4 fetch strictly below recompute, a warm-local floor below both,
+token identity (greedy AND per-request-seeded) across all three legs, the
+full prefix published and fetched (fleet hit rate 1.0), and the router
+gate's decision evidence carrying both cost estimates.
+
+The smoke shape runs here in tier-1 (CPU, ~15s); ``bench.py``'s main()
+runs the full shape in the slow lane.
+"""
+
+import asyncio
+import importlib.util
+import os
+
+import pytest
+
+_BENCH = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "bench.py")
+)
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench_prefix_econ", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def econ_report():
+    # one rig run shared by every assertion below (module-scoped: the run
+    # is the expensive part, the checks are reads of its report)
+    bench = _load_bench()
+    return asyncio.run(bench.run_prefix_economy(scale="smoke"))
+
+
+def test_fetch_beats_recompute(econ_report):
+    # the acceptance inequality: a cold worker that fetches the prefix
+    # from the G4 store must answer strictly faster than one recomputing
+    # the whole prefill
+    assert (
+        econ_report["prefix_econ_ttft_g4_fetch_ms"]
+        < econ_report["prefix_econ_ttft_recompute_ms"]
+    )
+
+
+def test_warm_local_is_the_floor(econ_report):
+    # a G1-resident prefix beats both cold legs: the router's preference
+    # order (warm worker > fetch > recompute) is grounded in measurement
+    assert (
+        econ_report["prefix_econ_ttft_warm_local_ms"]
+        < econ_report["prefix_econ_ttft_recompute_ms"]
+    )
+
+
+def test_token_identity_across_all_three_legs(econ_report):
+    assert econ_report["prefix_econ_token_identity_greedy"] is True
+    assert econ_report["prefix_econ_token_identity_seeded"] is True
+
+
+def test_full_prefix_published_and_fetched(econ_report):
+    n = econ_report["prefix_econ_prefix_tokens"] // 4  # smoke page=4
+    assert econ_report["prefix_econ_published_blocks"] == n
+    # both onboard passes (warmup prefix + measured prefix) delivered
+    assert econ_report["prefix_econ_fetched_blocks"] == 2 * n
+    assert econ_report["prefix_econ_fleet_prefix_hit_rate"] == 1.0
+    assert econ_report["prefix_econ_failed_fetches"] == 0
+
+
+def test_g4_transfer_telemetry(econ_report):
+    assert econ_report["prefix_econ_g4_bytes"] > 0
+    assert econ_report["prefix_econ_kv_g4_gbps"] > 0
+
+
+def test_gate_evidence_carries_both_estimates(econ_report):
+    # every gate verdict ships both cost predictions -- the decision is
+    # auditable whichever way it goes
+    assert econ_report["prefix_econ_gate_decision"] == "fetch"
+    assert econ_report["prefix_econ_gate_source"] == "remote"
+    assert econ_report["prefix_econ_gate_pred_fetch_ms"] is not None
+    assert econ_report["prefix_econ_gate_pred_prefill_ms"] > 0
+    assert (
+        econ_report["prefix_econ_gate_pred_fetch_ms"]
+        < econ_report["prefix_econ_gate_pred_prefill_ms"]
+    )
+    assert econ_report["prefix_econ_gate_ship_bytes"] > 0

